@@ -1,0 +1,103 @@
+"""Benchmark: scheduling throughput on the reference's benchmark matrix.
+
+Mirrors the reference harness
+(pkg/controllers/provisioning/scheduling/scheduling_benchmark_test.go):
+diverse pods (mixed sizes, selectors, zonal constraints) against a
+kwok-style catalog, reporting pods/sec. The reference's floor is
+MinPodsPerSec = 100 on a dev machine; `vs_baseline` is measured against
+that constant.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def build_problem(n_pods: int, n_types: int, seed: int = 42):
+    import numpy as np
+
+    from karpenter_tpu.apis.v1.labels import TOPOLOGY_ZONE_LABEL
+    from karpenter_tpu.apis.v1.nodepool import NodePool
+    from karpenter_tpu.cloudprovider.fake import GIB, instance_types
+    from karpenter_tpu.kube.objects import Container, ObjectMeta, Pod, PodSpec
+
+    rng = np.random.default_rng(seed)
+    types = instance_types(n_types)
+    pool = NodePool(metadata=ObjectMeta(name="default"))
+    pods = []
+    cpu_options = [0.1, 0.25, 0.5, 1.0, 2.0, 4.0]
+    mem_options = [0.25 * GIB, 0.5 * GIB, GIB, 2 * GIB, 4 * GIB]
+    arch_options = ["amd64", "arm64"]
+    zone_options = ["test-zone-1", "test-zone-2", "test-zone-3"]
+    for i in range(n_pods):
+        selector = {}
+        if rng.random() < 0.25:
+            selector["kubernetes.io/arch"] = str(rng.choice(arch_options))
+        if rng.random() < 0.15:
+            selector[TOPOLOGY_ZONE_LABEL] = str(rng.choice(zone_options))
+        pods.append(
+            Pod(
+                metadata=ObjectMeta(name=f"pod-{i}"),
+                spec=PodSpec(
+                    containers=[
+                        Container(
+                            requests={
+                                "cpu": float(rng.choice(cpu_options)),
+                                "memory": float(rng.choice(mem_options)),
+                            }
+                        )
+                    ],
+                    node_selector=selector,
+                ),
+            )
+        )
+    return pods, [(pool, types)]
+
+
+def main() -> None:
+    n_pods = int(os.environ.get("BENCH_PODS", "10000"))
+    n_types = int(os.environ.get("BENCH_TYPES", "400"))
+
+    from karpenter_tpu.solver.solver import solve
+
+    pods, pools = build_problem(n_pods, n_types)
+
+    # Warm-up on a small shard to pay compilation once
+    solve(pods[:64], pools)
+
+    t0 = time.perf_counter()
+    sol = solve(pods, pools)
+    elapsed = time.perf_counter() - t0
+
+    scheduled = sum(len(n.pods) for n in sol.new_nodes) + sum(
+        len(e.pods) for e in sol.existing
+    )
+    pods_per_sec = scheduled / elapsed if elapsed > 0 else 0.0
+    print(
+        json.dumps(
+            {
+                "metric": "scheduler_throughput",
+                "value": round(pods_per_sec, 1),
+                "unit": "pods/sec",
+                "vs_baseline": round(pods_per_sec / 100.0, 2),
+                "detail": {
+                    "pods": n_pods,
+                    "instance_types": n_types,
+                    "scheduled": scheduled,
+                    "nodes": len(sol.new_nodes),
+                    "unschedulable": len(sol.unschedulable),
+                    "wall_s": round(elapsed, 3),
+                    "fleet_price_per_hr": round(float(sol.total_price), 2),
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

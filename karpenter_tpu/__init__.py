@@ -1,0 +1,30 @@
+"""karpenter-tpu: a TPU-native node-autoscaling framework.
+
+A from-scratch rebuild of the capabilities of Karpenter
+(sigs.k8s.io/karpenter): just-in-time node provisioning driven by
+unschedulable pods, price-aware bin-packing over cloud instance-type
+catalogs, and continuous fleet disruption (emptiness / drift /
+expiration / consolidation) under disruption budgets.
+
+Where the reference runs its two hot paths (the provisioning
+bin-packing loop and the consolidation search) as sequential in-process
+Go heuristics, this framework formulates them as batched JAX/XLA
+programs: pod x instance-type x offering feasibility is evaluated as
+dense mask algebra on TPU, and the packing loop is a `lax.scan` whose
+per-step work is vectorized over nodes and instance types.
+
+Layer map (mirrors SURVEY.md section 1):
+  apis/          NodePool / NodeClaim / NodeOverlay API types
+  scheduling/    Requirement set-algebra, taints, hostports, volumes
+  cloudprovider/ CloudProvider SPI, InstanceType/Offering model,
+                 fake + kwok-style simulated providers
+  kube/          in-memory API substrate (objects, watch, patch)
+  state/         in-memory cluster mirror (Cluster, StateNode)
+  solver/        the TPU solver: dense encodings + batched packing
+  provisioning/  batcher, provisioner, scheduler orchestration
+  disruption/    emptiness / drift / consolidation engine
+  lifecycle/     nodeclaim launch/register/initialize, termination
+  operator/      runtime wiring, options
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,193 @@
+"""kwok-style simulated cloud provider.
+
+Counterpart of kwok/cloudprovider/cloudprovider.go: `create` picks the
+cheapest compatible offering and records a simulated instance;
+`tick(now)` materializes Node objects for instances whose registration
+delay has elapsed (fabricated nodes, no kubelet — the reference's kwok
+pattern that lets hundred-node scale-ups run on a laptop). Nodes appear
+with the `unregistered` NoExecute taint, capacity/allocatable from the
+instance type, and Ready=True.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+from karpenter_tpu.apis.v1.labels import (
+    ARCH_LABEL,
+    CAPACITY_TYPE_LABEL,
+    INSTANCE_TYPE_LABEL,
+    NODEPOOL_LABEL,
+    OS_LABEL,
+    TOPOLOGY_ZONE_LABEL,
+    UNREGISTERED_NO_EXECUTE_TAINT,
+)
+from karpenter_tpu.apis.v1.nodeclaim import NodeClaim, NodeClaimStatus
+from karpenter_tpu.apis.v1.nodepool import NodePool
+from karpenter_tpu.cloudprovider.fake import kwok_instance_types
+from karpenter_tpu.cloudprovider.types import (
+    CloudProvider,
+    InstanceType,
+    InsufficientCapacityError,
+    NodeClaimNotFoundError,
+    order_by_price,
+)
+from karpenter_tpu.kube.client import KubeClient
+from karpenter_tpu.kube.objects import (
+    Node,
+    NodeCondition,
+    NodeSpec,
+    NodeStatus,
+    ObjectMeta,
+)
+from karpenter_tpu.scheduling.requirement import Requirement
+from karpenter_tpu.scheduling.requirements import Requirements
+from karpenter_tpu.utils.resources import fits
+
+
+@dataclass
+class _Instance:
+    claim_name: str
+    node_name: str
+    instance_type: InstanceType
+    labels: dict[str, str]
+    created_at: float
+    registered: bool = False
+    terminated: bool = False
+
+
+class KwokCloudProvider(CloudProvider):
+    def __init__(
+        self,
+        kube: KubeClient,
+        types: Optional[list[InstanceType]] = None,
+        registration_delay: float = 0.0,
+    ):
+        self.kube = kube
+        self.types = types if types is not None else kwok_instance_types()
+        self.registration_delay = registration_delay
+        self._lock = threading.RLock()
+        self._instances: dict[str, _Instance] = {}  # provider id -> instance
+        self._counter = itertools.count(1)
+
+    # -- SPI ------------------------------------------------------------------
+
+    def create(self, node_claim: NodeClaim) -> NodeClaim:
+        with self._lock:
+            reqs = Requirements(
+                Requirement(r.key, r.operator, r.values, r.min_values)
+                for r in node_claim.spec.requirements
+            )
+            compatible = [
+                it
+                for it in self.types
+                if it.requirements.intersects(reqs) is None
+                and it.offerings.available().has_compatible(reqs)
+                and fits(node_claim.spec.resources, it.allocatable)
+            ]
+            if not compatible:
+                raise InsufficientCapacityError(
+                    f"no offering satisfies {node_claim.metadata.name}"
+                )
+            chosen = order_by_price(compatible, reqs)[0]
+            offering = chosen.offerings.available().compatible(reqs).cheapest()
+            seq = next(self._counter)
+            node_name = f"{node_claim.metadata.name}-{seq}"
+            provider_id = f"kwok://{node_name}"
+            labels = {
+                **node_claim.metadata.labels,
+                INSTANCE_TYPE_LABEL: chosen.name,
+                TOPOLOGY_ZONE_LABEL: offering.zone,
+                CAPACITY_TYPE_LABEL: offering.capacity_type,
+                ARCH_LABEL: chosen.requirements.get(ARCH_LABEL).any_value(),
+                OS_LABEL: chosen.requirements.get(OS_LABEL).any_value() or "linux",
+            }
+            self._instances[provider_id] = _Instance(
+                claim_name=node_claim.metadata.name,
+                node_name=node_name,
+                instance_type=chosen,
+                labels=labels,
+                created_at=time.time(),
+            )
+            out = NodeClaim(
+                metadata=node_claim.metadata,
+                spec=node_claim.spec,
+                status=NodeClaimStatus(
+                    provider_id=provider_id,
+                    image_id="kwok-image",
+                    capacity=dict(chosen.capacity),
+                    allocatable=dict(chosen.allocatable),
+                ),
+                status_conditions=node_claim.status_conditions,
+            )
+            out.metadata.labels = labels
+            return out
+
+    def tick(self, now: Optional[float] = None) -> list[Node]:
+        """Materialize Node objects for instances past the registration
+        delay (kwok NodeRegistrationDelay, cloudprovider.go:74-83)."""
+        now = time.time() if now is None else now
+        created = []
+        with self._lock:
+            for pid, inst in self._instances.items():
+                if inst.registered or inst.terminated:
+                    continue
+                if now - inst.created_at < self.registration_delay:
+                    continue
+                claim = self.kube.get_node_claim(inst.claim_name)
+                taints = [UNREGISTERED_NO_EXECUTE_TAINT]
+                if claim is not None:
+                    taints += list(claim.spec.taints) + list(claim.spec.startup_taints)
+                node = Node(
+                    metadata=ObjectMeta(name=inst.node_name, namespace="",
+                                        labels=dict(inst.labels)),
+                    spec=NodeSpec(taints=taints, provider_id=pid),
+                    status=NodeStatus(
+                        capacity=dict(inst.instance_type.capacity),
+                        allocatable=dict(inst.instance_type.allocatable),
+                        conditions=[NodeCondition(type="Ready", status="True")],
+                    ),
+                )
+                self.kube.create(node)
+                inst.registered = True
+                created.append(node)
+        return created
+
+    def delete(self, node_claim: NodeClaim) -> None:
+        with self._lock:
+            pid = node_claim.status.provider_id
+            inst = self._instances.get(pid)
+            if inst is None or inst.terminated:
+                raise NodeClaimNotFoundError(pid)
+            inst.terminated = True
+            del self._instances[pid]
+
+    def get(self, provider_id: str) -> NodeClaim:
+        with self._lock:
+            inst = self._instances.get(provider_id)
+            if inst is None:
+                raise NodeClaimNotFoundError(provider_id)
+            claim = NodeClaim(metadata=ObjectMeta(name=inst.claim_name, namespace=""))
+            claim.status.provider_id = provider_id
+            claim.metadata.labels = dict(inst.labels)
+            return claim
+
+    def list(self) -> list[NodeClaim]:
+        with self._lock:
+            return [self.get(pid) for pid in list(self._instances)]
+
+    def get_instance_types(self, node_pool: Optional[NodePool]) -> list[InstanceType]:
+        return list(self.types)
+
+    def is_drifted(self, node_claim: NodeClaim) -> str:
+        return ""
+
+    def name(self) -> str:
+        return "kwok"
+
+    def get_supported_node_classes(self) -> list[str]:
+        return ["KwokNodeClass"]

@@ -1,0 +1,216 @@
+"""In-memory Kubernetes-style API server.
+
+The reference's fabric is the real API server (watches + optimistic
+concurrency). This build substitutes a single-process store with the
+same semantics the controllers rely on:
+
+- create/get/list/update/delete by (kind, key)
+- resource versions bumped on write; stale updates rejected
+- finalizers: delete sets deletion_timestamp while finalizers remain;
+  the object disappears when the last finalizer is removed
+- watch: subscribers receive (event, obj) synchronously on mutation —
+  the analogue of informer event handlers feeding state.Cluster
+- immutable NodeClaim spec (the reference enforces via CEL)
+
+Controllers are written against this client; swapping in a real
+apiserver adapter later only replaces this module.
+"""
+
+from __future__ import annotations
+
+import copy
+import threading
+import time
+from typing import Callable, Iterable, Optional
+
+from karpenter_tpu.apis.v1.nodeclaim import NodeClaim
+from karpenter_tpu.apis.v1.nodepool import NodePool
+from karpenter_tpu.kube.objects import (
+    DaemonSet,
+    LabelSelector,
+    Node,
+    PersistentVolume,
+    PersistentVolumeClaim,
+    Pod,
+    PodDisruptionBudget,
+    StorageClass,
+)
+
+ADDED = "ADDED"
+MODIFIED = "MODIFIED"
+DELETED = "DELETED"
+
+WatchHandler = Callable[[str, object], None]
+
+
+class ConflictError(Exception):
+    pass
+
+
+class NotFoundError(Exception):
+    pass
+
+
+class InvalidError(Exception):
+    pass
+
+
+class KubeClient:
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._store: dict[str, dict[str, object]] = {}
+        self._watchers: dict[str, list[WatchHandler]] = {}
+        self._rv = 0
+
+    # -- core CRUD ------------------------------------------------------------
+
+    def _bucket(self, kind: str) -> dict[str, object]:
+        return self._store.setdefault(kind, {})
+
+    def create(self, obj) -> object:
+        with self._lock:
+            bucket = self._bucket(obj.kind)
+            if obj.key in bucket:
+                raise ConflictError(f"{obj.kind} {obj.key} already exists")
+            self._rv += 1
+            obj.metadata.resource_version = self._rv
+            obj.metadata.generation = 1
+            bucket[obj.key] = obj
+            self._notify(obj.kind, ADDED, obj)
+            return obj
+
+    def get(self, kind: str, key: str):
+        with self._lock:
+            obj = self._bucket(kind).get(key)
+            return obj
+
+    def list(self, kind: str, namespace: Optional[str] = None,
+             selector: Optional[LabelSelector] = None) -> list:
+        with self._lock:
+            out = []
+            for obj in self._bucket(kind).values():
+                if namespace is not None and obj.metadata.namespace != namespace:
+                    continue
+                if selector is not None and not selector.matches(obj.metadata.labels):
+                    continue
+                out.append(obj)
+            return out
+
+    def update(self, obj) -> object:
+        """Write an object back; bumps resource version.
+
+        NodeClaim specs are immutable (nodeclaim.go:145 CEL rule).
+        """
+        with self._lock:
+            bucket = self._bucket(obj.kind)
+            existing = bucket.get(obj.key)
+            if existing is None:
+                raise NotFoundError(f"{obj.kind} {obj.key}")
+            if isinstance(obj, NodeClaim) and existing is not obj:
+                if repr(existing.spec) != repr(obj.spec):
+                    raise InvalidError("NodeClaim spec is immutable")
+            self._rv += 1
+            obj.metadata.resource_version = self._rv
+            bucket[obj.key] = obj
+            self._notify(obj.kind, MODIFIED, obj)
+            return obj
+
+    def touch(self, obj) -> object:
+        """Record a mutation made in place on a stored object."""
+        return self.update(obj)
+
+    def delete(self, obj_or_kind, key: Optional[str] = None, now: Optional[float] = None):
+        """Delete with finalizer semantics."""
+        with self._lock:
+            if isinstance(obj_or_kind, str):
+                obj = self._bucket(obj_or_kind).get(key)
+            else:
+                obj = self._bucket(obj_or_kind.kind).get(obj_or_kind.key)
+            if obj is None:
+                return None
+            if obj.metadata.finalizers:
+                if obj.metadata.deletion_timestamp is None:
+                    obj.metadata.deletion_timestamp = now if now is not None else time.time()
+                    self._rv += 1
+                    obj.metadata.resource_version = self._rv
+                    self._notify(obj.kind, MODIFIED, obj)
+                return obj
+            del self._bucket(obj.kind)[obj.key]
+            self._notify(obj.kind, DELETED, obj)
+            return None
+
+    def remove_finalizer(self, obj, finalizer: str) -> None:
+        with self._lock:
+            if finalizer in obj.metadata.finalizers:
+                obj.metadata.finalizers.remove(finalizer)
+            if obj.metadata.deletion_timestamp is not None and not obj.metadata.finalizers:
+                bucket = self._bucket(obj.kind)
+                if obj.key in bucket:
+                    del bucket[obj.key]
+                    self._notify(obj.kind, DELETED, obj)
+            else:
+                self.update(obj)
+
+    # -- watch ----------------------------------------------------------------
+
+    def watch(self, kind: str, handler: WatchHandler) -> None:
+        with self._lock:
+            self._watchers.setdefault(kind, []).append(handler)
+            # replay current state (informer initial LIST)
+            for obj in self._bucket(kind).values():
+                handler(ADDED, obj)
+
+    def _notify(self, kind: str, event: str, obj) -> None:
+        for handler in self._watchers.get(kind, []):
+            handler(event, obj)
+
+    # -- typed sugar ----------------------------------------------------------
+
+    def pods(self, namespace: Optional[str] = None,
+             selector: Optional[LabelSelector] = None) -> list[Pod]:
+        return self.list("Pod", namespace, selector)
+
+    def nodes(self) -> list[Node]:
+        return self.list("Node")
+
+    def node_claims(self) -> list[NodeClaim]:
+        return self.list("NodeClaim")
+
+    def node_pools(self) -> list[NodePool]:
+        return self.list("NodePool")
+
+    def daemon_sets(self) -> list[DaemonSet]:
+        return self.list("DaemonSet")
+
+    def pdbs(self) -> list[PodDisruptionBudget]:
+        return self.list("PodDisruptionBudget")
+
+    def get_pod(self, namespace: str, name: str) -> Optional[Pod]:
+        return self.get("Pod", f"{namespace}/{name}")
+
+    def get_node(self, name: str) -> Optional[Node]:
+        return self.get("Node", name)
+
+    def get_node_claim(self, name: str) -> Optional[NodeClaim]:
+        return self.get("NodeClaim", name)
+
+    def get_node_pool(self, name: str) -> Optional[NodePool]:
+        return self.get("NodePool", name)
+
+    def get_pvc(self, namespace: str, name: str) -> Optional[PersistentVolumeClaim]:
+        return self.get("PersistentVolumeClaim", f"{namespace}/{name}")
+
+    def get_storage_class(self, name: str) -> Optional[StorageClass]:
+        return self.get("StorageClass", name)
+
+    def get_pv(self, name: str) -> Optional[PersistentVolume]:
+        return self.get("PersistentVolume", name)
+
+    def bind_pod(self, pod: Pod, node_name: str) -> None:
+        """The scheduler binding: sets spec.node_name."""
+        with self._lock:
+            pod.spec.node_name = node_name
+            self.update(pod)
+
+    def pods_on_node(self, node_name: str) -> list[Pod]:
+        return [p for p in self.pods() if p.spec.node_name == node_name]

@@ -1,0 +1,207 @@
+"""NodeClaim lifecycle: launch -> registration -> initialization -> liveness.
+
+Counterpart of pkg/controllers/nodeclaim/lifecycle (controller.go:119-183
+and launch/registration/initialization/liveness sub-reconcilers), plus
+the finalize path (controller.go:184-273) that tears the instance down
+when a claim is deleted.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Optional
+
+from karpenter_tpu.apis.v1.labels import (
+    NODE_INITIALIZED_LABEL,
+    NODE_REGISTERED_LABEL,
+    NODECLAIM_TERMINATION_TIMESTAMP_ANNOTATION,
+    NODEPOOL_LABEL,
+    TERMINATION_FINALIZER,
+    UNREGISTERED_TAINT_KEY,
+)
+from karpenter_tpu.apis.v1.nodeclaim import (
+    COND_INITIALIZED,
+    COND_INSTANCE_TERMINATING,
+    COND_LAUNCHED,
+    COND_REGISTERED,
+    NodeClaim,
+)
+from karpenter_tpu.cloudprovider.types import (
+    CloudProvider,
+    InsufficientCapacityError,
+    NodeClaimNotFoundError,
+    NodeClassNotReadyError,
+)
+from karpenter_tpu.kube.client import KubeClient
+from karpenter_tpu.kube.objects import Node
+from karpenter_tpu.scheduling.taints import is_ephemeral
+from karpenter_tpu.state.nodepoolhealth import HealthTracker
+from karpenter_tpu.utils.duration import parse_duration
+from karpenter_tpu.utils.resources import fits
+
+log = logging.getLogger("karpenter.lifecycle")
+
+LAUNCH_TIMEOUT_SECONDS = 5 * 60       # liveness.go:51
+REGISTRATION_TIMEOUT_SECONDS = 15 * 60  # liveness.go:56
+
+
+class NodeClaimLifecycle:
+    def __init__(
+        self,
+        kube: KubeClient,
+        cloud_provider: CloudProvider,
+        health: Optional[HealthTracker] = None,
+    ):
+        self.kube = kube
+        self.cloud_provider = cloud_provider
+        self.health = health or HealthTracker()
+
+    # -- entry ----------------------------------------------------------------
+
+    def reconcile(self, claim: NodeClaim, now: Optional[float] = None) -> None:
+        now = time.time() if now is None else now
+        if claim.metadata.deletion_timestamp is not None:
+            self._finalize(claim, now)
+            return
+        self._launch(claim, now)
+        if claim.status_conditions.is_true(COND_LAUNCHED):
+            self._register(claim, now)
+        if claim.status_conditions.is_true(COND_REGISTERED):
+            self._initialize(claim, now)
+        self._liveness(claim, now)
+
+    def reconcile_all(self, now: Optional[float] = None) -> None:
+        for claim in list(self.kube.node_claims()):
+            self.reconcile(claim, now)
+
+    # -- launch (launch.go:45-125) --------------------------------------------
+
+    def _launch(self, claim: NodeClaim, now: float) -> None:
+        if claim.status.provider_id:
+            claim.status_conditions.set_true(COND_LAUNCHED, now=now)
+            return
+        try:
+            launched = self.cloud_provider.create(claim)
+        except (InsufficientCapacityError, NodeClassNotReadyError) as err:
+            # ICE: delete the claim so pods reschedule elsewhere
+            log.info("launch failed for %s: %s; deleting claim", claim.metadata.name, err)
+            self.health.record(claim.metadata.labels.get(NODEPOOL_LABEL, ""), False)
+            self._delete_claim(claim, now)
+            return
+        except Exception as err:
+            claim.status_conditions.set_false(COND_LAUNCHED, "LaunchFailed", str(err), now=now)
+            self.kube.update(claim)
+            return
+        claim.status.provider_id = launched.status.provider_id
+        claim.status.image_id = launched.status.image_id
+        claim.status.capacity = launched.status.capacity
+        claim.status.allocatable = launched.status.allocatable
+        claim.metadata.labels = launched.metadata.labels
+        claim.status_conditions.set_true(COND_LAUNCHED, now=now)
+        self.kube.update(claim)
+
+    # -- registration (registration.go:50-130) --------------------------------
+
+    def _register(self, claim: NodeClaim, now: float) -> None:
+        if claim.status_conditions.is_true(COND_REGISTERED) and claim.status.node_name:
+            return
+        node = self._node_for(claim)
+        if node is None:
+            return
+        # sync labels/annotations; drop the unregistered taint
+        node.metadata.labels.update(claim.metadata.labels)
+        node.metadata.labels[NODE_REGISTERED_LABEL] = "true"
+        node.metadata.annotations.update(claim.metadata.annotations)
+        node.spec.taints = [
+            t for t in node.spec.taints if t.key != UNREGISTERED_TAINT_KEY
+        ]
+        if TERMINATION_FINALIZER not in node.metadata.finalizers:
+            node.metadata.finalizers.append(TERMINATION_FINALIZER)
+        self.kube.update(node)
+        claim.status.node_name = node.metadata.name
+        claim.status_conditions.set_true(COND_REGISTERED, now=now)
+        self.kube.update(claim)
+        self.health.record(claim.metadata.labels.get(NODEPOOL_LABEL, ""), True)
+
+    # -- initialization (initialization.go:46-134) -----------------------------
+
+    def _initialize(self, claim: NodeClaim, now: float) -> None:
+        if claim.status_conditions.is_true(COND_INITIALIZED):
+            return
+        node = self._node_for(claim)
+        if node is None or not node.is_ready():
+            return
+        # startup taints must be gone
+        startup_keys = {(t.key, t.effect) for t in claim.spec.startup_taints}
+        for taint in node.spec.taints:
+            if (taint.key, taint.effect) in startup_keys:
+                return
+            if is_ephemeral(taint):
+                return
+        # requested extended resources must be registered
+        if not fits(claim.spec.resources, node.status.allocatable):
+            return
+        node.metadata.labels[NODE_INITIALIZED_LABEL] = "true"
+        self.kube.update(node)
+        claim.status_conditions.set_true(COND_INITIALIZED, now=now)
+        self.kube.update(claim)
+
+    # -- liveness (liveness.go:51-124) -----------------------------------------
+
+    def _liveness(self, claim: NodeClaim, now: float) -> None:
+        age = now - claim.metadata.creation_timestamp
+        if not claim.status_conditions.is_true(COND_LAUNCHED):
+            if age > LAUNCH_TIMEOUT_SECONDS:
+                log.info("launch timeout for %s; deleting", claim.metadata.name)
+                self.health.record(claim.metadata.labels.get(NODEPOOL_LABEL, ""), False)
+                self._delete_claim(claim, now)
+            return
+        if not claim.status_conditions.is_true(COND_REGISTERED):
+            if age > REGISTRATION_TIMEOUT_SECONDS:
+                log.info("registration timeout for %s; deleting", claim.metadata.name)
+                self.health.record(claim.metadata.labels.get(NODEPOOL_LABEL, ""), False)
+                self._delete_claim(claim, now)
+
+    # -- finalize (controller.go:184-273) --------------------------------------
+
+    def _finalize(self, claim: NodeClaim, now: float) -> None:
+        if TERMINATION_FINALIZER not in claim.metadata.finalizers:
+            return
+        # annotate the termination deadline from terminationGracePeriod
+        tgp = parse_duration(claim.spec.termination_grace_period)
+        if tgp is not None and (
+            NODECLAIM_TERMINATION_TIMESTAMP_ANNOTATION not in claim.metadata.annotations
+        ):
+            claim.metadata.annotations[
+                NODECLAIM_TERMINATION_TIMESTAMP_ANNOTATION
+            ] = str(claim.metadata.deletion_timestamp + tgp)
+            self.kube.update(claim)
+        # delete node objects first; wait until they are gone
+        nodes = [n for n in self.kube.nodes()
+                 if n.spec.provider_id == claim.status.provider_id]
+        if nodes:
+            for node in nodes:
+                if node.metadata.deletion_timestamp is None:
+                    self.kube.delete(node, now=now)
+            return
+        if claim.status.provider_id:
+            try:
+                self.cloud_provider.delete(claim)
+            except NodeClaimNotFoundError:
+                pass
+        claim.status_conditions.set_true(COND_INSTANCE_TERMINATING, now=now)
+        self.kube.remove_finalizer(claim, TERMINATION_FINALIZER)
+
+    # -- helpers ---------------------------------------------------------------
+
+    def _node_for(self, claim: NodeClaim) -> Optional[Node]:
+        for node in self.kube.nodes():
+            if node.spec.provider_id == claim.status.provider_id:
+                return node
+        return None
+
+    def _delete_claim(self, claim: NodeClaim, now: float) -> None:
+        self.kube.delete(claim, now=now)
+        # finalize immediately: nothing to tear down pre-launch
+        self._finalize(claim, now)

@@ -1,0 +1,176 @@
+"""Node termination: taint -> drain -> volumes -> instance delete.
+
+Counterpart of pkg/controllers/node/termination (controller.go:91-190,
+terminator/terminator.go, terminator/eviction.go): when a node carries
+a deletion timestamp, taint it `disrupted:NoSchedule`, evict pods in
+priority waves (non-critical non-daemon first, critical daemon last),
+respect PDBs and the do-not-disrupt annotation (unless past the
+nodeclaim's termination grace period), await volume detachment, then
+remove the finalizer so the object — and through the nodeclaim
+finalizer, the instance — goes away.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Optional
+
+from karpenter_tpu.apis.v1.labels import (
+    DISRUPTED_NO_SCHEDULE_TAINT,
+    DO_NOT_DISRUPT_ANNOTATION,
+    NODECLAIM_TERMINATION_TIMESTAMP_ANNOTATION,
+    TERMINATION_FINALIZER,
+)
+from karpenter_tpu.apis.v1.nodeclaim import COND_DRAINED, COND_VOLUMES_DETACHED
+from karpenter_tpu.kube.client import KubeClient
+from karpenter_tpu.kube.objects import Node, Pod
+from karpenter_tpu.utils.pdb import PdbLimits
+
+log = logging.getLogger("karpenter.termination")
+
+CRITICAL_PRIORITY = 2_000_000_000  # system-cluster-critical threshold
+
+
+class EvictionQueue:
+    """Per-pod eviction with PDB 429 backoff (terminator/eviction.go)."""
+
+    def __init__(self, kube: KubeClient):
+        self.kube = kube
+        self.blocked: dict[str, str] = {}  # pod key -> blocking pdb
+
+    def evict(self, pod: Pod, now: Optional[float] = None) -> bool:
+        limits = PdbLimits(self.kube)
+        blocking = limits.can_evict(pod)
+        if blocking is not None:
+            self.blocked[pod.key] = blocking
+            return False
+        self.blocked.pop(pod.key, None)
+        self.kube.delete(pod, now=now)
+        return True
+
+
+def _critical(pod: Pod) -> bool:
+    return (
+        pod.spec.priority >= CRITICAL_PRIORITY
+        or pod.spec.priority_class_name in ("system-cluster-critical", "system-node-critical")
+    )
+
+
+def _drain_waves(pods: list[Pod]) -> list[list[Pod]]:
+    """Eviction order (terminator.go:96-139): non-critical non-daemon,
+    critical non-daemon, non-critical daemon, critical daemon."""
+    waves: list[list[Pod]] = [[], [], [], []]
+    for pod in pods:
+        daemon = pod.owner_kind() == "DaemonSet"
+        crit = _critical(pod)
+        idx = (2 if daemon else 0) + (1 if crit else 0)
+        waves[idx].append(pod)
+    return [w for w in waves if w]
+
+
+class TerminationController:
+    def __init__(self, kube: KubeClient, cluster=None):
+        self.kube = kube
+        self.cluster = cluster
+        self.queue = EvictionQueue(kube)
+
+    def reconcile(self, node: Node, now: Optional[float] = None) -> None:
+        now = time.time() if now is None else now
+        if node.metadata.deletion_timestamp is None:
+            return
+        if TERMINATION_FINALIZER not in node.metadata.finalizers:
+            return
+
+        # 1. taint so nothing new schedules (controller.go:91; terminator.go:55)
+        if not any(t.key == DISRUPTED_NO_SCHEDULE_TAINT.key for t in node.spec.taints):
+            node.spec.taints.append(DISRUPTED_NO_SCHEDULE_TAINT)
+            self.kube.update(node)
+
+        claim = self._claim_for(node)
+        deadline = self._termination_deadline(claim)
+
+        # 2. drain (terminator.go:96-180)
+        remaining = self._drain(node, deadline, now)
+        if remaining:
+            return  # wait for evictions / PDBs; retried next reconcile
+        if claim is not None:
+            claim.status_conditions.set_true(COND_DRAINED, now=now)
+
+        # 3. volume detachment (controller.go:223-268)
+        if not self._volumes_detached(node):
+            if deadline is None or now < deadline:
+                return
+        if claim is not None:
+            claim.status_conditions.set_true(COND_VOLUMES_DETACHED, now=now)
+            self.kube.update(claim)
+
+        # 4. done: drop the finalizer; the nodeclaim finalizer performs
+        # the instance delete once the node object is gone
+        self.kube.remove_finalizer(node, TERMINATION_FINALIZER)
+
+    def reconcile_all(self, now: Optional[float] = None) -> None:
+        for node in list(self.kube.nodes()):
+            self.reconcile(node, now=now)
+
+    # -- helpers ---------------------------------------------------------------
+
+    def _claim_for(self, node: Node):
+        for claim in self.kube.node_claims():
+            if claim.status.provider_id == node.spec.provider_id:
+                return claim
+        return None
+
+    def _termination_deadline(self, claim) -> Optional[float]:
+        if claim is None:
+            return None
+        raw = claim.metadata.annotations.get(
+            NODECLAIM_TERMINATION_TIMESTAMP_ANNOTATION
+        )
+        return float(raw) if raw else None
+
+    def _drain(self, node: Node, deadline: Optional[float], now: float) -> list[Pod]:
+        """Evict one wave at a time; returns pods still on the node
+        that block completion."""
+        pods = [
+            p
+            for p in self.kube.pods_on_node(node.metadata.name)
+            if not p.is_terminal()
+        ]
+        evictable = []
+        for pod in pods:
+            if pod.is_terminating():
+                evictable.append(pod)  # still counts as present
+                continue
+            # do-not-disrupt pods wait for the TGP deadline
+            # (terminator.go:140-180)
+            if (
+                pod.metadata.annotations.get(DO_NOT_DISRUPT_ANNOTATION) == "true"
+                and (deadline is None or now < deadline)
+            ):
+                evictable.append(pod)
+                continue
+            evictable.append(pod)
+        waves = _drain_waves([p for p in evictable if not p.is_terminating()])
+        if waves:
+            force = deadline is not None and now >= deadline
+            for pod in waves[0]:
+                if (
+                    pod.metadata.annotations.get(DO_NOT_DISRUPT_ANNOTATION) == "true"
+                    and not force
+                ):
+                    continue
+                if force:
+                    # TGP enforcement bypasses PDBs (terminator.go:140)
+                    self.kube.delete(pod, now=now)
+                else:
+                    self.queue.evict(pod, now=now)
+        return [
+            p for p in self.kube.pods_on_node(node.metadata.name) if not p.is_terminal()
+        ]
+
+    def _volumes_detached(self, node: Node) -> bool:
+        for pv in self.kube.list("PersistentVolume"):
+            if pv.attached_node == node.metadata.name:
+                return False
+        return True

@@ -1,0 +1,64 @@
+"""Preference relaxation ladder.
+
+Counterpart of provisioning/scheduling/preferences.go:38-141. When a
+pod cannot schedule, its soft constraints are peeled off one rung at a
+time (mutating the in-memory pod only):
+
+  1. drop preferred node-affinity terms
+  2. drop one required node-affinity term (they are ORed; the scheduler
+     only considers the first, so removing it surfaces the next)
+  3. drop ScheduleAnyway topology-spread constraints
+  4. drop preferred pod affinity, then preferred anti-affinity
+  5. tolerate PreferNoSchedule taints (terminal rung)
+
+Returns True if something was relaxed (caller retries), False when the
+ladder is exhausted.
+"""
+
+from __future__ import annotations
+
+from dataclasses import replace
+
+from karpenter_tpu.kube.objects import Affinity, NodeAffinity, Pod, PodAffinity
+
+_RELAXED_MARK = "karpenter.sh/relaxed"
+
+
+def relax(pod: Pod) -> bool:
+    aff = pod.spec.affinity
+    # 1. preferred node affinity
+    if aff and aff.node_affinity and aff.node_affinity.preferred:
+        pod.spec.affinity = replace(
+            aff, node_affinity=replace(aff.node_affinity, preferred=())
+        )
+        return True
+    # 2. required node affinity terms (drop the first OR-term)
+    if aff and aff.node_affinity and len(aff.node_affinity.required) > 1:
+        pod.spec.affinity = replace(
+            aff,
+            node_affinity=replace(aff.node_affinity, required=aff.node_affinity.required[1:]),
+        )
+        return True
+    # 3. ScheduleAnyway spread constraints
+    soft_tsc = [
+        t for t in pod.spec.topology_spread_constraints
+        if t.when_unsatisfiable == "ScheduleAnyway"
+    ]
+    if soft_tsc:
+        pod.spec.topology_spread_constraints = [
+            t for t in pod.spec.topology_spread_constraints
+            if t.when_unsatisfiable != "ScheduleAnyway"
+        ]
+        return True
+    # 4. preferred pod affinity / anti-affinity
+    if aff and aff.pod_affinity and aff.pod_affinity.preferred:
+        pod.spec.affinity = replace(
+            aff, pod_affinity=replace(aff.pod_affinity, preferred=())
+        )
+        return True
+    if aff and aff.pod_anti_affinity and aff.pod_anti_affinity.preferred:
+        pod.spec.affinity = replace(
+            aff, pod_anti_affinity=replace(aff.pod_anti_affinity, preferred=())
+        )
+        return True
+    return False

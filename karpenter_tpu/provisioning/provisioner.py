@@ -1,0 +1,252 @@
+"""Provisioner: pending pods -> NodeClaims.
+
+Counterpart of pkg/controllers/provisioning/provisioner.go: batch
+pending pods (batcher), gate on state sync, snapshot the cluster,
+build a Scheduler, solve, then create NodeClaims (parallel in the
+reference; sequential here — creation is in-memory) while enforcing
+NodePool limits, and nominate target nodes for pods placed on
+existing capacity.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+from karpenter_tpu.apis.v1.labels import (
+    DO_NOT_DISRUPT_ANNOTATION,
+    NODEPOOL_LABEL,
+    TERMINATION_FINALIZER,
+)
+from karpenter_tpu.apis.v1.nodeclaim import (
+    NodeClaim,
+    NodeClaimSpec,
+    RequirementSpec,
+)
+from karpenter_tpu.apis.v1.nodepool import NodePool, order_by_weight
+from karpenter_tpu.cloudprovider.types import CloudProvider
+from karpenter_tpu.kube.client import KubeClient
+from karpenter_tpu.kube.objects import ObjectMeta, Pod
+from karpenter_tpu.provisioning.scheduler import Scheduler, SchedulerResults
+from karpenter_tpu.scheduling.requirement import IN
+from karpenter_tpu.solver.solver import NodePlan
+from karpenter_tpu.state.cluster import Cluster
+from karpenter_tpu.utils import resources as resutil
+
+log = logging.getLogger("karpenter.provisioner")
+
+_claim_counter = itertools.count(1)
+
+
+@dataclass
+class Batcher:
+    """Debounce window for pod arrival (batcher.go:33-92): wait for
+    `idle_seconds` of quiet or `max_seconds` total."""
+
+    idle_seconds: float = 1.0
+    max_seconds: float = 10.0
+    _last_trigger: float = 0.0
+    _window_start: float = 0.0
+    _pending: bool = False
+
+    def trigger(self, now: Optional[float] = None) -> None:
+        now = time.time() if now is None else now
+        if not self._pending:
+            self._window_start = now
+            self._pending = True
+        self._last_trigger = now
+
+    def ready(self, now: Optional[float] = None) -> bool:
+        if not self._pending:
+            return False
+        now = time.time() if now is None else now
+        return (
+            now - self._last_trigger >= self.idle_seconds
+            or now - self._window_start >= self.max_seconds
+        )
+
+    def reset(self) -> None:
+        self._pending = False
+
+
+class Provisioner:
+    def __init__(
+        self,
+        kube: KubeClient,
+        cluster: Cluster,
+        cloud_provider: CloudProvider,
+    ):
+        self.kube = kube
+        self.cluster = cluster
+        self.cloud_provider = cloud_provider
+        self.batcher = Batcher()
+
+    # -- pod intake (provisioner.go:172-195, utils/node) ----------------------
+
+    def get_pending_pods(self) -> list[Pod]:
+        out = []
+        for pod in self.kube.pods():
+            if pod.is_terminal() or pod.is_terminating():
+                continue
+            if pod.spec.node_name:
+                continue
+            if pod.owner_kind() == "DaemonSet":
+                continue
+            if pod.spec.scheduler_name and pod.spec.scheduler_name not in (
+                "default-scheduler",
+                "karpenter",
+            ):
+                continue
+            out.append(pod)
+        return out
+
+    def reschedulable_pods_from_deleting_nodes(self) -> list[Pod]:
+        """Pods on draining nodes are included in the solve so
+        replacement capacity exists before eviction
+        (provisioner.go:324-333)."""
+        out = []
+        for node in self.cluster.nodes():
+            if not node.deleting():
+                continue
+            for pod_key in node.pod_keys:
+                pod = self.kube.get_pod(*pod_key.split("/", 1))
+                if pod is None or pod.is_terminal() or pod.is_terminating():
+                    continue
+                if pod.owner_kind() == "DaemonSet":
+                    continue
+                if pod.metadata.annotations.get(DO_NOT_DISRUPT_ANNOTATION) == "true":
+                    continue
+                out.append(pod)
+        return out
+
+    # -- schedule (provisioner.go:303-400) ------------------------------------
+
+    def ready_pools_with_types(self) -> list[tuple[NodePool, list]]:
+        pools = []
+        for pool in order_by_weight(self.kube.node_pools()):
+            if pool.metadata.deletion_timestamp is not None:
+                continue
+            if pool.is_static():
+                continue
+            if pool.status_conditions.is_false("NodeClassReady"):
+                continue
+            try:
+                types = self.cloud_provider.get_instance_types(pool)
+            except Exception as err:  # provider hiccups skip the pool
+                log.warning("skipping pool %s: %s", pool.metadata.name, err)
+                continue
+            if types:
+                pools.append((pool, types))
+        return pools
+
+    def schedule(self, extra_pods: Sequence[Pod] = ()) -> SchedulerResults:
+        pods = list(extra_pods) or (
+            self.get_pending_pods() + self.reschedulable_pods_from_deleting_nodes()
+        )
+        pools = self.ready_pools_with_types()
+        scheduler = Scheduler(
+            pools_with_types=pools,
+            state_nodes=self.cluster.deep_copy_nodes(),
+            daemonsets=self.cluster.daemonsets(),
+            cluster_pods=self.kube.pods(),
+        )
+        results = scheduler.solve(pods)
+        self.cluster.mark_pod_scheduling_decisions(pods)
+        return results
+
+    # -- create (provisioner.go:407-459) --------------------------------------
+
+    def create_node_claims(self, results: SchedulerResults) -> list[NodeClaim]:
+        created = []
+        for plan in results.new_node_plans:
+            claim = self._claim_from_plan(plan)
+            if claim is None:
+                for pod in plan.pods:
+                    results.errors[pod.key] = "nodepool limits exceeded"
+                continue
+            self.kube.create(claim)
+            plan.claim_name = claim.metadata.name
+            # sync-write into state so back-to-back solves see it
+            # (provisioner.go:448-453)
+            self.cluster.update_node_claim(claim)
+            created.append(claim)
+        # nominate existing nodes receiving pods (provisioner.go:399)
+        for node_name in results.existing_assignments:
+            state = self.cluster.node_for_name(node_name)
+            if state is not None:
+                state.nominate()
+        return created
+
+    def _claim_from_plan(self, plan: NodePlan) -> Optional[NodeClaim]:
+        pool = plan.pool
+        # limits check (reference checks at create: nodepool.go Limits)
+        if pool.spec.limits:
+            usage = self.cluster.nodepool_resources().get(pool.metadata.name, {})
+            biggest = plan.instance_types[0].capacity if plan.instance_types else {}
+            projected = resutil.merge(usage, biggest)
+            for key, limit in pool.spec.limits.items():
+                if projected.get(key, 0.0) > limit:
+                    return None
+
+        requirements = [
+            RequirementSpec(key=spec.key, operator=spec.operator,
+                            values=tuple(spec.values), min_values=spec.min_values)
+            for spec in pool.spec.template.spec.requirements
+        ]
+        for key, value in pool.spec.template.labels.items():
+            requirements.append(RequirementSpec(key=key, operator=IN, values=(value,)))
+        # tighten to the solved instance-type set
+        type_names = tuple(it.name for it in plan.instance_types)
+        requirements.append(
+            RequirementSpec(key="node.kubernetes.io/instance-type", operator=IN,
+                            values=type_names)
+        )
+        zones = tuple(sorted({o.zone for o in plan.offerings}))
+        if zones:
+            requirements.append(
+                RequirementSpec(key="topology.kubernetes.io/zone", operator=IN,
+                                values=zones)
+            )
+        captypes = tuple(sorted({o.capacity_type for o in plan.offerings}))
+        if captypes:
+            requirements.append(
+                RequirementSpec(key="karpenter.sh/capacity-type", operator=IN,
+                                values=captypes)
+            )
+
+        name = f"{pool.metadata.name}-{next(_claim_counter):05d}"
+        claim = NodeClaim(
+            metadata=ObjectMeta(
+                name=name,
+                namespace="",
+                labels={NODEPOOL_LABEL: pool.metadata.name,
+                        **pool.spec.template.labels},
+                annotations=dict(pool.spec.template.annotations),
+                finalizers=[TERMINATION_FINALIZER],
+            ),
+            spec=NodeClaimSpec(
+                requirements=requirements,
+                resources=resutil.requests_for_pods(plan.pods),
+                taints=list(pool.spec.template.spec.taints),
+                startup_taints=list(pool.spec.template.spec.startup_taints),
+                node_class_ref=pool.spec.template.spec.node_class_ref,
+                expire_after=pool.spec.template.spec.expire_after,
+                termination_grace_period=pool.spec.template.spec.termination_grace_period,
+            ),
+        )
+        claim.metadata.annotations["karpenter.sh/nodepool-hash"] = pool.hash()
+        claim.metadata.annotations["karpenter.sh/nodepool-hash-version"] = "v3"
+        return claim
+
+    # -- reconcile loop (provisioner.go:119-145) ------------------------------
+
+    def reconcile(self, now: Optional[float] = None) -> SchedulerResults:
+        if not self.cluster.synced():
+            return SchedulerResults(new_node_plans=[], existing_assignments={})
+        results = self.schedule()
+        self.create_node_claims(results)
+        self.batcher.reset()
+        return results

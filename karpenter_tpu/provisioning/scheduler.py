@@ -1,0 +1,433 @@
+"""Scheduling orchestration: pods + cluster snapshot -> node plans.
+
+Counterpart of provisioning/scheduling/scheduler.go. The flow
+(NewScheduler provisioner.go:235-301 + Solve scheduler.go:377):
+
+1. ready NodePools ordered by weight; instance types per pool
+2. existing + in-flight nodes from the state snapshot (existing first,
+   in-flight sorted fewest-pods-first — scheduler.go:552 comment)
+3. daemonset overhead per pool template (scheduler.go:772-803)
+4. fast path: pods free of topology constraints go through the batched
+   TPU solver in one shot (solver.solve)
+5. slow path: topology-constrained pods run per-pod against the same
+   dense encoding with Topology domain filtering, with the preference
+   relaxation ladder (preferences.go:38-141) applied on failure
+6. results: NodeClaimPlans (pool + price-ordered instance types,
+   truncated to MAX_INSTANCE_TYPES honoring minValues), existing-node
+   assignments, per-pod errors
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+from karpenter_tpu.apis.v1.labels import (
+    CAPACITY_TYPE_LABEL,
+    HOSTNAME_LABEL,
+    NODEPOOL_LABEL,
+    TOPOLOGY_ZONE_LABEL,
+    WELL_KNOWN_LABELS,
+)
+from karpenter_tpu.apis.v1.nodepool import NodePool
+from karpenter_tpu.cloudprovider.types import InstanceType, order_by_price, truncate
+from karpenter_tpu.kube.objects import Pod
+from karpenter_tpu.scheduling.requirement import IN, Requirement
+from karpenter_tpu.scheduling.requirements import Requirements
+from karpenter_tpu.scheduling.taints import tolerates_pod
+from karpenter_tpu.scheduling.topology import Topology
+from karpenter_tpu.solver.encode import (
+    ExistingNodeInput,
+    PodGroup,
+    encode,
+    group_pods,
+)
+from karpenter_tpu.solver.solver import NodePlan, Solution, solve_encoded
+from karpenter_tpu.state.cluster import StateNode
+from karpenter_tpu.utils import resources as resutil
+from karpenter_tpu.provisioning.preferences import relax
+
+# scheduler knob (nodeclaimtemplate.go:41)
+MAX_INSTANCE_TYPES = 600
+
+
+@dataclass
+class SchedulerResults:
+    new_node_plans: list[NodePlan]
+    existing_assignments: dict[str, list[Pod]]      # state-node name -> pods
+    errors: dict[str, str] = field(default_factory=dict)  # pod key -> reason
+
+    @property
+    def scheduled_count(self) -> int:
+        return sum(len(n.pods) for n in self.new_node_plans) + sum(
+            len(p) for p in self.existing_assignments.values()
+        )
+
+
+class Scheduler:
+    def __init__(
+        self,
+        pools_with_types: Sequence[tuple[NodePool, Sequence[InstanceType]]],
+        state_nodes: Sequence[StateNode] = (),
+        daemonsets: Sequence = (),
+        cluster_pods: Sequence[Pod] = (),
+        honor_preferences: bool = True,
+    ):
+        # weight order (provisioner.go:241-262)
+        self.pools_with_types = sorted(
+            pools_with_types, key=lambda pt: (-pt[0].spec.weight, pt[0].metadata.name)
+        )
+        self.honor_preferences = honor_preferences
+        self.daemonsets = list(daemonsets)
+        self.cluster_pods = list(cluster_pods)
+
+        # existing first, then in-flight fewest-pods-first (scheduler.go:552)
+        live = [n for n in state_nodes if not n.deleting() and n.initialized()]
+        inflight = [n for n in state_nodes if not n.deleting() and not n.initialized()]
+        inflight.sort(key=lambda n: (len(n.pod_keys), n.name))
+        self.state_nodes = live + inflight
+        self.existing_inputs = [self._existing_input(n) for n in self.state_nodes]
+
+        self.daemon_overhead = self._daemon_overhead()
+        self.topology = self._build_topology()
+
+    # -- construction helpers -------------------------------------------------
+
+    def _existing_input(self, node: StateNode) -> ExistingNodeInput:
+        reqs = Requirements.from_labels(node.labels())
+        if node.node_claim is not None and not node.registered():
+            for spec in node.node_claim.spec.requirements:
+                reqs.add(Requirement(spec.key, spec.operator, spec.values, spec.min_values))
+        return ExistingNodeInput(
+            name=node.name or (node.node_claim.metadata.name if node.node_claim else ""),
+            requirements=reqs,
+            taints=tuple(node.taints()),
+            available=resutil.positive(node.available()),
+            pool_name=node.nodepool_name(),
+            pod_count=len(node.pod_keys),
+        )
+
+    def _daemon_overhead(self) -> dict[str, dict[str, float]]:
+        """Per-pool daemonset resource overhead (scheduler.go:772-803):
+        sum requests of daemon pods whose scheduling terms admit the
+        pool template."""
+        out: dict[str, dict[str, float]] = {}
+        for pool, types in self.pools_with_types:
+            template_reqs = Requirements()
+            for spec in pool.spec.template.spec.requirements:
+                template_reqs.add(Requirement(spec.key, spec.operator, spec.values))
+            for key, value in pool.spec.template.labels.items():
+                template_reqs.add(Requirement(key, IN, [value]))
+            taints = list(pool.spec.template.spec.taints)
+            total: dict[str, float] = {}
+            for ds in self.daemonsets:
+                pod = Pod(spec=ds.spec.template.spec)
+                pod.metadata.labels = dict(ds.spec.template.metadata.labels)
+                if tolerates_pod(taints, pod) is not None:
+                    continue
+                pod_reqs = Requirements.from_pod(pod, required_only=True)
+                if template_reqs.intersects(pod_reqs) is not None:
+                    continue
+                total = resutil.merge(total, resutil.pod_requests(pod))
+            if total:
+                out[pool.metadata.name] = total
+        return out
+
+    def _build_topology(self) -> Topology:
+        domains: dict[str, set[str]] = {}
+        for pool, types in self.pools_with_types:
+            for it in types:
+                for key in (TOPOLOGY_ZONE_LABEL, CAPACITY_TYPE_LABEL):
+                    req = it.requirements.get(key)
+                    if req.operator() == IN:
+                        domains.setdefault(key, set()).update(req.values)
+        pod_domains: dict[str, dict[str, str]] = {}
+        for node in self.state_nodes:
+            labels = node.labels()
+            for key, value in labels.items():
+                domains.setdefault(key, set()).add(value)
+            if node.name:
+                domains.setdefault(HOSTNAME_LABEL, set()).add(node.name)
+            for pod_key in node.pod_keys:
+                mapping = {k: v for k, v in labels.items()}
+                mapping[HOSTNAME_LABEL] = node.name
+                pod_domains[pod_key] = mapping
+        scheduled = [p for p in self.cluster_pods if p.spec.node_name]
+        return Topology(domains=domains, cluster_pods=scheduled, pending_pods=[],
+                        pod_domains=pod_domains,
+                        honor_schedule_anyway=self.honor_preferences)
+
+    # -- solve ----------------------------------------------------------------
+
+    def solve(self, pods: Sequence[Pod]) -> SchedulerResults:
+        topology_full = Topology(
+            domains=self.topology.domains,
+            cluster_pods=[p for p in self.cluster_pods if p.spec.node_name],
+            pending_pods=list(pods),
+            pod_domains=self._pod_domains(),
+            honor_schedule_anyway=self.honor_preferences,
+        )
+        simple: list[Pod] = []
+        complex_: list[Pod] = []
+        for pod in pods:
+            (complex_ if topology_full.has_constraints(pod) else simple).append(pod)
+
+        results = SchedulerResults(new_node_plans=[], existing_assignments={})
+
+        # fast path: one batched solve on device
+        open_plans: list[NodePlan] = []
+        if simple:
+            solution = self._batched_solve(simple)
+            open_plans = solution.new_nodes
+            for assignment in solution.existing:
+                node = self.state_nodes[assignment.existing_index]
+                results.existing_assignments.setdefault(node.name, []).extend(
+                    assignment.pods
+                )
+                for pod in assignment.pods:
+                    self._commit_existing(node, pod)
+            for pod in solution.unschedulable:
+                retried = False
+                if self.honor_preferences:
+                    relaxed = relax(pod)
+                    if relaxed:
+                        retry = self._batched_solve([pod], required_only=True)
+                        if not retry.unschedulable:
+                            open_plans.extend(retry.new_nodes)
+                            for a in retry.existing:
+                                node = self.state_nodes[a.existing_index]
+                                results.existing_assignments.setdefault(
+                                    node.name, []
+                                ).extend(a.pods)
+                                for p in a.pods:
+                                    self._commit_existing(node, p)
+                            retried = True
+                if not retried:
+                    results.errors[pod.key] = "no compatible instance types or nodes"
+            for plan in open_plans:
+                for pod in plan.pods:
+                    topology_full.register(pod, self._plan_domains(plan))
+
+        # slow path: per-pod with topology filtering
+        if complex_:
+            self._solve_complex(complex_, open_plans, topology_full, results)
+
+        for plan in open_plans:
+            self._finalize_plan(plan)
+        results.new_node_plans.extend(open_plans)
+        return results
+
+    def _pod_domains(self) -> dict[str, dict[str, str]]:
+        out: dict[str, dict[str, str]] = {}
+        for node in self.state_nodes:
+            labels = node.labels()
+            for pod_key in node.pod_keys:
+                mapping = dict(labels)
+                mapping[HOSTNAME_LABEL] = node.name
+                out[pod_key] = mapping
+        return out
+
+    def _batched_solve(self, pods: Sequence[Pod], required_only: bool = False) -> Solution:
+        groups = group_pods(pods, required_only=required_only)
+        enc = encode(
+            groups,
+            self.pools_with_types,
+            self.existing_inputs,
+            self.daemon_overhead,
+        )
+        return solve_encoded(enc)
+
+    def _commit_existing(self, node: StateNode, pod: Pod) -> None:
+        usage = resutil.pod_requests(pod)
+        node.pod_usage = resutil.merge(node.pod_usage, usage)
+        node.pod_keys.add(pod.key)
+        # refresh solver input for subsequent passes
+        idx = self.state_nodes.index(node)
+        self.existing_inputs[idx] = self._existing_input(node)
+
+    def _plan_domains(self, plan: NodePlan) -> dict[str, str]:
+        """Representative domains for a planned node."""
+        out: dict[str, str] = {}
+        if plan.offerings:
+            out[TOPOLOGY_ZONE_LABEL] = plan.offerings[0].zone
+            out[CAPACITY_TYPE_LABEL] = plan.offerings[0].capacity_type
+        out[HOSTNAME_LABEL] = f"planned-{id(plan)}"
+        out[NODEPOOL_LABEL] = plan.pool.metadata.name
+        return out
+
+    # -- slow path ------------------------------------------------------------
+
+    def _solve_complex(
+        self,
+        pods: Sequence[Pod],
+        open_plans: list[NodePlan],
+        topology: Topology,
+        results: SchedulerResults,
+    ) -> None:
+        """Per-pod scheduling with topology domain filtering.
+
+        Pods in FFD order; each pod tries existing nodes, open plans,
+        then a new node, honoring the Topology's allowed domains. On
+        failure the preference ladder relaxes the pod and retries
+        (scheduler.go:456 + preferences.go).
+        """
+        ordered = sorted(
+            pods,
+            key=lambda p: -(
+                resutil.pod_requests(p).get("cpu", 0.0)
+                + resutil.pod_requests(p).get("memory", 0.0) / 2**32
+            ),
+        )
+        for pod in ordered:
+            for _ in range(8):  # relaxation ladder bound
+                if self._try_place(pod, open_plans, topology, results):
+                    break
+                if not (self.honor_preferences and relax(pod)):
+                    results.errors[pod.key] = (
+                        "incompatible with topology constraints or no capacity"
+                    )
+                    break
+
+    def _try_place(
+        self,
+        pod: Pod,
+        open_plans: list[NodePlan],
+        topology: Topology,
+        results: SchedulerResults,
+    ) -> bool:
+        pod_reqs = Requirements.from_pod(pod)
+        requests = resutil.pod_requests(pod)
+
+        # 1) existing nodes
+        for idx, node in enumerate(self.state_nodes):
+            inp = self.existing_inputs[idx]
+            if node.deleting():
+                continue
+            if tolerates_pod(list(inp.taints), pod) is not None:
+                continue
+            if not inp.requirements.is_compatible(
+                pod_reqs, allow_undefined=WELL_KNOWN_LABELS
+            ):
+                continue
+            if not resutil.fits(requests, inp.available):
+                continue
+            labels = node.labels()
+            candidate = {k: {v} for k, v in labels.items()}
+            candidate[HOSTNAME_LABEL] = {node.name}
+            allowed = topology.allowed_domains_for_pod(pod, candidate)
+            if allowed is None:
+                continue
+            node_mut = self.state_nodes[idx]
+            self._commit_existing(node_mut, pod)
+            results.existing_assignments.setdefault(node.name, []).append(pod)
+            topology.register(pod, {k: next(iter(v)) for k, v in allowed.items() if v})
+            return True
+
+        # 2) open planned nodes
+        for plan in open_plans:
+            if not self._plan_can_add(plan, pod, pod_reqs, requests, topology):
+                continue
+            plan.pods.append(pod)
+            topology.register(pod, self._plan_domains(plan))
+            return True
+
+        # 3) new node
+        for pool, types in self.pools_with_types:
+            taints = tuple(pool.spec.template.spec.taints) + tuple(
+                pool.spec.template.spec.startup_taints
+            )
+            if tolerates_pod(list(taints), pod) is not None:
+                continue
+            fitting = []
+            for it in types:
+                if it.requirements.intersects(pod_reqs) is not None:
+                    continue
+                overhead = self.daemon_overhead.get(pool.metadata.name, {})
+                need = resutil.merge(requests, overhead)
+                if not resutil.fits(need, it.allocatable):
+                    continue
+                offerings = it.offerings.available().compatible(pod_reqs)
+                if not offerings:
+                    continue
+                fitting.append((it, offerings))
+            if not fitting:
+                continue
+            zones = {o.zone for _, offs in fitting for o in offs}
+            candidate = {
+                TOPOLOGY_ZONE_LABEL: zones,
+                CAPACITY_TYPE_LABEL: {
+                    o.capacity_type for _, offs in fitting for o in offs
+                },
+                HOSTNAME_LABEL: {f"planned-new-{id(pod)}"},
+                NODEPOOL_LABEL: {pool.metadata.name},
+            }
+            for key, value in pool.spec.template.labels.items():
+                candidate.setdefault(key, {value})
+            allowed = topology.allowed_domains_for_pod(pod, candidate)
+            if allowed is None:
+                continue
+            allowed_zones = allowed.get(TOPOLOGY_ZONE_LABEL, zones)
+            chosen_types = []
+            chosen_offerings = []
+            for it, offs in fitting:
+                offs2 = [o for o in offs if o.zone in allowed_zones]
+                if offs2:
+                    chosen_types.append(it)
+                    chosen_offerings.extend(offs2)
+            if not chosen_types:
+                continue
+            chosen_offerings.sort(key=lambda o: o.price)
+            plan = NodePlan(
+                pool=pool,
+                instance_types=order_by_price(chosen_types, pod_reqs),
+                offerings=chosen_offerings,
+                pods=[pod],
+                price=chosen_offerings[0].price,
+            )
+            open_plans.append(plan)
+            topology.register(pod, self._plan_domains(plan))
+            return True
+        return False
+
+    def _plan_can_add(self, plan: NodePlan, pod: Pod, pod_reqs: Requirements,
+                      requests, topology: Topology) -> bool:
+        taints = tuple(plan.pool.spec.template.spec.taints) + tuple(
+            plan.pool.spec.template.spec.startup_taints
+        )
+        if tolerates_pod(list(taints), pod) is not None:
+            return False
+        overhead = self.daemon_overhead.get(plan.pool.metadata.name, {})
+        used = resutil.merge(
+            overhead, resutil.requests_for_pods(plan.pods), requests
+        )
+        remaining_types = [
+            it
+            for it in plan.instance_types
+            if it.requirements.intersects(pod_reqs) is None
+            and resutil.fits(used, it.allocatable)
+        ]
+        if not remaining_types:
+            return False
+        candidate = {k: {v} for k, v in self._plan_domains(plan).items()}
+        allowed = topology.allowed_domains_for_pod(pod, candidate)
+        if allowed is None:
+            return False
+        plan.instance_types = remaining_types
+        names = {it.name for it in remaining_types}
+        plan.offerings = [
+            o for o in plan.offerings if any(
+                it.offerings and o in it.offerings for it in remaining_types
+            )
+        ] or plan.offerings
+        return True
+
+    # -- finalize -------------------------------------------------------------
+
+    def _finalize_plan(self, plan: NodePlan) -> None:
+        """Price-order and truncate instance types
+        (results.TruncateInstanceTypes, provisioner.go:374)."""
+        reqs = Requirements()
+        plan.instance_types = truncate(
+            plan.instance_types, reqs, MAX_INSTANCE_TYPES
+        )

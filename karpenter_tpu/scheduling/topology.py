@@ -1,0 +1,298 @@
+"""Topology tracking: spread constraints, pod (anti-)affinity.
+
+Counterpart of pkg/controllers/provisioning/scheduling/topology.go +
+topologygroup.go: TopologyGroups own domain-count maps; placement asks
+each matching group which domains remain legal, and registration
+increments the chosen domain. Includes the inverse anti-affinity scan
+(topology.go:280-327): existing pods' required anti-affinity terms
+block incoming pods that match their selectors.
+
+Domains per topology key are discovered from NodePool requirements,
+live nodes and planned nodes (topology.go:105-146). Hostname domains
+are synthesized per (planned) node.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Iterable, Optional
+
+from karpenter_tpu.apis.v1.labels import HOSTNAME_LABEL
+from karpenter_tpu.kube.objects import (
+    LabelSelector,
+    Pod,
+    PodAffinityTerm,
+    TopologySpreadConstraint,
+)
+
+TYPE_SPREAD = "spread"
+TYPE_AFFINITY = "affinity"
+TYPE_ANTI_AFFINITY = "anti-affinity"
+
+
+@dataclass
+class TopologyGroup:
+    """One constraint shared by all pods carrying it
+    (topologygroup.go:56-128)."""
+
+    type: str
+    key: str                       # topology key (zone, hostname, ...)
+    selector: LabelSelector
+    namespaces: frozenset[str]
+    max_skew: int = 1
+    min_domains: Optional[int] = None
+    owners: set[str] = field(default_factory=set)   # pod keys owning it
+    counts: dict[str, int] = field(default_factory=dict)  # domain -> matching pods
+    # anti-affinity only: domains where an *owner* pod landed — future
+    # selector-matching pods are excluded from these (inverse scan)
+    owner_counts: dict[str, int] = field(default_factory=dict)
+
+    def matches(self, namespace: str, labels: dict[str, str]) -> bool:
+        return namespace in self.namespaces and self.selector.matches(labels)
+
+    def register_domain(self, domain: str) -> None:
+        self.counts.setdefault(domain, 0)
+
+    def record(self, domain: str, delta: int = 1) -> None:
+        self.counts[domain] = self.counts.get(domain, 0) + delta
+
+    # -- legality -------------------------------------------------------------
+
+    def allowed_domains(self, candidate_domains: Iterable[str]) -> set[str]:
+        """Domains where one more matching pod keeps the constraint
+        satisfied (nextDomainTopologySpread topologygroup.go:226-311)."""
+        candidates = set(candidate_domains)
+        if self.type == TYPE_SPREAD:
+            live = {d: c for d, c in self.counts.items()}
+            for d in candidates:
+                live.setdefault(d, 0)
+            if not live:
+                return candidates
+            global_min = min(live.values())
+            # min_domains: while fewer domains than minDomains have pods,
+            # only empty domains are legal targets (k8s minDomains semantics)
+            if self.min_domains is not None:
+                nonzero = sum(1 for c in live.values() if c > 0)
+                if nonzero < self.min_domains:
+                    empty = {d for d in candidates if live.get(d, 0) == 0}
+                    if empty:
+                        return empty
+            return {
+                d for d in candidates if live.get(d, 0) + 1 - global_min <= self.max_skew
+            }
+        if self.type == TYPE_AFFINITY:
+            occupied = {d for d, c in self.counts.items() if c > 0}
+            if not occupied:
+                # first matching pod anywhere is legal only if an owner
+                # self-selects (topologygroup.go anyCompatiblePod logic
+                # approximated: handled by caller via `self_selecting`)
+                return set(candidates)
+            return candidates & occupied
+        # anti-affinity: only empty domains
+        return {d for d in candidates if self.counts.get(d, 0) == 0}
+
+    def has_occupied(self) -> bool:
+        return any(c > 0 for c in self.counts.values())
+
+
+def _spread_signature(pod: Pod, tsc: TopologySpreadConstraint) -> tuple:
+    return (
+        TYPE_SPREAD,
+        tsc.topology_key,
+        tsc.max_skew,
+        tsc.min_domains,
+        tsc.when_unsatisfiable,
+        tsc.label_selector,
+        pod.metadata.namespace,
+    )
+
+
+def _term_signature(kind: str, pod: Pod, term: PodAffinityTerm) -> tuple:
+    namespaces = term.namespaces or (pod.metadata.namespace,)
+    return (kind, term.topology_key, term.label_selector, tuple(sorted(namespaces)))
+
+
+class Topology:
+    """Global tracker for one scheduling run (topology.go:47)."""
+
+    def __init__(
+        self,
+        domains: dict[str, set[str]],
+        cluster_pods: Iterable[Pod] = (),
+        pending_pods: Iterable[Pod] = (),
+        pod_domains: Optional[dict[str, dict[str, str]]] = None,
+        honor_schedule_anyway: bool = True,
+    ):
+        """
+        domains: topology key -> known domain values.
+        cluster_pods: already-scheduled pods (seed counts + inverse
+          anti-affinity).
+        pod_domains: pod key -> {topology key: domain} for scheduled
+          pods (derived from their node's labels).
+        honor_schedule_anyway: treat ScheduleAnyway spread constraints
+          as required (relaxed later by the preference ladder).
+        """
+        self.domains = {k: set(v) for k, v in domains.items()}
+        self.honor_schedule_anyway = honor_schedule_anyway
+        self._groups: dict[tuple, TopologyGroup] = {}
+        pod_domains = pod_domains or {}
+
+        for pod in pending_pods:
+            for group in self._groups_for_pod(pod, create=True):
+                group.owners.add(pod.key)
+
+        # Inverse anti-affinity (topology.go:280-327): scheduled pods
+        # with required anti-affinity block future matching pods.
+        for pod in cluster_pods:
+            aff = pod.spec.affinity
+            if aff and aff.pod_anti_affinity:
+                for term in aff.pod_anti_affinity.required:
+                    sig = _term_signature(TYPE_ANTI_AFFINITY, pod, term)
+                    group = self._ensure(sig, TYPE_ANTI_AFFINITY, term.topology_key,
+                                         term.label_selector,
+                                         term.namespaces or (pod.metadata.namespace,))
+                    domain = pod_domains.get(pod.key, {}).get(term.topology_key)
+                    if domain is not None:
+                        group.owner_counts[domain] = group.owner_counts.get(domain, 0) + 1
+
+        # Seed counts from scheduled pods for every group.
+        for pod in cluster_pods:
+            domains_for_pod = pod_domains.get(pod.key, {})
+            for group in self._groups.values():
+                if group.matches(pod.metadata.namespace, pod.metadata.labels):
+                    domain = domains_for_pod.get(group.key)
+                    if domain is not None:
+                        group.record(domain)
+
+    # -- group construction ---------------------------------------------------
+
+    def _ensure(self, sig: tuple, type_: str, key: str, selector: LabelSelector,
+                namespaces: Iterable[str], max_skew: int = 1,
+                min_domains: Optional[int] = None) -> TopologyGroup:
+        group = self._groups.get(sig)
+        if group is None:
+            group = TopologyGroup(
+                type=type_,
+                key=key,
+                selector=selector,
+                namespaces=frozenset(namespaces),
+                max_skew=max_skew,
+                min_domains=min_domains,
+            )
+            for domain in self.domains.get(key, ()):  # known domains
+                group.register_domain(domain)
+            self._groups[sig] = group
+        return group
+
+    def _groups_for_pod(self, pod: Pod, create: bool = False) -> list[TopologyGroup]:
+        out = []
+        for tsc in pod.spec.topology_spread_constraints:
+            if tsc.when_unsatisfiable == "ScheduleAnyway" and not self.honor_schedule_anyway:
+                continue
+            sig = _spread_signature(pod, tsc)
+            if create:
+                out.append(
+                    self._ensure(sig, TYPE_SPREAD, tsc.topology_key, tsc.label_selector,
+                                 (pod.metadata.namespace,), tsc.max_skew, tsc.min_domains)
+                )
+            elif sig in self._groups:
+                out.append(self._groups[sig])
+        aff = pod.spec.affinity
+        if aff:
+            if aff.pod_affinity:
+                for term in aff.pod_affinity.required:
+                    sig = _term_signature(TYPE_AFFINITY, pod, term)
+                    if create:
+                        out.append(self._ensure(sig, TYPE_AFFINITY, term.topology_key,
+                                                term.label_selector,
+                                                term.namespaces or (pod.metadata.namespace,)))
+                    elif sig in self._groups:
+                        out.append(self._groups[sig])
+            if aff.pod_anti_affinity:
+                for term in aff.pod_anti_affinity.required:
+                    sig = _term_signature(TYPE_ANTI_AFFINITY, pod, term)
+                    if create:
+                        out.append(self._ensure(sig, TYPE_ANTI_AFFINITY, term.topology_key,
+                                                term.label_selector,
+                                                term.namespaces or (pod.metadata.namespace,)))
+                    elif sig in self._groups:
+                        out.append(self._groups[sig])
+        return out
+
+    def has_constraints(self, pod: Pod) -> bool:
+        """True if this pod carries topology constraints or is blocked
+        by any anti-affinity group."""
+        if pod.spec.topology_spread_constraints:
+            return True
+        aff = pod.spec.affinity
+        if aff and (aff.pod_affinity or aff.pod_anti_affinity):
+            return True
+        for group in self._groups.values():
+            if group.type == TYPE_ANTI_AFFINITY and group.matches(
+                pod.metadata.namespace, pod.metadata.labels
+            ):
+                return True
+        return False
+
+    def register_domain(self, key: str, domain: str) -> None:
+        self.domains.setdefault(key, set()).add(domain)
+        for group in self._groups.values():
+            if group.key == key:
+                group.register_domain(domain)
+
+    # -- placement API --------------------------------------------------------
+
+    def allowed_domains_for_pod(
+        self, pod: Pod, candidate: dict[str, set[str]]
+    ) -> Optional[dict[str, set[str]]]:
+        """Intersect candidate domains per topology key with every
+        constraint this pod participates in. None => no legal placement.
+
+        `candidate`: topology key -> domains the target node could take.
+        """
+        result = {k: set(v) for k, v in candidate.items()}
+        # Constraints the pod owns
+        for group in self._groups_for_pod(pod):
+            domains = result.get(group.key)
+            if domains is None:
+                # node has no value for this key -> illegal for spread
+                # constraints that require the label
+                return None
+            allowed = group.allowed_domains(domains)
+            if group.type == TYPE_AFFINITY and not group.has_occupied():
+                # first pod: legal only if the pod self-selects (it
+                # will satisfy its own affinity) — else any domain is
+                # dead (reference: anyCompatiblePod check)
+                if not group.matches(pod.metadata.namespace, pod.metadata.labels):
+                    return None
+            if not allowed:
+                return None
+            result[group.key] = allowed
+        # Inverse anti-affinity: this pod matches some group's selector,
+        # so it must avoid domains where that group's owners landed.
+        for group in self._groups.values():
+            if group.type != TYPE_ANTI_AFFINITY:
+                continue
+            if not group.matches(pod.metadata.namespace, pod.metadata.labels):
+                continue
+            domains = result.get(group.key)
+            if domains is None:
+                continue
+            allowed = {d for d in domains if group.owner_counts.get(d, 0) == 0}
+            if not allowed:
+                return None
+            result[group.key] = allowed
+        return result
+
+    def register(self, pod: Pod, chosen: dict[str, str]) -> None:
+        """Commit a placement: update counts on all matching groups."""
+        for group in self._groups.values():
+            domain = chosen.get(group.key)
+            if domain is None:
+                continue
+            if group.matches(pod.metadata.namespace, pod.metadata.labels):
+                group.record(domain)
+            if group.type == TYPE_ANTI_AFFINITY and pod.key in group.owners:
+                group.owner_counts[domain] = group.owner_counts.get(domain, 0) + 1
+            group.register_domain(domain)

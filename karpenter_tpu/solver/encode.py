@@ -1,0 +1,331 @@
+"""Dense encoding of the scheduling problem for the TPU solver.
+
+The reference evaluates pod x node x instance-type feasibility with
+nested Go loops over set objects (scheduler.go:515-647,
+nodeclaim.go:373-447). Here the same semantics become dense arrays:
+
+- A **config** is one launchable node variant: (NodePool, InstanceType,
+  Offering). Its requirement set is the intersection of the pool
+  template's requirements/labels, the instance type's requirements and
+  the offering's zone/capacity-type pins. Existing and in-flight nodes
+  are appended as one-hot *pseudo-configs* carrying their own labels
+  and remaining allocatable, which unifies the scheduler's three scan
+  tiers (existing -> in-flight -> new) into one node axis.
+
+- Pods with identical (requirements, tolerations, resources) collapse
+  into **groups**; grouped first-fit is equivalent to per-pod FFD for
+  identical pods under the lowest-index tie-break.
+
+- Per label key, pod-side allowed values and config-side values are
+  boolean masks over a finite vocabulary; compatibility per key is a
+  (groups x vocab) @ (vocab x configs) matmul > 0 — MXU work — ANDed
+  across keys, with the reference's undefined-key rules
+  (requirements.go:175-191): undefined well-known keys match, undefined
+  custom keys match only NotIn/DoesNotExist.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+import numpy as np
+
+from karpenter_tpu.apis.v1.labels import (
+    NODEPOOL_LABEL,
+    WELL_KNOWN_LABELS,
+)
+from karpenter_tpu.apis.v1.nodepool import NodePool
+from karpenter_tpu.cloudprovider.types import InstanceType, Offering
+from karpenter_tpu.kube.objects import Pod, Taint
+from karpenter_tpu.scheduling.requirement import (
+    DOES_NOT_EXIST,
+    IN,
+    NOT_IN,
+    Requirement,
+)
+from karpenter_tpu.scheduling.requirements import Requirements
+from karpenter_tpu.scheduling.taints import tolerates
+from karpenter_tpu.utils import resources as resutil
+
+# Resource axis order: the well-known resources first, extended after.
+BASE_RESOURCES = (resutil.CPU, resutil.MEMORY, resutil.PODS, resutil.EPHEMERAL_STORAGE)
+
+
+@dataclass
+class PodGroup:
+    """Pods sharing requirements/tolerations/resources."""
+
+    requirements: Requirements
+    tolerations: tuple
+    resources: dict[str, float]
+    pods: list[Pod] = field(default_factory=list)
+
+    @property
+    def count(self) -> int:
+        return len(self.pods)
+
+
+def group_pods(pods: Sequence[Pod], required_only: bool = False) -> list[PodGroup]:
+    """Group pods by scheduling signature, sorted CPU+memory descending
+    (the reference queue's FFD order, scheduling/queue.go:31-60)."""
+    groups: dict[tuple, PodGroup] = {}
+    for pod in pods:
+        reqs = Requirements.from_pod(pod, required_only=required_only)
+        resources = resutil.pod_requests(pod)
+        tols = tuple(sorted(pod.spec.tolerations, key=repr))
+        signature = (
+            repr(reqs),
+            tols,
+            tuple(sorted(resources.items())),
+        )
+        group = groups.get(signature)
+        if group is None:
+            group = PodGroup(requirements=reqs, tolerations=tols, resources=resources)
+            groups[signature] = group
+        group.pods.append(pod)
+    return sorted(
+        groups.values(),
+        key=lambda g: (
+            -(g.resources.get(resutil.CPU, 0.0)),
+            -(g.resources.get(resutil.MEMORY, 0.0)),
+            repr(g.requirements),
+        ),
+    )
+
+
+@dataclass
+class ConfigInfo:
+    """Host-side identity of one config column."""
+
+    pool: Optional[NodePool]          # None for pseudo-configs
+    instance_type: Optional[InstanceType]
+    offering: Optional[Offering]
+    existing_index: int = -1          # >=0 for pseudo-configs
+    requirements: Requirements = field(default_factory=Requirements)
+    taints: tuple[Taint, ...] = ()
+
+
+@dataclass
+class ExistingNodeInput:
+    """One existing or in-flight node offered to the solver."""
+
+    name: str
+    requirements: Requirements        # labels (+ claim requirements if in-flight)
+    taints: tuple[Taint, ...]
+    available: dict[str, float]       # allocatable minus current usage
+    pool_name: str = ""
+    pod_count: int = 0
+
+
+@dataclass
+class Encoded:
+    """Arrays shipped to the device solver plus host decode tables."""
+
+    resource_keys: list[str]
+    groups: list[PodGroup]
+    configs: list[ConfigInfo]
+    n_existing: int                       # pseudo-config / reserved node slots
+    group_req: np.ndarray                 # [G, R] float32
+    group_count: np.ndarray               # [G] int32
+    compat: np.ndarray                    # [G, C] bool
+    cfg_alloc: np.ndarray                 # [C, R] float32
+    cfg_price: np.ndarray                 # [C] float32
+    cfg_pool: np.ndarray                  # [C] int32 (pool order index; -1 pseudo)
+    pool_overhead: np.ndarray             # [P+1, R] float32 daemon overhead per pool
+    existing_used: np.ndarray             # [E, R] float32 (all zeros: available baked in)
+
+
+def _config_requirements(
+    pool: NodePool, it: InstanceType, offering: Offering
+) -> Requirements:
+    reqs = Requirements()
+    for spec in pool.spec.template.spec.requirements:
+        reqs.add(Requirement(spec.key, spec.operator, spec.values, spec.min_values))
+    for key, value in pool.spec.template.labels.items():
+        reqs.add(Requirement(key, IN, [value]))
+    reqs.add(Requirement(NODEPOOL_LABEL, IN, [pool.metadata.name]))
+    reqs.add(*it.requirements.values())
+    reqs.add(*offering.requirements.values())
+    return reqs
+
+
+def build_configs(
+    pools_with_types: Sequence[tuple[NodePool, Sequence[InstanceType]]],
+    existing: Sequence[ExistingNodeInput] = (),
+) -> list[ConfigInfo]:
+    """Enumerate launchable configs (pool-weight order, then price) and
+    append pseudo-configs for existing nodes."""
+    configs: list[ConfigInfo] = []
+    for pool, types in pools_with_types:
+        taints = tuple(pool.spec.template.spec.taints) + tuple(
+            pool.spec.template.spec.startup_taints
+        )
+        for it in types:
+            for offering in it.offerings:
+                if not offering.available:
+                    continue
+                configs.append(
+                    ConfigInfo(
+                        pool=pool,
+                        instance_type=it,
+                        offering=offering,
+                        requirements=_config_requirements(pool, it, offering),
+                        taints=taints,
+                    )
+                )
+    for idx, node in enumerate(existing):
+        configs.append(
+            ConfigInfo(
+                pool=None,
+                instance_type=None,
+                offering=None,
+                existing_index=idx,
+                requirements=node.requirements,
+                taints=tuple(node.taints),
+            )
+        )
+    return configs
+
+
+def encode(
+    groups: Sequence[PodGroup],
+    pools_with_types: Sequence[tuple[NodePool, Sequence[InstanceType]]],
+    existing: Sequence[ExistingNodeInput] = (),
+    daemon_overhead: Optional[dict[str, dict[str, float]]] = None,
+) -> Encoded:
+    """Build the dense problem. `daemon_overhead` maps pool name ->
+    resource list of daemonset pods that will land on new nodes
+    (reference scheduler.go:772-803)."""
+    configs = build_configs(pools_with_types, existing)
+    n_launch = len(configs) - len(existing)
+
+    # Resource axis: union of base + whatever appears anywhere.
+    keys: list[str] = list(BASE_RESOURCES)
+    seen = set(keys)
+    for group in groups:
+        for key in group.resources:
+            if key not in seen:
+                seen.add(key)
+                keys.append(key)
+    R = len(keys)
+    G = len(groups)
+    C = len(configs)
+
+    group_req = np.zeros((G, R), np.float32)
+    group_count = np.zeros((G,), np.int32)
+    for gi, group in enumerate(groups):
+        group_count[gi] = group.count
+        for ri, key in enumerate(keys):
+            group_req[gi, ri] = group.resources.get(key, 0.0)
+
+    cfg_alloc = np.zeros((C, R), np.float32)
+    cfg_price = np.zeros((C,), np.float32)
+    cfg_pool = np.full((C,), -1, np.int32)
+    pool_order = {pool.metadata.name: i for i, (pool, _) in enumerate(pools_with_types)}
+    for ci, cfg in enumerate(configs):
+        if cfg.existing_index >= 0:
+            node = existing[cfg.existing_index]
+            for ri, key in enumerate(keys):
+                cfg_alloc[ci, ri] = node.available.get(key, 0.0)
+            cfg_price[ci] = 0.0
+        else:
+            for ri, key in enumerate(keys):
+                cfg_alloc[ci, ri] = cfg.instance_type.allocatable.get(key, 0.0)
+            cfg_price[ci] = cfg.offering.price
+            cfg_pool[ci] = pool_order[cfg.pool.metadata.name]
+
+    compat = _compat_matrix(groups, configs)
+
+    # Taints: group must tolerate the config's taints.
+    for ci, cfg in enumerate(configs):
+        if not cfg.taints:
+            continue
+        for gi, group in enumerate(groups):
+            if tolerates(cfg.taints, list(group.tolerations)) is not None:
+                compat[gi, ci] = False
+
+    n_pools = len(pools_with_types)
+    pool_overhead = np.zeros((n_pools + 1, R), np.float32)
+    if daemon_overhead:
+        for pname, overhead in daemon_overhead.items():
+            if pname in pool_order:
+                for ri, key in enumerate(keys):
+                    pool_overhead[pool_order[pname], ri] = overhead.get(key, 0.0)
+
+    return Encoded(
+        resource_keys=keys,
+        groups=list(groups),
+        configs=configs,
+        n_existing=len(existing),
+        group_req=group_req,
+        group_count=group_count,
+        compat=compat,
+        cfg_alloc=cfg_alloc,
+        cfg_price=cfg_price,
+        cfg_pool=cfg_pool,
+        pool_overhead=pool_overhead,
+        existing_used=np.zeros((len(existing), R), np.float32),
+    )
+
+
+def _compat_matrix(groups: Sequence[PodGroup], configs: Sequence[ConfigInfo]) -> np.ndarray:
+    """[G, C] requirement compatibility via per-key vocab masks.
+
+    Semantics mirror Requirements.compatible(pod, AllowUndefinedWellKnown)
+    evaluated config-side: every pod-constrained key must intersect the
+    config's values; keys the config doesn't define pass when well-known
+    or when the pod operator is NotIn/DoesNotExist.
+    """
+    G, C = len(groups), len(configs)
+    compat = np.ones((G, C), dtype=bool)
+
+    # Keys constrained by any pod group.
+    pod_keys: set[str] = set()
+    for group in groups:
+        pod_keys.update(group.requirements.keys())
+
+    for key in pod_keys:
+        vocab: dict[str, int] = {}
+        for cfg in configs:
+            if cfg.requirements.has(key):
+                for value in cfg.requirements.get(key).values:
+                    vocab.setdefault(value, len(vocab))
+        for group in groups:
+            if group.requirements.has(key):
+                for value in group.requirements.get(key).values:
+                    vocab.setdefault(value, len(vocab))
+        values = list(vocab)
+        V = len(values)
+
+        cfg_defined = np.zeros((C,), dtype=bool)
+        cfg_mask = np.zeros((C, V + 1), dtype=bool)  # last col: "any other value"
+        for ci, cfg in enumerate(configs):
+            if not cfg.requirements.has(key):
+                continue
+            cfg_defined[ci] = True
+            req = cfg.requirements.get(key)
+            for vi, value in enumerate(values):
+                cfg_mask[ci, vi] = req.has(value)
+            # complement config reqs admit values outside the vocab too
+            cfg_mask[ci, V] = req.complement
+
+        for gi, group in enumerate(groups):
+            if not group.requirements.has(key):
+                continue
+            req = group.requirements.get(key)
+            pod_mask = np.zeros((V + 1,), dtype=bool)
+            for vi, value in enumerate(values):
+                pod_mask[vi] = req.has(value)
+            pod_mask[V] = req.complement and (
+                req.greater_than is None and req.less_than is None
+            )
+            op = req.operator()
+            undefined_ok = key in WELL_KNOWN_LABELS or op in (NOT_IN, DOES_NOT_EXIST)
+            key_compat = np.where(
+                cfg_defined,
+                (cfg_mask & pod_mask[None, :]).any(axis=1),
+                undefined_ok,
+            )
+            compat[gi] &= key_compat
+    return compat

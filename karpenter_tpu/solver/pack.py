@@ -1,0 +1,216 @@
+"""Grouped first-fit-decreasing bin-packing as a JAX program.
+
+The reference's Scheduler.Solve (scheduler.go:377-675) is a per-pod
+loop: try existing nodes, then in-flight nodes, then a new NodeClaim,
+each via CanAdd (taints -> requirements -> resources -> re-filter
+instance types). Here the same decision procedure runs as a
+`lax.while_loop` over *pod groups* with all per-step work vectorized
+over (nodes x configs):
+
+  state: node_mask [N, C] bool  -- configs still feasible per node
+         node_used [N, R] f32   -- resources committed per node
+         node_active [N] bool, node_count
+  step:  ok = node_mask & compat[g] & fits  (fits: used <= alloc-req)
+         j  = lowest-index feasible node    (stable tie-break)
+         k  = per-config capacity floor((alloc - used_j) / req)
+         m  = min(remaining, max over ok configs of k)
+         place m pods on j, tighten node_mask[j] to configs with k>=m
+
+Placing a whole group at once is equivalent to the reference's per-pod
+FFD for identical pods: scanning pods one-by-one fills the first
+feasible node until it no longer fits, which is exactly "place
+min(remaining, capacity) then spill" under the lowest-index rule.
+Existing/in-flight nodes occupy the first `n_existing` node slots with
+one-hot pseudo-config masks, so "existing first, then in-flight, then
+new" falls out of the index order. New nodes open on the
+highest-weight pool whose configs admit the group (configs are ordered
+by pool weight at encode time) and are restricted to that pool's
+configs, mirroring addToNewNodeClaim (scheduler.go:587-647).
+
+Determinism: every choice is an argmax/argmin over a static axis with
+index tie-breaks — bit-reproducible across runs and shardable over the
+config axis.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from karpenter_tpu.solver.encode import Encoded
+
+BIG = jnp.float32(3.4e38)
+INT_BIG = jnp.int32(2**31 - 1)
+
+
+@dataclass
+class PackResult:
+    assign: np.ndarray        # [N, G] int32 pods of group g on node n
+    node_mask: np.ndarray     # [N, C] bool configs remaining per node
+    node_used: np.ndarray     # [N, R] float32
+    node_active: np.ndarray   # [N] bool
+    node_count: int
+    unschedulable: np.ndarray  # [G] int32 pods that found no placement
+
+
+@functools.partial(jax.jit, static_argnames=("max_nodes",))
+def pack(
+    compat: jnp.ndarray,       # [G, C] bool
+    group_req: jnp.ndarray,    # [G, R] f32
+    group_count: jnp.ndarray,  # [G] i32
+    cfg_alloc: jnp.ndarray,    # [C, R] f32
+    cfg_pool: jnp.ndarray,     # [C] i32 (-1 for pseudo-configs)
+    pool_overhead: jnp.ndarray,  # [P+1, R] f32
+    existing_mask: jnp.ndarray,  # [E, C] bool one-hot pseudo-config rows
+    existing_used: jnp.ndarray,  # [E, R] f32
+    max_nodes: int,
+):
+    G, C = compat.shape
+    R = group_req.shape[1]
+    E = existing_mask.shape[0]
+    N = max_nodes
+
+    node_mask = jnp.zeros((N, C), bool).at[:E].set(existing_mask)
+    node_used = jnp.zeros((N, R), jnp.float32).at[:E].set(existing_used)
+    node_active = jnp.zeros((N,), bool).at[:E].set(existing_mask.any(axis=1))
+    assign = jnp.zeros((N, G), jnp.int32)
+    unschedulable = jnp.zeros((G,), jnp.int32)
+
+    def fits(used, alloc_minus_req):
+        # [N, C]: node usage fits under alloc - req for every resource
+        return jnp.all(used[:, None, :] <= alloc_minus_req[None, :, :] + 1e-4, axis=-1)
+
+    def capacity(used_j, req):
+        # [C]: how many pods of `req` fit on top of used_j per config
+        safe_req = jnp.where(req > 0, req, 1.0)
+        head = cfg_alloc - used_j[None, :]
+        k = jnp.floor((head + 1e-4) / safe_req[None, :])
+        k = jnp.where(req[None, :] > 0, k, BIG)
+        return jnp.clip(jnp.min(k, axis=-1), 0.0, BIG).astype(jnp.int32)
+
+    def body(state):
+        g, remaining, node_mask, node_used, node_active, node_count, assign, unsched = state
+        req = group_req[g]
+        row = compat[g]
+
+        alloc_minus_req = cfg_alloc - req[None, :]
+        ok = node_mask & row[None, :] & fits(node_used, alloc_minus_req)
+        feasible = ok.any(axis=1) & node_active
+        j_existing = jnp.argmax(feasible)
+        has_existing = feasible.any()
+
+        # New-node option: highest-weight pool (lowest pool index) whose
+        # configs admit a single pod of this group on a fresh node.
+        fresh_ok = row & jnp.all(pool_overhead[cfg_pool] <= alloc_minus_req, axis=-1) & (
+            cfg_pool >= 0
+        )
+        chosen_pool = jnp.min(jnp.where(fresh_ok, cfg_pool, INT_BIG))
+        can_open = fresh_ok.any() & (node_count < N)
+
+        def place_existing(args):
+            node_mask, node_used, node_active, node_count, assign, remaining = args
+            j = j_existing
+            k = capacity(node_used[j], req) * ok[j]
+            m = jnp.minimum(remaining, jnp.max(k))
+            new_mask_j = ok[j] & (k >= m)
+            return (
+                node_mask.at[j].set(new_mask_j),
+                node_used.at[j].add(m.astype(jnp.float32) * req),
+                node_active,
+                node_count,
+                assign.at[j, g].add(m),
+                remaining - m,
+            )
+
+        def place_new(args):
+            node_mask, node_used, node_active, node_count, assign, remaining = args
+            j = node_count
+            mask = fresh_ok & (cfg_pool == chosen_pool)
+            overhead = pool_overhead[chosen_pool]
+            k = capacity(overhead, req) * mask
+            m = jnp.minimum(remaining, jnp.max(k))
+            new_mask_j = mask & (k >= m)
+            return (
+                node_mask.at[j].set(new_mask_j),
+                node_used.at[j].set(overhead + m.astype(jnp.float32) * req),
+                node_active.at[j].set(True),
+                node_count + 1,
+                assign.at[j, g].add(m),
+                remaining - m,
+            )
+
+        def give_up(args):
+            node_mask, node_used, node_active, node_count, assign, remaining = args
+            return node_mask, node_used, node_active, node_count, assign, jnp.int32(0)
+
+        branch = jnp.where(has_existing, 0, jnp.where(can_open, 1, 2))
+        node_mask, node_used, node_active, node_count, assign, new_remaining = jax.lax.switch(
+            branch,
+            (place_existing, place_new, give_up),
+            (node_mask, node_used, node_active, node_count, assign, remaining),
+        )
+        unsched = unsched.at[g].add(
+            jnp.where(branch == 2, remaining, 0)
+        )
+        done = new_remaining <= 0
+        g = jnp.where(done, g + 1, g)
+        next_remaining = jnp.where(
+            done, jnp.where(g < G, group_count[jnp.minimum(g, G - 1)], 0), new_remaining
+        )
+        return (g, next_remaining, node_mask, node_used, node_active, node_count, assign, unsched)
+
+    def cond(state):
+        g = state[0]
+        return g < G
+
+    init = (
+        jnp.int32(0),
+        jnp.where(G > 0, group_count[0], 0),
+        node_mask,
+        node_used,
+        node_active,
+        jnp.int32(E),
+        assign,
+        unschedulable,
+    )
+    state = jax.lax.while_loop(cond, body, init)
+    _, _, node_mask, node_used, node_active, node_count, assign, unsched = state
+    return assign, node_mask, node_used, node_active, node_count, unsched
+
+
+def solve_packing(enc: Encoded, max_nodes: int = 0) -> PackResult:
+    """Host entry: run the packing kernel on the encoded problem."""
+    G, C = enc.compat.shape
+    E = enc.n_existing
+    if max_nodes <= 0:
+        # worst case: every group opens its own node chain
+        max_nodes = E + int(enc.group_count.sum())
+        max_nodes = min(max_nodes, E + 4096)
+    existing_mask = np.zeros((E, C), dtype=bool)
+    for ci, cfg in enumerate(enc.configs):
+        if cfg.existing_index >= 0:
+            existing_mask[cfg.existing_index, ci] = True
+
+    assign, node_mask, node_used, node_active, node_count, unsched = pack(
+        jnp.asarray(enc.compat),
+        jnp.asarray(enc.group_req),
+        jnp.asarray(enc.group_count),
+        jnp.asarray(enc.cfg_alloc),
+        jnp.asarray(enc.cfg_pool),
+        jnp.asarray(enc.pool_overhead),
+        jnp.asarray(existing_mask),
+        jnp.asarray(enc.existing_used),
+        max_nodes=max_nodes,
+    )
+    return PackResult(
+        assign=np.asarray(assign),
+        node_mask=np.asarray(node_mask),
+        node_used=np.asarray(node_used),
+        node_active=np.asarray(node_active),
+        node_count=int(node_count),
+        unschedulable=np.asarray(unsched),
+    )

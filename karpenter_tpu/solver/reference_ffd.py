@@ -1,0 +1,68 @@
+"""Pure-Python per-pod first-fit-decreasing oracle.
+
+Implements the reference scheduler's decision procedure
+(scheduler.go:434-647) directly over the encoded problem: pods in
+size-descending order, each tried against nodes in index order
+(existing first), else a new node on the highest-weight admitting
+pool. Used as (a) the parity oracle for the JAX packing kernel and
+(b) the in-process fallback when no accelerator is available — the
+role the north star assigns to the Go FFD fallback.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from karpenter_tpu.solver.encode import Encoded
+
+
+@dataclass
+class _Node:
+    mask: np.ndarray           # [C] bool
+    used: np.ndarray           # [R] float32
+    assign: dict[int, int] = field(default_factory=dict)  # group -> count
+
+
+def solve_ffd_host(enc: Encoded) -> tuple[list[_Node], dict[int, int]]:
+    """Returns (nodes, unschedulable{group: count})."""
+    C = len(enc.configs)
+    alloc = enc.cfg_alloc  # [C, R]
+    nodes: list[_Node] = []
+    for ei in range(enc.n_existing):
+        mask = np.zeros((C,), bool)
+        for ci, cfg in enumerate(enc.configs):
+            if cfg.existing_index == ei:
+                mask[ci] = True
+        nodes.append(_Node(mask=mask, used=enc.existing_used[ei].copy()))
+    unschedulable: dict[int, int] = {}
+
+    for gi in range(len(enc.groups)):
+        req = enc.group_req[gi]
+        row = enc.compat[gi]
+        for _ in range(int(enc.group_count[gi])):
+            placed = False
+            for node in nodes:
+                ok = node.mask & row & np.all(node.used[None, :] + req[None, :] <= alloc + 1e-4, axis=1)
+                if ok.any():
+                    node.mask = ok
+                    node.used = node.used + req
+                    node.assign[gi] = node.assign.get(gi, 0) + 1
+                    placed = True
+                    break
+            if placed:
+                continue
+            # open new node on highest-weight (lowest index) admitting pool
+            fresh = row & (enc.cfg_pool >= 0)
+            overhead = enc.pool_overhead[enc.cfg_pool]
+            fresh &= np.all(overhead + req[None, :] <= alloc + 1e-4, axis=1)
+            if not fresh.any():
+                unschedulable[gi] = unschedulable.get(gi, 0) + 1
+                continue
+            pool = int(enc.cfg_pool[fresh].min())
+            mask = fresh & (enc.cfg_pool == pool)
+            node = _Node(mask=mask, used=enc.pool_overhead[pool] + req)
+            node.assign[gi] = 1
+            nodes.append(node)
+    return nodes, unschedulable

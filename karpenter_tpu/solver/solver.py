@@ -1,0 +1,175 @@
+"""Solver facade: encoded problem -> per-node placements.
+
+This is the `scheduling.Solver` seam the north star describes: the
+provisioning scheduler and the consolidation engine call `solve()`
+with pods + catalogs + existing nodes and get back node plans
+(which pool/instance-types/offering each planned node resolves to and
+which pods land where). Backend is the JAX packing kernel
+(`solver.pack`) with the host FFD oracle as fallback.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+import numpy as np
+
+from karpenter_tpu.apis.v1.nodepool import NodePool
+from karpenter_tpu.cloudprovider.types import InstanceType, Offering
+from karpenter_tpu.kube.objects import Pod
+from karpenter_tpu.solver.encode import (
+    Encoded,
+    ExistingNodeInput,
+    PodGroup,
+    encode,
+    group_pods,
+)
+
+
+@dataclass
+class NodePlan:
+    """One planned (new) node."""
+
+    pool: NodePool
+    instance_types: list[InstanceType]      # price-ordered options
+    offerings: list[Offering]               # feasible offerings (cheapest first)
+    pods: list[Pod] = field(default_factory=list)
+    price: float = 0.0                      # cheapest feasible offering
+    claim_name: str = ""                    # set once a NodeClaim is created
+
+
+@dataclass
+class ExistingAssignment:
+    existing_index: int
+    pods: list[Pod] = field(default_factory=list)
+
+
+@dataclass
+class Solution:
+    new_nodes: list[NodePlan]
+    existing: list[ExistingAssignment]
+    unschedulable: list[Pod]
+
+    @property
+    def total_price(self) -> float:
+        return sum(n.price for n in self.new_nodes)
+
+
+def _backend() -> str:
+    return os.environ.get("KARPENTER_SOLVER_BACKEND", "jax")
+
+
+def solve(
+    pods: Sequence[Pod],
+    pools_with_types: Sequence[tuple[NodePool, Sequence[InstanceType]]],
+    existing: Sequence[ExistingNodeInput] = (),
+    daemon_overhead: Optional[dict[str, dict[str, float]]] = None,
+    required_only: bool = False,
+    backend: Optional[str] = None,
+) -> Solution:
+    groups = group_pods(pods, required_only=required_only)
+    enc = encode(groups, pools_with_types, existing, daemon_overhead)
+    return solve_encoded(enc, backend=backend)
+
+
+def solve_encoded(enc: Encoded, backend: Optional[str] = None) -> Solution:
+    G, C = enc.compat.shape
+    if G == 0 or C == 0:
+        return Solution(
+            new_nodes=[],
+            existing=[],
+            unschedulable=[p for g in enc.groups for p in g.pods],
+        )
+    backend = backend or _backend()
+    if backend == "host":
+        return _decode_host(enc)
+    return _decode_device(enc)
+
+
+def _decode_device(enc: Encoded) -> Solution:
+    from karpenter_tpu.solver.pack import solve_packing
+
+    result = solve_packing(enc)
+    node_masks = result.node_mask
+    node_assign = result.assign
+    return _build_solution(
+        enc,
+        [
+            (ni, node_masks[ni], {g: int(c) for g, c in enumerate(node_assign[ni]) if c > 0})
+            for ni in range(result.node_count)
+            if result.node_active[ni]
+        ],
+        {g: int(c) for g, c in enumerate(result.unschedulable) if c > 0},
+    )
+
+
+def _decode_host(enc: Encoded) -> Solution:
+    from karpenter_tpu.solver.reference_ffd import solve_ffd_host
+
+    nodes, unsched = solve_ffd_host(enc)
+    return _build_solution(
+        enc,
+        [(ni, node.mask, node.assign) for ni, node in enumerate(nodes)],
+        unsched,
+    )
+
+
+def _build_solution(
+    enc: Encoded,
+    node_rows: list[tuple[int, np.ndarray, dict[int, int]]],
+    unsched: dict[int, int],
+) -> Solution:
+    new_nodes: list[NodePlan] = []
+    existing: dict[int, ExistingAssignment] = {}
+    group_cursor = [0] * len(enc.groups)
+
+    def take_pods(gi: int, count: int) -> list[Pod]:
+        start = group_cursor[gi]
+        group_cursor[gi] += count
+        return enc.groups[gi].pods[start : start + count]
+
+    for ni, mask, assignment in node_rows:
+        if not assignment:
+            continue
+        config_ids = np.flatnonzero(mask)
+        if config_ids.size == 0:
+            continue
+        first_cfg = enc.configs[config_ids[0]]
+        if first_cfg.existing_index >= 0:
+            slot = existing.setdefault(
+                first_cfg.existing_index, ExistingAssignment(first_cfg.existing_index)
+            )
+            for gi, count in assignment.items():
+                slot.pods.extend(take_pods(gi, count))
+            continue
+        pairs = sorted(
+            ((enc.cfg_price[ci], ci) for ci in config_ids), key=lambda t: (t[0], t[1])
+        )
+        seen_types: dict[str, InstanceType] = {}
+        offerings: list[Offering] = []
+        for _, ci in pairs:
+            cfg = enc.configs[ci]
+            seen_types.setdefault(cfg.instance_type.name, cfg.instance_type)
+            offerings.append(cfg.offering)
+        plan = NodePlan(
+            pool=first_cfg.pool,
+            instance_types=list(seen_types.values()),
+            offerings=offerings,
+            price=pairs[0][0],
+        )
+        for gi, count in assignment.items():
+            plan.pods.extend(take_pods(gi, count))
+        new_nodes.append(plan)
+
+    unschedulable: list[Pod] = []
+    for gi, count in unsched.items():
+        # unplaced pods are the tail of the group after placements
+        group = enc.groups[gi]
+        unschedulable.extend(group.pods[len(group.pods) - count :])
+    return Solution(
+        new_nodes=new_nodes,
+        existing=sorted(existing.values(), key=lambda e: e.existing_index),
+        unschedulable=unschedulable,
+    )

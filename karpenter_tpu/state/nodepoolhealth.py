@@ -1,0 +1,33 @@
+"""Per-NodePool launch/registration health tracking.
+
+Counterpart of pkg/state/nodepoolhealth (ring buffer capacity 10):
+recent registration outcomes decide Healthy/Degraded for the
+NodeRegistrationHealthy condition.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+
+CAPACITY = 10
+UNHEALTHY_THRESHOLD = 0.5  # more than half failures -> degraded
+
+
+class HealthTracker:
+    def __init__(self) -> None:
+        self._buffers: dict[str, deque[bool]] = {}
+
+    def record(self, pool_name: str, success: bool) -> None:
+        if not pool_name:
+            return
+        self._buffers.setdefault(pool_name, deque(maxlen=CAPACITY)).append(success)
+
+    def healthy(self, pool_name: str) -> bool:
+        buf = self._buffers.get(pool_name)
+        if not buf:
+            return True
+        failures = sum(1 for ok in buf if not ok)
+        return failures / len(buf) <= UNHEALTHY_THRESHOLD or len(buf) < 3
+
+    def reset(self, pool_name: str) -> None:
+        self._buffers.pop(pool_name, None)

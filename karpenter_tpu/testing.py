@@ -1,0 +1,136 @@
+"""Test harness: environment + expectation DSL.
+
+Counterpart of pkg/test (object factories, environment.go) and
+pkg/test/expectations (ExpectProvisioned, ExpectMakeNodesInitialized):
+wires the in-memory API, state mirror, provider and controllers
+together and drives full provision cycles synchronously, the way the
+reference's envtest suites call ExpectReconciled.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+from karpenter_tpu.apis.v1.nodepool import NodePool, NodePoolSpec
+from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
+from karpenter_tpu.cloudprovider.types import CloudProvider, InstanceType
+from karpenter_tpu.kube.client import KubeClient
+from karpenter_tpu.kube.objects import (
+    Container,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+)
+from karpenter_tpu.lifecycle.nodeclaim_lifecycle import NodeClaimLifecycle
+from karpenter_tpu.lifecycle.termination import TerminationController
+from karpenter_tpu.provisioning.provisioner import Provisioner
+from karpenter_tpu.provisioning.scheduler import SchedulerResults
+from karpenter_tpu.state.cluster import Cluster, attach_informers
+
+_name_counter = itertools.count(1)
+
+
+def mk_pod(
+    name: Optional[str] = None,
+    cpu: float = 1.0,
+    memory: float = 2**30,
+    labels: Optional[dict] = None,
+    node_selector: Optional[dict] = None,
+    **spec_kwargs,
+) -> Pod:
+    return Pod(
+        metadata=ObjectMeta(
+            name=name or f"pod-{next(_name_counter):05d}", labels=labels or {}
+        ),
+        spec=PodSpec(
+            containers=[Container(requests={"cpu": cpu, "memory": memory})],
+            node_selector=node_selector or {},
+            **spec_kwargs,
+        ),
+    )
+
+
+def mk_nodepool(name: Optional[str] = None, **kwargs) -> NodePool:
+    return NodePool(
+        metadata=ObjectMeta(name=name or f"pool-{next(_name_counter):05d}", namespace=""),
+        spec=NodePoolSpec(**kwargs),
+    )
+
+
+@dataclass
+class Environment:
+    """One test cluster: in-memory API + state + controllers."""
+
+    types: Optional[list[InstanceType]] = None
+    registration_delay: float = 0.0
+    kube: KubeClient = field(init=False)
+    cluster: Cluster = field(init=False)
+    cloud: KwokCloudProvider = field(init=False)
+    provisioner: Provisioner = field(init=False)
+    lifecycle: NodeClaimLifecycle = field(init=False)
+    termination: TerminationController = field(init=False)
+
+    def __post_init__(self) -> None:
+        self.kube = KubeClient()
+        self.cluster = Cluster(self.kube)
+        attach_informers(self.kube, self.cluster)
+        self.cloud = KwokCloudProvider(
+            self.kube, types=self.types, registration_delay=self.registration_delay
+        )
+        self.provisioner = Provisioner(self.kube, self.cluster, self.cloud)
+        self.lifecycle = NodeClaimLifecycle(self.kube, self.cloud)
+        self.termination = TerminationController(self.kube, self.cluster)
+
+    def reconcile_termination(self, now: Optional[float] = None, rounds: int = 4) -> None:
+        """Drive claim finalize -> node drain -> instance delete to
+        quiescence (each controller pass handles one stage)."""
+        for _ in range(rounds):
+            self.lifecycle.reconcile_all(now=now)
+            self.termination.reconcile_all(now=now)
+
+    # -- expectation DSL ------------------------------------------------------
+
+    def provision(self, *pods: Pod, bind: bool = True, now: Optional[float] = None
+                  ) -> SchedulerResults:
+        """ExpectProvisioned (expectations.go:299): create pods, run a
+        provisioning cycle, launch claims through the lifecycle, tick
+        the simulated cloud, register/initialize nodes, and bind pods
+        to their planned nodes."""
+        for pod in pods:
+            if self.kube.get_pod(pod.metadata.namespace, pod.metadata.name) is None:
+                self.kube.create(pod)
+        results = self.provisioner.reconcile(now=now)
+        self.lifecycle.reconcile_all(now=now)
+        self.cloud.tick(now=now)
+        self.lifecycle.reconcile_all(now=now)
+        if bind:
+            self.bind_results(results)
+        return results
+
+    def bind_results(self, results: SchedulerResults) -> None:
+        """Simulate kube-scheduler binding pods to their target nodes."""
+        for plan in results.new_node_plans:
+            if not plan.claim_name:
+                continue
+            claim = self.kube.get_node_claim(plan.claim_name)
+            if claim is None or not claim.status.node_name:
+                continue
+            for pod in plan.pods:
+                live = self.kube.get_pod(pod.metadata.namespace, pod.metadata.name)
+                if live is not None and not live.spec.node_name:
+                    self.kube.bind_pod(live, claim.status.node_name)
+        for node_name, pods in results.existing_assignments.items():
+            state = self.cluster.node_for_name(node_name)
+            target = state.name if state is not None else node_name
+            for pod in pods:
+                live = self.kube.get_pod(pod.metadata.namespace, pod.metadata.name)
+                if live is not None and not live.spec.node_name:
+                    self.kube.bind_pod(live, target)
+
+    def initialized_nodes(self) -> list:
+        return [
+            n for n in self.kube.nodes()
+            if n.metadata.labels.get("karpenter.sh/initialized") == "true"
+        ]

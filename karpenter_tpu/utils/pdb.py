@@ -1,0 +1,74 @@
+"""PodDisruptionBudget limits.
+
+Counterpart of pkg/utils/pdb (506 LoC): map pods to the PDBs selecting
+them and answer "can this pod be evicted right now" / "is this node's
+pod set disruptable".
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+from karpenter_tpu.kube.client import KubeClient
+from karpenter_tpu.kube.objects import Pod, PodDisruptionBudget
+
+
+def _scaled(value: int | str, total: int, round_up: bool) -> int:
+    if isinstance(value, int):
+        return value
+    if value.endswith("%"):
+        pct = int(value[:-1])
+        scaled = pct * total / 100.0
+        return math.ceil(scaled) if round_up else math.floor(scaled)
+    return int(value)
+
+
+class PdbLimits:
+    def __init__(self, kube: KubeClient):
+        self.kube = kube
+        self.pdbs = kube.pdbs()
+
+    def _matching(self, pod: Pod) -> list[PodDisruptionBudget]:
+        return [
+            pdb
+            for pdb in self.pdbs
+            if pdb.metadata.namespace == pod.metadata.namespace
+            and pdb.spec.selector.matches(pod.metadata.labels)
+        ]
+
+    def disruptions_allowed(self, pdb: PodDisruptionBudget) -> int:
+        """Compute allowed disruptions from live pod state (the real
+        controller-manager maintains status; we derive it)."""
+        pods = [
+            p
+            for p in self.kube.pods(namespace=pdb.metadata.namespace,
+                                    selector=pdb.spec.selector)
+            if not p.is_terminal()
+        ]
+        total = len(pods)
+        healthy = sum(1 for p in pods if p.spec.node_name and not p.is_terminating())
+        if pdb.spec.max_unavailable is not None:
+            max_unavailable = _scaled(pdb.spec.max_unavailable, total, round_up=False)
+            unavailable = total - healthy
+            return max(0, max_unavailable - unavailable)
+        if pdb.spec.min_available is not None:
+            min_available = _scaled(pdb.spec.min_available, total, round_up=True)
+            return max(0, healthy - min_available)
+        return total
+
+    def can_evict(self, pod: Pod) -> Optional[str]:
+        """None if eviction is permitted, else the blocking PDB name."""
+        for pdb in self._matching(pod):
+            if self.disruptions_allowed(pdb) <= 0:
+                return pdb.key
+        return None
+
+    def blocking_pdbs(self, pods: Sequence[Pod]) -> dict[str, str]:
+        """pod key -> blocking pdb key for every blocked pod."""
+        out = {}
+        for pod in pods:
+            blocked = self.can_evict(pod)
+            if blocked is not None:
+                out[pod.key] = blocked
+        return out

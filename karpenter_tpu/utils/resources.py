@@ -1,0 +1,89 @@
+"""Resource-list arithmetic over `dict[str, float]` resource maps.
+
+Counterpart of the reference's pkg/utils/resources/resources.go (822
+LoC of Quantity arithmetic): merge/subtract/fits over resource lists,
+and pod-request aggregation including init-container max semantics and
+pod-overhead (resources.go RequestsForPods / Ceiling semantics).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Mapping, TYPE_CHECKING
+
+if TYPE_CHECKING:  # pragma: no cover
+    from karpenter_tpu.kube.objects import Pod
+
+CPU = "cpu"
+MEMORY = "memory"
+EPHEMERAL_STORAGE = "ephemeral-storage"
+PODS = "pods"
+
+ResourceList = dict[str, float]
+
+
+def merge(*lists: Mapping[str, float]) -> ResourceList:
+    """Sum resource lists key-wise."""
+    out: ResourceList = {}
+    for rl in lists:
+        for key, value in rl.items():
+            out[key] = out.get(key, 0.0) + value
+    return out
+
+
+def subtract(a: Mapping[str, float], b: Mapping[str, float]) -> ResourceList:
+    """a - b key-wise; keys only in b appear negated."""
+    out: ResourceList = dict(a)
+    for key, value in b.items():
+        out[key] = out.get(key, 0.0) - value
+    return out
+
+
+def max_resources(*lists: Mapping[str, float]) -> ResourceList:
+    """Key-wise maximum (reference MaxResources)."""
+    out: ResourceList = {}
+    for rl in lists:
+        for key, value in rl.items():
+            if value > out.get(key, float("-inf")):
+                out[key] = value
+    return out
+
+
+def fits(candidate: Mapping[str, float], total: Mapping[str, float]) -> bool:
+    """True if every requested resource is available in `total`.
+
+    Mirrors resources.Fits: a resource requested but absent from the
+    total is only OK when the request is zero.
+    """
+    for key, value in candidate.items():
+        if value > total.get(key, 0.0) + 1e-9:
+            return False
+    return True
+
+
+def is_zero(rl: Mapping[str, float]) -> bool:
+    return all(abs(v) < 1e-9 for v in rl.values())
+
+
+def positive(rl: Mapping[str, float]) -> ResourceList:
+    """Clamp all values to >= 0 and drop zero entries."""
+    return {k: v for k, v in rl.items() if v > 1e-9}
+
+
+def pod_requests(pod: "Pod") -> ResourceList:
+    """Effective pod resource requests.
+
+    k8s semantics (mirrored from resources.PodRequests): the max of
+    (sum of container requests, each init-container's requests),
+    plus pod overhead, plus one implicit "pods" unit.
+    """
+    containers = merge(*(c.requests for c in pod.spec.containers)) if pod.spec.containers else {}
+    init = max_resources(*(c.requests for c in pod.spec.init_containers)) if pod.spec.init_containers else {}
+    out = max_resources(containers, init)
+    if pod.spec.overhead:
+        out = merge(out, pod.spec.overhead)
+    out[PODS] = out.get(PODS, 0.0) + 1.0
+    return out
+
+
+def requests_for_pods(pods: Iterable["Pod"]) -> ResourceList:
+    return merge(*(pod_requests(p) for p in pods))

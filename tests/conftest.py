@@ -1,0 +1,22 @@
+"""Test config: force JAX onto a virtual 8-device CPU platform.
+
+The driver benches on one real TPU chip; tests exercise the sharded
+solver paths on 8 virtual CPU devices so multi-chip layouts are
+validated without hardware.
+"""
+
+import os
+
+# Force CPU even when the ambient environment points JAX at a TPU
+# platform. The axon site hook overwrites the jax_platforms *config*
+# at interpreter startup (env vars alone don't stick), so override the
+# config directly before any backend initializes: the TPU chip is
+# single-tenant and tests must never touch it.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")

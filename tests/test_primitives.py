@@ -1,0 +1,149 @@
+"""Quantity, duration/cron, resources, taints, hostports, budget tests."""
+
+import pytest
+
+from karpenter_tpu.apis.v1.nodepool import Budget, NodePool
+from karpenter_tpu.kube.objects import Container, Pod, PodSpec, Taint, Toleration
+from karpenter_tpu.scheduling import taints as taintutil
+from karpenter_tpu.scheduling.hostports import HostPortUsage
+from karpenter_tpu.utils import resources as res
+from karpenter_tpu.utils.duration import CronSchedule, parse_duration
+from karpenter_tpu.utils.quantity import parse_quantity
+
+
+class TestQuantity:
+    @pytest.mark.parametrize(
+        "text,expected",
+        [
+            ("100m", 0.1),
+            ("1", 1.0),
+            ("1.5", 1.5),
+            ("2Gi", 2 * 2**30),
+            ("512Mi", 512 * 2**20),
+            ("1k", 1000.0),
+            ("1e3", 1000.0),
+            (5, 5.0),
+        ],
+    )
+    def test_parse(self, text, expected):
+        assert parse_quantity(text) == expected
+
+    def test_invalid(self):
+        with pytest.raises(ValueError):
+            parse_quantity("abc")
+
+
+class TestDuration:
+    def test_parse(self):
+        assert parse_duration("30s") == 30
+        assert parse_duration("5m") == 300
+        assert parse_duration("1h30m") == 5400
+        assert parse_duration("Never") is None
+        assert parse_duration(None) is None
+
+    def test_cron_matches(self):
+        # every day at 09:00 UTC
+        sched = CronSchedule.parse("0 9 * * *")
+        import calendar
+
+        ts = calendar.timegm((2026, 7, 29, 9, 0, 0, 0, 0, 0))
+        assert sched.matches(ts)
+        assert not sched.matches(ts + 60)
+
+    def test_cron_ranges_steps(self):
+        sched = CronSchedule.parse("*/15 8-17 * * mon-fri")
+        assert sched.minutes == {0, 15, 30, 45}
+        assert sched.hours == set(range(8, 18))
+        assert sched.weekdays == {1, 2, 3, 4, 5}
+
+
+class TestBudget:
+    def test_always_active_without_schedule(self):
+        budget = Budget(nodes="10%")
+        assert budget.is_active(1_000_000.0)
+
+    def test_percentage_rounds_up(self):
+        budget = Budget(nodes="5%")
+        assert budget.allowed_disruptions(0.0, 10) == 1  # ceil(0.5)
+
+    def test_int_nodes(self):
+        assert Budget(nodes="3").allowed_disruptions(0.0, 100) == 3
+
+    def test_inactive_schedule_unbounded(self):
+        import calendar
+
+        # window: 09:00 UTC for 1h; check at 11:00
+        budget = Budget(nodes="0", schedule="0 9 * * *", duration="1h")
+        at_11 = calendar.timegm((2026, 7, 29, 11, 0, 0, 0, 0, 0))
+        assert budget.allowed_disruptions(float(at_11), 10) > 1_000_000
+        at_0930 = calendar.timegm((2026, 7, 29, 9, 30, 0, 0, 0, 0))
+        assert budget.allowed_disruptions(float(at_0930), 10) == 0
+
+    def test_nodepool_min_over_budgets(self):
+        pool = NodePool()
+        pool.spec.disruption.budgets = [
+            Budget(nodes="5"),
+            Budget(nodes="2", reasons=["Empty"]),
+        ]
+        assert pool.allowed_disruptions(0.0, 100, "Empty") == 2
+        assert pool.allowed_disruptions(0.0, 100, "Drifted") == 5
+
+
+class TestResources:
+    def test_pod_requests_init_max(self):
+        pod = Pod(
+            spec=PodSpec(
+                containers=[Container(requests={"cpu": 1.0}), Container(requests={"cpu": 0.5})],
+                init_containers=[Container(requests={"cpu": 2.0})],
+            )
+        )
+        out = res.pod_requests(pod)
+        assert out["cpu"] == 2.0  # init container dominates
+        assert out["pods"] == 1.0
+
+    def test_fits(self):
+        assert res.fits({"cpu": 1.0}, {"cpu": 2.0, "memory": 1.0})
+        assert not res.fits({"cpu": 3.0}, {"cpu": 2.0})
+        assert not res.fits({"gpu": 1.0}, {"cpu": 2.0})
+        assert res.fits({"gpu": 0.0}, {"cpu": 2.0})
+
+
+class TestTaints:
+    def test_tolerates(self):
+        taint = Taint(key="dedicated", value="gpu", effect="NoSchedule")
+        assert taintutil.tolerates([taint], []) is not None
+        assert (
+            taintutil.tolerates(
+                [taint], [Toleration(key="dedicated", operator="Equal", value="gpu")]
+            )
+            is None
+        )
+        assert taintutil.tolerates([taint], [Toleration(key="dedicated", operator="Exists")]) is None
+        # empty-key Exists tolerates everything
+        assert taintutil.tolerates([taint], [Toleration(operator="Exists")]) is None
+
+    def test_prefer_no_schedule_never_blocks(self):
+        taint = Taint(key="x", effect="PreferNoSchedule")
+        assert taintutil.tolerates([taint], []) is None
+
+    def test_merge_prefers_existing(self):
+        a = [Taint(key="k", value="v1", effect="NoSchedule")]
+        merged = taintutil.merge(a, [Taint(key="k", value="v2", effect="NoSchedule")])
+        assert len(merged) == 1 and merged[0].value == "v1"
+
+    def test_ephemeral_filter(self):
+        eph = Taint(key="node.kubernetes.io/not-ready", effect="NoSchedule")
+        keep = Taint(key="dedicated", effect="NoSchedule")
+        assert taintutil.filter_ephemeral([eph, keep]) == [keep]
+
+
+class TestHostPorts:
+    def test_conflict(self):
+        usage = HostPortUsage()
+        pod1 = Pod(spec=PodSpec(containers=[Container(ports=[8080])]))
+        pod2 = Pod(spec=PodSpec(containers=[Container(ports=[8080])]))
+        pod3 = Pod(spec=PodSpec(containers=[Container(ports=[9090])]))
+        assert usage.conflict(pod1) is None
+        usage.add(pod1)
+        assert usage.conflict(pod2) is not None
+        assert usage.conflict(pod3) is None

@@ -1,0 +1,225 @@
+"""End-to-end provisioning slice tests.
+
+Mirrors the reference's provisioning suite behaviors
+(provisioning/suite_test.go + lifecycle): pending pods -> solve ->
+NodeClaims -> simulated cloud -> registered/initialized nodes -> pods
+bound; plus reuse of existing capacity, daemonset overhead, limits,
+topology spread and anti-affinity scenarios.
+"""
+
+import time
+
+from karpenter_tpu.apis.v1.labels import (
+    NODEPOOL_LABEL,
+    TOPOLOGY_ZONE_LABEL,
+)
+from karpenter_tpu.apis.v1.nodeclaim import COND_INITIALIZED, COND_REGISTERED
+from karpenter_tpu.cloudprovider.fake import GIB, make_instance_type
+from karpenter_tpu.kube.objects import (
+    Affinity,
+    Container,
+    DaemonSet,
+    DaemonSetSpec,
+    LabelSelector,
+    ObjectMeta,
+    PodAffinity,
+    PodAffinityTerm,
+    PodSpec,
+    PodTemplateSpec,
+    TopologySpreadConstraint,
+)
+from karpenter_tpu.testing import Environment, mk_nodepool, mk_pod
+
+
+def small_types():
+    return [
+        make_instance_type("c2", cpu=2, memory=8 * GIB),
+        make_instance_type("c8", cpu=8, memory=32 * GIB),
+    ]
+
+
+class TestEndToEnd:
+    def test_pending_pod_creates_node_and_binds(self):
+        env = Environment(types=small_types())
+        env.kube.create(mk_nodepool("default"))
+        pod = mk_pod(cpu=1.0)
+        results = env.provision(pod)
+        assert results.scheduled_count == 1
+        claims = env.kube.node_claims()
+        assert len(claims) == 1
+        assert claims[0].status_conditions.is_true(COND_REGISTERED)
+        assert claims[0].status_conditions.is_true(COND_INITIALIZED)
+        nodes = env.kube.nodes()
+        assert len(nodes) == 1
+        live = env.kube.get_pod("default", pod.metadata.name)
+        assert live.spec.node_name == nodes[0].metadata.name
+        assert nodes[0].metadata.labels[NODEPOOL_LABEL] == "default"
+
+    def test_no_nodepool_no_nodes(self):
+        env = Environment(types=small_types())
+        results = env.provision(mk_pod())
+        assert not env.kube.node_claims()
+        assert results.errors
+
+    def test_second_batch_reuses_existing_node(self):
+        env = Environment(types=small_types())
+        env.kube.create(mk_nodepool("default"))
+        env.provision(mk_pod(cpu=1.0))
+        assert len(env.kube.nodes()) == 1
+        # c2 has ~1.9 cpu allocatable; 1 used -> 0.9 free; 0.5 fits
+        env.provision(mk_pod(cpu=0.5))
+        assert len(env.kube.nodes()) == 1  # reused
+        env.provision(mk_pod(cpu=1.5))
+        assert len(env.kube.nodes()) == 2  # overflow opens a new node
+
+    def test_many_pods_bin_pack(self):
+        env = Environment(types=small_types())
+        env.kube.create(mk_nodepool("default"))
+        pods = [mk_pod(cpu=1.0, memory=GIB) for _ in range(14)]
+        results = env.provision(*pods)
+        assert results.scheduled_count == 14
+        # c8 has 7.9 cpu allocatable -> 7 pods/node -> 2 nodes
+        assert len(env.kube.nodes()) == 2
+
+    def test_daemonset_overhead_accounted(self):
+        env = Environment(types=small_types())
+        env.kube.create(mk_nodepool("default"))
+        ds = DaemonSet(
+            metadata=ObjectMeta(name="logging"),
+            spec=DaemonSetSpec(
+                template=PodTemplateSpec(
+                    spec=PodSpec(containers=[Container(requests={"cpu": 1.0})])
+                )
+            ),
+        )
+        env.kube.create(ds)
+        results = env.provision(mk_pod(cpu=1.5))
+        # c2 (1.9 alloc) can't hold 1.5 + 1.0 daemon -> picks c8
+        nodes = env.kube.nodes()
+        assert len(nodes) == 1
+        assert nodes[0].metadata.labels["node.kubernetes.io/instance-type"] == "c8"
+
+    def test_nodepool_limits_block_creation(self):
+        env = Environment(types=small_types())
+        pool = mk_nodepool("default")
+        pool.spec.limits = {"cpu": 1.0}  # smaller than any instance
+        env.kube.create(pool)
+        results = env.provision(mk_pod(cpu=0.5))
+        assert not env.kube.node_claims()
+        assert results.errors
+
+    def test_registration_delay_keeps_claim_unregistered(self):
+        env = Environment(types=small_types(), registration_delay=3600)
+        env.kube.create(mk_nodepool("default"))
+        env.provision(mk_pod())
+        claim = env.kube.node_claims()[0]
+        assert claim.status.provider_id  # launched
+        assert not claim.status_conditions.is_true(COND_REGISTERED)
+        assert not env.kube.nodes()
+
+    def test_inflight_claim_reused_before_new_node(self):
+        env = Environment(types=small_types(), registration_delay=3600)
+        env.kube.create(mk_nodepool("default"))
+        env.provision(mk_pod(cpu=0.5))
+        assert len(env.kube.node_claims()) == 1
+        # second pod fits the in-flight claim's remaining capacity
+        env.provision(mk_pod(cpu=0.5))
+        assert len(env.kube.node_claims()) == 1
+
+
+class TestTopologyScheduling:
+    def test_zone_spread_constraint(self):
+        env = Environment(types=small_types())
+        env.kube.create(mk_nodepool("default"))
+        pods = [
+            mk_pod(
+                labels={"app": "web"},
+                topology_spread_constraints=[
+                    TopologySpreadConstraint(
+                        max_skew=1,
+                        topology_key=TOPOLOGY_ZONE_LABEL,
+                        when_unsatisfiable="DoNotSchedule",
+                        label_selector=LabelSelector.of({"app": "web"}),
+                    )
+                ],
+            )
+            for _ in range(6)
+        ]
+        results = env.provision(*pods)
+        assert results.scheduled_count == 6
+        zones = {}
+        for node in env.kube.nodes():
+            zone = node.metadata.labels[TOPOLOGY_ZONE_LABEL]
+            for pod in env.kube.pods():
+                if pod.spec.node_name == node.metadata.name:
+                    zones[zone] = zones.get(zone, 0) + 1
+        assert max(zones.values()) - min(zones.values()) <= 1
+        assert len(zones) == 3
+
+    def test_hostname_anti_affinity_forces_nodes(self):
+        env = Environment(types=small_types())
+        env.kube.create(mk_nodepool("default"))
+        anti = Affinity(
+            pod_anti_affinity=PodAffinity(
+                required=(
+                    PodAffinityTerm(
+                        label_selector=LabelSelector.of({"app": "db"}),
+                        topology_key="kubernetes.io/hostname",
+                    ),
+                )
+            )
+        )
+        pods = [
+            mk_pod(cpu=0.25, labels={"app": "db"}, affinity=anti) for _ in range(3)
+        ]
+        results = env.provision(*pods)
+        assert results.scheduled_count == 3
+        # each pod must land on its own node
+        node_names = {
+            env.kube.get_pod("default", p.metadata.name).spec.node_name for p in pods
+        }
+        assert len(node_names) == 3
+
+    def test_pod_affinity_coschedules(self):
+        env = Environment(types=small_types())
+        env.kube.create(mk_nodepool("default"))
+        aff = Affinity(
+            pod_affinity=PodAffinity(
+                required=(
+                    PodAffinityTerm(
+                        label_selector=LabelSelector.of({"app": "cache"}),
+                        topology_key=TOPOLOGY_ZONE_LABEL,
+                    ),
+                )
+            )
+        )
+        pods = [
+            mk_pod(cpu=0.25, labels={"app": "cache"}, affinity=aff) for _ in range(4)
+        ]
+        results = env.provision(*pods)
+        assert results.scheduled_count == 4
+        zones = {
+            env.kube.get_node(
+                env.kube.get_pod("default", p.metadata.name).spec.node_name
+            ).metadata.labels[TOPOLOGY_ZONE_LABEL]
+            for p in pods
+        }
+        assert len(zones) == 1
+
+
+class TestLiveness:
+    def test_launch_timeout_deletes_claim(self):
+        env = Environment(types=small_types())
+        env.kube.create(mk_nodepool("default"))
+        # force launches to fail -> Launched False path
+        def fail_create(claim):
+            raise RuntimeError("simulated cloud outage")
+
+        env.cloud.create = fail_create
+        now = time.time()
+        env.provision(mk_pod(), now=now)
+        claim = env.kube.node_claims()[0]
+        assert not claim.status_conditions.is_true("Launched")
+        # after timeout the liveness reconciler deletes the claim
+        env.lifecycle.reconcile_all(now=now + 6 * 60)
+        assert not env.kube.node_claims()

@@ -1,0 +1,195 @@
+"""Requirement/Requirements set-algebra tests.
+
+Property sets derived from the reference's
+pkg/scheduling/requirement_test.go and requirements_test.go: operator
+semantics, intersections across the full operator matrix, Gt/Lt bounds,
+minValues propagation, and Compatible's custom-label rules.
+"""
+
+import pytest
+
+from karpenter_tpu.apis.v1.labels import WELL_KNOWN_LABELS
+from karpenter_tpu.scheduling.requirement import (
+    DOES_NOT_EXIST,
+    EXISTS,
+    GT,
+    IN,
+    LT,
+    NOT_IN,
+    Requirement,
+)
+from karpenter_tpu.scheduling.requirements import Requirements
+
+
+def req(op, *values, key="key", min_values=None):
+    return Requirement(key, op, values, min_values=min_values)
+
+
+class TestRequirementHas:
+    def test_in(self):
+        r = req(IN, "a", "b")
+        assert r.has("a") and r.has("b") and not r.has("c")
+
+    def test_not_in(self):
+        r = req(NOT_IN, "a")
+        assert not r.has("a") and r.has("b")
+
+    def test_exists(self):
+        assert req(EXISTS).has("anything")
+
+    def test_does_not_exist(self):
+        assert not req(DOES_NOT_EXIST).has("anything")
+
+    def test_gt_lt(self):
+        assert req(GT, "5").has("6")
+        assert not req(GT, "5").has("5")
+        assert req(LT, "5").has("4")
+        assert not req(LT, "5").has("5")
+        # non-numeric values fail bounds
+        assert not req(GT, "5").has("abc")
+
+    def test_operator_names(self):
+        assert req(IN, "a").operator() == IN
+        assert req(NOT_IN, "a").operator() == NOT_IN
+        assert req(EXISTS).operator() == EXISTS
+        assert req(DOES_NOT_EXIST).operator() == DOES_NOT_EXIST
+        # Gt/Lt become bounded Exists
+        assert req(GT, "1").operator() == EXISTS
+
+
+class TestIntersection:
+    def test_in_in(self):
+        out = req(IN, "a", "b").intersection(req(IN, "b", "c"))
+        assert out.operator() == IN and out.value_list() == ["b"]
+
+    def test_in_in_disjoint(self):
+        out = req(IN, "a").intersection(req(IN, "b"))
+        assert out.operator() == DOES_NOT_EXIST
+
+    def test_in_not_in(self):
+        out = req(IN, "a", "b").intersection(req(NOT_IN, "b"))
+        assert out.value_list() == ["a"]
+
+    def test_not_in_not_in(self):
+        out = req(NOT_IN, "a").intersection(req(NOT_IN, "b"))
+        assert out.operator() == NOT_IN
+        assert not out.has("a") and not out.has("b") and out.has("c")
+
+    def test_exists_in(self):
+        out = req(EXISTS).intersection(req(IN, "a"))
+        assert out.operator() == IN and out.value_list() == ["a"]
+
+    def test_does_not_exist_wins(self):
+        out = req(DOES_NOT_EXIST).intersection(req(IN, "a"))
+        assert out.operator() == DOES_NOT_EXIST
+
+    def test_gt_lt_band(self):
+        out = req(GT, "1").intersection(req(LT, "5"))
+        assert not out.has("1") and out.has("2") and out.has("4") and not out.has("5")
+
+    def test_gt_lt_empty_band(self):
+        out = req(GT, "5").intersection(req(LT, "5"))
+        assert out.operator() == DOES_NOT_EXIST
+
+    def test_in_with_bounds(self):
+        out = req(IN, "1", "3", "9").intersection(req(LT, "5"))
+        assert sorted(out.value_list()) == ["1", "3"]
+
+    def test_min_values_max_propagates(self):
+        out = req(IN, "a", "b", min_values=1).intersection(req(IN, "a", "b", min_values=2))
+        assert out.min_values == 2
+
+    def test_commutative_on_has(self):
+        cases = [
+            (req(IN, "a", "b"), req(NOT_IN, "b")),
+            (req(EXISTS), req(IN, "x")),
+            (req(GT, "2"), req(IN, "1", "3")),
+            (req(NOT_IN, "a"), req(NOT_IN, "b")),
+        ]
+        for a, b in cases:
+            ab, ba = a.intersection(b), b.intersection(a)
+            for v in ["a", "b", "x", "1", "3", "7"]:
+                assert ab.has(v) == ba.has(v)
+
+
+class TestHasIntersection:
+    @pytest.mark.parametrize(
+        "a,b,expected",
+        [
+            (req(IN, "a"), req(IN, "a", "b"), True),
+            (req(IN, "a"), req(IN, "b"), False),
+            (req(IN, "a"), req(NOT_IN, "a"), False),
+            (req(IN, "a", "b"), req(NOT_IN, "a"), True),
+            (req(NOT_IN, "a"), req(NOT_IN, "b"), True),
+            (req(EXISTS), req(DOES_NOT_EXIST), False),
+            (req(GT, "5"), req(LT, "4"), False),
+            (req(GT, "5"), req(IN, "10"), True),
+            (req(LT, "5"), req(IN, "10"), False),
+        ],
+    )
+    def test_matrix(self, a, b, expected):
+        assert a.has_intersection(b) == expected
+        assert b.has_intersection(a) == expected
+        # consistency with full intersection
+        inter = a.intersection(b)
+        nonempty = inter.complement or len(inter.values) > 0
+        assert nonempty == expected
+
+
+class TestRequirements:
+    def test_add_tightens(self):
+        rs = Requirements([req(IN, "a", "b")])
+        rs.add(req(IN, "b", "c"))
+        assert rs.get("key").value_list() == ["b"]
+
+    def test_get_undefined_is_exists(self):
+        rs = Requirements()
+        assert rs.get("anything").operator() == EXISTS
+
+    def test_intersects_ok(self):
+        a = Requirements([req(IN, "a", "b")])
+        b = Requirements([req(IN, "b")])
+        assert a.intersects(b) is None
+
+    def test_intersects_conflict(self):
+        a = Requirements([req(IN, "a")])
+        b = Requirements([req(IN, "b")])
+        assert a.intersects(b) is not None
+
+    def test_intersects_notin_leniency(self):
+        # both sides NotIn with empty intersection is forgiven
+        a = Requirements([req(NOT_IN, "a")])
+        b = Requirements([Requirement("key", DOES_NOT_EXIST)])
+        # existing NotIn + incoming DoesNotExist -> forgiven
+        assert a.intersects(b) is None
+
+    def test_compatible_custom_label_undefined_rejected(self):
+        node = Requirements()  # node defines nothing
+        pod = Requirements([Requirement("custom", IN, ["x"])])
+        assert node.compatible(pod) is not None
+
+    def test_compatible_well_known_undefined_allowed(self):
+        node = Requirements()
+        pod = Requirements([Requirement("topology.kubernetes.io/zone", IN, ["z1"])])
+        assert node.compatible(pod, allow_undefined=WELL_KNOWN_LABELS) is None
+
+    def test_compatible_custom_label_notin_ok(self):
+        node = Requirements()
+        pod = Requirements([Requirement("custom", NOT_IN, ["x"])])
+        assert node.compatible(pod) is None
+
+    def test_label_normalization(self):
+        r = Requirement("beta.kubernetes.io/arch", IN, ["amd64"])
+        assert r.key == "kubernetes.io/arch"
+
+    def test_labels_projection(self):
+        rs = Requirements([Requirement("node.kubernetes.io/instance-type", IN, ["m5.large"])])
+        assert rs.labels()["node.kubernetes.io/instance-type"] == "m5.large"
+
+    def test_hostname_not_projected(self):
+        rs = Requirements([Requirement("kubernetes.io/hostname", IN, ["h1"])])
+        assert "kubernetes.io/hostname" not in rs.labels()
+
+    def test_has_min_values(self):
+        assert not Requirements([req(IN, "a")]).has_min_values()
+        assert Requirements([req(IN, "a", min_values=1)]).has_min_values()

@@ -1,0 +1,227 @@
+"""Solver tests: device/host parity + scheduling semantics.
+
+Scenario shapes derived from the reference's
+provisioning/scheduling suites (instance_selection_test.go,
+suite_test.go): nodeSelector routing, taint tolerance, zone
+constraints, pool weight order, existing-node reuse, bin-packing
+tightness, unschedulable pods.
+"""
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.apis.v1.labels import (
+    CAPACITY_TYPE_LABEL,
+    NODEPOOL_LABEL,
+    TOPOLOGY_ZONE_LABEL,
+)
+from karpenter_tpu.apis.v1.nodepool import NodePool, NodePoolSpec, NodeClaimTemplate
+from karpenter_tpu.cloudprovider.fake import GIB, instance_types, make_instance_type
+from karpenter_tpu.kube.objects import (
+    Container,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+    Taint,
+    Toleration,
+)
+from karpenter_tpu.scheduling.requirements import Requirements
+from karpenter_tpu.solver.encode import ExistingNodeInput, encode, group_pods
+from karpenter_tpu.solver.reference_ffd import solve_ffd_host
+from karpenter_tpu.solver.solver import solve
+
+
+def make_pod(name, cpu=1.0, mem=GIB, labels=None, node_selector=None, tolerations=None):
+    return Pod(
+        metadata=ObjectMeta(name=name, labels=labels or {}),
+        spec=PodSpec(
+            containers=[Container(requests={"cpu": cpu, "memory": mem})],
+            node_selector=node_selector or {},
+            tolerations=tolerations or [],
+        ),
+    )
+
+
+def make_pool(name="default", weight=0, taints=None, labels=None):
+    pool = NodePool(metadata=ObjectMeta(name=name), spec=NodePoolSpec(weight=weight))
+    if taints:
+        pool.spec.template.spec.taints = taints
+    if labels:
+        pool.spec.template.labels = labels
+    return pool
+
+
+class TestSolverBasics:
+    def test_single_pod_gets_cheapest_fit(self):
+        types = [
+            make_instance_type("small", cpu=2, memory=4 * GIB),
+            make_instance_type("big", cpu=16, memory=64 * GIB),
+        ]
+        sol = solve([make_pod("p1", cpu=1.0)], [(make_pool(), types)])
+        assert len(sol.new_nodes) == 1
+        assert sol.new_nodes[0].instance_types[0].name == "small"
+        assert not sol.unschedulable
+
+    def test_bin_packs_identical_pods(self):
+        types = [make_instance_type("c4", cpu=4, memory=16 * GIB, pods=110)]
+        # 3.9 usable cpu after overhead -> 3 pods of 1.3 cpu? use 1.0: 3 per node
+        pods = [make_pod(f"p{i}", cpu=1.0, mem=GIB) for i in range(9)]
+        sol = solve(pods, [(make_pool(), types)])
+        assert len(sol.new_nodes) == 3
+        assert sorted(len(n.pods) for n in sol.new_nodes) == [3, 3, 3]
+
+    def test_node_selector_routes_to_instance_type(self):
+        types = [
+            make_instance_type("amd", cpu=4, memory=16 * GIB, arch="amd64"),
+            make_instance_type("arm", cpu=4, memory=16 * GIB, arch="arm64"),
+        ]
+        pod = make_pod("p1", node_selector={"kubernetes.io/arch": "arm64"})
+        sol = solve([pod], [(make_pool(), types)])
+        assert len(sol.new_nodes) == 1
+        assert sol.new_nodes[0].instance_types[0].name == "arm"
+
+    def test_zone_selector_separates_nodes(self):
+        types = [make_instance_type("c4", cpu=4, memory=16 * GIB)]
+        pods = [
+            make_pod("p1", node_selector={TOPOLOGY_ZONE_LABEL: "test-zone-1"}),
+            make_pod("p2", node_selector={TOPOLOGY_ZONE_LABEL: "test-zone-2"}),
+        ]
+        sol = solve(pods, [(make_pool(), types)])
+        assert len(sol.new_nodes) == 2
+        zones = sorted(
+            n.offerings[0].zone for n in sol.new_nodes
+        )
+        assert zones == ["test-zone-1", "test-zone-2"]
+
+    def test_unknown_custom_label_unschedulable(self):
+        types = [make_instance_type("c4")]
+        pod = make_pod("p1", node_selector={"my-custom": "x"})
+        sol = solve([pod], [(make_pool(), types)])
+        assert len(sol.unschedulable) == 1
+        assert not sol.new_nodes
+
+    def test_pool_label_satisfies_custom_selector(self):
+        types = [make_instance_type("c4")]
+        pod = make_pod("p1", node_selector={"team": "ml"})
+        sol = solve([pod], [(make_pool(labels={"team": "ml"}), types)])
+        assert len(sol.new_nodes) == 1
+
+    def test_taints_block_untolerating_pods(self):
+        types = [make_instance_type("c4")]
+        tainted = make_pool(
+            name="tainted", weight=10, taints=[Taint(key="dedicated", value="gpu")]
+        )
+        plain = make_pool(name="plain", weight=0)
+        pod = make_pod("p1")
+        sol = solve([pod], [(tainted, types), (plain, types)])
+        # despite higher weight, tainted pool is skipped
+        assert sol.new_nodes[0].pool.metadata.name == "plain"
+
+        tolerant = make_pod(
+            "p2", tolerations=[Toleration(key="dedicated", operator="Exists")]
+        )
+        sol2 = solve([tolerant], [(tainted, types), (plain, types)])
+        assert sol2.new_nodes[0].pool.metadata.name == "tainted"
+
+    def test_pool_weight_order(self):
+        types = [make_instance_type("c4")]
+        heavy = make_pool(name="heavy", weight=100)
+        light = make_pool(name="light", weight=1)
+        sol = solve([make_pod("p1")], [(heavy, types), (light, types)])
+        assert sol.new_nodes[0].pool.metadata.name == "heavy"
+
+    def test_existing_node_preferred(self):
+        types = [make_instance_type("c4")]
+        existing = ExistingNodeInput(
+            name="node-1",
+            requirements=Requirements.from_labels(
+                {"kubernetes.io/arch": "amd64", TOPOLOGY_ZONE_LABEL: "test-zone-1"}
+            ),
+            taints=(),
+            available={"cpu": 3.0, "memory": 8 * GIB, "pods": 100},
+        )
+        sol = solve([make_pod("p1", cpu=1.0)], [(make_pool(), types)], existing=[existing])
+        assert not sol.new_nodes
+        assert len(sol.existing) == 1 and len(sol.existing[0].pods) == 1
+
+    def test_existing_node_overflow_opens_new(self):
+        types = [make_instance_type("c4", cpu=4)]
+        existing = ExistingNodeInput(
+            name="node-1",
+            requirements=Requirements.from_labels({"kubernetes.io/arch": "amd64"}),
+            taints=(),
+            available={"cpu": 1.5, "memory": 8 * GIB, "pods": 100},
+        )
+        pods = [make_pod(f"p{i}", cpu=1.0) for i in range(4)]
+        sol = solve(pods, [(make_pool(), types)], existing=[existing])
+        assert len(sol.existing) == 1
+        assert len(sol.existing[0].pods) == 1
+        assert sum(len(n.pods) for n in sol.new_nodes) == 3
+
+    def test_daemon_overhead_reserved(self):
+        types = [make_instance_type("c4", cpu=4)]
+        # 3.9 cpu allocatable; 2.0 daemon overhead leaves 1.9 -> 1 pod of 1cpu... 1.9//1 = 1
+        sol = solve(
+            [make_pod("p1", cpu=1.0), make_pod("p2", cpu=1.0)],
+            [(make_pool(), types)],
+            daemon_overhead={"default": {"cpu": 2.0}},
+        )
+        assert len(sol.new_nodes) == 2
+
+    def test_capacity_type_requirement(self):
+        types = [make_instance_type("c4")]
+        pod = make_pod("p1", node_selector={CAPACITY_TYPE_LABEL: "on-demand"})
+        sol = solve([pod], [(make_pool(), types)])
+        assert len(sol.new_nodes) == 1
+        assert all(o.capacity_type == "on-demand" for o in sol.new_nodes[0].offerings)
+
+    def test_nodepool_label_selector(self):
+        types = [make_instance_type("c4")]
+        pool_a, pool_b = make_pool("pool-a", weight=10), make_pool("pool-b")
+        pod = make_pod("p1", node_selector={NODEPOOL_LABEL: "pool-b"})
+        sol = solve([pod], [(pool_a, types), (pool_b, types)])
+        assert sol.new_nodes[0].pool.metadata.name == "pool-b"
+
+
+class TestDeviceHostParity:
+    @pytest.mark.parametrize("seed", [0, 1, 2, 3])
+    def test_random_parity(self, seed):
+        rng = np.random.default_rng(seed)
+        types = instance_types(12)
+        pools = [
+            (make_pool("a", weight=5), types[:8]),
+            (make_pool("b", weight=1), types[4:]),
+        ]
+        pods = []
+        for i in range(60):
+            cpu = float(rng.choice([0.25, 0.5, 1.0, 2.0, 4.0]))
+            mem = float(rng.choice([1, 2, 4])) * GIB
+            selector = {}
+            if rng.random() < 0.3:
+                selector["kubernetes.io/arch"] = str(rng.choice(["amd64", "arm64"]))
+            if rng.random() < 0.2:
+                selector[TOPOLOGY_ZONE_LABEL] = str(
+                    rng.choice(["test-zone-1", "test-zone-2"])
+                )
+            pods.append(make_pod(f"p{i}", cpu=cpu, mem=mem, node_selector=selector))
+        groups = group_pods(pods)
+        enc = encode(groups, pools)
+        host_nodes, host_unsched = solve_ffd_host(enc)
+
+        device = solve(pods, pools, backend="jax")
+        host = solve(pods, pools, backend="host")
+
+        assert sum(len(n.pods) for n in device.new_nodes) == sum(
+            len(n.pods) for n in host.new_nodes
+        )
+        assert len(device.new_nodes) == len(host.new_nodes)
+        assert len(device.unschedulable) == len(host.unschedulable)
+        # identical node shapes: same multiset of (pool, cheapest-it, npods)
+        def shape(sol):
+            return sorted(
+                (n.pool.metadata.name, n.instance_types[0].name, len(n.pods))
+                for n in sol.new_nodes
+            )
+
+        assert shape(device) == shape(host)
+        assert abs(device.total_price - host.total_price) < 1e-6

@@ -1,0 +1,98 @@
+"""Node termination / drain / eviction tests.
+
+Mirrors reference node/termination suite behaviors: finalizer teardown
+order (claim -> node drain -> instance), disrupted taint, PDB-blocked
+eviction, TGP enforcement bypassing do-not-disrupt.
+"""
+
+import time
+
+from karpenter_tpu.apis.v1.labels import (
+    DISRUPTED_TAINT_KEY,
+    DO_NOT_DISRUPT_ANNOTATION,
+)
+from karpenter_tpu.cloudprovider.fake import GIB, make_instance_type
+from karpenter_tpu.kube.objects import (
+    LabelSelector,
+    ObjectMeta,
+    PodDisruptionBudget,
+    PodDisruptionBudgetSpec,
+)
+from karpenter_tpu.testing import Environment, mk_nodepool, mk_pod
+
+
+def one_type():
+    return [make_instance_type("c8", cpu=8, memory=32 * GIB)]
+
+
+def provisioned_env(n_pods=2):
+    env = Environment(types=one_type())
+    env.kube.create(mk_nodepool("default"))
+    pods = [mk_pod(cpu=0.5, labels={"app": "web"}) for _ in range(n_pods)]
+    env.provision(*pods)
+    return env, pods
+
+
+class TestTermination:
+    def test_claim_delete_tears_down_everything(self):
+        env, _ = provisioned_env()
+        claim = env.kube.node_claims()[0]
+        env.kube.delete(claim)
+        env.reconcile_termination()
+        assert not env.kube.node_claims()
+        assert not env.kube.nodes()
+        assert not env.cloud.list()
+
+    def test_node_tainted_during_drain(self):
+        env, _ = provisioned_env()
+        node = env.kube.nodes()[0]
+        # block eviction so drain stalls mid-way
+        env.kube.create(
+            PodDisruptionBudget(
+                metadata=ObjectMeta(name="pdb"),
+                spec=PodDisruptionBudgetSpec(
+                    selector=LabelSelector.of({"app": "web"}), max_unavailable=0
+                ),
+            )
+        )
+        claim = env.kube.node_claims()[0]
+        env.kube.delete(claim)
+        env.reconcile_termination()
+        node = env.kube.get_node(node.metadata.name)
+        assert node is not None  # still draining
+        assert any(t.key == DISRUPTED_TAINT_KEY for t in node.spec.taints)
+        assert env.termination.queue.blocked  # PDB blocked the eviction
+
+    def test_pdb_released_allows_drain(self):
+        env, _ = provisioned_env()
+        pdb = PodDisruptionBudget(
+            metadata=ObjectMeta(name="pdb"),
+            spec=PodDisruptionBudgetSpec(
+                selector=LabelSelector.of({"app": "web"}), max_unavailable=0
+            ),
+        )
+        env.kube.create(pdb)
+        claim = env.kube.node_claims()[0]
+        env.kube.delete(claim)
+        env.reconcile_termination()
+        assert env.kube.nodes()  # blocked
+        env.kube.delete(pdb)
+        env.reconcile_termination()
+        assert not env.kube.nodes()
+
+    def test_do_not_disrupt_pod_blocks_until_tgp(self):
+        env = Environment(types=one_type())
+        pool = mk_nodepool("default")
+        pool.spec.template.spec.termination_grace_period = "1h"
+        env.kube.create(pool)
+        pod = mk_pod(cpu=0.5)
+        pod.metadata.annotations[DO_NOT_DISRUPT_ANNOTATION] = "true"
+        env.provision(pod)
+        claim = env.kube.node_claims()[0]
+        now = time.time()
+        env.kube.delete(claim, now=now)
+        env.reconcile_termination(now=now)
+        assert env.kube.nodes()  # pod holds the node
+        # after the grace period the pod is force-deleted
+        env.reconcile_termination(now=now + 3601)
+        assert not env.kube.nodes()
