@@ -1,0 +1,162 @@
+"""NodeClaim disruption-condition controllers.
+
+Counterpart of pkg/controllers/nodeclaim/disruption (1,323 LoC) and
+nodeclaim/expiration: maintain the conditions the disruption engine
+consumes —
+
+- Consolidatable: consolidateAfter elapsed since the last pod event
+  (consolidation.go:38); cleared while pods churn.
+- Drifted: provider IsDrifted, or the NodePool template hash changed
+  (static drift), or the claim no longer satisfies the pool's
+  requirements (dynamic drift) (drift.go:50-185).
+- Expiration: claims older than expireAfter are force-deleted
+  (expiration/controller.go:57-100).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from karpenter_tpu.apis.v1.labels import (
+    NODEPOOL_HASH_ANNOTATION,
+    NODEPOOL_HASH_VERSION_ANNOTATION,
+    NODEPOOL_HASH_VERSION,
+    NODEPOOL_LABEL,
+)
+from karpenter_tpu.apis.v1.nodeclaim import (
+    COND_CONSOLIDATABLE,
+    COND_DRIFTED,
+    NodeClaim,
+)
+from karpenter_tpu.apis.v1.nodepool import (
+    CONSOLIDATION_WHEN_EMPTY,
+    NodePool,
+)
+from karpenter_tpu.cloudprovider.types import CloudProvider
+from karpenter_tpu.kube.client import KubeClient
+from karpenter_tpu.scheduling.requirement import Requirement
+from karpenter_tpu.scheduling.requirements import Requirements
+from karpenter_tpu.state.cluster import Cluster
+from karpenter_tpu.utils.duration import parse_duration
+
+
+class DisruptionConditionsController:
+    def __init__(self, kube: KubeClient, cluster: Cluster, cloud: CloudProvider):
+        self.kube = kube
+        self.cluster = cluster
+        self.cloud = cloud
+
+    def reconcile(self, claim: NodeClaim, now: Optional[float] = None) -> None:
+        now = time.time() if now is None else now
+        pool = self.kube.get_node_pool(claim.metadata.labels.get(NODEPOOL_LABEL, ""))
+        if pool is None:
+            return
+        self._consolidatable(claim, pool, now)
+        self._drifted(claim, pool, now)
+
+    def reconcile_all(self, now: Optional[float] = None) -> None:
+        for claim in list(self.kube.node_claims()):
+            self.reconcile(claim, now=now)
+
+    # -- Consolidatable (nodeclaim/disruption/consolidation.go:38) -------------
+
+    def _consolidatable(self, claim: NodeClaim, pool: NodePool, now: float) -> None:
+        consolidate_after = parse_duration(pool.spec.disruption.consolidate_after)
+        if consolidate_after is None:  # "Never"
+            claim.status_conditions.clear(COND_CONSOLIDATABLE)
+            return
+        last_event = claim.status.last_pod_event_time or claim.metadata.creation_timestamp
+        if now - last_event >= consolidate_after:
+            claim.status_conditions.set_true(COND_CONSOLIDATABLE, now=now)
+        else:
+            claim.status_conditions.clear(COND_CONSOLIDATABLE)
+
+    # -- Drifted (nodeclaim/disruption/drift.go:50-185) ------------------------
+
+    def _drifted(self, claim: NodeClaim, pool: NodePool, now: float) -> None:
+        if not claim.status_conditions.is_true("Launched"):
+            return
+        reason = self._drift_reason(claim, pool)
+        if reason:
+            claim.status_conditions.set_true(COND_DRIFTED, reason=reason, now=now)
+        else:
+            claim.status_conditions.clear(COND_DRIFTED)
+
+    def _drift_reason(self, claim: NodeClaim, pool: NodePool) -> str:
+        # provider-side drift (image/nodeclass changes)
+        provider_reason = self.cloud.is_drifted(claim)
+        if provider_reason:
+            return provider_reason
+        # static drift: template hash comparison at matching hash version
+        claim_version = claim.metadata.annotations.get(NODEPOOL_HASH_VERSION_ANNOTATION)
+        claim_hash = claim.metadata.annotations.get(NODEPOOL_HASH_ANNOTATION)
+        if claim_version == NODEPOOL_HASH_VERSION and claim_hash:
+            if claim_hash != pool.hash():
+                return "NodePoolDrifted"
+        # dynamic drift: claim labels must still satisfy pool requirements
+        pool_reqs = Requirements()
+        for spec in pool.spec.template.spec.requirements:
+            pool_reqs.add(Requirement(spec.key, spec.operator, spec.values))
+        for key, value in pool.spec.template.labels.items():
+            pool_reqs.add(Requirement(key, "In", [value]))
+        claim_reqs = Requirements.from_labels(claim.metadata.labels)
+        if claim_reqs.intersects(pool_reqs) is not None:
+            return "RequirementsDrifted"
+        return ""
+
+
+class ExpirationController:
+    """Force-deletes claims past expireAfter
+    (nodeclaim/expiration/controller.go:57-100)."""
+
+    def __init__(self, kube: KubeClient):
+        self.kube = kube
+
+    def reconcile_all(self, now: Optional[float] = None) -> list[NodeClaim]:
+        now = time.time() if now is None else now
+        expired = []
+        for claim in list(self.kube.node_claims()):
+            lifetime = parse_duration(claim.spec.expire_after)
+            if lifetime is None:
+                continue
+            if now - claim.metadata.creation_timestamp >= lifetime:
+                if claim.metadata.deletion_timestamp is None:
+                    self.kube.delete(claim, now=now)
+                    expired.append(claim)
+        return expired
+
+
+class PodEventsController:
+    """Stamps status.last_pod_event_time on bind/terminal/terminating
+    (nodeclaim/podevents/controller.go:63-110, 5s dedupe)."""
+
+    DEDUPE_SECONDS = 5.0
+
+    def __init__(self, kube: KubeClient, cluster: Cluster):
+        self.kube = kube
+        self.cluster = cluster
+
+    def reconcile_all(self, now: Optional[float] = None) -> None:
+        now = time.time() if now is None else now
+        claims_by_node = {
+            c.status.node_name: c for c in self.kube.node_claims() if c.status.node_name
+        }
+        touched: set[str] = set()
+        for pod in self.kube.pods():
+            if not pod.spec.node_name:
+                continue
+            claim = claims_by_node.get(pod.spec.node_name)
+            if claim is None or claim.metadata.name in touched:
+                continue
+            state = self.cluster.node_for_name(pod.spec.node_name)
+            if state is None:
+                continue
+            last = claim.status.last_pod_event_time or 0.0
+            times = self.cluster.pod_times(pod.key)
+            event_time = max(times.bound, times.first_seen)
+            if pod.is_terminal() or pod.is_terminating():
+                event_time = now
+            if event_time and event_time - last >= self.DEDUPE_SECONDS:
+                claim.status.last_pod_event_time = event_time
+                touched.add(claim.metadata.name)

@@ -1,0 +1,545 @@
+"""Disruption engine: emptiness / drift / consolidation.
+
+Counterpart of pkg/controllers/disruption (13.5k LoC): a polling
+controller that gathers disruptable candidates, applies cron-window
+budgets, and tries each Method in order — Emptiness, Drift,
+MultiNodeConsolidation, SingleNodeConsolidation — first success wins
+(controller.go:98-176). Consolidation decisions re-run the provisioning
+scheduler with candidates excluded (SimulateScheduling, helpers.go:52)
+and compare replacement price against the candidates' current price,
+including the spot-to-spot flexibility floor (consolidation.go:237-311).
+
+The multi-node search keeps the reference's binary-search-over-prefix
+shape (multinodeconsolidation.go:116-169); each probe is one batched
+solver call, so a full search is O(log N) solver launches instead of
+O(log N) sequential Go scheduling loops.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+from karpenter_tpu.apis.v1.labels import (
+    CAPACITY_TYPE_LABEL,
+    CAPACITY_TYPE_SPOT,
+    DISRUPTED_NO_SCHEDULE_TAINT,
+    DO_NOT_DISRUPT_ANNOTATION,
+    INSTANCE_TYPE_LABEL,
+    NODEPOOL_LABEL,
+    TOPOLOGY_ZONE_LABEL,
+)
+from karpenter_tpu.apis.v1.nodeclaim import (
+    COND_CONSOLIDATABLE,
+    COND_DISRUPTION_REASON,
+    COND_DRIFTED,
+    NodeClaim,
+)
+from karpenter_tpu.apis.v1.nodepool import (
+    CONSOLIDATION_WHEN_EMPTY,
+    REASON_DRIFTED,
+    REASON_EMPTY,
+    REASON_UNDERUTILIZED,
+    NodePool,
+)
+from karpenter_tpu.cloudprovider.types import CloudProvider
+from karpenter_tpu.kube.client import KubeClient
+from karpenter_tpu.kube.objects import Pod
+from karpenter_tpu.provisioning.provisioner import Provisioner
+from karpenter_tpu.provisioning.scheduler import Scheduler, SchedulerResults
+from karpenter_tpu.state.cluster import Cluster, StateNode
+from karpenter_tpu.utils.pdb import PdbLimits
+
+log = logging.getLogger("karpenter.disruption")
+
+# consolidation constants (consolidation.go:46-49)
+SPOT_TO_SPOT_MIN_TYPES = 15
+MULTI_NODE_MAX_CANDIDATES = 100  # multinodeconsolidation.go:86
+COMMAND_TIMEOUT_SECONDS = 10 * 60  # orchestration retry deadline (queue.go:86)
+
+
+@dataclass
+class Candidate:
+    """One disruptable node (disruption/types.go:73-121)."""
+
+    state_node: StateNode
+    node_pool: NodePool
+    reschedulable_pods: list[Pod]
+    instance_type_name: str
+    capacity_type: str
+    zone: str
+    price: float
+    disruption_cost: float
+
+
+@dataclass
+class Command:
+    """A decided disruption (types.go:129)."""
+
+    reason: str
+    candidates: list[Candidate]
+    results: Optional[SchedulerResults] = None  # replacement plans
+    started_at: float = 0.0
+
+    @property
+    def replacement_count(self) -> int:
+        return len(self.results.new_node_plans) if self.results else 0
+
+
+def pod_disruption_cost(pod: Pod) -> float:
+    """utils/disruption semantics: deletion-cost annotation, default 0,
+    shifted so every pod costs at least 1."""
+    raw = pod.metadata.annotations.get("controller.kubernetes.io/pod-deletion-cost", "0")
+    try:
+        cost = float(raw)
+    except ValueError:
+        cost = 0.0
+    return 1.0 + cost / 1000.0
+
+
+class DisruptionEngine:
+    def __init__(
+        self,
+        kube: KubeClient,
+        cluster: Cluster,
+        cloud: CloudProvider,
+        provisioner: Provisioner,
+        queue: Optional["OrchestrationQueue"] = None,
+        seed: int = 0,
+        options=None,
+    ):
+        from karpenter_tpu.operator.options import Options
+
+        self.kube = kube
+        self.cluster = cluster
+        self.cloud = cloud
+        self.provisioner = provisioner
+        self.queue = queue or OrchestrationQueue(kube, cluster, provisioner)
+        self.options = options or Options()
+        self._rng = random.Random(seed)
+
+    # -- candidates (helpers.go:174-193) ---------------------------------------
+
+    def get_candidates(self, reason: str, now: float) -> list[Candidate]:
+        out = []
+        pdb = PdbLimits(self.kube)
+        for node in self.cluster.nodes():
+            candidate = self._build_candidate(node, reason, pdb, now)
+            if candidate is not None:
+                out.append(candidate)
+        return out
+
+    def _build_candidate(
+        self, node: StateNode, reason: str, pdb: PdbLimits, now: float
+    ) -> Optional[Candidate]:
+        if node.deleting() or node.nominated(now):
+            return None
+        if node.validate_node_disruptable() is not None:
+            return None
+        claim = node.node_claim
+        if claim is None:
+            return None
+        pool = self.kube.get_node_pool(node.nodepool_name())
+        if pool is None or pool.is_static():
+            return None
+        # method eligibility via conditions
+        if reason == REASON_EMPTY or reason == REASON_UNDERUTILIZED:
+            if not claim.status_conditions.is_true(COND_CONSOLIDATABLE):
+                return None
+            if (
+                reason == REASON_UNDERUTILIZED
+                and pool.spec.disruption.consolidation_policy == CONSOLIDATION_WHEN_EMPTY
+            ):
+                return None
+        if reason == REASON_DRIFTED and not claim.status_conditions.is_true(COND_DRIFTED):
+            return None
+        # pods must be evictable (ValidatePodsDisruptable statenode.go:234)
+        pods = []
+        for pod_key in node.pod_keys:
+            pod = self.kube.get_pod(*pod_key.split("/", 1))
+            if pod is None or pod.is_terminal() or pod.is_terminating():
+                continue
+            if pod.metadata.annotations.get(DO_NOT_DISRUPT_ANNOTATION) == "true":
+                return None
+            if pod.owner_kind() == "DaemonSet":
+                continue
+            if pdb.can_evict(pod) is not None:
+                return None
+            pods.append(pod)
+        labels = node.labels()
+        price = self._node_price(labels)
+        if price is None:
+            # unpriceable candidates are excluded rather than priced at 0,
+            # which would poison the cheaper-than comparison
+            # (getCandidatePrices errors skip the candidate)
+            log.warning("no offering price for node %s; skipping candidate", node.name)
+            return None
+        lifetime_factor = 1.0
+        from karpenter_tpu.utils.duration import parse_duration
+
+        lifetime = parse_duration(claim.spec.expire_after)
+        if lifetime:
+            remaining = max(0.0, 1.0 - (now - claim.metadata.creation_timestamp) / lifetime)
+            lifetime_factor = remaining
+        return Candidate(
+            state_node=node,
+            node_pool=pool,
+            reschedulable_pods=pods,
+            instance_type_name=labels.get(INSTANCE_TYPE_LABEL, ""),
+            capacity_type=labels.get(CAPACITY_TYPE_LABEL, ""),
+            zone=labels.get(TOPOLOGY_ZONE_LABEL, ""),
+            price=price,
+            disruption_cost=sum(pod_disruption_cost(p) for p in pods) * lifetime_factor,
+        )
+
+    def _node_price(self, labels: dict[str, str]) -> Optional[float]:
+        it_name = labels.get(INSTANCE_TYPE_LABEL, "")
+        zone = labels.get(TOPOLOGY_ZONE_LABEL, "")
+        captype = labels.get(CAPACITY_TYPE_LABEL, "")
+        pool = self.kube.get_node_pool(labels.get(NODEPOOL_LABEL, ""))
+        try:
+            for it in self.cloud.get_instance_types(pool):
+                if it.name != it_name:
+                    continue
+                for off in it.offerings:
+                    if off.zone == zone and off.capacity_type == captype:
+                        return off.price
+        except Exception as err:
+            log.warning("price lookup failed for %s/%s/%s: %s", it_name, zone, captype, err)
+        return None
+
+    # -- budgets (helpers.go:231-280) ------------------------------------------
+
+    def budget_mapping(self, reason: str, now: float) -> dict[str, int]:
+        out = {}
+        for pool in self.kube.node_pools():
+            total = self.cluster.nodepool_node_count(pool.metadata.name)
+            allowed = pool.must_get_allowed_disruptions(now, total, reason)
+            deleting = sum(
+                1
+                for n in self.cluster.nodes()
+                if n.nodepool_name() == pool.metadata.name and n.deleting()
+            )
+            out[pool.metadata.name] = max(0, allowed - deleting)
+        return out
+
+    def _budget_filter(
+        self, candidates: list[Candidate], budgets: dict[str, int]
+    ) -> list[Candidate]:
+        taken: dict[str, int] = {}
+        out = []
+        for c in candidates:
+            pool = c.node_pool.metadata.name
+            if taken.get(pool, 0) < budgets.get(pool, 0):
+                taken[pool] = taken.get(pool, 0) + 1
+                out.append(c)
+        return out
+
+    # -- simulation (helpers.go:52-143) ----------------------------------------
+
+    def simulate_scheduling(
+        self, candidates: Sequence[Candidate]
+    ) -> tuple[SchedulerResults, bool]:
+        """Re-run the scheduler with candidates removed. Returns
+        (results, all_pods_scheduled)."""
+        deleting_names = {c.state_node.name for c in candidates}
+        snapshot = []
+        for node in self.cluster.deep_copy_nodes():
+            if node.name in deleting_names:
+                continue
+            # uninitialized-node guard (helpers.go:122-141): abort while
+            # other capacity is still materializing — its eventual pod
+            # load is unknown, so a consolidation decision against it
+            # would be built on sand
+            if node.managed() and not node.initialized() and not node.deleting():
+                return (
+                    SchedulerResults(new_node_plans=[], existing_assignments={}),
+                    False,
+                )
+            snapshot.append(node)
+        pods = [p for c in candidates for p in c.reschedulable_pods]
+        pending = self.provisioner.get_pending_pods()
+        scheduler = Scheduler(
+            pools_with_types=self.provisioner.ready_pools_with_types(),
+            state_nodes=snapshot,
+            daemonsets=self.cluster.daemonsets(),
+            cluster_pods=self.kube.pods(),
+        )
+        results = scheduler.solve(pods + pending)
+        scheduled_keys = {
+            p.key for plan in results.new_node_plans for p in plan.pods
+        } | {p.key for ps in results.existing_assignments.values() for p in ps}
+        all_ok = all(p.key in scheduled_keys for p in pods)
+        return results, all_ok
+
+    # -- consolidation decision (consolidation.go:137-311) ---------------------
+
+    def compute_consolidation(
+        self, candidates: list[Candidate]
+    ) -> Optional[Command]:
+        results, all_ok = self.simulate_scheduling(candidates)
+        if not all_ok:
+            return None
+        if len(results.new_node_plans) > 1:
+            return None
+        current_price = sum(c.price for c in candidates)
+        if not results.new_node_plans:
+            return Command(reason=REASON_EMPTY if not any(
+                c.reschedulable_pods for c in candidates
+            ) else REASON_UNDERUTILIZED, candidates=candidates, results=results)
+        plan = results.new_node_plans[0]
+        # replacement must be strictly cheaper: filter offerings by price
+        cheaper = [o for o in plan.offerings if o.price < current_price]
+        if not cheaper:
+            return None
+        all_spot = all(c.capacity_type == CAPACITY_TYPE_SPOT for c in candidates)
+        spot_replacement = any(
+            o.capacity_type == CAPACITY_TYPE_SPOT for o in cheaper
+        )
+        if all_spot and spot_replacement:
+            # spot-to-spot (consolidation.go:233-311): gated; replacement
+            # forced to spot; single-node additionally demands >=15
+            # cheaper instance types and truncates the launch set to 15
+            if not self.options.feature_gates.spot_to_spot_consolidation:
+                return None
+            spot_offerings = [
+                o for o in cheaper if o.capacity_type == CAPACITY_TYPE_SPOT
+            ]
+            type_names = []
+            for o in spot_offerings:
+                for it in plan.instance_types:
+                    if o in it.offerings and it.name not in type_names:
+                        type_names.append(it.name)
+            if not type_names:
+                return None
+            if len(candidates) == 1:
+                if len(type_names) < SPOT_TO_SPOT_MIN_TYPES:
+                    return None
+                type_names = type_names[:SPOT_TO_SPOT_MIN_TYPES]
+            keep = set(type_names)
+            plan.instance_types = [it for it in plan.instance_types if it.name in keep]
+            plan.offerings = [
+                o for o in spot_offerings
+                if any(o in it.offerings for it in plan.instance_types)
+            ]
+        else:
+            # OD -> [OD, spot]: filtering assumed the spot variant
+            # launches, so pin the replacement to spot when both remain
+            # (consolidation.go:215-223)
+            captypes = {o.capacity_type for o in cheaper}
+            if CAPACITY_TYPE_SPOT in captypes and len(captypes) > 1:
+                cheaper = [
+                    o for o in cheaper if o.capacity_type == CAPACITY_TYPE_SPOT
+                ]
+            plan.offerings = cheaper
+            names = set()
+            for o in cheaper:
+                for it in plan.instance_types:
+                    if o in it.offerings:
+                        names.add(it.name)
+            plan.instance_types = [it for it in plan.instance_types if it.name in names]
+        if not plan.instance_types:
+            return None
+        plan.price = min(o.price for o in plan.offerings)
+        return Command(reason=REASON_UNDERUTILIZED, candidates=candidates, results=results)
+
+    # -- methods ---------------------------------------------------------------
+
+    def emptiness(self, now: float) -> Optional[Command]:
+        """Delete empty consolidatable nodes (emptiness.go:42-113)."""
+        candidates = [
+            c for c in self.get_candidates(REASON_EMPTY, now) if not c.reschedulable_pods
+        ]
+        if not candidates:
+            return None
+        budgets = self.budget_mapping(REASON_EMPTY, now)
+        allowed = self._budget_filter(candidates, budgets)
+        if not allowed:
+            return None
+        return Command(reason=REASON_EMPTY, candidates=allowed)
+
+    def drift(self, now: float) -> Optional[Command]:
+        """Replace drifted nodes (drift.go:55-115); one at a time."""
+        candidates = self.get_candidates(REASON_DRIFTED, now)
+        if not candidates:
+            return None
+        budgets = self.budget_mapping(REASON_DRIFTED, now)
+        allowed = self._budget_filter(candidates, budgets)
+        # empty drifted nodes first (no disruption at all)
+        allowed.sort(key=lambda c: (len(c.reschedulable_pods), -c.disruption_cost))
+        for candidate in allowed:
+            results, ok = self.simulate_scheduling([candidate])
+            if ok:
+                return Command(reason=REASON_DRIFTED, candidates=[candidate],
+                               results=results)
+        return None
+
+    def multi_node_consolidation(self, now: float) -> Optional[Command]:
+        """Binary search the largest prefix replaceable by <=1 node
+        (multinodeconsolidation.go:51-225)."""
+        candidates = self.get_candidates(REASON_UNDERUTILIZED, now)
+        candidates.sort(key=lambda c: c.disruption_cost)
+        budgets = self.budget_mapping(REASON_UNDERUTILIZED, now)
+        candidates = self._budget_filter(candidates, budgets)
+        candidates = candidates[:MULTI_NODE_MAX_CANDIDATES]
+        if len(candidates) < 2:
+            return None
+        # minimum prefix is 2: single-node consolidation handles the rest
+        # (multinodeconsolidation.go:118-121)
+        lo, hi = 2, len(candidates)
+        best: Optional[Command] = None
+        while lo <= hi:
+            mid = (lo + hi) // 2
+            cmd = self.compute_consolidation(candidates[:mid])
+            if cmd is not None:
+                best = cmd
+                lo = mid + 1
+            else:
+                hi = mid - 1
+        if best is not None and len(best.candidates) >= 2:
+            # same-instance-type guard (multinodeconsolidation.go:171-225):
+            # don't churn N nodes into one identical node without savings
+            if best.results and best.results.new_node_plans:
+                plan = best.results.new_node_plans[0]
+                names = {c.instance_type_name for c in best.candidates}
+                if len(names) == 1 and plan.instance_types and (
+                    plan.instance_types[0].name in names
+                ):
+                    return None
+            return best
+        return None
+
+    def single_node_consolidation(self, now: float) -> Optional[Command]:
+        """Try candidates one at a time, round-robining nodepools
+        (singlenodeconsolidation.go:56-160)."""
+        candidates = self.get_candidates(REASON_UNDERUTILIZED, now)
+        by_pool: dict[str, list[Candidate]] = {}
+        for c in candidates:
+            by_pool.setdefault(c.node_pool.metadata.name, []).append(c)
+        budgets = self.budget_mapping(REASON_UNDERUTILIZED, now)
+        for pool_candidates in by_pool.values():
+            self._rng.shuffle(pool_candidates)
+        pools = sorted(by_pool)
+        idx = 0
+        remaining = {p: list(by_pool[p]) for p in pools}
+        while any(remaining.values()):
+            pool = pools[idx % len(pools)]
+            idx += 1
+            if not remaining[pool]:
+                continue
+            candidate = remaining[pool].pop()
+            # first success returns, so only a zero budget can block
+            if budgets.get(pool, 0) <= 0:
+                continue
+            cmd = self.compute_consolidation([candidate])
+            if cmd is not None:
+                return cmd
+        return None
+
+    # -- controller loop (controller.go:121-176) -------------------------------
+
+    def reconcile(self, now: Optional[float] = None) -> Optional[Command]:
+        now = time.time() if now is None else now
+        if not self.cluster.synced():
+            return None
+        for method in (
+            self.emptiness,
+            self.drift,
+            self.multi_node_consolidation,
+            self.single_node_consolidation,
+        ):
+            command = method(now)
+            if command is not None:
+                self.queue.start_command(command, now)
+                return command
+        return None
+
+
+class OrchestrationQueue:
+    """Executes commands: taint + mark + replace, then delete once
+    replacements initialize (disruption/queue.go:94-370)."""
+
+    def __init__(self, kube: KubeClient, cluster: Cluster, provisioner: Provisioner):
+        self.kube = kube
+        self.cluster = cluster
+        self.provisioner = provisioner
+        self.active: list[Command] = []
+
+    def start_command(self, command: Command, now: Optional[float] = None) -> None:
+        now = time.time() if now is None else now
+        command.started_at = now
+        for candidate in command.candidates:
+            node = candidate.state_node
+            if node.node is not None and not any(
+                t.key == DISRUPTED_NO_SCHEDULE_TAINT.key for t in node.node.spec.taints
+            ):
+                node.node.spec.taints.append(DISRUPTED_NO_SCHEDULE_TAINT)
+                self.kube.update(node.node)
+            if node.node_claim is not None:
+                node.node_claim.status_conditions.set_true(
+                    COND_DISRUPTION_REASON, reason=command.reason, now=now
+                )
+            node.marked_for_deletion = True
+        if command.results is not None:
+            self.provisioner.create_node_claims(command.results)
+            # a plan that produced no claim (e.g. nodepool limits) means
+            # replacement capacity will never come: roll back now
+            if any(not p.claim_name for p in command.results.new_node_plans):
+                log.warning("replacement creation failed; rolling back %s command",
+                            command.reason)
+                self._rollback(command)
+                return
+        self.active.append(command)
+
+    def reconcile(self, now: Optional[float] = None) -> None:
+        """waitOrTerminate (queue.go:137-246): once all replacement
+        claims are Initialized, delete the candidates. Commands whose
+        replacements die or that exceed the retry deadline roll back —
+        candidates are un-tainted and unmarked (queue.go:150-170)."""
+        now = time.time() if now is None else now
+        still_active = []
+        for command in self.active:
+            state = self._replacements_state(command)
+            if state == "ready":
+                for candidate in command.candidates:
+                    claim = candidate.state_node.node_claim
+                    if claim is not None and claim.metadata.deletion_timestamp is None:
+                        self.kube.delete(claim, now=now)
+            elif state == "failed" or now - command.started_at > COMMAND_TIMEOUT_SECONDS:
+                log.warning("disruption command %s rolled back (%s)", command.reason,
+                            state)
+                self._rollback(command)
+            else:
+                still_active.append(command)
+        self.active = still_active
+
+    def _replacements_state(self, command: Command) -> str:
+        """ready | waiting | failed."""
+        if command.results is None:
+            return "ready"
+        for plan in command.results.new_node_plans:
+            if not plan.claim_name:
+                return "failed"
+            claim = self.kube.get_node_claim(plan.claim_name)
+            if claim is None or claim.metadata.deletion_timestamp is not None:
+                # launch failed and the lifecycle controller deleted it
+                return "failed"
+            if not claim.status_conditions.is_true("Initialized"):
+                return "waiting"
+        return "ready"
+
+    def _rollback(self, command: Command) -> None:
+        for candidate in command.candidates:
+            node = candidate.state_node
+            node.marked_for_deletion = False
+            if node.node is not None:
+                node.node.spec.taints = [
+                    t for t in node.node.spec.taints
+                    if t.key != DISRUPTED_NO_SCHEDULE_TAINT.key
+                ]
+                self.kube.update(node.node)
+            if node.node_claim is not None:
+                node.node_claim.status_conditions.clear(COND_DISRUPTION_REASON)

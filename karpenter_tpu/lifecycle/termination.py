@@ -24,7 +24,7 @@ from karpenter_tpu.apis.v1.labels import (
 )
 from karpenter_tpu.apis.v1.nodeclaim import COND_DRAINED, COND_VOLUMES_DETACHED
 from karpenter_tpu.kube.client import KubeClient
-from karpenter_tpu.kube.objects import Node, Pod
+from karpenter_tpu.kube.objects import Node, ObjectMeta, Pod
 from karpenter_tpu.utils.pdb import PdbLimits
 
 log = logging.getLogger("karpenter.termination")
@@ -33,21 +33,50 @@ CRITICAL_PRIORITY = 2_000_000_000  # system-cluster-critical threshold
 
 
 class EvictionQueue:
-    """Per-pod eviction with PDB 429 backoff (terminator/eviction.go)."""
+    """Per-pod eviction with PDB 429 backoff (terminator/eviction.go).
+
+    Eviction deletes the pod and — because this framework carries its
+    own API substrate with no ReplicaSet controller or kube-scheduler
+    behind it — resurrects non-daemon workload pods as fresh pending
+    pods, which is what a controller-owned pod does in a real cluster.
+    The provisioner then reschedules them (typically onto replacement
+    capacity the orchestration queue already launched).
+    """
 
     def __init__(self, kube: KubeClient):
         self.kube = kube
         self.blocked: dict[str, str] = {}  # pod key -> blocking pdb
 
-    def evict(self, pod: Pod, now: Optional[float] = None) -> bool:
-        limits = PdbLimits(self.kube)
-        blocking = limits.can_evict(pod)
-        if blocking is not None:
-            self.blocked[pod.key] = blocking
-            return False
+    def evict(self, pod: Pod, now: Optional[float] = None, force: bool = False) -> bool:
+        if not force:
+            limits = PdbLimits(self.kube)
+            blocking = limits.can_evict(pod)
+            if blocking is not None:
+                self.blocked[pod.key] = blocking
+                return False
         self.blocked.pop(pod.key, None)
         self.kube.delete(pod, now=now)
+        if pod.owner_kind() != "DaemonSet":
+            self.kube.create(rebirth_pod(pod))
         return True
+
+
+def rebirth_pod(pod: Pod) -> Pod:
+    """A controller-owned pod's successor: same spec, unbound, new uid."""
+    import copy
+
+    spec = copy.deepcopy(pod.spec)
+    spec.node_name = ""
+    return Pod(
+        metadata=ObjectMeta(
+            name=pod.metadata.name,
+            namespace=pod.metadata.namespace,
+            labels=dict(pod.metadata.labels),
+            annotations=dict(pod.metadata.annotations),
+            owner_references=list(pod.metadata.owner_references),
+        ),
+        spec=spec,
+    )
 
 
 def _critical(pod: Pod) -> bool:
@@ -160,11 +189,8 @@ class TerminationController:
                     and not force
                 ):
                     continue
-                if force:
-                    # TGP enforcement bypasses PDBs (terminator.go:140)
-                    self.kube.delete(pod, now=now)
-                else:
-                    self.queue.evict(pod, now=now)
+                # TGP enforcement bypasses PDBs (terminator.go:140)
+                self.queue.evict(pod, now=now, force=force)
         return [
             p for p in self.kube.pods_on_node(node.metadata.name) if not p.is_terminal()
         ]

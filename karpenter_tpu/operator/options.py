@@ -1,0 +1,59 @@
+"""Operator options and feature gates.
+
+Counterpart of pkg/operator/options/options.go:67-203: one flat config
+struct (flags/env in the reference; kwargs here) plus feature gates
+parsed from a comma string ("SpotToSpotConsolidation=true,...").
+Defaults mirror the reference's.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class FeatureGates:
+    node_repair: bool = False
+    reserved_capacity: bool = True
+    spot_to_spot_consolidation: bool = False
+    node_overlay: bool = False
+    static_capacity: bool = False
+
+    @classmethod
+    def parse(cls, gates: str) -> "FeatureGates":
+        out = cls()
+        mapping = {
+            "NodeRepair": "node_repair",
+            "ReservedCapacity": "reserved_capacity",
+            "SpotToSpotConsolidation": "spot_to_spot_consolidation",
+            "NodeOverlay": "node_overlay",
+            "StaticCapacity": "static_capacity",
+        }
+        for part in gates.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            name, _, value = part.partition("=")
+            attr = mapping.get(name.strip())
+            if attr is not None:
+                setattr(out, attr, value.strip().lower() in ("true", "1", ""))
+        return out
+
+
+@dataclass
+class Options:
+    batch_idle_duration: float = 1.0       # options.go:126
+    batch_max_duration: float = 10.0       # options.go:127
+    preference_policy: str = "Respect"     # Respect | Ignore
+    min_values_policy: str = "Strict"      # Strict | BestEffort
+    feature_gates: FeatureGates = field(default_factory=FeatureGates)
+    metrics_port: int = 8080
+    health_probe_port: int = 8081
+    kube_client_qps: int = 200
+    kube_client_burst: int = 300
+    log_level: str = "info"
+    cluster_name: str = ""
+    disruption_poll_seconds: float = 10.0  # disruption/controller.go:69
+
+
+DEFAULT_OPTIONS = Options()

@@ -349,19 +349,22 @@ class Cluster:
 
     def delete_pod(self, pod: Pod) -> None:
         with self._lock:
-            self._unbind(pod.key)
+            self._unbind(pod.key, pod=pod)
             self._antiaffinity_pods.pop(pod.key, None)
             self._pod_times.pop(pod.key, None)
             self.mark_unconsolidated()
 
-    def _unbind(self, pod_key: str) -> None:
+    def _unbind(self, pod_key: str, pod: Optional[Pod] = None) -> None:
         node_name = self._bindings.pop(pod_key, None)
         if node_name is None:
             return
         state = self.node_for_name(node_name)
         if state is not None and pod_key in state.pod_keys:
             state.pod_keys.discard(pod_key)
-            pod = self.kube.get_pod(*pod_key.split("/", 1))
+            if pod is None:
+                # deleted pods are gone from the store; callers on the
+                # delete path pass the object so usage is released
+                pod = self.kube.get_pod(*pod_key.split("/", 1))
             if pod is not None:
                 usage = resutil.pod_requests(pod)
                 if pod.owner_kind() == "DaemonSet":

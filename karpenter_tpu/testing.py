@@ -23,6 +23,12 @@ from karpenter_tpu.kube.objects import (
     Pod,
     PodSpec,
 )
+from karpenter_tpu.disruption.conditions import (
+    DisruptionConditionsController,
+    ExpirationController,
+    PodEventsController,
+)
+from karpenter_tpu.disruption.engine import DisruptionEngine
 from karpenter_tpu.lifecycle.nodeclaim_lifecycle import NodeClaimLifecycle
 from karpenter_tpu.lifecycle.termination import TerminationController
 from karpenter_tpu.provisioning.provisioner import Provisioner
@@ -65,6 +71,8 @@ class Environment:
 
     types: Optional[list[InstanceType]] = None
     registration_delay: float = 0.0
+    options: Optional[object] = None  # operator Options; test default
+                                      # enables SpotToSpotConsolidation
     kube: KubeClient = field(init=False)
     cluster: Cluster = field(init=False)
     cloud: KwokCloudProvider = field(init=False)
@@ -82,6 +90,42 @@ class Environment:
         self.provisioner = Provisioner(self.kube, self.cluster, self.cloud)
         self.lifecycle = NodeClaimLifecycle(self.kube, self.cloud)
         self.termination = TerminationController(self.kube, self.cluster)
+        self.conditions = DisruptionConditionsController(
+            self.kube, self.cluster, self.cloud
+        )
+        self.expiration = ExpirationController(self.kube)
+        self.pod_events = PodEventsController(self.kube, self.cluster)
+        if self.options is None:
+            from karpenter_tpu.operator.options import FeatureGates, Options
+
+            self.options = Options(
+                feature_gates=FeatureGates(spot_to_spot_consolidation=True)
+            )
+        self.disruption = DisruptionEngine(
+            self.kube, self.cluster, self.cloud, self.provisioner,
+            options=self.options,
+        )
+
+    def reconcile_disruption(self, now: Optional[float] = None):
+        """One disruption cycle: refresh conditions, run the engine,
+        progress the orchestration queue and termination."""
+        self.pod_events.reconcile_all(now=now)
+        self.conditions.reconcile_all(now=now)
+        command = self.disruption.reconcile(now=now)
+        self.lifecycle.reconcile_all(now=now)
+        self.cloud.tick(now=now)
+        self.lifecycle.reconcile_all(now=now)
+        self.disruption.queue.reconcile(now=now)
+        self.reconcile_termination(now=now)
+        # evicted workload pods come back pending; rebind them
+        if self.provisioner.get_pending_pods():
+            self.provision(now=now)
+        return command
+
+    def all_pods_bound(self) -> bool:
+        return all(
+            p.spec.node_name for p in self.kube.pods() if not p.is_terminal()
+        )
 
     def reconcile_termination(self, now: Optional[float] = None, rounds: int = 4) -> None:
         """Drive claim finalize -> node drain -> instance delete to

@@ -1,0 +1,210 @@
+"""Disruption engine tests.
+
+Scenario shapes from the reference's disruption suites
+(emptiness_test.go, consolidation_test.go, drift_test.go,
+budgets_test.go, expiration): empty-node deletion under consolidateAfter,
+multi-node consolidation replacing several small nodes with one bigger
+one, single-node delete consolidation, budget caps, drift rolling,
+expiration, do-not-disrupt blocking.
+"""
+
+import time
+
+from karpenter_tpu.apis.v1.labels import DO_NOT_DISRUPT_ANNOTATION
+from karpenter_tpu.apis.v1.nodeclaim import COND_CONSOLIDATABLE, COND_DRIFTED
+from karpenter_tpu.apis.v1.nodepool import Budget, REASON_EMPTY
+from karpenter_tpu.cloudprovider.fake import GIB, make_instance_type
+from karpenter_tpu.testing import Environment, mk_nodepool, mk_pod
+
+
+def consolidation_types():
+    # price curve is sub-linear in size so merging small nodes into one
+    # bigger node is strictly cheaper (2 x c2 > 1 x c4)
+    return [
+        make_instance_type("c2", cpu=2, memory=8 * GIB, price=2.0),
+        make_instance_type("c4", cpu=4, memory=16 * GIB, price=3.0),
+        make_instance_type("c8", cpu=8, memory=32 * GIB, price=5.0),
+    ]
+
+
+def make_env(consolidate_after="0s", **pool_kwargs):
+    env = Environment(types=consolidation_types())
+    pool = mk_nodepool("default")
+    pool.spec.disruption.consolidate_after = consolidate_after
+    for key, value in pool_kwargs.items():
+        setattr(pool.spec.disruption, key, value)
+    env.kube.create(pool)
+    return env
+
+
+class TestEmptiness:
+    def test_empty_node_deleted(self):
+        env = make_env()
+        pod = mk_pod(cpu=1.0)
+        env.provision(pod)
+        assert len(env.kube.nodes()) == 1
+        # delete the pod: node becomes empty
+        env.kube.delete(env.kube.get_pod("default", pod.metadata.name))
+        now = time.time() + 60
+        command = env.reconcile_disruption(now=now)
+        assert command is not None and command.reason == REASON_EMPTY
+        assert not env.kube.nodes()
+        assert not env.kube.node_claims()
+
+    def test_consolidate_after_never_keeps_empty_node(self):
+        env = make_env(consolidate_after="Never")
+        pod = mk_pod(cpu=1.0)
+        env.provision(pod)
+        env.kube.delete(env.kube.get_pod("default", pod.metadata.name))
+        command = env.reconcile_disruption(now=time.time() + 3600)
+        assert command is None
+        assert len(env.kube.nodes()) == 1
+
+    def test_consolidate_after_window_respected(self):
+        env = make_env(consolidate_after="30m")
+        pod = mk_pod(cpu=1.0)
+        now = time.time()
+        env.provision(pod, now=now)
+        env.kube.delete(env.kube.get_pod("default", pod.metadata.name), now=now)
+        assert env.reconcile_disruption(now=now + 60) is None  # too soon
+        command = env.reconcile_disruption(now=now + 31 * 60)
+        assert command is not None
+
+    def test_do_not_disrupt_annotation_blocks(self):
+        env = make_env()
+        pod = mk_pod(cpu=1.0)
+        env.provision(pod)
+        claim = env.kube.node_claims()[0]
+        claim.metadata.annotations[DO_NOT_DISRUPT_ANNOTATION] = "true"
+        env.kube.delete(env.kube.get_pod("default", pod.metadata.name))
+        command = env.reconcile_disruption(now=time.time() + 60)
+        assert command is None
+        assert env.kube.nodes()
+
+    def test_budget_zero_blocks_emptiness(self):
+        env = Environment(types=consolidation_types())
+        pool = mk_nodepool("default")
+        pool.spec.disruption.budgets = [Budget(nodes="0")]
+        env.kube.create(pool)
+        pod = mk_pod(cpu=1.0)
+        env.provision(pod)
+        env.kube.delete(env.kube.get_pod("default", pod.metadata.name))
+        command = env.reconcile_disruption(now=time.time() + 60)
+        assert command is None
+        assert env.kube.nodes()
+
+
+class TestConsolidation:
+    def test_multi_node_consolidation_merges_small_nodes(self):
+        env = make_env()
+        # force small nodes: schedule pods one batch at a time
+        pods = []
+        for i in range(3):
+            pod = mk_pod(cpu=1.0, memory=2 * GIB)
+            env.provision(pod)
+            pods.append(pod)
+        assert len(env.kube.nodes()) == 3  # three c2 nodes
+        now = time.time() + 120
+        command = env.reconcile_disruption(now=now)
+        assert command is not None
+        assert len(command.candidates) >= 2
+        assert command.replacement_count == 1
+        # once replacements initialize, candidates drain away
+        for _ in range(3):
+            env.reconcile_disruption(now=now)
+        names = {
+            n.metadata.labels["node.kubernetes.io/instance-type"]
+            for n in env.kube.nodes()
+        }
+        # consolidated into one larger node
+        assert len(env.kube.nodes()) < 3
+
+    def test_single_node_delete_consolidation(self):
+        env = make_env()
+        # fill node1 so pod_b opens node2, then free capacity on node1
+        pod_a1 = mk_pod(cpu=1.0, memory=2 * GIB)
+        pod_a2 = mk_pod(cpu=0.5, memory=GIB)
+        env.provision(pod_a1, pod_a2)
+        assert len(env.kube.nodes()) == 1
+        pod_b = mk_pod(cpu=0.5, memory=GIB)
+        env.provision(pod_b)
+        assert len(env.kube.nodes()) == 2
+        env.kube.delete(env.kube.get_pod("default", pod_a2.metadata.name))
+        # keep node1 out of the candidate set so multi-node can't fire
+        node1_claim = env.kube.get_node_claim(
+            env.kube.node_claims()[0].metadata.name
+        )
+        node1_claim.metadata.annotations[DO_NOT_DISRUPT_ANNOTATION] = "true"
+        now = time.time() + 120
+        command = env.reconcile_disruption(now=now)
+        assert command is not None
+        # replacement-free delete: node2's pod fits node1's freed space
+        assert command.replacement_count == 0
+        assert len(command.candidates) == 1
+
+    def test_pods_survive_consolidation(self):
+        """Evicted pods resurrect pending and rebind to the
+        replacement node — the full churn loop is lossless."""
+        env = make_env()
+        pods = []
+        for i in range(3):
+            pod = mk_pod(cpu=1.0, memory=2 * GIB)
+            env.provision(pod)
+            pods.append(pod)
+        now = time.time() + 120
+        for _ in range(5):
+            env.reconcile_disruption(now=now)
+        assert len(env.kube.nodes()) == 1
+        node = env.kube.nodes()[0]
+        assert node.metadata.labels["node.kubernetes.io/instance-type"] == "c4"
+        live = [p for p in env.kube.pods() if not p.is_terminal()]
+        assert len(live) == 3
+        assert all(p.spec.node_name == node.metadata.name for p in live)
+        # stability: a further pass must not churn
+        assert env.reconcile_disruption(now=now + 60) is None
+
+    def test_no_consolidation_when_nodes_full(self):
+        env = make_env()
+        pods = [mk_pod(cpu=0.85, memory=3 * GIB) for _ in range(4)]
+        env.provision(*pods)
+        nodes_before = len(env.kube.nodes())
+        command = env.reconcile_disruption(now=time.time() + 120)
+        # fully-packed fleet: nothing to consolidate
+        assert command is None
+        assert len(env.kube.nodes()) == nodes_before
+
+
+class TestDrift:
+    def test_drifted_node_replaced(self):
+        env = make_env(consolidate_after="Never")
+        pod = mk_pod(cpu=1.0)
+        env.provision(pod)
+        claim = env.kube.node_claims()[0]
+        env.cloud.is_drifted = lambda c: "ImageDrift"
+        now = time.time() + 60
+        command = env.reconcile_disruption(now=now)
+        assert command is not None and command.reason == "Drifted"
+        assert command.replacement_count == 1
+
+    def test_nodepool_hash_change_drifts(self):
+        env = make_env(consolidate_after="Never")
+        env.provision(mk_pod(cpu=1.0))
+        pool = env.kube.get_node_pool("default")
+        pool.spec.template.labels["team"] = "new-team"  # changes hash
+        env.conditions.reconcile_all()
+        claim = env.kube.node_claims()[0]
+        assert claim.status_conditions.is_true(COND_DRIFTED)
+
+
+class TestExpiration:
+    def test_expired_claim_deleted(self):
+        env = Environment(types=consolidation_types())
+        pool = mk_nodepool("default")
+        pool.spec.template.spec.expire_after = "1h"
+        env.kube.create(pool)
+        env.provision(mk_pod(cpu=1.0))
+        now = time.time()
+        expired = env.expiration.reconcile_all(now=now + 3601)
+        assert len(expired) == 1
+        env.reconcile_termination(now=now + 3601)
+        assert not env.kube.node_claims()
