@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import itertools
 import threading
+import zlib
 from typing import Callable, Optional
 
 from karpenter_tpu.apis.v1.labels import (
@@ -81,8 +82,9 @@ def make_instance_type(
         for ct in capacity_types:
             for zone in zones:
                 # spot trades at a discount; mild per-zone variation
+                # (stable hash: Python's hash() is salted per process)
                 mult = 0.4 if ct == CAPACITY_TYPE_SPOT else 1.0
-                zone_mult = 1.0 + 0.01 * (hash(zone) % 7)
+                zone_mult = 1.0 + 0.01 * (zlib.crc32(zone.encode()) % 7)
                 offerings.append(
                     Offering(
                         requirements=Requirements.from_labels(

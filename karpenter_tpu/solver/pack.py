@@ -80,10 +80,6 @@ def pack(
     assign = jnp.zeros((N, G), jnp.int32)
     unschedulable = jnp.zeros((G,), jnp.int32)
 
-    def fits(used, alloc_minus_req):
-        # [N, C]: node usage fits under alloc - req for every resource
-        return jnp.all(used[:, None, :] <= alloc_minus_req[None, :, :] + 1e-4, axis=-1)
-
     def capacity(used_j, req):
         # [C]: how many pods of `req` fit on top of used_j per config
         safe_req = jnp.where(req > 0, req, 1.0)
@@ -92,109 +88,166 @@ def pack(
         k = jnp.where(req[None, :] > 0, k, BIG)
         return jnp.clip(jnp.min(k, axis=-1), 0.0, BIG).astype(jnp.int32)
 
-    def body(state):
-        g, remaining, node_mask, node_used, node_active, node_count, assign, unsched = state
+    def body(g, state):
+        """One group per iteration: (1) prefix-sum fill across every
+        feasible open node in index order — exactly the per-pod
+        first-fit outcome — then (2) bulk-open q identical fresh nodes
+        for any spill. Exact under FFD: within one group the open-node
+        feasibility set never changes, so the per-pod scan would
+        produce this same layout. Loop trip count is G, independent of
+        pod count."""
+        node_mask, node_used, node_active, node_count, assign, unsched = state
         req = group_req[g]
         row = compat[g]
+        remaining = group_count[g]
 
         alloc_minus_req = cfg_alloc - req[None, :]
-        ok = node_mask & row[None, :] & fits(node_used, alloc_minus_req)
-        feasible = ok.any(axis=1) & node_active
-        j_existing = jnp.argmax(feasible)
-        has_existing = feasible.any()
 
-        # New-node option: highest-weight pool (lowest pool index) whose
-        # configs admit a single pod of this group on a fresh node.
+        # [N, C] capacity for this group's pods; feasibility (>=1 pod
+        # fits) falls out of the same tensor, so the dominant N x C x R
+        # broadcast happens exactly once per iteration.
+        safe_req = jnp.where(req > 0, req, 1.0)
+        kmat = jnp.floor(
+            (cfg_alloc[None, :, :] - node_used[:, None, :] + 1e-4) / safe_req[None, None, :]
+        )
+        kmat = jnp.where(req[None, None, :] > 0, kmat, BIG).min(axis=-1)
+        kmat = jnp.clip(kmat, 0.0, 2.0e9).astype(jnp.int32)
+        ok = node_mask & row[None, :] & (kmat >= 1)
+        kmat = kmat * ok
+        k = kmat.max(axis=1)
+        prefix = jnp.cumsum(k) - k
+        take = jnp.clip(remaining - prefix, 0, k)
+        touched = take > 0
+        node_mask = jnp.where(touched[:, None], ok & (kmat >= take[:, None]), node_mask)
+        node_used = node_used + take[:, None].astype(jnp.float32) * req[None, :]
+        assign = assign.at[:, g].add(take)
+        remaining = remaining - take.sum()
+
+        # (2) bulk open on the highest-weight admitting pool
         fresh_ok = row & jnp.all(pool_overhead[cfg_pool] <= alloc_minus_req, axis=-1) & (
             cfg_pool >= 0
         )
         chosen_pool = jnp.min(jnp.where(fresh_ok, cfg_pool, INT_BIG))
-        can_open = fresh_ok.any() & (node_count < N)
+        do_open = (remaining > 0) & fresh_ok.any() & (node_count < N)
 
-        def place_existing(args):
+        def open_nodes(args):
             node_mask, node_used, node_active, node_count, assign, remaining = args
-            j = j_existing
-            k = capacity(node_used[j], req) * ok[j]
-            m = jnp.minimum(remaining, jnp.max(k))
-            new_mask_j = ok[j] & (k >= m)
-            return (
-                node_mask.at[j].set(new_mask_j),
-                node_used.at[j].add(m.astype(jnp.float32) * req),
-                node_active,
-                node_count,
-                assign.at[j, g].add(m),
-                remaining - m,
-            )
-
-        def place_new(args):
-            node_mask, node_used, node_active, node_count, assign, remaining = args
-            j = node_count
             mask = fresh_ok & (cfg_pool == chosen_pool)
             overhead = pool_overhead[chosen_pool]
-            k = capacity(overhead, req) * mask
-            m = jnp.minimum(remaining, jnp.max(k))
-            new_mask_j = mask & (k >= m)
+            kf = capacity(overhead, req) * mask
+            m_star = jnp.maximum(jnp.max(kf), 1)
+            q = jnp.minimum((remaining + m_star - 1) // m_star, N - node_count)
+            rem_last = jnp.minimum(m_star, remaining - (q - 1) * m_star)
+            idx = jnp.arange(N, dtype=jnp.int32)
+            sel_full = (idx >= node_count) & (idx < node_count + q - 1)
+            sel_last = idx == node_count + q - 1
+            fill = (
+                sel_full.astype(jnp.int32) * m_star
+                + sel_last.astype(jnp.int32) * rem_last
+            )
+            node_mask = jnp.where(
+                sel_full[:, None], (mask & (kf >= m_star))[None, :],
+                jnp.where(sel_last[:, None], (mask & (kf >= rem_last))[None, :], node_mask),
+            )
+            node_used = jnp.where(
+                (sel_full | sel_last)[:, None],
+                overhead[None, :] + fill[:, None].astype(jnp.float32) * req[None, :],
+                node_used,
+            )
+            placed = (q - 1) * m_star + rem_last
             return (
-                node_mask.at[j].set(new_mask_j),
-                node_used.at[j].set(overhead + m.astype(jnp.float32) * req),
-                node_active.at[j].set(True),
-                node_count + 1,
-                assign.at[j, g].add(m),
-                remaining - m,
+                node_mask,
+                node_used,
+                node_active | sel_full | sel_last,
+                node_count + q,
+                assign.at[:, g].add(fill),
+                remaining - placed,
             )
 
-        def give_up(args):
-            node_mask, node_used, node_active, node_count, assign, remaining = args
-            return node_mask, node_used, node_active, node_count, assign, jnp.int32(0)
-
-        branch = jnp.where(has_existing, 0, jnp.where(can_open, 1, 2))
-        node_mask, node_used, node_active, node_count, assign, new_remaining = jax.lax.switch(
-            branch,
-            (place_existing, place_new, give_up),
+        node_mask, node_used, node_active, node_count, assign, remaining = jax.lax.cond(
+            do_open,
+            open_nodes,
+            lambda args: args,
             (node_mask, node_used, node_active, node_count, assign, remaining),
         )
-        unsched = unsched.at[g].add(
-            jnp.where(branch == 2, remaining, 0)
-        )
-        done = new_remaining <= 0
-        g = jnp.where(done, g + 1, g)
-        next_remaining = jnp.where(
-            done, jnp.where(g < G, group_count[jnp.minimum(g, G - 1)], 0), new_remaining
-        )
-        return (g, next_remaining, node_mask, node_used, node_active, node_count, assign, unsched)
+        unsched = unsched.at[g].add(jnp.maximum(remaining, 0))
+        return (node_mask, node_used, node_active, node_count, assign, unsched)
 
-    def cond(state):
-        g = state[0]
-        return g < G
-
-    init = (
-        jnp.int32(0),
-        jnp.where(G > 0, group_count[0], 0),
-        node_mask,
-        node_used,
-        node_active,
-        jnp.int32(E),
-        assign,
-        unschedulable,
+    state = jax.lax.fori_loop(
+        0,
+        G,
+        body,
+        (node_mask, node_used, node_active, jnp.int32(E), assign, unschedulable),
     )
-    state = jax.lax.while_loop(cond, body, init)
-    _, _, node_mask, node_used, node_active, node_count, assign, unsched = state
+    node_mask, node_used, node_active, node_count, assign, unsched = state
     return assign, node_mask, node_used, node_active, node_count, unsched
 
 
+def _estimate_nodes(enc: Encoded) -> int:
+    """Lower bound on fresh nodes: per group, count / best-config
+    capacity, summed. The packer retries with a larger axis if the
+    estimate proves too tight (cap detection in solve_packing)."""
+    launchable = enc.cfg_pool >= 0
+    total = 0
+    for gi in range(enc.compat.shape[0]):
+        mask = enc.compat[gi] & launchable
+        count = int(enc.group_count[gi])
+        if not mask.any() or count == 0:
+            continue
+        req = enc.group_req[gi]
+        safe_req = np.where(req > 0, req, 1.0)
+        per_node = np.floor((enc.cfg_alloc[mask] + 1e-4) / safe_req[None, :])
+        per_node = np.where(req[None, :] > 0, per_node, np.inf).min(axis=1)
+        best = max(1.0, float(per_node.max()) if per_node.size else 1.0)
+        total += -(-count // int(best))
+    return total
+
+
 def solve_packing(enc: Encoded, max_nodes: int = 0) -> PackResult:
-    """Host entry: run the packing kernel on the encoded problem."""
+    """Host entry: run the packing kernel on the encoded problem.
+
+    With `max_nodes` unset, the node axis is sized from a per-group
+    capacity estimate, rounded to 1.5x-spaced buckets so repeated
+    solves share compilations, and grown on cap-hit — keeping the
+    per-iteration N x C work tight instead of worst-casing N at the
+    pod count. An explicit `max_nodes` is honored as a hard cap
+    (excess pods report unschedulable).
+    """
     G, C = enc.compat.shape
     E = enc.n_existing
-    if max_nodes <= 0:
-        # worst case: every group opens its own node chain
-        max_nodes = E + int(enc.group_count.sum())
-        max_nodes = min(max_nodes, E + 4096)
     existing_mask = np.zeros((E, C), dtype=bool)
     for ci, cfg in enumerate(enc.configs):
         if cfg.existing_index >= 0:
             existing_mask[cfg.existing_index, ci] = True
 
+    if max_nodes > 0:
+        return _run_pack(enc, existing_mask, max_nodes)
+
+    estimate = _estimate_nodes(enc)
+    max_nodes = E + max(32, int(1.35 * estimate) + 16)
+    max_nodes = _bucket(min(max_nodes, E + max(64, int(enc.group_count.sum()))))
+    worst_case = E + int(enc.group_count.sum())
+    while True:
+        result = _run_pack(enc, existing_mask, max_nodes)
+        capped = (
+            result.node_count >= max_nodes and result.unschedulable.sum() > 0
+        )
+        if not capped or max_nodes > worst_case:
+            return result
+        max_nodes = _bucket(max_nodes * 2)
+
+
+def _bucket(n: int) -> int:
+    """Round up to the next 1.5x-spaced bucket (>=32) to bound the
+    number of distinct compiled shapes while keeping padding waste
+    under 50%."""
+    out = 32
+    while out < n:
+        out = (out * 3 + 1) // 2
+    return out
+
+
+def _run_pack(enc: Encoded, existing_mask: np.ndarray, max_nodes: int) -> PackResult:
     assign, node_mask, node_used, node_active, node_count, unsched = pack(
         jnp.asarray(enc.compat),
         jnp.asarray(enc.group_req),
