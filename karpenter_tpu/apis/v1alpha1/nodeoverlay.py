@@ -1,0 +1,164 @@
+"""NodeOverlay (alpha): runtime overrides of instance-type attributes.
+
+Counterpart of pkg/apis/v1alpha1/nodeoverlay.go + the overlay store and
+cloudprovider decorator (pkg/controllers/nodeoverlay/store.go:47-260,
+pkg/cloudprovider/overlay/cloudprovider.go:30-60): operator-supplied
+price overrides / adjustments and extended-capacity injection, selected
+by requirements, with weight-based conflict resolution.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from karpenter_tpu.apis.v1.nodepool import NodePool
+from karpenter_tpu.cloudprovider.types import (
+    CloudProvider,
+    InstanceType,
+    Offering,
+    Offerings,
+)
+from karpenter_tpu.kube.objects import NodeSelectorRequirement, ObjectMeta
+from karpenter_tpu.scheduling.requirements import Requirements
+from karpenter_tpu.utils.resources import ResourceList
+
+
+@dataclass
+class NodeOverlaySpec:
+    requirements: list[NodeSelectorRequirement] = field(default_factory=list)
+    price_adjustment: Optional[str] = None  # "+0.5" | "-1.2" | "+10%" | "-5%"
+    price: Optional[str] = None             # absolute override
+    capacity: ResourceList = field(default_factory=dict)  # extended resources only
+    weight: int = 0
+
+
+@dataclass
+class NodeOverlay:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: NodeOverlaySpec = field(default_factory=NodeOverlaySpec)
+
+    kind = "NodeOverlay"
+
+    @property
+    def key(self) -> str:
+        return self.metadata.name
+
+
+def adjusted_price(base: float, change: Optional[str]) -> float:
+    """types.go:369-401: percent or absolute signed adjustment,
+    clamped at zero."""
+    if not change:
+        return base
+    if change.endswith("%"):
+        out = base * (1 + float(change[:-1]) / 100.0)
+    else:
+        out = base + float(change)
+    return max(0.0, out)
+
+
+class OverlayStore:
+    """Immutable snapshot applying overlays to instance types
+    (store.go:47-260). Overlays sorted by weight descending; the
+    heaviest matching overlay wins per attribute."""
+
+    def __init__(self, overlays: list[NodeOverlay]):
+        self.overlays = sorted(
+            overlays, key=lambda o: (-o.spec.weight, o.metadata.name)
+        )
+        # parse each overlay's selector once; matching runs per
+        # (instance type x offering) on the scheduler hot path
+        self._overlay_reqs = [
+            Requirements.from_node_selector_requirements(o.spec.requirements)
+            for o in self.overlays
+        ]
+
+    def _matching(self, it: InstanceType, offering: Offering) -> list[NodeOverlay]:
+        out = []
+        combined = it.requirements.copy()
+        combined.add(*offering.requirements.values())
+        for overlay, reqs in zip(self.overlays, self._overlay_reqs):
+            if combined.intersects(reqs) is None:
+                out.append(overlay)
+        return out
+
+    def apply(self, it: InstanceType) -> InstanceType:
+        new_offerings = Offerings()
+        price_touched = False
+        capacity_extra: ResourceList = {}
+        for offering in it.offerings:
+            price = offering.price
+            applied_price = False
+            for overlay in self._matching(it, offering):
+                if not applied_price and overlay.spec.price is not None:
+                    price = max(0.0, float(overlay.spec.price))
+                    applied_price = True
+                elif not applied_price and overlay.spec.price_adjustment is not None:
+                    price = adjusted_price(price, overlay.spec.price_adjustment)
+                    applied_price = True
+                # extended resources merge across overlays, heaviest
+                # writer wins per key (store.go:173-176)
+                for key, value in overlay.spec.capacity.items():
+                    if key not in it.capacity and key not in capacity_extra:
+                        capacity_extra[key] = value
+            price_touched = price_touched or applied_price
+            new_offerings.append(
+                Offering(
+                    requirements=offering.requirements,
+                    price=price,
+                    available=offering.available,
+                    reservation_capacity=offering.reservation_capacity,
+                )
+            )
+        if not price_touched and not capacity_extra:
+            return it
+        capacity = dict(it.capacity)
+        capacity.update(capacity_extra)
+        return InstanceType(
+            name=it.name,
+            requirements=it.requirements,
+            offerings=new_offerings,
+            capacity=capacity,
+            overhead=it.overhead,
+        )
+
+
+class OverlayCloudProvider(CloudProvider):
+    """Decorator applying the overlay store to GetInstanceTypes
+    (overlay/cloudprovider.go:30-60)."""
+
+    def __init__(self, inner: CloudProvider, kube):
+        self.inner = inner
+        self.kube = kube
+
+    def _store(self) -> OverlayStore:
+        return OverlayStore(self.kube.list("NodeOverlay"))
+
+    def get_instance_types(self, node_pool: Optional[NodePool]) -> list[InstanceType]:
+        store = self._store()
+        return [store.apply(it) for it in self.inner.get_instance_types(node_pool)]
+
+    # passthrough SPI
+    def create(self, node_claim):
+        return self.inner.create(node_claim)
+
+    def delete(self, node_claim):
+        return self.inner.delete(node_claim)
+
+    def get(self, provider_id):
+        return self.inner.get(provider_id)
+
+    def list(self):
+        return self.inner.list()
+
+    def is_drifted(self, node_claim):
+        return self.inner.is_drifted(node_claim)
+
+    def repair_policies(self):
+        return self.inner.repair_policies()
+
+    def name(self):
+        return self.inner.name()
+
+    def get_supported_node_classes(self):
+        return self.inner.get_supported_node_classes()

@@ -66,13 +66,21 @@ class KwokCloudProvider(CloudProvider):
         kube: KubeClient,
         types: Optional[list[InstanceType]] = None,
         registration_delay: float = 0.0,
+        clock=None,
     ):
+        """`clock` supplies the time source for instance timestamps.
+        Inject a simulated clock when driving tick() with simulated
+        `now` values and a nonzero registration delay — otherwise
+        created_at (wall) and now (simulated) come from different
+        clocks and the delay comparison is meaningless."""
         self.kube = kube
         self.types = types if types is not None else kwok_instance_types()
         self.registration_delay = registration_delay
+        self.clock = clock or time.time
         self._lock = threading.RLock()
         self._instances: dict[str, _Instance] = {}  # provider id -> instance
         self._counter = itertools.count(1)
+        self._repair_policies: list = []
 
     # -- SPI ------------------------------------------------------------------
 
@@ -111,7 +119,7 @@ class KwokCloudProvider(CloudProvider):
                 node_name=node_name,
                 instance_type=chosen,
                 labels=labels,
-                created_at=time.time(),
+                created_at=self.clock(),
             )
             out = NodeClaim(
                 metadata=node_claim.metadata,
@@ -130,13 +138,18 @@ class KwokCloudProvider(CloudProvider):
     def tick(self, now: Optional[float] = None) -> list[Node]:
         """Materialize Node objects for instances past the registration
         delay (kwok NodeRegistrationDelay, cloudprovider.go:74-83)."""
-        now = time.time() if now is None else now
+        now = self.clock() if now is None else now
         created = []
         with self._lock:
             for pid, inst in self._instances.items():
                 if inst.registered or inst.terminated:
                     continue
-                if now - inst.created_at < self.registration_delay:
+                # created_at is wall clock while `now` may be simulated;
+                # only gate when a delay is actually configured
+                if (
+                    self.registration_delay > 0
+                    and now - inst.created_at < self.registration_delay
+                ):
                     continue
                 claim = self.kube.get_node_claim(inst.claim_name)
                 taints = [UNREGISTERED_NO_EXECUTE_TAINT]
@@ -185,6 +198,9 @@ class KwokCloudProvider(CloudProvider):
 
     def is_drifted(self, node_claim: NodeClaim) -> str:
         return ""
+
+    def repair_policies(self) -> list:
+        return list(self._repair_policies)
 
     def name(self) -> str:
         return "kwok"

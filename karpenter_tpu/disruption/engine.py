@@ -120,6 +120,9 @@ class DisruptionEngine:
         self.queue = queue or OrchestrationQueue(kube, cluster, provisioner)
         self.options = options or Options()
         self._rng = random.Random(seed)
+        from karpenter_tpu.disruption.validation import Validator
+
+        self.queue.validator = Validator(self)
 
     # -- candidates (helpers.go:174-193) ---------------------------------------
 
@@ -467,6 +470,7 @@ class OrchestrationQueue:
         self.cluster = cluster
         self.provisioner = provisioner
         self.active: list[Command] = []
+        self.validator = None  # set by DisruptionEngine
 
     def start_command(self, command: Command, now: Optional[float] = None) -> None:
         now = time.time() if now is None else now
@@ -496,14 +500,19 @@ class OrchestrationQueue:
 
     def reconcile(self, now: Optional[float] = None) -> None:
         """waitOrTerminate (queue.go:137-246): once all replacement
-        claims are Initialized, delete the candidates. Commands whose
-        replacements die or that exceed the retry deadline roll back —
-        candidates are un-tainted and unmarked (queue.go:150-170)."""
+        claims are Initialized, re-validate (validation.go:152-280 —
+        pods/budgets may have churned since the command was computed)
+        and delete the candidates. Commands whose replacements die,
+        that fail validation, or that exceed the retry deadline roll
+        back — candidates are un-tainted and unmarked."""
         now = time.time() if now is None else now
         still_active = []
         for command in self.active:
             state = self._replacements_state(command)
             if state == "ready":
+                if self.validator is not None and not self._validate(command, now):
+                    self._rollback(command)
+                    continue
                 for candidate in command.candidates:
                     claim = candidate.state_node.node_claim
                     if claim is not None and claim.metadata.deletion_timestamp is None:
@@ -515,6 +524,15 @@ class OrchestrationQueue:
             else:
                 still_active.append(command)
         self.active = still_active
+
+    def _validate(self, command: Command, now: float) -> bool:
+        try:
+            self.validator.validate_for_execution(command, now)
+            return True
+        except Exception as err:
+            log.warning("disruption command %s failed validation: %s",
+                        command.reason, err)
+            return False
 
     def _replacements_state(self, command: Command) -> str:
         """ready | waiting | failed."""

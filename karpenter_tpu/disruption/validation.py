@@ -1,0 +1,100 @@
+"""Disruption command validation.
+
+Counterpart of pkg/controllers/disruption/validation.go:52-280: a
+command is computed against a snapshot, and cluster state moves on
+while replacements launch. Before the orchestration queue executes the
+candidate deletions it re-verifies, against *current* state:
+
+- every candidate's claim still exists and nothing re-armed
+  do-not-disrupt (node or pods),
+- no freshly-arrived pod on a candidate is PDB-blocked,
+- per-pool budgets still admit the deletions (candidates' own
+  marked-for-deletion state is excluded from the deleting count so the
+  command doesn't collide with itself).
+
+Raises ValidationError -> the queue rolls the command back.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional, TYPE_CHECKING
+
+from karpenter_tpu.apis.v1.labels import DO_NOT_DISRUPT_ANNOTATION
+from karpenter_tpu.utils.pdb import PdbLimits
+
+if TYPE_CHECKING:  # pragma: no cover
+    from karpenter_tpu.disruption.engine import Command, DisruptionEngine
+
+# The reference re-validates after this TTL (validation.go consolidationTTL);
+# in the tick-driven runtime validation happens at execution time, which is
+# at least one queue cycle after computation.
+VALIDATION_TTL_SECONDS = 15.0
+
+
+class ValidationError(Exception):
+    pass
+
+
+class Validator:
+    def __init__(self, engine: "DisruptionEngine"):
+        self.engine = engine
+
+    def validate_for_execution(self, command: "Command",
+                               now: Optional[float] = None) -> None:
+        now = time.time() if now is None else now
+        kube = self.engine.kube
+        pdb = PdbLimits(kube)
+        candidate_names = {
+            c.state_node.node_claim.metadata.name
+            for c in command.candidates
+            if c.state_node.node_claim is not None
+        }
+        for candidate in command.candidates:
+            node = candidate.state_node
+            claim = node.node_claim
+            if claim is None or kube.get_node_claim(claim.metadata.name) is None:
+                raise ValidationError(
+                    f"candidate {node.name} claim vanished"
+                )
+            if node.annotations().get(DO_NOT_DISRUPT_ANNOTATION) == "true":
+                raise ValidationError(f"candidate {node.name} re-armed do-not-disrupt")
+            live = self.engine.cluster.node_for_name(node.name)
+            pod_keys = live.pod_keys if live is not None else node.pod_keys
+            for pod_key in pod_keys:
+                pod = kube.get_pod(*pod_key.split("/", 1))
+                if pod is None or pod.is_terminal() or pod.is_terminating():
+                    continue
+                if pod.owner_kind() == "DaemonSet":
+                    continue
+                if pod.metadata.annotations.get(DO_NOT_DISRUPT_ANNOTATION) == "true":
+                    raise ValidationError(
+                        f"pod {pod_key} on candidate {node.name} is do-not-disrupt"
+                    )
+                if pdb.can_evict(pod) is not None:
+                    raise ValidationError(
+                        f"pod {pod_key} on candidate {node.name} is PDB-blocked"
+                    )
+        # budgets against current state, excluding this command's own marks
+        needed: dict[str, int] = {}
+        for candidate in command.candidates:
+            pool = candidate.node_pool.metadata.name
+            needed[pool] = needed.get(pool, 0) + 1
+        for pool_name, count in needed.items():
+            pool = kube.get_node_pool(pool_name)
+            if pool is None:
+                raise ValidationError(f"nodepool {pool_name} vanished")
+            total = self.engine.cluster.nodepool_node_count(pool_name)
+            allowed = pool.must_get_allowed_disruptions(now, total, command.reason)
+            deleting_others = sum(
+                1
+                for n in self.engine.cluster.nodes()
+                if n.nodepool_name() == pool_name
+                and n.deleting()
+                and not (
+                    n.node_claim is not None
+                    and n.node_claim.metadata.name in candidate_names
+                )
+            )
+            if allowed - deleting_others < count:
+                raise ValidationError(f"budget for nodepool {pool_name} closed")

@@ -1,0 +1,70 @@
+"""Deduplicated event recorder.
+
+Counterpart of pkg/events/recorder.go:47-120: events identical in
+(kind, object, reason, message) within a 10s TTL are dropped; a simple
+per-reason token bucket guards against floods.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+@dataclass(frozen=True)
+class Event:
+    kind: str          # object kind
+    name: str          # object name
+    type: str          # Normal | Warning
+    reason: str
+    message: str
+
+
+@dataclass
+class RecordedEvent:
+    event: Event
+    timestamp: float
+    count: int = 1
+
+
+class EventRecorder:
+    DEDUPE_TTL = 10.0
+    RATE_LIMIT_PER_REASON = 10  # events per TTL window
+    MAX_EVENTS = 1000           # ring buffer: long-running loops must not leak
+
+    def __init__(self) -> None:
+        from collections import deque
+
+        self.events: "deque[RecordedEvent]" = deque(maxlen=self.MAX_EVENTS)
+        self._last_seen: dict[Event, float] = {}
+        self._reason_counts: dict[str, list[float]] = {}
+
+    def publish(self, event: Event, now: Optional[float] = None) -> bool:
+        now = time.time() if now is None else now
+        # prune the dedupe cache so distinct one-off events can't grow
+        # it without bound
+        if len(self._last_seen) > 4 * self.MAX_EVENTS:
+            self._last_seen = {
+                e: t for e, t in self._last_seen.items()
+                if now - t < self.DEDUPE_TTL
+            }
+        last = self._last_seen.get(event)
+        if last is not None and now - last < self.DEDUPE_TTL:
+            for rec in reversed(self.events):
+                if rec.event == event:
+                    rec.count += 1
+                    break
+            return False
+        window = [t for t in self._reason_counts.get(event.reason, []) if now - t < self.DEDUPE_TTL]
+        if len(window) >= self.RATE_LIMIT_PER_REASON:
+            self._reason_counts[event.reason] = window
+            return False
+        window.append(now)
+        self._reason_counts[event.reason] = window
+        self._last_seen[event] = now
+        self.events.append(RecordedEvent(event=event, timestamp=now))
+        return True
+
+    def for_reason(self, reason: str) -> list[RecordedEvent]:
+        return [r for r in self.events if r.event.reason == reason]

@@ -1,0 +1,191 @@
+"""Hygiene controllers: consistency, hydration, nodepool status.
+
+- ConsistencyController (consistency/controller.go:79-150 +
+  nodeshape.go:35): verifies a registered node's real capacity is
+  within 10% of what the claim requested; emits an event and sets
+  ConsistentStateFound.
+- HydrationController (nodeclaim/hydration, node/hydration): back-fills
+  nodepool-hash annotations on objects created before the annotation
+  existed (upgrade path).
+- NodePoolStatusController folds the reference's nodepool/{counter,
+  readiness, registrationhealth, validation, hash} controllers: tallies
+  owned resources into status, mirrors NodeClassReady, sets
+  NodeRegistrationHealthy from the health tracker, validates the spec,
+  and propagates template-hash changes to claims.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from karpenter_tpu.apis.v1.labels import (
+    NODEPOOL_HASH_ANNOTATION,
+    NODEPOOL_HASH_VERSION,
+    NODEPOOL_HASH_VERSION_ANNOTATION,
+    NODEPOOL_LABEL,
+    is_restricted_label,
+)
+from karpenter_tpu.apis.v1.nodeclaim import (
+    COND_CONSISTENT_STATE_FOUND,
+    COND_REGISTERED,
+)
+from karpenter_tpu.apis.v1.nodepool import (
+    COND_NODE_CLASS_READY,
+    COND_NODE_REGISTRATION_HEALTHY,
+    COND_VALIDATION_SUCCEEDED,
+)
+from karpenter_tpu.events.recorder import Event, EventRecorder
+from karpenter_tpu.kube.client import KubeClient
+from karpenter_tpu.state.cluster import Cluster
+from karpenter_tpu.state.nodepoolhealth import HealthTracker
+from karpenter_tpu.utils import resources as resutil
+from karpenter_tpu.utils.duration import CronSchedule, parse_duration
+
+SHAPE_TOLERANCE = 0.10  # nodeshape.go:35
+
+
+class ConsistencyController:
+    def __init__(self, kube: KubeClient, recorder: Optional[EventRecorder] = None):
+        self.kube = kube
+        self.recorder = recorder or EventRecorder()
+
+    def reconcile_all(self, now: Optional[float] = None) -> None:
+        now = time.time() if now is None else now
+        nodes_by_pid = {n.spec.provider_id: n for n in self.kube.nodes()}
+        for claim in self.kube.node_claims():
+            if not claim.status_conditions.is_true(COND_REGISTERED):
+                continue
+            node = nodes_by_pid.get(claim.status.provider_id)
+            if node is None:
+                continue
+            consistent = True
+            for key, expected in claim.status.capacity.items():
+                actual = node.status.capacity.get(key, 0.0)
+                if expected > 0 and actual < expected * (1 - SHAPE_TOLERANCE):
+                    consistent = False
+                    self.recorder.publish(
+                        Event(
+                            kind="NodeClaim", name=claim.metadata.name,
+                            type="Warning", reason="FailedConsistencyCheck",
+                            message=f"node {node.metadata.name} {key} "
+                                    f"{actual} < expected {expected}",
+                        ),
+                        now=now,
+                    )
+            if consistent:
+                claim.status_conditions.set_true(COND_CONSISTENT_STATE_FOUND, now=now)
+            else:
+                claim.status_conditions.set_false(
+                    COND_CONSISTENT_STATE_FOUND, "ConsistencyCheckFailed", now=now
+                )
+
+
+class HydrationController:
+    def __init__(self, kube: KubeClient):
+        self.kube = kube
+
+    def reconcile_all(self) -> int:
+        hydrated = 0
+        pools = {p.metadata.name: p for p in self.kube.node_pools()}
+        for obj in list(self.kube.node_claims()) + list(self.kube.nodes()):
+            pool = pools.get(obj.metadata.labels.get(NODEPOOL_LABEL, ""))
+            if pool is None:
+                continue
+            if NODEPOOL_HASH_VERSION_ANNOTATION not in obj.metadata.annotations:
+                obj.metadata.annotations[NODEPOOL_HASH_VERSION_ANNOTATION] = (
+                    NODEPOOL_HASH_VERSION
+                )
+                obj.metadata.annotations[NODEPOOL_HASH_ANNOTATION] = pool.hash()
+                hydrated += 1
+        return hydrated
+
+
+class NodePoolStatusController:
+    def __init__(self, kube: KubeClient, cluster: Cluster,
+                 health: Optional[HealthTracker] = None,
+                 nodeclass_ready: bool = True):
+        self.kube = kube
+        self.cluster = cluster
+        self.health = health or HealthTracker()
+        self.nodeclass_ready = nodeclass_ready
+
+    def reconcile_all(self, now: Optional[float] = None) -> None:
+        now = time.time() if now is None else now
+        for pool in self.kube.node_pools():
+            self._counter(pool)
+            self._readiness(pool, now)
+            self._registration_health(pool, now)
+            self._validate(pool, now)
+            self._hash_propagation(pool)
+
+    def _counter(self, pool) -> None:
+        """nodepool/counter: aggregate owned capacity into status."""
+        total: dict[str, float] = {}
+        count = 0
+        for node in self.cluster.nodes():
+            if node.nodepool_name() != pool.metadata.name or node.deleting():
+                continue
+            total = resutil.merge(total, node.capacity())
+            count += 1
+        pool.status.resources = total
+        pool.status.nodes = count
+
+    def _readiness(self, pool, now: float) -> None:
+        if self.nodeclass_ready:
+            pool.status_conditions.set_true(COND_NODE_CLASS_READY, now=now)
+        else:
+            pool.status_conditions.set_false(
+                COND_NODE_CLASS_READY, "NodeClassNotReady", now=now
+            )
+
+    def _registration_health(self, pool, now: float) -> None:
+        if self.health.healthy(pool.metadata.name):
+            pool.status_conditions.set_true(COND_NODE_REGISTRATION_HEALTHY, now=now)
+        else:
+            pool.status_conditions.set_false(
+                COND_NODE_REGISTRATION_HEALTHY, "RegistrationFailuresExceeded", now=now
+            )
+
+    def _validate(self, pool, now: float) -> None:
+        """Runtime validation (nodepool/validation + CEL-rule analog)."""
+        errors = []
+        for key in pool.spec.template.labels:
+            err = is_restricted_label(key)
+            if err:
+                errors.append(err)
+        for budget in pool.spec.disruption.budgets:
+            if budget.schedule is not None:
+                try:
+                    CronSchedule.parse(budget.schedule)
+                except ValueError as err:
+                    errors.append(str(err))
+            if not budget.nodes.endswith("%"):
+                try:
+                    int(budget.nodes)
+                except ValueError:
+                    errors.append(f"invalid budget nodes {budget.nodes!r}")
+        try:
+            parse_duration(pool.spec.disruption.consolidate_after)
+        except ValueError as err:
+            errors.append(str(err))
+        if errors:
+            pool.status_conditions.set_false(
+                COND_VALIDATION_SUCCEEDED, "ValidationFailed", "; ".join(errors), now=now
+            )
+        else:
+            pool.status_conditions.set_true(COND_VALIDATION_SUCCEEDED, now=now)
+
+    def _hash_propagation(self, pool) -> None:
+        """nodepool/hash: stamp current template hash onto owned claims
+        at matching hash version (drift detection input)."""
+        current = pool.hash()
+        for claim in self.kube.node_claims():
+            if claim.metadata.labels.get(NODEPOOL_LABEL) != pool.metadata.name:
+                continue
+            version = claim.metadata.annotations.get(NODEPOOL_HASH_VERSION_ANNOTATION)
+            if version != NODEPOOL_HASH_VERSION:
+                claim.metadata.annotations[NODEPOOL_HASH_VERSION_ANNOTATION] = (
+                    NODEPOOL_HASH_VERSION
+                )
+                claim.metadata.annotations[NODEPOOL_HASH_ANNOTATION] = current

@@ -1,0 +1,176 @@
+"""Metrics registry: counters, gauges, histograms, and a diff-publishing
+gauge store.
+
+Counterpart of pkg/metrics (metrics.go core series names, store.go:33-110
+`Store` that re-publishes per-object gauge sets and deletes stale ones).
+Backend-agnostic: values live in-process and can be scraped/dumped; the
+series names mirror the reference's `karpenter_*` namespace so
+dashboards translate 1:1.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from dataclasses import dataclass, field
+from typing import Iterable, Optional
+
+LabelPairs = tuple[tuple[str, str], ...]
+
+
+def _labels(labels: Optional[dict[str, str]]) -> LabelPairs:
+    return tuple(sorted((labels or {}).items()))
+
+
+class Counter:
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._values: dict[LabelPairs, float] = {}
+        self._lock = threading.Lock()
+
+    def inc(self, labels: Optional[dict[str, str]] = None, value: float = 1.0) -> None:
+        key = _labels(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + value
+
+    def value(self, labels: Optional[dict[str, str]] = None) -> float:
+        return self._values.get(_labels(labels), 0.0)
+
+    def total(self) -> float:
+        return sum(self._values.values())
+
+
+class Gauge:
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._values: dict[LabelPairs, float] = {}
+        self._lock = threading.Lock()
+
+    def set(self, value: float, labels: Optional[dict[str, str]] = None) -> None:
+        with self._lock:
+            self._values[_labels(labels)] = value
+
+    def delete(self, labels: Optional[dict[str, str]] = None) -> None:
+        with self._lock:
+            self._values.pop(_labels(labels), None)
+
+    def value(self, labels: Optional[dict[str, str]] = None) -> float:
+        return self._values.get(_labels(labels), 0.0)
+
+    def series(self) -> dict[LabelPairs, float]:
+        return dict(self._values)
+
+
+class Histogram:
+    DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30, 60, 300)
+
+    def __init__(self, name: str, help: str = "", buckets: Iterable[float] = DEFAULT_BUCKETS):
+        self.name = name
+        self.help = help
+        self.buckets = sorted(buckets)
+        self._counts: dict[LabelPairs, list[int]] = {}
+        self._sums: dict[LabelPairs, float] = {}
+        self._totals: dict[LabelPairs, int] = {}
+        self._lock = threading.Lock()
+
+    def observe(self, value: float, labels: Optional[dict[str, str]] = None) -> None:
+        key = _labels(labels)
+        with self._lock:
+            counts = self._counts.setdefault(key, [0] * len(self.buckets))
+            idx = bisect.bisect_left(self.buckets, value)
+            if idx < len(counts):
+                counts[idx] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + value
+            self._totals[key] = self._totals.get(key, 0) + 1
+
+    def count(self, labels: Optional[dict[str, str]] = None) -> int:
+        return self._totals.get(_labels(labels), 0)
+
+    def sum(self, labels: Optional[dict[str, str]] = None) -> float:
+        return self._sums.get(_labels(labels), 0.0)
+
+
+class Registry:
+    def __init__(self) -> None:
+        self._metrics: dict[str, object] = {}
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get(name, lambda: Counter(name, help))
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get(name, lambda: Gauge(name, help))
+
+    def histogram(self, name: str, help: str = "", **kw) -> Histogram:
+        return self._get(name, lambda: Histogram(name, help, **kw))
+
+    def _get(self, name, factory):
+        metric = self._metrics.get(name)
+        if metric is None:
+            metric = factory()
+            self._metrics[name] = metric
+        return metric
+
+    def dump(self) -> dict[str, dict]:
+        out = {}
+        for name, metric in self._metrics.items():
+            if isinstance(metric, Counter):
+                out[name] = {"type": "counter", "total": metric.total()}
+            elif isinstance(metric, Gauge):
+                out[name] = {"type": "gauge", "series": len(metric.series())}
+            elif isinstance(metric, Histogram):
+                out[name] = {"type": "histogram"}
+        return out
+
+
+# The process-wide registry and the reference's core series
+# (pkg/metrics/metrics.go:32).
+REGISTRY = Registry()
+
+NODECLAIMS_CREATED = REGISTRY.counter(
+    "karpenter_nodeclaims_created_total", "NodeClaims created, by nodepool")
+NODECLAIMS_TERMINATED = REGISTRY.counter(
+    "karpenter_nodeclaims_terminated_total", "NodeClaims terminated, by nodepool")
+NODECLAIMS_DISRUPTED = REGISTRY.counter(
+    "karpenter_nodeclaims_disrupted_total", "NodeClaims disrupted, by reason")
+PODS_SCHEDULING_DURATION = REGISTRY.histogram(
+    "karpenter_pods_scheduling_duration_seconds",
+    "Time from pod first seen to scheduling decision")
+PODS_STARTUP_DURATION = REGISTRY.histogram(
+    "karpenter_pods_startup_duration_seconds",
+    "Time from pod first seen to bound")
+SCHEDULING_DURATION = REGISTRY.histogram(
+    "karpenter_provisioner_scheduling_duration_seconds",
+    "Solve wall clock")
+DISRUPTION_EVALUATION_DURATION = REGISTRY.histogram(
+    "karpenter_disruption_evaluation_duration_seconds",
+    "Disruption method evaluation wall clock")
+
+
+class Store:
+    """Diff-publishing gauge set per object (store.go:33-110): Update
+    replaces the object's series, ReplaceAll drops stale objects."""
+
+    def __init__(self, gauge: Gauge):
+        self.gauge = gauge
+        self._published: dict[str, list[dict[str, str]]] = {}
+
+    def update(self, key: str, series: list[tuple[dict[str, str], float]]) -> None:
+        for labels in self._published.get(key, []):
+            self.gauge.delete(labels)
+        out = []
+        for labels, value in series:
+            self.gauge.set(value, labels)
+            out.append(labels)
+        self._published[key] = out
+
+    def delete(self, key: str) -> None:
+        for labels in self._published.pop(key, []):
+            self.gauge.delete(labels)
+
+    def replace_all(self, series_by_key: dict[str, list[tuple[dict[str, str], float]]]) -> None:
+        for stale in set(self._published) - set(series_by_key):
+            self.delete(stale)
+        for key, series in series_by_key.items():
+            self.update(key, series)

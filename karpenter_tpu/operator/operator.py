@@ -1,0 +1,182 @@
+"""Operator runtime: controller registry + run loop.
+
+Counterpart of pkg/operator/operator.go:117-249 and
+pkg/controllers/controllers.go:66-148: builds the full controller set
+over one kube client / state / provider, and drives them. The
+reference runs controller-runtime watch-driven workers under leader
+election; this runtime is tick-driven — `step(now)` advances every
+controller once in dependency order, and `run()` loops it on wall
+clock. Tests call `step` directly for determinism (the envtest
+ExpectReconciled pattern).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from karpenter_tpu.cloudprovider.metrics import MetricsCloudProvider
+from karpenter_tpu.cloudprovider.types import CloudProvider
+from karpenter_tpu.apis.v1alpha1.nodeoverlay import OverlayCloudProvider
+from karpenter_tpu.disruption.conditions import (
+    DisruptionConditionsController,
+    ExpirationController,
+    PodEventsController,
+)
+from karpenter_tpu.disruption.engine import DisruptionEngine
+from karpenter_tpu.events.recorder import EventRecorder
+from karpenter_tpu.kube.client import KubeClient
+from karpenter_tpu.lifecycle.garbagecollection import (
+    GC_INTERVAL_SECONDS,
+    GarbageCollectionController,
+    NodeHealthController,
+)
+from karpenter_tpu.lifecycle.hygiene import (
+    ConsistencyController,
+    HydrationController,
+    NodePoolStatusController,
+)
+from karpenter_tpu.lifecycle.nodeclaim_lifecycle import NodeClaimLifecycle
+from karpenter_tpu.lifecycle.termination import TerminationController
+from karpenter_tpu.operator.options import Options
+from karpenter_tpu.provisioning.provisioner import Provisioner
+from karpenter_tpu.provisioning.static import StaticCapacityController
+from karpenter_tpu.state.cluster import Cluster, attach_informers
+from karpenter_tpu.state.nodepoolhealth import HealthTracker
+
+log = logging.getLogger("karpenter.operator")
+
+
+@dataclass
+class Operator:
+    kube: KubeClient
+    cloud_provider: CloudProvider
+    options: Options = field(default_factory=Options)
+
+    def __post_init__(self) -> None:
+        # decorators (kwok/main.go:37, controllers.go wiring)
+        provider = MetricsCloudProvider(self.cloud_provider)
+        if self.options.feature_gates.node_overlay:
+            provider = OverlayCloudProvider(provider, self.kube)
+        self.provider = provider
+
+        self.cluster = Cluster(self.kube)
+        attach_informers(self.kube, self.cluster)
+        self.recorder = EventRecorder()
+        self.health = HealthTracker()
+
+        self.provisioner = Provisioner(self.kube, self.cluster, provider)
+        self.lifecycle = NodeClaimLifecycle(self.kube, provider, health=self.health)
+        self.termination = TerminationController(self.kube, self.cluster)
+        self.conditions = DisruptionConditionsController(
+            self.kube, self.cluster, provider
+        )
+        self.pod_events = PodEventsController(self.kube, self.cluster)
+        self.expiration = ExpirationController(self.kube)
+        self.disruption = DisruptionEngine(
+            self.kube, self.cluster, provider, self.provisioner,
+            options=self.options,
+        )
+        self.gc = GarbageCollectionController(self.kube, provider)
+        self.node_health = NodeHealthController(self.kube, provider, self.options)
+        self.consistency = ConsistencyController(self.kube, self.recorder)
+        self.hydration = HydrationController(self.kube)
+        self.nodepool_status = NodePoolStatusController(
+            self.kube, self.cluster, health=self.health
+        )
+        self.static = StaticCapacityController(self.kube, self.cluster, self.options)
+
+        self._last_disruption = 0.0
+        self._last_gc = 0.0
+        # plans whose pods await binding (the kube-scheduler's job in a
+        # real cluster; this runtime owns the whole substrate, so it
+        # binds pods to the nodes the solver placed them on)
+        self._pending_bindings: list = []
+
+        # pod/node watch events drive the provisioning batcher
+        # (provisioning/controller.go PodController/NodeController)
+        def on_pod_event(event: str, pod) -> None:
+            if event in ("ADDED", "MODIFIED") and not pod.spec.node_name:
+                self.provisioner.batcher.trigger()
+
+        self.kube.watch("Pod", on_pod_event)
+
+    # -- one tick --------------------------------------------------------------
+
+    def step(self, now: Optional[float] = None) -> None:
+        """Advance every controller once, dependency-ordered: status
+        controllers -> provisioning -> lifecycle -> disruption (on its
+        poll period) -> orchestration -> termination -> hygiene."""
+        now = time.time() if now is None else now
+        self.hydration.reconcile_all()
+        self.nodepool_status.reconcile_all(now=now)
+        self.static.reconcile_all(now=now)
+
+        if self.provisioner.batcher.ready(now=now):
+            results = self.provisioner.reconcile(now=now)
+            self._pending_bindings.append(results)
+
+        self.lifecycle.reconcile_all(now=now)
+        tick = getattr(self.cloud_provider, "tick", None)
+        if tick is not None:
+            tick(now=now)
+        self.lifecycle.reconcile_all(now=now)
+
+        self._bind_pending()
+
+        self.pod_events.reconcile_all(now=now)
+        self.conditions.reconcile_all(now=now)
+        self.expiration.reconcile_all(now=now)
+
+        if now - self._last_disruption >= self.options.disruption_poll_seconds:
+            self._last_disruption = now
+            self.disruption.reconcile(now=now)
+        self.disruption.queue.reconcile(now=now)
+
+        self.termination.reconcile_all(now=now)
+        self.node_health.reconcile(now=now)
+        if now - self._last_gc >= GC_INTERVAL_SECONDS:
+            self._last_gc = now
+            self.gc.reconcile(now=now)
+        self.consistency.reconcile_all(now=now)
+
+    def _bind_pending(self) -> None:
+        """Bind pods from completed scheduling results to their target
+        nodes once those nodes exist (and immediately for placements on
+        live nodes). Results are dropped once fully bound or once every
+        pod found a different home."""
+        remaining = []
+        for results in self._pending_bindings:
+            unbound = False
+            for plan in results.new_node_plans:
+                claim = (
+                    self.kube.get_node_claim(plan.claim_name)
+                    if plan.claim_name else None
+                )
+                node_name = claim.status.node_name if claim is not None else ""
+                for pod in plan.pods:
+                    live = self.kube.get_pod(pod.metadata.namespace, pod.metadata.name)
+                    if live is None or live.spec.node_name:
+                        continue
+                    if node_name:
+                        self.kube.bind_pod(live, node_name)
+                    elif claim is not None:
+                        unbound = True  # node still materializing
+            for node_name, pods in results.existing_assignments.items():
+                for pod in pods:
+                    live = self.kube.get_pod(pod.metadata.namespace, pod.metadata.name)
+                    if live is not None and not live.spec.node_name:
+                        self.kube.bind_pod(live, node_name)
+            if unbound:
+                remaining.append(results)
+        self._pending_bindings = remaining
+
+    def run(self, stop_after: Optional[float] = None, tick_seconds: float = 1.0) -> None:
+        """Wall-clock loop (operator.Start). `stop_after` bounds the
+        run for embedding in tests/sims."""
+        deadline = None if stop_after is None else time.time() + stop_after
+        while deadline is None or time.time() < deadline:
+            self.step()
+            time.sleep(tick_seconds)

@@ -1,0 +1,275 @@
+"""Hygiene / aux controller tests: GC, health, consistency, overlay,
+static pools, nodepool status, events, metrics, validators, operator
+runtime."""
+
+import time
+
+from karpenter_tpu.apis.v1.labels import NODEPOOL_LABEL
+from karpenter_tpu.apis.v1.nodepool import (
+    COND_NODE_REGISTRATION_HEALTHY,
+    COND_VALIDATION_SUCCEEDED,
+    Budget,
+)
+from karpenter_tpu.apis.v1alpha1.nodeoverlay import (
+    NodeOverlay,
+    NodeOverlaySpec,
+    OverlayStore,
+    adjusted_price,
+)
+from karpenter_tpu.cloudprovider.fake import GIB, make_instance_type
+from karpenter_tpu.cloudprovider.types import RepairPolicy
+from karpenter_tpu.events.recorder import Event, EventRecorder
+from karpenter_tpu.kube.objects import (
+    NodeCondition,
+    NodeSelectorRequirement,
+    ObjectMeta,
+)
+from karpenter_tpu.lifecycle.garbagecollection import (
+    GarbageCollectionController,
+    NodeHealthController,
+)
+from karpenter_tpu.metrics.store import Gauge, Store
+from karpenter_tpu.operator.operator import Operator
+from karpenter_tpu.operator.options import FeatureGates, Options
+from karpenter_tpu.testing import Environment, mk_nodepool, mk_pod
+
+
+def types():
+    return [
+        make_instance_type("c2", cpu=2, memory=8 * GIB, price=2.0),
+        make_instance_type("c8", cpu=8, memory=32 * GIB, price=8.0),
+    ]
+
+
+class TestGarbageCollection:
+    def test_leaked_instance_deleted(self):
+        env = Environment(types=types())
+        env.kube.create(mk_nodepool("default"))
+        env.provision(mk_pod())
+        # orphan the instance: remove the claim bypassing finalizers
+        claim = env.kube.node_claims()[0]
+        claim.metadata.finalizers.clear()
+        env.kube.delete(claim)
+        gc = GarbageCollectionController(env.kube, env.cloud)
+        stats = gc.reconcile()
+        assert stats["leaked_instances"] == 1
+        assert not env.cloud.list()
+
+    def test_orphaned_claim_deleted(self):
+        env = Environment(types=types())
+        env.kube.create(mk_nodepool("default"))
+        env.provision(mk_pod())
+        node = env.kube.nodes()[0]
+        node.metadata.finalizers.clear()
+        env.kube.delete(node)  # node vanishes (e.g. manual kubectl delete)
+        gc = GarbageCollectionController(env.kube, env.cloud)
+        stats = gc.reconcile()
+        assert stats["orphaned_claims"] == 1
+
+
+class TestNodeHealth:
+    def _env_with_unhealthy(self, n_nodes, n_unhealthy):
+        env = Environment(types=types())
+        env.kube.create(mk_nodepool("default"))
+        for _ in range(n_nodes):
+            env.provision(mk_pod(cpu=1.5, memory=6 * GIB))
+        env.cloud._repair_policies = [
+            RepairPolicy(condition_type="BadDisk", condition_status="True",
+                         toleration_duration=60.0)
+        ]
+        now = time.time()
+        for node in env.kube.nodes()[:n_unhealthy]:
+            node.status.conditions.append(
+                NodeCondition(type="BadDisk", status="True",
+                              last_transition_time=now - 120)
+            )
+        return env, now
+
+    def test_unhealthy_node_repaired(self):
+        env, now = self._env_with_unhealthy(6, 1)
+        ctrl = NodeHealthController(
+            env.kube, env.cloud,
+            Options(feature_gates=FeatureGates(node_repair=True)),
+        )
+        repaired = ctrl.reconcile(now=now)
+        assert len(repaired) == 1
+
+    def test_circuit_breaker_at_20_percent(self):
+        env, now = self._env_with_unhealthy(6, 3)
+        ctrl = NodeHealthController(
+            env.kube, env.cloud,
+            Options(feature_gates=FeatureGates(node_repair=True)),
+        )
+        assert ctrl.reconcile(now=now) == []
+
+    def test_gate_off_no_repair(self):
+        env, now = self._env_with_unhealthy(6, 1)
+        ctrl = NodeHealthController(env.kube, env.cloud, Options())
+        assert ctrl.reconcile(now=now) == []
+
+
+class TestOverlay:
+    def test_adjusted_price(self):
+        assert adjusted_price(10.0, "+50%") == 15.0
+        assert adjusted_price(10.0, "-1.5") == 8.5
+        assert adjusted_price(1.0, "-200%") == 0.0
+        assert adjusted_price(10.0, None) == 10.0
+
+    def test_store_applies_by_weight(self):
+        it = make_instance_type("c2", cpu=2, price=10.0,
+                                capacity_types=("on-demand",), zones=("z1",))
+        heavy = NodeOverlay(
+            metadata=ObjectMeta(name="heavy"),
+            spec=NodeOverlaySpec(weight=10, price="3.0"),
+        )
+        light = NodeOverlay(
+            metadata=ObjectMeta(name="light"),
+            spec=NodeOverlaySpec(weight=1, price="7.0"),
+        )
+        store = OverlayStore([light, heavy])
+        out = store.apply(it)
+        assert out.offerings[0].price == 3.0
+
+    def test_store_selector_and_capacity(self):
+        it = make_instance_type("c2", cpu=2, capacity_types=("on-demand",), zones=("z1",))
+        overlay = NodeOverlay(
+            spec=NodeOverlaySpec(
+                requirements=[
+                    NodeSelectorRequirement(
+                        key="node.kubernetes.io/instance-type",
+                        operator="In", values=("c2",),
+                    )
+                ],
+                capacity={"example.com/gpu": 4.0},
+            )
+        )
+        out = OverlayStore([overlay]).apply(it)
+        assert out.capacity["example.com/gpu"] == 4.0
+        miss = OverlayStore([NodeOverlay(spec=NodeOverlaySpec(
+            requirements=[NodeSelectorRequirement(
+                key="node.kubernetes.io/instance-type", operator="In",
+                values=("other",))],
+            capacity={"example.com/gpu": 4.0},
+        ))]).apply(it)
+        assert "example.com/gpu" not in miss.capacity
+
+
+class TestStaticPools:
+    def _static_env(self, replicas=3):
+        env = Environment(types=types())
+        pool = mk_nodepool("static")
+        pool.spec.replicas = replicas
+        env.kube.create(pool)
+        op_options = Options(feature_gates=FeatureGates(static_capacity=True))
+        from karpenter_tpu.provisioning.static import StaticCapacityController
+
+        ctrl = StaticCapacityController(env.kube, env.cluster, op_options)
+        return env, ctrl
+
+    def test_scale_up_to_replicas(self):
+        env, ctrl = self._static_env(3)
+        ctrl.reconcile_all()
+        assert len(env.kube.node_claims()) == 3
+        env.lifecycle.reconcile_all()
+        env.cloud.tick()
+        env.lifecycle.reconcile_all()
+        assert len(env.kube.nodes()) == 3
+
+    def test_scale_down(self):
+        env, ctrl = self._static_env(3)
+        ctrl.reconcile_all()
+        env.lifecycle.reconcile_all()
+        env.cloud.tick()
+        env.lifecycle.reconcile_all()
+        pool = env.kube.get_node_pool("static")
+        pool.spec.replicas = 1
+        ctrl.reconcile_all()
+        env.reconcile_termination()
+        assert len([c for c in env.kube.node_claims()
+                    if c.metadata.deletion_timestamp is None]) == 1
+
+
+class TestNodePoolStatus:
+    def test_counter_and_conditions(self):
+        env = Environment(types=types())
+        env.kube.create(mk_nodepool("default"))
+        env.provision(mk_pod())
+        env.nodepool_status_reconcile() if hasattr(env, "nodepool_status_reconcile") else None
+        from karpenter_tpu.lifecycle.hygiene import NodePoolStatusController
+
+        ctrl = NodePoolStatusController(env.kube, env.cluster)
+        ctrl.reconcile_all()
+        pool = env.kube.get_node_pool("default")
+        assert pool.status.nodes == 1
+        assert pool.status.resources.get("cpu", 0) > 0
+        assert pool.status_conditions.is_true(COND_VALIDATION_SUCCEEDED)
+        assert pool.status_conditions.is_true(COND_NODE_REGISTRATION_HEALTHY)
+
+    def test_validation_rejects_bad_budget(self):
+        env = Environment(types=types())
+        pool = mk_nodepool("default")
+        pool.spec.disruption.budgets = [Budget(nodes="nope")]
+        env.kube.create(pool)
+        from karpenter_tpu.lifecycle.hygiene import NodePoolStatusController
+
+        ctrl = NodePoolStatusController(env.kube, env.cluster)
+        ctrl.reconcile_all()
+        assert pool.status_conditions.is_false(COND_VALIDATION_SUCCEEDED)
+
+
+class TestEventsAndMetrics:
+    def test_event_dedupe(self):
+        recorder = EventRecorder()
+        event = Event(kind="Pod", name="p", type="Normal", reason="R", message="m")
+        now = 1000.0
+        assert recorder.publish(event, now=now)
+        assert not recorder.publish(event, now=now + 1)
+        assert recorder.publish(event, now=now + 11)
+        assert recorder.events[0].count == 2
+
+    def test_gauge_store_diffing(self):
+        gauge = Gauge("test")
+        store = Store(gauge)
+        store.update("obj1", [({"a": "1"}, 5.0)])
+        assert gauge.value({"a": "1"}) == 5.0
+        store.update("obj1", [({"a": "2"}, 7.0)])
+        assert gauge.value({"a": "1"}) == 0.0
+        assert gauge.value({"a": "2"}) == 7.0
+        store.replace_all({})
+        assert gauge.value({"a": "2"}) == 0.0
+
+
+class TestOperatorRuntime:
+    def test_full_stack_step(self):
+        from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
+        from karpenter_tpu.kube.client import KubeClient
+
+        kube = KubeClient()
+        cloud = KwokCloudProvider(kube, types=types())
+        op = Operator(kube=kube, cloud_provider=cloud)
+        kube.create(mk_nodepool("default"))
+        kube.create(mk_pod(cpu=1.0))
+        now = time.time()
+        # batcher needs the idle window to elapse; status controllers
+        # observe the new node on the following tick
+        op.step(now=now)
+        op.step(now=now + 2)
+        assert kube.node_claims()
+        assert kube.nodes()
+        op.step(now=now + 3)
+        pool = kube.get_node_pool("default")
+        assert pool.status.nodes == 1
+
+    def test_operator_with_overlay_gate(self):
+        from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
+        from karpenter_tpu.kube.client import KubeClient
+
+        kube = KubeClient()
+        cloud = KwokCloudProvider(kube, types=types())
+        op = Operator(
+            kube=kube, cloud_provider=cloud,
+            options=Options(feature_gates=FeatureGates(node_overlay=True)),
+        )
+        kube.create(NodeOverlay(spec=NodeOverlaySpec(price="0.01")))
+        out = op.provider.get_instance_types(None)
+        assert all(o.price == 0.01 for it in out for o in it.offerings)
