@@ -30,8 +30,16 @@ def build_problem(n_pods: int, n_types: int, seed: int = 42):
     types = instance_types(n_types)
     pool = NodePool(metadata=ObjectMeta(name="default"))
     pods = []
-    cpu_options = [0.1, 0.25, 0.5, 1.0, 2.0, 4.0]
-    mem_options = [0.25 * GIB, 0.5 * GIB, GIB, 2 * GIB, 4 * GIB]
+    # Diverse shapes, mirroring the reference's makeDiversePods mix of
+    # generic workloads: balanced services, cpu-bound batch, and
+    # memory-bound caches/JVMs. The ratio spread is what makes packing
+    # non-trivial: cpu-heavy and mem-heavy pods must share nodes for a
+    # cost-efficient fleet.
+    balanced = [(0.25, 0.5), (0.5, 1.0), (1.0, 2.0), (2.0, 4.0), (4.0, 8.0)]
+    cpu_heavy = [(2.0, 0.5), (4.0, 1.0), (8.0, 2.0), (1.0, 0.25)]
+    mem_heavy = [(0.25, 4.0), (0.5, 8.0), (1.0, 16.0), (0.5, 4.0), (2.0, 16.0)]
+    shapes = balanced + cpu_heavy + mem_heavy
+    weights = np.array([0.4 / 5] * 5 + [0.3 / 4] * 4 + [0.3 / 5] * 5)
     arch_options = ["amd64", "arm64"]
     zone_options = ["test-zone-1", "test-zone-2", "test-zone-3"]
     for i in range(n_pods):
@@ -40,6 +48,7 @@ def build_problem(n_pods: int, n_types: int, seed: int = 42):
             selector["kubernetes.io/arch"] = str(rng.choice(arch_options))
         if rng.random() < 0.15:
             selector[TOPOLOGY_ZONE_LABEL] = str(rng.choice(zone_options))
+        cpu, mem_gib = shapes[rng.choice(len(shapes), p=weights / weights.sum())]
         pods.append(
             Pod(
                 metadata=ObjectMeta(name=f"pod-{i}"),
@@ -47,8 +56,8 @@ def build_problem(n_pods: int, n_types: int, seed: int = 42):
                     containers=[
                         Container(
                             requests={
-                                "cpu": float(rng.choice(cpu_options)),
-                                "memory": float(rng.choice(mem_options)),
+                                "cpu": float(cpu),
+                                "memory": float(mem_gib * GIB),
                             }
                         )
                     ],
@@ -75,18 +84,24 @@ def main() -> None:
 
     pods, pools = build_problem(n_pods, n_types)
 
+    # FFD heuristic (the reference's greedy) gives the cost baseline.
+    ffd = solve(pods, pools, objective="ffd")
+
     # Warm-up with the full problem (same static shapes as the timed
     # run) so the timed region measures solve, not compilation.
-    solve(pods, pools)
+    solve(pods, pools, objective="cost")
 
     t0 = time.perf_counter()
-    sol = solve(pods, pools)
+    sol = solve(pods, pools, objective="cost")
     elapsed = time.perf_counter() - t0
 
     scheduled = sum(len(n.pods) for n in sol.new_nodes) + sum(
         len(e.pods) for e in sol.existing
     )
     pods_per_sec = scheduled / elapsed if elapsed > 0 else 0.0
+    ffd_price = float(ffd.total_price)
+    cost_price = float(sol.total_price)
+    reduction = (1 - cost_price / ffd_price) if ffd_price > 0 else 0.0
     print(
         json.dumps(
             {
@@ -101,7 +116,9 @@ def main() -> None:
                     "nodes": len(sol.new_nodes),
                     "unschedulable": len(sol.unschedulable),
                     "wall_s": round(elapsed, 3),
-                    "fleet_price_per_hr": round(float(sol.total_price), 2),
+                    "fleet_price_per_hr": round(cost_price, 2),
+                    "ffd_fleet_price_per_hr": round(ffd_price, 2),
+                    "cost_reduction_vs_ffd": round(reduction, 4),
                 },
             }
         )

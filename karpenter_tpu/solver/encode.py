@@ -68,17 +68,43 @@ class PodGroup:
 
 def group_pods(pods: Sequence[Pod], required_only: bool = False) -> list[PodGroup]:
     """Group pods by scheduling signature, sorted CPU+memory descending
-    (the reference queue's FFD order, scheduling/queue.go:31-60)."""
+    (the reference queue's FFD order, scheduling/queue.go:31-60).
+
+    Requirements/resource parsing is memoized on a cheap raw-spec key so
+    a 50k-pod batch with a few hundred distinct shapes pays the parse
+    cost once per shape, not once per pod.
+    """
     groups: dict[tuple, PodGroup] = {}
+    parsed: dict[tuple, tuple] = {}
     for pod in pods:
-        reqs = Requirements.from_pod(pod, required_only=required_only)
-        resources = resutil.pod_requests(pod)
-        tols = tuple(sorted(pod.spec.tolerations, key=repr))
-        signature = (
-            repr(reqs),
-            tols,
-            tuple(sorted(resources.items())),
+        spec = pod.spec
+        raw = (
+            tuple(sorted(spec.node_selector.items())),
+            repr(spec.affinity) if spec.affinity is not None else "",
+            tuple(repr(t) for t in spec.tolerations),
+            tuple(
+                tuple(sorted(c.requests.items()))
+                for c in spec.containers
+            ),
+            tuple(
+                tuple(sorted(c.requests.items()))
+                for c in spec.init_containers
+            ),
+            tuple(sorted(spec.overhead.items())),
         )
+        hit = parsed.get(raw)
+        if hit is None:
+            reqs = Requirements.from_pod(pod, required_only=required_only)
+            resources = resutil.pod_requests(pod)
+            tols = tuple(sorted(pod.spec.tolerations, key=repr))
+            signature = (
+                repr(reqs),
+                tols,
+                tuple(sorted(resources.items())),
+            )
+            hit = (signature, reqs, tols, resources)
+            parsed[raw] = hit
+        signature, reqs, tols, resources = hit
         group = groups.get(signature)
         if group is None:
             group = PodGroup(requirements=reqs, tolerations=tols, resources=resources)
@@ -104,6 +130,10 @@ class ConfigInfo:
     existing_index: int = -1          # >=0 for pseudo-configs
     requirements: Requirements = field(default_factory=Requirements)
     taints: tuple[Taint, ...] = ()
+    # After column dedupe, every member (price, ConfigInfo) this column
+    # represents — identical (pool, allocatable, compat column) configs
+    # collapse to one device column and re-expand at decode.
+    alts: list = field(default_factory=list)
 
 
 @dataclass
@@ -252,6 +282,40 @@ def encode(
             if pname in pool_order:
                 for ri, key in enumerate(keys):
                     pool_overhead[pool_order[pname], ri] = overhead.get(key, 0.0)
+
+    # Column dedupe: launchable configs with identical (pool,
+    # allocatable, compat column) are indistinguishable to the packer —
+    # e.g. the same instance type's spot/on-demand offerings when no pod
+    # constrains capacity-type. Collapse them to one column carrying the
+    # min price; decode re-expands members into the offering list. This
+    # typically halves C on the kwok catalog (3 zones x 2 capacity
+    # types) and cuts device time proportionally.
+    keep: list[int] = []
+    by_key: dict[tuple, int] = {}
+    for ci, cfg in enumerate(configs):
+        if cfg.existing_index >= 0:
+            keep.append(ci)
+            continue
+        key = (
+            int(cfg_pool[ci]),
+            cfg_alloc[ci].tobytes(),
+            compat[:, ci].tobytes(),
+        )
+        rep = by_key.get(key)
+        if rep is None:
+            by_key[key] = ci
+            cfg.alts = [(float(cfg_price[ci]), cfg)]
+            keep.append(ci)
+        else:
+            configs[rep].alts.append((float(cfg_price[ci]), cfg))
+            if cfg_price[ci] < cfg_price[rep]:
+                cfg_price[rep] = cfg_price[ci]
+    if len(keep) < len(configs):
+        configs = [configs[i] for i in keep]
+        compat = np.ascontiguousarray(compat[:, keep])
+        cfg_alloc = np.ascontiguousarray(cfg_alloc[keep])
+        cfg_price = np.ascontiguousarray(cfg_price[keep])
+        cfg_pool = np.ascontiguousarray(cfg_pool[keep])
 
     return Encoded(
         resource_keys=keys,

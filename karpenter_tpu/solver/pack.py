@@ -57,7 +57,7 @@ class PackResult:
     unschedulable: np.ndarray  # [G] int32 pods that found no placement
 
 
-@functools.partial(jax.jit, static_argnames=("max_nodes",))
+@functools.partial(jax.jit, static_argnames=("max_nodes", "mode"))
 def pack(
     compat: jnp.ndarray,       # [G, C] bool
     group_req: jnp.ndarray,    # [G, R] f32
@@ -67,7 +67,10 @@ def pack(
     pool_overhead: jnp.ndarray,  # [P+1, R] f32
     existing_mask: jnp.ndarray,  # [E, C] bool one-hot pseudo-config rows
     existing_used: jnp.ndarray,  # [E, R] f32
+    cfg_price: jnp.ndarray,    # [C] f32 (0 for pseudo-configs)
     max_nodes: int,
+    mode: str = "ffd",
+    quota: jnp.ndarray | None = None,  # [N, G] i32 per-node group caps
 ):
     G, C = compat.shape
     R = group_req.shape[1]
@@ -115,6 +118,10 @@ def pack(
         ok = node_mask & row[None, :] & (kmat >= 1)
         kmat = kmat * ok
         k = kmat.max(axis=1)
+        if quota is not None:
+            # LP-planned nodes cap each group's take so complementary
+            # resource shapes can share the node (see lp_plan).
+            k = jnp.minimum(k, quota[:, g])
         prefix = jnp.cumsum(k) - k
         take = jnp.clip(remaining - prefix, 0, k)
         touched = take > 0
@@ -135,7 +142,16 @@ def pack(
             mask = fresh_ok & (cfg_pool == chosen_pool)
             overhead = pool_overhead[chosen_pool]
             kf = capacity(overhead, req) * mask
-            m_star = jnp.maximum(jnp.max(kf), 1)
+            if mode == "cost":
+                # Price-aware open: pick the config minimizing $/pod
+                # (lowest index on ties) instead of max capacity — the
+                # batched analogue of launching the cheapest adequate
+                # instance rather than the biggest compatible one.
+                ppp = jnp.where(kf >= 1, cfg_price / jnp.maximum(kf, 1), BIG)
+                c_star = jnp.argmin(ppp)
+                m_star = jnp.maximum(kf[c_star], 1)
+            else:
+                m_star = jnp.maximum(jnp.max(kf), 1)
             q = jnp.minimum((remaining + m_star - 1) // m_star, N - node_count)
             rem_last = jnp.minimum(m_star, remaining - (q - 1) * m_star)
             idx = jnp.arange(N, dtype=jnp.int32)
@@ -203,7 +219,9 @@ def _estimate_nodes(enc: Encoded) -> int:
     return total
 
 
-def solve_packing(enc: Encoded, max_nodes: int = 0) -> PackResult:
+def solve_packing(
+    enc: Encoded, max_nodes: int = 0, mode: str = "ffd", plan=None
+) -> PackResult:
     """Host entry: run the packing kernel on the encoded problem.
 
     With `max_nodes` unset, the node axis is sized from a per-group
@@ -212,23 +230,49 @@ def solve_packing(enc: Encoded, max_nodes: int = 0) -> PackResult:
     per-iteration N x C work tight instead of worst-casing N at the
     pod count. An explicit `max_nodes` is honored as a hard cap
     (excess pods report unschedulable).
+
+    With a `plan` (lp_plan.FleetPlan), the planned nodes are pre-opened
+    as reserved slots pointing at their launch config column, each with
+    the LP's per-node group quotas; the fresh-node path only handles
+    rounding spill.
     """
     G, C = enc.compat.shape
     E = enc.n_existing
-    existing_mask = np.zeros((E, C), dtype=bool)
+    n_planned = len(plan.planned_cols) if plan is not None else 0
+    reserved = E + n_planned
+    existing_mask = np.zeros((reserved, C), dtype=bool)
     for ci, cfg in enumerate(enc.configs):
         if cfg.existing_index >= 0:
             existing_mask[cfg.existing_index, ci] = True
+    existing_used = enc.existing_used
+    quota = None
+    if plan is not None:
+        existing_mask[E + np.arange(n_planned), plan.planned_cols] = True
+        planned_used = enc.pool_overhead[enc.cfg_pool[plan.planned_cols]]
+        existing_used = np.concatenate([enc.existing_used, planned_used], axis=0)
+        quota = np.concatenate(
+            [
+                np.full((E, G), np.iinfo(np.int32).max, np.int32),
+                plan.planned_quota,
+            ],
+            axis=0,
+        )
 
     if max_nodes > 0:
-        return _run_pack(enc, existing_mask, max_nodes)
+        return _run_pack(enc, existing_mask, existing_used, max_nodes, mode, quota)
 
     estimate = _estimate_nodes(enc)
-    max_nodes = E + max(32, int(1.35 * estimate) + 16)
-    max_nodes = _bucket(min(max_nodes, E + max(64, int(enc.group_count.sum()))))
-    worst_case = E + int(enc.group_count.sum())
+    if plan is not None:
+        # LP covered the bulk; fresh axis only absorbs rounding spill.
+        max_nodes = _bucket(reserved + max(32, estimate // 8 + 8))
+    else:
+        max_nodes = reserved + max(32, int(1.35 * estimate) + 16)
+        max_nodes = _bucket(
+            min(max_nodes, reserved + max(64, int(enc.group_count.sum())))
+        )
+    worst_case = reserved + int(enc.group_count.sum())
     while True:
-        result = _run_pack(enc, existing_mask, max_nodes)
+        result = _run_pack(enc, existing_mask, existing_used, max_nodes, mode, quota)
         capped = (
             result.node_count >= max_nodes and result.unschedulable.sum() > 0
         )
@@ -247,7 +291,21 @@ def _bucket(n: int) -> int:
     return out
 
 
-def _run_pack(enc: Encoded, existing_mask: np.ndarray, max_nodes: int) -> PackResult:
+def _run_pack(
+    enc: Encoded,
+    existing_mask: np.ndarray,
+    existing_used: np.ndarray,
+    max_nodes: int,
+    mode: str = "ffd",
+    quota: np.ndarray | None = None,
+) -> PackResult:
+    quota_full = None
+    if quota is not None:
+        quota_full = np.full(
+            (max_nodes, quota.shape[1]), np.iinfo(np.int32).max, np.int32
+        )
+        quota_full[: quota.shape[0]] = quota
+        quota_full = jnp.asarray(quota_full)
     assign, node_mask, node_used, node_active, node_count, unsched = pack(
         jnp.asarray(enc.compat),
         jnp.asarray(enc.group_req),
@@ -256,8 +314,11 @@ def _run_pack(enc: Encoded, existing_mask: np.ndarray, max_nodes: int) -> PackRe
         jnp.asarray(enc.cfg_pool),
         jnp.asarray(enc.pool_overhead),
         jnp.asarray(existing_mask),
-        jnp.asarray(enc.existing_used),
+        jnp.asarray(existing_used),
+        jnp.asarray(enc.cfg_price),
         max_nodes=max_nodes,
+        mode=mode,
+        quota=quota_full,
     )
     return PackResult(
         assign=np.asarray(assign),

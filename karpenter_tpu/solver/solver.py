@@ -68,13 +68,16 @@ def solve(
     daemon_overhead: Optional[dict[str, dict[str, float]]] = None,
     required_only: bool = False,
     backend: Optional[str] = None,
+    objective: str = "ffd",
 ) -> Solution:
     groups = group_pods(pods, required_only=required_only)
     enc = encode(groups, pools_with_types, existing, daemon_overhead)
-    return solve_encoded(enc, backend=backend)
+    return solve_encoded(enc, backend=backend, objective=objective)
 
 
-def solve_encoded(enc: Encoded, backend: Optional[str] = None) -> Solution:
+def solve_encoded(
+    enc: Encoded, backend: Optional[str] = None, objective: str = "ffd"
+) -> Solution:
     G, C = enc.compat.shape
     if G == 0 or C == 0:
         return Solution(
@@ -85,14 +88,21 @@ def solve_encoded(enc: Encoded, backend: Optional[str] = None) -> Solution:
     backend = backend or _backend()
     if backend == "host":
         return _decode_host(enc)
-    return _decode_device(enc)
+    return _decode_device(enc, objective)
 
 
-def _decode_device(enc: Encoded) -> Solution:
+def _decode_device(enc: Encoded, objective: str = "ffd") -> Solution:
     from karpenter_tpu.solver.pack import solve_packing
 
-    result = solve_packing(enc)
+    plan = None
+    if objective == "cost":
+        from karpenter_tpu.solver import lp_plan
+
+        plan = lp_plan.plan(enc)
+    result = solve_packing(enc, mode=objective, plan=plan)
     node_masks = result.node_mask
+    if objective == "cost":
+        node_masks = _downsize_masks(enc, result)
     node_assign = result.assign
     return _build_solution(
         enc,
@@ -103,6 +113,41 @@ def _decode_device(enc: Encoded) -> Solution:
         ],
         {g: int(c) for g, c in enumerate(result.unschedulable) if c > 0},
     )
+
+
+def _downsize_masks(enc: Encoded, result) -> np.ndarray:
+    """Re-widen each planned/fresh node's config mask to every same-pool
+    config that fits its *final* fill, so decode can pick a smaller,
+    cheaper machine for underfilled nodes. The kernel's mask only ever
+    tightens during placement (reference semantics: the in-flight
+    NodeClaim filters its instance-type options, nodeclaim.go:373-447);
+    once placement is final, any config compatible with all resident
+    pods and large enough is a valid — possibly cheaper — launch choice.
+    """
+    masks = result.node_mask.copy()
+    launch = enc.cfg_pool >= 0
+    for ni in range(result.node_count):
+        if not result.node_active[ni]:
+            continue
+        row = masks[ni]
+        cols = np.flatnonzero(row)
+        if cols.size == 0:
+            continue
+        first = enc.configs[cols[0]]
+        if first.existing_index >= 0:
+            continue  # real existing node, nothing to resize
+        pool = enc.cfg_pool[cols[0]]
+        groups_on = np.flatnonzero(result.assign[ni] > 0)
+        if groups_on.size == 0:
+            continue
+        fits = np.all(
+            enc.cfg_alloc + 1e-4 >= result.node_used[ni][None, :], axis=1
+        )
+        compat_all = enc.compat[groups_on].all(axis=0)
+        wide = launch & (enc.cfg_pool == pool) & fits & compat_all
+        if wide.any():
+            masks[ni] = wide
+    return masks
 
 
 def _decode_host(enc: Encoded) -> Solution:
@@ -144,20 +189,24 @@ def _build_solution(
             for gi, count in assignment.items():
                 slot.pods.extend(take_pods(gi, count))
             continue
-        pairs = sorted(
-            ((enc.cfg_price[ci], ci) for ci in config_ids), key=lambda t: (t[0], t[1])
-        )
+        members: list[tuple[float, int, "object"]] = []
+        for ci in config_ids:
+            cfg = enc.configs[ci]
+            if cfg.alts:
+                members.extend((price, ci, m) for price, m in cfg.alts)
+            else:
+                members.append((float(enc.cfg_price[ci]), ci, cfg))
+        members.sort(key=lambda t: (t[0], t[1]))
         seen_types: dict[str, InstanceType] = {}
         offerings: list[Offering] = []
-        for _, ci in pairs:
-            cfg = enc.configs[ci]
+        for _, _, cfg in members:
             seen_types.setdefault(cfg.instance_type.name, cfg.instance_type)
             offerings.append(cfg.offering)
         plan = NodePlan(
             pool=first_cfg.pool,
             instance_types=list(seen_types.values()),
             offerings=offerings,
-            price=pairs[0][0],
+            price=members[0][0],
         )
         for gi, count in assignment.items():
             plan.pods.extend(take_pods(gi, count))

@@ -225,3 +225,83 @@ class TestDeviceHostParity:
 
         assert shape(device) == shape(host)
         assert abs(device.total_price - host.total_price) < 1e-6
+
+
+class TestCostObjective:
+    """objective="cost": column-generation fleet planning (lp_plan)."""
+
+    def _diverse_problem(self, n=400, seed=3):
+        rng = np.random.default_rng(seed)
+        types = instance_types(96)
+        pools = [(make_pool("default"), types)]
+        shapes = [(0.25, 0.5), (1.0, 2.0), (4.0, 1.0), (0.5, 8.0), (1.0, 16.0)]
+        pods = []
+        for i in range(n):
+            cpu, mem_gib = shapes[int(rng.integers(len(shapes)))]
+            selector = {}
+            if rng.random() < 0.2:
+                selector["kubernetes.io/arch"] = str(rng.choice(["amd64", "arm64"]))
+            pods.append(
+                make_pod(f"p{i}", cpu=cpu, mem=mem_gib * GIB, node_selector=selector)
+            )
+        return pods, pools
+
+    def test_cost_schedules_everything(self):
+        pods, pools = self._diverse_problem()
+        sol = solve(pods, pools, objective="cost")
+        assert not sol.unschedulable
+        assert sum(len(n.pods) for n in sol.new_nodes) == len(pods)
+
+    def test_cost_never_oversubscribes(self):
+        from karpenter_tpu.utils import resources as resutil
+
+        pods, pools = self._diverse_problem()
+        sol = solve(pods, pools, objective="cost")
+        for node in sol.new_nodes:
+            used = {}
+            for pod in node.pods:
+                for key, val in resutil.pod_requests(pod).items():
+                    used[key] = used.get(key, 0.0) + val
+            it = node.instance_types[0]
+            for key, val in used.items():
+                assert val <= it.allocatable.get(key, 0.0) + 1e-3, (
+                    it.name,
+                    key,
+                    val,
+                )
+
+    def test_cost_respects_selectors(self):
+        pods, pools = self._diverse_problem()
+        sol = solve(pods, pools, objective="cost")
+        for node in sol.new_nodes:
+            archs = {
+                p.spec.node_selector.get("kubernetes.io/arch")
+                for p in node.pods
+                if p.spec.node_selector
+            }
+            archs.discard(None)
+            if archs:
+                # node's instance types must all carry a compatible arch
+                for it in node.instance_types:
+                    it_arch = it.requirements.get("kubernetes.io/arch").values
+                    assert archs <= set(it_arch)
+
+    def test_cost_not_worse_than_ffd_on_mixed_shapes(self):
+        pods, pools = self._diverse_problem(n=600, seed=11)
+        ffd = solve(pods, pools, objective="ffd")
+        cost = solve(pods, pools, objective="cost")
+        assert not cost.unschedulable
+        # cost mode must never be meaningfully worse than the greedy
+        assert cost.total_price <= ffd.total_price * 1.02
+
+    def test_lp_bound_is_certificate(self):
+        from karpenter_tpu.solver import lp_plan
+        from karpenter_tpu.solver.encode import encode, group_pods
+
+        pods, pools = self._diverse_problem(n=300, seed=5)
+        enc = encode(group_pods(pods), pools)
+        p = lp_plan.plan(enc)
+        assert p is not None
+        cost = solve(pods, pools, objective="cost")
+        # realized integral fleet can't beat the LP lower bound
+        assert cost.total_price >= p.lower_bound - 1e-6
