@@ -78,19 +78,22 @@ def group_pods(pods: Sequence[Pod], required_only: bool = False) -> list[PodGrou
     parsed: dict[tuple, tuple] = {}
     for pod in pods:
         spec = pod.spec
+        # Cheap hashable key over the scheduling-relevant raw spec;
+        # frozensets avoid per-pod sorts (Toleration is a frozen
+        # dataclass, so the tuple hashes directly).
         raw = (
-            tuple(sorted(spec.node_selector.items())),
-            repr(spec.affinity) if spec.affinity is not None else "",
-            tuple(repr(t) for t in spec.tolerations),
+            frozenset(spec.node_selector.items()) if spec.node_selector else None,
+            repr(spec.affinity) if spec.affinity is not None else None,
+            tuple(spec.tolerations) if spec.tolerations else None,
             tuple(
-                tuple(sorted(c.requests.items()))
+                frozenset(c.requests.items())
                 for c in spec.containers
             ),
             tuple(
-                tuple(sorted(c.requests.items()))
+                frozenset(c.requests.items())
                 for c in spec.init_containers
-            ),
-            tuple(sorted(spec.overhead.items())),
+            ) if spec.init_containers else None,
+            frozenset(spec.overhead.items()) if spec.overhead else None,
         )
         hit = parsed.get(raw)
         if hit is None:

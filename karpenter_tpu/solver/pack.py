@@ -199,6 +199,30 @@ def pack(
     return assign, node_mask, node_used, node_active, node_count, unsched
 
 
+@functools.partial(jax.jit, static_argnames=("max_nodes", "mode"))
+def pack_flat(*args, max_nodes: int, mode: str = "ffd", quota=None):
+    """`pack` with every output concatenated into ONE float32 vector.
+
+    The remote-device transport charges a fixed latency per
+    device-to-host fetch of a fresh array (~70ms through the axon
+    tunnel); fusing the six outputs into one buffer makes each solve
+    pay that latency exactly once.
+    """
+    assign, node_mask, node_used, node_active, node_count, unsched = pack(
+        *args, max_nodes=max_nodes, mode=mode, quota=quota
+    )
+    return jnp.concatenate(
+        [
+            assign.astype(jnp.float32).ravel(),
+            node_mask.astype(jnp.float32).ravel(),
+            node_used.ravel(),
+            node_active.astype(jnp.float32).ravel(),
+            jnp.asarray([node_count], jnp.float32),
+            unsched.astype(jnp.float32).ravel(),
+        ]
+    )
+
+
 def _estimate_nodes(enc: Encoded) -> int:
     """Lower bound on fresh nodes: per group, count / best-config
     capacity, summed. The packer retries with a larger axis if the
@@ -306,7 +330,7 @@ def _run_pack(
         )
         quota_full[: quota.shape[0]] = quota
         quota_full = jnp.asarray(quota_full)
-    assign, node_mask, node_used, node_active, node_count, unsched = pack(
+    flat = pack_flat(
         jnp.asarray(enc.compat),
         jnp.asarray(enc.group_req),
         jnp.asarray(enc.group_count),
@@ -320,11 +344,22 @@ def _run_pack(
         mode=mode,
         quota=quota_full,
     )
+    flat = np.asarray(flat)  # the one device->host fetch
+    G, C = enc.compat.shape
+    R = enc.group_req.shape[1]
+    N = max_nodes
+    o0, o1, o2, o3, o4 = (
+        N * G,
+        N * G + N * C,
+        N * G + N * C + N * R,
+        N * G + N * C + N * R + N,
+        N * G + N * C + N * R + N + 1,
+    )
     return PackResult(
-        assign=np.asarray(assign),
-        node_mask=np.asarray(node_mask),
-        node_used=np.asarray(node_used),
-        node_active=np.asarray(node_active),
-        node_count=int(node_count),
-        unschedulable=np.asarray(unsched),
+        assign=flat[:o0].reshape(N, G).astype(np.int32),
+        node_mask=flat[o0:o1].reshape(N, C) > 0.5,
+        node_used=flat[o1:o2].reshape(N, R),
+        node_active=flat[o2:o3] > 0.5,
+        node_count=int(flat[o3]),
+        unschedulable=flat[o4:].astype(np.int32),
     )

@@ -28,16 +28,61 @@ from karpenter_tpu.solver.encode import (
 )
 
 
-@dataclass
 class NodePlan:
-    """One planned (new) node."""
+    """One planned (new) node.
 
-    pool: NodePool
-    instance_types: list[InstanceType]      # price-ordered options
-    offerings: list[Offering]               # feasible offerings (cheapest first)
-    pods: list[Pod] = field(default_factory=list)
-    price: float = 0.0                      # cheapest feasible offering
-    claim_name: str = ""                    # set once a NodeClaim is created
+    `instance_types` (price-ordered options) and `offerings` (feasible,
+    cheapest first) materialize lazily from the solver's config mask:
+    a 50k-pod solve plans thousands of nodes but only the ones that
+    become NodeClaims ever need their full option lists expanded.
+    Both attributes remain assignable (the scheduler truncates them,
+    consolidation filters them)."""
+
+    def __init__(
+        self,
+        pool: NodePool,
+        instance_types: Optional[list[InstanceType]] = None,
+        offerings: Optional[list[Offering]] = None,
+        pods: Optional[list[Pod]] = None,
+        price: float = 0.0,
+        claim_name: str = "",
+        lazy=None,
+    ):
+        self.pool = pool
+        self._instance_types = instance_types
+        self._offerings = offerings
+        self._lazy = lazy
+        self.pods: list[Pod] = pods if pods is not None else []
+        self.price = price
+        self.claim_name = claim_name
+
+    def _materialize(self) -> None:
+        its, offs = self._lazy()
+        if self._instance_types is None:
+            self._instance_types = its
+        if self._offerings is None:
+            self._offerings = offs
+        self._lazy = None
+
+    @property
+    def instance_types(self) -> list[InstanceType]:
+        if self._instance_types is None and self._lazy is not None:
+            self._materialize()
+        return self._instance_types if self._instance_types is not None else []
+
+    @instance_types.setter
+    def instance_types(self, value: list[InstanceType]) -> None:
+        self._instance_types = value
+
+    @property
+    def offerings(self) -> list[Offering]:
+        if self._offerings is None and self._lazy is not None:
+            self._materialize()
+        return self._offerings if self._offerings is not None else []
+
+    @offerings.setter
+    def offerings(self, value: list[Offering]) -> None:
+        self._offerings = value
 
 
 @dataclass
@@ -94,24 +139,51 @@ def solve_encoded(
 def _decode_device(enc: Encoded, objective: str = "ffd") -> Solution:
     from karpenter_tpu.solver.pack import solve_packing
 
-    plan = None
-    if objective == "cost":
-        from karpenter_tpu.solver import lp_plan
+    if objective != "cost":
+        result = solve_packing(enc, mode=objective)
+        return _build_solution_arrays(
+            enc,
+            np.flatnonzero(result.node_active[: result.node_count]),
+            result.node_mask,
+            result.assign,
+            result.unschedulable,
+        )
 
-        plan = lp_plan.plan(enc)
-    result = solve_packing(enc, mode=objective, plan=plan)
-    node_masks = result.node_mask
-    if objective == "cost":
-        node_masks = _downsize_masks(enc, result)
-    node_assign = result.assign
-    return _build_solution(
+    # Cost objective: LP-planned packing raced against plain FFD; the
+    # cheaper fleet wins (fewer unschedulable pods first). FFD is thus
+    # a floor — the planner can only ever improve on the greedy
+    # heuristic, never regress it (the LP's restricted pattern set can
+    # be weak on small or degenerate demands).
+    from karpenter_tpu.solver import lp_plan
+
+    plan = lp_plan.plan(enc)
+    candidates = []
+    ffd_result = solve_packing(enc, mode="ffd")
+    candidates.append((ffd_result, _downsize_masks(enc, ffd_result)))
+    if plan is not None:
+        cost_result = solve_packing(enc, mode="cost", plan=plan)
+        candidates.append((cost_result, _downsize_masks(enc, cost_result)))
+
+    def key(item):
+        # Only nodes that actually hold pods count: pre-opened planned
+        # slots the packer never filled are skipped by decode, so they
+        # must not bias the race either.
+        result, masks = item
+        act = np.flatnonzero(
+            result.node_active[: result.node_count]
+            & (result.assign[: result.node_count].sum(axis=1) > 0)
+        )
+        prices = np.where(masks[act], enc.cfg_price[None, :], np.inf).min(axis=1)
+        fleet = float(np.where(np.isfinite(prices), prices, 0.0).sum())
+        return (int(result.unschedulable.sum()), fleet, len(act))
+
+    result, masks = min(candidates, key=key)
+    return _build_solution_arrays(
         enc,
-        [
-            (ni, node_masks[ni], {g: int(c) for g, c in enumerate(node_assign[ni]) if c > 0})
-            for ni in range(result.node_count)
-            if result.node_active[ni]
-        ],
-        {g: int(c) for g, c in enumerate(result.unschedulable) if c > 0},
+        np.flatnonzero(result.node_active[: result.node_count]),
+        masks,
+        result.assign,
+        result.unschedulable,
     )
 
 
@@ -154,69 +226,98 @@ def _decode_host(enc: Encoded) -> Solution:
     from karpenter_tpu.solver.reference_ffd import solve_ffd_host
 
     nodes, unsched = solve_ffd_host(enc)
-    return _build_solution(
-        enc,
-        [(ni, node.mask, node.assign) for ni, node in enumerate(nodes)],
-        unsched,
-    )
+    G = enc.compat.shape[0]
+    n = len(nodes)
+    masks = np.zeros((n, enc.compat.shape[1]), bool)
+    assign = np.zeros((n, G), np.int32)
+    for ni, node in enumerate(nodes):
+        masks[ni] = node.mask
+        for gi, count in node.assign.items():
+            assign[ni, gi] = count
+    unsched_arr = np.zeros(G, np.int32)
+    for gi, count in unsched.items():
+        unsched_arr[gi] = count
+    return _build_solution_arrays(enc, np.arange(n), masks, assign, unsched_arr)
 
 
-def _build_solution(
-    enc: Encoded,
-    node_rows: list[tuple[int, np.ndarray, dict[int, int]]],
-    unsched: dict[int, int],
-) -> Solution:
-    new_nodes: list[NodePlan] = []
-    existing: dict[int, ExistingAssignment] = {}
-    group_cursor = [0] * len(enc.groups)
+def _node_options(enc: Encoded, mask: np.ndarray):
+    """Closure for NodePlan's lazy (instance_types, offerings): expand
+    the config mask's members cheapest-first. Captures only the masked
+    ConfigInfo slice (not the Encoded) so a surviving NodePlan doesn't
+    pin the solver's dense arrays and all pod groups in memory."""
+    cols = np.flatnonzero(mask)
+    configs = enc.configs          # list ref only: no dense arrays, no pods
+    prices = enc.cfg_price[cols].tolist()
 
-    def take_pods(gi: int, count: int) -> list[Pod]:
-        start = group_cursor[gi]
-        group_cursor[gi] += count
-        return enc.groups[gi].pods[start : start + count]
-
-    for ni, mask, assignment in node_rows:
-        if not assignment:
-            continue
-        config_ids = np.flatnonzero(mask)
-        if config_ids.size == 0:
-            continue
-        first_cfg = enc.configs[config_ids[0]]
-        if first_cfg.existing_index >= 0:
-            slot = existing.setdefault(
-                first_cfg.existing_index, ExistingAssignment(first_cfg.existing_index)
-            )
-            for gi, count in assignment.items():
-                slot.pods.extend(take_pods(gi, count))
-            continue
-        members: list[tuple[float, int, "object"]] = []
-        for ci in config_ids:
-            cfg = enc.configs[ci]
+    def thunk():
+        members: list[tuple[float, int, object]] = []
+        for ci, price in zip(cols.tolist(), prices):
+            cfg = configs[ci]
             if cfg.alts:
-                members.extend((price, ci, m) for price, m in cfg.alts)
+                members.extend((p, ci, m) for p, m in cfg.alts)
             else:
-                members.append((float(enc.cfg_price[ci]), ci, cfg))
+                members.append((price, ci, cfg))
         members.sort(key=lambda t: (t[0], t[1]))
         seen_types: dict[str, InstanceType] = {}
         offerings: list[Offering] = []
         for _, _, cfg in members:
             seen_types.setdefault(cfg.instance_type.name, cfg.instance_type)
             offerings.append(cfg.offering)
-        plan = NodePlan(
-            pool=first_cfg.pool,
-            instance_types=list(seen_types.values()),
-            offerings=offerings,
-            price=members[0][0],
+        return list(seen_types.values()), offerings
+
+    return thunk
+
+
+def _build_solution_arrays(
+    enc: Encoded,
+    active_idx: np.ndarray,    # node rows with pods
+    node_masks: np.ndarray,    # [N, C] bool
+    assign: np.ndarray,        # [N, G] int
+    unsched: np.ndarray,       # [G] int
+) -> Solution:
+    """Vectorized decode: per-node price/first-config via one masked
+    reduction each; option lists stay lazy (see NodePlan)."""
+    new_nodes: list[NodePlan] = []
+    existing: dict[int, ExistingAssignment] = {}
+    group_cursor = np.zeros(len(enc.groups), np.int64)
+
+    sub_mask = node_masks[active_idx]
+    price_mat = np.where(sub_mask, enc.cfg_price[None, :], np.inf)
+    node_price = price_mat.min(axis=1)
+    first_col = sub_mask.argmax(axis=1)
+    any_col = sub_mask.any(axis=1)
+
+    for row, ni in enumerate(active_idx):
+        gs = np.nonzero(assign[ni])[0]
+        if gs.size == 0 or not any_col[row]:
+            continue
+        pods: list[Pod] = []
+        for gi in gs:
+            count = int(assign[ni, gi])
+            start = int(group_cursor[gi])
+            group_cursor[gi] += count
+            pods.extend(enc.groups[gi].pods[start : start + count])
+        first_cfg = enc.configs[int(first_col[row])]
+        if first_cfg.existing_index >= 0:
+            slot = existing.setdefault(
+                first_cfg.existing_index, ExistingAssignment(first_cfg.existing_index)
+            )
+            slot.pods.extend(pods)
+            continue
+        new_nodes.append(
+            NodePlan(
+                pool=first_cfg.pool,
+                price=float(node_price[row]),
+                pods=pods,
+                lazy=_node_options(enc, sub_mask[row]),
+            )
         )
-        for gi, count in assignment.items():
-            plan.pods.extend(take_pods(gi, count))
-        new_nodes.append(plan)
 
     unschedulable: list[Pod] = []
-    for gi, count in unsched.items():
+    for gi in np.nonzero(unsched)[0]:
         # unplaced pods are the tail of the group after placements
         group = enc.groups[gi]
-        unschedulable.extend(group.pods[len(group.pods) - count :])
+        unschedulable.extend(group.pods[len(group.pods) - int(unsched[gi]) :])
     return Solution(
         new_nodes=new_nodes,
         existing=sorted(existing.values(), key=lambda e: e.existing_index),
