@@ -170,7 +170,12 @@ class Store:
             self.gauge.delete(labels)
 
     def replace_all(self, series_by_key: dict[str, list[tuple[dict[str, str], float]]]) -> None:
-        for stale in set(self._published) - set(series_by_key):
-            self.delete(stale)
+        self.prune(set(series_by_key))
         for key, series in series_by_key.items():
             self.update(key, series)
+
+    def prune(self, live_keys: set[str]) -> None:
+        """Drop series for objects no longer live (the ReplaceAll
+        half-step for controllers that Update incrementally)."""
+        for stale in set(self._published) - live_keys:
+            self.delete(stale)

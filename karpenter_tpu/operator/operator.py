@@ -40,6 +40,11 @@ from karpenter_tpu.lifecycle.hygiene import (
 )
 from karpenter_tpu.lifecycle.nodeclaim_lifecycle import NodeClaimLifecycle
 from karpenter_tpu.lifecycle.termination import TerminationController
+from karpenter_tpu.metrics.controllers import (
+    NodeMetricsController,
+    NodePoolMetricsController,
+    PodMetricsController,
+)
 from karpenter_tpu.operator.options import Options
 from karpenter_tpu.provisioning.provisioner import Provisioner
 from karpenter_tpu.provisioning.static import StaticCapacityController
@@ -87,9 +92,13 @@ class Operator:
             self.kube, self.cluster, health=self.health
         )
         self.static = StaticCapacityController(self.kube, self.cluster, self.options)
+        self.pod_metrics = PodMetricsController(self.kube, self.cluster)
+        self.node_metrics = NodeMetricsController(self.kube, self.cluster)
+        self.nodepool_metrics = NodePoolMetricsController(self.kube, self.cluster)
 
         self._last_disruption = 0.0
         self._last_gc = 0.0
+        self._last_metrics = 0.0
         # plans whose pods await binding (the kube-scheduler's job in a
         # real cluster; this runtime owns the whole substrate, so it
         # binds pods to the nodes the solver placed them on)
@@ -141,6 +150,11 @@ class Operator:
             self._last_gc = now
             self.gc.reconcile(now=now)
         self.consistency.reconcile_all(now=now)
+        if now - self._last_metrics >= self.options.metrics_interval_seconds:
+            self._last_metrics = now
+            self.pod_metrics.reconcile_all(now=now)
+            self.node_metrics.reconcile_all(now=now)
+            self.nodepool_metrics.reconcile_all(now=now)
 
     def _bind_pending(self) -> None:
         """Bind pods from completed scheduling results to their target

@@ -54,6 +54,7 @@ class Options:
     log_level: str = "info"
     cluster_name: str = ""
     disruption_poll_seconds: float = 10.0  # disruption/controller.go:69
+    metrics_interval_seconds: float = 10.0  # object-gauge republish cadence
 
 
 DEFAULT_OPTIONS = Options()

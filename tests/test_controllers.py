@@ -273,3 +273,91 @@ class TestOperatorRuntime:
         kube.create(NodeOverlay(spec=NodeOverlaySpec(price="0.01")))
         out = op.provider.get_instance_types(None)
         assert all(o.price == 0.01 for it in out for o in it.offerings)
+
+
+class TestMetricsControllers:
+    """metrics/{pod,node,nodepool} gauge republishing + latency
+    histograms (controllers/metrics/pod/controller.go and siblings)."""
+
+    def _operator_env(self):
+        from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
+        from karpenter_tpu.kube.client import KubeClient
+
+        kube = KubeClient()
+        cloud = KwokCloudProvider(kube, types=types())
+        return Operator(kube, cloud)
+
+    def test_pod_node_nodepool_series(self):
+        op = self._operator_env()
+        pool = mk_nodepool("pools")
+        pool.spec.limits = {"cpu": 100.0}
+        pool.spec.weight = 7
+        op.kube.create(pool)
+        now = time.time()
+        for i in range(3):
+            op.kube.create(mk_pod(name=f"m-{i}", cpu=1.0))
+        for _ in range(4):
+            now += 2
+            op.step(now=now)
+        op.pod_metrics.reconcile_all()
+        op.node_metrics.reconcile_all()
+        op.nodepool_metrics.reconcile_all()
+        from karpenter_tpu.metrics.controllers import (
+            NODEPOOL_LIMIT,
+            NODEPOOL_NODE_COUNT,
+            NODEPOOL_WEIGHT,
+            NODES_ALLOCATABLE,
+            PODS_STATE,
+        )
+        # one series per pod, bound to a node (the registry is global,
+        # so only look at this test's pods)
+        mine = [k for k in PODS_STATE.series() if dict(k)["name"].startswith("m-")]
+        assert len(mine) == 3
+        assert all(
+            dict(k).get("node") for k in mine
+        ), "pods should be bound in their series labels"
+        assert NODEPOOL_LIMIT.value({"nodepool": "pools", "resource_type": "cpu"}) == 100.0
+        assert NODEPOOL_WEIGHT.value({"nodepool": "pools"}) == 7.0
+        assert NODEPOOL_NODE_COUNT.value({"nodepool": "pools"}) >= 1.0
+        assert any(
+            dict(k).get("resource_type") == "cpu" for k in NODES_ALLOCATABLE.series()
+        )
+
+    def test_series_dropped_when_objects_go(self):
+        op = self._operator_env()
+        op.kube.create(mk_nodepool("gone"))
+        op.kube.create(mk_pod(name="temp", cpu=0.5))
+        now = time.time()
+        for _ in range(4):
+            now += 2
+            op.step(now=now)
+        op.pod_metrics.reconcile_all()
+        from karpenter_tpu.metrics.controllers import PODS_STATE
+
+        assert len(PODS_STATE.series()) >= 1
+        for pod in op.kube.pods():
+            op.kube.delete(pod)
+        op.step(now=now + 2)
+        op.pod_metrics.reconcile_all()
+        assert all(
+            dict(k).get("name") != "temp" for k in PODS_STATE.series()
+        )
+
+    def test_latency_histograms_observe(self):
+        from karpenter_tpu.metrics.store import (
+            PODS_SCHEDULING_DURATION,
+            PODS_STARTUP_DURATION,
+        )
+
+        before_sched = PODS_SCHEDULING_DURATION.count()
+        before_start = PODS_STARTUP_DURATION.count()
+        op = self._operator_env()
+        op.kube.create(mk_nodepool("lat"))
+        op.kube.create(mk_pod(name="lat-pod", cpu=0.5))
+        now = time.time()
+        for _ in range(4):
+            now += 2
+            op.step(now=now)
+        op.pod_metrics.reconcile_all()
+        assert PODS_SCHEDULING_DURATION.count() > before_sched
+        assert PODS_STARTUP_DURATION.count() > before_start
