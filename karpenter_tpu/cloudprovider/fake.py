@@ -20,10 +20,12 @@ from karpenter_tpu.apis.v1.labels import (
     ARCH_LABEL,
     CAPACITY_TYPE_LABEL,
     CAPACITY_TYPE_ON_DEMAND,
+    CAPACITY_TYPE_RESERVED,
     CAPACITY_TYPE_SPOT,
     INSTANCE_TYPE_LABEL,
     NODEPOOL_LABEL,
     OS_LABEL,
+    RESERVATION_ID_LABEL,
     TOPOLOGY_ZONE_LABEL,
 )
 from karpenter_tpu.apis.v1.nodeclaim import (
@@ -72,7 +74,11 @@ def make_instance_type(
     extra_resources: Optional[ResourceList] = None,
     extra_labels: Optional[dict[str, str]] = None,
     offerings: Optional[Offerings] = None,
+    reservations: Optional[list[tuple[str, str, int]]] = None,
 ) -> InstanceType:
+    """`reservations`: list of (reservation_id, zone, instance_count) —
+    each becomes a reserved-capacity offering priced at ~0 (already
+    paid for), bounded by its instance count."""
     capacity: ResourceList = {CPU: cpu, MEMORY: memory, PODS: pods}
     if extra_resources:
         capacity.update(extra_resources)
@@ -94,6 +100,24 @@ def make_instance_type(
                         available=True,
                     )
                 )
+        for rid, zone, count in reservations or ():
+            offerings.append(
+                Offering(
+                    requirements=Requirements.from_labels(
+                        {
+                            CAPACITY_TYPE_LABEL: CAPACITY_TYPE_RESERVED,
+                            TOPOLOGY_ZONE_LABEL: zone,
+                            RESERVATION_ID_LABEL: rid,
+                        }
+                    ),
+                    # reserved capacity is prepaid: marginal launch
+                    # price is ~nothing (cloudprovider/types.go
+                    # AdjustedPrice treats reserved as ~free)
+                    price=base_price * 1e-4,
+                    available=True,
+                    reservation_capacity=count,
+                )
+            )
     reqs = Requirements(
         [
             Requirement(INSTANCE_TYPE_LABEL, IN, [name]),
@@ -218,6 +242,8 @@ class FakeCloudProvider(CloudProvider):
                 ARCH_LABEL: chosen.requirements.get(ARCH_LABEL).any_value(),
                 OS_LABEL: chosen.requirements.get(OS_LABEL).any_value(),
             }
+            if offering.reservation_id:
+                labels[RESERVATION_ID_LABEL] = offering.reservation_id
             if node_claim.metadata.labels.get(NODEPOOL_LABEL):
                 labels[NODEPOOL_LABEL] = node_claim.metadata.labels[NODEPOOL_LABEL]
             out = NodeClaim(

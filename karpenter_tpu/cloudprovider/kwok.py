@@ -23,6 +23,7 @@ from karpenter_tpu.apis.v1.labels import (
     INSTANCE_TYPE_LABEL,
     NODEPOOL_LABEL,
     OS_LABEL,
+    RESERVATION_ID_LABEL,
     TOPOLOGY_ZONE_LABEL,
     UNREGISTERED_NO_EXECUTE_TAINT,
 )
@@ -114,6 +115,8 @@ class KwokCloudProvider(CloudProvider):
                 ARCH_LABEL: chosen.requirements.get(ARCH_LABEL).any_value(),
                 OS_LABEL: chosen.requirements.get(OS_LABEL).any_value() or "linux",
             }
+            if offering.reservation_id:
+                labels[RESERVATION_ID_LABEL] = offering.reservation_id
             self._instances[provider_id] = _Instance(
                 claim_name=node_claim.metadata.name,
                 node_name=node_name,

@@ -72,7 +72,9 @@ class Operator:
         self.recorder = EventRecorder()
         self.health = HealthTracker()
 
-        self.provisioner = Provisioner(self.kube, self.cluster, provider)
+        self.provisioner = Provisioner(
+            self.kube, self.cluster, provider, options=self.options
+        )
         self.lifecycle = NodeClaimLifecycle(self.kube, provider, health=self.health)
         self.termination = TerminationController(self.kube, self.cluster)
         self.conditions = DisruptionConditionsController(

@@ -78,10 +78,12 @@ class Provisioner:
         kube: KubeClient,
         cluster: Cluster,
         cloud_provider: CloudProvider,
+        options=None,
     ):
         self.kube = kube
         self.cluster = cluster
         self.cloud_provider = cloud_provider
+        self.options = options
         self.batcher = Batcher()
 
     # -- pod intake (provisioner.go:172-195, utils/node) ----------------------
@@ -152,6 +154,10 @@ class Provisioner:
             state_nodes=self.cluster.deep_copy_nodes(),
             daemonsets=self.cluster.daemonsets(),
             cluster_pods=self.kube.pods(),
+            allow_reserved=(
+                self.options.feature_gates.reserved_capacity
+                if self.options is not None else True
+            ),
         )
         results = scheduler.solve(pods)
         self.cluster.mark_pod_scheduling_decisions(pods)
@@ -215,6 +221,17 @@ class Provisioner:
             requirements.append(
                 RequirementSpec(key="karpenter.sh/capacity-type", operator=IN,
                                 values=captypes)
+            )
+        # a reservation-pinned plan carries its reservation id so the
+        # provider launches into the reserved capacity
+        # (FinalizeScheduling, scheduling/nodeclaim.go:252)
+        rids = tuple(sorted({
+            o.reservation_id for o in plan.offerings if o.reservation_id
+        }))
+        if rids:
+            requirements.append(
+                RequirementSpec(key="karpenter.sh/reservation-id", operator=IN,
+                                values=rids)
             )
 
         name = f"{pool.metadata.name}-{next(_claim_counter):05d}"

@@ -27,6 +27,7 @@ from karpenter_tpu.apis.v1.labels import (
     CAPACITY_TYPE_LABEL,
     HOSTNAME_LABEL,
     NODEPOOL_LABEL,
+    RESERVATION_ID_LABEL,
     TOPOLOGY_ZONE_LABEL,
     WELL_KNOWN_LABELS,
 )
@@ -65,6 +66,23 @@ class SchedulerResults:
         )
 
 
+def _strip_reserved(it: InstanceType) -> InstanceType:
+    """Instance type without its reserved-capacity offerings."""
+    kept = [o for o in it.offerings if not o.is_reserved()]
+    if len(kept) == len(it.offerings):
+        return it
+    from karpenter_tpu.cloudprovider.types import Offerings
+
+    out = InstanceType(
+        name=it.name,
+        requirements=it.requirements,
+        offerings=Offerings(kept),
+        capacity=it.capacity,
+        overhead=it.overhead,
+    )
+    return out
+
+
 class Scheduler:
     def __init__(
         self,
@@ -73,7 +91,15 @@ class Scheduler:
         daemonsets: Sequence = (),
         cluster_pods: Sequence[Pod] = (),
         honor_preferences: bool = True,
+        allow_reserved: bool = True,
     ):
+        if not allow_reserved:
+            # ReservedCapacity gate off: reserved offerings never enter
+            # the solve (options.go feature gates)
+            pools_with_types = [
+                (pool, [_strip_reserved(it) for it in types])
+                for pool, types in pools_with_types
+            ]
         # weight order (provisioner.go:241-262)
         self.pools_with_types = sorted(
             pools_with_types, key=lambda pt: (-pt[0].spec.weight, pt[0].metadata.name)
@@ -88,6 +114,25 @@ class Scheduler:
         inflight.sort(key=lambda n: (len(n.pod_keys), n.name))
         self.state_nodes = live + inflight
         self.existing_inputs = [self._existing_input(n) for n in self.state_nodes]
+
+        # live reservation usage: nodes (incl. deleting — the instance
+        # is held until gone) already launched against a reservation id
+        # reduce how many more the solver may open
+        # (scheduling/reservationmanager.go:28-110)
+        self.reserved_in_use: dict[str, int] = {}
+        for node in state_nodes:
+            rid = node.labels().get(RESERVATION_ID_LABEL, "")
+            if not rid and node.node_claim is not None:
+                # a pinned claim that hasn't launched yet carries the
+                # reservation only in its spec requirements — it must
+                # still consume budget or back-to-back solves
+                # overcommit the reservation
+                for spec in node.node_claim.spec.requirements:
+                    if spec.key == RESERVATION_ID_LABEL and spec.values:
+                        rid = spec.values[0]
+                        break
+            if rid:
+                self.reserved_in_use[rid] = self.reserved_in_use.get(rid, 0) + 1
 
         self.daemon_overhead = self._daemon_overhead()
         self.topology = self._build_topology()
@@ -235,6 +280,7 @@ class Scheduler:
             self.pools_with_types,
             self.existing_inputs,
             self.daemon_overhead,
+            reserved_in_use=self.reserved_in_use,
         )
         return solve_encoded(enc)
 

@@ -167,6 +167,9 @@ class Encoded:
     cfg_pool: np.ndarray                  # [C] int32 (pool order index; -1 pseudo)
     pool_overhead: np.ndarray             # [P+1, R] float32 daemon overhead per pool
     existing_used: np.ndarray             # [E, R] float32 (all zeros: available baked in)
+    cfg_cap: np.ndarray = None            # [C] float32 max nodes per config
+                                          # (inf = uncapped; finite for
+                                          # capacity-reservation offerings)
 
 
 def _config_requirements(
@@ -226,10 +229,15 @@ def encode(
     pools_with_types: Sequence[tuple[NodePool, Sequence[InstanceType]]],
     existing: Sequence[ExistingNodeInput] = (),
     daemon_overhead: Optional[dict[str, dict[str, float]]] = None,
+    reserved_in_use: Optional[dict[str, int]] = None,
 ) -> Encoded:
     """Build the dense problem. `daemon_overhead` maps pool name ->
     resource list of daemonset pods that will land on new nodes
-    (reference scheduler.go:772-803)."""
+    (reference scheduler.go:772-803). `reserved_in_use` maps
+    reservation id -> instances already consumed by live nodes; the
+    remainder caps how many nodes the solver may open against that
+    reservation (ReservationManager semantics,
+    scheduling/reservationmanager.go:28-110)."""
     configs = build_configs(pools_with_types, existing)
     n_launch = len(configs) - len(existing)
 
@@ -255,6 +263,8 @@ def encode(
     cfg_alloc = np.zeros((C, R), np.float32)
     cfg_price = np.zeros((C,), np.float32)
     cfg_pool = np.full((C,), -1, np.int32)
+    cfg_cap = np.full((C,), np.inf, np.float32)
+    in_use = reserved_in_use or {}
     pool_order = {pool.metadata.name: i for i, (pool, _) in enumerate(pools_with_types)}
     for ci, cfg in enumerate(configs):
         if cfg.existing_index >= 0:
@@ -267,6 +277,11 @@ def encode(
                 cfg_alloc[ci, ri] = cfg.instance_type.allocatable.get(key, 0.0)
             cfg_price[ci] = cfg.offering.price
             cfg_pool[ci] = pool_order[cfg.pool.metadata.name]
+            rid = cfg.offering.reservation_id
+            if rid:
+                cfg_cap[ci] = max(
+                    0, cfg.offering.reservation_capacity - in_use.get(rid, 0)
+                )
 
     compat = _compat_matrix(groups, configs)
 
@@ -301,6 +316,9 @@ def encode(
             continue
         key = (
             int(cfg_pool[ci]),
+            # distinct reservations must not merge (their budgets would
+            # collapse to one cap instead of the sum)
+            cfg.offering.reservation_id if cfg.offering is not None else "",
             cfg_alloc[ci].tobytes(),
             compat[:, ci].tobytes(),
         )
@@ -319,6 +337,7 @@ def encode(
         cfg_alloc = np.ascontiguousarray(cfg_alloc[keep])
         cfg_price = np.ascontiguousarray(cfg_price[keep])
         cfg_pool = np.ascontiguousarray(cfg_pool[keep])
+        cfg_cap = np.ascontiguousarray(cfg_cap[keep])
 
     return Encoded(
         resource_keys=keys,
@@ -333,6 +352,7 @@ def encode(
         cfg_pool=cfg_pool,
         pool_overhead=pool_overhead,
         existing_used=np.zeros((len(existing), R), np.float32),
+        cfg_cap=cfg_cap,
     )
 
 

@@ -71,6 +71,7 @@ def pack(
     max_nodes: int,
     mode: str = "ffd",
     quota: jnp.ndarray | None = None,  # [N, G] i32 per-node group caps
+    cfg_cap: jnp.ndarray | None = None,  # [C] f32 max nodes per config
 ):
     G, C = compat.shape
     R = group_req.shape[1]
@@ -82,6 +83,14 @@ def pack(
     node_active = jnp.zeros((N,), bool).at[:E].set(existing_mask.any(axis=1))
     assign = jnp.zeros((N, G), jnp.int32)
     unschedulable = jnp.zeros((G,), jnp.int32)
+    if cfg_cap is None:
+        cfg_cap = jnp.full((C,), BIG, jnp.float32)
+    capped = cfg_cap < BIG
+    # Nodes pre-opened against a capped config (LP-planned reserved
+    # slots) consume that config's reservation budget up front.
+    cfg_used0 = (existing_mask.astype(jnp.float32).sum(axis=0) * capped).astype(
+        jnp.float32
+    )
 
     def capacity(used_j, req):
         # [C]: how many pods of `req` fit on top of used_j per config
@@ -94,12 +103,13 @@ def pack(
     def body(g, state):
         """One group per iteration: (1) prefix-sum fill across every
         feasible open node in index order — exactly the per-pod
-        first-fit outcome — then (2) bulk-open q identical fresh nodes
-        for any spill. Exact under FFD: within one group the open-node
-        feasibility set never changes, so the per-pod scan would
-        produce this same layout. Loop trip count is G, independent of
-        pod count."""
-        node_mask, node_used, node_active, node_count, assign, unsched = state
+        first-fit outcome — then (2) bulk-open fresh nodes for any
+        spill, config by config while capacity-reservation budgets
+        allow (inner while). Exact under FFD: within one group the
+        open-node feasibility set never changes, so the per-pod scan
+        would produce this same layout. Loop trip count is G,
+        independent of pod count."""
+        node_mask, node_used, node_active, node_count, assign, unsched, cfg_used = state
         req = group_req[g]
         row = compat[g]
         remaining = group_count[g]
@@ -130,15 +140,26 @@ def pack(
         assign = assign.at[:, g].add(take)
         remaining = remaining - take.sum()
 
-        # (2) bulk open on the highest-weight admitting pool
-        fresh_ok = row & jnp.all(pool_overhead[cfg_pool] <= alloc_minus_req, axis=-1) & (
-            cfg_pool >= 0
-        )
-        chosen_pool = jnp.min(jnp.where(fresh_ok, cfg_pool, INT_BIG))
-        do_open = (remaining > 0) & fresh_ok.any() & (node_count < N)
+        # (2) bulk open, config by config, while reservation budgets
+        # allow. Each inner iteration opens >=1 node (or the loop
+        # exits), so it terminates within the node axis. Most groups
+        # take exactly one iteration; extra rounds happen only when a
+        # capacity reservation runs dry mid-group and the spill falls
+        # back to the next config (ReservationManager fallback,
+        # scheduling/reservationmanager.go + nodeclaim.go:201-251).
+        fits_fresh = row & jnp.all(
+            pool_overhead[cfg_pool] <= alloc_minus_req, axis=-1
+        ) & (cfg_pool >= 0)
 
-        def open_nodes(args):
-            node_mask, node_used, node_active, node_count, assign, remaining = args
+        def open_cond(args):
+            _, _, _, node_count, _, remaining, cfg_used = args
+            can = fits_fresh & (cfg_used < cfg_cap)
+            return (remaining > 0) & can.any() & (node_count < N)
+
+        def open_round(args):
+            node_mask, node_used, node_active, node_count, assign, remaining, cfg_used = args
+            fresh_ok = fits_fresh & (cfg_used < cfg_cap)
+            chosen_pool = jnp.min(jnp.where(fresh_ok, cfg_pool, INT_BIG))
             mask = fresh_ok & (cfg_pool == chosen_pool)
             overhead = pool_overhead[chosen_pool]
             kf = capacity(overhead, req) * mask
@@ -149,11 +170,25 @@ def pack(
                 # instance rather than the biggest compatible one.
                 ppp = jnp.where(kf >= 1, cfg_price / jnp.maximum(kf, 1), BIG)
                 c_star = jnp.argmin(ppp)
-                m_star = jnp.maximum(kf[c_star], 1)
             else:
-                m_star = jnp.maximum(jnp.max(kf), 1)
+                # Greedy opens the biggest instance, but the launch
+                # resolves to the cheapest offering — which is the
+                # reservation while it lasts (the reference's
+                # ReservationManager reserves per nodeclaim). Prefer a
+                # capped config that undercuts every uncapped price.
+                kf_ok = kf >= 1
+                min_uncapped = jnp.min(
+                    jnp.where(kf_ok & ~capped, cfg_price, BIG)
+                )
+                res_mask = kf_ok & capped & (cfg_price < min_uncapped)
+                c_res = jnp.argmax(jnp.where(res_mask, kf, -1))
+                c_star = jnp.where(res_mask.any(), c_res, jnp.argmax(kf))
+            m_star = jnp.maximum(kf[c_star], 1)
+            cap_left = (cfg_cap[c_star] - cfg_used[c_star]).astype(jnp.float32)
             q = jnp.minimum((remaining + m_star - 1) // m_star, N - node_count)
-            rem_last = jnp.minimum(m_star, remaining - (q - 1) * m_star)
+            q = jnp.minimum(q, jnp.maximum(cap_left, 0).astype(jnp.int32))
+            q = jnp.maximum(q, 1)  # open_cond guarantees one is legal
+            rem_last = jnp.clip(remaining - (q - 1) * m_star, 1, m_star)
             idx = jnp.arange(N, dtype=jnp.int32)
             sel_full = (idx >= node_count) & (idx < node_count + q - 1)
             sel_last = idx == node_count + q - 1
@@ -161,9 +196,23 @@ def pack(
                 sel_full.astype(jnp.int32) * m_star
                 + sel_last.astype(jnp.int32) * rem_last
             )
+            # A capped (reserved) config pins its nodes: the option
+            # mask is exactly that column, mirroring FinalizeScheduling
+            # adding the reservation-id requirement
+            # (scheduling/nodeclaim.go:252). Uncapped opens exclude
+            # capped columns so decode can never resolve a node onto a
+            # reservation the budget didn't admit.
+            is_capped = capped[c_star]
+            one_hot = jnp.arange(C) == c_star
+            open_mask_full = jnp.where(
+                is_capped, one_hot, mask & ~capped & (kf >= m_star)
+            )
+            open_mask_last = jnp.where(
+                is_capped, one_hot, mask & ~capped & (kf >= rem_last)
+            )
             node_mask = jnp.where(
-                sel_full[:, None], (mask & (kf >= m_star))[None, :],
-                jnp.where(sel_last[:, None], (mask & (kf >= rem_last))[None, :], node_mask),
+                sel_full[:, None], open_mask_full[None, :],
+                jnp.where(sel_last[:, None], open_mask_last[None, :], node_mask),
             )
             node_used = jnp.where(
                 (sel_full | sel_last)[:, None],
@@ -178,29 +227,33 @@ def pack(
                 node_count + q,
                 assign.at[:, g].add(fill),
                 remaining - placed,
+                cfg_used.at[c_star].add(q.astype(jnp.float32)),
             )
 
-        node_mask, node_used, node_active, node_count, assign, remaining = jax.lax.cond(
-            do_open,
-            open_nodes,
-            lambda args: args,
-            (node_mask, node_used, node_active, node_count, assign, remaining),
+        (node_mask, node_used, node_active, node_count, assign, remaining,
+         cfg_used) = jax.lax.while_loop(
+            open_cond,
+            open_round,
+            (node_mask, node_used, node_active, node_count, assign, remaining,
+             cfg_used),
         )
         unsched = unsched.at[g].add(jnp.maximum(remaining, 0))
-        return (node_mask, node_used, node_active, node_count, assign, unsched)
+        return (node_mask, node_used, node_active, node_count, assign, unsched,
+                cfg_used)
 
     state = jax.lax.fori_loop(
         0,
         G,
         body,
-        (node_mask, node_used, node_active, jnp.int32(E), assign, unschedulable),
+        (node_mask, node_used, node_active, jnp.int32(E), assign, unschedulable,
+         cfg_used0),
     )
-    node_mask, node_used, node_active, node_count, assign, unsched = state
+    node_mask, node_used, node_active, node_count, assign, unsched, _ = state
     return assign, node_mask, node_used, node_active, node_count, unsched
 
 
 @functools.partial(jax.jit, static_argnames=("max_nodes", "mode"))
-def pack_flat(*args, max_nodes: int, mode: str = "ffd", quota=None):
+def pack_flat(*args, max_nodes: int, mode: str = "ffd", quota=None, cfg_cap=None):
     """`pack` with every output concatenated into ONE float32 vector.
 
     The remote-device transport charges a fixed latency per
@@ -209,7 +262,7 @@ def pack_flat(*args, max_nodes: int, mode: str = "ffd", quota=None):
     pay that latency exactly once.
     """
     assign, node_mask, node_used, node_active, node_count, unsched = pack(
-        *args, max_nodes=max_nodes, mode=mode, quota=quota
+        *args, max_nodes=max_nodes, mode=mode, quota=quota, cfg_cap=cfg_cap
     )
     return jnp.concatenate(
         [
@@ -282,19 +335,26 @@ def solve_packing(
             axis=0,
         )
 
+    # the kernel sees the existing axis padded to its shape bucket, so
+    # fresh nodes open at the padded offset — size the node axis for it
+    reserved_p = _pad_axis(reserved) if reserved else 0
+
     if max_nodes > 0:
-        return _run_pack(enc, existing_mask, existing_used, max_nodes, mode, quota)
+        return _run_pack(
+            enc, existing_mask, existing_used,
+            max_nodes + (reserved_p - reserved), mode, quota,
+        )
 
     estimate = _estimate_nodes(enc)
     if plan is not None:
         # LP covered the bulk; fresh axis only absorbs rounding spill.
-        max_nodes = _bucket(reserved + max(32, estimate // 8 + 8))
+        max_nodes = _bucket(reserved_p + max(32, estimate // 8 + 8))
     else:
-        max_nodes = reserved + max(32, int(1.35 * estimate) + 16)
+        max_nodes = reserved_p + max(32, int(1.35 * estimate) + 16)
         max_nodes = _bucket(
-            min(max_nodes, reserved + max(64, int(enc.group_count.sum())))
+            min(max_nodes, reserved_p + max(64, int(enc.group_count.sum())))
         )
-    worst_case = reserved + int(enc.group_count.sum())
+    worst_case = reserved_p + int(enc.group_count.sum())
     while True:
         result = _run_pack(enc, existing_mask, existing_used, max_nodes, mode, quota)
         capped = (
@@ -315,6 +375,16 @@ def _bucket(n: int) -> int:
     return out
 
 
+def _pad_axis(n: int, base: int = 16) -> int:
+    """1.25x-spaced shape buckets: every solve shape maps onto a small
+    family of compiled programs (first axon compiles cost ~30s; an
+    unbucketed consolidation search would recompile per prefix size)."""
+    out = base
+    while out < n:
+        out = (out * 5 + 3) // 4
+    return out
+
+
 def _run_pack(
     enc: Encoded,
     existing_mask: np.ndarray,
@@ -323,43 +393,69 @@ def _run_pack(
     mode: str = "ffd",
     quota: np.ndarray | None = None,
 ) -> PackResult:
+    G, C = enc.compat.shape
+    R = enc.group_req.shape[1]
+    E = existing_mask.shape[0]
+    Gp, Cp, Ep = _pad_axis(G), _pad_axis(C), _pad_axis(E) if E else 0
+    N = max_nodes
+
+    compat = np.zeros((Gp, Cp), bool)
+    compat[:G, :C] = enc.compat
+    group_req = np.zeros((Gp, R), np.float32)
+    group_req[:G] = enc.group_req
+    group_count = np.zeros((Gp,), np.int32)
+    group_count[:G] = enc.group_count
+    cfg_alloc = np.zeros((Cp, R), np.float32)
+    cfg_alloc[:C] = enc.cfg_alloc
+    cfg_pool = np.full((Cp,), -1, np.int32)
+    cfg_pool[:C] = enc.cfg_pool
+    cfg_price = np.zeros((Cp,), np.float32)
+    cfg_price[:C] = enc.cfg_price
+    emask = np.zeros((Ep, Cp), bool)
+    eused = np.zeros((Ep, R), np.float32)
+    if E:
+        emask[:E, :C] = existing_mask
+        eused[:E] = existing_used
+
     quota_full = None
     if quota is not None:
-        quota_full = np.full(
-            (max_nodes, quota.shape[1]), np.iinfo(np.int32).max, np.int32
-        )
-        quota_full[: quota.shape[0]] = quota
+        quota_full = np.full((N, Gp), np.iinfo(np.int32).max, np.int32)
+        quota_full[: quota.shape[0], :G] = quota[:, :G]
         quota_full = jnp.asarray(quota_full)
+    cfg_cap = None
+    if enc.cfg_cap is not None and np.isfinite(enc.cfg_cap).any():
+        uncapped = np.float32(BIG)  # pack classifies capped = cap < BIG
+        cap = np.full((Cp,), uncapped, np.float32)
+        cap[:C] = np.where(np.isfinite(enc.cfg_cap), enc.cfg_cap, uncapped)
+        cfg_cap = jnp.asarray(cap)
     flat = pack_flat(
-        jnp.asarray(enc.compat),
-        jnp.asarray(enc.group_req),
-        jnp.asarray(enc.group_count),
-        jnp.asarray(enc.cfg_alloc),
-        jnp.asarray(enc.cfg_pool),
+        jnp.asarray(compat),
+        jnp.asarray(group_req),
+        jnp.asarray(group_count),
+        jnp.asarray(cfg_alloc),
+        jnp.asarray(cfg_pool),
         jnp.asarray(enc.pool_overhead),
-        jnp.asarray(existing_mask),
-        jnp.asarray(existing_used),
-        jnp.asarray(enc.cfg_price),
+        jnp.asarray(emask),
+        jnp.asarray(eused),
+        jnp.asarray(cfg_price),
         max_nodes=max_nodes,
         mode=mode,
         quota=quota_full,
+        cfg_cap=cfg_cap,
     )
     flat = np.asarray(flat)  # the one device->host fetch
-    G, C = enc.compat.shape
-    R = enc.group_req.shape[1]
-    N = max_nodes
     o0, o1, o2, o3, o4 = (
-        N * G,
-        N * G + N * C,
-        N * G + N * C + N * R,
-        N * G + N * C + N * R + N,
-        N * G + N * C + N * R + N + 1,
+        N * Gp,
+        N * Gp + N * Cp,
+        N * Gp + N * Cp + N * R,
+        N * Gp + N * Cp + N * R + N,
+        N * Gp + N * Cp + N * R + N + 1,
     )
     return PackResult(
-        assign=flat[:o0].reshape(N, G).astype(np.int32),
-        node_mask=flat[o0:o1].reshape(N, C) > 0.5,
+        assign=flat[:o0].reshape(N, Gp)[:, :G].astype(np.int32),
+        node_mask=flat[o0:o1].reshape(N, Cp)[:, :C] > 0.5,
         node_used=flat[o1:o2].reshape(N, R),
         node_active=flat[o2:o3] > 0.5,
         node_count=int(flat[o3]),
-        unschedulable=flat[o4:].astype(np.int32),
+        unschedulable=flat[o4:][:G].astype(np.int32),
     )

@@ -29,6 +29,13 @@ def solve_ffd_host(enc: Encoded) -> tuple[list[_Node], dict[int, int]]:
     """Returns (nodes, unschedulable{group: count})."""
     C = len(enc.configs)
     alloc = enc.cfg_alloc  # [C, R]
+    cap = (
+        enc.cfg_cap.astype(np.float64)
+        if enc.cfg_cap is not None
+        else np.full((C,), np.inf)
+    )
+    capped = np.isfinite(cap)
+    cap_used = np.zeros((C,), np.float64)  # nodes opened per capped config
     nodes: list[_Node] = []
     for ei in range(enc.n_existing):
         mask = np.zeros((C,), bool)
@@ -54,7 +61,7 @@ def solve_ffd_host(enc: Encoded) -> tuple[list[_Node], dict[int, int]]:
             if placed:
                 continue
             # open new node on highest-weight (lowest index) admitting pool
-            fresh = row & (enc.cfg_pool >= 0)
+            fresh = row & (enc.cfg_pool >= 0) & (cap_used < cap)
             overhead = enc.pool_overhead[enc.cfg_pool]
             fresh &= np.all(overhead + req[None, :] <= alloc + 1e-4, axis=1)
             if not fresh.any():
@@ -62,6 +69,22 @@ def solve_ffd_host(enc: Encoded) -> tuple[list[_Node], dict[int, int]]:
                 continue
             pool = int(enc.cfg_pool[fresh].min())
             mask = fresh & (enc.cfg_pool == pool)
+            # a reserved (capped) column pins the node and consumes one
+            # reservation instance; otherwise capped columns drop from
+            # the option mask (ReservationManager semantics)
+            reserved_opts = np.flatnonzero(mask & capped)
+            if reserved_opts.size and enc.cfg_price is not None and (
+                enc.cfg_price[reserved_opts].min()
+                <= enc.cfg_price[mask].min() + 1e-12
+            ):
+                pin = reserved_opts[np.argmin(enc.cfg_price[reserved_opts])]
+                mask = np.zeros((C,), bool)
+                mask[pin] = True
+                cap_used[pin] += 1
+            else:
+                # an uncapped option is strictly cheaper, so at least
+                # one survives the filter
+                mask = mask & ~capped
             node = _Node(mask=mask, used=enc.pool_overhead[pool] + req)
             node.assign[gi] = 1
             nodes.append(node)

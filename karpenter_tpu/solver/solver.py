@@ -156,7 +156,7 @@ def _decode_device(enc: Encoded, objective: str = "ffd") -> Solution:
     # be weak on small or degenerate demands).
     from karpenter_tpu.solver import lp_plan
 
-    plan = lp_plan.plan(enc)
+    plan = lp_plan.plan(enc, cfg_cap=enc.cfg_cap)
     candidates = []
     ffd_result = solve_packing(enc, mode="ffd")
     candidates.append((ffd_result, _downsize_masks(enc, ffd_result)))
@@ -198,6 +198,10 @@ def _downsize_masks(enc: Encoded, result) -> np.ndarray:
     """
     masks = result.node_mask.copy()
     launch = enc.cfg_pool >= 0
+    uncapped = (
+        ~np.isfinite(enc.cfg_cap) if enc.cfg_cap is not None
+        else np.ones(len(enc.configs), bool)
+    )
     for ni in range(result.node_count):
         if not result.node_active[ni]:
             continue
@@ -208,6 +212,10 @@ def _downsize_masks(enc: Encoded, result) -> np.ndarray:
         first = enc.configs[cols[0]]
         if first.existing_index >= 0:
             continue  # real existing node, nothing to resize
+        if not uncapped[cols].all():
+            # reservation-pinned node: the pin is the point
+            # (FinalizeScheduling, scheduling/nodeclaim.go:252)
+            continue
         pool = enc.cfg_pool[cols[0]]
         groups_on = np.flatnonzero(result.assign[ni] > 0)
         if groups_on.size == 0:
@@ -216,7 +224,13 @@ def _downsize_masks(enc: Encoded, result) -> np.ndarray:
             enc.cfg_alloc + 1e-4 >= result.node_used[ni][None, :], axis=1
         )
         compat_all = enc.compat[groups_on].all(axis=0)
-        wide = launch & (enc.cfg_pool == pool) & fits & compat_all
+        # capacity-reservation columns only stay valid if the packer
+        # already pinned this node to them — widening onto them would
+        # overspend the reservation budget
+        wide = (
+            launch & (enc.cfg_pool == pool) & fits & compat_all
+            & (uncapped | row)
+        )
         if wide.any():
             masks[ni] = wide
     return masks

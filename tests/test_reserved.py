@@ -1,0 +1,178 @@
+"""Capacity-reservation tests: solver cap enforcement, ReservationManager
+accounting, reservation pinning on claims, feature gating.
+
+Reference semantics: scheduling/reservationmanager.go:28-110 (counting
+across a single solve), scheduling/nodeclaim.go:184-251 (reserved
+offering bookkeeping + fallback), nodeclaim.go:252 (FinalizeScheduling
+pins capacity-type/reservation-id)."""
+
+import numpy as np
+
+from karpenter_tpu.apis.v1.labels import (
+    CAPACITY_TYPE_RESERVED,
+    RESERVATION_ID_LABEL,
+)
+from karpenter_tpu.cloudprovider.fake import GIB, make_instance_type
+from karpenter_tpu.solver.solver import solve
+from karpenter_tpu.testing import Environment, mk_nodepool, mk_pod
+
+
+def reserved_types(capacity=2):
+    """One 4-cpu type with a 'rsv-1' reservation of `capacity`
+    instances in zone test-zone-1, plus spot/on-demand offerings."""
+    return [
+        make_instance_type(
+            "c4",
+            cpu=4,
+            memory=16 * GIB,
+            price=1.0,
+            reservations=[("rsv-1", "test-zone-1", capacity)],
+        ),
+    ]
+
+
+def _pods(n, cpu=3.5):
+    return [mk_pod(name=f"r-{i}", cpu=cpu) for i in range(n)]
+
+
+class TestSolverReservationCaps:
+    def test_reserved_preferred_up_to_cap(self):
+        pool = mk_nodepool("p")
+        pods = _pods(5)  # 5 nodes needed (3.5 cpu pods on 4-cpu nodes)
+        sol = solve(pods, [(pool, reserved_types(capacity=2))], objective="cost")
+        assert not sol.unschedulable
+        reserved_nodes = [
+            n for n in sol.new_nodes
+            if n.offerings and n.offerings[0].is_reserved()
+        ]
+        other_nodes = [
+            n for n in sol.new_nodes
+            if not (n.offerings and n.offerings[0].is_reserved())
+        ]
+        # exactly the reservation capacity lands reserved; rest fall back
+        assert len(reserved_nodes) == 2
+        assert len(other_nodes) == 3
+        # reserved nodes are pinned: single reservation offering
+        for n in reserved_nodes:
+            assert all(o.reservation_id == "rsv-1" for o in n.offerings)
+
+    def test_ffd_objective_also_respects_cap(self):
+        pool = mk_nodepool("p")
+        pods = _pods(6)
+        sol = solve(pods, [(pool, reserved_types(capacity=1))], objective="ffd")
+        assert not sol.unschedulable
+        reserved_nodes = [
+            n for n in sol.new_nodes
+            if n.offerings and any(o.is_reserved() for o in n.offerings)
+        ]
+        assert len(reserved_nodes) <= 1
+
+    def test_host_oracle_respects_cap(self):
+        pool = mk_nodepool("p")
+        pods = _pods(4)
+        sol = solve(pods, [(pool, reserved_types(capacity=2))], backend="host")
+        assert not sol.unschedulable
+        reserved_nodes = [
+            n for n in sol.new_nodes
+            if n.offerings and any(o.is_reserved() for o in n.offerings)
+        ]
+        assert len(reserved_nodes) <= 2
+
+    def test_reservation_reduces_fleet_cost(self):
+        pool = mk_nodepool("p")
+        pods = _pods(4)
+        with_rsv = solve(pods, [(pool, reserved_types(capacity=4))], objective="cost")
+        without = solve(pods, [(pool, reserved_types(capacity=0))], objective="cost")
+        assert with_rsv.total_price < without.total_price * 0.5
+
+    def test_in_use_reservations_reduce_cap(self):
+        from karpenter_tpu.solver.encode import encode, group_pods
+
+        pool = mk_nodepool("p")
+        groups = group_pods(_pods(4))
+        enc = encode(
+            groups,
+            [(pool, reserved_types(capacity=2))],
+            reserved_in_use={"rsv-1": 1},
+        )
+        caps = enc.cfg_cap[np.isfinite(enc.cfg_cap)]
+        assert list(caps) == [1.0]
+
+
+class TestReservationEndToEnd:
+    def test_claims_pinned_and_capped(self):
+        env = Environment(types=reserved_types(capacity=2))
+        env.kube.create(mk_nodepool("p"))
+        env.provision(*_pods(5))
+        claims = env.kube.node_claims()
+        assert len(claims) == 5
+        pinned = [
+            c for c in claims
+            if any(
+                r.key == RESERVATION_ID_LABEL and "rsv-1" in r.values
+                for r in c.spec.requirements
+            )
+        ]
+        assert len(pinned) == 2
+        # the kwok provider launched them into the reservation
+        reserved_nodes = [
+            n for n in env.kube.nodes()
+            if n.metadata.labels.get("karpenter.sh/capacity-type")
+            == CAPACITY_TYPE_RESERVED
+        ]
+        assert len(reserved_nodes) == 2
+
+    def test_second_solve_sees_in_use_reservations(self):
+        env = Environment(types=reserved_types(capacity=2))
+        env.kube.create(mk_nodepool("p"))
+        env.provision(*_pods(2))  # consumes the whole reservation
+        env.provision(*[mk_pod(name=f"late-{i}", cpu=3.5) for i in range(2)])
+        claims = env.kube.node_claims()
+        pinned = [
+            c for c in claims
+            if any(r.key == RESERVATION_ID_LABEL for r in c.spec.requirements)
+        ]
+        assert len(pinned) == 2, "late pods must not over-commit the reservation"
+
+    def test_feature_gate_off_ignores_reservations(self):
+        from karpenter_tpu.operator.options import FeatureGates, Options
+
+        env = Environment(
+            types=reserved_types(capacity=4),
+            options=Options(feature_gates=FeatureGates(reserved_capacity=False)),
+        )
+        env.kube.create(mk_nodepool("p"))
+        # route through a Provisioner carrying the options
+        from karpenter_tpu.provisioning.provisioner import Provisioner
+
+        prov = Provisioner(env.kube, env.cluster, env.cloud, options=env.options)
+        for pod in _pods(2):
+            env.kube.create(pod)
+        results = prov.schedule()
+        prov.create_node_claims(results)
+        claims = env.kube.node_claims()
+        assert claims and all(
+            not any(r.key == RESERVATION_ID_LABEL for r in c.spec.requirements)
+            for c in claims
+        )
+
+    def test_inflight_pinned_claims_consume_budget(self):
+        """Claims created but not yet launched carry the reservation
+        only in spec requirements; back-to-back solves must still see
+        them (the ReservationManager race)."""
+        from karpenter_tpu.provisioning.provisioner import Provisioner
+
+        env = Environment(types=reserved_types(capacity=2))
+        env.kube.create(mk_nodepool("p"))
+        prov = Provisioner(env.kube, env.cluster, env.cloud)
+        for pod in _pods(2):
+            env.kube.create(pod)
+        prov.create_node_claims(prov.schedule())  # no lifecycle tick: unlaunched
+        for i in range(2):
+            env.kube.create(mk_pod(name=f"late-{i}", cpu=3.5))
+        prov.create_node_claims(prov.schedule())
+        pinned = [
+            c for c in env.kube.node_claims()
+            if any(r.key == RESERVATION_ID_LABEL for r in c.spec.requirements)
+        ]
+        assert len(pinned) == 2, f"{len(pinned)} pinned claims overcommit the reservation"
