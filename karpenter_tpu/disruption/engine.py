@@ -270,6 +270,8 @@ class DisruptionEngine:
             state_nodes=snapshot,
             daemonsets=self.cluster.daemonsets(),
             cluster_pods=self.kube.pods(),
+            allow_reserved=self.options.feature_gates.reserved_capacity,
+            min_values_policy=self.options.min_values_policy,
         )
         results = scheduler.solve(pods + pending)
         scheduled_keys = {

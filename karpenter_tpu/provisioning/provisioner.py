@@ -18,6 +18,7 @@ from typing import Optional, Sequence
 
 from karpenter_tpu.apis.v1.labels import (
     DO_NOT_DISRUPT_ANNOTATION,
+    NODECLAIM_MIN_VALUES_RELAXED_ANNOTATION,
     NODEPOOL_LABEL,
     TERMINATION_FINALIZER,
 )
@@ -158,6 +159,10 @@ class Provisioner:
                 self.options.feature_gates.reserved_capacity
                 if self.options is not None else True
             ),
+            min_values_policy=(
+                self.options.min_values_policy
+                if self.options is not None else "Strict"
+            ),
         )
         results = scheduler.solve(pods)
         self.cluster.mark_pod_scheduling_decisions(pods)
@@ -255,6 +260,10 @@ class Provisioner:
             ),
         )
         claim.metadata.annotations["karpenter.sh/nodepool-hash"] = pool.hash()
+        if plan.min_values_relaxed:
+            claim.metadata.annotations[
+                NODECLAIM_MIN_VALUES_RELAXED_ANNOTATION
+            ] = "true"
         claim.metadata.annotations["karpenter.sh/nodepool-hash-version"] = "v3"
         return claim
 

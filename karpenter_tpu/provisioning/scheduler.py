@@ -32,7 +32,12 @@ from karpenter_tpu.apis.v1.labels import (
     WELL_KNOWN_LABELS,
 )
 from karpenter_tpu.apis.v1.nodepool import NodePool
-from karpenter_tpu.cloudprovider.types import InstanceType, order_by_price, truncate
+from karpenter_tpu.cloudprovider.types import (
+    InstanceType,
+    order_by_price,
+    satisfies_min_values,
+    truncate,
+)
 from karpenter_tpu.kube.objects import Pod
 from karpenter_tpu.scheduling.requirement import IN, Requirement
 from karpenter_tpu.scheduling.requirements import Requirements
@@ -66,6 +71,14 @@ class SchedulerResults:
         )
 
 
+def _pool_requirements(pool: NodePool) -> Requirements:
+    """The pool template's requirement set, minValues included."""
+    reqs = Requirements()
+    for spec in pool.spec.template.spec.requirements:
+        reqs.add(Requirement(spec.key, spec.operator, spec.values, spec.min_values))
+    return reqs
+
+
 def _strip_reserved(it: InstanceType) -> InstanceType:
     """Instance type without its reserved-capacity offerings."""
     kept = [o for o in it.offerings if not o.is_reserved()]
@@ -92,7 +105,9 @@ class Scheduler:
         cluster_pods: Sequence[Pod] = (),
         honor_preferences: bool = True,
         allow_reserved: bool = True,
+        min_values_policy: str = "Strict",
     ):
+        self.min_values_policy = min_values_policy
         if not allow_reserved:
             # ReservedCapacity gate off: reserved offerings never enter
             # the solve (options.go feature gates)
@@ -104,6 +119,21 @@ class Scheduler:
         self.pools_with_types = sorted(
             pools_with_types, key=lambda pt: (-pt[0].spec.weight, pt[0].metadata.name)
         )
+        if self.min_values_policy != "BestEffort":
+            # Strict: a pool whose full catalog cannot satisfy its own
+            # minValues can never launch a valid claim — drop it up
+            # front so pods fall through to the next weighted pool
+            # (upstream filters minValues-incompatible options per
+            # nodepool during scheduling, types.go:284-318)
+            kept = []
+            for pool, types in self.pools_with_types:
+                pool_reqs = _pool_requirements(pool)
+                if pool_reqs.has_min_values():
+                    _, err = satisfies_min_values(list(types), pool_reqs)
+                    if err is not None:
+                        continue
+                kept.append((pool, types))
+            self.pools_with_types = kept
         self.honor_preferences = honor_preferences
         self.daemonsets = list(daemonsets)
         self.cluster_pods = list(cluster_pods)
@@ -260,8 +290,30 @@ class Scheduler:
 
         for plan in open_plans:
             self._finalize_plan(plan)
-        results.new_node_plans.extend(open_plans)
+            if not self._enforce_min_values(plan, results):
+                continue
+            results.new_node_plans.append(plan)
         return results
+
+    def _enforce_min_values(self, plan: NodePlan, results: SchedulerResults) -> bool:
+        """minValues flexibility floor per planned node
+        (types.go:284-318; relaxation annotation scheduler.go:649-658).
+        Strict: a plan whose instance-type options can't satisfy the
+        pool's minValues is rejected and its pods report the reason.
+        BestEffort: the plan survives, marked relaxed so the claim gets
+        the min-values-relaxed annotation."""
+        pool_reqs = _pool_requirements(plan.pool)
+        if not pool_reqs.has_min_values():
+            return True
+        _, err = satisfies_min_values(plan.instance_types, pool_reqs)
+        if err is None:
+            return True
+        if self.min_values_policy == "BestEffort":
+            plan.min_values_relaxed = True
+            return True
+        for pod in plan.pods:
+            results.errors[pod.key] = f"minValues requirement not met: {err}"
+        return False
 
     def _pod_domains(self) -> dict[str, dict[str, str]]:
         out: dict[str, dict[str, str]] = {}
@@ -414,10 +466,16 @@ class Scheduler:
             if allowed is None:
                 continue
             allowed_zones = allowed.get(TOPOLOGY_ZONE_LABEL, zones)
+            allowed_cts = allowed.get(
+                CAPACITY_TYPE_LABEL, candidate[CAPACITY_TYPE_LABEL]
+            )
             chosen_types = []
             chosen_offerings = []
             for it, offs in fitting:
-                offs2 = [o for o in offs if o.zone in allowed_zones]
+                offs2 = [
+                    o for o in offs
+                    if o.zone in allowed_zones and o.capacity_type in allowed_cts
+                ]
                 if offs2:
                     chosen_types.append(it)
                     chosen_offerings.extend(offs2)
@@ -471,9 +529,17 @@ class Scheduler:
     # -- finalize -------------------------------------------------------------
 
     def _finalize_plan(self, plan: NodePlan) -> None:
-        """Price-order and truncate instance types
-        (results.TruncateInstanceTypes, provisioner.go:374)."""
-        reqs = Requirements()
-        plan.instance_types = truncate(
-            plan.instance_types, reqs, MAX_INSTANCE_TYPES
-        )
+        """Price-order and truncate instance types, honoring the pool's
+        minValues floors (results.TruncateInstanceTypes,
+        provisioner.go:374; types.go:322-334)."""
+        pool_reqs = _pool_requirements(plan.pool)
+        try:
+            plan.instance_types = truncate(
+                plan.instance_types, pool_reqs, MAX_INSTANCE_TYPES
+            )
+        except Exception:
+            # truncation cannot keep the minValues floor —
+            # _enforce_min_values decides reject (Strict) vs relax
+            plan.instance_types = truncate(
+                plan.instance_types, Requirements(), MAX_INSTANCE_TYPES
+            )

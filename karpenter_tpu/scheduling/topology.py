@@ -70,14 +70,22 @@ class TopologyGroup:
             if not live:
                 return candidates
             global_min = min(live.values())
-            # min_domains: while fewer domains than minDomains have pods,
-            # only empty domains are legal targets (k8s minDomains semantics)
+            # min_domains: while fewer domains than minDomains have
+            # pods and an empty domain exists anywhere, the next pod
+            # must open one (nextDomainTopologySpread's minDomains
+            # handling) — candidates without an empty domain are
+            # rejected. Only when NO domain is empty anywhere does the
+            # k8s fallback apply: global minimum treated as 0 for the
+            # skew check.
             if self.min_domains is not None:
                 nonzero = sum(1 for c in live.values() if c > 0)
                 if nonzero < self.min_domains:
-                    empty = {d for d in candidates if live.get(d, 0) == 0}
-                    if empty:
-                        return empty
+                    if any(c == 0 for c in live.values()):
+                        return {d for d in candidates if live.get(d, 0) == 0}
+                    return {
+                        d for d in candidates
+                        if live.get(d, 0) + 1 <= self.max_skew
+                    }
             return {
                 d for d in candidates if live.get(d, 0) + 1 - global_min <= self.max_skew
             }

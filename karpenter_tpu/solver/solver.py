@@ -55,6 +55,9 @@ class NodePlan:
         self.pods: list[Pod] = pods if pods is not None else []
         self.price = price
         self.claim_name = claim_name
+        # set when BestEffort minValues policy relaxed the floor
+        # (scheduler.go:649-658 / min-values-relaxed annotation)
+        self.min_values_relaxed = False
 
     def _materialize(self) -> None:
         its, offs = self._lazy()

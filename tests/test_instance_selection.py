@@ -1,0 +1,201 @@
+"""Instance-selection suite: price ordering, requirement filtering,
+minValues flexibility floors, truncation, extended resources.
+
+Models provisioning/scheduling/instance_selection_test.go and
+cloudprovider/types.go:221-334 (OrderByPrice / SatisfiesMinValues /
+Truncate)."""
+
+import pytest
+
+from karpenter_tpu.apis.v1.labels import (
+    CAPACITY_TYPE_LABEL,
+    CAPACITY_TYPE_ON_DEMAND,
+    INSTANCE_TYPE_LABEL,
+    TOPOLOGY_ZONE_LABEL,
+)
+from karpenter_tpu.apis.v1.nodepool import NodePool
+from karpenter_tpu.cloudprovider.fake import GIB, make_instance_type
+from karpenter_tpu.cloudprovider.types import (
+    order_by_price,
+    satisfies_min_values,
+    truncate,
+)
+from karpenter_tpu.apis.v1.nodeclaim import RequirementSpec
+from karpenter_tpu.kube.objects import ObjectMeta
+from karpenter_tpu.scheduling.requirement import IN, Requirement
+from karpenter_tpu.scheduling.requirements import Requirements
+from karpenter_tpu.solver.solver import solve
+from karpenter_tpu.testing import Environment, mk_nodepool, mk_pod
+
+
+def catalog():
+    return [
+        make_instance_type("tiny", cpu=2, memory=4 * GIB, price=0.5),
+        make_instance_type("mid", cpu=8, memory=32 * GIB, price=2.0),
+        make_instance_type("big", cpu=32, memory=128 * GIB, price=8.0),
+        make_instance_type("gpu", cpu=8, memory=32 * GIB, price=10.0,
+                           extra_resources={"example.com/gpu": 4.0}),
+        make_instance_type("arm", cpu=8, memory=32 * GIB, price=1.5,
+                           arch="arm64"),
+    ]
+
+
+class TestSelection:
+    def test_cheapest_fitting_type_launches(self):
+        env = Environment(types=catalog())
+        env.kube.create(mk_nodepool("p"))
+        env.provision(mk_pod(cpu=1.0))
+        node = env.kube.nodes()[0]
+        assert node.metadata.labels[INSTANCE_TYPE_LABEL] == "tiny"
+
+    def test_arch_requirement_filters(self):
+        env = Environment(types=catalog())
+        env.kube.create(mk_nodepool("p"))
+        pod = mk_pod(cpu=1.0)
+        pod.spec.node_selector = {"kubernetes.io/arch": "arm64"}
+        env.provision(pod)
+        assert env.kube.nodes()[0].metadata.labels[INSTANCE_TYPE_LABEL] == "arm"
+
+    def test_instance_type_selector(self):
+        env = Environment(types=catalog())
+        env.kube.create(mk_nodepool("p"))
+        pod = mk_pod(cpu=1.0)
+        pod.spec.node_selector = {INSTANCE_TYPE_LABEL: "mid"}
+        env.provision(pod)
+        assert env.kube.nodes()[0].metadata.labels[INSTANCE_TYPE_LABEL] == "mid"
+
+    def test_extended_resource_routes_to_gpu_type(self):
+        env = Environment(types=catalog())
+        env.kube.create(mk_nodepool("p"))
+        pod = mk_pod(cpu=1.0)
+        pod.spec.containers[0].requests["example.com/gpu"] = 2.0
+        env.provision(pod)
+        assert env.kube.nodes()[0].metadata.labels[INSTANCE_TYPE_LABEL] == "gpu"
+
+    def test_pods_capacity_forces_extra_nodes(self):
+        # the 'pods' resource caps how many pods fit regardless of cpu
+        types = [make_instance_type("p4", cpu=32, memory=64 * GIB, pods=4,
+                                    price=1.0)]
+        pool = mk_nodepool("p")
+        pods = [mk_pod(name=f"tiny-{i}", cpu=0.05) for i in range(9)]
+        sol = solve(pods, [(pool, types)])
+        assert not sol.unschedulable
+        assert len(sol.new_nodes) == 3
+
+    def test_on_demand_requirement_skips_spot(self):
+        env = Environment(types=catalog())
+        env.kube.create(mk_nodepool("p"))
+        pod = mk_pod(cpu=1.0)
+        pod.spec.node_selector = {CAPACITY_TYPE_LABEL: CAPACITY_TYPE_ON_DEMAND}
+        env.provision(pod)
+        node = env.kube.nodes()[0]
+        assert node.metadata.labels[CAPACITY_TYPE_LABEL] == "on-demand"
+
+    def test_order_by_price_respects_requirements(self):
+        types = catalog()
+        reqs = Requirements([
+            Requirement(CAPACITY_TYPE_LABEL, IN, [CAPACITY_TYPE_ON_DEMAND])
+        ])
+        ordered = order_by_price(types, reqs)
+        prices = [
+            min(o.price for o in it.offerings
+                if o.capacity_type == "on-demand")
+            for it in ordered
+        ]
+        assert prices == sorted(prices)
+
+
+class TestMinValues:
+    def _pool_with_min_values(self, n):
+        pool = mk_nodepool("p")
+        pool.spec.template.spec.requirements = [
+            RequirementSpec(
+                key=INSTANCE_TYPE_LABEL,
+                operator="Exists",
+                min_values=n,
+            )
+        ]
+        return pool
+
+    def test_satisfies_min_values(self):
+        types = catalog()
+        reqs = Requirements([
+            Requirement(INSTANCE_TYPE_LABEL, "Exists", [], min_values=3)
+        ])
+        count, err = satisfies_min_values(types, reqs)
+        assert err is None and count >= 3
+        reqs6 = Requirements([
+            Requirement(INSTANCE_TYPE_LABEL, "Exists", [], min_values=6)
+        ])
+        _, err = satisfies_min_values(types, reqs6)
+        assert err is not None
+
+    def test_truncate_honors_min_values(self):
+        types = catalog()
+        reqs = Requirements([
+            Requirement(INSTANCE_TYPE_LABEL, "Exists", [], min_values=2)
+        ])
+        out = truncate(types, reqs, max_items=2)
+        assert len(out) == 2
+        with pytest.raises(Exception):
+            truncate(types, reqs, max_items=1)
+
+    def test_claim_keeps_min_values_flexibility(self):
+        env = Environment(types=catalog())
+        env.kube.create(self._pool_with_min_values(2))
+        env.provision(mk_pod(cpu=1.0))
+        claim = env.kube.node_claims()[0]
+        type_req = next(
+            r for r in claim.spec.requirements if r.key == INSTANCE_TYPE_LABEL
+            and r.operator == IN
+        )
+        assert len(type_req.values) >= 2
+
+    def test_unsatisfiable_min_values_blocks(self):
+        env = Environment(types=catalog())
+        env.kube.create(self._pool_with_min_values(10))
+        env.provision(mk_pod(cpu=1.0))
+        assert not env.kube.node_claims()
+
+
+class TestTruncation:
+    def test_max_instance_types_truncation(self):
+        from karpenter_tpu.provisioning.scheduler import MAX_INSTANCE_TYPES
+
+        many = [
+            make_instance_type(f"t-{i}", cpu=4, memory=8 * GIB,
+                               price=1.0 + i * 0.001)
+            for i in range(MAX_INSTANCE_TYPES + 50)
+        ]
+        env = Environment(types=many)
+        env.kube.create(mk_nodepool("p"))
+        env.provision(mk_pod(cpu=1.0))
+        claim = env.kube.node_claims()[0]
+        type_req = next(
+            r for r in claim.spec.requirements
+            if r.key == INSTANCE_TYPE_LABEL and r.operator == IN
+        )
+        assert len(type_req.values) <= MAX_INSTANCE_TYPES
+        # cheapest survives truncation (truncate is price-ordered)
+        assert "t-0" in type_req.values
+
+    def test_best_effort_min_values_relaxes_with_annotation(self):
+        from karpenter_tpu.apis.v1.labels import (
+            NODECLAIM_MIN_VALUES_RELAXED_ANNOTATION,
+        )
+        from karpenter_tpu.operator.options import Options
+        from karpenter_tpu.provisioning.provisioner import Provisioner
+
+        env = Environment(types=catalog())
+        env.kube.create(TestMinValues._pool_with_min_values(None, 10))
+        prov = Provisioner(
+            env.kube, env.cluster, env.cloud,
+            options=Options(min_values_policy="BestEffort"),
+        )
+        env.kube.create(mk_pod(cpu=1.0))
+        prov.create_node_claims(prov.schedule())
+        claims = env.kube.node_claims()
+        assert len(claims) == 1
+        assert claims[0].metadata.annotations.get(
+            NODECLAIM_MIN_VALUES_RELAXED_ANNOTATION
+        ) == "true"
