@@ -12,6 +12,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Optional
 
+from karpenter_tpu.apis.v1.condition import ConditionSet
 from karpenter_tpu.apis.v1.nodepool import NodePool
 from karpenter_tpu.cloudprovider.types import (
     CloudProvider,
@@ -33,10 +34,18 @@ class NodeOverlaySpec:
     weight: int = 0
 
 
+COND_OVERLAY_VALIDATION = "ValidationSucceeded"
+
+
 @dataclass
 class NodeOverlay:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     spec: NodeOverlaySpec = field(default_factory=NodeOverlaySpec)
+    status_conditions: ConditionSet = field(
+        default_factory=lambda: ConditionSet(
+            root_types=[COND_OVERLAY_VALIDATION]
+        )
+    )
 
     kind = "NodeOverlay"
 
@@ -123,16 +132,106 @@ class OverlayStore:
         )
 
 
+def detect_conflicts(overlays: list[NodeOverlay]) -> dict[str, str]:
+    """Equal-weight overlays that can select the same instances AND
+    write the same attribute with different values conflict; the
+    lexicographically-later one is flagged (nodeoverlay/controller.go
+    conflict detection by weight)."""
+    conflicts: dict[str, str] = {}
+    by_weight: dict[int, list[NodeOverlay]] = {}
+    for o in overlays:
+        by_weight.setdefault(o.spec.weight, []).append(o)
+    for weight, group in by_weight.items():
+        group = sorted(group, key=lambda o: o.metadata.name)
+        reqs = {
+            o.metadata.name: Requirements.from_node_selector_requirements(
+                o.spec.requirements
+            )
+            for o in group
+        }
+        for i, a in enumerate(group):
+            for b in group[i + 1 :]:
+                # disjoint selectors can never target the same instance
+                if reqs[a.metadata.name].intersects(reqs[b.metadata.name]) is not None:
+                    continue
+                a_price = a.spec.price is not None or a.spec.price_adjustment is not None
+                b_price = b.spec.price is not None or b.spec.price_adjustment is not None
+                price_conflict = (
+                    a_price and b_price
+                    and (a.spec.price, a.spec.price_adjustment)
+                    != (b.spec.price, b.spec.price_adjustment)
+                )
+                capacity_conflict = any(
+                    a.spec.capacity[k] != b.spec.capacity[k]
+                    for k in set(a.spec.capacity) & set(b.spec.capacity)
+                )
+                if price_conflict or capacity_conflict:
+                    conflicts[b.metadata.name] = (
+                        f"conflicts with {a.metadata.name} at weight {weight}"
+                    )
+    return conflicts
+
+
+class UnevaluatedNodePoolError(Exception):
+    """GetInstanceTypes called before the overlay controller produced
+    its first store snapshot (nodeoverlay/controller.go:69-140) — the
+    provisioner skips the pool until evaluation completes."""
+
+
+class NodeOverlayController:
+    """Singleton revalidation loop: builds immutable store snapshots
+    from the live overlays, flags conflicts via status conditions, and
+    hands the snapshot to the decorator (controller.go:69-140)."""
+
+    def __init__(self, kube, provider: "OverlayCloudProvider"):
+        self.kube = kube
+        self.provider = provider
+        provider.gated = True  # serve only controller snapshots
+
+    def reconcile(self, now: Optional[float] = None) -> None:
+        overlays = list(self.kube.list("NodeOverlay"))
+        conflicts = detect_conflicts(overlays)
+        valid = []
+        for overlay in overlays:
+            reason = conflicts.get(overlay.metadata.name)
+            if reason:
+                overlay.status_conditions.set_false(
+                    COND_OVERLAY_VALIDATION, reason="Conflict", message=reason,
+                    now=now,
+                )
+            else:
+                overlay.status_conditions.set_true(
+                    COND_OVERLAY_VALIDATION, now=now
+                )
+                valid.append(overlay)
+        self.provider.set_store(OverlayStore(valid))
+
+
 class OverlayCloudProvider(CloudProvider):
     """Decorator applying the overlay store to GetInstanceTypes
-    (overlay/cloudprovider.go:30-60)."""
+    (overlay/cloudprovider.go:30-60). Serves the controller's snapshot;
+    before the first evaluation, pools are gated behind
+    UnevaluatedNodePoolError."""
 
     def __init__(self, inner: CloudProvider, kube):
         self.inner = inner
         self.kube = kube
+        self._snapshot: Optional[OverlayStore] = None
+        # set by NodeOverlayController: once a controller owns this
+        # decorator, only its snapshots are served (the reference's
+        # UnevaluatedNodePoolError gate); standalone use builds lazily
+        self.gated = False
+
+    def set_store(self, store: OverlayStore) -> None:
+        self._snapshot = store
 
     def _store(self) -> OverlayStore:
-        return OverlayStore(self.kube.list("NodeOverlay"))
+        if self._snapshot is not None:
+            return self._snapshot
+        if self.gated:
+            raise UnevaluatedNodePoolError("node overlays not yet evaluated")
+        # standalone (no controller): read-through, no caching
+        return OverlayStore(list(self.kube.list("NodeOverlay")))
 
     def get_instance_types(self, node_pool: Optional[NodePool]) -> list[InstanceType]:
         store = self._store()

@@ -263,14 +263,14 @@ class FakeCloudProvider(CloudProvider):
 
     def _pick_instance_type(self, reqs: Requirements, claim: NodeClaim) -> InstanceType:
         from karpenter_tpu.cloudprovider.types import order_by_price
-        from karpenter_tpu.utils.resources import fits
+        from karpenter_tpu.utils.resources import fits_declared
 
         compatible = [
             it
             for it in self.types
             if it.requirements.intersects(reqs) is None
             and it.offerings.available().has_compatible(reqs)
-            and fits(claim.spec.resources, it.allocatable)
+            and fits_declared(claim.spec.resources, it.allocatable)
         ]
         if not compatible:
             raise Exception(f"no compatible instance type for {claim.metadata.name}")

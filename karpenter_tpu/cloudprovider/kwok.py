@@ -47,7 +47,7 @@ from karpenter_tpu.kube.objects import (
 )
 from karpenter_tpu.scheduling.requirement import Requirement
 from karpenter_tpu.scheduling.requirements import Requirements
-from karpenter_tpu.utils.resources import fits
+from karpenter_tpu.utils.resources import fits_declared
 
 
 @dataclass
@@ -96,7 +96,7 @@ class KwokCloudProvider(CloudProvider):
                 for it in self.types
                 if it.requirements.intersects(reqs) is None
                 and it.offerings.available().has_compatible(reqs)
-                and fits(node_claim.spec.resources, it.allocatable)
+                and fits_declared(node_claim.spec.resources, it.allocatable)
             ]
             if not compatible:
                 raise InsufficientCapacityError(

@@ -63,8 +63,14 @@ class Operator:
     def __post_init__(self) -> None:
         # decorators (kwok/main.go:37, controllers.go wiring)
         provider = MetricsCloudProvider(self.cloud_provider)
+        self.overlay_controller = None
         if self.options.feature_gates.node_overlay:
+            from karpenter_tpu.apis.v1alpha1.nodeoverlay import (
+                NodeOverlayController,
+            )
+
             provider = OverlayCloudProvider(provider, self.kube)
+            self.overlay_controller = NodeOverlayController(self.kube, provider)
         self.provider = provider
 
         self.cluster = Cluster(self.kube)
@@ -121,6 +127,9 @@ class Operator:
         controllers -> provisioning -> lifecycle -> disruption (on its
         poll period) -> orchestration -> termination -> hygiene."""
         now = time.time() if now is None else now
+        if self.overlay_controller is not None:
+            # overlay snapshot before anything consumes instance types
+            self.overlay_controller.reconcile(now=now)
         self.hydration.reconcile_all()
         self.nodepool_status.reconcile_all(now=now)
         self.static.reconcile_all(now=now)

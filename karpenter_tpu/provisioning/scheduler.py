@@ -39,6 +39,7 @@ from karpenter_tpu.cloudprovider.types import (
     truncate,
 )
 from karpenter_tpu.kube.objects import Pod
+from karpenter_tpu.scheduling.hostports import HostPortUsage, pod_host_ports
 from karpenter_tpu.scheduling.requirement import IN, Requirement
 from karpenter_tpu.scheduling.requirements import Requirements
 from karpenter_tpu.scheduling.taints import tolerates_pod
@@ -129,7 +130,14 @@ class Scheduler:
             for pool, types in self.pools_with_types:
                 pool_reqs = _pool_requirements(pool)
                 if pool_reqs.has_min_values():
-                    _, err = satisfies_min_values(list(types), pool_reqs)
+                    # count only types the pool's own requirements admit
+                    # — raw-catalog counting would let an unsatisfiable
+                    # pool survive on incompatible types
+                    compatible = [
+                        it for it in types
+                        if pool_reqs.intersects(it.requirements) is None
+                    ]
+                    _, err = satisfies_min_values(compatible, pool_reqs)
                     if err is not None:
                         continue
                 kept.append((pool, types))
@@ -166,6 +174,15 @@ class Scheduler:
 
         self.daemon_overhead = self._daemon_overhead()
         self.topology = self._build_topology()
+
+        # per-node host-port reservations from live pods
+        # (hostportusage.go; consumed by the per-pod path)
+        self._host_ports: dict[str, HostPortUsage] = {}
+        for pod in self.cluster_pods:
+            if pod.spec.node_name and pod_host_ports(pod):
+                self._host_ports.setdefault(
+                    pod.spec.node_name, HostPortUsage()
+                ).add(pod)
 
     # -- construction helpers -------------------------------------------------
 
@@ -246,7 +263,13 @@ class Scheduler:
         simple: list[Pod] = []
         complex_: list[Pod] = []
         for pod in pods:
-            (complex_ if topology_full.has_constraints(pod) else simple).append(pod)
+            # host-port pods need per-node conflict tracking: the
+            # grouped fast path would stack identical pods whose ports
+            # collide (hostportusage.go), so they go per-pod
+            if topology_full.has_constraints(pod) or pod_host_ports(pod):
+                complex_.append(pod)
+            else:
+                simple.append(pod)
 
         results = SchedulerResults(new_node_plans=[], existing_assignments={})
 
@@ -410,22 +433,41 @@ class Scheduler:
                 continue
             if not resutil.fits(requests, inp.available):
                 continue
+            if pod_host_ports(pod):
+                # keyed by inp.name: an in-flight node has no Node yet,
+                # so node.name is "" and unnamed nodes would share (and
+                # falsely conflict in) one bucket
+                usage = self._host_ports.setdefault(inp.name, HostPortUsage())
+                if usage.conflict(pod) is not None:
+                    continue
             labels = node.labels()
             candidate = {k: {v} for k, v in labels.items()}
-            candidate[HOSTNAME_LABEL] = {node.name}
+            candidate[HOSTNAME_LABEL] = {inp.name}
             allowed = topology.allowed_domains_for_pod(pod, candidate)
             if allowed is None:
                 continue
             node_mut = self.state_nodes[idx]
             self._commit_existing(node_mut, pod)
-            results.existing_assignments.setdefault(node.name, []).append(pod)
+            if pod_host_ports(pod):
+                self._host_ports[inp.name].add(pod)
+            results.existing_assignments.setdefault(inp.name, []).append(pod)
             topology.register(pod, {k: next(iter(v)) for k, v in allowed.items() if v})
             return True
 
         # 2) open planned nodes
         for plan in open_plans:
+            if pod_host_ports(pod):
+                # port check first: _plan_can_add narrows the plan's
+                # type options as a side effect of admission
+                usage = self._host_ports.setdefault(
+                    f"planned-{id(plan)}", HostPortUsage()
+                )
+                if usage.conflict(pod) is not None:
+                    continue
             if not self._plan_can_add(plan, pod, pod_reqs, requests, topology):
                 continue
+            if pod_host_ports(pod):
+                self._host_ports[f"planned-{id(plan)}"].add(pod)
             plan.pods.append(pod)
             topology.register(pod, self._plan_domains(plan))
             return True
@@ -490,6 +532,10 @@ class Scheduler:
                 price=chosen_offerings[0].price,
             )
             open_plans.append(plan)
+            if pod_host_ports(pod):
+                usage = HostPortUsage()
+                usage.add(pod)
+                self._host_ports[f"planned-{id(plan)}"] = usage
             topology.register(pod, self._plan_domains(plan))
             return True
         return False
@@ -537,7 +583,7 @@ class Scheduler:
             plan.instance_types = truncate(
                 plan.instance_types, pool_reqs, MAX_INSTANCE_TYPES
             )
-        except Exception:
+        except ValueError:
             # truncation cannot keep the minValues floor —
             # _enforce_min_values decides reject (Strict) vs relax
             plan.instance_types = truncate(

@@ -60,6 +60,19 @@ def fits(candidate: Mapping[str, float], total: Mapping[str, float]) -> bool:
     return True
 
 
+def fits_declared(candidate: Mapping[str, float], total: Mapping[str, float]) -> bool:
+    """`fits` over only the resources `total` declares.
+
+    Providers materializing a claim check size against the *raw*
+    catalog; extended resources the raw type doesn't declare may be
+    legitimately injected at scheduling time (NodeOverlay capacity, or
+    a device plugin on the real node) and must not fail the launch."""
+    for key, value in candidate.items():
+        if key in total and value > total[key] + 1e-9:
+            return False
+    return True
+
+
 def is_zero(rl: Mapping[str, float]) -> bool:
     return all(abs(v) < 1e-9 for v in rl.values())
 

@@ -271,8 +271,44 @@ class TestOperatorRuntime:
             options=Options(feature_gates=FeatureGates(node_overlay=True)),
         )
         kube.create(NodeOverlay(spec=NodeOverlaySpec(price="0.01")))
+        # before the first evaluation the pool is gated
+        # (UnevaluatedNodePoolError, nodeoverlay/controller.go:69-140)
+        import pytest
+
+        from karpenter_tpu.apis.v1alpha1.nodeoverlay import (
+            UnevaluatedNodePoolError,
+        )
+
+        with pytest.raises(UnevaluatedNodePoolError):
+            op.provider.get_instance_types(None)
+        op.overlay_controller.reconcile()
         out = op.provider.get_instance_types(None)
         assert all(o.price == 0.01 for it in out for o in it.offerings)
+
+    def test_overlay_conflicts_flagged(self):
+        from karpenter_tpu.apis.v1alpha1.nodeoverlay import (
+            COND_OVERLAY_VALIDATION,
+            NodeOverlayController,
+            OverlayCloudProvider,
+        )
+        from karpenter_tpu.cloudprovider.fake import FakeCloudProvider
+        from karpenter_tpu.kube.client import KubeClient
+
+        kube = KubeClient()
+        a = NodeOverlay(metadata=ObjectMeta(name="a"),
+                        spec=NodeOverlaySpec(weight=5, price="1.0"))
+        b = NodeOverlay(metadata=ObjectMeta(name="b"),
+                        spec=NodeOverlaySpec(weight=5, price="2.0"))
+        kube.create(a)
+        kube.create(b)
+        provider = OverlayCloudProvider(FakeCloudProvider(types()), kube)
+        ctrl = NodeOverlayController(kube, provider)
+        ctrl.reconcile()
+        assert a.status_conditions.is_true(COND_OVERLAY_VALIDATION)
+        assert b.status_conditions.is_false(COND_OVERLAY_VALIDATION)
+        # only the valid overlay applies
+        out = provider.get_instance_types(None)
+        assert all(o.price == 1.0 for it in out for o in it.offerings)
 
 
 class TestMetricsControllers:
@@ -361,3 +397,30 @@ class TestMetricsControllers:
         op.pod_metrics.reconcile_all()
         assert PODS_SCHEDULING_DURATION.count() > before_sched
         assert PODS_STARTUP_DURATION.count() > before_start
+
+    def test_overlay_capacity_launches_through_operator(self):
+        """Overlay-injected extended capacity must survive the launch:
+        the provider checks claim size only against resources the raw
+        type declares (fits_declared)."""
+        import time as _time
+
+        from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
+        from karpenter_tpu.kube.client import KubeClient
+
+        kube = KubeClient()
+        cloud = KwokCloudProvider(kube, types=types())
+        op = Operator(
+            kube=kube, cloud_provider=cloud,
+            options=Options(feature_gates=FeatureGates(node_overlay=True)),
+        )
+        kube.create(mk_nodepool("g"))
+        kube.create(NodeOverlay(spec=NodeOverlaySpec(
+            capacity={"example.com/fpga": 2.0})))
+        pod = mk_pod(name="fpga", cpu=0.5)
+        pod.spec.containers[0].requests["example.com/fpga"] = 1.0
+        kube.create(pod)
+        now = _time.time()
+        for _ in range(8):
+            now += 2
+            op.step(now=now)
+        assert [p for p in kube.pods() if p.spec.node_name]

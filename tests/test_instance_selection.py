@@ -105,17 +105,19 @@ class TestSelection:
         assert prices == sorted(prices)
 
 
+def _pool_with_min_values(n):
+    pool = mk_nodepool("p")
+    pool.spec.template.spec.requirements = [
+        RequirementSpec(
+            key=INSTANCE_TYPE_LABEL,
+            operator="Exists",
+            min_values=n,
+        )
+    ]
+    return pool
+
+
 class TestMinValues:
-    def _pool_with_min_values(self, n):
-        pool = mk_nodepool("p")
-        pool.spec.template.spec.requirements = [
-            RequirementSpec(
-                key=INSTANCE_TYPE_LABEL,
-                operator="Exists",
-                min_values=n,
-            )
-        ]
-        return pool
 
     def test_satisfies_min_values(self):
         types = catalog()
@@ -142,7 +144,7 @@ class TestMinValues:
 
     def test_claim_keeps_min_values_flexibility(self):
         env = Environment(types=catalog())
-        env.kube.create(self._pool_with_min_values(2))
+        env.kube.create(_pool_with_min_values(2))
         env.provision(mk_pod(cpu=1.0))
         claim = env.kube.node_claims()[0]
         type_req = next(
@@ -153,7 +155,7 @@ class TestMinValues:
 
     def test_unsatisfiable_min_values_blocks(self):
         env = Environment(types=catalog())
-        env.kube.create(self._pool_with_min_values(10))
+        env.kube.create(_pool_with_min_values(10))
         env.provision(mk_pod(cpu=1.0))
         assert not env.kube.node_claims()
 
@@ -187,7 +189,7 @@ class TestTruncation:
         from karpenter_tpu.provisioning.provisioner import Provisioner
 
         env = Environment(types=catalog())
-        env.kube.create(TestMinValues._pool_with_min_values(None, 10))
+        env.kube.create(_pool_with_min_values(10))
         prov = Provisioner(
             env.kube, env.cluster, env.cloud,
             options=Options(min_values_policy="BestEffort"),

@@ -147,3 +147,38 @@ class TestHostPorts:
         usage.add(pod1)
         assert usage.conflict(pod2) is not None
         assert usage.conflict(pod3) is None
+
+
+class TestHostPortScheduling:
+    """Host-port conflicts route through the per-pod path and force
+    separate nodes (hostportusage.go wired into the scheduler)."""
+
+    def test_host_port_pods_get_separate_nodes(self):
+        from karpenter_tpu.cloudprovider.fake import GIB, make_instance_type
+        from karpenter_tpu.provisioning.scheduler import Scheduler
+        from karpenter_tpu.testing import mk_nodepool, mk_pod
+
+        pods = []
+        for i in range(3):
+            pod = mk_pod(name=f"hp-{i}", cpu=0.25)
+            pod.spec.containers[0].ports = [8080]
+            pods.append(pod)
+        types = [make_instance_type("c8", cpu=8, memory=32 * GIB, price=1.0)]
+        sched = Scheduler(pools_with_types=[(mk_nodepool("p"), types)])
+        res = sched.solve(pods)
+        assert res.scheduled_count == 3
+        assert len(res.new_node_plans) == 3, "conflicting ports must not share a node"
+
+    def test_mixed_port_and_plain_pods_share(self):
+        from karpenter_tpu.cloudprovider.fake import GIB, make_instance_type
+        from karpenter_tpu.provisioning.scheduler import Scheduler
+        from karpenter_tpu.testing import mk_nodepool, mk_pod
+
+        porty = mk_pod(name="porty", cpu=0.25)
+        porty.spec.containers[0].ports = [443]
+        plain = [mk_pod(name=f"plain-{i}", cpu=0.25) for i in range(3)]
+        types = [make_instance_type("c8", cpu=8, memory=32 * GIB, price=1.0)]
+        sched = Scheduler(pools_with_types=[(mk_nodepool("p"), types)])
+        res = sched.solve([porty] + plain)
+        assert res.scheduled_count == 4
+        assert len(res.new_node_plans) == 1
