@@ -1,0 +1,54 @@
+"""JAX platform pinning for the single-tenant TPU environment.
+
+The ambient environment points JAX at one real TPU chip behind a
+tunnel (`JAX_PLATFORMS=axon`) and a site hook overwrites the
+`jax_platforms` *config* at interpreter startup, so exporting the env
+var alone doesn't stick — the config must be updated directly before
+any backend initializes. Tests, the multichip dryrun, and the bench's
+fallback path all need the same recipe; keep it in one place.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+
+def force_cpu_mesh(n_devices: int = 0) -> None:
+    """Pin JAX to the CPU platform, optionally with `n_devices` virtual
+    devices for sharding tests. Must be called before the first JAX
+    backend touch in the process; raises if a non-CPU backend already
+    initialized (too late to repin).
+    """
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    if n_devices:
+        flags = os.environ.get("XLA_FLAGS", "")
+        m = re.search(r"--xla_force_host_platform_device_count=(\d+)", flags)
+        if m:
+            if int(m.group(1)) < n_devices:
+                flags = flags.replace(
+                    m.group(0),
+                    f"--xla_force_host_platform_device_count={n_devices}",
+                )
+                os.environ["XLA_FLAGS"] = flags
+        else:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={n_devices}"
+            ).strip()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    backend = jax.default_backend()
+    if backend != "cpu":
+        raise RuntimeError(
+            f"backend {backend!r} initialized before force_cpu_mesh() — "
+            "too late to repin; call it before any JAX backend touch"
+        )
+    if n_devices:
+        have = len(jax.devices())
+        if have < n_devices:
+            raise RuntimeError(
+                f"need {n_devices} cpu devices, have {have} — a backend "
+                "initialized before force_cpu_mesh() could set XLA_FLAGS"
+            )

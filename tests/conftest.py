@@ -2,21 +2,16 @@
 
 The driver benches on one real TPU chip; tests exercise the sharded
 solver paths on 8 virtual CPU devices so multi-chip layouts are
-validated without hardware.
+validated without hardware. The pin recipe (env var + direct config
+update, required because the axon site hook overwrites jax_platforms
+at interpreter startup) lives in karpenter_tpu.utils.platform.
 """
 
 import os
+import sys
 
-# Force CPU even when the ambient environment points JAX at a TPU
-# platform. The axon site hook overwrites the jax_platforms *config*
-# at interpreter startup (env vars alone don't stick), so override the
-# config directly before any backend initializes: the TPU chip is
-# single-tenant and tests must never touch it.
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import jax
+from karpenter_tpu.utils.platform import force_cpu_mesh
 
-jax.config.update("jax_platforms", "cpu")
+force_cpu_mesh(8)
