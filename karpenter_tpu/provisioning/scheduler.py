@@ -172,6 +172,18 @@ class Scheduler:
             if rid:
                 self.reserved_in_use[rid] = self.reserved_in_use.get(rid, 0) + 1
 
+        # total instances per reservation id (for per-pod path budget
+        # checks; the batched path enforces the same budget in-kernel)
+        self._rsv_capacity: dict[str, int] = {}
+        for _, types in self.pools_with_types:
+            for it in types:
+                for o in it.offerings:
+                    if o.is_reserved():
+                        self._rsv_capacity[o.reservation_id] = max(
+                            self._rsv_capacity.get(o.reservation_id, 0),
+                            o.reservation_capacity,
+                        )
+
         self.daemon_overhead = self._daemon_overhead()
         self.topology = self._build_topology()
 
@@ -273,11 +285,18 @@ class Scheduler:
 
         results = SchedulerResults(new_node_plans=[], existing_assignments={})
 
+        # reservation budget for THIS round: live usage plus every plan
+        # opened during the round, batched or per-pod, so later
+        # placements (retries, complex pods) never re-grant budget a
+        # sibling plan already consumed (reservationmanager.go debits
+        # across all in-flight nodeclaims of one scheduling run)
+        round_in_use: dict[str, int] = dict(self.reserved_in_use)
+
         # fast path: one batched solve on device
         open_plans: list[NodePlan] = []
         if simple:
-            solution = self._batched_solve(simple)
-            open_plans = solution.new_nodes
+            solution = self._batched_solve(simple, reserved_in_use=round_in_use)
+            self._accept_plans(solution.new_nodes, open_plans, results, round_in_use)
             for assignment in solution.existing:
                 node = self.state_nodes[assignment.existing_index]
                 results.existing_assignments.setdefault(node.name, []).extend(
@@ -290,9 +309,14 @@ class Scheduler:
                 if self.honor_preferences:
                     relaxed = relax(pod)
                     if relaxed:
-                        retry = self._batched_solve([pod], required_only=True)
+                        retry = self._batched_solve(
+                            [pod], required_only=True,
+                            reserved_in_use=round_in_use,
+                        )
                         if not retry.unschedulable:
-                            open_plans.extend(retry.new_nodes)
+                            self._accept_plans(
+                                retry.new_nodes, open_plans, results, round_in_use
+                            )
                             for a in retry.existing:
                                 node = self.state_nodes[a.existing_index]
                                 results.existing_assignments.setdefault(
@@ -309,7 +333,9 @@ class Scheduler:
 
         # slow path: per-pod with topology filtering
         if complex_:
-            self._solve_complex(complex_, open_plans, topology_full, results)
+            self._solve_complex(
+                complex_, open_plans, topology_full, results, round_in_use
+            )
 
         for plan in open_plans:
             self._finalize_plan(plan)
@@ -348,16 +374,56 @@ class Scheduler:
                 out[pod_key] = mapping
         return out
 
-    def _batched_solve(self, pods: Sequence[Pod], required_only: bool = False) -> Solution:
+    def _batched_solve(
+        self,
+        pods: Sequence[Pod],
+        required_only: bool = False,
+        reserved_in_use: Optional[dict[str, int]] = None,
+    ) -> Solution:
         groups = group_pods(pods, required_only=required_only)
         enc = encode(
             groups,
             self.pools_with_types,
             self.existing_inputs,
             self.daemon_overhead,
-            reserved_in_use=self.reserved_in_use,
+            reserved_in_use=(
+                reserved_in_use if reserved_in_use is not None
+                else self.reserved_in_use
+            ),
         )
         return solve_encoded(enc)
+
+    def _rsv_remaining(self, rid: str, round_in_use: dict[str, int]) -> int:
+        """Instances left on a reservation after live nodes AND plans
+        opened earlier in this scheduling round (reservationmanager.go
+        debits across all in-flight nodeclaims of a run)."""
+        return self._rsv_capacity.get(rid, 0) - round_in_use.get(rid, 0)
+
+    @staticmethod
+    def _debit_reservations(plans: Sequence[NodePlan], round_in_use: dict[str, int]) -> None:
+        for plan in plans:
+            if plan.reservation_id:
+                round_in_use[plan.reservation_id] = (
+                    round_in_use.get(plan.reservation_id, 0) + 1
+                )
+
+    def _accept_plans(
+        self,
+        new_nodes: Sequence[NodePlan],
+        open_plans: list[NodePlan],
+        results: SchedulerResults,
+        round_in_use: dict[str, int],
+    ) -> None:
+        """Admit a batched solution's planned nodes into the round:
+        Strict minValues rejects a plan BEFORE its pods enter the
+        topology tracker (phantom pods would skew spread/anti-affinity
+        for the rest of the round), and survivors debit the round's
+        reservation budget exactly once."""
+        kept = [
+            plan for plan in new_nodes if self._enforce_min_values(plan, results)
+        ]
+        self._debit_reservations(kept, round_in_use)
+        open_plans.extend(kept)
 
     def _commit_existing(self, node: StateNode, pod: Pod) -> None:
         usage = resutil.pod_requests(pod)
@@ -385,6 +451,7 @@ class Scheduler:
         open_plans: list[NodePlan],
         topology: Topology,
         results: SchedulerResults,
+        round_in_use: dict[str, int],
     ) -> None:
         """Per-pod scheduling with topology domain filtering.
 
@@ -402,7 +469,7 @@ class Scheduler:
         )
         for pod in ordered:
             for _ in range(8):  # relaxation ladder bound
-                if self._try_place(pod, open_plans, topology, results):
+                if self._try_place(pod, open_plans, topology, results, round_in_use):
                     break
                 if not (self.honor_preferences and relax(pod)):
                     results.errors[pod.key] = (
@@ -416,6 +483,7 @@ class Scheduler:
         open_plans: list[NodePlan],
         topology: Topology,
         results: SchedulerResults,
+        round_in_use: dict[str, int],
     ) -> bool:
         pod_reqs = Requirements.from_pod(pod)
         requests = resutil.pod_requests(pod)
@@ -517,12 +585,29 @@ class Scheduler:
                 offs2 = [
                     o for o in offs
                     if o.zone in allowed_zones and o.capacity_type in allowed_cts
+                    # a reserved offering only stays on the menu while
+                    # its reservation has budget left this round —
+                    # otherwise N per-pod plans could each pin the
+                    # near-free reservation past its instance count
+                    and (
+                        not o.is_reserved()
+                        or self._rsv_remaining(o.reservation_id, round_in_use) > 0
+                    )
                 ]
                 if offs2:
                     chosen_types.append(it)
                     chosen_offerings.extend(offs2)
             if not chosen_types:
                 continue
+            if self.min_values_policy != "BestEffort":
+                # Strict minValues checked at creation: a failing plan
+                # would otherwise be rejected after its pod already
+                # registered into the topology tracker
+                pool_reqs = _pool_requirements(pool)
+                if pool_reqs.has_min_values():
+                    _, mv_err = satisfies_min_values(chosen_types, pool_reqs)
+                    if mv_err is not None:
+                        continue
             chosen_offerings.sort(key=lambda o: o.price)
             plan = NodePlan(
                 pool=pool,
@@ -531,6 +616,9 @@ class Scheduler:
                 pods=[pod],
                 price=chosen_offerings[0].price,
             )
+            if chosen_offerings[0].is_reserved():
+                plan.reservation_id = chosen_offerings[0].reservation_id
+                self._debit_reservations([plan], round_in_use)
             open_plans.append(plan)
             if pod_host_ports(pod):
                 usage = HostPortUsage()

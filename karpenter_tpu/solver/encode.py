@@ -167,9 +167,12 @@ class Encoded:
     cfg_pool: np.ndarray                  # [C] int32 (pool order index; -1 pseudo)
     pool_overhead: np.ndarray             # [P+1, R] float32 daemon overhead per pool
     existing_used: np.ndarray             # [E, R] float32 (all zeros: available baked in)
-    cfg_cap: np.ndarray = None            # [C] float32 max nodes per config
-                                          # (inf = uncapped; finite for
-                                          # capacity-reservation offerings)
+    # Capacity-reservation budgets are keyed by reservation id, not by
+    # config column: several columns (zones, pools, dedupe survivors)
+    # can draw on ONE reservation and must share its remaining budget
+    # (ReservationManager semantics, scheduling/reservationmanager.go).
+    cfg_rsv: np.ndarray = None            # [C] int32 reservation slot, -1 = none
+    rsv_cap: np.ndarray = None            # [K] f32 remaining instances per slot
 
 
 def _config_requirements(
@@ -263,7 +266,9 @@ def encode(
     cfg_alloc = np.zeros((C, R), np.float32)
     cfg_price = np.zeros((C,), np.float32)
     cfg_pool = np.full((C,), -1, np.int32)
-    cfg_cap = np.full((C,), np.inf, np.float32)
+    cfg_rsv = np.full((C,), -1, np.int32)
+    rsv_slots: dict[str, int] = {}
+    rsv_cap_list: list[float] = []
     in_use = reserved_in_use or {}
     pool_order = {pool.metadata.name: i for i, (pool, _) in enumerate(pools_with_types)}
     for ci, cfg in enumerate(configs):
@@ -279,9 +284,17 @@ def encode(
             cfg_pool[ci] = pool_order[cfg.pool.metadata.name]
             rid = cfg.offering.reservation_id
             if rid:
-                cfg_cap[ci] = max(
-                    0, cfg.offering.reservation_capacity - in_use.get(rid, 0)
+                remaining = float(
+                    max(0, cfg.offering.reservation_capacity - in_use.get(rid, 0))
                 )
+                slot = rsv_slots.get(rid)
+                if slot is None:
+                    slot = len(rsv_cap_list)
+                    rsv_slots[rid] = slot
+                    rsv_cap_list.append(remaining)
+                else:
+                    rsv_cap_list[slot] = max(rsv_cap_list[slot], remaining)
+                cfg_rsv[ci] = slot
 
     compat = _compat_matrix(groups, configs)
 
@@ -337,7 +350,7 @@ def encode(
         cfg_alloc = np.ascontiguousarray(cfg_alloc[keep])
         cfg_price = np.ascontiguousarray(cfg_price[keep])
         cfg_pool = np.ascontiguousarray(cfg_pool[keep])
-        cfg_cap = np.ascontiguousarray(cfg_cap[keep])
+        cfg_rsv = np.ascontiguousarray(cfg_rsv[keep])
 
     return Encoded(
         resource_keys=keys,
@@ -352,7 +365,8 @@ def encode(
         cfg_pool=cfg_pool,
         pool_overhead=pool_overhead,
         existing_used=np.zeros((len(existing), R), np.float32),
-        cfg_cap=cfg_cap,
+        cfg_rsv=cfg_rsv,
+        rsv_cap=np.asarray(rsv_cap_list, np.float32),
     )
 
 

@@ -71,7 +71,8 @@ def pack(
     max_nodes: int,
     mode: str = "ffd",
     quota: jnp.ndarray | None = None,  # [N, G] i32 per-node group caps
-    cfg_cap: jnp.ndarray | None = None,  # [C] f32 max nodes per config
+    cfg_rsv: jnp.ndarray | None = None,  # [C] i32 reservation slot, -1 none
+    rsv_cap: jnp.ndarray | None = None,  # [K] f32 budget per reservation
 ):
     G, C = compat.shape
     R = group_req.shape[1]
@@ -83,13 +84,23 @@ def pack(
     node_active = jnp.zeros((N,), bool).at[:E].set(existing_mask.any(axis=1))
     assign = jnp.zeros((N, G), jnp.int32)
     unschedulable = jnp.zeros((G,), jnp.int32)
-    if cfg_cap is None:
-        cfg_cap = jnp.full((C,), BIG, jnp.float32)
-    capped = cfg_cap < BIG
+    if cfg_rsv is None:
+        cfg_rsv = jnp.full((C,), -1, jnp.int32)
+    if rsv_cap is None:
+        rsv_cap = jnp.zeros((0,), jnp.float32)
+    K = rsv_cap.shape[0]
+    capped = cfg_rsv >= 0
+    # Budgets are per RESERVATION, shared by every column drawing on it
+    # (zones / pools / dedupe survivors of one reservation id). Slot K
+    # is the uncapped sink with infinite budget.
+    rsv_cap_ext = jnp.concatenate([rsv_cap, jnp.full((1,), BIG, jnp.float32)])
+    cfg_slot = jnp.where(capped, cfg_rsv, K)  # [C] -> [K+1] index
     # Nodes pre-opened against a capped config (LP-planned reserved
-    # slots) consume that config's reservation budget up front.
-    cfg_used0 = (existing_mask.astype(jnp.float32).sum(axis=0) * capped).astype(
-        jnp.float32
+    # slots) consume that reservation's budget up front.
+    rsv_used0 = (
+        jnp.zeros((K + 1,), jnp.float32)
+        .at[cfg_slot]
+        .add(existing_mask.astype(jnp.float32).sum(axis=0) * capped)
     )
 
     def capacity(used_j, req):
@@ -109,7 +120,7 @@ def pack(
         open-node feasibility set never changes, so the per-pod scan
         would produce this same layout. Loop trip count is G,
         independent of pod count."""
-        node_mask, node_used, node_active, node_count, assign, unsched, cfg_used = state
+        node_mask, node_used, node_active, node_count, assign, unsched, rsv_used = state
         req = group_req[g]
         row = compat[g]
         remaining = group_count[g]
@@ -152,13 +163,13 @@ def pack(
         ) & (cfg_pool >= 0)
 
         def open_cond(args):
-            _, _, _, node_count, _, remaining, cfg_used = args
-            can = fits_fresh & (cfg_used < cfg_cap)
+            _, _, _, node_count, _, remaining, rsv_used = args
+            can = fits_fresh & (rsv_used[cfg_slot] < rsv_cap_ext[cfg_slot])
             return (remaining > 0) & can.any() & (node_count < N)
 
         def open_round(args):
-            node_mask, node_used, node_active, node_count, assign, remaining, cfg_used = args
-            fresh_ok = fits_fresh & (cfg_used < cfg_cap)
+            node_mask, node_used, node_active, node_count, assign, remaining, rsv_used = args
+            fresh_ok = fits_fresh & (rsv_used[cfg_slot] < rsv_cap_ext[cfg_slot])
             chosen_pool = jnp.min(jnp.where(fresh_ok, cfg_pool, INT_BIG))
             mask = fresh_ok & (cfg_pool == chosen_pool)
             overhead = pool_overhead[chosen_pool]
@@ -184,7 +195,10 @@ def pack(
                 c_res = jnp.argmax(jnp.where(res_mask, kf, -1))
                 c_star = jnp.where(res_mask.any(), c_res, jnp.argmax(kf))
             m_star = jnp.maximum(kf[c_star], 1)
-            cap_left = (cfg_cap[c_star] - cfg_used[c_star]).astype(jnp.float32)
+            slot_star = cfg_slot[c_star]
+            cap_left = jnp.minimum(
+                rsv_cap_ext[slot_star] - rsv_used[slot_star], 2.0e9
+            )
             q = jnp.minimum((remaining + m_star - 1) // m_star, N - node_count)
             q = jnp.minimum(q, jnp.maximum(cap_left, 0).astype(jnp.int32))
             q = jnp.maximum(q, 1)  # open_cond guarantees one is legal
@@ -227,33 +241,34 @@ def pack(
                 node_count + q,
                 assign.at[:, g].add(fill),
                 remaining - placed,
-                cfg_used.at[c_star].add(q.astype(jnp.float32)),
+                rsv_used.at[slot_star].add(q.astype(jnp.float32)),
             )
 
         (node_mask, node_used, node_active, node_count, assign, remaining,
-         cfg_used) = jax.lax.while_loop(
+         rsv_used) = jax.lax.while_loop(
             open_cond,
             open_round,
             (node_mask, node_used, node_active, node_count, assign, remaining,
-             cfg_used),
+             rsv_used),
         )
         unsched = unsched.at[g].add(jnp.maximum(remaining, 0))
         return (node_mask, node_used, node_active, node_count, assign, unsched,
-                cfg_used)
+                rsv_used)
 
     state = jax.lax.fori_loop(
         0,
         G,
         body,
         (node_mask, node_used, node_active, jnp.int32(E), assign, unschedulable,
-         cfg_used0),
+         rsv_used0),
     )
     node_mask, node_used, node_active, node_count, assign, unsched, _ = state
     return assign, node_mask, node_used, node_active, node_count, unsched
 
 
 @functools.partial(jax.jit, static_argnames=("max_nodes", "mode"))
-def pack_flat(*args, max_nodes: int, mode: str = "ffd", quota=None, cfg_cap=None):
+def pack_flat(*args, max_nodes: int, mode: str = "ffd", quota=None,
+              cfg_rsv=None, rsv_cap=None):
     """`pack` with every output concatenated into ONE float32 vector.
 
     The remote-device transport charges a fixed latency per
@@ -262,7 +277,8 @@ def pack_flat(*args, max_nodes: int, mode: str = "ffd", quota=None, cfg_cap=None
     pay that latency exactly once.
     """
     assign, node_mask, node_used, node_active, node_count, unsched = pack(
-        *args, max_nodes=max_nodes, mode=mode, quota=quota, cfg_cap=cfg_cap
+        *args, max_nodes=max_nodes, mode=mode, quota=quota,
+        cfg_rsv=cfg_rsv, rsv_cap=rsv_cap,
     )
     return jnp.concatenate(
         [
@@ -422,12 +438,13 @@ def _run_pack(
         quota_full = np.full((N, Gp), np.iinfo(np.int32).max, np.int32)
         quota_full[: quota.shape[0], :G] = quota[:, :G]
         quota_full = jnp.asarray(quota_full)
-    cfg_cap = None
-    if enc.cfg_cap is not None and np.isfinite(enc.cfg_cap).any():
-        uncapped = np.float32(BIG)  # pack classifies capped = cap < BIG
-        cap = np.full((Cp,), uncapped, np.float32)
-        cap[:C] = np.where(np.isfinite(enc.cfg_cap), enc.cfg_cap, uncapped)
-        cfg_cap = jnp.asarray(cap)
+    cfg_rsv = None
+    rsv_cap = None
+    if enc.rsv_cap is not None and enc.rsv_cap.size:
+        rsvp = np.full((Cp,), -1, np.int32)
+        rsvp[:C] = enc.cfg_rsv
+        cfg_rsv = jnp.asarray(rsvp)
+        rsv_cap = jnp.asarray(enc.rsv_cap.astype(np.float32))
     flat = pack_flat(
         jnp.asarray(compat),
         jnp.asarray(group_req),
@@ -441,7 +458,8 @@ def _run_pack(
         max_nodes=max_nodes,
         mode=mode,
         quota=quota_full,
-        cfg_cap=cfg_cap,
+        cfg_rsv=cfg_rsv,
+        rsv_cap=rsv_cap,
     )
     flat = np.asarray(flat)  # the one device->host fetch
     o0, o1, o2, o3, o4 = (

@@ -29,13 +29,17 @@ def solve_ffd_host(enc: Encoded) -> tuple[list[_Node], dict[int, int]]:
     """Returns (nodes, unschedulable{group: count})."""
     C = len(enc.configs)
     alloc = enc.cfg_alloc  # [C, R]
-    cap = (
-        enc.cfg_cap.astype(np.float64)
-        if enc.cfg_cap is not None
-        else np.full((C,), np.inf)
+    # reservation budgets shared per reservation id, not per column
+    cfg_rsv = (
+        enc.cfg_rsv if enc.cfg_rsv is not None else np.full((C,), -1, np.int32)
     )
-    capped = np.isfinite(cap)
-    cap_used = np.zeros((C,), np.float64)  # nodes opened per capped config
+    rsv_cap = (
+        enc.rsv_cap.astype(np.float64)
+        if enc.rsv_cap is not None
+        else np.zeros((0,), np.float64)
+    )
+    capped = cfg_rsv >= 0
+    rsv_used = np.zeros(len(rsv_cap), np.float64)
     nodes: list[_Node] = []
     for ei in range(enc.n_existing):
         mask = np.zeros((C,), bool)
@@ -61,7 +65,12 @@ def solve_ffd_host(enc: Encoded) -> tuple[list[_Node], dict[int, int]]:
             if placed:
                 continue
             # open new node on highest-weight (lowest index) admitting pool
-            fresh = row & (enc.cfg_pool >= 0) & (cap_used < cap)
+            if len(rsv_cap):
+                slot = np.clip(cfg_rsv, 0, None)
+                budget_ok = ~capped | (rsv_used[slot] < rsv_cap[slot])
+            else:
+                budget_ok = np.ones((C,), bool)
+            fresh = row & (enc.cfg_pool >= 0) & budget_ok
             overhead = enc.pool_overhead[enc.cfg_pool]
             fresh &= np.all(overhead + req[None, :] <= alloc + 1e-4, axis=1)
             if not fresh.any():
@@ -80,7 +89,7 @@ def solve_ffd_host(enc: Encoded) -> tuple[list[_Node], dict[int, int]]:
                 pin = reserved_opts[np.argmin(enc.cfg_price[reserved_opts])]
                 mask = np.zeros((C,), bool)
                 mask[pin] = True
-                cap_used[pin] += 1
+                rsv_used[cfg_rsv[pin]] += 1
             else:
                 # an uncapped option is strictly cheaper, so at least
                 # one survives the filter

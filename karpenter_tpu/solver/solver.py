@@ -58,6 +58,10 @@ class NodePlan:
         # set when BestEffort minValues policy relaxed the floor
         # (scheduler.go:649-658 / min-values-relaxed annotation)
         self.min_values_relaxed = False
+        # reservation id this node resolves onto (its cheapest feasible
+        # offering is reserved) — the claim will consume one instance
+        # of that reservation's budget (reservationmanager.go)
+        self.reservation_id = ""
 
     def _materialize(self) -> None:
         its, offs = self._lazy()
@@ -159,7 +163,7 @@ def _decode_device(enc: Encoded, objective: str = "ffd") -> Solution:
     # be weak on small or degenerate demands).
     from karpenter_tpu.solver import lp_plan
 
-    plan = lp_plan.plan(enc, cfg_cap=enc.cfg_cap)
+    plan = lp_plan.plan(enc)
     candidates = []
     ffd_result = solve_packing(enc, mode="ffd")
     candidates.append((ffd_result, _downsize_masks(enc, ffd_result)))
@@ -202,7 +206,7 @@ def _downsize_masks(enc: Encoded, result) -> np.ndarray:
     masks = result.node_mask.copy()
     launch = enc.cfg_pool >= 0
     uncapped = (
-        ~np.isfinite(enc.cfg_cap) if enc.cfg_cap is not None
+        enc.cfg_rsv < 0 if enc.cfg_rsv is not None
         else np.ones(len(enc.configs), bool)
     )
     for ni in range(result.node_count):
@@ -301,6 +305,7 @@ def _build_solution_arrays(
     sub_mask = node_masks[active_idx]
     price_mat = np.where(sub_mask, enc.cfg_price[None, :], np.inf)
     node_price = price_mat.min(axis=1)
+    price_col = price_mat.argmin(axis=1)
     first_col = sub_mask.argmax(axis=1)
     any_col = sub_mask.any(axis=1)
 
@@ -321,14 +326,18 @@ def _build_solution_arrays(
             )
             slot.pods.extend(pods)
             continue
-        new_nodes.append(
-            NodePlan(
-                pool=first_cfg.pool,
-                price=float(node_price[row]),
-                pods=pods,
-                lazy=_node_options(enc, sub_mask[row]),
-            )
+        plan = NodePlan(
+            pool=first_cfg.pool,
+            price=float(node_price[row]),
+            pods=pods,
+            lazy=_node_options(enc, sub_mask[row]),
         )
+        # the decode resolves the claim onto the cheapest offering; if
+        # that is a reserved one, the node consumes reservation budget
+        cheapest_cfg = enc.configs[int(price_col[row])]
+        if cheapest_cfg.offering is not None and cheapest_cfg.offering.reservation_id:
+            plan.reservation_id = cheapest_cfg.offering.reservation_id
+        new_nodes.append(plan)
 
     unschedulable: list[Pod] = []
     for gi in np.nonzero(unsched)[0]:

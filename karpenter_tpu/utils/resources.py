@@ -61,14 +61,21 @@ def fits(candidate: Mapping[str, float], total: Mapping[str, float]) -> bool:
 
 
 def fits_declared(candidate: Mapping[str, float], total: Mapping[str, float]) -> bool:
-    """`fits` over only the resources `total` declares.
+    """`fits` with leniency for undeclared EXTENDED resources only.
 
     Providers materializing a claim check size against the *raw*
     catalog; extended resources the raw type doesn't declare may be
     legitimately injected at scheduling time (NodeOverlay capacity, or
-    a device plugin on the real node) and must not fail the launch."""
+    a device plugin on the real node) and must not fail the launch.
+    Core resources (cpu/memory/pods/ephemeral-storage) can never be
+    injected that way — a type that doesn't declare them cannot run
+    the pods, so they stay strict to catch solver/claim sizing bugs."""
+    core = (CPU, MEMORY, PODS, EPHEMERAL_STORAGE)
     for key, value in candidate.items():
-        if key in total and value > total[key] + 1e-9:
+        if key in total:
+            if value > total[key] + 1e-9:
+                return False
+        elif key in core and value > 1e-9:
             return False
     return True
 

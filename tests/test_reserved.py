@@ -95,8 +95,76 @@ class TestSolverReservationCaps:
             [(pool, reserved_types(capacity=2))],
             reserved_in_use={"rsv-1": 1},
         )
-        caps = enc.cfg_cap[np.isfinite(enc.cfg_cap)]
-        assert list(caps) == [1.0]
+        assert list(enc.rsv_cap) == [1.0]
+        assert (enc.cfg_rsv >= 0).sum() >= 1
+
+    def test_shared_budget_across_columns(self):
+        """Two instance types drawing on ONE reservation id must share
+        its budget — per-column caps would let the solver open 2x the
+        reservation (reservationmanager.go keys budgets by id)."""
+        pool = mk_nodepool("p")
+        types = [
+            make_instance_type(
+                "c4a", cpu=4, memory=16 * GIB, price=1.0,
+                reservations=[("rsv-s", "test-zone-1", 2)],
+            ),
+            make_instance_type(
+                "c4b", cpu=4, memory=16 * GIB, price=1.1,
+                reservations=[("rsv-s", "test-zone-2", 2)],
+            ),
+        ]
+        sol = solve(_pods(6), [(pool, types)], objective="cost")
+        assert not sol.unschedulable
+        reserved_nodes = [
+            n for n in sol.new_nodes
+            if n.offerings and n.offerings[0].is_reserved()
+        ]
+        assert len(reserved_nodes) <= 2, (
+            f"{len(reserved_nodes)} reserved nodes overcommit the "
+            "2-instance shared reservation"
+        )
+
+
+class TestPerPodPathBudget:
+    def test_complex_path_respects_reservation_budget(self):
+        """Host-port pods route through the per-pod (complex) path;
+        its new-node plans must debit the same round budget as the
+        batched path — otherwise N such pods each pin the near-free
+        reservation past its instance count (ADVICE r1 medium)."""
+        from karpenter_tpu.provisioning.scheduler import Scheduler
+
+        pool = mk_nodepool("p")
+        pods = []
+        for i in range(5):
+            pod = mk_pod(name=f"hp-{i}", cpu=3.5)
+            pod.spec.containers[0].ports = [8080]
+            pods.append(pod)
+        sched = Scheduler(pools_with_types=[(pool, reserved_types(capacity=2))])
+        res = sched.solve(pods)
+        assert res.scheduled_count == 5
+        reserved_plans = [
+            p for p in res.new_node_plans
+            if p.offerings and p.offerings[0].is_reserved()
+        ]
+        assert len(reserved_plans) <= 2, (
+            f"{len(reserved_plans)} per-pod plans overcommit the "
+            "2-instance reservation"
+        )
+
+    def test_retry_path_sees_round_debits(self):
+        """The relaxed-preference retry re-encodes with the round's
+        debits, not the stale pre-round usage (ADVICE r1 low)."""
+        from karpenter_tpu.provisioning.scheduler import Scheduler
+
+        pool = mk_nodepool("p")
+        sched = Scheduler(pools_with_types=[(pool, reserved_types(capacity=1))])
+        res = sched.solve(_pods(3))
+        assert res.scheduled_count == 3
+        reserved_plans = [
+            p for p in res.new_node_plans
+            if p.offerings and p.offerings[0].is_reserved()
+        ]
+        assert len(reserved_plans) <= 1
 
 
 class TestReservationEndToEnd:
