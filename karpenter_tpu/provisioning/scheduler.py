@@ -44,6 +44,7 @@ from karpenter_tpu.scheduling.requirement import IN, Requirement
 from karpenter_tpu.scheduling.requirements import Requirements
 from karpenter_tpu.scheduling.taints import tolerates_pod
 from karpenter_tpu.scheduling.topology import Topology
+from karpenter_tpu.solver import topo_batch
 from karpenter_tpu.solver.encode import (
     ExistingNodeInput,
     PodGroup,
@@ -331,10 +332,103 @@ class Scheduler:
                 for pod in plan.pods:
                     topology_full.register(pod, self._plan_domains(plan))
 
-        # slow path: per-pod with topology filtering
+        # topology path: lower spread/affinity/ports to solver-native
+        # form (domain pins + per-node caps + group conflicts) and run
+        # ONE batched device solve; only what the lowering cannot
+        # express falls back to the per-pod loop (solver/topo_batch.py)
+        deferred: list[Pod] = []
         if complex_:
+            # open fast-path plans join the solve as pseudo-existing
+            # nodes (in-flight NodeClaim model) so constrained pods can
+            # share them instead of opening fresh capacity
+            plan_refs: list[NodePlan] = []
+            plan_inputs: list[ExistingNodeInput] = []
+            for plan in open_plans:
+                inp = self._plan_input(plan)
+                if inp is not None:
+                    plan_refs.append(plan)
+                    plan_inputs.append(inp)
+            existing_all = list(self.existing_inputs) + plan_inputs
+            tb = topo_batch.prepare(
+                complex_, topology_full, existing_all, self._host_ports
+            )
+            results.errors.update(tb.errors)
+            deferred = list(tb.fallback)
+            if tb.groups:
+                enc = encode(
+                    tb.groups,
+                    self.pools_with_types,
+                    existing_all,
+                    self.daemon_overhead,
+                    reserved_in_use=round_in_use,
+                    group_cap=tb.group_cap,
+                    conflict=tb.conflict,
+                    existing_quota=tb.existing_quota,
+                )
+                solution = solve_encoded(enc)
+                n_before = len(open_plans)
+                self._accept_plans(
+                    solution.new_nodes, open_plans, results, round_in_use
+                )
+                E = len(self.existing_inputs)
+                for a in solution.existing:
+                    inp = existing_all[a.existing_index]
+                    if a.existing_index >= E:
+                        # pods joined an open fast-path plan: narrow its
+                        # options to types that hold the enlarged pod
+                        # set and admit the new pods' requirements (the
+                        # in-flight NodeClaim re-filter,
+                        # nodeclaim.go:373-447)
+                        plan = plan_refs[a.existing_index - E]
+                        used = resutil.merge(
+                            self.daemon_overhead.get(plan.pool.metadata.name, {}),
+                            resutil.requests_for_pods(plan.pods + a.pods),
+                        )
+                        joined_reqs = [Requirements.from_pod(p) for p in a.pods]
+                        fitting = [
+                            it for it in plan.instance_types
+                            if resutil.fits(used, it.allocatable)
+                            and all(
+                                it.requirements.intersects(r) is None
+                                for r in joined_reqs
+                            )
+                        ]
+                        if not fitting:
+                            deferred.extend(a.pods)
+                            continue
+                        plan.instance_types = fitting
+                        plan.offerings = [
+                            o for o in plan.offerings
+                            if any(it.offerings and o in it.offerings for it in fitting)
+                        ] or plan.offerings
+                        plan.pods.extend(a.pods)
+                        domains = self._plan_domains(plan)
+                        for p in a.pods:
+                            self._register_topo_pod(
+                                p, domains, inp.name, tb, topology_full
+                            )
+                        continue
+                    node = self.state_nodes[a.existing_index]
+                    results.existing_assignments.setdefault(
+                        inp.name, []
+                    ).extend(a.pods)
+                    labels = dict(node.labels())
+                    labels[HOSTNAME_LABEL] = inp.name
+                    for p in a.pods:
+                        self._commit_existing(node, p)
+                        self._register_topo_pod(p, labels, inp.name, tb, topology_full)
+                for plan in open_plans[n_before:]:
+                    domains = self._plan_domains(plan)
+                    for p in plan.pods:
+                        self._register_topo_pod(
+                            p, domains, f"planned-{id(plan)}", tb, topology_full
+                        )
+                deferred.extend(solution.unschedulable)
+
+        # slow path: per-pod with topology filtering
+        if deferred:
             self._solve_complex(
-                complex_, open_plans, topology_full, results, round_in_use
+                deferred, open_plans, topology_full, results, round_in_use
             )
 
         for plan in open_plans:
@@ -432,6 +526,50 @@ class Scheduler:
         # refresh solver input for subsequent passes
         idx = self.state_nodes.index(node)
         self.existing_inputs[idx] = self._existing_input(node)
+
+    def _register_topo_pod(
+        self, pod: Pod, base_domains: dict[str, str], host_port_key: str,
+        tb, topology: Topology,
+    ) -> None:
+        """Commit one lowered-solve placement into the round's topology
+        tracker and host-port ledger (assignment domains override the
+        node's representative ones)."""
+        chosen = dict(base_domains)
+        chosen.update(tb.assignments.get(pod.key, {}))
+        topology.register(pod, chosen)
+        if pod_host_ports(pod):
+            self._host_ports.setdefault(host_port_key, HostPortUsage()).add(pod)
+
+    def _plan_input(self, plan: NodePlan) -> Optional[ExistingNodeInput]:
+        """An open plan as a pseudo-existing node for the lowered
+        topology solve — the in-flight NodeClaim model (scheduling/
+        nodeclaim.go:114-167): remaining capacity is the cheapest
+        instance-type option that still holds the plan's current pods."""
+        used = resutil.merge(
+            self.daemon_overhead.get(plan.pool.metadata.name, {}),
+            resutil.requests_for_pods(plan.pods),
+        )
+        for it in plan.instance_types:  # price-ordered
+            if resutil.fits(used, it.allocatable):
+                avail = resutil.positive(resutil.subtract(it.allocatable, used))
+                break
+        else:
+            return None
+        labels = self._plan_domains(plan)
+        reqs = Requirements.from_labels(labels)
+        for key, value in plan.pool.spec.template.labels.items():
+            reqs.add(Requirement(key, IN, [value]))
+        taints = tuple(plan.pool.spec.template.spec.taints) + tuple(
+            plan.pool.spec.template.spec.startup_taints
+        )
+        return ExistingNodeInput(
+            name=f"planned-{id(plan)}",
+            requirements=reqs,
+            taints=taints,
+            available=avail,
+            pool_name=plan.pool.metadata.name,
+            pod_count=len(plan.pods),
+        )
 
     def _plan_domains(self, plan: NodePlan) -> dict[str, str]:
         """Representative domains for a planned node."""

@@ -173,6 +173,14 @@ class Encoded:
     # (ReservationManager semantics, scheduling/reservationmanager.go).
     cfg_rsv: np.ndarray = None            # [C] int32 reservation slot, -1 = none
     rsv_cap: np.ndarray = None            # [K] f32 remaining instances per slot
+    # Topology constraints lowered to solver-native form (see
+    # solver/topo_batch.py): per-node pod caps per group (hostname
+    # spread) and pairwise node-sharing exclusions (hostname
+    # anti-affinity, host-port collisions).
+    group_cap: np.ndarray = None          # [G] int32 max pods of g per node
+    conflict: np.ndarray = None           # [G, G] bool mutually exclusive groups
+    existing_quota: np.ndarray = None     # [E, G] int32 remaining cap per
+                                          # existing node (counts already there)
 
 
 def _config_requirements(
@@ -233,6 +241,9 @@ def encode(
     existing: Sequence[ExistingNodeInput] = (),
     daemon_overhead: Optional[dict[str, dict[str, float]]] = None,
     reserved_in_use: Optional[dict[str, int]] = None,
+    group_cap: Optional[np.ndarray] = None,
+    conflict: Optional[np.ndarray] = None,
+    existing_quota: Optional[np.ndarray] = None,
 ) -> Encoded:
     """Build the dense problem. `daemon_overhead` maps pool name ->
     resource list of daemonset pods that will land on new nodes
@@ -367,6 +378,9 @@ def encode(
         existing_used=np.zeros((len(existing), R), np.float32),
         cfg_rsv=cfg_rsv,
         rsv_cap=np.asarray(rsv_cap_list, np.float32),
+        group_cap=group_cap,
+        conflict=conflict,
+        existing_quota=existing_quota,
     )
 
 

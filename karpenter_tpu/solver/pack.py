@@ -73,6 +73,10 @@ def pack(
     quota: jnp.ndarray | None = None,  # [N, G] i32 per-node group caps
     cfg_rsv: jnp.ndarray | None = None,  # [C] i32 reservation slot, -1 none
     rsv_cap: jnp.ndarray | None = None,  # [K] f32 budget per reservation
+    group_cap: jnp.ndarray | None = None,  # [G] i32 max pods of g per node
+    conflict: jnp.ndarray | None = None,  # [G, G] bool groups that exclude
+                                          # each other from sharing a node
+                                          # (hostname anti-affinity, ports)
 ):
     G, C = compat.shape
     R = group_req.shape[1]
@@ -143,6 +147,19 @@ def pack(
             # LP-planned nodes cap each group's take so complementary
             # resource shapes can share the node (see lp_plan).
             k = jnp.minimum(k, quota[:, g])
+        if group_cap is not None:
+            # per-node cap for this group net of what the node already
+            # holds (hostname topology spread: at most maxSkew matching
+            # pods per node, topologygroup.go:226-311)
+            k = jnp.minimum(k, jnp.maximum(group_cap[g] - assign[:, g], 0))
+        if conflict is not None:
+            # a node holding any pod of a conflicting group is off
+            # limits (hostname anti-affinity owners + their selector
+            # matches, topology.go:280-327; host-port collisions,
+            # hostportusage.go) — one masked reduction over the live
+            # assignment state
+            blocked = (assign * conflict[g][None, :]).sum(axis=1) > 0
+            k = jnp.where(blocked, 0, k)
         prefix = jnp.cumsum(k) - k
         take = jnp.clip(remaining - prefix, 0, k)
         touched = take > 0
@@ -195,6 +212,11 @@ def pack(
                 c_res = jnp.argmax(jnp.where(res_mask, kf, -1))
                 c_star = jnp.where(res_mask.any(), c_res, jnp.argmax(kf))
             m_star = jnp.maximum(kf[c_star], 1)
+            if group_cap is not None:
+                # fresh nodes respect the per-node group cap too (a
+                # self-conflicting group must set group_cap=1 so each
+                # fresh node takes one pod)
+                m_star = jnp.clip(group_cap[g], 1, m_star)
             slot_star = cfg_slot[c_star]
             cap_left = jnp.minimum(
                 rsv_cap_ext[slot_star] - rsv_used[slot_star], 2.0e9
@@ -268,7 +290,7 @@ def pack(
 
 @functools.partial(jax.jit, static_argnames=("max_nodes", "mode"))
 def pack_flat(*args, max_nodes: int, mode: str = "ffd", quota=None,
-              cfg_rsv=None, rsv_cap=None):
+              cfg_rsv=None, rsv_cap=None, group_cap=None, conflict=None):
     """`pack` with every output concatenated into ONE float32 vector.
 
     The remote-device transport charges a fixed latency per
@@ -278,7 +300,8 @@ def pack_flat(*args, max_nodes: int, mode: str = "ffd", quota=None,
     """
     assign, node_mask, node_used, node_active, node_count, unsched = pack(
         *args, max_nodes=max_nodes, mode=mode, quota=quota,
-        cfg_rsv=cfg_rsv, rsv_cap=rsv_cap,
+        cfg_rsv=cfg_rsv, rsv_cap=rsv_cap, group_cap=group_cap,
+        conflict=conflict,
     )
     return jnp.concatenate(
         [
@@ -338,18 +361,17 @@ def solve_packing(
         if cfg.existing_index >= 0:
             existing_mask[cfg.existing_index, ci] = True
     existing_used = enc.existing_used
-    quota = None
+    existing_rows = (
+        enc.existing_quota.astype(np.int32)
+        if enc.existing_quota is not None
+        else np.full((E, G), np.iinfo(np.int32).max, np.int32)
+    )
+    quota = existing_rows if enc.existing_quota is not None else None
     if plan is not None:
         existing_mask[E + np.arange(n_planned), plan.planned_cols] = True
         planned_used = enc.pool_overhead[enc.cfg_pool[plan.planned_cols]]
         existing_used = np.concatenate([enc.existing_used, planned_used], axis=0)
-        quota = np.concatenate(
-            [
-                np.full((E, G), np.iinfo(np.int32).max, np.int32),
-                plan.planned_quota,
-            ],
-            axis=0,
-        )
+        quota = np.concatenate([existing_rows, plan.planned_quota], axis=0)
 
     # the kernel sees the existing axis padded to its shape bucket, so
     # fresh nodes open at the padded offset — size the node axis for it
@@ -434,10 +456,28 @@ def _run_pack(
         eused[:E] = existing_used
 
     quota_full = None
-    if quota is not None:
+    if quota is not None or enc.group_cap is not None:
         quota_full = np.full((N, Gp), np.iinfo(np.int32).max, np.int32)
-        quota_full[: quota.shape[0], :G] = quota[:, :G]
+        if enc.group_cap is not None:
+            # per-node caps apply to every node slot, fresh ones included
+            quota_full[:, :G] = np.minimum(
+                quota_full[:, :G], enc.group_cap[None, :].astype(np.int32)
+            )
+        if quota is not None:
+            quota_full[: quota.shape[0], :G] = np.minimum(
+                quota[:, :G], quota_full[: quota.shape[0], :G]
+            )
         quota_full = jnp.asarray(quota_full)
+    group_cap_full = None
+    if enc.group_cap is not None:
+        gc = np.full((Gp,), np.iinfo(np.int32).max, np.int32)
+        gc[:G] = enc.group_cap
+        group_cap_full = jnp.asarray(gc)
+    conflict_full = None
+    if enc.conflict is not None and enc.conflict.any():
+        cf = np.zeros((Gp, Gp), bool)
+        cf[:G, :G] = enc.conflict
+        conflict_full = jnp.asarray(cf)
     cfg_rsv = None
     rsv_cap = None
     if enc.rsv_cap is not None and enc.rsv_cap.size:
@@ -460,6 +500,8 @@ def _run_pack(
         quota=quota_full,
         cfg_rsv=cfg_rsv,
         rsv_cap=rsv_cap,
+        group_cap=group_cap_full,
+        conflict=conflict_full,
     )
     flat = np.asarray(flat)  # the one device->host fetch
     o0, o1, o2, o3, o4 = (

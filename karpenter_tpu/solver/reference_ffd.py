@@ -23,6 +23,7 @@ class _Node:
     mask: np.ndarray           # [C] bool
     used: np.ndarray           # [R] float32
     assign: dict[int, int] = field(default_factory=dict)  # group -> count
+    quota: np.ndarray | None = None  # [G] remaining per-group cap (existing)
 
 
 def solve_ffd_host(enc: Encoded) -> tuple[list[_Node], dict[int, int]]:
@@ -40,21 +41,53 @@ def solve_ffd_host(enc: Encoded) -> tuple[list[_Node], dict[int, int]]:
     )
     capped = cfg_rsv >= 0
     rsv_used = np.zeros(len(rsv_cap), np.float64)
+    G = len(enc.groups)
+    # lowered topology constraints (solver/topo_batch.py) — the host
+    # oracle must enforce the same per-node caps / group conflicts /
+    # existing-node quotas the device kernel does
+    group_cap = (
+        enc.group_cap.astype(np.int64)
+        if enc.group_cap is not None
+        else np.full((G,), np.iinfo(np.int64).max, np.int64)
+    )
+    conflict = enc.conflict if enc.conflict is not None else None
     nodes: list[_Node] = []
     for ei in range(enc.n_existing):
         mask = np.zeros((C,), bool)
         for ci, cfg in enumerate(enc.configs):
             if cfg.existing_index == ei:
                 mask[ci] = True
-        nodes.append(_Node(mask=mask, used=enc.existing_used[ei].copy()))
+        quota = (
+            enc.existing_quota[ei].astype(np.int64)
+            if enc.existing_quota is not None
+            else None
+        )
+        nodes.append(
+            _Node(mask=mask, used=enc.existing_used[ei].copy(), quota=quota)
+        )
     unschedulable: dict[int, int] = {}
 
-    for gi in range(len(enc.groups)):
+    def node_admits(node: _Node, gi: int) -> bool:
+        have = node.assign.get(gi, 0)
+        cap = group_cap[gi]
+        if node.quota is not None:
+            cap = min(cap, node.quota[gi])
+        if have >= cap:
+            return False
+        if conflict is not None:
+            for other, count in node.assign.items():
+                if count > 0 and conflict[gi, other]:
+                    return False
+        return True
+
+    for gi in range(G):
         req = enc.group_req[gi]
         row = enc.compat[gi]
         for _ in range(int(enc.group_count[gi])):
             placed = False
             for node in nodes:
+                if not node_admits(node, gi):
+                    continue
                 ok = node.mask & row & np.all(node.used[None, :] + req[None, :] <= alloc + 1e-4, axis=1)
                 if ok.any():
                     node.mask = ok

@@ -1,0 +1,232 @@
+"""Tests for the topology lowering (solver/topo_batch.py): constrained
+pods ride the batched device solver via domain pins, per-node caps and
+group conflicts, with legality identical to the per-pod tracker.
+
+Reference semantics: topologygroup.go:226-311 (spread skew),
+topology.go:280-327 (anti-affinity inverse scan), hostportusage.go.
+"""
+
+from collections import Counter, defaultdict
+
+from karpenter_tpu.apis.v1.labels import TOPOLOGY_ZONE_LABEL
+from karpenter_tpu.cloudprovider.fake import GIB, instance_types, make_instance_type
+from karpenter_tpu.kube.objects import (
+    Affinity,
+    LabelSelector,
+    PodAffinity,
+    PodAffinityTerm,
+    TopologySpreadConstraint,
+)
+from karpenter_tpu.provisioning.scheduler import Scheduler
+from karpenter_tpu.testing import mk_nodepool, mk_pod
+
+ZONE = TOPOLOGY_ZONE_LABEL
+HOSTNAME = "kubernetes.io/hostname"
+
+
+def spread_pod(name, app, key=ZONE, skew=1, cpu=1.0):
+    pod = mk_pod(name=name, cpu=cpu)
+    pod.metadata.labels["app"] = app
+    pod.spec.topology_spread_constraints = [
+        TopologySpreadConstraint(
+            max_skew=skew,
+            topology_key=key,
+            when_unsatisfiable="DoNotSchedule",
+            label_selector=LabelSelector.of({"app": app}),
+        )
+    ]
+    return pod
+
+
+def anti_pod(name, app, key=HOSTNAME, cpu=1.0):
+    pod = mk_pod(name=name, cpu=cpu)
+    pod.metadata.labels["app"] = app
+    pod.spec.affinity = Affinity(
+        pod_anti_affinity=PodAffinity(
+            required=(
+                PodAffinityTerm(
+                    topology_key=key,
+                    label_selector=LabelSelector.of({"app": app}),
+                ),
+            )
+        )
+    )
+    return pod
+
+
+def affinity_pod(name, app, key=ZONE, cpu=1.0):
+    pod = mk_pod(name=name, cpu=cpu)
+    pod.metadata.labels["app"] = app
+    pod.spec.affinity = Affinity(
+        pod_affinity=PodAffinity(
+            required=(
+                PodAffinityTerm(
+                    topology_key=key,
+                    label_selector=LabelSelector.of({"app": app}),
+                ),
+            )
+        )
+    )
+    return pod
+
+
+def zone_of(plan):
+    return plan.offerings[0].zone
+
+
+class TestZonalSpreadLowering:
+    def test_skew_within_bound(self):
+        pods = [spread_pod(f"p-{i}", f"svc-{i % 4}") for i in range(60)]
+        sched = Scheduler(pools_with_types=[(mk_nodepool("p"), instance_types(20))])
+        res = sched.solve(pods)
+        assert res.scheduled_count == 60 and not res.errors
+        per_app = defaultdict(Counter)
+        for plan in res.new_node_plans:
+            for pod in plan.pods:
+                per_app[pod.metadata.labels["app"]][zone_of(plan)] += 1
+        for app, counts in per_app.items():
+            # all three zones carry load and skew <= 1
+            values = [counts.get(z, 0) for z in
+                      ("test-zone-1", "test-zone-2", "test-zone-3")]
+            assert max(values) - min(values) <= 1, (app, counts)
+
+    def test_large_skew_allows_imbalance_but_schedules(self):
+        pods = [spread_pod(f"p-{i}", "svc", skew=5) for i in range(20)]
+        sched = Scheduler(pools_with_types=[(mk_nodepool("p"), instance_types(20))])
+        res = sched.solve(pods)
+        assert res.scheduled_count == 20 and not res.errors
+
+    def test_seeded_counts_respected(self):
+        """Pods already in zone-1 pull new placements toward the other
+        zones (water-fill starts from live counts)."""
+        from karpenter_tpu.testing import Environment
+
+        env = Environment(types=instance_types(20))
+        env.kube.create(mk_nodepool("p"))
+        seed = [spread_pod(f"s-{i}", "svc") for i in range(3)]
+        env.provision(*seed)
+        placed = Counter()
+        for node in env.kube.nodes():
+            zone = node.metadata.labels.get(ZONE)
+            state = env.cluster.node_for_name(node.metadata.name)
+            placed[zone] += len(state.pod_keys)
+        more = [spread_pod(f"m-{i}", "svc") for i in range(6)]
+        env.provision(*more)
+        counts = Counter()
+        for node in env.kube.nodes():
+            zone = node.metadata.labels.get(ZONE)
+            state = env.cluster.node_for_name(node.metadata.name)
+            counts[zone] += len(state.pod_keys)
+        values = [counts.get(z, 0) for z in
+                  ("test-zone-1", "test-zone-2", "test-zone-3")]
+        assert max(values) - min(values) <= 1, counts
+
+
+class TestHostnameAntiAffinityLowering:
+    def test_owners_on_distinct_nodes(self):
+        pods = [anti_pod(f"a-{i}", "db") for i in range(4)]
+        sched = Scheduler(pools_with_types=[(mk_nodepool("p"), instance_types(20))])
+        res = sched.solve(pods)
+        assert res.scheduled_count == 4 and not res.errors
+        for plan in res.new_node_plans:
+            owners = [p for p in plan.pods if p.metadata.labels.get("app") == "db"]
+            assert len(owners) <= 1
+
+    def test_matched_pods_avoid_owner_nodes(self):
+        """Selector-matched pods without the term must not share a node
+        with an owner (the inverse scan)."""
+        owners = [anti_pod(f"a-{i}", "web") for i in range(2)]
+        plain = []
+        for i in range(6):
+            pod = mk_pod(name=f"w-{i}", cpu=1.0)
+            pod.metadata.labels["app"] = "web"
+            plain.append(pod)
+        sched = Scheduler(pools_with_types=[(mk_nodepool("p"), instance_types(20))])
+        res = sched.solve(owners + plain)
+        assert res.scheduled_count == 8 and not res.errors
+        for plan in res.new_node_plans:
+            apps = [p.metadata.name for p in plan.pods
+                    if p.metadata.labels.get("app") == "web"]
+            has_owner = any(n.startswith("a-") for n in apps)
+            if has_owner:
+                assert len(apps) == 1, f"owner shares node: {apps}"
+
+
+class TestZoneAffinityAntiLowering:
+    def test_zone_anti_distinct_zones_and_overflow_errors(self):
+        pods = [anti_pod(f"z-{i}", "singleton", key=ZONE) for i in range(5)]
+        sched = Scheduler(pools_with_types=[(mk_nodepool("p"), instance_types(20))])
+        res = sched.solve(pods)
+        # 3 zones -> 3 scheduled, 2 unplaceable
+        assert res.scheduled_count == 3
+        assert len(res.errors) == 2
+        zones = [zone_of(plan) for plan in res.new_node_plans for _ in plan.pods]
+        assert len(set(zones)) == len(zones)
+
+    def test_zone_affinity_colocates(self):
+        pods = [affinity_pod(f"c-{i}", "cache") for i in range(6)]
+        sched = Scheduler(pools_with_types=[(mk_nodepool("p"), instance_types(20))])
+        res = sched.solve(pods)
+        assert res.scheduled_count == 6 and not res.errors
+        zones = {zone_of(plan) for plan in res.new_node_plans if plan.pods}
+        assert len(zones) == 1
+
+
+class TestHostnameSpreadLowering:
+    def test_per_node_cap(self):
+        pods = [spread_pod(f"h-{i}", "svc", key=HOSTNAME, skew=2, cpu=0.25)
+                for i in range(10)]
+        sched = Scheduler(pools_with_types=[(mk_nodepool("p"), instance_types(20))])
+        res = sched.solve(pods)
+        assert res.scheduled_count == 10 and not res.errors
+        for plan in res.new_node_plans:
+            assert len(plan.pods) <= 2
+
+
+class TestBatchIntegration:
+    def test_constrained_pods_avoid_per_pod_fallback(self):
+        """The bench shape (zonal spread + hostname anti) must lower
+        fully — nothing routed to the per-pod path."""
+        from karpenter_tpu.scheduling.topology import Topology
+        from karpenter_tpu.solver import topo_batch
+
+        pods = []
+        for i in range(40):
+            pod = spread_pod(f"b-{i}", f"svc-{i % 4}")
+            if i % 10 == 0:
+                pod.spec.affinity = Affinity(
+                    pod_anti_affinity=PodAffinity(
+                        required=(
+                            PodAffinityTerm(
+                                topology_key=HOSTNAME,
+                                label_selector=LabelSelector.of(
+                                    {"app": pod.metadata.labels["app"]}
+                                ),
+                            ),
+                        )
+                    )
+                )
+            pods.append(pod)
+        sched = Scheduler(pools_with_types=[(mk_nodepool("p"), instance_types(20))])
+        topo = sched.topology
+        full = Topology(
+            domains=topo.domains,
+            cluster_pods=[],
+            pending_pods=pods,
+            honor_schedule_anyway=True,
+        )
+        tb = topo_batch.prepare(pods, full, sched.existing_inputs, {})
+        assert not tb.fallback and not tb.errors
+        assert sum(g.count for g in tb.groups) == 40
+
+    def test_mixed_simple_and_constrained_share_plans(self):
+        """Constrained pods join fast-path open plans instead of
+        opening fresh nodes (pseudo-existing plan inputs)."""
+        porty = mk_pod(name="porty", cpu=0.25)
+        porty.spec.containers[0].ports = [443]
+        plain = [mk_pod(name=f"plain-{i}", cpu=0.25) for i in range(3)]
+        types = [make_instance_type("c8", cpu=8, memory=32 * GIB, price=1.0)]
+        sched = Scheduler(pools_with_types=[(mk_nodepool("p"), types)])
+        res = sched.solve([porty] + plain)
+        assert res.scheduled_count == 4
+        assert len(res.new_node_plans) == 1
