@@ -272,6 +272,7 @@ class DisruptionEngine:
             cluster_pods=self.kube.pods(),
             allow_reserved=self.options.feature_gates.reserved_capacity,
             min_values_policy=self.options.min_values_policy,
+            kube=self.kube,
         )
         results = scheduler.solve(pods + pending)
         scheduled_keys = {

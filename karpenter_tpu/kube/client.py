@@ -26,6 +26,7 @@ from typing import Callable, Iterable, Optional
 from karpenter_tpu.apis.v1.nodeclaim import NodeClaim
 from karpenter_tpu.apis.v1.nodepool import NodePool
 from karpenter_tpu.kube.objects import (
+    CSINode,
     DaemonSet,
     LabelSelector,
     Node,
@@ -205,6 +206,12 @@ class KubeClient:
 
     def get_pv(self, name: str) -> Optional[PersistentVolume]:
         return self.get("PersistentVolume", name)
+
+    def get_csi_node(self, name: str) -> Optional[CSINode]:
+        return self.get("CSINode", name)
+
+    def csi_nodes(self) -> list[CSINode]:
+        return self.list("CSINode")
 
     def bind_pod(self, pod: Pod, node_name: str) -> None:
         """The scheduler binding: sets spec.node_name."""

@@ -228,6 +228,10 @@ class PodSpec:
     scheduler_name: str = "default-scheduler"
     termination_grace_period_seconds: Optional[int] = 30
     restart_policy: str = "Always"
+    # transient, re-derived each scheduling round: zonal requirements
+    # injected from the pod's PVCs (volumetopology.go:51-160); consumed
+    # by Requirements.from_pod, never part of the API object proper
+    injected_requirements: list = field(default_factory=list)
 
 
 @dataclass
@@ -418,6 +422,21 @@ class PersistentVolume:
     attached_node: str = ""            # for volume-detachment tracking
 
     kind = "PersistentVolume"
+
+    @property
+    def key(self) -> str:
+        return self.metadata.name
+
+
+@dataclass
+class CSINode:
+    """Per-node CSI driver attach limits (the reference reads these
+    from CSINode.spec.drivers[].allocatable.count, volumeusage.go)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    volume_limits: dict[str, int] = field(default_factory=dict)  # driver -> max
+
+    kind = "CSINode"
 
     @property
     def key(self) -> str:

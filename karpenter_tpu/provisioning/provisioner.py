@@ -155,6 +155,7 @@ class Provisioner:
             state_nodes=self.cluster.deep_copy_nodes(),
             daemonsets=self.cluster.daemonsets(),
             cluster_pods=self.kube.pods(),
+            kube=self.kube,
             allow_reserved=(
                 self.options.feature_gates.reserved_capacity
                 if self.options is not None else True

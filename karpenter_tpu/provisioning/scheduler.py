@@ -40,6 +40,8 @@ from karpenter_tpu.cloudprovider.types import (
 )
 from karpenter_tpu.kube.objects import Pod
 from karpenter_tpu.scheduling.hostports import HostPortUsage, pod_host_ports
+from karpenter_tpu.scheduling.volumeusage import VolumeUsage, pod_volume_drivers
+from karpenter_tpu.provisioning import volume_topology
 from karpenter_tpu.scheduling.requirement import IN, Requirement
 from karpenter_tpu.scheduling.requirements import Requirements
 from karpenter_tpu.scheduling.taints import tolerates_pod
@@ -108,8 +110,10 @@ class Scheduler:
         honor_preferences: bool = True,
         allow_reserved: bool = True,
         min_values_policy: str = "Strict",
+        kube=None,
     ):
         self.min_values_policy = min_values_policy
+        self.kube = kube
         if not allow_reserved:
             # ReservedCapacity gate off: reserved offerings never enter
             # the solve (options.go feature gates)
@@ -197,6 +201,22 @@ class Scheduler:
                     pod.spec.node_name, HostPortUsage()
                 ).add(pod)
 
+        # per-node CSI volume-limit accounting (volumeusage.go;
+        # existingnode.go:29-140): limits come from CSINode objects,
+        # usage is seeded from live pods' PVC volumes
+        self._volume_usage: dict[str, VolumeUsage] = {}
+        if self.kube is not None:
+            for csi in self.kube.csi_nodes():
+                if csi.volume_limits:
+                    self._volume_usage[csi.metadata.name] = VolumeUsage(
+                        limits=csi.volume_limits
+                    )
+            if self._volume_usage:
+                for pod in self.cluster_pods:
+                    usage = self._volume_usage.get(pod.spec.node_name)
+                    if usage is not None and pod.spec.volumes:
+                        usage.add(pod, self.kube)
+
     # -- construction helpers -------------------------------------------------
 
     def _existing_input(self, node: StateNode) -> ExistingNodeInput:
@@ -266,6 +286,14 @@ class Scheduler:
     # -- solve ----------------------------------------------------------------
 
     def solve(self, pods: Sequence[Pod]) -> SchedulerResults:
+        if self.kube is not None:
+            # PVC zonal requirements re-derived HERE, at every solve
+            # entry (provisioning and disruption simulation alike), so
+            # results never depend on which caller stamped the shared
+            # pod object last (volumetopology.go:51-160)
+            for pod in pods:
+                if pod.spec.volumes or pod.spec.injected_requirements:
+                    volume_topology.inject(pod, self.kube)
         topology_full = Topology(
             domains=self.topology.domains,
             cluster_pods=[p for p in self.cluster_pods if p.spec.node_name],
@@ -275,11 +303,23 @@ class Scheduler:
         )
         simple: list[Pod] = []
         complex_: list[Pod] = []
+        volume_limited: list[Pod] = []
+        limited_drivers = {
+            d for usage in self._volume_usage.values() for d in usage.limits
+        }
         for pod in pods:
-            # host-port pods need per-node conflict tracking: the
-            # grouped fast path would stack identical pods whose ports
-            # collide (hostportusage.go), so they go per-pod
-            if topology_full.has_constraints(pod) or pod_host_ports(pod):
+            # CSI attach limits are per unique volume per node — only
+            # the per-pod path tracks them (the reference enforces
+            # them on existing nodes only, existingnode.go:29-140);
+            # route per-pod only when the pod's drivers are actually
+            # limited somewhere
+            if (
+                limited_drivers
+                and pod.spec.volumes
+                and limited_drivers & pod_volume_drivers(pod, self.kube).keys()
+            ):
+                volume_limited.append(pod)
+            elif topology_full.has_constraints(pod) or pod_host_ports(pod):
                 complex_.append(pod)
             else:
                 simple.append(pod)
@@ -425,7 +465,8 @@ class Scheduler:
                         )
                 deferred.extend(solution.unschedulable)
 
-        # slow path: per-pod with topology filtering
+        # slow path: per-pod with topology + volume-limit filtering
+        deferred.extend(volume_limited)
         if deferred:
             self._solve_complex(
                 deferred, open_plans, topology_full, results, round_in_use
@@ -646,6 +687,12 @@ class Scheduler:
                 usage = self._host_ports.setdefault(inp.name, HostPortUsage())
                 if usage.conflict(pod) is not None:
                     continue
+            if pod.spec.volumes:
+                # CSI attach limits on the existing node
+                # (existingnode.go:29-140, volumeusage.go)
+                vusage = self._volume_usage.get(inp.name)
+                if vusage is not None and vusage.exceeds_limits(pod, self.kube):
+                    continue
             labels = node.labels()
             candidate = {k: {v} for k, v in labels.items()}
             candidate[HOSTNAME_LABEL] = {inp.name}
@@ -656,6 +703,8 @@ class Scheduler:
             self._commit_existing(node_mut, pod)
             if pod_host_ports(pod):
                 self._host_ports[inp.name].add(pod)
+            if pod.spec.volumes and inp.name in self._volume_usage:
+                self._volume_usage[inp.name].add(pod, self.kube)
             results.existing_assignments.setdefault(inp.name, []).append(pod)
             topology.register(pod, {k: next(iter(v)) for k, v in allowed.items() if v})
             return True

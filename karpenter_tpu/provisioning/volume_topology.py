@@ -1,0 +1,47 @@
+"""PVC-driven zonal requirements + CSI volume-limit context.
+
+Counterpart of provisioning/scheduling/volumetopology.go:51-160: a pod
+referencing a BOUND PVC must schedule into the persistent volume's
+zone; a pod with an unbound PVC whose StorageClass restricts
+allowedTopologies must schedule into one of those zones. The derived
+requirement is stored on `pod.spec.injected_requirements` (transient,
+re-derived every round) where `Requirements.from_pod` picks it up for
+both the batched solver encoding and the per-pod path.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING
+
+from karpenter_tpu.apis.v1.labels import TOPOLOGY_ZONE_LABEL
+from karpenter_tpu.kube.objects import Pod
+from karpenter_tpu.scheduling.requirement import IN, Requirement
+
+if TYPE_CHECKING:  # pragma: no cover
+    from karpenter_tpu.kube.client import KubeClient
+
+
+def inject(pod: Pod, kube: "KubeClient") -> None:
+    """Re-derive the pod's PVC zonal requirements for this round."""
+    reqs: list[Requirement] = []
+    for vol in pod.spec.volumes:
+        pvc_name = vol.pvc_name
+        if vol.ephemeral:
+            pvc_name = f"{pod.metadata.name}-{vol.name}"
+        if not pvc_name:
+            continue
+        pvc = kube.get_pvc(pod.metadata.namespace, pvc_name)
+        if pvc is None:
+            continue
+        zones = None
+        if pvc.spec.volume_name:
+            pv = kube.get_pv(pvc.spec.volume_name)
+            if pv is not None and pv.zones:
+                zones = pv.zones
+        elif pvc.spec.storage_class_name:
+            sc = kube.get_storage_class(pvc.spec.storage_class_name)
+            if sc is not None and sc.zones:
+                zones = sc.zones
+        if zones:
+            reqs.append(Requirement(TOPOLOGY_ZONE_LABEL, IN, list(zones)))
+    pod.spec.injected_requirements = reqs

@@ -193,6 +193,21 @@ class Requirement:
     def __hash__(self) -> int:
         return hash((self.key, self.complement, self.values, self.greater_than, self.less_than))
 
+    def signature(self) -> tuple:
+        """Lossless, hashable identity — unlike __repr__, which
+        truncates long value lists for display and must never be used
+        as a grouping key."""
+        # None -> -1 so signatures stay totally ordered (sort keys);
+        # legal Gt/Lt/minValues operands are non-negative
+        return (
+            self.key,
+            self.complement,
+            tuple(sorted(self.values)),
+            -1 if self.greater_than is None else self.greater_than,
+            -1 if self.less_than is None else self.less_than,
+            -1 if self.min_values is None else self.min_values,
+        )
+
     def __repr__(self) -> str:
         op = self.operator()
         if op in (EXISTS, DOES_NOT_EXIST):

@@ -60,6 +60,9 @@ class Requirements:
         is taken, relaxation removes terms one at a time.
         """
         reqs = cls.from_labels(dict(pod.spec.node_selector))
+        if pod.spec.injected_requirements:
+            # PVC-derived zonal requirements (volumetopology.go:51-160)
+            reqs.add(*pod.spec.injected_requirements)
         affinity = pod.spec.affinity
         if affinity is None or affinity.node_affinity is None:
             return reqs
@@ -188,6 +191,10 @@ class Requirements:
 
     def __repr__(self) -> str:
         return ", ".join(sorted(repr(r) for r in self._reqs.values()))
+
+    def signature(self) -> tuple:
+        """Lossless grouping key (repr truncates long value lists)."""
+        return tuple(sorted(r.signature() for r in self._reqs.values()))
 
 
 ALLOW_UNDEFINED_WELL_KNOWN = WELL_KNOWN_LABELS

@@ -83,6 +83,8 @@ def group_pods(pods: Sequence[Pod], required_only: bool = False) -> list[PodGrou
         # dataclass, so the tuple hashes directly).
         raw = (
             frozenset(spec.node_selector.items()) if spec.node_selector else None,
+            tuple(r.signature() for r in spec.injected_requirements)
+            if spec.injected_requirements else None,
             repr(spec.affinity) if spec.affinity is not None else None,
             tuple(spec.tolerations) if spec.tolerations else None,
             tuple(
@@ -101,7 +103,7 @@ def group_pods(pods: Sequence[Pod], required_only: bool = False) -> list[PodGrou
             resources = resutil.pod_requests(pod)
             tols = tuple(sorted(pod.spec.tolerations, key=repr))
             signature = (
-                repr(reqs),
+                reqs.signature(),
                 tols,
                 tuple(sorted(resources.items())),
             )
@@ -118,7 +120,7 @@ def group_pods(pods: Sequence[Pod], required_only: bool = False) -> list[PodGrou
         key=lambda g: (
             -(g.resources.get(resutil.CPU, 0.0)),
             -(g.resources.get(resutil.MEMORY, 0.0)),
-            repr(g.requirements),
+            g.requirements.signature(),
         ),
     )
 

@@ -182,7 +182,7 @@ def prepare(
         key=lambda g: (
             -(batch.groups[g].resources.get(resutil.CPU, 0.0)),
             -(batch.groups[g].resources.get(resutil.MEMORY, 0.0)),
-            repr(batch.groups[g].requirements),
+            batch.groups[g].requirements.signature(),
         ),
     )
     perm = np.asarray(order)

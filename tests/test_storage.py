@@ -1,0 +1,196 @@
+"""Storage-aware scheduling: PVC zonal-requirement injection
+(volumetopology.go:51-160) and CSI volume attach limits on existing
+nodes (existingnode.go:29-140, volumeusage.go)."""
+
+from karpenter_tpu.apis.v1.labels import TOPOLOGY_ZONE_LABEL
+from karpenter_tpu.cloudprovider.fake import GIB, instance_types, make_instance_type
+from karpenter_tpu.kube.objects import (
+    CSINode,
+    ObjectMeta,
+    PersistentVolume,
+    PersistentVolumeClaim,
+    PersistentVolumeClaimSpec,
+    PodVolume,
+    StorageClass,
+)
+from karpenter_tpu.testing import Environment, mk_nodepool, mk_pod
+
+ZONE = TOPOLOGY_ZONE_LABEL
+
+
+def pvc_pod(name, claim, cpu=1.0):
+    pod = mk_pod(name=name, cpu=cpu)
+    pod.spec.volumes = [PodVolume(name="data", pvc_name=claim)]
+    return pod
+
+
+class TestVolumeTopologyInjection:
+    def test_bound_pvc_pins_pv_zone(self):
+        """A pod whose PVC is bound to a zonal PV must land in the
+        PV's zone."""
+        env = Environment(types=instance_types(50))
+        env.kube.create(mk_nodepool("p"))
+        env.kube.create(PersistentVolume(
+            metadata=ObjectMeta(name="pv-1"), zones=["test-zone-2"],
+        ))
+        env.kube.create(PersistentVolumeClaim(
+            metadata=ObjectMeta(name="data-0", namespace="default"),
+            spec=PersistentVolumeClaimSpec(volume_name="pv-1"),
+        ))
+        env.provision(pvc_pod("db-0", "data-0"))
+        nodes = env.kube.nodes()
+        assert len(nodes) == 1
+        assert nodes[0].metadata.labels[ZONE] == "test-zone-2"
+
+    def test_unbound_pvc_uses_storageclass_topology(self):
+        env = Environment(types=instance_types(50))
+        env.kube.create(mk_nodepool("p"))
+        env.kube.create(StorageClass(
+            metadata=ObjectMeta(name="zonal-ssd"),
+            provisioner="ebs.csi.aws.com",
+            zones=["test-zone-3"],
+        ))
+        env.kube.create(PersistentVolumeClaim(
+            metadata=ObjectMeta(name="data-1", namespace="default"),
+            spec=PersistentVolumeClaimSpec(storage_class_name="zonal-ssd"),
+        ))
+        env.provision(pvc_pod("db-1", "data-1"))
+        nodes = env.kube.nodes()
+        assert len(nodes) == 1
+        assert nodes[0].metadata.labels[ZONE] == "test-zone-3"
+
+    def test_unrestricted_pvc_schedules_anywhere(self):
+        env = Environment(types=instance_types(50))
+        env.kube.create(mk_nodepool("p"))
+        env.kube.create(StorageClass(
+            metadata=ObjectMeta(name="any"), provisioner="ebs.csi.aws.com",
+        ))
+        env.kube.create(PersistentVolumeClaim(
+            metadata=ObjectMeta(name="data-2", namespace="default"),
+            spec=PersistentVolumeClaimSpec(storage_class_name="any"),
+        ))
+        env.provision(pvc_pod("db-2", "data-2"))
+        assert len(env.kube.nodes()) == 1
+
+    def test_conflicting_pv_zone_and_selector_unschedulable(self):
+        env = Environment(types=instance_types(50))
+        env.kube.create(mk_nodepool("p"))
+        env.kube.create(PersistentVolume(
+            metadata=ObjectMeta(name="pv-x"), zones=["test-zone-1"],
+        ))
+        env.kube.create(PersistentVolumeClaim(
+            metadata=ObjectMeta(name="data-x", namespace="default"),
+            spec=PersistentVolumeClaimSpec(volume_name="pv-x"),
+        ))
+        pod = pvc_pod("db-x", "data-x")
+        pod.spec.node_selector[ZONE] = "test-zone-2"  # contradicts PV
+        env.provision(pod)
+        assert len(env.kube.nodes()) == 0
+        assert not env.kube.get_pod("default", "db-x").spec.node_name
+
+
+class TestInjectionAtSolveEntry:
+    def test_scheduler_injects_without_provisioner(self):
+        """Any solve with a kube handle derives PVC pins itself — the
+        disruption simulation path must not depend on the provisioner
+        having stamped the pod earlier (r2 review finding)."""
+        from karpenter_tpu.provisioning.scheduler import Scheduler
+        from karpenter_tpu.kube.client import KubeClient
+
+        kube = KubeClient()
+        kube.create(PersistentVolume(
+            metadata=ObjectMeta(name="pv-sim"), zones=["test-zone-1"],
+        ))
+        kube.create(PersistentVolumeClaim(
+            metadata=ObjectMeta(name="data-sim", namespace="default"),
+            spec=PersistentVolumeClaimSpec(volume_name="pv-sim"),
+        ))
+        pod = pvc_pod("sim", "data-sim")
+        sched = Scheduler(
+            pools_with_types=[(mk_nodepool("p"), instance_types(50))],
+            kube=kube,
+        )
+        res = sched.solve([pod])
+        assert res.scheduled_count == 1
+        zones = {o.zone for plan in res.new_node_plans for o in plan.offerings}
+        assert zones == {"test-zone-1"}
+
+    def test_stale_stamp_rederived(self):
+        """A pod stamped for an old PV zone re-derives at solve entry
+        when the binding changes."""
+        from karpenter_tpu.provisioning.scheduler import Scheduler
+        from karpenter_tpu.kube.client import KubeClient
+
+        kube = KubeClient()
+        kube.create(PersistentVolume(
+            metadata=ObjectMeta(name="pv-old"), zones=["test-zone-1"],
+        ))
+        kube.create(PersistentVolume(
+            metadata=ObjectMeta(name="pv-new"), zones=["test-zone-2"],
+        ))
+        pvc = PersistentVolumeClaim(
+            metadata=ObjectMeta(name="data-m", namespace="default"),
+            spec=PersistentVolumeClaimSpec(volume_name="pv-old"),
+        )
+        kube.create(pvc)
+        pod = pvc_pod("mv", "data-m")
+        pools = [(mk_nodepool("p"), instance_types(50))]
+        Scheduler(pools_with_types=pools, kube=kube).solve([pod])
+        pvc.spec.volume_name = "pv-new"  # rebind
+        res = Scheduler(pools_with_types=pools, kube=kube).solve([pod])
+        zones = {o.zone for plan in res.new_node_plans for o in plan.offerings}
+        assert zones == {"test-zone-2"}
+
+
+class TestVolumeLimits:
+    def _env_with_limited_node(self, limit=2):
+        """One live node whose CSINode allows `limit` ebs volumes."""
+        env = Environment(
+            types=[make_instance_type("c16", cpu=16, memory=64 * GIB, price=1.0)]
+        )
+        env.kube.create(mk_nodepool("p"))
+        env.kube.create(StorageClass(
+            metadata=ObjectMeta(name="ssd"), provisioner="ebs.csi.aws.com",
+        ))
+        env.provision(mk_pod(name="warm", cpu=0.25))  # materialize a node
+        node = env.kube.nodes()[0]
+        env.kube.create(CSINode(
+            metadata=ObjectMeta(name=node.metadata.name),
+            volume_limits={"ebs.csi.aws.com": limit},
+        ))
+        return env, node
+
+    def _mk_claim(self, env, name):
+        env.kube.create(PersistentVolumeClaim(
+            metadata=ObjectMeta(name=name, namespace="default"),
+            spec=PersistentVolumeClaimSpec(storage_class_name="ssd"),
+        ))
+
+    def test_attach_limit_overflow_opens_new_node(self):
+        env, node = self._env_with_limited_node(limit=2)
+        for i in range(3):
+            self._mk_claim(env, f"vol-{i}")
+        env.provision(*[pvc_pod(f"s-{i}", f"vol-{i}", cpu=0.25) for i in range(3)])
+        pods = [p for p in env.kube.pods() if p.metadata.name.startswith("s-")]
+        assert all(p.spec.node_name for p in pods), "all stateful pods bound"
+        on_limited = [p for p in pods if p.spec.node_name == node.metadata.name]
+        assert len(on_limited) == 2, (
+            f"{len(on_limited)} pods on the 2-volume node"
+        )
+        assert len(env.kube.nodes()) == 2, "overflow opened a second node"
+
+    def test_shared_pvc_counts_once(self):
+        env, node = self._env_with_limited_node(limit=2)
+        self._mk_claim(env, "shared")
+        self._mk_claim(env, "solo")
+        pods = [
+            pvc_pod("a", "shared", cpu=0.25),
+            pvc_pod("b", "shared", cpu=0.25),
+            pvc_pod("c", "solo", cpu=0.25),
+        ]
+        env.provision(*pods)
+        bound = [p for p in env.kube.pods() if p.metadata.name in "abc"
+                 and p.spec.node_name]
+        assert len(bound) == 3
+        # shared volume counts once: all three fit the 2-volume node
+        assert len(env.kube.nodes()) == 1
