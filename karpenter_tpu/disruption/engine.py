@@ -59,6 +59,11 @@ log = logging.getLogger("karpenter.disruption")
 SPOT_TO_SPOT_MIN_TYPES = 15
 MULTI_NODE_MAX_CANDIDATES = 100  # multinodeconsolidation.go:86
 COMMAND_TIMEOUT_SECONDS = 10 * 60  # orchestration retry deadline (queue.go:86)
+# method wall-clock bounds: the multi-node search keeps the last valid
+# command when time runs out (multinodeconsolidation.go:35,116-169);
+# single-node stops mid-scan (singlenodeconsolidation.go:34)
+MULTI_NODE_TIMEOUT_SECONDS = 60.0
+SINGLE_NODE_TIMEOUT_SECONDS = 3 * 60.0
 
 
 @dataclass
@@ -110,9 +115,11 @@ class DisruptionEngine:
         queue: Optional["OrchestrationQueue"] = None,
         seed: int = 0,
         options=None,
+        clock=None,
     ):
         from karpenter_tpu.operator.options import Options
 
+        self.clock = clock if clock is not None else time.monotonic
         self.kube = kube
         self.cluster = cluster
         self.cloud = cloud
@@ -273,6 +280,7 @@ class DisruptionEngine:
             allow_reserved=self.options.feature_gates.reserved_capacity,
             min_values_policy=self.options.min_values_policy,
             kube=self.kube,
+            clock=self.clock,
         )
         results = scheduler.solve(pods + pending)
         scheduled_keys = {
@@ -397,7 +405,14 @@ class DisruptionEngine:
         # (multinodeconsolidation.go:118-121)
         lo, hi = 2, len(candidates)
         best: Optional[Command] = None
+        deadline = self.clock() + MULTI_NODE_TIMEOUT_SECONDS
         while lo <= hi:
+            if self.clock() > deadline:
+                # out of time: keep the last valid command rather than
+                # discard the round (multinodeconsolidation.go:116-134)
+                log.warning("multi-node consolidation timed out; "
+                            "keeping best command so far")
+                break
             mid = (lo + hi) // 2
             cmd = self.compute_consolidation(candidates[:mid])
             if cmd is not None:
@@ -431,7 +446,12 @@ class DisruptionEngine:
         pools = sorted(by_pool)
         idx = 0
         remaining = {p: list(by_pool[p]) for p in pools}
+        deadline = self.clock() + SINGLE_NODE_TIMEOUT_SECONDS
         while any(remaining.values()):
+            if self.clock() > deadline:
+                log.warning("single-node consolidation timed out after "
+                            "%d candidates", idx)
+                return None
             pool = pools[idx % len(pools)]
             idx += 1
             if not remaining[pool]:

@@ -80,11 +80,13 @@ class Provisioner:
         cluster: Cluster,
         cloud_provider: CloudProvider,
         options=None,
+        clock=None,
     ):
         self.kube = kube
         self.cluster = cluster
         self.cloud_provider = cloud_provider
         self.options = options
+        self.clock = clock if clock is not None else time.monotonic
         self.batcher = Batcher()
 
     # -- pod intake (provisioner.go:172-195, utils/node) ----------------------
@@ -164,6 +166,7 @@ class Provisioner:
                 self.options.min_values_policy
                 if self.options is not None else "Strict"
             ),
+            clock=self.clock,
         )
         results = scheduler.solve(pods)
         self.cluster.mark_pod_scheduling_decisions(pods)

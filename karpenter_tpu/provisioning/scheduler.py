@@ -61,6 +61,13 @@ from karpenter_tpu.provisioning.preferences import relax
 # scheduler knob (nodeclaimtemplate.go:41)
 MAX_INSTANCE_TYPES = 600
 
+# Solve wall-clock bound (provisioner.go:365-368): one minute, after
+# which the round returns best-effort partial results and unplaced pods
+# report a timeout error
+SOLVE_TIMEOUT_SECONDS = 60.0
+
+TIMEOUT_ERROR = "scheduling timed out; will retry next round"
+
 
 @dataclass
 class SchedulerResults:
@@ -111,9 +118,16 @@ class Scheduler:
         allow_reserved: bool = True,
         min_values_policy: str = "Strict",
         kube=None,
+        clock=None,
+        solve_timeout: float = SOLVE_TIMEOUT_SECONDS,
     ):
         self.min_values_policy = min_values_policy
         self.kube = kube
+        import time as _time
+
+        self.clock = clock if clock is not None else _time.monotonic
+        self.solve_timeout = solve_timeout
+        self._deadline: Optional[float] = None
         if not allow_reserved:
             # ReservedCapacity gate off: reserved offerings never enter
             # the solve (options.go feature gates)
@@ -285,7 +299,14 @@ class Scheduler:
 
     # -- solve ----------------------------------------------------------------
 
+    def _timed_out(self) -> bool:
+        return self._deadline is not None and self.clock() > self._deadline
+
     def solve(self, pods: Sequence[Pod]) -> SchedulerResults:
+        # best-effort wall-clock bound for the whole round
+        # (provisioner.go:365-368); work completed before the deadline
+        # is kept, pods not yet placed report TIMEOUT_ERROR
+        self._deadline = self.clock() + self.solve_timeout
         if self.kube is not None:
             # PVC zonal requirements re-derived HERE, at every solve
             # entry (provisioning and disruption simulation alike), so
@@ -347,6 +368,9 @@ class Scheduler:
                     self._commit_existing(node, pod)
             for pod in solution.unschedulable:
                 retried = False
+                if self._timed_out():
+                    results.errors[pod.key] = TIMEOUT_ERROR
+                    continue
                 if self.honor_preferences:
                     relaxed = relax(pod)
                     if relaxed:
@@ -377,6 +401,10 @@ class Scheduler:
         # ONE batched device solve; only what the lowering cannot
         # express falls back to the per-pod loop (solver/topo_batch.py)
         deferred: list[Pod] = []
+        if complex_ and self._timed_out():
+            for pod in complex_:
+                results.errors[pod.key] = TIMEOUT_ERROR
+            complex_ = []
         if complex_:
             # open fast-path plans join the solve as pseudo-existing
             # nodes (in-flight NodeClaim model) so constrained pods can
@@ -647,6 +675,9 @@ class Scheduler:
             ),
         )
         for pod in ordered:
+            if self._timed_out():
+                results.errors[pod.key] = TIMEOUT_ERROR
+                continue
             for _ in range(8):  # relaxation ladder bound
                 if self._try_place(pod, open_plans, topology, results, round_in_use):
                     break

@@ -1,0 +1,145 @@
+"""Wall-clock bound tests with a fake clock.
+
+The reference bounds its hot loops: Solve gets a 1-minute context
+timeout (provisioner.go:365-368), multi-node consolidation stops the
+binary search after 1 minute keeping the last valid command
+(multinodeconsolidation.go:35,116-134), single-node consolidation
+stops scanning after 3 minutes (singlenodeconsolidation.go:34).
+"""
+
+import time
+
+from karpenter_tpu.apis.v1.labels import TOPOLOGY_ZONE_LABEL
+from karpenter_tpu.cloudprovider.fake import GIB, make_instance_type
+from karpenter_tpu.kube.objects import LabelSelector, TopologySpreadConstraint
+from karpenter_tpu.provisioning.scheduler import (
+    SOLVE_TIMEOUT_SECONDS,
+    TIMEOUT_ERROR,
+    Scheduler,
+)
+from karpenter_tpu.testing import Environment, mk_nodepool, mk_pod
+
+
+class FakeClock:
+    """Monotonic clock advancing `step` seconds per reading."""
+
+    def __init__(self, step: float = 0.0, start: float = 0.0):
+        self.now = start
+        self.step = step
+
+    def __call__(self) -> float:
+        value = self.now
+        self.now += self.step
+        return value
+
+
+def _types():
+    return [
+        make_instance_type("c2", cpu=2, memory=8 * GIB, price=2.0),
+        make_instance_type("c4", cpu=4, memory=16 * GIB, price=3.0),
+        make_instance_type("c8", cpu=8, memory=32 * GIB, price=5.0),
+    ]
+
+
+def _spread_pod(name):
+    pod = mk_pod(name=name, cpu=0.5)
+    pod.metadata.labels["app"] = "web"
+    pod.spec.topology_spread_constraints = [
+        TopologySpreadConstraint(
+            max_skew=1,
+            topology_key=TOPOLOGY_ZONE_LABEL,
+            when_unsatisfiable="DoNotSchedule",
+            label_selector=LabelSelector.of({"app": "web"}),
+        )
+    ]
+    return pod
+
+
+class TestSolveTimeout:
+    def test_fast_path_survives_timeout_and_late_pods_error(self):
+        # clock jumps 100s per reading: the deadline (60s) has passed by
+        # the first check, so the already-solved batched result is kept
+        # while topology-constrained pods report the timeout
+        sched = Scheduler(
+            pools_with_types=[(mk_nodepool("p"), _types())],
+            clock=FakeClock(step=100.0),
+        )
+        simple = [mk_pod(name=f"s-{i}", cpu=1.0) for i in range(3)]
+        constrained = [_spread_pod(f"t-{i}") for i in range(2)]
+        results = sched.solve(simple + constrained)
+        placed = {p.key for plan in results.new_node_plans for p in plan.pods}
+        assert all(p.key in placed for p in simple)
+        for pod in constrained:
+            assert results.errors[pod.key] == TIMEOUT_ERROR
+
+    def test_no_timeout_with_real_clock(self):
+        sched = Scheduler(pools_with_types=[(mk_nodepool("p"), _types())])
+        results = sched.solve(
+            [mk_pod(name=f"s-{i}", cpu=1.0) for i in range(3)]
+            + [_spread_pod(f"t-{i}") for i in range(2)]
+        )
+        assert not results.errors
+        assert results.scheduled_count == 5
+
+    def test_default_timeout_is_one_minute(self):
+        assert SOLVE_TIMEOUT_SECONDS == 60.0
+
+
+def _consolidatable_env(n_nodes: int) -> Environment:
+    env = Environment(types=_types())
+    pool = mk_nodepool("default")
+    pool.spec.disruption.consolidate_after = "0s"
+    env.kube.create(pool)
+    # one c2 node per pod: force one-node-per-pod with hostname
+    # anti-affinity-free trick — provision each pod in its own round
+    for i in range(n_nodes):
+        env.provision(mk_pod(name=f"p-{i}", cpu=1.5))
+    assert len(env.kube.nodes()) == n_nodes
+    now = time.time() + 60
+    env.pod_events.reconcile_all(now=now)
+    env.conditions.reconcile_all(now=now)
+    return env
+
+
+class TestConsolidationTimeouts:
+    def test_multi_node_keeps_best_command_on_timeout(self):
+        env = _consolidatable_env(3)
+        now = time.time() + 60
+        # untimed search merges all three c2 nodes
+        env.disruption.clock = FakeClock(step=0.0)
+        full = env.disruption.multi_node_consolidation(now)
+        assert full is not None and len(full.candidates) == 3
+
+        # rebuild conditions (the probe mutated nothing durable) and
+        # time out after the first probe: 40s/reading crosses the 60s
+        # deadline on the second loop check, keeping the first (2-node)
+        # valid command instead of discarding the round
+        env.disruption.clock = FakeClock(step=40.0)
+        partial = env.disruption.multi_node_consolidation(now)
+        assert partial is not None
+        assert len(partial.candidates) == 2
+
+    def test_single_node_stops_on_timeout(self):
+        env = Environment(types=_types())
+        pool = mk_nodepool("default")
+        pool.spec.disruption.consolidate_after = "0s"
+        env.kube.create(pool)
+        # pin the pod onto an oversized c8, then drop the selector so a
+        # cheaper c2 replacement becomes legal
+        # on-demand: spot-to-spot would demand >=15 cheaper types
+        pod = mk_pod(
+            name="big", cpu=1.0,
+            node_selector={
+                "node.kubernetes.io/instance-type": "c8",
+                "karpenter.sh/capacity-type": "on-demand",
+            },
+        )
+        env.provision(pod)
+        env.kube.get_pod("default", "big").spec.node_selector = {}
+        now = time.time() + 60
+        env.pod_events.reconcile_all(now=now)
+        env.conditions.reconcile_all(now=now)
+        env.disruption.clock = FakeClock(step=0.0)
+        assert env.disruption.single_node_consolidation(now) is not None
+        env.disruption.clock = FakeClock(step=200.0)
+        assert env.disruption.single_node_consolidation(now) is None
