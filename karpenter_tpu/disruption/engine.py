@@ -136,6 +136,11 @@ class DisruptionEngine:
     def get_candidates(self, reason: str, now: float) -> list[Candidate]:
         out = []
         pdb = PdbLimits(self.kube)
+        # price lookups hit a per-round offering index instead of
+        # re-fetching the full catalog per candidate (O(candidates ×
+        # catalog) otherwise; the reference resolves prices from the
+        # instance types already fetched for the scheduling run)
+        self._price_index = {}
         for node in self.cluster.nodes():
             candidate = self._build_candidate(node, reason, pdb, now)
             if candidate is not None:
@@ -209,17 +214,22 @@ class DisruptionEngine:
         it_name = labels.get(INSTANCE_TYPE_LABEL, "")
         zone = labels.get(TOPOLOGY_ZONE_LABEL, "")
         captype = labels.get(CAPACITY_TYPE_LABEL, "")
-        pool = self.kube.get_node_pool(labels.get(NODEPOOL_LABEL, ""))
-        try:
-            for it in self.cloud.get_instance_types(pool):
-                if it.name != it_name:
-                    continue
-                for off in it.offerings:
-                    if off.zone == zone and off.capacity_type == captype:
-                        return off.price
-        except Exception as err:
-            log.warning("price lookup failed for %s/%s/%s: %s", it_name, zone, captype, err)
-        return None
+        pool_name = labels.get(NODEPOOL_LABEL, "")
+        index = getattr(self, "_price_index", None)
+        if index is None:
+            index = self._price_index = {}
+        if pool_name not in index:
+            prices: dict[tuple[str, str, str], float] = {}
+            pool = self.kube.get_node_pool(pool_name)
+            try:
+                for it in self.cloud.get_instance_types(pool):
+                    for off in it.offerings:
+                        prices[(it.name, off.zone, off.capacity_type)] = off.price
+            except Exception as err:
+                log.warning("price catalog fetch failed for pool %s: %s",
+                            pool_name, err)
+            index[pool_name] = prices
+        return index[pool_name].get((it_name, zone, captype))
 
     # -- budgets (helpers.go:231-280) ------------------------------------------
 

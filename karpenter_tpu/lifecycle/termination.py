@@ -32,8 +32,19 @@ log = logging.getLogger("karpenter.termination")
 CRITICAL_PRIORITY = 2_000_000_000  # system-cluster-critical threshold
 
 
+# eviction retry limiter constants (terminator/eviction.go: the queue
+# uses an item-exponential rate limiter, 100ms base / 10s cap, so a
+# PDB-blocked pod is retried with backoff instead of hammered)
+EVICT_BACKOFF_BASE_SECONDS = 0.1
+EVICT_BACKOFF_MAX_SECONDS = 10.0
+
+
 class EvictionQueue:
     """Per-pod eviction with PDB 429 backoff (terminator/eviction.go).
+
+    A PDB rejection is the API substrate's 429: the pod is recorded
+    with an exponential next-retry time and skipped until it elapses,
+    mirroring the reference's rate-limited eviction workqueue.
 
     Eviction deletes the pod and — because this framework carries its
     own API substrate with no ReplicaSet controller or kube-scheduler
@@ -46,19 +57,43 @@ class EvictionQueue:
     def __init__(self, kube: KubeClient):
         self.kube = kube
         self.blocked: dict[str, str] = {}  # pod key -> blocking pdb
+        self._attempts: dict[str, int] = {}  # pod key -> 429 count
+        self._retry_at: dict[str, float] = {}  # pod key -> next attempt
 
     def evict(self, pod: Pod, now: Optional[float] = None, force: bool = False) -> bool:
+        now = time.time() if now is None else now
         if not force:
+            if now < self._retry_at.get(pod.key, 0.0):
+                return False  # still backing off from the last 429
             limits = PdbLimits(self.kube)
             blocking = limits.can_evict(pod)
             if blocking is not None:
                 self.blocked[pod.key] = blocking
+                n = self._attempts.get(pod.key, 0)
+                self._attempts[pod.key] = n + 1
+                self._retry_at[pod.key] = now + min(
+                    EVICT_BACKOFF_MAX_SECONDS,
+                    EVICT_BACKOFF_BASE_SECONDS * 2**n,
+                )
                 return False
-        self.blocked.pop(pod.key, None)
+        self._forget(pod.key)
         self.kube.delete(pod, now=now)
         if pod.owner_kind() != "DaemonSet":
             self.kube.create(rebirth_pod(pod))
         return True
+
+    def _forget(self, pod_key: str) -> None:
+        self.blocked.pop(pod_key, None)
+        self._attempts.pop(pod_key, None)
+        self._retry_at.pop(pod_key, None)
+
+    def prune(self) -> None:
+        """Drop bookkeeping for pods that no longer exist (the
+        reference's queue removes items on pod deletion events)."""
+        live = {p.key for p in self.kube.pods()}
+        for key in list(self.blocked.keys() | self._retry_at.keys()):
+            if key not in live:
+                self._forget(key)
 
 
 def rebirth_pod(pod: Pod) -> Pod:
@@ -141,6 +176,7 @@ class TerminationController:
     def reconcile_all(self, now: Optional[float] = None) -> None:
         for node in list(self.kube.nodes()):
             self.reconcile(node, now=now)
+        self.queue.prune()
 
     # -- helpers ---------------------------------------------------------------
 

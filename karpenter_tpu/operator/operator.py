@@ -181,13 +181,23 @@ class Operator:
                     if plan.claim_name else None
                 )
                 node_name = claim.status.node_name if claim is not None else ""
+                claim_gone = claim is None or (
+                    claim.metadata.deletion_timestamp is not None
+                )
                 for pod in plan.pods:
                     live = self.kube.get_pod(pod.metadata.namespace, pod.metadata.name)
                     if live is None or live.spec.node_name:
                         continue
-                    if node_name:
+                    if node_name and not claim_gone:
                         self.kube.bind_pod(live, node_name)
-                    elif claim is not None:
+                    elif claim_gone:
+                        # binding target never materializes (ICE /
+                        # liveness timeout deleted the claim): re-queue
+                        # the still-pending pod through the batcher —
+                        # the controller analogue of the reference's
+                        # pod-event-driven re-provisioning
+                        self.provisioner.batcher.trigger()
+                    else:
                         unbound = True  # node still materializing
             for node_name, pods in results.existing_assignments.items():
                 for pod in pods:

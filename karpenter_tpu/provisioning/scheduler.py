@@ -365,7 +365,7 @@ class Scheduler:
                     assignment.pods
                 )
                 for pod in assignment.pods:
-                    self._commit_existing(node, pod)
+                    self._commit_existing(assignment.existing_index, pod)
             for pod in solution.unschedulable:
                 retried = False
                 if self._timed_out():
@@ -388,7 +388,7 @@ class Scheduler:
                                     node.name, []
                                 ).extend(a.pods)
                                 for p in a.pods:
-                                    self._commit_existing(node, p)
+                                    self._commit_existing(a.existing_index, p)
                             retried = True
                 if not retried:
                     results.errors[pod.key] = "no compatible instance types or nodes"
@@ -483,7 +483,7 @@ class Scheduler:
                     labels = dict(node.labels())
                     labels[HOSTNAME_LABEL] = inp.name
                     for p in a.pods:
-                        self._commit_existing(node, p)
+                        self._commit_existing(a.existing_index, p)
                         self._register_topo_pod(p, labels, inp.name, tb, topology_full)
                 for plan in open_plans[n_before:]:
                     domains = self._plan_domains(plan)
@@ -588,12 +588,12 @@ class Scheduler:
         self._debit_reservations(kept, round_in_use)
         open_plans.extend(kept)
 
-    def _commit_existing(self, node: StateNode, pod: Pod) -> None:
+    def _commit_existing(self, idx: int, pod: Pod) -> None:
+        node = self.state_nodes[idx]
         usage = resutil.pod_requests(pod)
         node.pod_usage = resutil.merge(node.pod_usage, usage)
         node.pod_keys.add(pod.key)
         # refresh solver input for subsequent passes
-        idx = self.state_nodes.index(node)
         self.existing_inputs[idx] = self._existing_input(node)
 
     def _register_topo_pod(
@@ -730,8 +730,7 @@ class Scheduler:
             allowed = topology.allowed_domains_for_pod(pod, candidate)
             if allowed is None:
                 continue
-            node_mut = self.state_nodes[idx]
-            self._commit_existing(node_mut, pod)
+            self._commit_existing(idx, pod)
             if pod_host_ports(pod):
                 self._host_ports[inp.name].add(pod)
             if pod.spec.volumes and inp.name in self._volume_usage:

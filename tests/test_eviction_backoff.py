@@ -1,0 +1,113 @@
+"""Eviction-queue backoff + operator binding re-queue tests.
+
+The reference's eviction queue retries PDB-blocked (429) evictions
+through an exponential rate limiter (terminator/eviction.go); the
+operator re-provisions pods whose planned claim never materialized.
+"""
+
+import time
+
+from karpenter_tpu.kube.objects import (
+    LabelSelector,
+    ObjectMeta,
+    PodDisruptionBudget,
+    PodDisruptionBudgetSpec,
+)
+from karpenter_tpu.cloudprovider.fake import GIB, make_instance_type
+from karpenter_tpu.lifecycle.termination import (
+    EVICT_BACKOFF_BASE_SECONDS,
+    EVICT_BACKOFF_MAX_SECONDS,
+    EvictionQueue,
+)
+from karpenter_tpu.testing import Environment, mk_nodepool, mk_pod
+
+
+def _blocked_env():
+    env = Environment(types=[make_instance_type("c8", cpu=8, memory=32 * GIB)])
+    env.kube.create(mk_nodepool("default"))
+    pod = mk_pod(cpu=0.5, labels={"app": "web"})
+    env.provision(pod)
+    env.kube.create(
+        PodDisruptionBudget(
+            metadata=ObjectMeta(name="pdb"),
+            spec=PodDisruptionBudgetSpec(
+                selector=LabelSelector.of({"app": "web"}), max_unavailable=0
+            ),
+        )
+    )
+    return env, env.kube.get_pod("default", pod.metadata.name)
+
+
+class TestEvictionBackoff:
+    def test_blocked_eviction_backs_off_exponentially(self):
+        env, pod = _blocked_env()
+        q = EvictionQueue(env.kube)
+        t0 = 1000.0
+        assert not q.evict(pod, now=t0)
+        assert "pdb" in q.blocked[pod.key]
+        # within the backoff window nothing is attempted (attempt count
+        # unchanged even though the PDB would still block)
+        assert not q.evict(pod, now=t0 + EVICT_BACKOFF_BASE_SECONDS / 2)
+        assert q._attempts[pod.key] == 1
+        # after the window the retry happens and doubles the backoff
+        assert not q.evict(pod, now=t0 + EVICT_BACKOFF_BASE_SECONDS * 1.5)
+        assert q._attempts[pod.key] == 2
+        # backoff saturates at the cap
+        for i in range(12):
+            q.evict(pod, now=t0 + 100.0 + 20.0 * i)
+        assert (
+            q._retry_at[pod.key] - (t0 + 100.0 + 20.0 * 11)
+            <= EVICT_BACKOFF_MAX_SECONDS + 1e-9
+        )
+
+    def test_force_bypasses_backoff_and_clears_state(self):
+        env, pod = _blocked_env()
+        q = EvictionQueue(env.kube)
+        assert not q.evict(pod, now=1000.0)
+        assert q.evict(pod, now=1000.01, force=True)
+        assert pod.key not in q._attempts
+        assert pod.key not in q.blocked
+
+    def test_prune_drops_vanished_pods(self):
+        env, pod = _blocked_env()
+        q = EvictionQueue(env.kube)
+        q.evict(pod, now=1000.0)
+        env.kube.delete(pod, now=1000.0)
+        # pod enters Terminating; prune keeps it until actually gone
+        env.kube.remove(pod) if hasattr(env.kube, "remove") else None
+        q.prune()
+        live = {p.key for p in env.kube.pods()}
+        if pod.key not in live:
+            assert pod.key not in q.blocked
+
+
+class TestBindingRequeue:
+    def test_claim_death_requeues_pods_through_batcher(self):
+        from karpenter_tpu.operator.operator import Operator
+        from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
+        from karpenter_tpu.kube.client import KubeClient
+
+        kube = KubeClient()
+        cloud = KwokCloudProvider(
+            kube, types=[make_instance_type("c8", cpu=8, memory=32 * GIB)]
+        )
+        op = Operator(kube=kube, cloud_provider=cloud)
+        kube.create(mk_nodepool("default"))
+        kube.create(mk_pod(name="orphan", cpu=1.0))
+        now = time.time()
+        op.provisioner.batcher.trigger(now=now)
+        results = op.provisioner.reconcile(now=now + 30)
+        assert results.new_node_plans
+        op._pending_bindings.append(results)
+        # kill the claim before any node materializes (ICE analogue)
+        claim = kube.get_node_claim(results.new_node_plans[0].claim_name)
+        kube.delete(claim, now=now + 30)
+        kube.remove_finalizer(claim, claim.metadata.finalizers[0]) if (
+            claim.metadata.finalizers
+        ) else None
+        op.provisioner.batcher.reset()
+        op._bind_pending()
+        # the pod is still pending and the batcher was re-triggered so
+        # the next tick re-provisions it
+        assert not op._pending_bindings
+        assert op.provisioner.batcher._pending

@@ -77,7 +77,9 @@ class TestTermination:
         env.reconcile_termination()
         assert env.kube.nodes()  # blocked
         env.kube.delete(pdb)
-        env.reconcile_termination()
+        # the eviction queue backs off after the PDB 429; the retry
+        # happens once the backoff window elapses
+        env.reconcile_termination(now=time.time() + 11)
         assert not env.kube.nodes()
 
     def test_do_not_disrupt_pod_blocks_until_tgp(self):
