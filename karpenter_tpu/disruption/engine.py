@@ -127,6 +127,8 @@ class DisruptionEngine:
         self.queue = queue or OrchestrationQueue(kube, cluster, provisioner)
         self.options = options or Options()
         self._rng = random.Random(seed)
+        # per-round offering price index; reset by get_candidates
+        self._price_index: dict[str, dict[tuple[str, str, str], float]] = {}
         from karpenter_tpu.disruption.validation import Validator
 
         self.queue.validator = Validator(self)
@@ -215,9 +217,7 @@ class DisruptionEngine:
         zone = labels.get(TOPOLOGY_ZONE_LABEL, "")
         captype = labels.get(CAPACITY_TYPE_LABEL, "")
         pool_name = labels.get(NODEPOOL_LABEL, "")
-        index = getattr(self, "_price_index", None)
-        if index is None:
-            index = self._price_index = {}
+        index = self._price_index
         if pool_name not in index:
             prices: dict[tuple[str, str, str], float] = {}
             pool = self.kube.get_node_pool(pool_name)

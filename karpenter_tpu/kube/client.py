@@ -10,6 +10,11 @@ same semantics the controllers rely on:
   the object disappears when the last finalizer is removed
 - watch: subscribers receive (event, obj) synchronously on mutation —
   the analogue of informer event handlers feeding state.Cluster
+- async delivery mode: watch events queue instead of firing inline,
+  modelling the informer-cache lag behind the real API server
+  (cluster.go:118-213 exists because of exactly this); the operator
+  pumps `deliver()` once per tick, and `Cluster.synced()` reports
+  False while events are in flight
 - immutable NodeClaim spec (the reference enforces via CEL)
 
 Controllers are written against this client; swapping in a real
@@ -57,11 +62,13 @@ class InvalidError(Exception):
 
 
 class KubeClient:
-    def __init__(self) -> None:
+    def __init__(self, async_delivery: bool = False) -> None:
         self._lock = threading.RLock()
         self._store: dict[str, dict[str, object]] = {}
         self._watchers: dict[str, list[WatchHandler]] = {}
         self._rv = 0
+        self.async_delivery = async_delivery
+        self._pending_events: list[tuple[str, str, object]] = []
 
     # -- core CRUD ------------------------------------------------------------
 
@@ -162,8 +169,39 @@ class KubeClient:
                 handler(ADDED, obj)
 
     def _notify(self, kind: str, event: str, obj) -> None:
+        if not self._watchers.get(kind):
+            return
+        if self.async_delivery:
+            self._pending_events.append((kind, event, obj))
+            return
+        self._dispatch(kind, event, obj)
+
+    def _dispatch(self, kind: str, event: str, obj) -> None:
         for handler in self._watchers.get(kind, []):
             handler(event, obj)
+
+    def deliver(self, limit: Optional[int] = None) -> int:
+        """Drain queued watch events to their handlers (the informer
+        stream catching up with the API server). Returns the number
+        delivered. `limit` delivers only the oldest N, letting tests
+        hold the cache arbitrarily stale."""
+        with self._lock:
+            n = len(self._pending_events) if limit is None else min(
+                limit, len(self._pending_events)
+            )
+            batch = self._pending_events[:n]
+            del self._pending_events[:n]
+        for kind, event, obj in batch:
+            self._dispatch(kind, event, obj)
+        return n
+
+    def pending_events(self, kinds: Optional[Iterable[str]] = None) -> int:
+        """Undelivered watch events, optionally filtered by kind."""
+        with self._lock:
+            if kinds is None:
+                return len(self._pending_events)
+            wanted = set(kinds)
+            return sum(1 for k, _, _ in self._pending_events if k in wanted)
 
     # -- typed sugar ----------------------------------------------------------
 

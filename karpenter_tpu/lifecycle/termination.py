@@ -71,9 +71,11 @@ class EvictionQueue:
                 self.blocked[pod.key] = blocking
                 n = self._attempts.get(pod.key, 0)
                 self._attempts[pod.key] = n + 1
+                # exponent capped: the backoff saturates at the max
+                # long before 2**n overflows float range
                 self._retry_at[pod.key] = now + min(
                     EVICT_BACKOFF_MAX_SECONDS,
-                    EVICT_BACKOFF_BASE_SECONDS * 2**n,
+                    EVICT_BACKOFF_BASE_SECONDS * 2 ** min(n, 7),
                 )
                 return False
         self._forget(pod.key)

@@ -127,6 +127,11 @@ class Operator:
         controllers -> provisioning -> lifecycle -> disruption (on its
         poll period) -> orchestration -> termination -> hygiene."""
         now = time.time() if now is None else now
+        # informer pump: under async delivery, queued watch events land
+        # at tick start, so every controller in the tick reads one
+        # consistent (possibly one-tick-stale) mirror — the informer
+        # cache model the reference's Synced() barrier exists for
+        self.kube.deliver()
         if self.overlay_controller is not None:
             # overlay snapshot before anything consumes instance types
             self.overlay_controller.reconcile(now=now)
@@ -144,7 +149,7 @@ class Operator:
             tick(now=now)
         self.lifecycle.reconcile_all(now=now)
 
-        self._bind_pending()
+        self._bind_pending(now=now)
 
         self.pod_events.reconcile_all(now=now)
         self.conditions.reconcile_all(now=now)
@@ -167,7 +172,7 @@ class Operator:
             self.node_metrics.reconcile_all(now=now)
             self.nodepool_metrics.reconcile_all(now=now)
 
-    def _bind_pending(self) -> None:
+    def _bind_pending(self, now: Optional[float] = None) -> None:
         """Bind pods from completed scheduling results to their target
         nodes once those nodes exist (and immediately for placements on
         live nodes). Results are dropped once fully bound or once every
@@ -195,8 +200,10 @@ class Operator:
                         # liveness timeout deleted the claim): re-queue
                         # the still-pending pod through the batcher —
                         # the controller analogue of the reference's
-                        # pod-event-driven re-provisioning
-                        self.provisioner.batcher.trigger()
+                        # pod-event-driven re-provisioning; simulated
+                        # clock threaded through so batcher windows
+                        # never mix wall and sim time
+                        self.provisioner.batcher.trigger(now=now)
                     else:
                         unbound = True  # node still materializing
             for node_name, pods in results.existing_assignments.items():

@@ -418,10 +418,36 @@ class Cluster:
                 self._pod_times.setdefault(pod.key, PodSchedulingTimes()).scheduling_decision = now
 
     def synced(self) -> bool:
-        """The informer/state sync barrier (cluster.go:118). The
-        in-memory client delivers events synchronously, so state is
-        always consistent with the store."""
-        return True
+        """The informer/state sync barrier (cluster.go:118-213): the
+        mirror is synced when the watch stream is fully delivered AND
+        every Node/NodeClaim the store knows is tracked here. Under
+        async delivery this goes False the moment a mutation is queued
+        and stays False until the informer pump catches up — the gate
+        every provisioning/disruption reconcile checks before solving
+        against the mirror."""
+        if self.kube.pending_events(("Node", "NodeClaim", "Pod", "DaemonSet")):
+            return False
+        # store snapshots taken BEFORE the cluster lock: watch dispatch
+        # holds the kube lock while calling into cluster handlers
+        # (kube->cluster order), so taking cluster->kube here would be
+        # a lock-order inversion that can deadlock embedders running
+        # the operator loop and API writes on separate threads
+        store_claims = self.kube.node_claims()
+        store_nodes = self.kube.nodes()
+        with self._lock:
+            for claim in store_claims:
+                pid = claim.status.provider_id
+                if pid:
+                    state = self._by_provider.get(pid)
+                    if state is None or state.node_claim is None:
+                        return False
+                elif claim.metadata.name not in self._unpaired_claims:
+                    return False
+            for node in store_nodes:
+                pid = node.spec.provider_id
+                if pid and pid not in self._by_provider:
+                    return False
+            return True
 
 
 def _has_required_anti_affinity(pod: Pod) -> bool:

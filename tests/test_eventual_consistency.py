@@ -1,0 +1,141 @@
+"""Eventual-consistency suite: informer lag + the synced() barrier.
+
+The reference's hardest race class lives between the API server and
+the informer caches; `Cluster.Synced()` (cluster.go:118-213) gates
+every reconcile on the mirror having caught up. Here the in-memory
+client runs in async-delivery mode: watch events queue until
+`deliver()` pumps them, `synced()` reports False while events are in
+flight, and the whole operator loop must converge with a one-tick
+informer lag.
+"""
+
+import time
+
+from karpenter_tpu.cloudprovider.fake import GIB, make_instance_type
+from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
+from karpenter_tpu.kube.client import KubeClient
+from karpenter_tpu.operator.operator import Operator
+from karpenter_tpu.provisioning.provisioner import Provisioner
+from karpenter_tpu.state.cluster import Cluster, attach_informers
+from karpenter_tpu.testing import mk_nodepool, mk_pod
+
+
+def _types():
+    return [
+        make_instance_type("c4", cpu=4, memory=16 * GIB, price=1.0),
+        make_instance_type("c16", cpu=16, memory=64 * GIB, price=4.0),
+    ]
+
+
+def mk_lagged_operator():
+    kube = KubeClient(async_delivery=True)
+    cloud = KwokCloudProvider(kube, types=_types())
+    return Operator(kube, cloud)
+
+
+def run(op, now, steps, dt=2.0):
+    for _ in range(steps):
+        now += dt
+        op.step(now=now)
+    return now
+
+
+class TestSyncedBarrier:
+    def test_pending_events_unsync_the_mirror(self):
+        kube = KubeClient(async_delivery=True)
+        cluster = Cluster(kube)
+        attach_informers(kube, cluster)
+        assert cluster.synced()
+        kube.create(mk_pod(name="p", cpu=1.0))
+        assert not cluster.synced()  # ADDED event still queued
+        kube.deliver()
+        assert cluster.synced()
+
+    def test_partial_delivery_stays_unsynced(self):
+        kube = KubeClient(async_delivery=True)
+        cluster = Cluster(kube)
+        attach_informers(kube, cluster)
+        kube.create(mk_pod(name="a", cpu=1.0))
+        kube.create(mk_pod(name="b", cpu=1.0))
+        assert kube.deliver(limit=1) == 1
+        assert not cluster.synced()
+        kube.deliver()
+        assert cluster.synced()
+
+    def test_untracked_store_claim_unsyncs(self):
+        # a claim visible in the store but missing from the mirror
+        # (informer registered after the write) must block reconciles
+        kube = KubeClient(async_delivery=True)
+        cluster = Cluster(kube)
+        kube.create(mk_nodepool("general"))  # unwatched kind: no event
+        # create a claim straight into the store before informers exist
+        from karpenter_tpu.apis.v1.nodeclaim import NodeClaim, NodeClaimSpec
+        from karpenter_tpu.kube.objects import ObjectMeta
+
+        kube.create(NodeClaim(metadata=ObjectMeta(name="ghost", namespace=""),
+                              spec=NodeClaimSpec()))
+        attach_informers(kube, cluster)  # replay pairs it up again
+        assert cluster.synced()
+        # now orphan the mirror entry artificially
+        cluster._unpaired_claims.clear()
+        assert not cluster.synced()
+
+    def test_unsynced_mirror_gates_the_provisioner(self):
+        kube = KubeClient(async_delivery=True)
+        cloud = KwokCloudProvider(kube, types=_types())
+        cluster = Cluster(kube)
+        attach_informers(kube, cluster)
+        provisioner = Provisioner(kube, cluster, cloud)
+        kube.create(mk_nodepool("general"))
+        kube.create(mk_pod(name="w", cpu=1.0))
+        # event in flight: the reconcile must refuse to solve
+        results = provisioner.reconcile()
+        assert not results.new_node_plans
+        assert not kube.node_claims()
+        kube.deliver()
+        results = provisioner.reconcile()
+        assert len(results.new_node_plans) == 1
+        assert kube.node_claims()
+
+
+class TestLaggedOperatorLoop:
+    def test_provision_burst_converges_under_lag(self):
+        op = mk_lagged_operator()
+        op.kube.create(mk_nodepool("general"))
+        for i in range(60):
+            op.kube.create(mk_pod(name=f"r-{i}", cpu=0.9))
+        run(op, time.time(), 12)
+        bound = [p for p in op.kube.pods() if p.spec.node_name]
+        assert len(bound) == 60
+        assert 3 <= len(op.kube.nodes()) <= 20
+
+    def test_scale_down_consolidates_under_lag(self):
+        op = mk_lagged_operator()
+        op.kube.create(mk_nodepool("general"))
+        for i in range(30):
+            op.kube.create(mk_pod(name=f"w-{i}", cpu=0.9))
+        now = run(op, time.time(), 12)
+        nodes_before = len(op.kube.nodes())
+        for pod in list(op.kube.pods())[:24]:
+            op.kube.delete(pod)
+        run(op, now, 50, dt=6.0)
+        live = [n for n in op.kube.nodes() if n.metadata.deletion_timestamp is None]
+        assert len(live) < nodes_before
+        bound = [p for p in op.kube.pods() if p.spec.node_name]
+        assert len(bound) == 6
+
+    def test_teardown_converges_under_lag(self):
+        op = mk_lagged_operator()
+        op.kube.create(mk_nodepool("general"))
+        for i in range(5):
+            op.kube.create(mk_pod(name=f"t-{i}", cpu=0.9))
+        now = run(op, time.time(), 8)
+        assert op.kube.node_claims()
+        for pod in list(op.kube.pods()):
+            op.kube.delete(pod)
+        for claim in list(op.kube.node_claims()):
+            op.kube.delete(claim)
+        run(op, now, 30, dt=6.0)
+        assert not op.kube.node_claims()
+        assert not op.kube.nodes()
+        assert not op.cloud_provider.list()

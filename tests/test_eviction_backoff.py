@@ -72,13 +72,13 @@ class TestEvictionBackoff:
         env, pod = _blocked_env()
         q = EvictionQueue(env.kube)
         q.evict(pod, now=1000.0)
+        assert pod.key in q.blocked and pod.key in q._retry_at
         env.kube.delete(pod, now=1000.0)
-        # pod enters Terminating; prune keeps it until actually gone
-        env.kube.remove(pod) if hasattr(env.kube, "remove") else None
+        assert pod.key not in {p.key for p in env.kube.pods()}
         q.prune()
-        live = {p.key for p in env.kube.pods()}
-        if pod.key not in live:
-            assert pod.key not in q.blocked
+        assert pod.key not in q.blocked
+        assert pod.key not in q._retry_at
+        assert pod.key not in q._attempts
 
 
 class TestBindingRequeue:
