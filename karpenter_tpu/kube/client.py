@@ -69,6 +69,12 @@ class KubeClient:
         self._rv = 0
         self.async_delivery = async_delivery
         self._pending_events: list[tuple[str, str, object]] = []
+        # serializes deliver() so concurrent pumps can't interleave
+        # event order; re-entrant pumps (a handler calling deliver)
+        # no-op instead of delivering newer events ahead of the
+        # in-flight batch
+        self._deliver_lock = threading.RLock()
+        self._delivering = False
 
     # -- core CRUD ------------------------------------------------------------
 
@@ -185,15 +191,22 @@ class KubeClient:
         stream catching up with the API server). Returns the number
         delivered. `limit` delivers only the oldest N, letting tests
         hold the cache arbitrarily stale."""
-        with self._lock:
-            n = len(self._pending_events) if limit is None else min(
-                limit, len(self._pending_events)
-            )
-            batch = self._pending_events[:n]
-            del self._pending_events[:n]
-        for kind, event, obj in batch:
-            self._dispatch(kind, event, obj)
-        return n
+        with self._deliver_lock:
+            if self._delivering:
+                return 0
+            self._delivering = True
+            try:
+                with self._lock:
+                    n = len(self._pending_events) if limit is None else min(
+                        limit, len(self._pending_events)
+                    )
+                    batch = self._pending_events[:n]
+                    del self._pending_events[:n]
+                for kind, event, obj in batch:
+                    self._dispatch(kind, event, obj)
+                return n
+            finally:
+                self._delivering = False
 
     def pending_events(self, kinds: Optional[Iterable[str]] = None) -> int:
         """Undelivered watch events, optionally filtered by kind."""

@@ -35,6 +35,7 @@ config axis.
 from __future__ import annotations
 
 import functools
+import os
 from dataclasses import dataclass
 
 import jax
@@ -45,6 +46,32 @@ from karpenter_tpu.solver.encode import Encoded
 
 BIG = jnp.float32(3.4e38)
 INT_BIG = jnp.int32(2**31 - 1)
+
+
+@functools.lru_cache(maxsize=None)
+def _mesh(shards: int):
+    """Device mesh over the config axis. Configs are the natural
+    parallel dimension: every hot tensor in the kernel is [N, C] or
+    [C, R], per-step reductions over C (feasibility max, argmax picks)
+    lower to ICI collectives XLA inserts, and the pod/group loop state
+    stays tiny and replicated."""
+    from jax.sharding import Mesh
+
+    devices = jax.devices()
+    if len(devices) < shards:
+        raise ValueError(
+            f"{shards} solver shards requested but only "
+            f"{len(devices)} devices visible"
+        )
+    return Mesh(np.array(devices[:shards]), ("cfg",))
+
+
+def default_shards() -> int:
+    """Shard count the framework paths inherit (0 = unsharded)."""
+    try:
+        return int(os.environ.get("KARPENTER_SOLVER_SHARDS", "0") or 0)
+    except ValueError:
+        return 0
 
 
 @dataclass
@@ -336,7 +363,8 @@ def _estimate_nodes(enc: Encoded) -> int:
 
 
 def solve_packing(
-    enc: Encoded, max_nodes: int = 0, mode: str = "ffd", plan=None
+    enc: Encoded, max_nodes: int = 0, mode: str = "ffd", plan=None,
+    shards: int = 0,
 ) -> PackResult:
     """Host entry: run the packing kernel on the encoded problem.
 
@@ -351,7 +379,16 @@ def solve_packing(
     as reserved slots pointing at their launch config column, each with
     the LP's per-node group quotas; the fresh-node path only handles
     rounding spill.
+
+    With `shards > 1` (or KARPENTER_SOLVER_SHARDS set), the config
+    axis is partitioned over a `shards`-device mesh — inputs land
+    pre-sharded via NamedSharding and XLA turns the kernel's config
+    reductions into collectives. Results are identical to the
+    unsharded solve (every choice is an index-tie-broken arg-reduction,
+    insensitive to partitioning).
     """
+    if shards == 0:
+        shards = default_shards()
     G, C = enc.compat.shape
     E = enc.n_existing
     n_planned = len(plan.planned_cols) if plan is not None else 0
@@ -380,7 +417,7 @@ def solve_packing(
     if max_nodes > 0:
         return _run_pack(
             enc, existing_mask, existing_used,
-            max_nodes + (reserved_p - reserved), mode, quota,
+            max_nodes + (reserved_p - reserved), mode, quota, shards,
         )
 
     estimate = _estimate_nodes(enc)
@@ -394,7 +431,9 @@ def solve_packing(
         )
     worst_case = reserved_p + int(enc.group_count.sum())
     while True:
-        result = _run_pack(enc, existing_mask, existing_used, max_nodes, mode, quota)
+        result = _run_pack(
+            enc, existing_mask, existing_used, max_nodes, mode, quota, shards
+        )
         capped = (
             result.node_count >= max_nodes and result.unschedulable.sum() > 0
         )
@@ -430,11 +469,15 @@ def _run_pack(
     max_nodes: int,
     mode: str = "ffd",
     quota: np.ndarray | None = None,
+    shards: int = 0,
 ) -> PackResult:
     G, C = enc.compat.shape
     R = enc.group_req.shape[1]
     E = existing_mask.shape[0]
     Gp, Cp, Ep = _pad_axis(G), _pad_axis(C), _pad_axis(E) if E else 0
+    if shards > 1:
+        # the sharded axis must divide evenly across the mesh
+        Cp = -(-Cp // shards) * shards
     N = max_nodes
 
     compat = np.zeros((Gp, Cp), bool)
@@ -485,16 +528,54 @@ def _run_pack(
         rsvp[:C] = enc.cfg_rsv
         cfg_rsv = jnp.asarray(rsvp)
         rsv_cap = jnp.asarray(enc.rsv_cap.astype(np.float32))
+
+    compat_j = jnp.asarray(compat)
+    cfg_alloc_j = jnp.asarray(cfg_alloc)
+    cfg_pool_j = jnp.asarray(cfg_pool)
+    cfg_price_j = jnp.asarray(cfg_price)
+    emask_j = jnp.asarray(emask)
+    rest = {
+        "group_req": jnp.asarray(group_req),
+        "group_count": jnp.asarray(group_count),
+        "pool_overhead": jnp.asarray(enc.pool_overhead),
+        "eused": jnp.asarray(eused),
+    }
+    if shards > 1:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh = _mesh(shards)
+        shard_cfg = NamedSharding(mesh, P("cfg"))
+        shard_nc = NamedSharding(mesh, P(None, "cfg"))
+        shard_cr = NamedSharding(mesh, P("cfg", None))
+        replicated = NamedSharding(mesh, P())
+        # committed input shardings drive GSPMD: the jitted kernel
+        # compiles with the config axis split over ICI and everything
+        # else replicated
+        compat_j = jax.device_put(compat_j, shard_nc)
+        cfg_alloc_j = jax.device_put(cfg_alloc_j, shard_cr)
+        cfg_pool_j = jax.device_put(cfg_pool_j, shard_cfg)
+        cfg_price_j = jax.device_put(cfg_price_j, shard_cfg)
+        emask_j = jax.device_put(emask_j, shard_nc)
+        rest = {k: jax.device_put(v, replicated) for k, v in rest.items()}
+        if cfg_rsv is not None:
+            cfg_rsv = jax.device_put(cfg_rsv, shard_cfg)
+            rsv_cap = jax.device_put(rsv_cap, replicated)
+        if quota_full is not None:
+            quota_full = jax.device_put(quota_full, replicated)
+        if group_cap_full is not None:
+            group_cap_full = jax.device_put(group_cap_full, replicated)
+        if conflict_full is not None:
+            conflict_full = jax.device_put(conflict_full, replicated)
     flat = pack_flat(
-        jnp.asarray(compat),
-        jnp.asarray(group_req),
-        jnp.asarray(group_count),
-        jnp.asarray(cfg_alloc),
-        jnp.asarray(cfg_pool),
-        jnp.asarray(enc.pool_overhead),
-        jnp.asarray(emask),
-        jnp.asarray(eused),
-        jnp.asarray(cfg_price),
+        compat_j,
+        rest["group_req"],
+        rest["group_count"],
+        cfg_alloc_j,
+        cfg_pool_j,
+        rest["pool_overhead"],
+        emask_j,
+        rest["eused"],
+        cfg_price_j,
         max_nodes=max_nodes,
         mode=mode,
         quota=quota_full,

@@ -121,15 +121,19 @@ def solve(
     required_only: bool = False,
     backend: Optional[str] = None,
     objective: str = "ffd",
+    shards: int = 0,
 ) -> Solution:
     groups = group_pods(pods, required_only=required_only)
     enc = encode(groups, pools_with_types, existing, daemon_overhead)
-    return solve_encoded(enc, backend=backend, objective=objective)
+    return solve_encoded(enc, backend=backend, objective=objective, shards=shards)
 
 
 def solve_encoded(
-    enc: Encoded, backend: Optional[str] = None, objective: str = "ffd"
+    enc: Encoded, backend: Optional[str] = None, objective: str = "ffd",
+    shards: int = 0,
 ) -> Solution:
+    """`shards > 1` partitions the solver's config axis over a device
+    mesh (see pack.solve_packing); 0 inherits KARPENTER_SOLVER_SHARDS."""
     G, C = enc.compat.shape
     if G == 0 or C == 0:
         return Solution(
@@ -140,14 +144,16 @@ def solve_encoded(
     backend = backend or _backend()
     if backend == "host":
         return _decode_host(enc)
-    return _decode_device(enc, objective)
+    return _decode_device(enc, objective, shards)
 
 
-def _decode_device(enc: Encoded, objective: str = "ffd") -> Solution:
+def _decode_device(
+    enc: Encoded, objective: str = "ffd", shards: int = 0
+) -> Solution:
     from karpenter_tpu.solver.pack import solve_packing
 
     if objective != "cost":
-        result = solve_packing(enc, mode=objective)
+        result = solve_packing(enc, mode=objective, shards=shards)
         return _build_solution_arrays(
             enc,
             np.flatnonzero(result.node_active[: result.node_count]),
@@ -165,10 +171,10 @@ def _decode_device(enc: Encoded, objective: str = "ffd") -> Solution:
 
     plan = lp_plan.plan(enc)
     candidates = []
-    ffd_result = solve_packing(enc, mode="ffd")
+    ffd_result = solve_packing(enc, mode="ffd", shards=shards)
     candidates.append((ffd_result, _downsize_masks(enc, ffd_result)))
     if plan is not None:
-        cost_result = solve_packing(enc, mode="cost", plan=plan)
+        cost_result = solve_packing(enc, mode="cost", plan=plan, shards=shards)
         candidates.append((cost_result, _downsize_masks(enc, cost_result)))
 
     def key(item):

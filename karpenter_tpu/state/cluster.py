@@ -33,6 +33,11 @@ from karpenter_tpu.utils.resources import ResourceList
 
 NOMINATION_WINDOW_SECONDS = 20.0
 
+# the kinds whose watch streams feed the mirror — attach_informers
+# registers handlers for exactly these, and synced() refuses while any
+# of their events are undelivered; one constant so the two can't drift
+INFORMER_KINDS = ("Node", "NodeClaim", "Pod", "DaemonSet")
+
 
 class StateNode:
     """A Node + NodeClaim pair (statenode.go:119)."""
@@ -425,7 +430,7 @@ class Cluster:
         and stays False until the informer pump catches up — the gate
         every provisioning/disruption reconcile checks before solving
         against the mirror."""
-        if self.kube.pending_events(("Node", "NodeClaim", "Pod", "DaemonSet")):
+        if self.kube.pending_events(INFORMER_KINDS):
             return False
         # store snapshots taken BEFORE the cluster lock: watch dispatch
         # holds the kube lock while calling into cluster handlers
@@ -482,7 +487,12 @@ def attach_informers(kube: KubeClient, cluster: Cluster) -> None:
         else:
             cluster.update_daemonset(obj)
 
-    kube.watch("Node", on_node)
-    kube.watch("NodeClaim", on_claim)
-    kube.watch("Pod", on_pod)
-    kube.watch("DaemonSet", on_daemonset)
+    handlers = {
+        "Node": on_node,
+        "NodeClaim": on_claim,
+        "Pod": on_pod,
+        "DaemonSet": on_daemonset,
+    }
+    assert set(handlers) == set(INFORMER_KINDS)
+    for kind in INFORMER_KINDS:
+        kube.watch(kind, handlers[kind])
