@@ -389,6 +389,24 @@ def solve_packing(
     """
     if shards == 0:
         shards = default_shards()
+        if shards > 1:
+            # env-inherited counts degrade gracefully: a fleet-wide
+            # KARPENTER_SOLVER_SHARDS must not crash-loop hosts with
+            # fewer visible devices — fall back to the unsharded solve.
+            # An explicit shards argument still raises (the caller
+            # asked for that exact mesh).
+            try:
+                visible = len(jax.devices())
+            except Exception:
+                visible = 1
+            if shards > visible:
+                import logging
+
+                logging.getLogger("karpenter.solver").warning(
+                    "KARPENTER_SOLVER_SHARDS=%d exceeds %d visible "
+                    "devices; running unsharded", shards, visible,
+                )
+                shards = 0
     G, C = enc.compat.shape
     E = enc.n_existing
     n_planned = len(plan.planned_cols) if plan is not None else 0

@@ -103,6 +103,10 @@ class Solution:
     new_nodes: list[NodePlan]
     existing: list[ExistingAssignment]
     unschedulable: list[Pod]
+    # cost-objective solves attach the planner's bounds here so callers
+    # can report optimality gaps without re-running column generation:
+    # {"lower_bound": linear resource bound, "estimate": master-LP value}
+    lp: Optional[dict] = None
 
     @property
     def total_price(self) -> float:
@@ -191,13 +195,19 @@ def _decode_device(
         return (int(result.unschedulable.sum()), fleet, len(act))
 
     result, masks = min(candidates, key=key)
-    return _build_solution_arrays(
+    solution = _build_solution_arrays(
         enc,
         np.flatnonzero(result.node_active[: result.node_count]),
         masks,
         result.assign,
         result.unschedulable,
     )
+    if plan is not None:
+        solution.lp = {
+            "lower_bound": plan.lower_bound,
+            "estimate": plan.objective_estimate,
+        }
+    return solution
 
 
 def _downsize_masks(enc: Encoded, result) -> np.ndarray:

@@ -58,6 +58,65 @@ class TestShardedPack:
             len(n.pods) for n in base.new_nodes
         ]
 
+    def test_sharded_with_existing_nodes_matches(self):
+        # the production consolidation path: existing nodes occupy the
+        # pseudo-config columns, so the sharded emask branch must agree
+        from karpenter_tpu.apis.v1.labels import (
+            CAPACITY_TYPE_LABEL,
+            INSTANCE_TYPE_LABEL,
+            NODEPOOL_LABEL,
+            TOPOLOGY_ZONE_LABEL,
+        )
+        from karpenter_tpu.scheduling.requirements import Requirements
+        from karpenter_tpu.solver.encode import ExistingNodeInput
+
+        pods, pools, _ = _problem(900, 48, seed=13)
+        types = pools[0][1]
+        existing = []
+        for i, it in enumerate(types[:6]):
+            labels = {
+                NODEPOOL_LABEL: pools[0][0].metadata.name,
+                INSTANCE_TYPE_LABEL: it.name,
+                TOPOLOGY_ZONE_LABEL: "test-zone-1",
+                CAPACITY_TYPE_LABEL: "on-demand",
+            }
+            existing.append(
+                ExistingNodeInput(
+                    name=f"live-{i}",
+                    requirements=Requirements.from_labels(labels),
+                    taints=(),
+                    available=dict(it.allocatable),
+                    pool_name=pools[0][0].metadata.name,
+                    pod_count=0,
+                )
+            )
+        base = solve(pods, pools, existing=existing)
+        sharded = solve(pods, pools, existing=existing, shards=8)
+        assert len(sharded.new_nodes) == len(base.new_nodes)
+        assert len(sharded.existing) == len(base.existing)
+        assert [
+            (a.existing_index, len(a.pods)) for a in sharded.existing
+        ] == [(a.existing_index, len(a.pods)) for a in base.existing]
+
+    def test_sharded_lp_planned_cost_solve_matches(self):
+        # cost mode with an actual FleetPlan: planned columns pre-open
+        # nodes with per-node quotas — the quota/emask device_put path
+        from karpenter_tpu.cloudprovider.fake import (
+            heterogeneous_instance_types,
+        )
+        from karpenter_tpu.solver import lp_plan
+        from karpenter_tpu.solver.pack import solve_packing as sp
+
+        pods, pools, _ = _problem(1500, 60, seed=21)
+        pools = [(pools[0][0], heterogeneous_instance_types(60))]
+        enc = encode(group_pods(pods), pools)
+        plan = lp_plan.plan(enc)
+        assert plan is not None and len(plan.planned_cols) > 0
+        base = sp(enc, mode="cost", plan=plan)
+        sharded = sp(enc, mode="cost", plan=plan, shards=8)
+        assert sharded.node_count == base.node_count
+        assert np.array_equal(sharded.assign, base.assign)
+
     def test_too_many_shards_raises(self):
         with pytest.raises(ValueError):
             _mesh(512)
