@@ -165,14 +165,25 @@ def build_problem(n_pods: int, n_types: int, seed: int = 42,
     return pods, [(pool, types)]
 
 
-def _timed_cost_solve(pods, pools, bound_gap: bool = False):
+def _timed_cost_solve(pods, pools, bound_gap: bool = False, repeats: int = 1):
+    """One warm-up solve (captures compile + cache population), then
+    `repeats` timed steady-state solves. With repeats > 1 the detail
+    carries the full latency distribution (p50/p90/p99) separately
+    from the one-time compile cost — the BASELINE "<1s p99" target is
+    about the steady state, not the first trace."""
     from karpenter_tpu.solver.solver import solve
 
     ffd = solve(pods, pools, objective="ffd")
-    solve(pods, pools, objective="cost")  # warm same static shapes
     t0 = time.perf_counter()
-    sol = solve(pods, pools, objective="cost")
-    wall = time.perf_counter() - t0
+    solve(pods, pools, objective="cost")  # warm: compile + shape buckets
+    warm_wall = time.perf_counter() - t0
+    samples = []
+    sol = None
+    for _ in range(max(1, repeats)):
+        t0 = time.perf_counter()
+        sol = solve(pods, pools, objective="cost")
+        samples.append(time.perf_counter() - t0)
+    wall = sorted(samples)[len(samples) // 2]  # p50 is the headline wall
     scheduled = sum(len(n.pods) for n in sol.new_nodes) + sum(
         len(e.pods) for e in sol.existing
     )
@@ -191,6 +202,18 @@ def _timed_cost_solve(pods, pools, bound_gap: bool = False):
             1 - cost_price / ffd_price, 4
         ) if ffd_price > 0 else 0.0,
     }
+    if repeats > 1:
+        ordered = sorted(samples)
+
+        def pct(p):
+            return round(ordered[min(len(ordered) - 1,
+                                     int(p * len(ordered)))], 3)
+
+        out["warmup_s"] = round(warm_wall, 3)  # compile + cache fill
+        out["p50_s"] = pct(0.50)
+        out["p90_s"] = pct(0.90)
+        out["p99_s"] = pct(0.99)
+        out["samples"] = len(ordered)
     if bound_gap and sol.lp is not None:
         # quantify optimality from the bounds the cost solve already
         # computed: the master-LP value estimates the Gilmore-Gomory
@@ -481,10 +504,14 @@ def scenario_consolidation() -> dict:
 
 
 def scenario_reserved_50k(n_pods: int, n_types: int) -> dict:
+    """The headline: 50k pods x 500 types with capacity reservations.
+    Reports the steady-state latency distribution over 8 solves plus
+    the one-time warm-up (compile) cost — BASELINE target is p99 < 1s
+    on the TPU chip."""
     pods, pools = build_problem(
         n_pods, n_types, reservations=True, zonal_frac=0.1
     )
-    return _timed_cost_solve(pods, pools)
+    return _timed_cost_solve(pods, pools, bound_gap=True, repeats=8)
 
 
 def scenario_hetero(n_pods: int = 10000, n_types: int = 200) -> dict:

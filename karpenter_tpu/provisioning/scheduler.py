@@ -84,10 +84,9 @@ class SchedulerResults:
 
 def _pool_requirements(pool: NodePool) -> Requirements:
     """The pool template's requirement set, minValues included."""
-    reqs = Requirements()
-    for spec in pool.spec.template.spec.requirements:
-        reqs.add(Requirement(spec.key, spec.operator, spec.values, spec.min_values))
-    return reqs
+    from karpenter_tpu.solver.encode import pool_template_requirements
+
+    return pool_template_requirements(pool, with_labels=False)
 
 
 def _strip_reserved(it: InstanceType) -> InstanceType:
@@ -274,13 +273,24 @@ class Scheduler:
         return out
 
     def _build_topology(self) -> Topology:
+        # Domain discovery honors the POOL's own requirements
+        # (topology.go:105-146): a pool restricted to two zones
+        # contributes only those two as spread domains — otherwise the
+        # skew floor counts zones no node could ever open in and
+        # DoNotSchedule wedges.
+        from karpenter_tpu.solver.encode import pool_template_requirements
+
         domains: dict[str, set[str]] = {}
         for pool, types in self.pools_with_types:
+            pool_reqs = pool_template_requirements(pool)
             for it in types:
                 for key in (TOPOLOGY_ZONE_LABEL, CAPACITY_TYPE_LABEL):
                     req = it.requirements.get(key)
                     if req.operator() == IN:
-                        domains.setdefault(key, set()).update(req.values)
+                        gate = pool_reqs.get(key)
+                        domains.setdefault(key, set()).update(
+                            v for v in req.values if gate.has(v)
+                        )
         pod_domains: dict[str, dict[str, str]] = {}
         for node in self.state_nodes:
             labels = node.labels()
@@ -681,6 +691,7 @@ class Scheduler:
             for _ in range(8):  # relaxation ladder bound
                 if self._try_place(pod, open_plans, topology, results, round_in_use):
                     break
+                topology.invalidate(pod.key)  # relax() mutates the pod
                 if not (self.honor_preferences and relax(pod)):
                     results.errors[pod.key] = (
                         "incompatible with topology constraints or no capacity"

@@ -59,14 +59,31 @@ class TopologyGroup:
 
     # -- legality -------------------------------------------------------------
 
-    def allowed_domains(self, candidate_domains: Iterable[str]) -> set[str]:
+    def allowed_domains(
+        self,
+        candidate_domains: Iterable[str],
+        eligible: Optional[set[str]] = None,
+    ) -> set[str]:
         """Domains where one more matching pod keeps the constraint
-        satisfied (nextDomainTopologySpread topologygroup.go:226-311)."""
+        satisfied (nextDomainTopologySpread topologygroup.go:226-311).
+
+        `eligible`: the domains the POD itself may reach (its node
+        selector / required affinity) — per NodeAffinityPolicy=Honor the
+        skew minimum is computed over these, never over domains the pod
+        could not land in."""
         candidates = set(candidate_domains)
         if self.type == TYPE_SPREAD:
+            if eligible is not None:
+                # a domain the pod's own required terms exclude is never
+                # a legal placement, and never part of the skew minimum
+                candidates &= eligible
+                if not candidates:
+                    return set()
             live = {d: c for d, c in self.counts.items()}
             for d in candidates:
                 live.setdefault(d, 0)
+            if eligible is not None:
+                live = {d: c for d, c in live.items() if d in eligible}
             if not live:
                 return candidates
             global_min = min(live.values())
@@ -144,6 +161,10 @@ class Topology:
         self.domains = {k: set(v) for k, v in domains.items()}
         self.honor_schedule_anyway = honor_schedule_anyway
         self._groups: dict[tuple, TopologyGroup] = {}
+        # required-only requirement sets, parsed once per pod per round
+        # (allowed_domains_for_pod runs once per candidate node in the
+        # scheduler loop — reparsing there would be quadratic)
+        self._pod_reqs_cache: dict[str, "Requirements"] = {}
         pod_domains = pod_domains or {}
 
         for pod in pending_pods:
@@ -243,6 +264,11 @@ class Topology:
                 return True
         return False
 
+    def invalidate(self, pod_key: str) -> None:
+        """Drop the cached requirement parse for a pod whose spec was
+        mutated (the preference-relaxation ladder edits pods in place)."""
+        self._pod_reqs_cache.pop(pod_key, None)
+
     def register_domain(self, key: str, domain: str) -> None:
         self.domains.setdefault(key, set()).add(domain)
         for group in self._groups.values():
@@ -260,6 +286,12 @@ class Topology:
         `candidate`: topology key -> domains the target node could take.
         """
         result = {k: set(v) for k, v in candidate.items()}
+        pod_reqs = self._pod_reqs_cache.get(pod.key)
+        if pod_reqs is None:
+            from karpenter_tpu.scheduling.requirements import Requirements
+
+            pod_reqs = Requirements.from_pod(pod, required_only=True)
+            self._pod_reqs_cache[pod.key] = pod_reqs
         # Constraints the pod owns
         for group in self._groups_for_pod(pod):
             domains = result.get(group.key)
@@ -267,7 +299,11 @@ class Topology:
                 # node has no value for this key -> illegal for spread
                 # constraints that require the label
                 return None
-            allowed = group.allowed_domains(domains)
+            gate = pod_reqs.get(group.key)
+            eligible = {
+                d for d in self.domains.get(group.key, ()) if gate.has(d)
+            } or None
+            allowed = group.allowed_domains(domains, eligible=eligible)
             if group.type == TYPE_AFFINITY and not group.has_occupied():
                 # first pod: legal only if the pod self-selects (it
                 # will satisfy its own affinity) — else any domain is

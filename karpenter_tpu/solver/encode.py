@@ -185,14 +185,26 @@ class Encoded:
                                           # existing node (counts already there)
 
 
-def _config_requirements(
-    pool: NodePool, it: InstanceType, offering: Offering
+def pool_template_requirements(
+    pool: NodePool, with_labels: bool = True
 ) -> Requirements:
+    """The pool template's requirement set (spec requirements incl.
+    minValues, plus template labels as IN pins). The single source for
+    every consumer — config building, domain discovery, minValues
+    enforcement — so the assembly can't drift between sites."""
     reqs = Requirements()
     for spec in pool.spec.template.spec.requirements:
         reqs.add(Requirement(spec.key, spec.operator, spec.values, spec.min_values))
-    for key, value in pool.spec.template.labels.items():
-        reqs.add(Requirement(key, IN, [value]))
+    if with_labels:
+        for key, value in pool.spec.template.labels.items():
+            reqs.add(Requirement(key, IN, [value]))
+    return reqs
+
+
+def _config_requirements(
+    pool: NodePool, it: InstanceType, offering: Offering
+) -> Requirements:
+    reqs = pool_template_requirements(pool)
     reqs.add(Requirement(NODEPOOL_LABEL, IN, [pool.metadata.name]))
     reqs.add(*it.requirements.values())
     reqs.add(*offering.requirements.values())
@@ -210,9 +222,20 @@ def build_configs(
         taints = tuple(pool.spec.template.spec.taints) + tuple(
             pool.spec.template.spec.startup_taints
         )
+        # the pool template's own requirements filter which types and
+        # offerings may launch under it (InstanceTypes.Compatible,
+        # types.go:243; offering filtering nodeclaim.go:373-447). A
+        # conflicting (pool, type/offering) pair must never become a
+        # config: no pod references the conflicting key, so the compat
+        # matrix would not catch it.
+        pool_reqs = pool_template_requirements(pool)
         for it in types:
+            if pool_reqs.intersects(it.requirements) is not None:
+                continue
             for offering in it.offerings:
                 if not offering.available:
+                    continue
+                if pool_reqs.intersects(offering.requirements) is not None:
                     continue
                 configs.append(
                     ConfigInfo(

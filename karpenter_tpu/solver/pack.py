@@ -168,8 +168,19 @@ def pack(
         kmat = jnp.where(req[None, None, :] > 0, kmat, BIG).min(axis=-1)
         kmat = jnp.clip(kmat, 0.0, 2.0e9).astype(jnp.int32)
         ok = node_mask & row[None, :] & (kmat >= 1)
+        # a reservation-pinned node (mask holds a capped column) only
+        # admits groups compatible with THAT column, and its fill is
+        # bounded by the reserved machine — otherwise a later group
+        # could tighten the reserved column away, silently un-pinning
+        # a node whose budget was already spent
+        pinned = node_mask & capped[None, :]
+        is_pinned = pinned.any(axis=1)
+        pin_ok = (ok & pinned).any(axis=1)
+        ok = ok & jnp.where(is_pinned[:, None], pin_ok[:, None], True)
         kmat = kmat * ok
-        k = kmat.max(axis=1)
+        k = jnp.where(
+            is_pinned, (kmat * pinned).max(axis=1), kmat.max(axis=1)
+        )
         if quota is not None:
             # LP-planned nodes cap each group's take so complementary
             # resource shapes can share the node (see lp_plan).
@@ -259,20 +270,22 @@ def pack(
                 sel_full.astype(jnp.int32) * m_star
                 + sel_last.astype(jnp.int32) * rem_last
             )
-            # A capped (reserved) config pins its nodes: the option
-            # mask is exactly that column, mirroring FinalizeScheduling
-            # adding the reservation-id requirement
-            # (scheduling/nodeclaim.go:252). Uncapped opens exclude
+            # A capped (reserved) open keeps its reserved column PLUS
+            # the same-pool uncapped columns that fit: decode resolves
+            # the node onto the (near-free) reservation — so the claim
+            # still pins the reservation id (FinalizeScheduling,
+            # scheduling/nodeclaim.go:252) — while the instance-type
+            # OPTION list keeps the flexibility the reference's
+            # minValues floor is measured against (the pin narrows the
+            # launch, not the option set). Uncapped opens exclude
             # capped columns so decode can never resolve a node onto a
             # reservation the budget didn't admit.
             is_capped = capped[c_star]
             one_hot = jnp.arange(C) == c_star
-            open_mask_full = jnp.where(
-                is_capped, one_hot, mask & ~capped & (kf >= m_star)
-            )
-            open_mask_last = jnp.where(
-                is_capped, one_hot, mask & ~capped & (kf >= rem_last)
-            )
+            base_full = mask & ~capped & (kf >= m_star)
+            base_last = mask & ~capped & (kf >= rem_last)
+            open_mask_full = jnp.where(is_capped, one_hot | base_full, base_full)
+            open_mask_last = jnp.where(is_capped, one_hot | base_last, base_last)
             node_mask = jnp.where(
                 sel_full[:, None], open_mask_full[None, :],
                 jnp.where(sel_last[:, None], open_mask_last[None, :], node_mask),

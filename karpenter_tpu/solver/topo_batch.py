@@ -297,12 +297,30 @@ def _lower_partition(
 
     # per-pod domain choice, bucketed into pinned pseudo-groups
     for shape in shape_groups:
+        # the shape's OWN requirements (node selector, required node
+        # affinity) restrict which domains its pods may use — and per
+        # NodeAffinityPolicy=Honor semantics the skew is computed over
+        # exactly that eligible set (topologygroup.go:226-311), so the
+        # water-fill below must never pin a pod to an unreachable
+        # domain nor count one in the minimum
+        shape_cand: dict[str, list[str]] = {}
+        reachable = True
+        for key in keys:
+            gate = shape.requirements.get(key)
+            allowed = [d for d in candidates[key] if gate.has(d)]
+            if not allowed:
+                reachable = False
+                break
+            shape_cand[key] = allowed
+        if not reachable:
+            batch.fallback.extend(shape.pods)
+            continue
         buckets: dict[tuple, list[Pod]] = {}
         for pod in shape.pods:
             assignment: dict[str, str] = {}
             dead = False
             for key in keys:
-                cand = candidates[key]
+                cand = shape_cand[key]
                 anti = [g for g in domain_anti if g.key == key]
                 if anti:
                     # distinct empty domain per pod

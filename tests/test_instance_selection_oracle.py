@@ -1,0 +1,402 @@
+"""Instance-selection oracle suite, ported from the reference's
+property families (provisioning/scheduling/instance_selection_test.go).
+
+The core invariant ("should schedule on one of the cheapest
+instances", instance_selection_test.go:87-462): for any combination of
+pod- and pool-side constraints, the planned node's launch price equals
+the cheapest compatible (instance type x offering) price, and every
+surviving offering satisfies the constraints. The MinValues families
+(instance_selection_test.go:661-1557) cover Gt/Lt operators, max-of-
+operators on one key, multiple keys, truncation interaction, and
+reserved-capacity interaction.
+"""
+
+import math
+
+import pytest
+
+from karpenter_tpu.apis.v1.labels import (
+    ARCH_LABEL,
+    CAPACITY_TYPE_LABEL,
+    INSTANCE_TYPE_LABEL,
+    OS_LABEL,
+    TOPOLOGY_ZONE_LABEL,
+)
+from karpenter_tpu.apis.v1.nodeclaim import RequirementSpec
+from karpenter_tpu.cloudprovider.fake import (
+    GIB,
+    instance_types,
+    make_instance_type,
+)
+from karpenter_tpu.kube.objects import (
+    Affinity,
+    NodeAffinity,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+)
+from karpenter_tpu.provisioning.scheduler import Scheduler
+from karpenter_tpu.scheduling.requirement import Requirement
+from karpenter_tpu.scheduling.requirements import Requirements
+from karpenter_tpu.solver.solver import solve
+from karpenter_tpu.testing import mk_nodepool, mk_pod
+
+
+def pool_with(*reqs, name="default", min_values=None):
+    pool = mk_nodepool(name)
+    pool.spec.template.spec.requirements = [
+        RequirementSpec(
+            key=k, operator=op, values=tuple(v),
+            min_values=(min_values or {}).get(k),
+        )
+        for k, op, v in reqs
+    ]
+    return pool
+
+
+def aff_pod(name="p", cpu=1.0, reqs=(), selector=None):
+    pod = mk_pod(name=name, cpu=cpu)
+    if selector:
+        pod.spec.node_selector = dict(selector)
+    if reqs:
+        pod.spec.affinity = Affinity(
+            node_affinity=NodeAffinity(
+                required=(
+                    NodeSelectorTerm(
+                        match_expressions=tuple(
+                            NodeSelectorRequirement(k, op, tuple(v))
+                            for k, op, v in reqs
+                        )
+                    ),
+                )
+            )
+        )
+    return pod
+
+
+def cheapest_compatible_price(types, pod, pool) -> float:
+    """The oracle: min over compatible (type, offering) of the price,
+    honoring pod requirements AND the pool template's requirements."""
+    pod_reqs = Requirements.from_pod(pod)
+    pool_reqs = Requirements()
+    for spec in pool.spec.template.spec.requirements:
+        pool_reqs.add(Requirement(spec.key, spec.operator, spec.values))
+    best = math.inf
+    for it in types:
+        if it.requirements.intersects(pod_reqs) is not None:
+            continue
+        if it.requirements.intersects(pool_reqs) is not None:
+            continue
+        from karpenter_tpu.utils import resources as resutil
+
+        if not resutil.fits(resutil.pod_requests(pod), it.allocatable):
+            continue
+        for off in it.offerings.available():
+            if pod_reqs.intersects(off.requirements) is not None:
+                continue
+            if pool_reqs.intersects(off.requirements) is not None:
+                continue
+            best = min(best, off.price)
+    return best
+
+
+CATALOG_SIZE = 24
+
+# (label, pool requirement triples, pod requirement triples)
+CHEAPEST_CASES = [
+    ("unconstrained", (), ()),
+    ("pod-arch-amd64", (), ((ARCH_LABEL, "In", ["amd64"]),)),
+    ("pod-arch-arm64", (), ((ARCH_LABEL, "In", ["arm64"]),)),
+    ("pool-arch-amd64", ((ARCH_LABEL, "In", ["amd64"]),), ()),
+    ("pool-arch-arm64", ((ARCH_LABEL, "In", ["arm64"]),), ()),
+    ("pool-os-windows", ((OS_LABEL, "In", ["windows"]),), ()),
+    ("pod-os-windows", (), ((OS_LABEL, "In", ["windows"]),)),
+    ("pod-os-linux", (), ((OS_LABEL, "In", ["linux"]),)),
+    ("pool-zone-2", ((TOPOLOGY_ZONE_LABEL, "In", ["test-zone-2"]),), ()),
+    ("pod-zone-2", (), ((TOPOLOGY_ZONE_LABEL, "In", ["test-zone-2"]),)),
+    ("pool-ct-spot", ((CAPACITY_TYPE_LABEL, "In", ["spot"]),), ()),
+    ("pod-ct-spot", (), ((CAPACITY_TYPE_LABEL, "In", ["spot"]),)),
+    (
+        "pool-od-zone1",
+        ((CAPACITY_TYPE_LABEL, "In", ["on-demand"]),
+         (TOPOLOGY_ZONE_LABEL, "In", ["test-zone-1"])),
+        (),
+    ),
+    (
+        "pod-spot-zone1",
+        (),
+        ((CAPACITY_TYPE_LABEL, "In", ["spot"]),
+         (TOPOLOGY_ZONE_LABEL, "In", ["test-zone-1"])),
+    ),
+    (
+        "pool-spot-pod-zone2",
+        ((CAPACITY_TYPE_LABEL, "In", ["spot"]),),
+        ((TOPOLOGY_ZONE_LABEL, "In", ["test-zone-2"]),),
+    ),
+    (
+        "pool-od-zone1-arm64-windows",
+        ((CAPACITY_TYPE_LABEL, "In", ["on-demand"]),
+         (TOPOLOGY_ZONE_LABEL, "In", ["test-zone-1"]),
+         (ARCH_LABEL, "In", ["arm64"]),
+         (OS_LABEL, "In", ["windows"])),
+        (),
+    ),
+    (
+        "pool-spot-zone2-pod-amd64-linux",
+        ((CAPACITY_TYPE_LABEL, "In", ["spot"]),
+         (TOPOLOGY_ZONE_LABEL, "In", ["test-zone-2"])),
+        ((ARCH_LABEL, "In", ["amd64"]), (OS_LABEL, "In", ["linux"])),
+    ),
+    (
+        "pod-spot-zone2-amd64-linux",
+        (),
+        ((CAPACITY_TYPE_LABEL, "In", ["spot"]),
+         (TOPOLOGY_ZONE_LABEL, "In", ["test-zone-2"]),
+         (ARCH_LABEL, "In", ["amd64"]),
+         (OS_LABEL, "In", ["linux"])),
+    ),
+    ("pod-notin-arm64", (), ((ARCH_LABEL, "NotIn", ["arm64"]),)),
+    (
+        "pool-notin-zone3",
+        ((TOPOLOGY_ZONE_LABEL, "NotIn", ["test-zone-3"]),),
+        (),
+    ),
+]
+
+
+class TestCheapestInstance:
+    @pytest.mark.parametrize(
+        "label,pool_reqs,pod_reqs",
+        CHEAPEST_CASES,
+        ids=[c[0] for c in CHEAPEST_CASES],
+    )
+    def test_schedules_on_cheapest_compatible(self, label, pool_reqs, pod_reqs):
+        types = instance_types(CATALOG_SIZE)
+        pool = pool_with(*pool_reqs)
+        pod = aff_pod(reqs=pod_reqs)
+        sol = solve([pod], [(pool, types)])
+        oracle = cheapest_compatible_price(types, pod, pool)
+        assert len(sol.new_nodes) == 1, f"{label}: pod did not schedule"
+        plan = sol.new_nodes[0]
+        assert plan.price == pytest.approx(oracle), label
+        # every surviving offering satisfies the combined constraints
+        pod_r = Requirements.from_pod(pod)
+        for off in plan.offerings:
+            assert pod_r.intersects(off.requirements) is None, label
+
+    @pytest.mark.parametrize(
+        "label,pod_reqs",
+        [
+            ("arch-arm-invalid", ((ARCH_LABEL, "In", ["arm"]),)),
+            ("os-darwin-invalid", ((OS_LABEL, "In", ["darwin"]),)),
+            ("zone-nonexistent", ((TOPOLOGY_ZONE_LABEL, "In", ["test-zone-9"]),)),
+        ],
+    )
+    def test_no_match_means_unschedulable(self, label, pod_reqs):
+        types = instance_types(CATALOG_SIZE)
+        sol = solve([aff_pod(reqs=pod_reqs)], [(mk_nodepool("p"), types)])
+        assert not sol.new_nodes and len(sol.unschedulable) == 1, label
+
+    def test_conflicting_pool_and_pod_unschedulable(self):
+        # instance_selection_test.go:512: pool pins arm64, pod demands a
+        # zone only amd64 types... here simpler: pool arm64 + pod amd64
+        types = instance_types(CATALOG_SIZE)
+        pool = pool_with((ARCH_LABEL, "In", ["arm64"]))
+        pod = aff_pod(reqs=((ARCH_LABEL, "In", ["amd64"]),))
+        sol = solve([pod], [(pool, types)])
+        assert not sol.new_nodes and len(sol.unschedulable) == 1
+
+    def test_schedules_on_instance_with_enough_resources(self):
+        # instance_selection_test.go:546: cheapest FITTING, not cheapest
+        types = [
+            make_instance_type("small", cpu=2, memory=4 * GIB, price=0.5),
+            make_instance_type("big", cpu=32, memory=128 * GIB, price=7.0),
+        ]
+        pod = mk_pod(cpu=20.0)
+        sol = solve([pod], [(mk_nodepool("p"), types)])
+        assert len(sol.new_nodes) == 1
+        assert sol.new_nodes[0].instance_types[0].name == "big"
+
+    def test_od_requirement_picks_cheapest_od_not_cheapest_spot_type(self):
+        # instance_selection_test.go:600: spot ordering must not leak
+        # into an on-demand-constrained launch
+        ta = make_instance_type(
+            "spot-cheap", cpu=4, memory=8 * GIB,
+            offerings=None, price=None,
+        )
+        # hand-build offerings: ta spot=1.0 od=5.0; tb spot=1.2 od=2.0
+        from karpenter_tpu.cloudprovider.types import Offering, Offerings
+
+        def offs(spot, od):
+            out = Offerings()
+            for ct, price in (("spot", spot), ("on-demand", od)):
+                out.append(Offering(
+                    requirements=Requirements.from_labels({
+                        CAPACITY_TYPE_LABEL: ct,
+                        TOPOLOGY_ZONE_LABEL: "test-zone-1",
+                    }),
+                    price=price, available=True,
+                ))
+            return out
+
+        ta = make_instance_type("ta", cpu=4, memory=8 * GIB, offerings=offs(1.0, 5.0))
+        tb = make_instance_type("tb", cpu=4, memory=8 * GIB, offerings=offs(1.2, 2.0))
+        pod = aff_pod(reqs=((CAPACITY_TYPE_LABEL, "In", ["on-demand"]),))
+        sol = solve([pod], [(mk_nodepool("p"), [ta, tb])])
+        assert len(sol.new_nodes) == 1
+        assert sol.new_nodes[0].price == pytest.approx(2.0)
+
+
+def sized_catalog():
+    """Types carrying a numeric example.com/size label for Gt/Lt."""
+    out = []
+    for size, price in ((1, 0.5), (2, 0.9), (4, 1.7), (8, 3.2), (16, 6.0)):
+        out.append(
+            make_instance_type(
+                f"s{size}", cpu=float(4), memory=16 * GIB, price=price,
+                extra_labels={"example.com/size": str(size)},
+            )
+        )
+    return out
+
+
+def sched(pool, types, *pods, policy="Strict"):
+    s = Scheduler(
+        pools_with_types=[(pool, types)], min_values_policy=policy
+    )
+    return s.solve(list(pods))
+
+
+class TestMinValuesOperators:
+    def test_gt_min_values_satisfied(self):
+        # instance_selection_test.go:739: Gt keeps sizes > 2 -> {4,8,16}
+        pool = pool_with(
+            ("example.com/size", "Gt", ["2"]),
+            min_values={"example.com/size": 3},
+        )
+        res = sched(pool, sized_catalog(), mk_pod(cpu=1.0))
+        assert len(res.new_node_plans) == 1
+        names = {it.name for it in res.new_node_plans[0].instance_types}
+        assert names <= {"s4", "s8", "s16"} and len(names) >= 3
+
+    def test_gt_min_values_unsatisfiable_fails(self):
+        # instance_selection_test.go:835: only {8,16} exceed 4 but the
+        # floor demands 3 distinct values
+        pool = pool_with(
+            ("example.com/size", "Gt", ["4"]),
+            min_values={"example.com/size": 3},
+        )
+        res = sched(pool, sized_catalog(), mk_pod(cpu=1.0))
+        assert not res.new_node_plans
+
+    def test_lt_min_values_satisfied(self):
+        # instance_selection_test.go:924
+        pool = pool_with(
+            ("example.com/size", "Lt", ["8"]),
+            min_values={"example.com/size": 3},
+        )
+        res = sched(pool, sized_catalog(), mk_pod(cpu=1.0))
+        assert len(res.new_node_plans) == 1
+        names = {it.name for it in res.new_node_plans[0].instance_types}
+        assert names <= {"s1", "s2", "s4"} and len(names) >= 3
+
+    def test_lt_min_values_unsatisfiable_fails(self):
+        # instance_selection_test.go:1019
+        pool = pool_with(
+            ("example.com/size", "Lt", ["2"]),
+            min_values={"example.com/size": 2},
+        )
+        res = sched(pool, sized_catalog(), mk_pod(cpu=1.0))
+        assert not res.new_node_plans
+
+    def test_max_of_min_values_across_operators_same_key(self):
+        # instance_selection_test.go:1090/1412: two requirements on one
+        # key take the max of their minValues floors
+        pool = mk_nodepool("p")
+        pool.spec.template.spec.requirements = [
+            RequirementSpec(key="example.com/size", operator="Exists",
+                            values=(), min_values=2),
+            RequirementSpec(key="example.com/size", operator="NotIn",
+                            values=("16",), min_values=4),
+        ]
+        res = sched(pool, sized_catalog(), mk_pod(cpu=1.0))
+        assert len(res.new_node_plans) == 1
+        names = {it.name for it in res.new_node_plans[0].instance_types}
+        # the max floor (4) must hold over the NotIn-filtered set
+        assert len(names) >= 4 and "s16" not in names
+
+    def test_max_of_min_values_unsatisfiable(self):
+        pool = mk_nodepool("p")
+        pool.spec.template.spec.requirements = [
+            RequirementSpec(key="example.com/size", operator="Exists",
+                            values=(), min_values=2),
+            RequirementSpec(key="example.com/size", operator="In",
+                            values=("1", "2"), min_values=3),
+        ]
+        res = sched(pool, sized_catalog(), mk_pod(cpu=1.0))
+        assert not res.new_node_plans
+
+    def test_multiple_keys_with_min_values(self):
+        # instance_selection_test.go:1497
+        pool = mk_nodepool("p")
+        pool.spec.template.spec.requirements = [
+            RequirementSpec(key=INSTANCE_TYPE_LABEL, operator="Exists",
+                            values=(), min_values=3),
+            RequirementSpec(key="example.com/size", operator="Exists",
+                            values=(), min_values=2),
+        ]
+        res = sched(pool, sized_catalog(), mk_pod(cpu=1.0))
+        assert len(res.new_node_plans) == 1
+        plan = res.new_node_plans[0]
+        assert len({it.name for it in plan.instance_types}) >= 3
+
+    def test_min_values_with_truncation_keeps_floor(self):
+        # instance_selection_test.go:1337: truncation must preserve the
+        # minValues floor, keeping the cheapest floor-satisfying set
+        from karpenter_tpu.provisioning.scheduler import MAX_INSTANCE_TYPES
+
+        many = [
+            make_instance_type(f"t-{i}", cpu=4, memory=8 * GIB,
+                               price=1.0 + i * 0.001)
+            for i in range(MAX_INSTANCE_TYPES + 40)
+        ]
+        pool = pool_with(min_values={INSTANCE_TYPE_LABEL: 5})
+        pool.spec.template.spec.requirements = [
+            RequirementSpec(key=INSTANCE_TYPE_LABEL, operator="Exists",
+                            values=(), min_values=5),
+        ]
+        res = sched(pool, many, mk_pod(cpu=1.0))
+        assert len(res.new_node_plans) == 1
+        kept = res.new_node_plans[0].instance_types
+        assert 5 <= len(kept) <= MAX_INSTANCE_TYPES
+
+    def test_min_values_with_reserved_capacity(self):
+        # reserved offerings pin the claim to the reservation while the
+        # instance-type flexibility floor still holds over the options
+        types = [
+            make_instance_type(
+                f"r{i}", cpu=8, memory=32 * GIB, price=2.0 + i,
+                reservations=[(f"rsv-{i}", "test-zone-1", 4)],
+            )
+            for i in range(3)
+        ]
+        pool = mk_nodepool("p")
+        pool.spec.template.spec.requirements = [
+            RequirementSpec(key=INSTANCE_TYPE_LABEL, operator="Exists",
+                            values=(), min_values=2),
+        ]
+        res = sched(pool, types, mk_pod(cpu=1.0))
+        assert len(res.new_node_plans) == 1
+        plan = res.new_node_plans[0]
+        assert len({it.name for it in plan.instance_types}) >= 2
+        # cheapest resolution is the (near-free) reservation
+        assert plan.reservation_id
+
+    def test_best_effort_policy_keeps_unsatisfiable_plan(self):
+        pool = pool_with(
+            ("example.com/size", "Gt", ["4"]),
+            min_values={"example.com/size": 3},
+        )
+        res = sched(pool, sized_catalog(), mk_pod(cpu=1.0),
+                    policy="BestEffort")
+        assert len(res.new_node_plans) == 1
+        assert res.new_node_plans[0].min_values_relaxed

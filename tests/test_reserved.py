@@ -52,9 +52,13 @@ class TestSolverReservationCaps:
         # exactly the reservation capacity lands reserved; rest fall back
         assert len(reserved_nodes) == 2
         assert len(other_nodes) == 3
-        # reserved nodes are pinned: single reservation offering
+        # reserved nodes resolve onto the reservation (the claim pins
+        # the reservation id) while the option list may keep fallback
+        # offerings — the pin narrows the launch, not the flexibility
+        # (FinalizeScheduling, scheduling/nodeclaim.go:252)
         for n in reserved_nodes:
-            assert all(o.reservation_id == "rsv-1" for o in n.offerings)
+            assert n.reservation_id == "rsv-1"
+            assert n.offerings[0].reservation_id == "rsv-1"
 
     def test_ffd_objective_also_respects_cap(self):
         pool = mk_nodepool("p")
@@ -244,3 +248,40 @@ class TestReservationEndToEnd:
             if any(r.key == RESERVATION_ID_LABEL for r in c.spec.requirements)
         ]
         assert len(pinned) == 2, f"{len(pinned)} pinned claims overcommit the reservation"
+
+
+class TestReservationPinIntegrity:
+    def test_later_group_cannot_strip_the_pin(self):
+        """A reservation-pinned node only admits pods compatible with
+        the reserved column; a zone-incompatible pod must open its own
+        node instead of tightening the reserved column away (which
+        would leak the consumed budget)."""
+        types = reserved_types(capacity=1)
+        # pod A: unconstrained and BIG (its group packs first under FFD)
+        # — resolves onto the zone-1 reservation. pod B: small and
+        # pinned to zone-2 — compatible with c4's spot/od offerings but
+        # NOT with the reserved offering; it must not join A's node.
+        a = mk_pod(name="a", cpu=3.0)
+        b = mk_pod(
+            name="b", cpu=0.5,
+            node_selector={"topology.kubernetes.io/zone": "test-zone-2"},
+        )
+        sol = solve([a, b], [(mk_nodepool("p"), types)], objective="ffd")
+        assert not sol.unschedulable
+        reserved_plans = [n for n in sol.new_nodes if n.reservation_id]
+        assert len(reserved_plans) == 1
+        pinned = reserved_plans[0]
+        names = {p.metadata.name for p in pinned.pods}
+        assert "b" not in names  # zone-2 pod never joins the pinned node
+        # the pinned node's offerings still include the reservation
+        assert any(o.reservation_id == "rsv-1" for o in pinned.offerings)
+
+    def test_compatible_later_group_joins_without_unpinning(self):
+        types = reserved_types(capacity=1)
+        a = mk_pod(name="a", cpu=1.0)
+        b = mk_pod(name="b", cpu=1.0)  # fits alongside a on the c4
+        sol = solve([a, b], [(mk_nodepool("p"), types)], objective="cost")
+        assert not sol.unschedulable
+        reserved_plans = [n for n in sol.new_nodes if n.reservation_id]
+        assert len(reserved_plans) == 1
+        assert {p.metadata.name for p in reserved_plans[0].pods} == {"a", "b"}

@@ -1,0 +1,563 @@
+"""Topology oracle suite, ported from the reference's property
+families (provisioning/scheduling/topology_test.go).
+
+Covers the families the round-1 review called out as unported:
+unknown keys / degenerate selectors, NodePool-constrained zonal
+domains, skew edges, hostname maxSkew > 1, multi-deployment spreads,
+capacity-type spreads under constraints, combined constraint stacks,
+spread x node-affinity domain limiting, pod-affinity targets, and
+NodePool taints. Line references point at topology_test.go property
+names.
+"""
+
+from collections import Counter
+
+import pytest
+
+from karpenter_tpu.apis.v1.labels import (
+    ARCH_LABEL,
+    CAPACITY_TYPE_LABEL,
+    HOSTNAME_LABEL,
+    TOPOLOGY_ZONE_LABEL,
+)
+from karpenter_tpu.apis.v1.nodeclaim import RequirementSpec
+from karpenter_tpu.cloudprovider.fake import GIB, make_instance_type
+from karpenter_tpu.kube.objects import (
+    Affinity,
+    LabelSelector,
+    NodeAffinity,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+    PodAffinity,
+    PodAffinityTerm,
+    PreferredSchedulingTerm,
+    Taint,
+    Toleration,
+    TopologySpreadConstraint,
+)
+from karpenter_tpu.provisioning.scheduler import Scheduler
+from karpenter_tpu.testing import mk_nodepool, mk_pod
+
+
+def types():
+    return [
+        make_instance_type("c4", cpu=4, memory=16 * GIB, price=1.0),
+        make_instance_type("c4-arm", cpu=4, memory=16 * GIB, price=0.9,
+                           arch="arm64"),
+        make_instance_type("c16", cpu=16, memory=64 * GIB, price=4.0),
+    ]
+
+
+def spread_pod(name, app, key=TOPOLOGY_ZONE_LABEL, skew=1, cpu=0.5,
+               when="DoNotSchedule", min_domains=None, selector=None,
+               extra_constraints=()):
+    pod = mk_pod(name=name, cpu=cpu)
+    pod.metadata.labels["app"] = app
+    pod.spec.topology_spread_constraints = [
+        TopologySpreadConstraint(
+            max_skew=skew,
+            topology_key=key,
+            when_unsatisfiable=when,
+            label_selector=(
+                LabelSelector.of({"app": app}) if selector is None else selector
+            ),
+            min_domains=min_domains,
+        ),
+        *extra_constraints,
+    ]
+    return pod
+
+
+def solve(pods, pools=None, **kw):
+    sched = Scheduler(
+        pools_with_types=pools or [(mk_nodepool("p"), types())], **kw
+    )
+    return sched.solve(pods), sched
+
+
+def domain_counts(results, key):
+    counts = Counter()
+    for plan in results.new_node_plans:
+        if key == TOPOLOGY_ZONE_LABEL:
+            domain = plan.offerings[0].zone
+        elif key == CAPACITY_TYPE_LABEL:
+            domain = plan.offerings[0].capacity_type
+        else:
+            domain = f"planned-{id(plan)}"
+        counts[domain] += len(plan.pods)
+    return counts
+
+
+def pool_with_reqs(*reqs, name="p"):
+    pool = mk_nodepool(name)
+    pool.spec.template.spec.requirements = [
+        RequirementSpec(key=k, operator=op, values=tuple(v)) for k, op, v in reqs
+    ]
+    return pool
+
+
+class TestDegenerateSpread:
+    def test_unknown_topology_key_ignored(self):
+        # topology_test.go:60 "should ignore unknown topology keys":
+        # the reference leaves such pods pending; we mirror that the
+        # constraint never poisons the rest of the solve
+        good = [mk_pod(name=f"g-{i}", cpu=0.5) for i in range(3)]
+        weird = spread_pod("w", "app", key="example.com/unknown-topology")
+        res, _ = solve(good + [weird])
+        placed = {p.key for plan in res.new_node_plans for p in plan.pods}
+        assert all(p.key in placed for p in good)
+
+    def test_empty_label_selector_matches_nothing_spreads_trivially(self):
+        # topology_test.go:94: nil selector -> no pods counted, skew 0
+        pods = [
+            spread_pod(f"n-{i}", "app", selector=LabelSelector())
+            for i in range(4)
+        ]
+        res, _ = solve(pods)
+        assert res.scheduled_count == 4
+
+
+class TestZonalSpread:
+    def test_balance_across_zones_match_labels(self):
+        # topology_test.go:110
+        pods = [spread_pod(f"z-{i}", "web") for i in range(9)]
+        res, _ = solve(pods)
+        counts = domain_counts(res, TOPOLOGY_ZONE_LABEL)
+        assert res.scheduled_count == 9
+        assert len(counts) == 3
+        assert max(counts.values()) - min(counts.values()) <= 1
+
+    def test_respects_nodepool_zonal_subset(self):
+        # topology_test.go:159: pool limited to two zones -> spread
+        # happens over exactly those two
+        pool = pool_with_reqs(
+            (TOPOLOGY_ZONE_LABEL, "In", ["test-zone-1", "test-zone-2"])
+        )
+        pods = [spread_pod(f"z-{i}", "web") for i in range(6)]
+        res, _ = solve(pods, pools=[(pool, types())])
+        counts = domain_counts(res, TOPOLOGY_ZONE_LABEL)
+        assert res.scheduled_count == 6
+        assert set(counts) == {"test-zone-1", "test-zone-2"}
+        assert max(counts.values()) - min(counts.values()) <= 1
+
+    def test_respects_nodepool_zonal_subset_via_labels(self):
+        # topology_test.go:175: a template LABEL pins the domain
+        pool = mk_nodepool("p")
+        pool.spec.template.labels = {TOPOLOGY_ZONE_LABEL: "test-zone-2"}
+        pods = [spread_pod(f"z-{i}", "web", when="ScheduleAnyway")
+                for i in range(4)]
+        res, _ = solve(pods, pools=[(pool, types())])
+        assert res.scheduled_count == 4
+        assert set(domain_counts(res, TOPOLOGY_ZONE_LABEL)) == {"test-zone-2"}
+
+    def test_domains_across_nodepools_union(self):
+        # topology_test.go:206: two pools each pinned to one zone; the
+        # spread discovers the union of domains
+        pool_a = pool_with_reqs((TOPOLOGY_ZONE_LABEL, "In", ["test-zone-1"]),
+                                name="pa")
+        pool_b = pool_with_reqs((TOPOLOGY_ZONE_LABEL, "In", ["test-zone-2"]),
+                                name="pb")
+        pods = [spread_pod(f"z-{i}", "web") for i in range(6)]
+        res, _ = solve(pods, pools=[(pool_a, types()), (pool_b, types())])
+        counts = domain_counts(res, TOPOLOGY_ZONE_LABEL)
+        assert res.scheduled_count == 6
+        assert set(counts) == {"test-zone-1", "test-zone-2"}
+
+    def test_max_skew_hard_limit_never_violated(self):
+        # topology_test.go:349: DoNotSchedule means skew <= maxSkew in
+        # every prefix of the solution
+        pods = [spread_pod(f"z-{i}", "web", skew=2) for i in range(10)]
+        res, _ = solve(pods)
+        counts = domain_counts(res, TOPOLOGY_ZONE_LABEL)
+        assert res.scheduled_count == 10
+        assert max(counts.values()) - min(counts.values()) <= 2
+
+    def test_min_domains_blocks_when_unreachable(self):
+        # topology_test.go:484: minDomains > available zones -> the
+        # constraint cannot be met; DoNotSchedule leaves pods pending
+        pods = [spread_pod(f"m-{i}", "app", min_domains=5) for i in range(2)]
+        res, _ = solve(pods)
+        assert res.scheduled_count + len(res.errors) == 2
+
+    def test_min_domains_equal_available_ok(self):
+        # topology_test.go:504
+        pods = [spread_pod(f"m-{i}", "app", min_domains=3) for i in range(3)]
+        res, _ = solve(pods)
+        assert res.scheduled_count == 3
+        assert len(domain_counts(res, TOPOLOGY_ZONE_LABEL)) == 3
+
+
+class TestHostnameSpread:
+    def test_balance_across_nodes(self):
+        # topology_test.go:547
+        pods = [spread_pod(f"h-{i}", "db", key=HOSTNAME_LABEL)
+                for i in range(4)]
+        res, _ = solve(pods)
+        assert res.scheduled_count == 4
+        assert len(res.new_node_plans) == 4
+        for plan in res.new_node_plans:
+            assert len([p for p in plan.pods if "db" in p.metadata.labels.get(
+                "app", "")]) <= 1
+
+    def test_max_skew_two_allows_pairs(self):
+        # topology_test.go:560: "balance pods on the same hostname up
+        # to maxskew"
+        pods = [spread_pod(f"h-{i}", "db", key=HOSTNAME_LABEL, skew=2)
+                for i in range(6)]
+        res, _ = solve(pods)
+        assert res.scheduled_count == 6
+        per_node = [len(plan.pods) for plan in res.new_node_plans]
+        assert max(per_node) <= 2
+
+    def test_multiple_deployments_spread_independently(self):
+        # topology_test.go:573: two apps each hostname-spread; their
+        # constraints must not interfere
+        pods = []
+        for i in range(3):
+            pods.append(spread_pod(f"a-{i}", "app-a", key=HOSTNAME_LABEL))
+            pods.append(spread_pod(f"b-{i}", "app-b", key=HOSTNAME_LABEL))
+        res, _ = solve(pods)
+        assert res.scheduled_count == 6
+        for plan in res.new_node_plans:
+            apps = Counter(p.metadata.labels["app"] for p in plan.pods)
+            assert all(v <= 1 for v in apps.values())
+
+
+class TestCapacityTypeSpread:
+    def test_balance_across_capacity_types(self):
+        # topology_test.go:655
+        pods = [spread_pod(f"c-{i}", "web", key=CAPACITY_TYPE_LABEL)
+                for i in range(6)]
+        res, _ = solve(pods)
+        counts = domain_counts(res, CAPACITY_TYPE_LABEL)
+        assert res.scheduled_count == 6
+        assert len(counts) == 2
+        assert max(counts.values()) - min(counts.values()) <= 1
+
+    def test_respects_nodepool_capacity_type_constraint(self):
+        # topology_test.go:668: pool pinned to spot -> one domain only
+        pool = pool_with_reqs((CAPACITY_TYPE_LABEL, "In", ["spot"]))
+        pods = [spread_pod(f"c-{i}", "web", key=CAPACITY_TYPE_LABEL,
+                           when="ScheduleAnyway") for i in range(4)]
+        res, _ = solve(pods, pools=[(pool, types())])
+        assert res.scheduled_count == 4
+        assert set(domain_counts(res, CAPACITY_TYPE_LABEL)) == {"spot"}
+
+    def test_schedule_anyway_violates_when_needed(self):
+        # topology_test.go:718: pods nodeSelector-pinned to on-demand
+        # with a ScheduleAnyway ct spread still schedule
+        pods = []
+        for i in range(4):
+            pod = spread_pod(f"c-{i}", "web", key=CAPACITY_TYPE_LABEL,
+                             when="ScheduleAnyway")
+            pod.spec.node_selector = {CAPACITY_TYPE_LABEL: "on-demand"}
+            pods.append(pod)
+        res, _ = solve(pods)
+        assert res.scheduled_count == 4
+        assert set(domain_counts(res, CAPACITY_TYPE_LABEL)) == {"on-demand"}
+
+
+class TestCombinedConstraints:
+    def test_hostname_and_zonal_together(self):
+        # topology_test.go:943
+        extra = TopologySpreadConstraint(
+            max_skew=1,
+            topology_key=HOSTNAME_LABEL,
+            when_unsatisfiable="DoNotSchedule",
+            label_selector=LabelSelector.of({"app": "both"}),
+        )
+        pods = [
+            spread_pod(f"hz-{i}", "both", extra_constraints=(extra,))
+            for i in range(6)
+        ]
+        res, _ = solve(pods)
+        assert res.scheduled_count == 6
+        zc = domain_counts(res, TOPOLOGY_ZONE_LABEL)
+        assert max(zc.values()) - min(zc.values()) <= 1
+        for plan in res.new_node_plans:
+            assert len(plan.pods) <= 1  # hostname skew 1
+
+    def test_zonal_and_capacity_type_together(self):
+        # topology_test.go:1689-1728
+        extra = TopologySpreadConstraint(
+            max_skew=1,
+            topology_key=CAPACITY_TYPE_LABEL,
+            when_unsatisfiable="DoNotSchedule",
+            label_selector=LabelSelector.of({"app": "zc"}),
+        )
+        pods = [
+            spread_pod(f"zc-{i}", "zc", extra_constraints=(extra,))
+            for i in range(6)
+        ]
+        res, _ = solve(pods)
+        assert res.scheduled_count == 6
+        zc = domain_counts(res, TOPOLOGY_ZONE_LABEL)
+        cc = domain_counts(res, CAPACITY_TYPE_LABEL)
+        assert max(zc.values()) - min(zc.values()) <= 1
+        assert max(cc.values()) - min(cc.values()) <= 1
+
+    def test_all_three_constraints(self):
+        # topology_test.go:1729-1766
+        extras = (
+            TopologySpreadConstraint(
+                max_skew=1, topology_key=CAPACITY_TYPE_LABEL,
+                when_unsatisfiable="DoNotSchedule",
+                label_selector=LabelSelector.of({"app": "hzc"}),
+            ),
+            TopologySpreadConstraint(
+                max_skew=3, topology_key=HOSTNAME_LABEL,
+                when_unsatisfiable="DoNotSchedule",
+                label_selector=LabelSelector.of({"app": "hzc"}),
+            ),
+        )
+        pods = [
+            spread_pod(f"x-{i}", "hzc", extra_constraints=extras)
+            for i in range(6)
+        ]
+        res, _ = solve(pods)
+        assert res.scheduled_count == 6
+        zc = domain_counts(res, TOPOLOGY_ZONE_LABEL)
+        cc = domain_counts(res, CAPACITY_TYPE_LABEL)
+        assert max(zc.values()) - min(zc.values()) <= 1
+        assert max(cc.values()) - min(cc.values()) <= 1
+        assert all(len(p.pods) <= 3 for p in res.new_node_plans)
+
+
+class TestSpreadWithNodeAffinity:
+    def test_node_selector_limits_spread_domains(self):
+        # topology_test.go:1768: spread counts only the selector's zones
+        pods = []
+        for i in range(4):
+            pod = spread_pod(f"s-{i}", "lim")
+            pod.spec.node_selector = {TOPOLOGY_ZONE_LABEL: "test-zone-2"}
+            pods.append(pod)
+        res, _ = solve(pods)
+        assert res.scheduled_count == 4
+        assert set(domain_counts(res, TOPOLOGY_ZONE_LABEL)) == {"test-zone-2"}
+
+    def test_required_affinity_limits_spread_domains(self):
+        # topology_test.go:1816: required node affinity over two zones
+        pods = []
+        for i in range(6):
+            pod = spread_pod(f"r-{i}", "lim2")
+            pod.spec.affinity = Affinity(
+                node_affinity=NodeAffinity(
+                    required=(
+                        NodeSelectorTerm(
+                            match_expressions=(
+                                NodeSelectorRequirement(
+                                    TOPOLOGY_ZONE_LABEL, "In",
+                                    ("test-zone-1", "test-zone-2"),
+                                ),
+                            )
+                        ),
+                    )
+                )
+            )
+            pods.append(pod)
+        res, _ = solve(pods)
+        counts = domain_counts(res, TOPOLOGY_ZONE_LABEL)
+        assert res.scheduled_count == 6
+        assert set(counts) <= {"test-zone-1", "test-zone-2"}
+        assert max(counts.values()) - min(counts.values()) <= 1
+
+    def test_preferred_affinity_does_not_limit_spread(self):
+        # topology_test.go:1860: preferences must not shrink the domain
+        # set the spread may use
+        pods = []
+        for i in range(6):
+            pod = spread_pod(f"p-{i}", "pref")
+            pod.spec.affinity = Affinity(
+                node_affinity=NodeAffinity(
+                    preferred=(
+                        PreferredSchedulingTerm(
+                            weight=1,
+                            preference=NodeSelectorTerm(
+                                match_expressions=(
+                                    NodeSelectorRequirement(
+                                        TOPOLOGY_ZONE_LABEL, "In",
+                                        ("test-zone-1",),
+                                    ),
+                                )
+                            ),
+                        ),
+                    )
+                )
+            )
+            pods.append(pod)
+        res, _ = solve(pods)
+        assert res.scheduled_count == 6
+        counts = domain_counts(res, TOPOLOGY_ZONE_LABEL)
+        # skew still respected across ALL zones (preference can't pin)
+        assert max(counts.values()) - min(counts.values()) <= 1
+
+
+def affinity_pod(name, app, target_app, key, anti=False, cpu=0.5,
+                 required=True):
+    pod = mk_pod(name=name, cpu=cpu)
+    pod.metadata.labels["app"] = app
+    term = PodAffinityTerm(
+        topology_key=key, label_selector=LabelSelector.of({"app": target_app})
+    )
+    pa = PodAffinity(required=(term,))
+    pod.spec.affinity = Affinity(
+        pod_anti_affinity=pa if anti else None,
+        pod_affinity=None if anti else pa,
+    )
+    return pod
+
+
+class TestPodAffinity:
+    def test_hostname_affinity_colocates(self):
+        # topology_test.go:1964
+        anchor = mk_pod(name="anchor", cpu=0.5)
+        anchor.metadata.labels["app"] = "anchor"
+        follower = affinity_pod("f", "fol", "anchor", HOSTNAME_LABEL)
+        res, _ = solve([anchor, follower])
+        assert res.scheduled_count == 2
+        for plan in res.new_node_plans:
+            names = {p.metadata.name for p in plan.pods}
+            if "anchor" in names:
+                assert "f" in names
+
+    def test_affinity_to_nonexistent_pod_unschedulable(self):
+        # topology_test.go:2738
+        orphan = affinity_pod("o", "orphan", "ghost", TOPOLOGY_ZONE_LABEL)
+        res, _ = solve([orphan])
+        assert res.scheduled_count == 0
+        assert len(res.errors) == 1
+
+    def test_self_affinity_zone(self):
+        # topology_test.go:2151: all pods of the app share one zone
+        pods = [
+            affinity_pod(f"s-{i}", "self", "self", TOPOLOGY_ZONE_LABEL)
+            for i in range(4)
+        ]
+        res, _ = solve(pods)
+        assert res.scheduled_count == 4
+        assert len(domain_counts(res, TOPOLOGY_ZONE_LABEL)) == 1
+
+    def test_anti_affinity_hostname_separates(self):
+        # topology_test.go:2325
+        pods = [
+            affinity_pod(f"a-{i}", "iso", "iso", HOSTNAME_LABEL, anti=True)
+            for i in range(3)
+        ]
+        res, _ = solve(pods)
+        assert res.scheduled_count == 3
+        assert len(res.new_node_plans) == 3
+
+    def test_anti_affinity_zone_caps_at_domain_count(self):
+        # topology_test.go:2347: 3 zones -> at most 3 such pods
+        pods = [
+            affinity_pod(f"z-{i}", "zi", "zi", TOPOLOGY_ZONE_LABEL, anti=True)
+            for i in range(5)
+        ]
+        res, _ = solve(pods)
+        assert res.scheduled_count == 3
+        assert len(res.errors) == 2
+
+    def test_anti_affinity_cross_app_zone(self):
+        # topology_test.go:2386 "other schedules first": app-b pods
+        # must avoid zones holding app-a pods
+        a = mk_pod(name="a0", cpu=0.5)
+        a.metadata.labels["app"] = "app-a"
+        b = affinity_pod("b0", "app-b", "app-a", TOPOLOGY_ZONE_LABEL,
+                         anti=True)
+        res, _ = solve([a, b])
+        assert res.scheduled_count == 2
+        zones = {}
+        for plan in res.new_node_plans:
+            for p in plan.pods:
+                zones[p.metadata.name] = plan.offerings[0].zone
+        assert zones["a0"] != zones["b0"]
+
+    def test_preferred_anti_affinity_may_be_violated(self):
+        # topology_test.go:2292
+        pods = []
+        for i in range(4):
+            pod = mk_pod(name=f"pa-{i}", cpu=0.5)
+            pod.metadata.labels["app"] = "soft"
+            pod.spec.affinity = Affinity(
+                pod_anti_affinity=PodAffinity(
+                    preferred=(
+                        # weight, term
+                        __import__(
+                            "karpenter_tpu.kube.objects", fromlist=["W"]
+                        ).WeightedPodAffinityTerm(
+                            weight=1,
+                            pod_affinity_term=PodAffinityTerm(
+                                topology_key=TOPOLOGY_ZONE_LABEL,
+                                label_selector=LabelSelector.of(
+                                    {"app": "soft"}
+                                ),
+                            ),
+                        ),
+                    )
+                )
+            )
+            pods.append(pod)
+        res, _ = solve(pods)
+        assert res.scheduled_count == 4  # 3 zones, 4 pods: one violates
+
+
+class TestNodePoolTaints:
+    def test_taints_block_and_tolerations_admit(self):
+        # topology_test.go:3011-3021
+        pool = mk_nodepool("tainted")
+        pool.spec.template.spec.taints = [
+            Taint(key="example.com/dedicated", value="gpu", effect="NoSchedule")
+        ]
+        plain = mk_pod(name="plain", cpu=0.5)
+        tolerant = mk_pod(name="tol", cpu=0.5)
+        tolerant.spec.tolerations = [
+            Toleration(key="example.com/dedicated", operator="Equal",
+                       value="gpu", effect="NoSchedule")
+        ]
+        res, _ = solve([plain, tolerant], pools=[(pool, types())])
+        placed = {p.key for plan in res.new_node_plans for p in plan.pods}
+        assert "default/tol" in placed
+        assert "default/plain" not in placed
+
+
+class TestEligibleDomainMinimum:
+    def test_ineligible_domain_never_whitelisted(self):
+        """allowed_domains must reject a candidate the pod's own terms
+        exclude, even when filtering the count map makes its count look
+        like the minimum (review regression: NotIn pods were whitelisted
+        into crowded excluded zones)."""
+        from karpenter_tpu.scheduling.topology import (
+            TYPE_SPREAD,
+            TopologyGroup,
+        )
+
+        group = TopologyGroup(
+            type=TYPE_SPREAD, key=TOPOLOGY_ZONE_LABEL,
+            selector=LabelSelector.of({"app": "w"}),
+            namespaces=frozenset({"default"}), max_skew=1,
+        )
+        group.counts = {"zone-a": 3, "zone-b": 0}
+        allowed = group.allowed_domains({"zone-a"}, eligible={"zone-b"})
+        assert allowed == set()
+
+    def test_notin_pod_avoids_excluded_zone_end_to_end(self):
+        pods = [spread_pod(f"w-{i}", "web") for i in range(3)]
+        excl = spread_pod("excl", "web")
+        excl.spec.affinity = Affinity(
+            node_affinity=NodeAffinity(
+                required=(
+                    NodeSelectorTerm(
+                        match_expressions=(
+                            NodeSelectorRequirement(
+                                TOPOLOGY_ZONE_LABEL, "NotIn", ("test-zone-1",)
+                            ),
+                        )
+                    ),
+                )
+            )
+        )
+        res, _ = solve(pods + [excl])
+        assert res.scheduled_count == 4
+        for plan in res.new_node_plans:
+            if any(p.metadata.name == "excl" for p in plan.pods):
+                assert plan.offerings[0].zone != "test-zone-1"
