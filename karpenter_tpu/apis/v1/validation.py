@@ -1,0 +1,222 @@
+"""Admission-time spec validation — the CEL analogue.
+
+The reference embeds its invariants as CEL rules in kubebuilder
+markers (nodepool.go:39-41, nodeclaim.go:38-40,145,197-205) plus the
+post-codegen patch scripts (hack/validation/{requirements,labels,
+taint}.sh); the API server rejects bad specs before any controller
+sees them. Here the in-memory client plays the API server, so the same
+rules run as plain functions at create/update time and raise
+InvalidError on violation.
+
+Implemented rule set (reference source for each):
+- requirements: valid operator; In needs values; Gt/Lt need exactly one
+  non-negative integer; minValues in [1, 50] and <= len(values) for In;
+  <= 100 requirements; restricted label keys rejected
+  (nodeclaim.go:38-41,85-86; hack/validation/requirements.sh)
+- template labels: restricted domains rejected
+  (hack/validation/labels.sh)
+- taints: non-empty key, valid effect (hack/validation/taint.sh)
+- durations: expireAfter / consolidateAfter are "<n>(s|m|h)..." or
+  "Never"; terminationGracePeriod never "Never" (nodeclaim.go:63,72)
+- budgets: nodes is int or percentage; schedule only with duration;
+  <= 50 budgets (nodepool.go:99-129)
+- weight in [0, 10000] (nodepool.go:60-61 scaled; 0 = unset here)
+- static pools: only limits.nodes; no weight; replicas >= 0; and the
+  static/dynamic mode is immutable on update (nodepool.go:39-41)
+- NodeClaim spec immutability lives in the client (nodeclaim.go:145)
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Optional
+
+from karpenter_tpu.apis.v1.labels import (
+    NODEPOOL_LABEL,
+    RESERVATION_ID_LABEL,
+    is_restricted_label,
+)
+
+# keys the framework itself stamps onto claims (FinalizeScheduling adds
+# the reservation-id pin, scheduling/nodeclaim.go:252); the reference
+# admits them via its feature-gated WellKnownLabels extension
+_SYSTEM_REQUIREMENT_KEYS = frozenset({RESERVATION_ID_LABEL})
+
+VALID_OPERATORS = frozenset({"In", "NotIn", "Exists", "DoesNotExist", "Gt", "Lt"})
+VALID_TAINT_EFFECTS = frozenset({"NoSchedule", "PreferNoSchedule", "NoExecute"})
+_DURATION_RE = re.compile(r"^([0-9]+(s|m|h))+$")
+_BUDGET_NODES_RE = re.compile(r"^((100|[0-9]{1,2})%|[0-9]+)$")
+MAX_REQUIREMENTS = 100
+MAX_BUDGETS = 50
+
+
+class ValidationError(ValueError):
+    """A spec the admission layer must reject."""
+
+
+def _validate_duration(raw, field: str, allow_never: bool) -> Optional[str]:
+    if raw is None:
+        return None
+    if isinstance(raw, (int, float)):
+        return None  # already-parsed seconds (internal callers)
+    if raw == "Never":
+        return None if allow_never else f"{field}: 'Never' is not allowed"
+    if not _DURATION_RE.match(str(raw)):
+        return f"{field}: invalid duration {raw!r}"
+    return None
+
+
+def validate_requirements(requirements, field: str) -> list[str]:
+    errs: list[str] = []
+    if len(requirements) > MAX_REQUIREMENTS:
+        errs.append(f"{field}: more than {MAX_REQUIREMENTS} requirements")
+    for spec in requirements:
+        where = f"{field}[{spec.key}]"
+        if spec.key == NODEPOOL_LABEL:
+            # well-known on nodes, but user specs may not constrain it
+            # (hack/validation/labels.sh: 'karpenter.sh/nodepool' is
+            # restricted — the system stamps it)
+            errs.append(f"{where}: label {NODEPOOL_LABEL} is restricted")
+        elif spec.key not in _SYSTEM_REQUIREMENT_KEYS:
+            restricted = is_restricted_label(spec.key)
+            if restricted:
+                errs.append(f"{where}: {restricted}")
+        if spec.operator not in VALID_OPERATORS:
+            errs.append(f"{where}: unknown operator {spec.operator!r}")
+            continue
+        if spec.operator == "In" and not spec.values:
+            errs.append(f"{where}: operator 'In' must have a value defined")
+        if spec.operator in ("Gt", "Lt"):
+            ok = len(spec.values) == 1
+            if ok:
+                try:
+                    ok = int(spec.values[0]) >= 0
+                except ValueError:
+                    ok = False
+            if not ok:
+                errs.append(
+                    f"{where}: operator '{spec.operator}' must have a "
+                    "single positive integer value"
+                )
+        if spec.operator in ("Exists", "DoesNotExist") and spec.values:
+            errs.append(
+                f"{where}: operator '{spec.operator}' must not define values"
+            )
+        if spec.min_values is not None:
+            if not 1 <= spec.min_values <= 50:
+                errs.append(f"{where}: minValues must be in [1, 50]")
+            elif spec.operator == "In" and len(spec.values) < spec.min_values:
+                errs.append(
+                    f"{where}: 'minValues' must have at least that many "
+                    "values in 'values'"
+                )
+    return errs
+
+
+def _validate_taints(taints, field: str) -> list[str]:
+    errs = []
+    for taint in taints:
+        if not taint.key:
+            errs.append(f"{field}: taint key must not be empty")
+        if taint.effect not in VALID_TAINT_EFFECTS:
+            errs.append(f"{field}: invalid taint effect {taint.effect!r}")
+    return errs
+
+
+def _validate_template(template) -> list[str]:
+    errs = validate_requirements(
+        template.spec.requirements, "spec.template.spec.requirements"
+    )
+    for key in template.labels:
+        restricted = is_restricted_label(key)
+        if restricted:
+            errs.append(f"spec.template.labels[{key}]: {restricted}")
+    errs += _validate_taints(template.spec.taints, "spec.template.spec.taints")
+    errs += _validate_taints(
+        template.spec.startup_taints, "spec.template.spec.startupTaints"
+    )
+    err = _validate_duration(
+        template.spec.expire_after, "spec.template.spec.expireAfter",
+        allow_never=True,
+    )
+    if err:
+        errs.append(err)
+    err = _validate_duration(
+        template.spec.termination_grace_period,
+        "spec.template.spec.terminationGracePeriod", allow_never=False,
+    )
+    if err:
+        errs.append(err)
+    return errs
+
+
+def validate_node_pool(pool, old=None) -> None:
+    """Admission check; raises ValidationError with every violation.
+    `old` enables update-only (transition) rules."""
+    errs = _validate_template(pool.spec.template)
+    disruption = pool.spec.disruption
+    err = _validate_duration(
+        disruption.consolidate_after, "spec.disruption.consolidateAfter",
+        allow_never=True,
+    )
+    if err:
+        errs.append(err)
+    if len(disruption.budgets) > MAX_BUDGETS:
+        errs.append(f"spec.disruption.budgets: more than {MAX_BUDGETS} budgets")
+    for i, budget in enumerate(disruption.budgets):
+        where = f"spec.disruption.budgets[{i}]"
+        if not _BUDGET_NODES_RE.match(str(budget.nodes)):
+            errs.append(f"{where}.nodes: must be an integer or percentage")
+        if (budget.schedule is None) != (budget.duration is None):
+            errs.append(f"{where}: 'schedule' must be set with 'duration'")
+        if budget.duration is not None:
+            err = _validate_duration(budget.duration, f"{where}.duration",
+                                     allow_never=False)
+            if err:
+                errs.append(err)
+    if not 0 <= pool.spec.weight <= 10000:
+        errs.append("spec.weight: must be in [0, 10000]")
+    for key, value in pool.spec.limits.items():
+        if value < 0:
+            errs.append(f"spec.limits[{key}]: must be non-negative")
+    if pool.is_static():
+        if pool.spec.replicas < 0:
+            errs.append("spec.replicas: must be non-negative")
+        if pool.spec.weight:
+            errs.append("'weight' is not supported on static NodePools")
+        if pool.spec.limits and set(pool.spec.limits) != {"nodes"}:
+            errs.append("only 'limits.nodes' is supported on static NodePools")
+    if old is not None and (old.spec.replicas is None) != (
+        pool.spec.replicas is None
+    ):
+        errs.append(
+            "Cannot transition NodePool between static (replicas set) and "
+            "dynamic (replicas unset) provisioning modes"
+        )
+    if errs:
+        raise ValidationError("; ".join(errs))
+
+
+def validate_node_claim(claim) -> None:
+    """Admission check for NodeClaim create (spec immutability on
+    update is enforced by the client, nodeclaim.go:145)."""
+    errs = validate_requirements(claim.spec.requirements, "spec.requirements")
+    errs += _validate_taints(claim.spec.taints, "spec.taints")
+    errs += _validate_taints(claim.spec.startup_taints, "spec.startupTaints")
+    err = _validate_duration(claim.spec.expire_after, "spec.expireAfter",
+                             allow_never=True)
+    if err:
+        errs.append(err)
+    err = _validate_duration(
+        claim.spec.termination_grace_period, "spec.terminationGracePeriod",
+        allow_never=False,
+    )
+    if err:
+        errs.append(err)
+    ref = claim.spec.node_class_ref
+    if ref is not None:
+        for attr in ("group", "kind", "name"):
+            if not getattr(ref, attr, ""):
+                errs.append(f"spec.nodeClassRef.{attr}: may not be empty")
+    if errs:
+        raise ValidationError("; ".join(errs))

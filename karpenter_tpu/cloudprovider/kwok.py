@@ -83,6 +83,50 @@ class KwokCloudProvider(CloudProvider):
         self._counter = itertools.count(1)
         self._repair_policies: list = []
 
+    def restore(self) -> int:
+        """Rehydrate instance state from the store after a restart —
+        the checkpoint/resume analogue: claims (and their nodes) are
+        the durable record, the provider's in-memory map is a cache.
+        Returns the number of instances rebuilt."""
+        with self._lock:
+            by_name = {it.name: it for it in self.types}
+            nodes_by_pid = {
+                n.spec.provider_id: n for n in self.kube.nodes()
+                if n.spec.provider_id
+            }
+            rebuilt = 0
+            for claim in self.kube.node_claims():
+                pid = claim.status.provider_id
+                if not pid or pid in self._instances:
+                    continue
+                it = by_name.get(
+                    claim.metadata.labels.get(INSTANCE_TYPE_LABEL, "")
+                )
+                if it is None:
+                    continue
+                node = nodes_by_pid.get(pid)
+                self._instances[pid] = _Instance(
+                    claim_name=claim.metadata.name,
+                    node_name=(
+                        node.metadata.name if node is not None
+                        else pid.removeprefix("kwok://")
+                    ),
+                    instance_type=it,
+                    labels=dict(claim.metadata.labels),
+                    created_at=self.clock(),
+                    registered=node is not None,
+                )
+                rebuilt += 1
+            # never reuse a node-name sequence number from a prior life
+            taken = [
+                int(inst.node_name.rsplit("-", 1)[-1])
+                for inst in self._instances.values()
+                if inst.node_name.rsplit("-", 1)[-1].isdigit()
+            ]
+            if taken:
+                self._counter = itertools.count(max(taken) + 1)
+            return rebuilt
+
     # -- SPI ------------------------------------------------------------------
 
     def create(self, node_claim: NodeClaim) -> NodeClaim:

@@ -69,6 +69,11 @@ class KubeClient:
         self._rv = 0
         self.async_delivery = async_delivery
         self._pending_events: list[tuple[str, str, object]] = []
+        # field-indexer analogue (operator.go:251-294 indexes
+        # pod.spec.nodeName): node name -> pod keys, kept in lockstep
+        # with writes so pods_on_node is O(pods-on-node) not O(pods)
+        self._pods_by_node: dict[str, set[str]] = {}
+        self._pod_node: dict[str, str] = {}
         # serializes deliver() so concurrent pumps can't interleave
         # event order; re-entrant pumps (a handler calling deliver)
         # no-op instead of delivering newer events ahead of the
@@ -81,8 +86,41 @@ class KubeClient:
     def _bucket(self, kind: str) -> dict[str, object]:
         return self._store.setdefault(kind, {})
 
+    def _admit(self, obj, old=None) -> None:
+        """Admission-time validation — the CEL analogue the real API
+        server runs before any write lands (apis/v1/validation.py)."""
+        from karpenter_tpu.apis.v1.validation import (
+            ValidationError,
+            validate_node_claim,
+            validate_node_pool,
+        )
+
+        try:
+            if isinstance(obj, NodePool):
+                validate_node_pool(obj, old=old)
+            elif isinstance(obj, NodeClaim) and old is None:
+                validate_node_claim(obj)
+        except ValidationError as err:
+            raise InvalidError(str(err)) from None
+
+    def _index_pod(self, obj, removed: bool = False) -> None:
+        if not isinstance(obj, Pod):
+            return
+        old = self._pod_node.get(obj.key)
+        new = "" if removed else obj.spec.node_name
+        if old == new:
+            return
+        if old:
+            self._pods_by_node.get(old, set()).discard(obj.key)
+        if new:
+            self._pods_by_node.setdefault(new, set()).add(obj.key)
+            self._pod_node[obj.key] = new
+        else:
+            self._pod_node.pop(obj.key, None)
+
     def create(self, obj) -> object:
         with self._lock:
+            self._admit(obj)
             bucket = self._bucket(obj.kind)
             if obj.key in bucket:
                 raise ConflictError(f"{obj.kind} {obj.key} already exists")
@@ -90,6 +128,7 @@ class KubeClient:
             obj.metadata.resource_version = self._rv
             obj.metadata.generation = 1
             bucket[obj.key] = obj
+            self._index_pod(obj)
             self._notify(obj.kind, ADDED, obj)
             return obj
 
@@ -123,9 +162,11 @@ class KubeClient:
             if isinstance(obj, NodeClaim) and existing is not obj:
                 if repr(existing.spec) != repr(obj.spec):
                     raise InvalidError("NodeClaim spec is immutable")
+            self._admit(obj, old=existing)
             self._rv += 1
             obj.metadata.resource_version = self._rv
             bucket[obj.key] = obj
+            self._index_pod(obj)
             self._notify(obj.kind, MODIFIED, obj)
             return obj
 
@@ -150,6 +191,7 @@ class KubeClient:
                     self._notify(obj.kind, MODIFIED, obj)
                 return obj
             del self._bucket(obj.kind)[obj.key]
+            self._index_pod(obj, removed=True)
             self._notify(obj.kind, DELETED, obj)
             return None
 
@@ -161,9 +203,45 @@ class KubeClient:
                 bucket = self._bucket(obj.kind)
                 if obj.key in bucket:
                     del bucket[obj.key]
+                    self._index_pod(obj, removed=True)
                     self._notify(obj.kind, DELETED, obj)
             else:
                 self.update(obj)
+
+    # -- checkpoint / resume ---------------------------------------------------
+    #
+    # The reference's durable state IS the API server (SURVEY §5.4:
+    # conditions, labels, finalizers, taints — the in-memory caches are
+    # rebuilt from watches on restart). This store is that API server,
+    # so persistence = serializing the store; a fresh operator attaches
+    # informers, replays the LIST, and resumes exactly where the old
+    # process stopped.
+
+    def save(self, path: str) -> None:
+        import pickle
+
+        with self._lock:
+            with open(path, "wb") as fh:
+                pickle.dump(self._store, fh)
+
+    @classmethod
+    def load(cls, path: str, async_delivery: bool = False) -> "KubeClient":
+        import pickle
+
+        client = cls(async_delivery=async_delivery)
+        with open(path, "rb") as fh:
+            client._store = pickle.load(fh)
+        client._rv = max(
+            (
+                obj.metadata.resource_version
+                for bucket in client._store.values()
+                for obj in bucket.values()
+            ),
+            default=0,
+        )
+        for pod in client._bucket("Pod").values():
+            client._index_pod(pod)
+        return client
 
     # -- watch ----------------------------------------------------------------
 
@@ -271,4 +349,9 @@ class KubeClient:
             self.update(pod)
 
     def pods_on_node(self, node_name: str) -> list[Pod]:
-        return [p for p in self.pods() if p.spec.node_name == node_name]
+        with self._lock:
+            keys = self._pods_by_node.get(node_name)
+            if not keys:
+                return []
+            bucket = self._bucket("Pod")
+            return [bucket[k] for k in keys if k in bucket]

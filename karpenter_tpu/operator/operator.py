@@ -59,8 +59,20 @@ class Operator:
     kube: KubeClient
     cloud_provider: CloudProvider
     options: Options = field(default_factory=Options)
+    # HA: with leader_election on, step() is a no-op (beyond the
+    # informer pump) unless this instance holds the lease — the
+    # reference's active/passive replica model (operator.go:141-165)
+    identity: str = "operator-1"
+    leader_election: bool = False
 
     def __post_init__(self) -> None:
+        from karpenter_tpu.operator.leader import LeaderElector
+        from karpenter_tpu.utils.profiling import Profiler
+
+        self.elector = LeaderElector(self.kube, self.identity)
+        # per-phase wall-clock histograms (the pprof analogue,
+        # operator.go:183-199); read via self.profiler.report()
+        self.profiler = Profiler(enabled=self.options.enable_profiling)
         # decorators (kwok/main.go:37, controllers.go wiring)
         provider = MetricsCloudProvider(self.cloud_provider)
         self.overlay_controller = None
@@ -132,6 +144,8 @@ class Operator:
         # consistent (possibly one-tick-stale) mirror — the informer
         # cache model the reference's Synced() barrier exists for
         self.kube.deliver()
+        if self.leader_election and not self.elector.try_acquire_or_renew(now):
+            return  # standby replica: keep the mirror warm, do nothing
         if self.overlay_controller is not None:
             # overlay snapshot before anything consumes instance types
             self.overlay_controller.reconcile(now=now)
@@ -140,14 +154,16 @@ class Operator:
         self.static.reconcile_all(now=now)
 
         if self.provisioner.batcher.ready(now=now):
-            results = self.provisioner.reconcile(now=now)
+            with self.profiler.span("provisioning"):
+                results = self.provisioner.reconcile(now=now)
             self._pending_bindings.append(results)
 
-        self.lifecycle.reconcile_all(now=now)
-        tick = getattr(self.cloud_provider, "tick", None)
-        if tick is not None:
-            tick(now=now)
-        self.lifecycle.reconcile_all(now=now)
+        with self.profiler.span("lifecycle"):
+            self.lifecycle.reconcile_all(now=now)
+            tick = getattr(self.cloud_provider, "tick", None)
+            if tick is not None:
+                tick(now=now)
+            self.lifecycle.reconcile_all(now=now)
 
         self._bind_pending(now=now)
 
@@ -157,10 +173,12 @@ class Operator:
 
         if now - self._last_disruption >= self.options.disruption_poll_seconds:
             self._last_disruption = now
-            self.disruption.reconcile(now=now)
+            with self.profiler.span("disruption"):
+                self.disruption.reconcile(now=now)
         self.disruption.queue.reconcile(now=now)
 
-        self.termination.reconcile_all(now=now)
+        with self.profiler.span("termination"):
+            self.termination.reconcile_all(now=now)
         self.node_health.reconcile(now=now)
         if now - self._last_gc >= GC_INTERVAL_SECONDS:
             self._last_gc = now
@@ -214,6 +232,29 @@ class Operator:
             if unbound:
                 remaining.append(results)
         self._pending_bindings = remaining
+
+    def healthz(self) -> dict:
+        """Liveness: the process and its store are responsive
+        (operator.go:205-222 mounts healthz/readyz probes)."""
+        try:
+            self.kube.node_pools()
+            store_ok = True
+        except Exception:
+            store_ok = False
+        return {"ok": store_ok, "checks": {"store": store_ok}}
+
+    def readyz(self) -> dict:
+        """Readiness: the mirror has caught up with the store (the
+        reference additionally probes CRD presence; here the typed
+        store is always 'installed')."""
+        synced = self.cluster.synced()
+        leader = (
+            self.elector.is_leader() if self.leader_election else True
+        )
+        return {
+            "ok": synced,
+            "checks": {"informers_synced": synced, "leader": leader},
+        }
 
     def run(self, stop_after: Optional[float] = None, tick_seconds: float = 1.0) -> None:
         """Wall-clock loop (operator.Start). `stop_after` bounds the

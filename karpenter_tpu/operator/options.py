@@ -55,6 +55,7 @@ class Options:
     cluster_name: str = ""
     disruption_poll_seconds: float = 10.0  # disruption/controller.go:69
     metrics_interval_seconds: float = 10.0  # object-gauge republish cadence
+    enable_profiling: bool = False         # operator.go:183-199 pprof gate
 
 
 DEFAULT_OPTIONS = Options()

@@ -69,12 +69,39 @@ class StaticCapacityController:
         active = [c for c in claims if c.metadata.deletion_timestamp is None]
         target = pool.spec.replicas or 0
         if len(active) < target:
-            for _ in range(target - len(active)):
-                self._launch(pool)
+            # reserve before launching (statenodepool.go
+            # ReserveNodeCount): under informer lag a second reconcile
+            # sees stale counts; the reservation is what prevents it
+            # from overshooting the replica target
+            granted = self.cluster.reserve_node_count(
+                pool.metadata.name, target - len(active), target
+            )
+            launched = 0
+            try:
+                for _ in range(granted):
+                    self._launch(pool)
+                    launched += 1
+            except Exception:
+                # every unlaunched slot goes back, not just the one
+                # that failed — leaked reservations would wedge the
+                # pool below its replica target forever
+                self.cluster.release_node_reservation(
+                    pool.metadata.name, granted - launched
+                )
+                raise
         elif len(active) > target:
             self._scale_down(pool, active, len(active) - target, now)
         else:
             self._roll_drifted(pool, active, now)
+
+    def _next_claim_name(self, pool: NodePool) -> str:
+        """Collision-proof claim name: the module counter restarts on
+        checkpoint resume (KubeClient.load), so skip names the durable
+        store already holds."""
+        while True:
+            name = f"{pool.metadata.name}-static-{next(_counter):05d}"
+            if self.kube.get_node_claim(name) is None:
+                return name
 
     def _launch(self, pool: NodePool) -> NodeClaim:
         requirements = [
@@ -86,7 +113,7 @@ class StaticCapacityController:
             requirements.append(RequirementSpec(key=key, operator=IN, values=(value,)))
         claim = NodeClaim(
             metadata=ObjectMeta(
-                name=f"{pool.metadata.name}-static-{next(_counter):05d}",
+                name=self._next_claim_name(pool),
                 namespace="",
                 labels={NODEPOOL_LABEL: pool.metadata.name,
                         **pool.spec.template.labels},

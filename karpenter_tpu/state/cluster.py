@@ -191,6 +191,18 @@ class PodSchedulingTimes:
     bound: float = 0.0
 
 
+@dataclass
+class NodePoolState:
+    """Static-pool accounting (statenodepool.go:30-170): observed
+    active/deleting claim counts plus in-flight launch reservations so
+    concurrent (or informer-lagged) launch paths can't overshoot a
+    static pool's replica count."""
+
+    active: int = 0
+    deleting: int = 0
+    reserved: int = 0  # launches granted but not yet observed as claims
+
+
 class Cluster:
     """The mirror (cluster.go:54-118)."""
 
@@ -206,6 +218,8 @@ class Cluster:
         self._antiaffinity_pods: dict[str, Pod] = {}
         self._unconsolidated_at: float = 0.0
         self._pod_times: dict[str, PodSchedulingTimes] = {}
+        self._pool_state: dict[str, NodePoolState] = {}
+        self._claim_pool: dict[str, tuple[str, bool]] = {}  # name -> (pool, deleting)
 
     # -- queries --------------------------------------------------------------
 
@@ -245,6 +259,61 @@ class Cluster:
                 for n in self.nodes()
                 if n.nodepool_name() == pool_name and not n.deleting()
             )
+
+    # -- static-pool accounting (statenodepool.go:30-170) ----------------------
+
+    def nodepool_state(self, pool_name: str) -> NodePoolState:
+        with self._lock:
+            return self._pool_state.setdefault(pool_name, NodePoolState())
+
+    def reserve_node_count(self, pool_name: str, want: int, limit: int) -> int:
+        """Grant up to `want` launch slots without exceeding `limit`
+        total (active + deleting-excluded + already-reserved). The
+        reservation holds until the claim is observed through the watch
+        stream, so an informer-lagged second reconcile cannot
+        double-launch (ReserveNodeCount semantics)."""
+        with self._lock:
+            state = self._pool_state.setdefault(pool_name, NodePoolState())
+            granted = max(0, min(want, limit - state.active - state.reserved))
+            state.reserved += granted
+            return granted
+
+    def release_node_reservation(self, pool_name: str, count: int = 1) -> None:
+        with self._lock:
+            state = self._pool_state.setdefault(pool_name, NodePoolState())
+            state.reserved = max(0, state.reserved - count)
+
+    def _track_claim(self, claim: NodeClaim) -> None:
+        pool = claim.metadata.labels.get(NODEPOOL_LABEL, "")
+        deleting = claim.metadata.deletion_timestamp is not None
+        prev = self._claim_pool.get(claim.metadata.name)
+        if prev == (pool, deleting):
+            return
+        if prev is not None:
+            self._untrack_counts(*prev)
+        self._claim_pool[claim.metadata.name] = (pool, deleting)
+        if pool:
+            state = self._pool_state.setdefault(pool, NodePoolState())
+            if deleting:
+                state.deleting += 1
+            else:
+                state.active += 1
+                # a granted launch materialized: its reservation retires
+                state.reserved = max(0, state.reserved - 1)
+
+    def _untrack_counts(self, pool: str, deleting: bool) -> None:
+        if not pool:
+            return
+        state = self._pool_state.setdefault(pool, NodePoolState())
+        if deleting:
+            state.deleting = max(0, state.deleting - 1)
+        else:
+            state.active = max(0, state.active - 1)
+
+    def _untrack_claim(self, name: str) -> None:
+        prev = self._claim_pool.pop(name, None)
+        if prev is not None:
+            self._untrack_counts(*prev)
 
     # -- consolidation timestamps (cluster.go:537-563) ------------------------
 
@@ -293,6 +362,7 @@ class Cluster:
 
     def update_node_claim(self, claim: NodeClaim) -> None:
         with self._lock:
+            self._track_claim(claim)
             pid = claim.status.provider_id
             old_pid = self._claim_keys.get(claim.metadata.name)
             if pid:
@@ -314,6 +384,7 @@ class Cluster:
 
     def delete_node_claim(self, claim: NodeClaim) -> None:
         with self._lock:
+            self._untrack_claim(claim.metadata.name)
             self._unpaired_claims.pop(claim.metadata.name, None)
             pid = self._claim_keys.pop(claim.metadata.name, None)
             if pid and pid in self._by_provider:

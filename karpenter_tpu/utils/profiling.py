@@ -1,0 +1,84 @@
+"""Wall-clock profiler — the pprof-harness analogue.
+
+The reference mounts net/http/pprof behind --enable-profiling
+(operator.go:183-199) and its benchmark harness emits cpu/heap
+profiles (scheduling_benchmark_test.go:114-160). This build's hot path
+is a compiled XLA program (profiled via jax.profiler when needed), so
+the operator-level equivalent is a label -> latency-histogram tracer:
+cheap enough to leave on, queryable like a /debug/pprof summary, and
+driving the per-controller step timings the operator exposes.
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+
+
+# fixed latency bucket edges (seconds) + an explicit +Inf overflow,
+# prometheus-histogram style — a span slower than the largest edge
+# must never masquerade as <= that edge
+BUCKETS = (0.001, 0.005, 0.025, 0.1, 0.5, 1.0, 5.0, 30.0)
+_BUCKET_LABELS = tuple(f"le_{b}" for b in BUCKETS) + ("le_inf",)
+
+
+@dataclass
+class _Series:
+    count: int = 0
+    total_s: float = 0.0
+    max_s: float = 0.0
+    buckets: list[int] = field(
+        default_factory=lambda: [0] * (len(BUCKETS) + 1)
+    )
+
+
+class Profiler:
+    """Label -> wall-clock histogram with nesting support."""
+
+    def __init__(self, enabled: bool = True, clock=None):
+        self.enabled = enabled
+        self.clock = clock if clock is not None else time.perf_counter
+        self._series: dict[str, _Series] = {}
+
+    @contextmanager
+    def span(self, label: str):
+        if not self.enabled:
+            yield
+            return
+        start = self.clock()
+        try:
+            yield
+        finally:
+            self.record(label, self.clock() - start)
+
+    def record(self, label: str, seconds: float) -> None:
+        if not self.enabled:
+            return
+        series = self._series.setdefault(label, _Series())
+        series.count += 1
+        series.total_s += seconds
+        series.max_s = max(series.max_s, seconds)
+        for i, edge in enumerate(BUCKETS):
+            if seconds <= edge:
+                series.buckets[i] += 1
+                break
+        else:
+            series.buckets[-1] += 1  # the +Inf overflow bucket
+
+    def report(self) -> dict[str, dict]:
+        """The /debug/pprof-style summary: per label, call count, mean,
+        max and bucketed latency counts."""
+        return {
+            label: {
+                "count": s.count,
+                "mean_s": round(s.total_s / s.count, 6) if s.count else 0.0,
+                "total_s": round(s.total_s, 6),
+                "max_s": round(s.max_s, 6),
+                "buckets": dict(zip(_BUCKET_LABELS, s.buckets)),
+            }
+            for label, s in sorted(self._series.items())
+        }
+
+    def reset(self) -> None:
+        self._series.clear()

@@ -206,10 +206,21 @@ class TestNodePoolStatus:
         assert pool.status_conditions.is_true(COND_NODE_REGISTRATION_HEALTHY)
 
     def test_validation_rejects_bad_budget(self):
+        import pytest
+
+        from karpenter_tpu.kube.client import InvalidError
+
         env = Environment(types=types())
         pool = mk_nodepool("default")
         pool.spec.disruption.budgets = [Budget(nodes="nope")]
+        # admission layer (the CEL analogue) rejects the create outright
+        with pytest.raises(InvalidError):
+            env.kube.create(pool)
+        # an object that slipped past admission (hydration/upgrade) is
+        # still caught by the runtime validation condition
+        pool.spec.disruption.budgets = []
         env.kube.create(pool)
+        pool.spec.disruption.budgets = [Budget(nodes="nope")]  # in-place
         from karpenter_tpu.lifecycle.hygiene import NodePoolStatusController
 
         ctrl = NodePoolStatusController(env.kube, env.cluster)

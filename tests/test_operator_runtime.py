@@ -1,0 +1,405 @@
+"""Operator runtime parity: admission validation, leader election,
+healthz/readyz, the pods-by-node field indexer, checkpoint/resume.
+
+Reference anchors: CEL rules (nodepool.go:39-41, nodeclaim.go:38-40)
+and hack/validation scripts; lease leader election + probes
+(operator.go:141-165, 205-222); field indexers (operator.go:251-294);
+"the API server is the checkpoint" (SURVEY §5.4).
+"""
+
+import time
+
+import pytest
+
+from karpenter_tpu.apis.v1.nodeclaim import RequirementSpec
+from karpenter_tpu.apis.v1.nodepool import Budget
+from karpenter_tpu.cloudprovider.fake import GIB, make_instance_type
+from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
+from karpenter_tpu.kube.client import InvalidError, KubeClient
+from karpenter_tpu.kube.objects import Taint
+from karpenter_tpu.operator.leader import LEASE_DURATION_SECONDS, LeaderElector
+from karpenter_tpu.operator.operator import Operator
+from karpenter_tpu.testing import Environment, mk_nodepool, mk_pod
+
+
+def _types():
+    return [make_instance_type("c4", cpu=4, memory=16 * GIB, price=1.0)]
+
+
+class TestAdmissionValidation:
+    def _reject(self, pool):
+        kube = KubeClient()
+        with pytest.raises(InvalidError):
+            kube.create(pool)
+
+    def test_in_operator_requires_values(self):
+        pool = mk_nodepool("p")
+        pool.spec.template.spec.requirements = [
+            RequirementSpec(key="example.com/k", operator="In", values=())
+        ]
+        self._reject(pool)
+
+    @pytest.mark.parametrize("values", [(), ("1", "2"), ("-3",), ("x",)])
+    def test_gt_lt_need_single_positive_integer(self, values):
+        pool = mk_nodepool("p")
+        pool.spec.template.spec.requirements = [
+            RequirementSpec(key="example.com/k", operator="Gt", values=values)
+        ]
+        self._reject(pool)
+
+    def test_min_values_bounds_and_values_floor(self):
+        pool = mk_nodepool("p")
+        pool.spec.template.spec.requirements = [
+            RequirementSpec(key="example.com/k", operator="In",
+                            values=("a",), min_values=2)
+        ]
+        self._reject(pool)
+        pool2 = mk_nodepool("p2")
+        pool2.spec.template.spec.requirements = [
+            RequirementSpec(key="example.com/k", operator="Exists",
+                            values=(), min_values=51)
+        ]
+        self._reject(pool2)
+
+    def test_restricted_label_domain_rejected(self):
+        pool = mk_nodepool("p")
+        pool.spec.template.labels = {"kubernetes.io/hostname": "x"}
+        self._reject(pool)
+        pool2 = mk_nodepool("p2")
+        pool2.spec.template.spec.requirements = [
+            RequirementSpec(key="karpenter.sh/nodepool", operator="In",
+                            values=("other",))
+        ]
+        self._reject(pool2)
+
+    def test_bad_durations_rejected(self):
+        pool = mk_nodepool("p")
+        pool.spec.template.spec.expire_after = "3 days"
+        self._reject(pool)
+        pool2 = mk_nodepool("p2")
+        pool2.spec.disruption.consolidate_after = "bogus"
+        self._reject(pool2)
+
+    def test_budget_schedule_requires_duration(self):
+        pool = mk_nodepool("p")
+        pool.spec.disruption.budgets = [Budget(nodes="5", schedule="0 9 * * *")]
+        self._reject(pool)
+
+    def test_invalid_taint_effect_rejected(self):
+        pool = mk_nodepool("p")
+        pool.spec.template.spec.taints = [
+            Taint(key="k", value="v", effect="Sideways")
+        ]
+        self._reject(pool)
+
+    def test_static_pool_rules(self):
+        pool = mk_nodepool("p")
+        pool.spec.replicas = 3
+        pool.spec.weight = 10
+        self._reject(pool)
+        pool2 = mk_nodepool("p2")
+        pool2.spec.replicas = 3
+        pool2.spec.limits = {"cpu": 100.0}
+        self._reject(pool2)
+
+    def test_static_dynamic_transition_banned_on_update(self):
+        import copy
+
+        kube = KubeClient()
+        pool = mk_nodepool("p")
+        kube.create(pool)
+        changed = copy.deepcopy(pool)
+        changed.spec.replicas = 2
+        with pytest.raises(InvalidError):
+            kube.update(changed)
+
+    def test_valid_pool_admitted(self):
+        kube = KubeClient()
+        pool = mk_nodepool("p")
+        pool.spec.disruption.budgets = [
+            Budget(nodes="10%", schedule="0 9 * * *", duration="8h")
+        ]
+        pool.spec.template.spec.requirements = [
+            RequirementSpec(key="example.com/size", operator="Gt", values=("2",)),
+            RequirementSpec(key="kubernetes.io/arch", operator="In",
+                            values=("amd64", "arm64"), min_values=2),
+        ]
+        kube.create(pool)  # no raise
+
+
+class TestLeaderElection:
+    def test_single_leader_and_failover(self):
+        kube = KubeClient()
+        a = LeaderElector(kube, "op-a")
+        b = LeaderElector(kube, "op-b")
+        t0 = 1000.0
+        assert a.try_acquire_or_renew(now=t0)
+        assert not b.try_acquire_or_renew(now=t0 + 1)
+        # a keeps renewing: b stays standby
+        assert a.try_acquire_or_renew(now=t0 + 5)
+        assert not b.try_acquire_or_renew(now=t0 + 6)
+        # a goes silent: lease expires, b takes over
+        t_late = t0 + 6 + LEASE_DURATION_SECONDS + 1
+        assert b.try_acquire_or_renew(now=t_late)
+        assert not a.try_acquire_or_renew(now=t_late + 1)
+
+    def test_standby_operator_does_not_provision(self):
+        kube = KubeClient()
+        cloud = KwokCloudProvider(kube, types=_types())
+        leader = Operator(kube, cloud, identity="op-a", leader_election=True)
+        standby = Operator(kube, cloud, identity="op-b", leader_election=True)
+        kube.create(mk_nodepool("p"))
+        kube.create(mk_pod(cpu=1.0))
+        now = time.time()
+        leader.step(now=now)  # acquires the lease
+        claims_after_leader = len(kube.node_claims())
+        for i in range(10):
+            standby.step(now=now + i)  # never acts while lease is live
+        assert len(kube.node_claims()) == claims_after_leader
+        # full cycle through the leader only
+        for i in range(6):
+            leader.step(now=now + 2 * i)
+            standby.step(now=now + 2 * i + 1)
+        assert all(p.spec.node_name for p in kube.pods())
+
+
+class TestProbes:
+    def test_healthz_and_readyz(self):
+        env = Environment(types=_types())
+        op = Operator(env.kube, env.cloud)
+        assert op.healthz()["ok"]
+        ready = op.readyz()
+        assert ready["ok"] and ready["checks"]["informers_synced"]
+
+    def test_readyz_false_while_informers_lag(self):
+        kube = KubeClient(async_delivery=True)
+        op = Operator(kube, KwokCloudProvider(kube, types=_types()))
+        kube.create(mk_pod(cpu=1.0))
+        assert not op.readyz()["ok"]
+        kube.deliver()
+        assert op.readyz()["ok"]
+
+
+class TestPodIndexer:
+    def test_index_tracks_bind_and_delete(self):
+        kube = KubeClient()
+        pod = mk_pod(name="a", cpu=1.0)
+        kube.create(pod)
+        assert kube.pods_on_node("n1") == []
+        kube.bind_pod(pod, "n1")
+        assert [p.metadata.name for p in kube.pods_on_node("n1")] == ["a"]
+        kube.bind_pod(pod, "n2")
+        assert kube.pods_on_node("n1") == []
+        assert [p.metadata.name for p in kube.pods_on_node("n2")] == ["a"]
+        kube.delete(pod)
+        assert kube.pods_on_node("n2") == []
+
+
+class TestCheckpointResume:
+    def test_save_load_resumes_cluster(self, tmp_path):
+        env = Environment(types=_types())
+        env.kube.create(mk_nodepool("p"))
+        env.provision(*[mk_pod(name=f"w-{i}", cpu=1.0) for i in range(4)])
+        assert env.all_pods_bound()
+        path = str(tmp_path / "store.ckpt")
+        env.kube.save(path)
+
+        # a fresh process: new client from the checkpoint, new operator,
+        # provider rehydrated from the durable claims
+        kube2 = KubeClient.load(path)
+        assert len(kube2.pods()) == 4 and kube2.node_claims()
+        cloud2 = KwokCloudProvider(kube2, types=_types())
+        assert cloud2.restore() == len(kube2.node_claims())
+        op2 = Operator(kube2, cloud2)
+        # mirror rebuilt from the informer LIST replay
+        assert op2.cluster.synced()
+        assert len(op2.cluster.nodes()) == len(kube2.nodes())
+        # the resumed operator keeps working: a new pod schedules onto
+        # the existing capacity without relaunching anything
+        nodes_before = {n.metadata.name for n in kube2.nodes()}
+        kube2.create(mk_pod(name="late", cpu=0.5))
+        now = time.time()
+        op2.provisioner.batcher.trigger(now=now)
+        for i in range(4):
+            op2.step(now=now + 2 + i)
+        late = kube2.get_pod("default", "late")
+        assert late.spec.node_name
+        assert {n.metadata.name for n in kube2.nodes()} == nodes_before
+        # GC must not reap rehydrated instances as leaked
+        op2.gc.reconcile(now=now + 10)
+        assert len(kube2.node_claims()) == len(nodes_before)
+
+
+class TestNodePoolState:
+    def test_counts_and_reservations(self):
+        from karpenter_tpu.apis.v1.labels import NODEPOOL_LABEL, TERMINATION_FINALIZER
+        from karpenter_tpu.apis.v1.nodeclaim import NodeClaim, NodeClaimSpec
+        from karpenter_tpu.kube.objects import ObjectMeta
+        from karpenter_tpu.state.cluster import Cluster, attach_informers
+
+        kube = KubeClient(async_delivery=True)
+        cluster = Cluster(kube)
+        attach_informers(kube, cluster)
+        # reservations cap at the limit across calls
+        assert cluster.reserve_node_count("p", 2, 3) == 2
+        assert cluster.reserve_node_count("p", 2, 3) == 1
+        assert cluster.reserve_node_count("p", 1, 3) == 0
+        # claims materialize through the (lagged) watch stream and
+        # retire their reservations
+        claims = []
+        for i in range(3):
+            claim = NodeClaim(
+                metadata=ObjectMeta(
+                    name=f"c-{i}", namespace="",
+                    labels={NODEPOOL_LABEL: "p"},
+                    finalizers=[TERMINATION_FINALIZER],
+                ),
+                spec=NodeClaimSpec(),
+            )
+            kube.create(claim)
+            claims.append(claim)
+        state = cluster.nodepool_state("p")
+        assert state.active == 0 and state.reserved == 3  # still queued
+        kube.deliver()
+        assert state.active == 3 and state.reserved == 0
+        # deletion flips active -> deleting while the finalizer holds
+        kube.delete(claims[0], now=1000.0)
+        kube.deliver()
+        assert state.active == 2 and state.deleting == 1
+        kube.remove_finalizer(claims[0], TERMINATION_FINALIZER)
+        kube.deliver()
+        assert state.active == 2 and state.deleting == 0
+
+    def test_static_pool_exact_replicas(self):
+        from karpenter_tpu.operator.options import FeatureGates, Options
+
+        env = Environment(
+            types=_types(),
+            options=Options(feature_gates=FeatureGates(static_capacity=True)),
+        )
+        pool = mk_nodepool("stat")
+        pool.spec.replicas = 3
+        env.kube.create(pool)
+        # repeated reconciles must converge on exactly 3, never overshoot
+        for _ in range(3):
+            env.provisioner.batcher.trigger()
+            now = time.time()
+            from karpenter_tpu.provisioning.static import StaticCapacityController
+
+            ctrl = StaticCapacityController(env.kube, env.cluster, env.options)
+            ctrl.reconcile_all(now=now)
+        assert len(env.kube.node_claims()) == 3
+        assert env.cluster.nodepool_state("stat").active == 3
+
+
+class TestProfiling:
+    def test_profiler_histograms(self):
+        from karpenter_tpu.utils.profiling import Profiler
+
+        ticks = iter([0.0, 0.010, 1.0, 1.2])
+        prof = Profiler(enabled=True, clock=lambda: next(ticks))
+        with prof.span("solve"):
+            pass
+        with prof.span("solve"):
+            pass
+        report = prof.report()["solve"]
+        assert report["count"] == 2
+        assert report["max_s"] == 0.2
+        assert report["buckets"]["le_0.025"] == 1
+
+    def test_operator_profiling_gate(self):
+        from karpenter_tpu.operator.options import Options
+
+        env = Environment(types=_types())
+        op = Operator(env.kube, env.cloud,
+                      options=Options(enable_profiling=True))
+        env.kube.create(mk_nodepool("p"))
+        env.kube.create(mk_pod(cpu=1.0))
+        now = time.time()
+        op.provisioner.batcher.trigger(now=now)
+        for i in range(4):
+            op.step(now=now + 2 + i)
+        assert "provisioning" in op.profiler.report()
+        # gate off: no series recorded
+        op2 = Operator(env.kube, env.cloud)
+        op2.step(now=now + 10)
+        assert op2.profiler.report() == {}
+
+
+class TestReviewRegressions:
+    def test_launch_failure_releases_all_unlaunched_reservations(self):
+        from karpenter_tpu.operator.options import FeatureGates, Options
+        from karpenter_tpu.provisioning.static import StaticCapacityController
+
+        env = Environment(
+            types=_types(),
+            options=Options(feature_gates=FeatureGates(static_capacity=True)),
+        )
+        pool = mk_nodepool("stat")
+        pool.spec.replicas = 5
+        env.kube.create(pool)
+        ctrl = StaticCapacityController(env.kube, env.cluster, env.options)
+        # fail the 3rd launch once
+        real_launch = ctrl._launch
+        calls = {"n": 0}
+
+        def flaky(p):
+            calls["n"] += 1
+            if calls["n"] == 3:
+                raise RuntimeError("boom")
+            return real_launch(p)
+
+        ctrl._launch = flaky
+        with pytest.raises(RuntimeError):
+            ctrl.reconcile_all()
+        ctrl._launch = real_launch
+        ctrl.reconcile_all()  # must recover to exactly 5
+        assert len(env.kube.node_claims()) == 5
+        state = env.cluster.nodepool_state("stat")
+        assert state.active == 5 and state.reserved == 0
+
+    def test_static_names_survive_checkpoint_resume(self, tmp_path):
+        from karpenter_tpu.operator.options import FeatureGates, Options
+        from karpenter_tpu.provisioning.static import StaticCapacityController
+        from karpenter_tpu.state.cluster import Cluster, attach_informers
+
+        opts = Options(feature_gates=FeatureGates(static_capacity=True))
+        env = Environment(types=_types(), options=opts)
+        pool = mk_nodepool("stat")
+        pool.spec.replicas = 2
+        env.kube.create(pool)
+        StaticCapacityController(env.kube, env.cluster, opts).reconcile_all()
+        path = str(tmp_path / "s.ckpt")
+        env.kube.save(path)
+        # resumed process: counter restarts, names must not collide
+        import karpenter_tpu.provisioning.static as static_mod
+        import itertools as it
+
+        static_mod._counter = it.count(1)
+        kube2 = KubeClient.load(path)
+        cluster2 = Cluster(kube2)
+        attach_informers(kube2, cluster2)
+        pool2 = kube2.get_node_pool("stat")
+        pool2.spec.replicas = 3
+        StaticCapacityController(kube2, cluster2, opts).reconcile_all()
+        assert len(kube2.node_claims()) == 3
+        assert len({c.metadata.name for c in kube2.node_claims()}) == 3
+
+    def test_expired_lease_race_has_one_winner(self):
+        kube = KubeClient()
+        a = LeaderElector(kube, "op-a")
+        b = LeaderElector(kube, "op-b")
+        assert a.try_acquire_or_renew(now=1000.0)
+        late = 1000.0 + LEASE_DURATION_SECONDS + 5
+        wins = [b.try_acquire_or_renew(now=late),
+                a.try_acquire_or_renew(now=late)]
+        assert sum(wins) == 1
+
+    def test_profiler_overflow_bucket(self):
+        from karpenter_tpu.utils.profiling import Profiler
+
+        prof = Profiler(enabled=True)
+        prof.record("slow", 60.0)
+        report = prof.report()["slow"]
+        assert report["buckets"]["le_inf"] == 1
+        assert report["buckets"]["le_30.0"] == 0
