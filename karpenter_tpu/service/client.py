@@ -1,0 +1,88 @@
+"""Solver-service client with in-process fallback.
+
+The control plane calls `RemoteSolver.solve_packing` exactly where it
+would call the local kernel; connection failures and deadline misses
+fall back to the in-process solve, so a dead or slow solver host
+degrades to round-1 behavior instead of wedging provisioning (the
+fallback the SURVEY §7 seam requires).
+
+Enable by setting KARPENTER_SOLVER_ENDPOINT=host:port — solver.solve_
+encoded routes every device solve through it.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from typing import Optional
+
+from karpenter_tpu.service import codec
+from karpenter_tpu.service.server import SOLVE_METHOD
+from karpenter_tpu.solver.pack import PackResult, solve_packing
+
+log = logging.getLogger("karpenter.solver-client")
+
+DEFAULT_TIMEOUT_SECONDS = 55.0  # under the 60s Solve wall-clock bound
+BREAKER_FAILURES = 2            # consecutive failures that trip it
+BREAKER_COOLDOWN_SECONDS = 60.0
+
+
+def endpoint_from_env() -> Optional[str]:
+    return os.environ.get("KARPENTER_SOLVER_ENDPOINT") or None
+
+
+class RemoteSolver:
+    def __init__(self, endpoint: str,
+                 timeout: float = DEFAULT_TIMEOUT_SECONDS,
+                 fallback_local: bool = True):
+        import grpc
+
+        self.endpoint = endpoint
+        self.timeout = timeout
+        self.fallback_local = fallback_local
+        self._channel = grpc.insecure_channel(endpoint)
+        self._solve = self._channel.unary_unary(
+            SOLVE_METHOD, request_serializer=None, response_deserializer=None
+        )
+        # circuit breaker: a routable-but-black-holed endpoint costs a
+        # full deadline per RPC; after BREAKER_FAILURES consecutive
+        # misses every solve goes straight local until the cooldown
+        # elapses, so provisioning never serializes repeated stalls
+        self._failures = 0
+        self._skip_until = 0.0
+
+    def solve_packing(self, enc, max_nodes: int = 0, mode: str = "ffd",
+                      plan=None, shards: int = 0) -> PackResult:
+        def local() -> PackResult:
+            return solve_packing(
+                enc, max_nodes=max_nodes, mode=mode, plan=plan, shards=shards
+            )
+
+        now = time.monotonic()
+        if self.fallback_local and now < self._skip_until:
+            return local()
+        request = codec.encode_request(enc, mode, max_nodes, shards, plan)
+        try:
+            response = self._solve(request, timeout=self.timeout)
+            self._failures = 0
+            return codec.decode_result(response)
+        except Exception as err:
+            self._failures += 1
+            if self._failures >= BREAKER_FAILURES:
+                self._skip_until = now + BREAKER_COOLDOWN_SECONDS
+                log.warning(
+                    "solver service %s: %d consecutive failures; breaker "
+                    "open for %.0fs", self.endpoint, self._failures,
+                    BREAKER_COOLDOWN_SECONDS,
+                )
+            if not self.fallback_local:
+                raise
+            log.warning(
+                "solver service %s unavailable (%s); solving in-process",
+                self.endpoint, type(err).__name__,
+            )
+            return local()
+
+    def close(self) -> None:
+        self._channel.close()

@@ -1,0 +1,121 @@
+"""Wire codec for the solver service.
+
+The solver boundary (SURVEY §5.8/§7: control plane on the cluster,
+solver service on the TPU hosts, gRPC over DCN between them) carries
+exactly the dense arrays the packing kernel consumes — nothing richer
+crosses the wire. Requests/responses are compressed npz bundles with a
+tiny JSON header; gRPC's custom-serializer API ships them as-is, so no
+protoc codegen is needed and the payload stays numpy end to end.
+
+The decode back into NodePlans (pools, instance types, offerings)
+stays client-side: those are control-plane objects the solver host
+never needs.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+from dataclasses import dataclass
+
+import numpy as np
+
+from karpenter_tpu.solver.encode import Encoded
+from karpenter_tpu.solver.pack import PackResult
+
+_ARRAY_FIELDS = (
+    "group_req", "group_count", "compat", "cfg_alloc", "cfg_price",
+    "cfg_pool", "pool_overhead", "existing_used",
+)
+_OPTIONAL_ARRAY_FIELDS = (
+    "cfg_rsv", "rsv_cap", "group_cap", "conflict", "existing_quota",
+)
+
+
+@dataclass
+class _StubConfig:
+    """Server-side stand-in for ConfigInfo: the kernel entry only needs
+    the existing-node column marker."""
+
+    existing_index: int
+
+
+def encode_request(
+    enc: Encoded, mode: str, max_nodes: int, shards: int, plan=None
+) -> bytes:
+    header = {
+        "mode": mode,
+        "max_nodes": max_nodes,
+        "shards": shards,
+        "n_existing": enc.n_existing,
+        "existing_index": [c.existing_index for c in enc.configs],
+        "has_plan": plan is not None,
+    }
+    arrays = {name: getattr(enc, name) for name in _ARRAY_FIELDS}
+    for name in _OPTIONAL_ARRAY_FIELDS:
+        value = getattr(enc, name)
+        if value is not None:
+            arrays[name] = value
+    if plan is not None:
+        arrays["plan_cols"] = plan.planned_cols
+        arrays["plan_quota"] = plan.planned_quota
+    buf = io.BytesIO()
+    np.savez_compressed(
+        buf, __header__=np.frombuffer(
+            json.dumps(header).encode(), dtype=np.uint8
+        ), **arrays,
+    )
+    return buf.getvalue()
+
+
+def decode_request(payload: bytes):
+    """-> (Encoded-compatible object, mode, max_nodes, shards, plan)."""
+    data = np.load(io.BytesIO(payload), allow_pickle=False)
+    header = json.loads(bytes(data["__header__"]).decode())
+    kwargs = {name: data[name] for name in _ARRAY_FIELDS}
+    for name in _OPTIONAL_ARRAY_FIELDS:
+        kwargs[name] = data[name] if name in data.files else None
+    enc = Encoded(
+        resource_keys=[],
+        groups=[],
+        configs=[_StubConfig(i) for i in header["existing_index"]],
+        n_existing=header["n_existing"],
+        **kwargs,
+    )
+    plan = None
+    if header["has_plan"]:
+        from karpenter_tpu.solver.lp_plan import FleetPlan
+
+        plan = FleetPlan(
+            planned_cols=data["plan_cols"],
+            planned_quota=data["plan_quota"],
+            lower_bound=0.0,
+            objective_estimate=0.0,
+        )
+    return enc, header["mode"], header["max_nodes"], header["shards"], plan
+
+
+def encode_result(result: PackResult) -> bytes:
+    buf = io.BytesIO()
+    np.savez_compressed(
+        buf,
+        assign=result.assign,
+        node_mask=result.node_mask,
+        node_used=result.node_used,
+        node_active=result.node_active,
+        node_count=np.asarray([result.node_count], np.int64),
+        unschedulable=result.unschedulable,
+    )
+    return buf.getvalue()
+
+
+def decode_result(payload: bytes) -> PackResult:
+    data = np.load(io.BytesIO(payload), allow_pickle=False)
+    return PackResult(
+        assign=data["assign"],
+        node_mask=data["node_mask"],
+        node_used=data["node_used"],
+        node_active=data["node_active"],
+        node_count=int(data["node_count"][0]),
+        unschedulable=data["unschedulable"],
+    )

@@ -1,0 +1,86 @@
+"""Solver service: the TPU-host side of the gRPC seam.
+
+SURVEY §5.8/§7: the control plane keeps the API-server fabric; the new
+distributed piece is a stateless solver service on the TPU hosts —
+request in, solution out, reached over gRPC (DCN), with intra-solve
+parallelism over ICI via the sharded kernel (solve_packing shards).
+
+One RPC: /karpenter.tpu.Solver/Solve, bytes in / bytes out (npz
+codec). Solves are serialized per process: the packing kernel owns the
+chip, and concurrent jit dispatch from server threads would interleave
+on one device anyway.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from concurrent import futures
+from typing import Optional
+
+from karpenter_tpu.service import codec
+
+log = logging.getLogger("karpenter.solver-service")
+
+SERVICE_NAME = "karpenter.tpu.Solver"
+SOLVE_METHOD = f"/{SERVICE_NAME}/Solve"
+
+
+class SolverServer:
+    def __init__(self, port: int = 0, shards: int = 0, max_workers: int = 4,
+                 bind: str = "127.0.0.1"):
+        """`shards`: device-mesh width the service solves with — its own
+        ICI parallelism, authoritative over anything a client sends (a
+        control plane has no idea how many chips this host has).
+        `port=0` picks a free port, exposed as `self.port` after
+        start(). `bind`: loopback by default (tests/sidecar); a
+        standalone TPU host serves on all interfaces via serve()."""
+        import grpc
+
+        self._default_shards = shards
+        self._solve_lock = threading.Lock()
+        self.requests_served = 0
+
+        def solve_handler(request: bytes, context) -> bytes:
+            from karpenter_tpu.solver.pack import solve_packing
+
+            enc, mode, max_nodes, _, plan = codec.decode_request(request)
+            with self._solve_lock:
+                result = solve_packing(
+                    enc, max_nodes=max_nodes, mode=mode, plan=plan,
+                    shards=self._default_shards,
+                )
+                self.requests_served += 1
+            return codec.encode_result(result)
+
+        handler = grpc.method_handlers_generic_handler(
+            SERVICE_NAME,
+            {
+                "Solve": grpc.unary_unary_rpc_method_handler(
+                    solve_handler,
+                    request_deserializer=None,   # raw bytes
+                    response_serializer=None,
+                )
+            },
+        )
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=max_workers)
+        )
+        self._server.add_generic_rpc_handlers((handler,))
+        self.port = self._server.add_insecure_port(f"{bind}:{port}")
+
+    def start(self) -> "SolverServer":
+        self._server.start()
+        log.info("solver service listening on :%d", self.port)
+        return self
+
+    def stop(self, grace: Optional[float] = 1.0) -> None:
+        self._server.stop(grace)
+
+
+def serve(port: int = 50151, shards: int = 0,
+          bind: str = "[::]") -> None:  # pragma: no cover
+    """Blocking entry point for a standalone solver host: listens on
+    all interfaces so the control plane can reach it over DCN."""
+    server = SolverServer(port=port, shards=shards, bind=bind).start()
+    server._server.wait_for_termination()

@@ -117,6 +117,34 @@ def _backend() -> str:
     return os.environ.get("KARPENTER_SOLVER_BACKEND", "jax")
 
 
+_remote_solver = None
+_remote_lock = __import__("threading").Lock()
+
+
+def _solve_packing(enc, **kwargs):
+    """The solver seam: with KARPENTER_SOLVER_ENDPOINT set, device
+    solves go to the gRPC solver service on the TPU hosts (DCN) —
+    SURVEY §5.8 — and fall back to the in-process kernel when it is
+    unreachable. Without it, solve locally."""
+    global _remote_solver
+    from karpenter_tpu.service.client import endpoint_from_env
+
+    endpoint = endpoint_from_env()
+    if endpoint:
+        with _remote_lock:
+            if _remote_solver is None or _remote_solver.endpoint != endpoint:
+                from karpenter_tpu.service.client import RemoteSolver
+
+                if _remote_solver is not None:
+                    _remote_solver.close()  # don't leak the old channel
+                _remote_solver = RemoteSolver(endpoint)
+            client = _remote_solver
+        return client.solve_packing(enc, **kwargs)
+    from karpenter_tpu.solver.pack import solve_packing
+
+    return solve_packing(enc, **kwargs)
+
+
 def solve(
     pods: Sequence[Pod],
     pools_with_types: Sequence[tuple[NodePool, Sequence[InstanceType]]],
@@ -154,10 +182,8 @@ def solve_encoded(
 def _decode_device(
     enc: Encoded, objective: str = "ffd", shards: int = 0
 ) -> Solution:
-    from karpenter_tpu.solver.pack import solve_packing
-
     if objective != "cost":
-        result = solve_packing(enc, mode=objective, shards=shards)
+        result = _solve_packing(enc, mode=objective, shards=shards)
         return _build_solution_arrays(
             enc,
             np.flatnonzero(result.node_active[: result.node_count]),
@@ -175,10 +201,10 @@ def _decode_device(
 
     plan = lp_plan.plan(enc)
     candidates = []
-    ffd_result = solve_packing(enc, mode="ffd", shards=shards)
+    ffd_result = _solve_packing(enc, mode="ffd", shards=shards)
     candidates.append((ffd_result, _downsize_masks(enc, ffd_result)))
     if plan is not None:
-        cost_result = solve_packing(enc, mode="cost", plan=plan, shards=shards)
+        cost_result = _solve_packing(enc, mode="cost", plan=plan, shards=shards)
         candidates.append((cost_result, _downsize_masks(enc, cost_result)))
 
     def key(item):

@@ -1,0 +1,100 @@
+"""Concurrency stress: operator loop racing API writers.
+
+The reference wires the Go race detector into every test run
+(Makefile:75-92) and hardens state.Cluster with a coarse RWMutex +
+copy-on-read snapshots. The analogue here: run the operator loop in
+one thread while other threads churn pods through the API, and assert
+(a) no exceptions escape any thread — in particular no deadlock
+between the kube lock, the cluster lock and the delivery lock (the
+round-2 review found one lock-order inversion in synced(); this is
+the regression net for that class) — and (b) the system converges
+once the churn stops.
+"""
+
+import threading
+import time
+
+from karpenter_tpu.cloudprovider.fake import GIB, make_instance_type
+from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
+from karpenter_tpu.kube.client import KubeClient
+from karpenter_tpu.operator.operator import Operator
+from karpenter_tpu.testing import mk_nodepool, mk_pod
+
+
+def _run_stress(async_delivery: bool, seconds: float = 2.5) -> None:
+    kube = KubeClient(async_delivery=async_delivery)
+    cloud = KwokCloudProvider(
+        kube, types=[make_instance_type("c8", cpu=8, memory=32 * GIB)]
+    )
+    op = Operator(kube, cloud)
+    kube.create(mk_nodepool("general"))
+    errors: list[BaseException] = []
+    stop = threading.Event()
+
+    def guard(fn):
+        def run():
+            try:
+                fn()
+            except BaseException as err:  # noqa: BLE001 - the assertion IS "no error"
+                errors.append(err)
+                stop.set()
+        return run
+
+    def operator_loop():
+        now = time.time()
+        while not stop.is_set():
+            now += 2.0
+            op.step(now=now)
+
+    def churn(prefix):
+        i = 0
+        while not stop.is_set():
+            i += 1
+            pod = mk_pod(name=f"{prefix}-{i}", cpu=0.5)
+            kube.create(pod)
+            if i % 3 == 0:
+                kube.delete(pod)
+            if i % 7 == 0:
+                # reads race the writes: snapshot + synced barrier
+                op.cluster.deep_copy_nodes()
+                op.cluster.synced()
+            time.sleep(0.001)
+
+    threads = [
+        threading.Thread(target=guard(operator_loop), daemon=True),
+        threading.Thread(target=guard(lambda: churn("a")), daemon=True),
+        threading.Thread(target=guard(lambda: churn("b")), daemon=True),
+    ]
+    for t in threads:
+        t.start()
+    time.sleep(seconds)
+    stop.set()
+    for t in threads:
+        t.join(timeout=30)
+        assert not t.is_alive(), "thread wedged: possible deadlock"
+    assert not errors, f"background thread raised: {errors[:1]!r}"
+
+    # churn stopped: the loop must converge — every surviving pod bound
+    op.provisioner.batcher.trigger()
+    now = time.time() + 100
+    for i in range(30):
+        op.step(now=now + 2 * i)
+        if all(
+            p.spec.node_name for p in kube.pods()
+            if not p.is_terminal() and p.metadata.deletion_timestamp is None
+        ):
+            break
+    pending = [
+        p.metadata.name for p in kube.pods()
+        if not p.spec.node_name and not p.is_terminal()
+        and p.metadata.deletion_timestamp is None
+    ]
+    assert not pending, f"{len(pending)} pods never bound after churn"
+
+
+class TestRaceStress:
+    def test_sync_delivery_stress(self):
+        _run_stress(async_delivery=False)
+
+    def test_async_delivery_stress(self):
+        _run_stress(async_delivery=True)
