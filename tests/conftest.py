@@ -15,3 +15,20 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from karpenter_tpu.utils.platform import force_cpu_mesh
 
 force_cpu_mesh(8)
+
+
+def same_solution(a, b):
+    """Used-row PackResult equality: the node-axis SIZE may differ
+    between calls (solve_packing remembers a tight axis after the
+    first solve), but the placement in the used rows must be
+    identical."""
+    import numpy as np
+
+    n = a.node_count
+    if n != b.node_count:
+        return False
+    return (
+        np.array_equal(a.assign[:n], b.assign[:n])
+        and np.array_equal(a.node_mask[:n], b.node_mask[:n])
+        and np.array_equal(a.unschedulable, b.unschedulable)
+    )

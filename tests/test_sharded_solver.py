@@ -11,9 +11,11 @@ import numpy as np
 import pytest
 
 from bench import build_problem
+from conftest import same_solution
 from karpenter_tpu.solver.encode import encode, group_pods
 from karpenter_tpu.solver.pack import _mesh, default_shards, solve_packing
 from karpenter_tpu.solver.solver import solve
+
 
 
 def _problem(n_pods, n_types, seed=3):
@@ -27,17 +29,13 @@ class TestShardedPack:
         _, _, enc = _problem(5000, 200)
         base = solve_packing(enc, mode="ffd")
         sharded = solve_packing(enc, mode="ffd", shards=8)
-        assert sharded.node_count == base.node_count
-        assert np.array_equal(sharded.assign, base.assign)
-        assert np.array_equal(sharded.node_mask, base.node_mask)
-        assert np.array_equal(sharded.unschedulable, base.unschedulable)
+        assert same_solution(sharded, base)
 
     def test_sharded_cost_mode_matches(self):
         _, _, enc = _problem(1200, 64, seed=11)
         base = solve_packing(enc, mode="cost")
         sharded = solve_packing(enc, mode="cost", shards=8)
-        assert sharded.node_count == base.node_count
-        assert np.array_equal(sharded.assign, base.assign)
+        assert same_solution(sharded, base)
 
     def test_two_and_four_way_shardings_agree(self):
         _, _, enc = _problem(800, 48, seed=5)
@@ -45,8 +43,7 @@ class TestShardedPack:
             solve_packing(enc, mode="ffd", shards=s) for s in (0, 2, 4, 8)
         ]
         for r in results[1:]:
-            assert r.node_count == results[0].node_count
-            assert np.array_equal(r.assign, results[0].assign)
+            assert same_solution(r, results[0])
 
     def test_solve_facade_shards(self):
         pods, pools, _ = _problem(600, 32, seed=9)
@@ -114,8 +111,7 @@ class TestShardedPack:
         assert plan is not None and len(plan.planned_cols) > 0
         base = sp(enc, mode="cost", plan=plan)
         sharded = sp(enc, mode="cost", plan=plan, shards=8)
-        assert sharded.node_count == base.node_count
-        assert np.array_equal(sharded.assign, base.assign)
+        assert same_solution(sharded, base)
 
     def test_too_many_shards_raises(self):
         with pytest.raises(ValueError):

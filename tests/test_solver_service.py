@@ -10,6 +10,7 @@ import numpy as np
 import pytest
 
 from bench import build_problem
+from conftest import same_solution
 from karpenter_tpu.service import codec
 from karpenter_tpu.service.client import RemoteSolver
 from karpenter_tpu.service.server import SolverServer
@@ -17,6 +18,7 @@ from karpenter_tpu.solver.encode import encode, group_pods
 from karpenter_tpu.solver.pack import solve_packing
 from karpenter_tpu.solver.solver import solve
 from karpenter_tpu.solver import lp_plan
+
 
 
 @pytest.fixture(scope="module")
@@ -59,8 +61,7 @@ class TestService:
         remote = RemoteSolver(f"127.0.0.1:{server.port}").solve_packing(
             enc, mode="ffd"
         )
-        assert remote.node_count == local.node_count
-        assert np.array_equal(remote.assign, local.assign)
+        assert same_solution(remote, local)
 
     def test_remote_cost_solve_with_plan(self, server):
         _, _, enc = _enc(800, 32, seed=11)
@@ -69,8 +70,7 @@ class TestService:
         remote = RemoteSolver(f"127.0.0.1:{server.port}").solve_packing(
             enc, mode="cost", plan=plan
         )
-        assert remote.node_count == local.node_count
-        assert np.array_equal(remote.assign, local.assign)
+        assert same_solution(remote, local)
 
     def test_env_routes_full_solve_through_service(self, server, monkeypatch):
         import karpenter_tpu.solver.solver as solver_mod
