@@ -250,13 +250,17 @@ class Scheduler:
         """Per-pool daemonset resource overhead (scheduler.go:772-803):
         sum requests of daemon pods whose scheduling terms admit the
         pool template."""
+        from karpenter_tpu.solver.encode import pool_template_requirements
+
         out: dict[str, dict[str, float]] = {}
         for pool, types in self.pools_with_types:
-            template_reqs = Requirements()
-            for spec in pool.spec.template.spec.requirements:
-                template_reqs.add(Requirement(spec.key, spec.operator, spec.values))
-            for key, value in pool.spec.template.labels.items():
-                template_reqs.add(Requirement(key, IN, [value]))
+            template_reqs = pool_template_requirements(pool)
+            # the nodepool pin is part of the template's identity
+            # (NewNodeClaimTemplate adds it): a daemonset selecting
+            # 'karpenter.sh/nodepool: other' must not be budgeted here
+            template_reqs.add(
+                Requirement(NODEPOOL_LABEL, IN, [pool.metadata.name])
+            )
             taints = list(pool.spec.template.spec.taints)
             total: dict[str, float] = {}
             for ds in self.daemonsets:
@@ -265,7 +269,14 @@ class Scheduler:
                 if tolerates_pod(taints, pod) is not None:
                     continue
                 pod_reqs = Requirements.from_pod(pod, required_only=True)
-                if template_reqs.intersects(pod_reqs) is not None:
+                # full compatibility, not bare intersection: a daemonset
+                # requiring a custom label the template never defines
+                # can never land on the pool's nodes, so its overhead
+                # must not be budgeted (scheduler.go:772-803 uses
+                # IsCompatible with the undefined-key rules)
+                if not template_reqs.is_compatible(
+                    pod_reqs, allow_undefined=WELL_KNOWN_LABELS
+                ):
                     continue
                 total = resutil.merge(total, resutil.pod_requests(pod))
             if total:

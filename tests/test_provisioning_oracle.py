@@ -1,0 +1,330 @@
+"""Provisioning oracle suite, ported from the reference's
+provisioning/suite_test.go property families: resource limits,
+daemonset overhead accounting, batcher windows, claim creation
+(requirement tightening, label/annotation propagation, TGP),
+deleting/invalid nodepools, weighted fallthrough.
+"""
+
+import time
+
+from karpenter_tpu.apis.v1.labels import NODEPOOL_LABEL
+from karpenter_tpu.apis.v1.nodeclaim import RequirementSpec
+from karpenter_tpu.cloudprovider.fake import GIB, make_instance_type
+from karpenter_tpu.kube.objects import (
+    Affinity,
+    DaemonSet,
+    DaemonSetSpec,
+    NodeAffinity,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+    ObjectMeta,
+    PodTemplateSpec,
+    Taint,
+    Toleration,
+)
+from karpenter_tpu.provisioning.provisioner import Batcher
+from karpenter_tpu.testing import Environment, mk_nodepool, mk_pod
+
+
+def types():
+    return [
+        make_instance_type("c4", cpu=4, memory=16 * GIB, price=1.0),
+        make_instance_type("c16", cpu=16, memory=64 * GIB, price=4.0),
+        make_instance_type(
+            "gpu8", cpu=8, memory=32 * GIB, price=10.0,
+            extra_resources={"example.com/gpu": 4.0},
+        ),
+    ]
+
+
+def mk_daemonset(name="ds", cpu=0.5, memory=GIB, tolerations=None,
+                 node_selector=None, affinity=None, labels=None):
+    from karpenter_tpu.kube.objects import Container, PodSpec
+
+    return DaemonSet(
+        metadata=ObjectMeta(name=name),
+        spec=DaemonSetSpec(
+            template=PodTemplateSpec(
+                metadata=ObjectMeta(name=f"{name}-pod", labels=labels or {}),
+                spec=PodSpec(
+                    containers=[
+                        Container(requests={"cpu": cpu, "memory": memory})
+                    ],
+                    tolerations=tolerations or [],
+                    node_selector=node_selector or {},
+                    affinity=affinity,
+                ),
+            )
+        ),
+    )
+
+
+class TestResourceLimits:
+    def test_not_schedule_when_limits_exceeded(self):
+        # suite_test.go:741: committed capacity already exceeds the
+        # limit and no existing node has room -> creation blocked
+        env = Environment(types=[types()[0]])  # c4 only: 1 pod per node
+        pool = mk_nodepool("p")
+        pool.spec.limits = {"cpu": 20.0}
+        env.kube.create(pool)
+        env.provision(*[mk_pod(cpu=3.5) for _ in range(5)])  # 5x4 = 20 cpu
+        before = len(env.kube.node_claims())
+        results = env.provision(mk_pod(name="over", cpu=3.5), bind=False)
+        assert len(env.kube.node_claims()) == before
+        assert "default/over" in results.errors
+
+    def test_schedule_if_limits_would_be_met(self):
+        # suite_test.go:764
+        env = Environment(types=types())
+        pool = mk_nodepool("p")
+        pool.spec.limits = {"cpu": 50.0}
+        env.kube.create(pool)
+        env.provision(mk_pod(cpu=3.0))
+        assert len(env.kube.node_claims()) == 1
+
+    def test_gpu_limits(self):
+        # suite_test.go:846: extended-resource limits block too
+        env = Environment(types=types())
+        pool = mk_nodepool("p")
+        pool.spec.limits = {"example.com/gpu": 4.0}
+        env.kube.create(pool)
+        gpu_pod = mk_pod(name="g1", cpu=1.0)
+        gpu_pod.spec.containers[0].requests["example.com/gpu"] = 4.0
+        env.provision(gpu_pod)
+        assert len(env.kube.node_claims()) == 1
+        gpu_pod2 = mk_pod(name="g2", cpu=1.0)
+        gpu_pod2.spec.containers[0].requests["example.com/gpu"] = 2.0
+        results = env.provision(gpu_pod2, bind=False)
+        assert len(env.kube.node_claims()) == 1
+        assert "default/g2" in results.errors
+
+    def test_limits_hold_across_rounds(self):
+        # suite_test.go:862: the second round sees the first round's usage
+        env = Environment(types=types())
+        pool = mk_nodepool("p")
+        pool.spec.limits = {"cpu": 5.0}
+        env.kube.create(pool)
+        env.provision(mk_pod(cpu=3.0))
+        claims_1 = len(env.kube.node_claims())
+        env.provision(mk_pod(name="second", cpu=3.0), bind=False)
+        assert len(env.kube.node_claims()) == claims_1
+
+
+class TestDaemonSets:
+    def test_overhead_reserved_on_fresh_nodes(self):
+        # suite_test.go:892
+        env = Environment(types=[types()[0]])  # only c4
+        env.kube.create(mk_nodepool("p"))
+        env.kube.create(mk_daemonset(cpu=2.0))
+        env.provision(*[mk_pod(name=f"w-{i}", cpu=1.5) for i in range(2)])
+        # 2x1.5 + 2.0 daemon = 5 cpu > one c4: two nodes needed
+        assert len(env.kube.node_claims()) == 2
+
+    def test_too_large_daemonset_blocks(self):
+        # suite_test.go:961: overhead alone exceeds every type
+        env = Environment(types=[types()[0]])
+        env.kube.create(mk_nodepool("p"))
+        env.kube.create(mk_daemonset(cpu=100.0))
+        results = env.provision(mk_pod(name="w", cpu=0.5), bind=False)
+        assert not env.kube.node_claims()
+        assert "default/w" in results.errors
+
+    def test_non_tolerating_daemonset_ignored(self):
+        # suite_test.go:1100: pool taint the daemonset does not tolerate
+        env = Environment(types=[types()[0]])
+        pool = mk_nodepool("p")
+        pool.spec.template.spec.taints = [
+            Taint(key="example.com/team", value="a", effect="NoSchedule")
+        ]
+        env.kube.create(pool)
+        env.kube.create(mk_daemonset(cpu=3.0))  # would not fit alongside
+        pod = mk_pod(cpu=3.0)
+        pod.spec.tolerations = [
+            Toleration(key="example.com/team", operator="Equal", value="a",
+                       effect="NoSchedule")
+        ]
+        env.provision(pod)
+        # daemonset ignored: one c4 holds the 3-cpu pod
+        assert len(env.kube.node_claims()) == 1
+
+    def test_tolerating_daemonset_counted(self):
+        env = Environment(types=[types()[0]])
+        pool = mk_nodepool("p")
+        pool.spec.template.spec.taints = [
+            Taint(key="example.com/team", value="a", effect="NoSchedule")
+        ]
+        env.kube.create(pool)
+        env.kube.create(mk_daemonset(
+            cpu=2.0,
+            tolerations=[Toleration(key="example.com/team", operator="Equal",
+                                    value="a", effect="NoSchedule")],
+        ))
+        pod = mk_pod(cpu=3.0)
+        pod.spec.tolerations = [
+            Toleration(key="example.com/team", operator="Equal", value="a",
+                       effect="NoSchedule")
+        ]
+        results = env.provision(pod, bind=False)
+        # 3 + 2 daemon > c4's ~3.9 allocatable: unschedulable on c4-only
+        assert not results.new_node_plans or "default/" in next(
+            iter(results.errors), "default/"
+        )
+
+    def test_daemonset_with_incompatible_selector_ignored(self):
+        # suite_test.go:1177-1337 family: a daemonset whose node
+        # affinity can never match the pool contributes no overhead
+        env = Environment(types=[types()[0]])
+        env.kube.create(mk_nodepool("p"))
+        env.kube.create(mk_daemonset(
+            cpu=3.0, node_selector={"example.com/region": "mars"}
+        ))
+        env.provision(mk_pod(cpu=3.0))
+        assert len(env.kube.node_claims()) == 1
+
+    def test_daemonset_preference_does_not_block(self):
+        # suite_test.go:1309: an incompatible PREFERENCE still leaves
+        # the daemonset schedulable -> overhead counted
+        env = Environment(types=[types()[0]])
+        env.kube.create(mk_nodepool("p"))
+        affinity = Affinity(
+            node_affinity=NodeAffinity(
+                preferred=(),
+                required=(
+                    NodeSelectorTerm(
+                        match_expressions=(
+                            NodeSelectorRequirement(
+                                "kubernetes.io/os", "In", ("linux",)
+                            ),
+                        )
+                    ),
+                ),
+            )
+        )
+        env.kube.create(mk_daemonset(cpu=2.0, affinity=affinity))
+        env.provision(*[mk_pod(name=f"w-{i}", cpu=1.5) for i in range(2)])
+        assert len(env.kube.node_claims()) == 2
+
+
+class TestBatcher:
+    def test_idle_window_fires(self):
+        # suite_test.go:118
+        b = Batcher(idle_seconds=1.0, max_seconds=10.0)
+        b.trigger(now=100.0)
+        assert not b.ready(now=100.5)
+        assert b.ready(now=101.1)
+
+    def test_new_pod_extends_window(self):
+        # suite_test.go:174
+        b = Batcher(idle_seconds=1.0, max_seconds=10.0)
+        b.trigger(now=100.0)
+        b.trigger(now=100.8)
+        assert not b.ready(now=101.5)  # idle restarted at 100.8
+        assert b.ready(now=101.9)
+
+    def test_max_window_caps_extension(self):
+        b = Batcher(idle_seconds=1.0, max_seconds=10.0)
+        b.trigger(now=100.0)
+        for i in range(20):
+            b.trigger(now=100.0 + 0.6 * i)  # continuous arrivals
+        assert b.ready(now=110.1)  # max window forces the flush
+
+
+class TestClaimCreation:
+    def test_deleting_nodepool_ignored(self):
+        # suite_test.go:280
+        env = Environment(types=types())
+        pool = mk_nodepool("p")
+        pool.metadata.finalizers = ["keep"]
+        env.kube.create(pool)
+        env.kube.delete(pool)
+        results = env.provision(mk_pod(name="w", cpu=1.0), bind=False)
+        assert not env.kube.node_claims()
+        assert "default/w" in results.errors
+
+    def test_no_nodepools_unschedulable(self):
+        # suite_test.go:291
+        env = Environment(types=types())
+        results = env.provision(mk_pod(name="w", cpu=1.0), bind=False)
+        assert "default/w" in results.errors
+
+    def test_claim_carries_template_metadata_and_tgp(self):
+        # suite_test.go:267,1376,1394: labels/annotations/TGP propagate
+        env = Environment(types=types())
+        pool = mk_nodepool("p")
+        pool.spec.template.labels = {"example.com/tier": "gold"}
+        pool.spec.template.annotations = {"example.com/note": "hi"}
+        pool.spec.template.spec.termination_grace_period = "30m"
+        env.kube.create(pool)
+        env.provision(mk_pod(cpu=1.0))
+        claim = env.kube.node_claims()[0]
+        assert claim.metadata.labels["example.com/tier"] == "gold"
+        assert claim.metadata.annotations["example.com/note"] == "hi"
+        assert claim.spec.termination_grace_period == "30m"
+        node = env.kube.nodes()[0]
+        assert node.metadata.labels["example.com/tier"] == "gold"
+
+    def test_claim_requirements_tightened_to_solution(self):
+        # suite_test.go:1522: instance-type requirement reflects the
+        # solved set, not the whole catalog
+        env = Environment(types=types())
+        env.kube.create(mk_nodepool("p"))
+        env.provision(mk_pod(cpu=1.0))
+        claim = env.kube.node_claims()[0]
+        type_req = next(
+            r for r in claim.spec.requirements
+            if r.key == "node.kubernetes.io/instance-type"
+        )
+        assert set(type_req.values) <= {"c4", "c16", "gpu8"}
+        zone_req = next(
+            r for r in claim.spec.requirements
+            if r.key == "topology.kubernetes.io/zone"
+        )
+        assert zone_req.values  # solved zones recorded
+
+
+class TestWeightedFallthrough:
+    def test_higher_weight_pool_wins_when_feasible(self):
+        # suite_test.go:2623
+        env = Environment(types=types())
+        low = mk_nodepool("low")
+        high = mk_nodepool("high")
+        high.spec.weight = 50
+        env.kube.create(low)
+        env.kube.create(high)
+        env.provision(mk_pod(cpu=1.0))
+        claim = env.kube.node_claims()[0]
+        assert claim.metadata.labels[NODEPOOL_LABEL] == "high"
+
+    def test_falls_through_when_high_weight_cannot_fit(self):
+        env = Environment(types=types())
+        low = mk_nodepool("low")
+        high = mk_nodepool("high")
+        high.spec.weight = 50
+        high.spec.template.spec.requirements = [
+            RequirementSpec(key="kubernetes.io/arch", operator="In",
+                            values=("arm64",))
+        ]
+        env.kube.create(low)
+        env.kube.create(high)
+        pod = mk_pod(cpu=1.0, node_selector={"kubernetes.io/arch": "amd64"})
+        env.provision(pod)
+        claim = env.kube.node_claims()[0]
+        assert claim.metadata.labels[NODEPOOL_LABEL] == "low"
+
+
+class TestPoolPinnedDaemonSet:
+    def test_daemonset_pinned_to_other_pool_not_budgeted(self):
+        # a daemonset nodeSelector-pinned to pool-a must not inflate
+        # pool-b's overhead (NewNodeClaimTemplate includes the nodepool
+        # pin in the template requirements)
+        env = Environment(types=[types()[0]])
+        env.kube.create(mk_nodepool("pool-a"))
+        env.kube.create(mk_nodepool("pool-b"))
+        env.kube.create(mk_daemonset(
+            cpu=3.0, node_selector={NODEPOOL_LABEL: "pool-a"}
+        ))
+        pod = mk_pod(cpu=3.0, node_selector={NODEPOOL_LABEL: "pool-b"})
+        env.provision(pod)
+        claims = env.kube.node_claims()
+        assert len(claims) == 1
+        assert claims[0].metadata.labels[NODEPOOL_LABEL] == "pool-b"
