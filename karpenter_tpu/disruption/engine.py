@@ -95,14 +95,22 @@ class Command:
 
 
 def pod_disruption_cost(pod: Pod) -> float:
-    """utils/disruption semantics: deletion-cost annotation, default 0,
-    shifted so every pod costs at least 1."""
-    raw = pod.metadata.annotations.get("controller.kubernetes.io/pod-deletion-cost", "0")
-    try:
-        cost = float(raw)
-    except ValueError:
-        cost = 0.0
-    return 1.0 + cost / 1000.0
+    """EvictionCost (utils/disruption/disruption.go): base 1.0, plus
+    the pod-deletion-cost annotation scaled by 2^27 (min cost ~ -15
+    pods, max ~ +17) and the scheduling priority scaled by 2^25,
+    clamped to [-10, 10]."""
+    cost = 1.0
+    raw = pod.metadata.annotations.get(
+        "controller.kubernetes.io/pod-deletion-cost"
+    )
+    if raw is not None:
+        try:
+            cost += float(raw) / 2.0**27
+        except ValueError:
+            log.warning("bad pod-deletion-cost %r on %s", raw, pod.key)
+    if pod.spec.priority:
+        cost += float(pod.spec.priority) / 2.0**25
+    return max(-10.0, min(10.0, cost))
 
 
 class DisruptionEngine:
@@ -481,6 +489,7 @@ class DisruptionEngine:
         now = time.time() if now is None else now
         if not self.cluster.synced():
             return None
+        self._untaint_leftovers()
         for method in (
             self.emptiness,
             self.drift,
@@ -492,6 +501,44 @@ class DisruptionEngine:
                 self.queue.start_command(command, now)
                 return command
         return None
+
+
+    def _untaint_leftovers(self) -> None:
+        """Un-taint nodes left disrupted by a previous action that is
+        no longer in flight — a crashed operator or a rolled-back
+        command must not leave capacity unschedulable forever
+        (controller.go:136-157)."""
+        in_flight = {
+            c.state_node.name
+            for cmd in self.queue.active
+            for c in cmd.candidates
+        }
+        for node in self.cluster.nodes():
+            if node.name in in_flight or node.node is None:
+                continue
+            # only API-level deletion exempts a node; marked_for_deletion
+            # alone is exactly the stale state this pass must recover (a
+            # command that died before reaching the queue leaves the mark
+            # AND the taint — skipping on it would wedge the node forever)
+            if any(
+                obj is not None and obj.metadata.deletion_timestamp is not None
+                for obj in (node.node, node.node_claim)
+            ):
+                continue
+            if any(
+                t.key == DISRUPTED_NO_SCHEDULE_TAINT.key
+                for t in node.node.spec.taints
+            ):
+                node.node.spec.taints = [
+                    t for t in node.node.spec.taints
+                    if t.key != DISRUPTED_NO_SCHEDULE_TAINT.key
+                ]
+                self.kube.update(node.node)
+                if node.node_claim is not None:
+                    node.node_claim.status_conditions.clear(
+                        COND_DISRUPTION_REASON
+                    )
+                node.marked_for_deletion = False
 
 
 class OrchestrationQueue:

@@ -254,13 +254,7 @@ class Scheduler:
 
         out: dict[str, dict[str, float]] = {}
         for pool, types in self.pools_with_types:
-            template_reqs = pool_template_requirements(pool)
-            # the nodepool pin is part of the template's identity
-            # (NewNodeClaimTemplate adds it): a daemonset selecting
-            # 'karpenter.sh/nodepool: other' must not be budgeted here
-            template_reqs.add(
-                Requirement(NODEPOOL_LABEL, IN, [pool.metadata.name])
-            )
+            template_reqs = pool_template_requirements(pool, with_pool_pin=True)
             taints = list(pool.spec.template.spec.taints)
             total: dict[str, float] = {}
             for ds in self.daemonsets:

@@ -186,26 +186,30 @@ class Encoded:
 
 
 def pool_template_requirements(
-    pool: NodePool, with_labels: bool = True
+    pool: NodePool, with_labels: bool = True, with_pool_pin: bool = False
 ) -> Requirements:
     """The pool template's requirement set (spec requirements incl.
-    minValues, plus template labels as IN pins). The single source for
-    every consumer — config building, domain discovery, minValues
-    enforcement — so the assembly can't drift between sites."""
+    minValues, plus template labels as IN pins, plus — with
+    `with_pool_pin` — the karpenter.sh/nodepool identity pin that
+    NewNodeClaimTemplate adds). The single source for every consumer —
+    config building, domain discovery, daemon-overhead gating,
+    minValues enforcement — so the assembly can't drift between
+    sites."""
     reqs = Requirements()
     for spec in pool.spec.template.spec.requirements:
         reqs.add(Requirement(spec.key, spec.operator, spec.values, spec.min_values))
     if with_labels:
         for key, value in pool.spec.template.labels.items():
             reqs.add(Requirement(key, IN, [value]))
+    if with_pool_pin:
+        reqs.add(Requirement(NODEPOOL_LABEL, IN, [pool.metadata.name]))
     return reqs
 
 
 def _config_requirements(
     pool: NodePool, it: InstanceType, offering: Offering
 ) -> Requirements:
-    reqs = pool_template_requirements(pool)
-    reqs.add(Requirement(NODEPOOL_LABEL, IN, [pool.metadata.name]))
+    reqs = pool_template_requirements(pool, with_pool_pin=True)
     reqs.add(*it.requirements.values())
     reqs.add(*offering.requirements.values())
     return reqs

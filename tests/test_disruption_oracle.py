@@ -1,0 +1,262 @@
+"""Disruption oracle suite, ported from the reference's disruption
+suite_test.go property families: candidate eligibility (do-not-disrupt
+pods/nodes, daemonset/mirror variants, terminal/terminating
+exemptions, PDB blocking), eviction-cost math, budget counting edge
+cases, and leftover-taint hygiene.
+"""
+
+import time
+
+from karpenter_tpu.apis.v1.labels import (
+    DISRUPTED_NO_SCHEDULE_TAINT,
+    DO_NOT_DISRUPT_ANNOTATION,
+)
+from karpenter_tpu.apis.v1.nodepool import REASON_EMPTY, REASON_UNDERUTILIZED
+from karpenter_tpu.cloudprovider.fake import GIB, make_instance_type
+from karpenter_tpu.disruption.engine import pod_disruption_cost
+from karpenter_tpu.kube.objects import (
+    LabelSelector,
+    ObjectMeta,
+    OwnerReference,
+    PodDisruptionBudget,
+    PodDisruptionBudgetSpec,
+)
+from karpenter_tpu.testing import Environment, mk_nodepool, mk_pod
+
+
+def _env(n_pods=1, cpu=0.5, labels=None):
+    env = Environment(
+        types=[make_instance_type("c8", cpu=8, memory=32 * GIB, price=2.0)]
+    )
+    pool = mk_nodepool("default")
+    pool.spec.disruption.consolidate_after = "0s"
+    env.kube.create(pool)
+    pods = [
+        mk_pod(name=f"w-{i}", cpu=cpu, labels=dict(labels or {}))
+        for i in range(n_pods)
+    ]
+    env.provision(*pods)
+    return env, pods
+
+
+def _candidates(env, reason=REASON_UNDERUTILIZED, at=60):
+    now = time.time() + at
+    env.pod_events.reconcile_all(now=now)
+    env.conditions.reconcile_all(now=now)
+    return env.disruption.get_candidates(reason, now)
+
+
+class TestCandidateEligibility:
+    def test_do_not_disrupt_pod_blocks(self):
+        # suite_test.go:917
+        env, pods = _env()
+        live = env.kube.get_pod("default", pods[0].metadata.name)
+        live.metadata.annotations[DO_NOT_DISRUPT_ANNOTATION] = "true"
+        assert _candidates(env) == []
+
+    def test_do_not_disrupt_daemonset_pod_blocks(self):
+        # suite_test.go:983: daemon pods are normally ignored, but a
+        # do-not-disrupt one still blocks the candidate
+        env, pods = _env()
+        ds_pod = mk_pod(name="daemon", cpu=0.1)
+        ds_pod.metadata.owner_references = [
+            OwnerReference(kind="DaemonSet", name="ds", uid="uid-ds-1")
+        ]
+        ds_pod.metadata.annotations[DO_NOT_DISRUPT_ANNOTATION] = "true"
+        env.kube.create(ds_pod)
+        env.kube.bind_pod(ds_pod, env.kube.nodes()[0].metadata.name)
+        assert _candidates(env) == []
+
+    def test_terminal_do_not_disrupt_pod_does_not_block(self):
+        # suite_test.go:1241
+        env, pods = _env()
+        live = env.kube.get_pod("default", pods[0].metadata.name)
+        live.metadata.annotations[DO_NOT_DISRUPT_ANNOTATION] = "true"
+        live.status.phase = "Succeeded"
+        assert len(_candidates(env)) == 1
+
+    def test_do_not_disrupt_node_annotation_blocks(self):
+        # suite_test.go:1279
+        env, _ = _env()
+        node = env.kube.nodes()[0]
+        node.metadata.annotations[DO_NOT_DISRUPT_ANNOTATION] = "true"
+        assert _candidates(env) == []
+
+    def test_fully_blocking_pdb_blocks(self):
+        # suite_test.go:1352
+        env, _ = _env(labels={"app": "w"})
+        env.kube.create(PodDisruptionBudget(
+            metadata=ObjectMeta(name="pdb"),
+            spec=PodDisruptionBudgetSpec(
+                selector=LabelSelector.of({"app": "w"}), max_unavailable=0
+            ),
+        ))
+        assert _candidates(env) == []
+
+    def test_pdb_on_terminal_pod_does_not_block(self):
+        # suite_test.go:1546
+        env, pods = _env(labels={"app": "w"})
+        env.kube.create(PodDisruptionBudget(
+            metadata=ObjectMeta(name="pdb"),
+            spec=PodDisruptionBudgetSpec(
+                selector=LabelSelector.of({"app": "w"}), max_unavailable=0
+            ),
+        ))
+        live = env.kube.get_pod("default", pods[0].metadata.name)
+        live.status.phase = "Succeeded"
+        assert len(_candidates(env)) == 1
+
+    def test_uninitialized_node_not_a_candidate(self):
+        # suite_test.go:712
+        env = Environment(
+            types=[make_instance_type("c8", cpu=8, memory=32 * GIB)],
+            registration_delay=3600.0,
+        )
+        pool = mk_nodepool("default")
+        pool.spec.disruption.consolidate_after = "0s"
+        env.kube.create(pool)
+        env.provision(mk_pod(cpu=0.5))
+        assert _candidates(env) == []
+
+
+class TestEvictionCost:
+    def test_default_cost_is_one(self):
+        # suite_test.go:845
+        assert pod_disruption_cost(mk_pod(cpu=1.0)) == 1.0
+
+    def test_positive_deletion_cost_raises(self):
+        # suite_test.go:849
+        pod = mk_pod(cpu=1.0)
+        pod.metadata.annotations[
+            "controller.kubernetes.io/pod-deletion-cost"
+        ] = "100000000"
+        assert pod_disruption_cost(pod) > 1.0
+
+    def test_negative_deletion_cost_lowers(self):
+        # suite_test.go:857
+        pod = mk_pod(cpu=1.0)
+        pod.metadata.annotations[
+            "controller.kubernetes.io/pod-deletion-cost"
+        ] = "-100000000"
+        assert pod_disruption_cost(pod) < 1.0
+
+    def test_cost_ordering_by_deletion_cost(self):
+        # suite_test.go:865
+        costs = []
+        for raw in ("-2147483647", "0", "2147483647"):
+            pod = mk_pod(cpu=1.0)
+            pod.metadata.annotations[
+                "controller.kubernetes.io/pod-deletion-cost"
+            ] = raw
+            costs.append(pod_disruption_cost(pod))
+        assert costs == sorted(costs)
+        assert -10.0 <= costs[0] and costs[-1] <= 10.0
+
+    def test_priority_raises_and_lowers(self):
+        # suite_test.go:884-890
+        high = mk_pod(cpu=1.0)
+        high.spec.priority = 100_000_000
+        low = mk_pod(cpu=1.0)
+        low.spec.priority = -100_000_000
+        assert pod_disruption_cost(high) > 1.0 > pod_disruption_cost(low)
+
+
+class TestBudgetCounting:
+    def test_deleting_nodes_reduce_allowed(self):
+        # suite_test.go:796: nodes already deleting consume budget
+        env, pods = _env(n_pods=4, cpu=0.5)
+        # each pod landed on one shared node; spread onto 4 nodes instead
+        env2 = Environment(
+            types=[make_instance_type("c1", cpu=1, memory=4 * GIB)]
+        )
+        pool = mk_nodepool("default")
+        from karpenter_tpu.apis.v1.nodepool import Budget
+
+        pool.spec.disruption.budgets = [Budget(nodes="2")]
+        env2.kube.create(pool)
+        for i in range(4):
+            env2.provision(mk_pod(name=f"s-{i}", cpu=0.6))
+        assert len(env2.kube.nodes()) == 4
+        now = time.time()
+        # one claim already deleting
+        env2.kube.delete(env2.kube.node_claims()[0], now=now)
+        mapping = env2.disruption.budget_mapping(REASON_EMPTY, now)
+        assert mapping["default"] == 1  # 2 allowed - 1 deleting
+
+    def test_never_negative(self):
+        # suite_test.go:775
+        env, _ = _env()
+        from karpenter_tpu.apis.v1.nodepool import Budget
+
+        pool = env.kube.get_node_pool("default")
+        pool.spec.disruption.budgets = [Budget(nodes="0")]
+        now = time.time()
+        env.kube.delete(env.kube.node_claims()[0], now=now)
+        mapping = env.disruption.budget_mapping(REASON_EMPTY, now)
+        assert mapping["default"] == 0
+
+    def test_per_reason_budgets(self):
+        # budgets with `reasons` cap only those reasons
+        env, _ = _env()
+        from karpenter_tpu.apis.v1.nodepool import Budget
+
+        pool = env.kube.get_node_pool("default")
+        pool.spec.disruption.budgets = [
+            Budget(nodes="0", reasons=["Drifted"]),
+        ]
+        now = time.time()
+        assert env.disruption.budget_mapping("Drifted", now)["default"] == 0
+        assert env.disruption.budget_mapping(REASON_EMPTY, now)["default"] > 0
+
+
+class TestLeftoverTaints:
+    def test_stale_disrupted_taint_removed(self):
+        # suite_test.go:586: taints left by a previous (crashed/rolled
+        # back) action are removed on the next reconcile
+        env, pods = _env()
+        node = env.kube.nodes()[0]
+        node.spec.taints.append(DISRUPTED_NO_SCHEDULE_TAINT)
+        env.kube.update(node)
+        env.disruption.reconcile(now=time.time())
+        fresh = env.kube.nodes()[0]
+        assert not any(
+            t.key == DISRUPTED_NO_SCHEDULE_TAINT.key for t in fresh.spec.taints
+        )
+
+    def test_in_flight_command_taints_kept(self):
+        # a command actually executing must keep its taints
+        env, pods = _env(n_pods=1, cpu=0.5)
+        env.kube.delete(env.kube.get_pod("default", pods[0].metadata.name))
+        now = time.time() + 60
+        env.pod_events.reconcile_all(now=now)
+        env.conditions.reconcile_all(now=now)
+        command = env.disruption.reconcile(now=now)
+        assert command is not None
+        # queue is active; another reconcile pass must not un-taint
+        in_flight = {c.state_node.name for c in command.candidates}
+        env.disruption._untaint_leftovers()
+        for node in env.kube.nodes():
+            if node.metadata.name in in_flight and (
+                node.metadata.deletion_timestamp is None
+            ):
+                assert any(
+                    t.key == DISRUPTED_NO_SCHEDULE_TAINT.key
+                    for t in node.spec.taints
+                )
+
+    def test_wedged_marked_node_recovered(self):
+        # review regression: a command that died before reaching the
+        # orchestration queue leaves marked_for_deletion + the taint;
+        # the hygiene pass must recover that node, not skip it
+        env, _ = _env()
+        state = env.cluster.nodes()[0]
+        state.marked_for_deletion = True
+        node = env.kube.nodes()[0]
+        node.spec.taints.append(DISRUPTED_NO_SCHEDULE_TAINT)
+        env.kube.update(node)
+        env.disruption.reconcile(now=time.time())
+        fresh = env.kube.nodes()[0]
+        assert not any(
+            t.key == DISRUPTED_NO_SCHEDULE_TAINT.key for t in fresh.spec.taints
+        )
+        assert not env.cluster.nodes()[0].marked_for_deletion
