@@ -124,15 +124,25 @@ def _critical(pod: Pod) -> bool:
 
 
 def _drain_waves(pods: list[Pod]) -> list[list[Pod]]:
-    """Eviction order (terminator.go:96-139): non-critical non-daemon,
-    critical non-daemon, non-critical daemon, critical daemon."""
+    """Eviction order (terminator.go groupPodsByPriority, mirroring
+    graceful node shutdown): non-critical non-daemon, non-critical
+    daemon, critical non-daemon, critical daemon."""
     waves: list[list[Pod]] = [[], [], [], []]
     for pod in pods:
         daemon = pod.owner_kind() == "DaemonSet"
         crit = _critical(pod)
-        idx = (2 if daemon else 0) + (1 if crit else 0)
+        idx = (2 if crit else 0) + (1 if daemon else 0)
         waves[idx].append(pod)
     return [w for w in waves if w]
+
+
+def _tolerates_disrupted(pod: Pod) -> bool:
+    """Pods tolerating the karpenter.sh/disrupted:NoSchedule taint are
+    NOT drained (IsDrainable, utils/pod): they opted to ride the node
+    down, so they neither get evicted nor block drain completion."""
+    from karpenter_tpu.scheduling.taints import tolerates_pod
+
+    return tolerates_pod([DISRUPTED_NO_SCHEDULE_TAINT], pod) is None
 
 
 class TerminationController:
@@ -171,8 +181,18 @@ class TerminationController:
             claim.status_conditions.set_true(COND_VOLUMES_DETACHED, now=now)
             self.kube.update(claim)
 
-        # 4. done: drop the finalizer; the nodeclaim finalizer performs
-        # the instance delete once the node object is gone
+        # 4. done: pods that rode the node down (disrupted-taint
+        # tolerators, stragglers) die with it — the kubelet/pod-GC
+        # role in a real cluster; controller-owned ones are reborn
+        # pending so the workload replica is recreated
+        for pod in list(self.kube.pods_on_node(node.metadata.name)):
+            if pod.is_terminal():
+                continue
+            self.kube.delete(pod, now=now)
+            if pod.owner_kind() != "DaemonSet":
+                self.kube.create(rebirth_pod(pod))
+        # drop the finalizer; the nodeclaim finalizer performs the
+        # instance delete once the node object is gone
         self.kube.remove_finalizer(node, TERMINATION_FINALIZER)
 
     def reconcile_all(self, now: Optional[float] = None) -> None:
@@ -202,23 +222,9 @@ class TerminationController:
         pods = [
             p
             for p in self.kube.pods_on_node(node.metadata.name)
-            if not p.is_terminal()
+            if not p.is_terminal() and not _tolerates_disrupted(p)
         ]
-        evictable = []
-        for pod in pods:
-            if pod.is_terminating():
-                evictable.append(pod)  # still counts as present
-                continue
-            # do-not-disrupt pods wait for the TGP deadline
-            # (terminator.go:140-180)
-            if (
-                pod.metadata.annotations.get(DO_NOT_DISRUPT_ANNOTATION) == "true"
-                and (deadline is None or now < deadline)
-            ):
-                evictable.append(pod)
-                continue
-            evictable.append(pod)
-        waves = _drain_waves([p for p in evictable if not p.is_terminating()])
+        waves = _drain_waves([p for p in pods if not p.is_terminating()])
         if waves:
             force = deadline is not None and now >= deadline
             for pod in waves[0]:
@@ -230,7 +236,8 @@ class TerminationController:
                 # TGP enforcement bypasses PDBs (terminator.go:140)
                 self.queue.evict(pod, now=now, force=force)
         return [
-            p for p in self.kube.pods_on_node(node.metadata.name) if not p.is_terminal()
+            p for p in self.kube.pods_on_node(node.metadata.name)
+            if not p.is_terminal() and not _tolerates_disrupted(p)
         ]
 
     def _volumes_detached(self, node: Node) -> bool:

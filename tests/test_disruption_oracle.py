@@ -60,7 +60,7 @@ class TestCandidateEligibility:
         env, pods = _env()
         ds_pod = mk_pod(name="daemon", cpu=0.1)
         ds_pod.metadata.owner_references = [
-            OwnerReference(kind="DaemonSet", name="ds", uid="uid-ds-1")
+            OwnerReference(kind="DaemonSet", name="ds", uid="uid-ds-1", controller=True)
         ]
         ds_pod.metadata.annotations[DO_NOT_DISRUPT_ANNOTATION] = "true"
         env.kube.create(ds_pod)
@@ -164,8 +164,6 @@ class TestEvictionCost:
 class TestBudgetCounting:
     def test_deleting_nodes_reduce_allowed(self):
         # suite_test.go:796: nodes already deleting consume budget
-        env, pods = _env(n_pods=4, cpu=0.5)
-        # each pod landed on one shared node; spread onto 4 nodes instead
         env2 = Environment(
             types=[make_instance_type("c1", cpu=1, memory=4 * GIB)]
         )

@@ -1,0 +1,141 @@
+"""Termination oracle suite, ported from node/termination suite_test.go
+families: drain-wave priority ordering, disruption-taint tolerations
+riding the node down, terminal pods not blocking, TGP-forced eviction.
+"""
+
+import time
+
+from karpenter_tpu.apis.v1.labels import (
+    DISRUPTED_NO_SCHEDULE_TAINT,
+    NODECLAIM_TERMINATION_TIMESTAMP_ANNOTATION,
+)
+from karpenter_tpu.cloudprovider.fake import GIB, make_instance_type
+from karpenter_tpu.kube.objects import OwnerReference, Toleration
+from karpenter_tpu.lifecycle.termination import _drain_waves
+from karpenter_tpu.testing import Environment, mk_nodepool, mk_pod
+
+
+def _pod(name, daemon=False, critical=False, tolerations=None):
+    pod = mk_pod(name=name, cpu=0.1)
+    if daemon:
+        pod.metadata.owner_references = [
+            OwnerReference(kind="DaemonSet", name="ds", uid="uid-ds", controller=True)
+        ]
+    if critical:
+        pod.spec.priority_class_name = "system-cluster-critical"
+    if tolerations:
+        pod.spec.tolerations = tolerations
+    return pod
+
+
+class TestDrainWaves:
+    def test_reference_wave_order(self):
+        # terminator.go groupPodsByPriority: non-crit non-daemon,
+        # non-crit daemon, crit non-daemon, crit daemon
+        pods = [
+            _pod("crit-daemon", daemon=True, critical=True),
+            _pod("plain"),
+            _pod("crit", critical=True),
+            _pod("daemon", daemon=True),
+        ]
+        waves = _drain_waves(pods)
+        names = [[p.metadata.name for p in w] for w in waves]
+        assert names == [["plain"], ["daemon"], ["crit"], ["crit-daemon"]]
+
+    def test_one_wave_at_a_time(self):
+        # suite_test.go:403 "evict pods in order and wait": the second
+        # wave is only evicted after the first is gone
+        env = Environment(
+            types=[make_instance_type("c8", cpu=8, memory=32 * GIB)]
+        )
+        env.kube.create(mk_nodepool("p"))
+        plain = _pod("plain")
+        crit = _pod("crit", critical=True)
+        env.provision(plain, crit)
+        claim = env.kube.node_claims()[0]
+        env.kube.delete(claim)
+        env.lifecycle.reconcile_all()
+        env.termination.reconcile_all()
+        # first pass evicts only the non-critical wave
+        live_crit = env.kube.get_pod("default", "crit")
+        assert live_crit is not None and live_crit.spec.node_name
+        # the plain pod was evicted (rebirthed unbound)
+        reborn = env.kube.get_pod("default", "plain")
+        assert reborn is None or not reborn.spec.node_name
+
+
+class TestDisruptionTaintToleration:
+    def test_tolerating_pod_not_evicted_and_drain_completes(self):
+        # suite_test.go:220/250: pods tolerating the disrupted taint
+        # ride the node down — never evicted, never blocking
+        env = Environment(
+            types=[make_instance_type("c8", cpu=8, memory=32 * GIB)]
+        )
+        env.kube.create(mk_nodepool("p"))
+        rider = _pod("rider", tolerations=[
+            Toleration(key=DISRUPTED_NO_SCHEDULE_TAINT.key,
+                       operator="Exists")
+        ])
+        env.provision(rider)
+        claim = env.kube.node_claims()[0]
+        env.kube.delete(claim)
+        env.reconcile_termination()
+        # node fully terminated even though the rider never got evicted
+        assert not env.kube.nodes()
+        assert not env.cloud.list()
+
+    def test_terminal_pods_do_not_block(self):
+        # suite_test.go:339
+        env = Environment(
+            types=[make_instance_type("c8", cpu=8, memory=32 * GIB)]
+        )
+        env.kube.create(mk_nodepool("p"))
+        pod = _pod("done")
+        env.provision(pod)
+        env.kube.get_pod("default", "done").status.phase = "Succeeded"
+        env.kube.delete(env.kube.node_claims()[0])
+        env.reconcile_termination()
+        assert not env.kube.nodes()
+
+
+class TestTGPForce:
+    def test_do_not_disrupt_pod_force_evicted_past_deadline(self):
+        # terminator.go:140-180: TGP enforcement bypasses both PDBs and
+        # do-not-disrupt once the node deadline passes
+        env = Environment(
+            types=[make_instance_type("c8", cpu=8, memory=32 * GIB)]
+        )
+        env.kube.create(mk_nodepool("p"))
+        pod = _pod("sticky")
+        pod.metadata.annotations["karpenter.sh/do-not-disrupt"] = "true"
+        env.provision(pod)
+        claim = env.kube.node_claims()[0]
+        now = time.time()
+        claim.metadata.annotations[
+            NODECLAIM_TERMINATION_TIMESTAMP_ANNOTATION
+        ] = str(now + 60)
+        env.kube.delete(claim, now=now)
+        env.reconcile_termination(now=now + 1)
+        assert env.kube.nodes()  # blocked before the deadline
+        env.reconcile_termination(now=now + 61)
+        assert not env.kube.nodes()
+
+    def test_rider_pod_rebirthed_when_node_dies(self):
+        # review regression: a tolerating pod must not survive as a
+        # ghost bound to a deleted node — it dies with the node and its
+        # controller-owned replacement comes back pending
+        env = Environment(
+            types=[make_instance_type("c8", cpu=8, memory=32 * GIB)]
+        )
+        env.kube.create(mk_nodepool("p"))
+        rider = _pod("rider", tolerations=[
+            Toleration(key=DISRUPTED_NO_SCHEDULE_TAINT.key,
+                       operator="Exists")
+        ])
+        env.provision(rider)
+        env.kube.delete(env.kube.node_claims()[0])
+        env.reconcile_termination()
+        assert not env.kube.nodes()
+        reborn = env.kube.get_pod("default", "rider")
+        assert reborn is not None
+        assert not reborn.spec.node_name  # pending again, not a ghost
