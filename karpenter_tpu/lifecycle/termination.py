@@ -186,11 +186,8 @@ class TerminationController:
         # role in a real cluster; controller-owned ones are reborn
         # pending so the workload replica is recreated
         for pod in list(self.kube.pods_on_node(node.metadata.name)):
-            if pod.is_terminal():
-                continue
-            self.kube.delete(pod, now=now)
-            if pod.owner_kind() != "DaemonSet":
-                self.kube.create(rebirth_pod(pod))
+            if not pod.is_terminal():
+                self.queue.evict(pod, now=now, force=True)
         # drop the finalizer; the nodeclaim finalizer performs the
         # instance delete once the node object is gone
         self.kube.remove_finalizer(node, TERMINATION_FINALIZER)
@@ -216,14 +213,21 @@ class TerminationController:
         )
         return float(raw) if raw else None
 
-    def _drain(self, node: Node, deadline: Optional[float], now: float) -> list[Pod]:
-        """Evict one wave at a time; returns pods still on the node
-        that block completion."""
-        pods = [
+    def _blocking_pods(self, node: Node) -> list[Pod]:
+        """Pods whose presence blocks drain completion: live, and not
+        riding the node down via a disrupted-taint toleration."""
+        return [
             p
             for p in self.kube.pods_on_node(node.metadata.name)
             if not p.is_terminal() and not _tolerates_disrupted(p)
         ]
+
+    def _drain(self, node: Node, deadline: Optional[float], now: float) -> list[Pod]:
+        """Evict one wave at a time; returns pods still on the node
+        that block completion. Like the reference (terminator.go
+        Drain), the first non-empty wave gates the rest — a
+        do-not-disrupt pod in it stalls drain until the TGP deadline."""
+        pods = self._blocking_pods(node)
         waves = _drain_waves([p for p in pods if not p.is_terminating()])
         if waves:
             force = deadline is not None and now >= deadline
@@ -235,10 +239,7 @@ class TerminationController:
                     continue
                 # TGP enforcement bypasses PDBs (terminator.go:140)
                 self.queue.evict(pod, now=now, force=force)
-        return [
-            p for p in self.kube.pods_on_node(node.metadata.name)
-            if not p.is_terminal() and not _tolerates_disrupted(p)
-        ]
+        return self._blocking_pods(node)
 
     def _volumes_detached(self, node: Node) -> bool:
         for pv in self.kube.list("PersistentVolume"):

@@ -6,10 +6,15 @@ time (mutating the in-memory pod only):
 
   1. drop preferred node-affinity terms
   2. drop one required node-affinity term (they are ORed; the scheduler
-     only considers the first, so removing it surfaces the next)
+     only considers the first, so removing it surfaces the next) — the
+     FINAL term is never relaxed (preferences.go:70-76)
   3. drop ScheduleAnyway topology-spread constraints
   4. drop preferred pod affinity, then preferred anti-affinity
-  5. tolerate PreferNoSchedule taints (terminal rung)
+
+The reference's terminal rung (tolerate PreferNoSchedule taints,
+preferences.go:129-141) has no analogue here because this build's
+`tolerates` never blocks on PreferNoSchedule in the first place
+(scheduling/taints.py) — same outcome, no relaxation round needed.
 
 Returns True if something was relaxed (caller retries), False when the
 ladder is exhausted.

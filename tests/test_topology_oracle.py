@@ -561,3 +561,63 @@ class TestEligibleDomainMinimum:
         for plan in res.new_node_plans:
             if any(p.metadata.name == "excl" for p in plan.pods):
                 assert plan.offerings[0].zone != "test-zone-1"
+
+
+class TestPreferentialFallback:
+    def test_final_required_term_never_relaxed(self):
+        # suite_test.go:2198 "should not relax the final term"
+        pod = mk_pod(name="stuck", cpu=0.5)
+        pod.spec.affinity = Affinity(
+            node_affinity=NodeAffinity(
+                required=(
+                    NodeSelectorTerm(
+                        match_expressions=(
+                            NodeSelectorRequirement(
+                                TOPOLOGY_ZONE_LABEL, "In", ("invalid-zone",)
+                            ),
+                        )
+                    ),
+                )
+            )
+        )
+        res, _ = solve([pod])
+        assert res.scheduled_count == 0
+        assert len(res.errors) == 1
+
+    def test_or_term_relaxation_surfaces_next_term(self):
+        # suite_test.go:2196 Required family: the first OR term is
+        # impossible; dropping it surfaces the satisfiable second term
+        pod = mk_pod(name="fallback", cpu=0.5)
+        pod.spec.affinity = Affinity(
+            node_affinity=NodeAffinity(
+                required=(
+                    NodeSelectorTerm(
+                        match_expressions=(
+                            NodeSelectorRequirement(
+                                TOPOLOGY_ZONE_LABEL, "In", ("invalid-zone",)
+                            ),
+                        )
+                    ),
+                    NodeSelectorTerm(
+                        match_expressions=(
+                            NodeSelectorRequirement(
+                                TOPOLOGY_ZONE_LABEL, "In", ("test-zone-2",)
+                            ),
+                        )
+                    ),
+                )
+            )
+        )
+        res, _ = solve([pod])
+        assert res.scheduled_count == 1
+        assert set(domain_counts(res, TOPOLOGY_ZONE_LABEL)) == {"test-zone-2"}
+
+    def test_preference_policy_ignore_strips_preferences(self):
+        # suite_test.go:2371: with honor_preferences off, preferred
+        # terms are ignored outright
+        pods = []
+        for i in range(6):
+            pod = spread_pod(f"i-{i}", "ign", when="ScheduleAnyway")
+            pods.append(pod)
+        res, _ = solve(pods, honor_preferences=False)
+        assert res.scheduled_count == 6
