@@ -82,6 +82,9 @@ class KwokCloudProvider(CloudProvider):
         self._instances: dict[str, _Instance] = {}  # provider id -> instance
         self._counter = itertools.count(1)
         self._repair_policies: list = []
+        # chaos hook (parity with the fake provider's error injection,
+        # fake/cloudprovider.go): the next create() raises this once
+        self.next_create_error: Optional[Exception] = None
 
     def restore(self) -> int:
         """Rehydrate instance state from the store after a restart —
@@ -131,6 +134,9 @@ class KwokCloudProvider(CloudProvider):
 
     def create(self, node_claim: NodeClaim) -> NodeClaim:
         with self._lock:
+            if self.next_create_error is not None:
+                err, self.next_create_error = self.next_create_error, None
+                raise err
             reqs = Requirements(
                 Requirement(r.key, r.operator, r.values, r.min_values)
                 for r in node_claim.spec.requirements

@@ -421,23 +421,51 @@ class DisruptionEngine:
             return None
         # minimum prefix is 2: single-node consolidation handles the rest
         # (multinodeconsolidation.go:118-121)
-        lo, hi = 2, len(candidates)
-        best: Optional[Command] = None
         deadline = self.clock() + MULTI_NODE_TIMEOUT_SECONDS
-        while lo <= hi:
-            if self.clock() > deadline:
-                # out of time: keep the last valid command rather than
-                # discard the round (multinodeconsolidation.go:116-134)
-                log.warning("multi-node consolidation timed out; "
-                            "keeping best command so far")
-                break
-            mid = (lo + hi) // 2
-            cmd = self.compute_consolidation(candidates[:mid])
-            if cmd is not None:
-                best = cmd
-                lo = mid + 1
-            else:
-                hi = mid - 1
+        # The valid-prefix set is NOT monotone: replacing 2 small nodes
+        # can cost more than their price while replacing all 3 is
+        # cheaper (the shared replacement amortizes). The reference's
+        # binary search assumes monotonicity and misses such merges;
+        # each probe here is one batched device solve, so we probe the
+        # FULL prefix first (the largest possible saving), fall back to
+        # the reference-style binary search, and finish with a
+        # descending sweep over prefixes neither covered — all under
+        # the method's wall-clock bound.
+        best = self.compute_consolidation(candidates)
+        if best is None:
+            lo, hi = 2, len(candidates) - 1
+            probed = set()
+            timed_out = False
+            while lo <= hi:
+                if self.clock() > deadline:
+                    log.warning("multi-node consolidation timed out; "
+                                "keeping best command so far")
+                    timed_out = True
+                    break
+                mid = (lo + hi) // 2
+                probed.add(mid)
+                cmd = self.compute_consolidation(candidates[:mid])
+                if cmd is not None:
+                    best = cmd
+                    lo = mid + 1
+                else:
+                    hi = mid - 1
+            # descending sweep over every prefix LARGER than what the
+            # binary search settled on: under non-monotonicity a bigger
+            # (more saving) merge can hide above a failing midpoint
+            best_n = len(best.candidates) if best is not None else 1
+            if not timed_out:
+                for n in range(len(candidates) - 1, best_n, -1):
+                    if n in probed:
+                        continue
+                    if self.clock() > deadline:
+                        log.warning("multi-node consolidation timed out "
+                                    "during prefix sweep; keeping best")
+                        break
+                    cmd = self.compute_consolidation(candidates[:n])
+                    if cmd is not None:
+                        best = cmd
+                        break
         if best is not None and len(best.candidates) >= 2:
             # same-instance-type guard (multinodeconsolidation.go:171-225):
             # don't churn N nodes into one identical node without savings

@@ -152,6 +152,12 @@ class KubeClient:
     def update(self, obj) -> object:
         """Write an object back; bumps resource version.
 
+        Optimistic concurrency (the API server's resourceVersion
+        precondition): writing a DIFFERENT object instance whose
+        resource version is older than the stored one is a conflict —
+        the caller read stale state and must re-read and retry.
+        In-place mutations of the canonical object (the common
+        single-process controller pattern here) are never stale.
         NodeClaim specs are immutable (nodeclaim.go:145 CEL rule).
         """
         with self._lock:
@@ -159,6 +165,14 @@ class KubeClient:
             existing = bucket.get(obj.key)
             if existing is None:
                 raise NotFoundError(f"{obj.kind} {obj.key}")
+            if existing is not obj and (
+                obj.metadata.resource_version < existing.metadata.resource_version
+            ):
+                raise ConflictError(
+                    f"{obj.kind} {obj.key}: stale resourceVersion "
+                    f"{obj.metadata.resource_version} < "
+                    f"{existing.metadata.resource_version}"
+                )
             if isinstance(obj, NodeClaim) and existing is not obj:
                 if repr(existing.spec) != repr(obj.spec):
                     raise InvalidError("NodeClaim spec is immutable")

@@ -1,0 +1,129 @@
+"""Chaos suite: failure injection across subsystem boundaries.
+
+Models the reference's chaos tier (test/suites/regression/chaos_test.go
+plus the fake provider's error hooks): operator restart in the middle
+of an active disruption command, provider create errors mid-burst, and
+registration flapping. The invariants are always the same — no capacity
+is leaked, no pod is stranded, and the system converges once the fault
+clears.
+"""
+
+import time
+
+from karpenter_tpu.apis.v1.labels import DISRUPTED_NO_SCHEDULE_TAINT
+from karpenter_tpu.cloudprovider.fake import GIB, make_instance_type
+from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
+from karpenter_tpu.cloudprovider.types import InsufficientCapacityError
+from karpenter_tpu.kube.client import KubeClient
+from karpenter_tpu.operator.operator import Operator
+from karpenter_tpu.testing import Environment, mk_nodepool, mk_pod
+
+
+def _types():
+    return [
+        make_instance_type("c2", cpu=2, memory=8 * GIB, price=2.0),
+        make_instance_type("c8", cpu=8, memory=32 * GIB, price=5.0),
+    ]
+
+
+class TestRestartMidDisruption:
+    def test_resumed_operator_recovers_tainted_fleet(self, tmp_path):
+        """Kill the operator after a consolidation command tainted its
+        candidates but before any deletion: the resumed process (fresh
+        queue, no in-memory command state) must un-taint the leftovers
+        and still converge the fleet."""
+        env = Environment(types=_types())
+        pool = mk_nodepool("default")
+        pool.spec.disruption.consolidate_after = "0s"
+        env.kube.create(pool)
+        pods = [mk_pod(name=f"w-{i}", cpu=1.5) for i in range(3)]
+        for pod in pods:
+            env.provision(pod)
+        assert len(env.kube.nodes()) == 3  # one c2 each
+        now = time.time() + 60
+        env.pod_events.reconcile_all(now=now)
+        env.conditions.reconcile_all(now=now)
+        # compute + start a command (taints candidates), then "crash"
+        # before the queue ever progresses it
+        command = env.disruption.reconcile(now=now)
+        assert command is not None
+        tainted = [
+            n for n in env.kube.nodes()
+            if any(t.key == DISRUPTED_NO_SCHEDULE_TAINT.key
+                   for t in n.spec.taints)
+        ]
+        assert tainted
+        path = str(tmp_path / "crash.ckpt")
+        env.kube.save(path)
+
+        # fresh process from the checkpoint: new operator, empty queue
+        kube2 = KubeClient.load(path)
+        cloud2 = KwokCloudProvider(kube2, types=_types())
+        cloud2.restore()
+        op2 = Operator(kube2, cloud2)
+        pool2 = kube2.get_node_pool("default")
+        pool2.spec.disruption.consolidate_after = "0s"
+        now2 = now + 30
+        for i in range(30):
+            op2.step(now=now2 + 6 * i)
+        # leftover taints cleared or nodes consolidated away; either
+        # way nothing stays wedged and every pod has a home
+        for node in kube2.nodes():
+            if node.metadata.deletion_timestamp is None:
+                assert not any(
+                    t.key == DISRUPTED_NO_SCHEDULE_TAINT.key
+                    for t in node.spec.taints
+                ), "resumed operator left a node wedged"
+        live = [p for p in kube2.pods() if not p.is_terminal()]
+        assert live and all(p.spec.node_name for p in live)
+
+
+class TestProviderErrors:
+    def test_create_error_mid_burst_retries_to_convergence(self):
+        kube = KubeClient()
+        cloud = KwokCloudProvider(kube, types=_types())
+        op = Operator(kube, cloud)
+        kube.create(mk_nodepool("general"))
+        for i in range(6):
+            kube.create(mk_pod(name=f"b-{i}", cpu=1.5))
+        cloud.next_create_error = InsufficientCapacityError("zone dry")
+        now = time.time()
+        op.provisioner.batcher.trigger(now=now)
+        for i in range(12):
+            op.step(now=now + 2 + 2 * i)
+        # ICE killed one claim; the pods re-provisioned onto fresh ones
+        live = [p for p in kube.pods() if not p.is_terminal()]
+        assert live and all(
+            p.spec.node_name for p in live
+        ), "pods stranded after ICE"
+        # no leaked instances: every cloud instance backs a live claim
+        claim_pids = {
+            c.status.provider_id for c in kube.node_claims()
+            if c.status.provider_id
+        }
+        assert {c.status.provider_id for c in cloud.list()} <= claim_pids
+
+
+class TestRegistrationFlap:
+    def test_slow_registration_does_not_runaway(self):
+        """A node that takes a long time to register must not trigger
+        runaway claim creation (chaos_test.go:48)."""
+        _now = [time.time()]
+        kube = KubeClient()
+        cloud = KwokCloudProvider(kube, types=_types(),
+                                  registration_delay=300.0,
+                                  clock=lambda: _now[0])
+        op = Operator(kube, cloud)
+        kube.create(mk_nodepool("general"))
+        kube.create(mk_pod(name="w", cpu=1.5))
+        for i in range(10):
+            _now[0] += 5
+            op.step(now=_now[0])
+        assert len(kube.node_claims()) == 1, "runaway scale-up"
+        # registration completes once the delay elapses
+        _now[0] += 400
+        for i in range(4):
+            _now[0] += 5
+            op.step(now=_now[0])
+        live = [p for p in kube.pods() if not p.is_terminal()]
+        assert live and all(p.spec.node_name for p in live)

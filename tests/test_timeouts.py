@@ -103,21 +103,54 @@ def _consolidatable_env(n_nodes: int) -> Environment:
 
 class TestConsolidationTimeouts:
     def test_multi_node_keeps_best_command_on_timeout(self):
-        env = _consolidatable_env(3)
+        env = _consolidatable_env(4)
         now = time.time() + 60
-        # untimed search merges all three c2 nodes
+        # untimed search merges all four c2 nodes (full-prefix probe)
         env.disruption.clock = FakeClock(step=0.0)
         full = env.disruption.multi_node_consolidation(now)
-        assert full is not None and len(full.candidates) == 3
+        assert full is not None and len(full.candidates) == 4
 
-        # rebuild conditions (the probe mutated nothing durable) and
-        # time out after the first probe: 40s/reading crosses the 60s
-        # deadline on the second loop check, keeping the first (2-node)
-        # valid command instead of discarding the round
+        # force the full prefix to fail so the binary search engages;
+        # clock readings advance 40s per probe check, so the deadline
+        # (60s) trips on the second loop check — the 2-node command
+        # found before it is kept instead of discarding the round
+        real = env.disruption.compute_consolidation
+        env.disruption.compute_consolidation = (
+            lambda c: None if len(c) == 4 else real(c)
+        )
         env.disruption.clock = FakeClock(step=40.0)
         partial = env.disruption.multi_node_consolidation(now)
+        env.disruption.compute_consolidation = real
         assert partial is not None
         assert len(partial.candidates) == 2
+
+    def test_non_monotone_merge_found_where_binary_search_fails(self):
+        """3 nodes at 1.5 cpu each on 2-cpu machines: the 2-node prefix
+        is NOT cheaper (replacement can't absorb both pods onto the
+        third node) but the 3-node merge onto one big machine is. The
+        reference's pure binary search misses this; the full-prefix
+        probe finds it."""
+        from karpenter_tpu.cloudprovider.fake import GIB, make_instance_type
+        from karpenter_tpu.testing import Environment, mk_nodepool, mk_pod
+
+        env = Environment(types=[
+            make_instance_type("c2", cpu=2, memory=8 * GIB, price=2.0),
+            make_instance_type("c8", cpu=8, memory=32 * GIB, price=5.0),
+        ])
+        pool = mk_nodepool("default")
+        pool.spec.disruption.consolidate_after = "0s"
+        env.kube.create(pool)
+        for i in range(3):
+            env.provision(mk_pod(name=f"w-{i}", cpu=1.5))
+        assert len(env.kube.nodes()) == 3
+        now = time.time() + 60
+        env.pod_events.reconcile_all(now=now)
+        env.conditions.reconcile_all(now=now)
+        # the 2-prefix really is invalid (premise of the test)
+        cands = env.disruption.get_candidates("Underutilized", now)
+        assert env.disruption.compute_consolidation(cands[:2]) is None
+        command = env.disruption.multi_node_consolidation(now)
+        assert command is not None and len(command.candidates) == 3
 
     def test_single_node_stops_on_timeout(self):
         env = Environment(types=_types())
