@@ -64,6 +64,11 @@ COMMAND_TIMEOUT_SECONDS = 10 * 60  # orchestration retry deadline (queue.go:86)
 # single-node stops mid-scan (singlenodeconsolidation.go:34)
 MULTI_NODE_TIMEOUT_SECONDS = 60.0
 SINGLE_NODE_TIMEOUT_SECONDS = 3 * 60.0
+# extra prefixes probed above the binary-search result (largest first):
+# the amortized-merge payoff concentrates just above the failing
+# midpoint, and an uncapped sweep would burn the whole timeout on O(N)
+# device solves every round when no larger merge exists
+MULTI_NODE_SWEEP_PROBES = 8
 
 
 @dataclass
@@ -455,13 +460,17 @@ class DisruptionEngine:
             # (more saving) merge can hide above a failing midpoint
             best_n = len(best.candidates) if best is not None else 1
             if not timed_out:
+                sweeps = 0
                 for n in range(len(candidates) - 1, best_n, -1):
                     if n in probed:
                         continue
+                    if sweeps >= MULTI_NODE_SWEEP_PROBES:
+                        break
                     if self.clock() > deadline:
                         log.warning("multi-node consolidation timed out "
                                     "during prefix sweep; keeping best")
                         break
+                    sweeps += 1
                     cmd = self.compute_consolidation(candidates[:n])
                     if cmd is not None:
                         best = cmd

@@ -355,8 +355,12 @@ def pack_flat(*args, max_nodes: int, mode: str = "ffd", quota=None,
     )
 
 
-# problem-shape signature -> node-axis bucket that fit last time
+# problem-shape signature -> node-axis bucket that fit last time.
+# Guarded by a lock: the cost objective runs its FFD and planned solves
+# on separate threads, and an unsynchronized clear-at-cap could drop a
+# sibling's just-remembered axis.
 _axis_memory: dict[tuple, int] = {}
+_axis_lock = __import__("threading").Lock()
 
 
 def _estimate_nodes(enc: Encoded) -> int:
@@ -474,7 +478,8 @@ def solve_packing(
     )
     axis_key = (G, C, total_pods, mode, plan is not None, reserved_p,
                 fingerprint)
-    remembered = _axis_memory.get(axis_key)
+    with _axis_lock:
+        remembered = _axis_memory.get(axis_key)
     if remembered is not None:
         max_nodes = remembered
     else:
@@ -497,15 +502,17 @@ def solve_packing(
         )
         if not capped or max_nodes > worst_case:
             if not capped:
-                if len(_axis_memory) > 256:
-                    _axis_memory.clear()
-                # remember a TIGHT axis derived from the actual node
-                # count, not the (possibly overgrown) bucket we used —
-                # the [N, C] work is linear in N, so next time pays for
-                # the nodes it needs plus headroom, nothing more
-                _axis_memory[axis_key] = _bucket(
-                    int(result.node_count * 1.15) + 16
-                )
+                with _axis_lock:
+                    if len(_axis_memory) > 256:
+                        _axis_memory.clear()
+                    # remember a TIGHT axis derived from the actual
+                    # node count, not the (possibly overgrown) bucket
+                    # we used — the [N, C] work is linear in N, so next
+                    # time pays for the nodes it needs plus headroom,
+                    # nothing more
+                    _axis_memory[axis_key] = _bucket(
+                        int(result.node_count * 1.15) + 16
+                    )
             return result
         # grow proportionally to observed density, not blind doubling:
         # a capped run tells us pods-per-node, so jump straight to the

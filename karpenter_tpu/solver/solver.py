@@ -197,14 +197,28 @@ def _decode_device(
     # a floor — the planner can only ever improve on the greedy
     # heuristic, never regress it (the LP's restricted pattern set can
     # be weak on small or degenerate demands).
+    #
+    # The FFD pack needs no plan, so it dispatches on a worker thread
+    # while the host runs column generation: the device crunches the
+    # greedy race while scipy solves the master LP — the two dominant
+    # costs of a 50k-pod solve overlap instead of serializing.
+    from concurrent.futures import ThreadPoolExecutor
+
     from karpenter_tpu.solver import lp_plan
 
-    plan = lp_plan.plan(enc)
-    candidates = []
-    ffd_result = _solve_packing(enc, mode="ffd", shards=shards)
-    candidates.append((ffd_result, _downsize_masks(enc, ffd_result)))
-    if plan is not None:
-        cost_result = _solve_packing(enc, mode="cost", plan=plan, shards=shards)
+    with ThreadPoolExecutor(max_workers=1) as executor:
+        ffd_future = executor.submit(
+            _solve_packing, enc, mode="ffd", shards=shards
+        )
+        plan = lp_plan.plan(enc)
+        cost_result = (
+            _solve_packing(enc, mode="cost", plan=plan, shards=shards)
+            if plan is not None
+            else None
+        )
+        ffd_result = ffd_future.result()
+    candidates = [(ffd_result, _downsize_masks(enc, ffd_result))]
+    if cost_result is not None:
         candidates.append((cost_result, _downsize_masks(enc, cost_result)))
 
     def key(item):
