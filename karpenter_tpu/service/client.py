@@ -48,7 +48,12 @@ class RemoteSolver:
         # circuit breaker: a routable-but-black-holed endpoint costs a
         # full deadline per RPC; after BREAKER_FAILURES consecutive
         # misses every solve goes straight local until the cooldown
-        # elapses, so provisioning never serializes repeated stalls
+        # elapses, so provisioning never serializes repeated stalls.
+        # Locked: the cost objective solves from two threads, and an
+        # interleaved failure count would keep the breaker from opening.
+        import threading
+
+        self._breaker_lock = threading.Lock()
         self._failures = 0
         self._skip_until = 0.0
 
@@ -60,22 +65,25 @@ class RemoteSolver:
             )
 
         now = time.monotonic()
-        if self.fallback_local and now < self._skip_until:
-            return local()
+        with self._breaker_lock:
+            if self.fallback_local and now < self._skip_until:
+                return local()
         request = codec.encode_request(enc, mode, max_nodes, shards, plan)
         try:
             response = self._solve(request, timeout=self.timeout)
-            self._failures = 0
+            with self._breaker_lock:
+                self._failures = 0
             return codec.decode_result(response)
         except Exception as err:
-            self._failures += 1
-            if self._failures >= BREAKER_FAILURES:
-                self._skip_until = now + BREAKER_COOLDOWN_SECONDS
-                log.warning(
-                    "solver service %s: %d consecutive failures; breaker "
-                    "open for %.0fs", self.endpoint, self._failures,
-                    BREAKER_COOLDOWN_SECONDS,
-                )
+            with self._breaker_lock:
+                self._failures += 1
+                if self._failures >= BREAKER_FAILURES:
+                    self._skip_until = now + BREAKER_COOLDOWN_SECONDS
+                    log.warning(
+                        "solver service %s: %d consecutive failures; "
+                        "breaker open for %.0fs", self.endpoint,
+                        self._failures, BREAKER_COOLDOWN_SECONDS,
+                    )
             if not self.fallback_local:
                 raise
             log.warning(

@@ -211,12 +211,17 @@ def _decode_device(
             _solve_packing, enc, mode="ffd", shards=shards
         )
         plan = lp_plan.plan(enc)
+        # join the FFD solve BEFORE dispatching the planned one: the
+        # overlap we want is device-vs-host (FFD kernel vs scipy LP);
+        # letting both kernels run concurrently would double peak
+        # device memory for no additional win (the LP almost always
+        # outlasts the FFD pack anyway)
+        ffd_result = ffd_future.result()
         cost_result = (
             _solve_packing(enc, mode="cost", plan=plan, shards=shards)
             if plan is not None
             else None
         )
-        ffd_result = ffd_future.result()
     candidates = [(ffd_result, _downsize_masks(enc, ffd_result))]
     if cost_result is not None:
         candidates.append((cost_result, _downsize_masks(enc, cost_result)))

@@ -1,0 +1,154 @@
+"""NodeOverlay oracle suite, ported from the reference's nodeoverlay
+suite_test.go families: price adjustments (absolute and percentage),
+capacity injection, requirement-scoped application, multi-overlay
+weight resolution, and non-overlapping coexistence.
+"""
+
+import pytest
+
+from karpenter_tpu.apis.v1alpha1.nodeoverlay import (
+    COND_OVERLAY_VALIDATION,
+    NodeOverlay,
+    NodeOverlayController,
+    NodeOverlaySpec,
+    OverlayCloudProvider,
+    adjusted_price,
+)
+from karpenter_tpu.apis.v1.nodeclaim import RequirementSpec
+from karpenter_tpu.cloudprovider.fake import (
+    GIB,
+    FakeCloudProvider,
+    make_instance_type,
+)
+from karpenter_tpu.kube.client import KubeClient
+from karpenter_tpu.kube.objects import ObjectMeta
+
+
+def _types():
+    return [
+        make_instance_type("small", cpu=2, memory=8 * GIB, price=1.0),
+        make_instance_type("big", cpu=16, memory=64 * GIB, price=8.0,
+                           arch="arm64"),
+    ]
+
+
+def _store(*overlays):
+    kube = KubeClient()
+    for i, ov in enumerate(overlays):
+        if not ov.metadata.name or ov.metadata.name.startswith("pool-"):
+            ov.metadata.name = f"ov-{i}"
+        kube.create(ov)
+    provider = OverlayCloudProvider(FakeCloudProvider(_types()), kube)
+    NodeOverlayController(kube, provider).reconcile()
+    return kube, provider
+
+
+def _prices(provider, name):
+    return sorted(
+        o.price for it in provider.get_instance_types(None)
+        if it.name == name for o in it.offerings
+    )
+
+
+class TestPriceAdjustments:
+    def test_zero_overlays_identity(self):
+        # suite_test.go:114
+        kube, provider = _store()
+        base = FakeCloudProvider(_types())
+        assert _prices(provider, "small") == sorted(
+            o.price for it in base.get_instance_types(None)
+            if it.name == "small" for o in it.offerings
+        )
+
+    @pytest.mark.parametrize("change,base,expected", [
+        ("+0.5", 1.0, 1.5),
+        ("-0.25", 1.0, 0.75),
+        ("+50%", 2.0, 3.0),
+        ("-10%", 2.0, 1.8),
+    ])
+    def test_adjustment_math(self, change, base, expected):
+        # types.go:369-401 AdjustedPrice
+        assert adjusted_price(base, change) == pytest.approx(expected)
+
+    def test_adjustment_never_negative(self):
+        assert adjusted_price(1.0, "-5.0") == 0.0
+
+    def test_percentage_adjustment_applies_through_provider(self):
+        kube, provider = _store(
+            NodeOverlay(spec=NodeOverlaySpec(price_adjustment="-50%"))
+        )
+        base = sorted(
+            o.price for it in FakeCloudProvider(_types()).get_instance_types(None)
+            if it.name == "small" for o in it.offerings
+        )
+        got = _prices(provider, "small")
+        assert got == pytest.approx([p * 0.5 for p in base])
+
+
+class TestRequirementScoping:
+    def test_overlay_applies_only_to_selected_types(self):
+        # suite_test.go:1825/1989: requirement-scoped overlays leave
+        # non-matching types untouched
+        overlay = NodeOverlay(spec=NodeOverlaySpec(
+            price="0.05",
+            requirements=[RequirementSpec(
+                key="kubernetes.io/arch", operator="In", values=("arm64",)
+            )],
+        ))
+        kube, provider = _store(overlay)
+        assert set(_prices(provider, "big")) == {0.05}
+        assert 0.05 not in set(_prices(provider, "small"))
+
+
+class TestCapacityInjection:
+    def test_capacity_adds_extended_resource(self):
+        # suite_test.go:2017
+        overlay = NodeOverlay(spec=NodeOverlaySpec(
+            capacity={"example.com/accelerator": 2.0},
+        ))
+        kube, provider = _store(overlay)
+        for it in provider.get_instance_types(None):
+            assert it.capacity.get("example.com/accelerator") == 2.0
+
+    def test_capacity_from_multiple_nonconflicting_overlays(self):
+        # suite_test.go:2047: disjoint capacity keys both apply
+        a = NodeOverlay(metadata=ObjectMeta(name="a"), spec=NodeOverlaySpec(
+            capacity={"example.com/a": 1.0}))
+        b = NodeOverlay(metadata=ObjectMeta(name="b"), spec=NodeOverlaySpec(
+            capacity={"example.com/b": 2.0}))
+        kube, provider = _store(a, b)
+        assert a.status_conditions.is_true(COND_OVERLAY_VALIDATION)
+        assert b.status_conditions.is_true(COND_OVERLAY_VALIDATION)
+        for it in provider.get_instance_types(None):
+            assert it.capacity.get("example.com/a") == 1.0
+            assert it.capacity.get("example.com/b") == 2.0
+
+
+class TestWeightResolution:
+    def test_higher_weight_wins_price(self):
+        # suite_test.go:2218
+        low = NodeOverlay(metadata=ObjectMeta(name="low"),
+                          spec=NodeOverlaySpec(weight=1, price="2.0"))
+        high = NodeOverlay(metadata=ObjectMeta(name="high"),
+                           spec=NodeOverlaySpec(weight=9, price="0.5"))
+        kube, provider = _store(low, high)
+        assert set(_prices(provider, "small")) == {0.5}
+
+    def test_mutually_exclusive_requirements_both_apply(self):
+        # suite_test.go:898: same weight, disjoint selectors -> no
+        # conflict, each scope gets its own price
+        amd = NodeOverlay(metadata=ObjectMeta(name="amd"), spec=NodeOverlaySpec(
+            weight=5, price="0.1",
+            requirements=[RequirementSpec(
+                key="kubernetes.io/arch", operator="In", values=("amd64",))],
+        ))
+        arm = NodeOverlay(metadata=ObjectMeta(name="arm"), spec=NodeOverlaySpec(
+            weight=5, price="0.2",
+            requirements=[RequirementSpec(
+                key="kubernetes.io/arch", operator="In", values=("arm64",))],
+        ))
+        kube, provider = _store(amd, arm)
+        assert amd.status_conditions.is_true(COND_OVERLAY_VALIDATION)
+        assert arm.status_conditions.is_true(COND_OVERLAY_VALIDATION)
+        assert set(_prices(provider, "small")) == {0.1}
+        assert set(_prices(provider, "big")) == {0.2}
