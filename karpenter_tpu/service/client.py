@@ -64,10 +64,13 @@ class RemoteSolver:
                 enc, max_nodes=max_nodes, mode=mode, plan=plan, shards=shards
             )
 
-        now = time.monotonic()
         with self._breaker_lock:
-            if self.fallback_local and now < self._skip_until:
-                return local()
+            # only the STATE read happens under the lock — the local
+            # solve must run outside it or concurrent solves serialize
+            # on one breaker for multiple seconds each
+            skip = self.fallback_local and time.monotonic() < self._skip_until
+        if skip:
+            return local()
         request = codec.encode_request(enc, mode, max_nodes, shards, plan)
         try:
             response = self._solve(request, timeout=self.timeout)
@@ -78,7 +81,12 @@ class RemoteSolver:
             with self._breaker_lock:
                 self._failures += 1
                 if self._failures >= BREAKER_FAILURES:
-                    self._skip_until = now + BREAKER_COOLDOWN_SECONDS
+                    # cooldown from NOW, not from before the RPC — a
+                    # deadline-miss failure burns the timeout first and
+                    # must still keep the breaker open a full cooldown
+                    self._skip_until = (
+                        time.monotonic() + BREAKER_COOLDOWN_SECONDS
+                    )
                     log.warning(
                         "solver service %s: %d consecutive failures; "
                         "breaker open for %.0fs", self.endpoint,
