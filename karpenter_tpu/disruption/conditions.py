@@ -95,11 +95,9 @@ class DisruptionConditionsController:
             if claim_hash != pool.hash():
                 return "NodePoolDrifted"
         # dynamic drift: claim labels must still satisfy pool requirements
-        pool_reqs = Requirements()
-        for spec in pool.spec.template.spec.requirements:
-            pool_reqs.add(Requirement(spec.key, spec.operator, spec.values))
-        for key, value in pool.spec.template.labels.items():
-            pool_reqs.add(Requirement(key, "In", [value]))
+        from karpenter_tpu.solver.encode import pool_template_requirements
+
+        pool_reqs = pool_template_requirements(pool)
         claim_reqs = Requirements.from_labels(claim.metadata.labels)
         if claim_reqs.intersects(pool_reqs) is not None:
             return "RequirementsDrifted"

@@ -36,6 +36,7 @@ from __future__ import annotations
 
 import functools
 import os
+import threading
 from dataclasses import dataclass
 
 import jax
@@ -360,7 +361,7 @@ def pack_flat(*args, max_nodes: int, mode: str = "ffd", quota=None,
 # on separate threads, and an unsynchronized clear-at-cap could drop a
 # sibling's just-remembered axis.
 _axis_memory: dict[tuple, int] = {}
-_axis_lock = __import__("threading").Lock()
+_axis_lock = threading.Lock()
 
 
 def _estimate_nodes(enc: Encoded) -> int:

@@ -258,3 +258,71 @@ class TestLeftoverTaints:
             t.key == DISRUPTED_NO_SCHEDULE_TAINT.key for t in fresh.spec.taints
         )
         assert not env.cluster.nodes()[0].marked_for_deletion
+
+
+class TestDriftTriggers:
+    def test_requirements_drift(self):
+        # drift.go:50-185 dynamic drift: tightening the pool's
+        # requirements so the live claim's labels no longer satisfy
+        # them marks it Drifted
+        from karpenter_tpu.apis.v1.nodeclaim import COND_DRIFTED, RequirementSpec
+
+        env, _ = _env()
+        pool = env.kube.get_node_pool("default")
+        claim = env.kube.node_claims()[0]
+        arch = claim.metadata.labels.get("kubernetes.io/arch", "amd64")
+        other = "arm64" if arch == "amd64" else "amd64"
+        pool.spec.template.spec.requirements = [
+            RequirementSpec(key="kubernetes.io/arch", operator="In",
+                            values=(other,))
+        ]
+        env.conditions.reconcile_all()
+        assert claim.status_conditions.is_true(COND_DRIFTED)
+
+    def test_drift_condition_clears_when_resolved(self):
+        from karpenter_tpu.apis.v1.nodeclaim import COND_DRIFTED
+
+        env, _ = _env()
+        claim = env.kube.node_claims()[0]
+        env.cloud.is_drifted = lambda c: "ImageDrift"
+        env.conditions.reconcile_all()
+        assert claim.status_conditions.is_true(COND_DRIFTED)
+        env.cloud.is_drifted = lambda c: ""
+        env.conditions.reconcile_all()
+        assert not claim.status_conditions.is_true(COND_DRIFTED)
+
+
+class TestValidationRollback:
+    def test_pdb_appearing_mid_command_rolls_back(self):
+        # validation.go:152-280: the 15s revalidation catches state
+        # that churned since the command was computed — a new blocking
+        # PDB must roll the command back (un-taint, unmark) instead of
+        # evicting through it
+        env, pods = _env(n_pods=1, cpu=0.5, labels={"app": "w"})
+        # make the single node consolidatable: pin it to an oversized
+        # type first (as in the timeout suite) is overkill; instead
+        # delete the pod so emptiness picks the node up
+        env.kube.delete(env.kube.get_pod("default", pods[0].metadata.name))
+        now = time.time() + 60
+        env.pod_events.reconcile_all(now=now)
+        env.conditions.reconcile_all(now=now)
+        command = env.disruption.reconcile(now=now)
+        assert command is not None
+        # a new pod with a fully blocking PDB lands on the candidate
+        blocker = mk_pod(name="late", cpu=0.2, labels={"app": "w"})
+        env.kube.create(blocker)
+        env.kube.bind_pod(blocker, command.candidates[0].state_node.name)
+        env.kube.create(PodDisruptionBudget(
+            metadata=ObjectMeta(name="late-pdb"),
+            spec=PodDisruptionBudgetSpec(
+                selector=LabelSelector.of({"app": "w"}), max_unavailable=0
+            ),
+        ))
+        env.disruption.queue.reconcile(now=now + 16)
+        # rolled back: node survives, taint removed, pod untouched
+        node = env.kube.nodes()[0]
+        assert node.metadata.deletion_timestamp is None
+        assert not any(
+            t.key == DISRUPTED_NO_SCHEDULE_TAINT.key for t in node.spec.taints
+        )
+        assert env.kube.get_pod("default", "late") is not None
