@@ -110,6 +110,8 @@ def pack(
     R = group_req.shape[1]
     E = existing_mask.shape[0]
     N = max_nodes
+    if quota is not None:
+        quota = quota.astype(jnp.int32)  # shipped int16, compared int32
 
     node_mask = jnp.zeros((N, C), bool).at[:E].set(existing_mask)
     node_used = jnp.zeros((N, R), jnp.float32).at[:E].set(existing_used)
@@ -332,26 +334,34 @@ def pack(
 @functools.partial(jax.jit, static_argnames=("max_nodes", "mode"))
 def pack_flat(*args, max_nodes: int, mode: str = "ffd", quota=None,
               cfg_rsv=None, rsv_cap=None, group_cap=None, conflict=None):
-    """`pack` with every output concatenated into ONE float32 vector.
+    """`pack` with the outputs fused into ONE compact uint32 vector.
 
     The remote-device transport charges a fixed latency per
     device-to-host fetch of a fresh array (~70ms through the axon
-    tunnel); fusing the six outputs into one buffer makes each solve
-    pay that latency exactly once.
+    tunnel) plus bandwidth per byte; one buffer pays the latency once,
+    and the buffer carries only what the host cannot recompute:
+    `assign` counts, the node config masks bit-packed 32 columns per
+    word, `node_count`, and the unschedulable tally. `node_used` and
+    `node_active` are derived host-side from `assign` (see the fetch
+    closure in `_run_pack`) — shipping them would quadruple the payload.
     """
     assign, node_mask, node_used, node_active, node_count, unsched = pack(
         *args, max_nodes=max_nodes, mode=mode, quota=quota,
         cfg_rsv=cfg_rsv, rsv_cap=rsv_cap, group_cap=group_cap,
         conflict=conflict,
     )
+    n, cp = node_mask.shape
+    words = cp // 32  # _run_pack pads the config axis to a 32-multiple
+    packed = (
+        node_mask.reshape(n, words, 32).astype(jnp.uint32)
+        << jnp.arange(32, dtype=jnp.uint32)[None, None, :]
+    ).sum(axis=-1, dtype=jnp.uint32)
     return jnp.concatenate(
         [
-            assign.astype(jnp.float32).ravel(),
-            node_mask.astype(jnp.float32).ravel(),
-            node_used.ravel(),
-            node_active.astype(jnp.float32).ravel(),
-            jnp.asarray([node_count], jnp.float32),
-            unsched.astype(jnp.float32).ravel(),
+            assign.astype(jnp.uint32).ravel(),
+            packed.ravel(),
+            node_count.astype(jnp.uint32)[None],
+            unsched.astype(jnp.uint32).ravel(),
         ]
     )
 
@@ -388,7 +398,40 @@ def solve_packing(
     enc: Encoded, max_nodes: int = 0, mode: str = "ffd", plan=None,
     shards: int = 0,
 ) -> PackResult:
-    """Host entry: run the packing kernel on the encoded problem.
+    """Host entry: run the packing kernel on the encoded problem."""
+    return solve_packing_async(
+        enc, max_nodes=max_nodes, mode=mode, plan=plan, shards=shards
+    ).result()
+
+
+class PendingPack:
+    """A dispatched-but-unfetched device solve.
+
+    `result()` blocks on the device buffer, decodes it, and — if the
+    node axis proved too small — re-runs synchronously with a larger
+    axis. Dispatching without fetching lets the caller overlap host
+    work (column generation, decoding a sibling solve) with the kernel:
+    the cost objective dispatches FFD, prices columns on the host while
+    the device packs, dispatches the planned solve, then decodes the
+    FFD result while the second kernel runs.
+    """
+
+    def __init__(self, fetch):
+        self._fetch = fetch
+        self._result: PackResult | None = None
+
+    def result(self) -> PackResult:
+        if self._result is None:
+            self._result = self._fetch()
+        return self._result
+
+
+def solve_packing_async(
+    enc: Encoded, max_nodes: int = 0, mode: str = "ffd", plan=None,
+    shards: int = 0,
+) -> PendingPack:
+    """`solve_packing` that returns immediately after dispatching the
+    first kernel attempt; see PendingPack.
 
     With `max_nodes` unset, the node axis is sized from a per-group
     capacity estimate (or the axis remembered from the last solve of
@@ -456,9 +499,11 @@ def solve_packing(
     reserved_p = _pad_axis(reserved) if reserved else 0
 
     if max_nodes > 0:
-        return _run_pack(
-            enc, existing_mask, existing_used,
-            max_nodes + (reserved_p - reserved), mode, quota, shards,
+        return PendingPack(
+            _run_pack(
+                enc, existing_mask, existing_used,
+                max_nodes + (reserved_p - reserved), mode, quota, shards,
+            )
         )
 
     total_pods = int(enc.group_count.sum())
@@ -494,40 +539,53 @@ def solve_packing(
                 min(max_nodes, reserved_p + max(64, total_pods))
             )
     worst_case = reserved_p + total_pods
-    while True:
-        result = _run_pack(
-            enc, existing_mask, existing_used, max_nodes, mode, quota, shards
-        )
-        capped = (
-            result.node_count >= max_nodes and result.unschedulable.sum() > 0
-        )
-        if not capped or max_nodes > worst_case:
-            if not capped:
-                with _axis_lock:
-                    if len(_axis_memory) > 256:
-                        _axis_memory.clear()
-                    # remember a TIGHT axis derived from the actual
-                    # node count, not the (possibly overgrown) bucket
-                    # we used — the [N, C] work is linear in N, so next
-                    # time pays for the nodes it needs plus headroom,
-                    # nothing more
-                    _axis_memory[axis_key] = _bucket(
-                        int(result.node_count * 1.15) + 16
-                    )
-            return result
-        # grow proportionally to observed density, not blind doubling:
-        # a capped run tells us pods-per-node, so jump straight to the
-        # bucket that should hold the rest
-        scheduled = total_pods - int(result.unschedulable.sum())
-        if scheduled > 0:
-            needed = int(result.node_count * total_pods / scheduled * 1.2)
-        else:
-            needed = max_nodes * 2
-        # clamped: one node holds >= one pod, so worst_case is the
-        # provable maximum — an extrapolation from a tiny scheduled
-        # prefix must not force an absurd static shape
-        needed = min(needed, worst_case + 1)
-        max_nodes = _bucket(max(needed, max_nodes + 1))
+    pending = _run_pack(
+        enc, existing_mask, existing_used, max_nodes, mode, quota, shards
+    )
+
+    def fetch() -> PackResult:
+        nonlocal pending, max_nodes
+        while True:
+            result = pending()
+            capped = (
+                result.node_count >= max_nodes
+                and result.unschedulable.sum() > 0
+            )
+            if not capped or max_nodes > worst_case:
+                if not capped:
+                    with _axis_lock:
+                        if len(_axis_memory) > 256:
+                            _axis_memory.clear()
+                        # remember a TIGHT axis derived from the actual
+                        # node count, not the (possibly overgrown)
+                        # bucket we used — the [N, C] work is linear in
+                        # N, so next time pays for the nodes it needs
+                        # plus headroom, nothing more
+                        _axis_memory[axis_key] = _bucket(
+                            int(result.node_count * 1.15) + 16
+                        )
+                return result
+            # grow proportionally to observed density, not blind
+            # doubling: a capped run tells us pods-per-node, so jump
+            # straight to the bucket that should hold the rest
+            scheduled = total_pods - int(result.unschedulable.sum())
+            if scheduled > 0:
+                needed = int(
+                    result.node_count * total_pods / scheduled * 1.2
+                )
+            else:
+                needed = max_nodes * 2
+            # clamped: one node holds >= one pod, so worst_case is the
+            # provable maximum — an extrapolation from a tiny scheduled
+            # prefix must not force an absurd static shape
+            needed = min(needed, worst_case + 1)
+            max_nodes = _bucket(max(needed, max_nodes + 1))
+            pending = _run_pack(
+                enc, existing_mask, existing_used, max_nodes, mode, quota,
+                shards,
+            )
+
+    return PendingPack(fetch)
 
 
 def _bucket(n: int) -> int:
@@ -556,14 +614,19 @@ def _run_pack(
     mode: str = "ffd",
     quota: np.ndarray | None = None,
     shards: int = 0,
-) -> PackResult:
+):
+    """Dispatch one kernel attempt; returns a zero-arg callable that
+    blocks on the device buffer and decodes it into a PackResult."""
+    import math
+
     G, C = enc.compat.shape
     R = enc.group_req.shape[1]
     E = existing_mask.shape[0]
     Gp, Cp, Ep = _pad_axis(G), _pad_axis(C), _pad_axis(E) if E else 0
-    if shards > 1:
-        # the sharded axis must divide evenly across the mesh
-        Cp = -(-Cp // shards) * shards
+    # the config axis must split evenly over the mesh AND pack evenly
+    # into the 32-bit mask words of the flat output
+    step = math.lcm(32, shards) if shards > 1 else 32
+    Cp = -(-Cp // step) * step
     N = max_nodes
 
     compat = np.zeros((Gp, Cp), bool)
@@ -586,15 +649,21 @@ def _run_pack(
 
     quota_full = None
     if quota is not None or enc.group_cap is not None:
-        quota_full = np.full((N, Gp), np.iinfo(np.int32).max, np.int32)
+        # int16 on the wire: per-node pod counts are bounded by the
+        # 'pods' capacity (hundreds), so 32767 is an honest "no cap"
+        # sentinel at half the transfer bytes; the kernel widens back
+        # to int32 before comparing.
+        quota_full = np.full((N, Gp), np.int16(32767), np.int16)
         if enc.group_cap is not None:
             # per-node caps apply to every node slot, fresh ones included
             quota_full[:, :G] = np.minimum(
-                quota_full[:, :G], enc.group_cap[None, :].astype(np.int32)
+                quota_full[:, :G],
+                np.minimum(enc.group_cap, 32767)[None, :].astype(np.int16),
             )
         if quota is not None:
             quota_full[: quota.shape[0], :G] = np.minimum(
-                quota[:, :G], quota_full[: quota.shape[0], :G]
+                np.minimum(quota[:, :G], 32767).astype(np.int16),
+                quota_full[: quota.shape[0], :G],
             )
         quota_full = jnp.asarray(quota_full)
     group_cap_full = None
@@ -652,7 +721,7 @@ def _run_pack(
             group_cap_full = jax.device_put(group_cap_full, replicated)
         if conflict_full is not None:
             conflict_full = jax.device_put(conflict_full, replicated)
-    flat = pack_flat(
+    flat_dev = pack_flat(
         compat_j,
         rest["group_req"],
         rest["group_count"],
@@ -670,19 +739,53 @@ def _run_pack(
         group_cap=group_cap_full,
         conflict=conflict_full,
     )
-    flat = np.asarray(flat)  # the one device->host fetch
-    o0, o1, o2, o3, o4 = (
-        N * Gp,
-        N * Gp + N * Cp,
-        N * Gp + N * Cp + N * R,
-        N * Gp + N * Cp + N * R + N,
-        N * Gp + N * Cp + N * R + N + 1,
-    )
-    return PackResult(
-        assign=flat[:o0].reshape(N, Gp)[:, :G].astype(np.int32),
-        node_mask=flat[o0:o1].reshape(N, Cp)[:, :C] > 0.5,
-        node_used=flat[o1:o2].reshape(N, R),
-        node_active=flat[o2:o3] > 0.5,
-        node_count=int(flat[o3]),
-        unschedulable=flat[o4:][:G].astype(np.int32),
-    )
+    # dispatch returned immediately (async device execution); capture
+    # only host arrays in the closure so the fetch can rebuild what the
+    # compact buffer leaves out
+    W = Cp // 32
+    emask_any = emask.any(axis=1) if Ep else np.zeros((0,), bool)
+    group_req_h = enc.group_req.astype(np.float32)
+    pool_overhead_h = enc.pool_overhead
+    cfg_pool_h = cfg_pool  # host copy, padded
+
+    def fetch() -> PackResult:
+        flat = np.asarray(flat_dev)  # the one device->host fetch
+        o0 = N * Gp
+        o1 = o0 + N * W
+        assign = flat[:o0].reshape(N, Gp)[:, :G].astype(np.int32)
+        words = np.ascontiguousarray(flat[o0:o1].reshape(N, W))
+        bits = np.unpackbits(
+            words.view(np.uint8).reshape(N, W * 4), axis=1, bitorder="little"
+        )
+        node_mask = bits[:, :C].astype(bool)
+        node_count = int(flat[o1])
+        unsched = flat[o1 + 1 : o1 + 1 + Gp][:G].astype(np.int32)
+        # node_active / node_used are pure functions of the shipped
+        # state: active = holds pods or is a live existing slot;
+        # used = base (existing usage / fresh pool overhead) + the
+        # placed pods' requests. All addends are the same float32
+        # values the kernel accumulated, so fits-checks downstream see
+        # identical numbers modulo summation order (covered by the
+        # 1e-4 epsilon the kernel itself uses).
+        node_active = assign.sum(axis=1) > 0
+        if Ep:
+            node_active[:Ep] |= emask_any
+        base = np.zeros((N, R), np.float32)
+        if Ep:
+            base[:Ep] = eused
+        fresh = node_active.copy()
+        fresh[:Ep] = False
+        if fresh.any():
+            first_col = node_mask[fresh].argmax(axis=1)
+            base[fresh] = pool_overhead_h[cfg_pool_h[first_col]]
+        node_used = base + assign.astype(np.float32) @ group_req_h
+        return PackResult(
+            assign=assign,
+            node_mask=node_mask,
+            node_used=node_used,
+            node_active=node_active,
+            node_count=node_count,
+            unschedulable=unsched,
+        )
+
+    return fetch

@@ -121,28 +121,52 @@ _remote_solver = None
 _remote_lock = __import__("threading").Lock()
 
 
+def _remote_client():
+    global _remote_solver
+    from karpenter_tpu.service.client import endpoint_from_env
+
+    endpoint = endpoint_from_env()
+    if not endpoint:
+        return None
+    with _remote_lock:
+        if _remote_solver is None or _remote_solver.endpoint != endpoint:
+            from karpenter_tpu.service.client import RemoteSolver
+
+            if _remote_solver is not None:
+                _remote_solver.close()  # don't leak the old channel
+            _remote_solver = RemoteSolver(endpoint)
+        return _remote_solver
+
+
 def _solve_packing(enc, **kwargs):
     """The solver seam: with KARPENTER_SOLVER_ENDPOINT set, device
     solves go to the gRPC solver service on the TPU hosts (DCN) —
     SURVEY §5.8 — and fall back to the in-process kernel when it is
     unreachable. Without it, solve locally."""
-    global _remote_solver
-    from karpenter_tpu.service.client import endpoint_from_env
-
-    endpoint = endpoint_from_env()
-    if endpoint:
-        with _remote_lock:
-            if _remote_solver is None or _remote_solver.endpoint != endpoint:
-                from karpenter_tpu.service.client import RemoteSolver
-
-                if _remote_solver is not None:
-                    _remote_solver.close()  # don't leak the old channel
-                _remote_solver = RemoteSolver(endpoint)
-            client = _remote_solver
+    client = _remote_client()
+    if client is not None:
         return client.solve_packing(enc, **kwargs)
     from karpenter_tpu.solver.pack import solve_packing
 
     return solve_packing(enc, **kwargs)
+
+
+def _solve_packing_async(enc, **kwargs):
+    """Dispatch a solve without blocking: local solves use the kernel's
+    true async dispatch (the device computes while the host keeps
+    working); remote solves run the RPC on a worker thread. Returns an
+    object with .result() -> PackResult."""
+    client = _remote_client()
+    if client is not None:
+        from concurrent.futures import ThreadPoolExecutor
+
+        executor = ThreadPoolExecutor(max_workers=1)
+        future = executor.submit(client.solve_packing, enc, **kwargs)
+        executor.shutdown(wait=False)
+        return future
+    from karpenter_tpu.solver.pack import solve_packing_async
+
+    return solve_packing_async(enc, **kwargs)
 
 
 def solve(
@@ -198,32 +222,31 @@ def _decode_device(
     # heuristic, never regress it (the LP's restricted pattern set can
     # be weak on small or degenerate demands).
     #
-    # The FFD pack needs no plan, so it dispatches on a worker thread
-    # while the host runs column generation: the device crunches the
-    # greedy race while scipy solves the master LP — the two dominant
-    # costs of a 50k-pod solve overlap instead of serializing.
-    from concurrent.futures import ThreadPoolExecutor
-
+    # The whole race is a pipeline around ONE device: dispatch the FFD
+    # kernel (async), run column generation on the host while it packs,
+    # dispatch the planned kernel (its input upload overlaps the FFD
+    # tail), decode/downsize the FFD result while the planned kernel
+    # runs, then fetch the planned result. Host and device are both
+    # busy end to end; nothing waits that doesn't have to.
+    #
+    # Both kernels' buffers are device-resident at once; that is the
+    # deliberate price of the overlap and it is small: the per-kernel
+    # state is O(N x C) bools + O(N x G) ints (~100MB even at a 50k
+    # node axis), against >=16GB of HBM — three orders of magnitude of
+    # headroom, so no size gate is needed.
     from karpenter_tpu.solver import lp_plan
 
-    with ThreadPoolExecutor(max_workers=1) as executor:
-        ffd_future = executor.submit(
-            _solve_packing, enc, mode="ffd", shards=shards
-        )
-        plan = lp_plan.plan(enc)
-        # join the FFD solve BEFORE dispatching the planned one: the
-        # overlap we want is device-vs-host (FFD kernel vs scipy LP);
-        # letting both kernels run concurrently would double peak
-        # device memory for no additional win (the LP almost always
-        # outlasts the FFD pack anyway)
-        ffd_result = ffd_future.result()
-        cost_result = (
-            _solve_packing(enc, mode="cost", plan=plan, shards=shards)
-            if plan is not None
-            else None
-        )
+    ffd_pending = _solve_packing_async(enc, mode="ffd", shards=shards)
+    plan = lp_plan.plan(enc)
+    cost_pending = (
+        _solve_packing_async(enc, mode="cost", plan=plan, shards=shards)
+        if plan is not None
+        else None
+    )
+    ffd_result = ffd_pending.result()
     candidates = [(ffd_result, _downsize_masks(enc, ffd_result))]
-    if cost_result is not None:
+    if cost_pending is not None:
+        cost_result = cost_pending.result()
         candidates.append((cost_result, _downsize_masks(enc, cost_result)))
 
     def key(item):
