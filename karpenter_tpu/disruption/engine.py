@@ -302,6 +302,7 @@ class DisruptionEngine:
             cluster_pods=self.kube.pods(),
             allow_reserved=self.options.feature_gates.reserved_capacity,
             min_values_policy=self.options.min_values_policy,
+            ignore_dra_requests=self.options.ignore_dra_requests,
             kube=self.kube,
             clock=self.clock,
         )

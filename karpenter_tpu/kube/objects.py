@@ -203,6 +203,9 @@ class Container:
     requests: ResourceList = field(default_factory=dict)
     ports: list[int] = field(default_factory=list)  # host ports only
     host_ip: str = ""
+    # Dynamic Resource Allocation: names of pod-level resourceClaims
+    # this container consumes (corev1 Container.Resources.Claims)
+    resource_claims: list[str] = field(default_factory=list)
 
 
 @dataclass

@@ -56,6 +56,10 @@ class Options:
     disruption_poll_seconds: float = 10.0  # disruption/controller.go:69
     metrics_interval_seconds: float = 10.0  # object-gauge republish cadence
     enable_profiling: bool = False         # operator.go:183-199 pprof gate
+    # Pods consuming DRA ResourceClaims are rejected with a permanent
+    # scheduling error while set (options.go:130 ignore-dra-requests;
+    # default true upstream until formal DRA support lands)
+    ignore_dra_requests: bool = True
 
 
 DEFAULT_OPTIONS = Options()

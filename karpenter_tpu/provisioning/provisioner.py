@@ -166,6 +166,10 @@ class Provisioner:
                 self.options.min_values_policy
                 if self.options is not None else "Strict"
             ),
+            ignore_dra_requests=(
+                self.options.ignore_dra_requests
+                if self.options is not None else True
+            ),
             clock=self.clock,
         )
         results = scheduler.solve(pods)

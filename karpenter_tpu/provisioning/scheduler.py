@@ -68,6 +68,13 @@ SOLVE_TIMEOUT_SECONDS = 60.0
 
 TIMEOUT_ERROR = "scheduling timed out; will retry next round"
 
+# DRA pods are rejected permanently (no relaxation retry) while the
+# ignore-dra-requests flag is on — scheduler.go:489-491, 448-452
+DRA_ERROR = (
+    "pod has Dynamic Resource Allocation requirements that are not yet "
+    "supported"
+)
+
 
 @dataclass
 class SchedulerResults:
@@ -119,8 +126,10 @@ class Scheduler:
         kube=None,
         clock=None,
         solve_timeout: float = SOLVE_TIMEOUT_SECONDS,
+        ignore_dra_requests: bool = True,
     ):
         self.min_values_policy = min_values_policy
+        self.ignore_dra_requests = ignore_dra_requests
         self.kube = kube
         import time as _time
 
@@ -251,6 +260,7 @@ class Scheduler:
         sum requests of daemon pods whose scheduling terms admit the
         pool template."""
         from karpenter_tpu.solver.encode import pool_template_requirements
+        from karpenter_tpu.utils.pod import has_dra_requirements
 
         out: dict[str, dict[str, float]] = {}
         for pool, types in self.pools_with_types:
@@ -260,6 +270,11 @@ class Scheduler:
             for ds in self.daemonsets:
                 pod = Pod(spec=ds.spec.template.spec)
                 pod.metadata.labels = dict(ds.spec.template.metadata.labels)
+                # a DRA daemon pod can never be scheduled by us, so
+                # its requests must not inflate the overhead budget
+                # (shouldSkipDaemonPod, scheduler.go:702-705)
+                if self.ignore_dra_requests and has_dra_requirements(pod):
+                    continue
                 if tolerates_pod(taints, pod) is not None:
                     continue
                 pod_reqs = Requirements.from_pod(pod, required_only=True)
@@ -322,6 +337,17 @@ class Scheduler:
         # (provisioner.go:365-368); work completed before the deadline
         # is kept, pods not yet placed report TIMEOUT_ERROR
         self._deadline = self.clock() + self.solve_timeout
+        dra_rejected: list[Pod] = []
+        if self.ignore_dra_requests:
+            # DRA gate (scheduler.go:489-491): device allocation can't
+            # be simulated, so these pods get a permanent error up
+            # front — they never enter the solve and never relax
+            from karpenter_tpu.utils.pod import has_dra_requirements
+
+            kept = []
+            for pod in pods:
+                (dra_rejected if has_dra_requirements(pod) else kept).append(pod)
+            pods = kept
         if self.kube is not None:
             # PVC zonal requirements re-derived HERE, at every solve
             # entry (provisioning and disruption simulation alike), so
@@ -361,6 +387,8 @@ class Scheduler:
                 simple.append(pod)
 
         results = SchedulerResults(new_node_plans=[], existing_assignments={})
+        for pod in dra_rejected:
+            results.errors[pod.key] = DRA_ERROR
 
         # reservation budget for THIS round: live usage plus every plan
         # opened during the round, batched or per-pod, so later

@@ -79,7 +79,7 @@ def default_shards() -> int:
 class PackResult:
     assign: np.ndarray        # [N, G] int32 pods of group g on node n
     node_mask: np.ndarray     # [N, C] bool configs remaining per node
-    node_used: np.ndarray     # [N, R] float32
+    node_used: np.ndarray     # [N, R] float64 (exact host recompute)
     node_active: np.ndarray   # [N] bool
     node_count: int
     unschedulable: np.ndarray  # [G] int32 pods that found no placement
@@ -499,10 +499,14 @@ def solve_packing_async(
     reserved_p = _pad_axis(reserved) if reserved else 0
 
     if max_nodes > 0:
+        # the node axis must at least hold the existing/planned slots
+        # (the kernel writes them unconditionally); a cap below that
+        # count means "no fresh opens at all", not a smaller axis
         return PendingPack(
             _run_pack(
                 enc, existing_mask, existing_used,
-                max_nodes + (reserved_p - reserved), mode, quota, shards,
+                max(max_nodes + (reserved_p - reserved), reserved_p),
+                mode, quota, shards,
             )
         )
 
@@ -748,6 +752,12 @@ def _run_pack(
     pool_overhead_h = enc.pool_overhead
     cfg_pool_h = cfg_pool  # host copy, padded
 
+    # every call path guarantees the node axis holds the existing
+    # slots (the explicit-max_nodes path clamps to reserved_p; the
+    # auto-sized path starts there) — the kernel's .at[:Ep] writes
+    # would fail to trace otherwise
+    assert N >= Ep, (N, Ep)
+
     def fetch() -> PackResult:
         flat = np.asarray(flat_dev)  # the one device->host fetch
         o0 = N * Gp
@@ -763,14 +773,16 @@ def _run_pack(
         # node_active / node_used are pure functions of the shipped
         # state: active = holds pods or is a live existing slot;
         # used = base (existing usage / fresh pool overhead) + the
-        # placed pods' requests. All addends are the same float32
-        # values the kernel accumulated, so fits-checks downstream see
-        # identical numbers modulo summation order (covered by the
-        # 1e-4 epsilon the kernel itself uses).
+        # placed pods' requests. The sum runs in float64: every addend
+        # is an exact float32 value and the totals stay far below
+        # 2^53, so this is the EXACT usage — float32 matmul would
+        # round differently from the kernel's sequential accumulation
+        # (ulp ~1KB at byte-scale memory), and a low-by-rounding value
+        # could let _downsize_masks resize a node below its true fill.
         node_active = assign.sum(axis=1) > 0
         if Ep:
             node_active[:Ep] |= emask_any
-        base = np.zeros((N, R), np.float32)
+        base = np.zeros((N, R), np.float64)
         if Ep:
             base[:Ep] = eused
         fresh = node_active.copy()
@@ -778,7 +790,9 @@ def _run_pack(
         if fresh.any():
             first_col = node_mask[fresh].argmax(axis=1)
             base[fresh] = pool_overhead_h[cfg_pool_h[first_col]]
-        node_used = base + assign.astype(np.float32) @ group_req_h
+        node_used = base + assign.astype(np.float64) @ group_req_h.astype(
+            np.float64
+        )
         return PackResult(
             assign=assign,
             node_mask=node_mask,

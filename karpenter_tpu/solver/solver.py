@@ -151,19 +151,28 @@ def _solve_packing(enc, **kwargs):
     return solve_packing(enc, **kwargs)
 
 
+_rpc_executor = None
+
+
 def _solve_packing_async(enc, **kwargs):
     """Dispatch a solve without blocking: local solves use the kernel's
     true async dispatch (the device computes while the host keeps
-    working); remote solves run the RPC on a worker thread. Returns an
-    object with .result() -> PackResult."""
+    working); remote solves run the RPC on a shared worker pool.
+    Returns an object with .result() -> PackResult."""
     client = _remote_client()
     if client is not None:
-        from concurrent.futures import ThreadPoolExecutor
+        global _rpc_executor
+        with _remote_lock:
+            if _rpc_executor is None:
+                from concurrent.futures import ThreadPoolExecutor
 
-        executor = ThreadPoolExecutor(max_workers=1)
-        future = executor.submit(client.solve_packing, enc, **kwargs)
-        executor.shutdown(wait=False)
-        return future
+                # sized for the cost objective's two concurrent RPCs
+                # (FFD race + planned solve) with headroom for a
+                # sibling disruption simulation
+                _rpc_executor = ThreadPoolExecutor(
+                    max_workers=4, thread_name_prefix="solver-rpc"
+                )
+        return _rpc_executor.submit(client.solve_packing, enc, **kwargs)
     from karpenter_tpu.solver.pack import solve_packing_async
 
     return solve_packing_async(enc, **kwargs)
@@ -323,7 +332,11 @@ def _downsize_masks(enc: Encoded, result) -> np.ndarray:
             & (uncapped | row)
         )
         if wide.any():
-            masks[ni] = wide
+            # the kernel-validated columns stay in as a floor: they
+            # provably hold the final fill, so numeric edge cases in
+            # the re-widened fits check can never leave the node with
+            # only configs smaller than its actual usage
+            masks[ni] = wide | row
     return masks
 
 

@@ -1,0 +1,152 @@
+"""Dynamic Resource Allocation gate.
+
+The reference cannot simulate DRA device allocation, so pods consuming
+ResourceClaims are rejected with a PERMANENT scheduling error while
+the ignore-dra-requests flag (default on) is set — no preference
+relaxation is attempted — and DRA daemon pods are excluded from the
+daemonset overhead budget (scheduler.go:484-491,448-452,702-705;
+suite_test.go "Dynamic Resource Allocation (DRA)" family).
+"""
+
+from karpenter_tpu.cloudprovider.fake import GIB, make_instance_type
+from karpenter_tpu.kube.objects import (
+    Container,
+    DaemonSet,
+    DaemonSetSpec,
+    ObjectMeta,
+    PodSpec,
+    PodTemplateSpec,
+)
+from karpenter_tpu.provisioning.scheduler import DRA_ERROR, Scheduler
+from karpenter_tpu.testing import Environment, mk_nodepool, mk_pod
+from karpenter_tpu.utils.pod import has_dra_requirements
+
+
+def small_types():
+    return [
+        make_instance_type("c2", cpu=2, memory=8 * GIB),
+        make_instance_type("c8", cpu=8, memory=32 * GIB),
+    ]
+
+
+def dra_pod(name: str = "dra", cpu: float = 1.0):
+    pod = mk_pod(name=name, cpu=cpu)
+    pod.spec.containers[0].resource_claims = ["gpu-claim"]
+    return pod
+
+
+class TestDetection:
+    def test_plain_pod_has_no_dra(self):
+        assert not has_dra_requirements(mk_pod())
+
+    def test_container_claims_detected(self):
+        assert has_dra_requirements(dra_pod())
+
+    def test_init_container_claims_detected(self):
+        pod = mk_pod()
+        pod.spec.init_containers = [
+            Container(name="init", resource_claims=["warmup-claim"])
+        ]
+        assert has_dra_requirements(pod)
+
+
+class TestSchedulerGate:
+    def test_dra_pod_rejected_permanently(self):
+        env = Environment(types=small_types())
+        env.kube.create(mk_nodepool("default"))
+        results = env.provision(dra_pod())
+        assert results.errors["default/dra"] == DRA_ERROR
+        assert env.kube.nodes() == []
+
+    def test_non_dra_pods_still_schedule_in_same_batch(self):
+        env = Environment(types=small_types())
+        env.kube.create(mk_nodepool("default"))
+        results = env.provision(dra_pod(), mk_pod(name="ok"))
+        assert results.errors["default/dra"] == DRA_ERROR
+        assert results.scheduled_count == 1
+        assert len(env.kube.nodes()) == 1
+
+    def test_flag_off_schedules_claims_unmodeled(self):
+        # with ignore-dra-requests disabled the pod flows through
+        # scheduling as an ordinary pod (claims are simply not modeled)
+        sched = Scheduler(
+            pools_with_types=[(mk_nodepool("p"), small_types())],
+            ignore_dra_requests=False,
+        )
+        results = sched.solve([dra_pod()])
+        assert not results.errors
+        assert results.scheduled_count == 1
+
+    def test_relaxation_never_runs_for_dra(self):
+        # a DRA pod with droppable preferences must fail on DRA, not on
+        # compatibility after relaxation (scheduler.go:448-452)
+        pod = dra_pod()
+        pod.spec.node_selector = {"kubernetes.io/arch": "amd64"}
+        sched = Scheduler(pools_with_types=[(mk_nodepool("p"), small_types())])
+        results = sched.solve([pod])
+        assert results.errors[pod.key] == DRA_ERROR
+
+
+class TestDaemonOverhead:
+    def _daemonset(self, name: str, claims: list[str]):
+        return DaemonSet(
+            metadata=ObjectMeta(name=name),
+            spec=DaemonSetSpec(
+                template=PodTemplateSpec(
+                    spec=PodSpec(
+                        containers=[
+                            Container(
+                                requests={"cpu": 1.0},
+                                resource_claims=claims,
+                            )
+                        ]
+                    )
+                )
+            ),
+        )
+
+    def test_dra_daemonset_excluded_from_overhead(self):
+        sched = Scheduler(
+            pools_with_types=[(mk_nodepool("p"), small_types())],
+            daemonsets=[self._daemonset("dra-ds", ["dev"])],
+        )
+        assert sched.daemon_overhead == {}
+
+    def test_plain_daemonset_still_counted(self):
+        sched = Scheduler(
+            pools_with_types=[(mk_nodepool("p"), small_types())],
+            daemonsets=[self._daemonset("plain-ds", [])],
+        )
+        (overhead,) = sched.daemon_overhead.values()
+        assert overhead["cpu"] == 1.0
+
+    def test_flag_off_counts_dra_daemonset(self):
+        sched = Scheduler(
+            pools_with_types=[(mk_nodepool("p"), small_types())],
+            daemonsets=[self._daemonset("dra-ds", ["dev"])],
+            ignore_dra_requests=False,
+        )
+        (overhead,) = sched.daemon_overhead.values()
+        assert overhead["cpu"] == 1.0
+
+
+class TestDisruptionInteraction:
+    def test_consolidation_aborts_when_candidate_hosts_dra_pod(self):
+        # SimulateScheduling cannot re-place a DRA pod, so a node
+        # hosting one must never be consolidated away (the all-pods-
+        # scheduled guard catches the permanent DRA error)
+        env = Environment(types=small_types())
+        env.kube.create(mk_nodepool("default"))
+        env.provision(*[mk_pod(name=f"w-{i}", cpu=0.4) for i in range(2)])
+        assert len(env.kube.nodes()) == 1
+        # bind a DRA pod onto the standing node out of band: it lands
+        # in cluster state like any running workload
+        pod = dra_pod(cpu=0.1)
+        pod.spec.node_name = env.kube.nodes()[0].metadata.name
+        env.kube.create(pod)
+        candidates = env.disruption.get_candidates(
+            reason="underutilized", now=10_000.0
+        )
+        assert len(candidates) == 1
+        results, all_ok = env.disruption.simulate_scheduling(candidates)
+        assert not all_ok

@@ -158,6 +158,33 @@ class TestSolverBasics:
         assert len(sol.existing[0].pods) == 1
         assert sum(len(n.pods) for n in sol.new_nodes) == 3
 
+    def test_explicit_max_nodes_below_existing_count_clips(self):
+        # an explicit max_nodes below the existing-node count means
+        # "no fresh opens" — existing slots still pack, nothing
+        # crashes, and the spill reports unschedulable
+        from karpenter_tpu.solver.pack import solve_packing
+
+        types = [make_instance_type("c4", cpu=4)]
+        existing = [
+            ExistingNodeInput(
+                name=f"node-{i}",
+                requirements=Requirements.from_labels(
+                    {"kubernetes.io/arch": "amd64"}
+                ),
+                taints=(),
+                available={"cpu": 1.0, "memory": 8 * GIB, "pods": 100},
+            )
+            for i in range(20)
+        ]
+        pods = [make_pod(f"p{i}", cpu=1.0) for i in range(30)]
+        enc = encode(group_pods(pods), [(make_pool(), types)], existing, None)
+        result = solve_packing(enc, max_nodes=10)
+        # all 20 existing nodes fill (1 cpu each), the other 10 pods
+        # spill with no fresh node allowed to open
+        assert int(result.assign.sum()) == 20
+        assert int(result.unschedulable.sum()) == 10
+        assert result.assign[result.node_active].sum() == 20
+
     def test_daemon_overhead_reserved(self):
         types = [make_instance_type("c4", cpu=4)]
         # 3.9 cpu allocatable; 2.0 daemon overhead leaves 1.9 -> 1 pod of 1cpu... 1.9//1 = 1
