@@ -671,9 +671,10 @@ class Scheduler:
         reqs = Requirements.from_labels(labels)
         for key, value in plan.pool.spec.template.labels.items():
             reqs.add(Requirement(key, IN, [value]))
-        taints = tuple(plan.pool.spec.template.spec.taints) + tuple(
-            plan.pool.spec.template.spec.startup_taints
-        )
+        # permanent taints only: startupTaints clear before pods run,
+        # so they never gate placement onto the planned node (same
+        # rule as build_configs / statenode.go:322-326)
+        taints = tuple(plan.pool.spec.template.spec.taints)
         return ExistingNodeInput(
             name=f"planned-{id(plan)}",
             requirements=reqs,
@@ -801,11 +802,10 @@ class Scheduler:
             topology.register(pod, self._plan_domains(plan))
             return True
 
-        # 3) new node
+        # 3) new node — permanent template taints only; startupTaints
+        # clear before pods run (same rule as build_configs)
         for pool, types in self.pools_with_types:
-            taints = tuple(pool.spec.template.spec.taints) + tuple(
-                pool.spec.template.spec.startup_taints
-            )
+            taints = tuple(pool.spec.template.spec.taints)
             if tolerates_pod(list(taints), pod) is not None:
                 continue
             fitting = []
@@ -891,9 +891,9 @@ class Scheduler:
 
     def _plan_can_add(self, plan: NodePlan, pod: Pod, pod_reqs: Requirements,
                       requests, topology: Topology) -> bool:
-        taints = tuple(plan.pool.spec.template.spec.taints) + tuple(
-            plan.pool.spec.template.spec.startup_taints
-        )
+        # permanent template taints only (startupTaints never gate
+        # placement; see build_configs)
+        taints = tuple(plan.pool.spec.template.spec.taints)
         if tolerates_pod(list(taints), pod) is not None:
             return False
         overhead = self.daemon_overhead.get(plan.pool.metadata.name, {})

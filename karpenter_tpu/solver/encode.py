@@ -223,9 +223,13 @@ def build_configs(
     append pseudo-configs for existing nodes."""
     configs: list[ConfigInfo] = []
     for pool, types in pools_with_types:
-        taints = tuple(pool.spec.template.spec.taints) + tuple(
-            pool.spec.template.spec.startup_taints
-        )
+        # only the template's permanent taints gate pod placement:
+        # startupTaints clear before initialization, so pods are
+        # assumed to schedule past them (the reference's
+        # NodeClaimTemplate exposes only Taints to the scheduler;
+        # statenode.go:322-326 ignores a claim's own startup taints
+        # while it initializes)
+        taints = tuple(pool.spec.template.spec.taints)
         # the pool template's own requirements filter which types and
         # offerings may launch under it (InstanceTypes.Compatible,
         # types.go:243; offering filtering nodeclaim.go:373-447). A

@@ -116,15 +116,26 @@ class StateNode:
         return out
 
     def taints(self) -> list[Taint]:
-        """Node taints; ephemeral startup taints ignored while the
-        managed node initializes (statenode.go Taints())."""
+        """Node taints; while a managed node initializes, known
+        ephemeral taints AND the claim's own startupTaints are ignored
+        — both clear before pods run (statenode.go:315-328)."""
         source = self.node.spec.taints if self.node is not None else (
             list(self.node_claim.spec.taints) + list(self.node_claim.spec.startup_taints)
             if self.node_claim is not None
             else []
         )
         if not self.initialized() and self.managed():
-            return filter_ephemeral(source)
+            startup = (
+                self.node_claim.spec.startup_taints
+                if self.node_claim is not None
+                else ()
+            )
+            return [
+                t for t in filter_ephemeral(source)
+                if not any(
+                    t.key == s.key and t.effect == s.effect for s in startup
+                )
+            ]
         return list(source)
 
     def capacity(self) -> ResourceList:
@@ -331,7 +342,26 @@ class Cluster:
         with self._lock:
             pid = node.spec.provider_id
             if not pid:
-                return
+                # a node we own must carry its providerID before it
+                # enters state; an UNMANAGED (bring-your-own) node is
+                # tracked under its name so its capacity is schedulable
+                # (cluster.go:353-358)
+                if node.metadata.labels.get(NODEPOOL_LABEL):
+                    return
+                pid = node.metadata.name
+                if not pid:
+                    return
+            elif pid != node.metadata.name:
+                # the node may have been ingested name-keyed before the
+                # cloud controller stamped its providerID — drop the
+                # stale entry or its capacity double-counts forever
+                stale = self._by_provider.get(node.metadata.name)
+                if (
+                    stale is not None
+                    and stale.node_claim is None
+                    and self._by_name.get(node.metadata.name) == node.metadata.name
+                ):
+                    del self._by_provider[node.metadata.name]
             state = self._by_provider.get(pid)
             if state is None:
                 claim_state = None
@@ -350,7 +380,16 @@ class Cluster:
 
     def delete_node(self, node: Node) -> None:
         with self._lock:
-            pid = node.spec.provider_id
+            pid = node.spec.provider_id or node.metadata.name
+            # the node may still be tracked under its name if the
+            # update that stamped spec.providerID was coalesced away
+            # by a relist — without this fallback the phantom entry
+            # (and its capacity) would survive the delete forever
+            if (
+                pid not in self._by_provider
+                and self._by_name.get(node.metadata.name) == node.metadata.name
+            ):
+                pid = node.metadata.name
             state = self._by_provider.get(pid)
             if state is None:
                 return
@@ -520,7 +559,14 @@ class Cluster:
                 elif claim.metadata.name not in self._unpaired_claims:
                     return False
             for node in store_nodes:
-                pid = node.spec.provider_id
+                # providerID-less unmanaged nodes are tracked under
+                # their name (update_node) — the barrier must hold for
+                # them too or a solve runs blind to their capacity
+                pid = node.spec.provider_id or (
+                    ""
+                    if node.metadata.labels.get(NODEPOOL_LABEL)
+                    else node.metadata.name
+                )
                 if pid and pid not in self._by_provider:
                     return False
             return True

@@ -1,0 +1,393 @@
+"""Binpacking + node-reuse oracle suite.
+
+Property families from the reference's scheduling suite
+(provisioning/scheduling/suite_test.go: "Binpacking" :1514-1831,
+"In-Flight Nodes" :1831-2473, "Existing Nodes" :2473-2654) re-stated
+against this framework's batched solver: smallest-adequate instance
+selection, packing density, init-container and runtime-class overhead
+semantics, per-node pod limits, in-flight reuse across registration
+delay, startup/ephemeral taint assumptions, and unowned-node reuse.
+"""
+
+from karpenter_tpu.apis.v1.labels import (
+    DISRUPTED_TAINT_KEY,
+    INSTANCE_TYPE_LABEL,
+    NODEPOOL_LABEL,
+)
+from karpenter_tpu.cloudprovider.fake import GIB, make_instance_type
+from karpenter_tpu.kube.objects import (
+    Container,
+    Node,
+    NodeCondition,
+    NodeStatus,
+    ObjectMeta,
+    Taint,
+)
+from karpenter_tpu.testing import Environment, mk_nodepool, mk_pod
+
+
+def sized_catalog():
+    # strictly size-ordered price curve: smallest adequate type is
+    # always the cheapest adequate type
+    return [
+        make_instance_type("s-1", cpu=1, memory=2 * GIB, price=1.0),
+        make_instance_type("s-2", cpu=2, memory=4 * GIB, price=2.0),
+        make_instance_type("s-4", cpu=4, memory=8 * GIB, price=4.0),
+        make_instance_type("s-8", cpu=8, memory=16 * GIB, price=8.0),
+        make_instance_type("s-16", cpu=16, memory=32 * GIB, price=16.0),
+    ]
+
+
+def node_types(env):
+    return [
+        n.metadata.labels.get(INSTANCE_TYPE_LABEL) for n in env.kube.nodes()
+    ]
+
+
+class TestBinpacking:
+    def test_small_pod_lands_on_smallest_instance(self):
+        # suite_test.go:1515 "should schedule a small pod on the
+        # smallest instance"
+        env = Environment(types=sized_catalog())
+        env.kube.create(mk_nodepool("default"))
+        env.provision(mk_pod(cpu=0.4, memory=GIB // 2))
+        assert node_types(env) == ["s-1"]
+
+    def test_many_small_pods_on_one_smallest_adequate(self):
+        # suite_test.go:1567 — 5 x 0.5cpu wants ONE s-4, not five s-1s
+        env = Environment(types=sized_catalog())
+        env.kube.create(mk_nodepool("default"))
+        env.provision(*[mk_pod(name=f"p{i}", cpu=0.5, memory=GIB // 4)
+                        for i in range(5)])
+        nodes = env.kube.nodes()
+        assert len(nodes) == 1
+        assert env.all_pods_bound()
+
+    def test_new_node_when_at_capacity(self):
+        # suite_test.go:1586
+        env = Environment(types=[make_instance_type("c4", cpu=4)])
+        env.kube.create(mk_nodepool("default"))
+        env.provision(*[mk_pod(name=f"p{i}", cpu=1.0) for i in range(3)])
+        assert len(env.kube.nodes()) == 1
+        env.provision(*[mk_pod(name=f"q{i}", cpu=1.0) for i in range(3)])
+        assert len(env.kube.nodes()) == 2
+        assert env.all_pods_bound()
+
+    def test_small_and_large_pods_pack_together(self):
+        # suite_test.go:1606
+        env = Environment(types=sized_catalog())
+        env.kube.create(mk_nodepool("default"))
+        env.provision(
+            mk_pod(name="large", cpu=6.0, memory=4 * GIB),
+            *[mk_pod(name=f"small{i}", cpu=0.4, memory=GIB // 4)
+              for i in range(4)],
+        )
+        assert len(env.kube.nodes()) == 1
+        assert env.all_pods_bound()
+
+    def test_zero_quantity_requests(self):
+        # suite_test.go:1664
+        env = Environment(types=sized_catalog())
+        env.kube.create(mk_nodepool("default"))
+        pod = mk_pod(cpu=0.0, memory=0.0)
+        results = env.provision(pod)
+        assert results.scheduled_count == 1
+
+    def test_pod_exceeding_every_instance_unschedulable(self):
+        # suite_test.go:1676
+        env = Environment(types=sized_catalog())
+        env.kube.create(mk_nodepool("default"))
+        results = env.provision(mk_pod(cpu=100.0))
+        assert results.scheduled_count == 0
+        assert len(results.errors) == 1
+        assert env.kube.nodes() == []
+
+    def test_pod_count_limit_opens_new_node(self):
+        # suite_test.go:1687 — capacity fits but max-pods does not
+        env = Environment(
+            types=[make_instance_type("tiny-pods", cpu=32, pods=3)]
+        )
+        env.kube.create(mk_nodepool("default"))
+        env.provision(*[mk_pod(name=f"p{i}", cpu=0.1) for i in range(5)])
+        # 3 pods per node (minus any daemons = none here) -> 2 nodes
+        assert len(env.kube.nodes()) == 2
+        assert env.all_pods_bound()
+
+    def test_init_container_requests_bound_the_node(self):
+        # suite_test.go:1709 — effective request is
+        # max(sum(containers), max(initContainers))
+        env = Environment(types=sized_catalog())
+        env.kube.create(mk_nodepool("default"))
+        pod = mk_pod(cpu=0.5)
+        pod.spec.init_containers = [
+            Container(name="init", requests={"cpu": 7.0, "memory": GIB})
+        ]
+        env.provision(pod)
+        assert node_types(env) == ["s-8"]
+
+    def test_init_container_exceeding_catalog_unschedulable(self):
+        # suite_test.go:1734
+        env = Environment(types=sized_catalog())
+        env.kube.create(mk_nodepool("default"))
+        pod = mk_pod(cpu=0.5)
+        pod.spec.init_containers = [
+            Container(name="init", requests={"cpu": 99.0})
+        ]
+        results = env.provision(pod)
+        assert results.scheduled_count == 0
+
+    def test_runtime_class_overhead_counted(self):
+        # suite_test.go:1539 — pod overhead joins the request
+        env = Environment(types=sized_catalog())
+        env.kube.create(mk_nodepool("default"))
+        pod = mk_pod(cpu=0.5)
+        pod.spec.overhead = {"cpu": 3.0}
+        env.provision(pod)
+        # 0.5 + 3.0 overhead doesn't fit s-2's ~1.9 allocatable
+        assert node_types(env) == ["s-4"]
+
+    def test_valid_instance_regardless_of_price(self):
+        # suite_test.go:1756 — when only an expensive type fits the
+        # selector, it is chosen anyway
+        cheap = make_instance_type("cheap-amd", cpu=16, price=1.0)
+        costly = make_instance_type(
+            "costly-arm", cpu=16, arch="arm64", price=50.0
+        )
+        env = Environment(types=[cheap, costly])
+        env.kube.create(mk_nodepool("default"))
+        env.provision(
+            mk_pod(node_selector={"kubernetes.io/arch": "arm64"})
+        )
+        assert node_types(env) == ["costly-arm"]
+
+
+class TestInFlightNodes:
+    def test_in_flight_node_reused_not_duplicated(self):
+        # suite_test.go:1832 — a launched-but-unregistered node absorbs
+        # the next compatible pod instead of a second launch
+        env = Environment(
+            types=[make_instance_type("c4", cpu=4)], registration_delay=5.0
+        )
+        env.kube.create(mk_nodepool("default"))
+        env.provision(mk_pod(name="first", cpu=1.0), now=0.0)
+        assert len(env.kube.node_claims()) == 1
+        assert env.kube.nodes() == []  # still in flight
+        env.provision(mk_pod(name="second", cpu=1.0), now=1.0)
+        assert len(env.kube.node_claims()) == 1
+
+    def test_incompatible_pod_opens_second_claim(self):
+        # suite_test.go:1917 (node-selector variant)
+        env = Environment(
+            types=[
+                make_instance_type("amd", cpu=4),
+                make_instance_type("arm", cpu=4, arch="arm64"),
+            ],
+            registration_delay=5.0,
+        )
+        env.kube.create(mk_nodepool("default"))
+        env.provision(
+            mk_pod(name="first",
+                   node_selector={"kubernetes.io/arch": "amd64"}),
+            now=0.0,
+        )
+        env.provision(
+            mk_pod(name="second",
+                   node_selector={"kubernetes.io/arch": "arm64"}),
+            now=1.0,
+        )
+        assert len(env.kube.node_claims()) == 2
+
+    def test_spillover_opens_second_claim(self):
+        # suite_test.go:1898 — in-flight node full -> second node
+        env = Environment(
+            types=[make_instance_type("c2", cpu=2)], registration_delay=5.0
+        )
+        env.kube.create(mk_nodepool("default"))
+        env.provision(mk_pod(name="first", cpu=1.5), now=0.0)
+        env.provision(mk_pod(name="second", cpu=1.5), now=1.0)
+        assert len(env.kube.node_claims()) == 2
+
+    def test_terminating_in_flight_not_reused(self):
+        # suite_test.go:1934
+        env = Environment(
+            types=[make_instance_type("c4", cpu=4)], registration_delay=5.0
+        )
+        env.kube.create(mk_nodepool("default"))
+        env.provision(mk_pod(name="first", cpu=1.0), now=0.0)
+        claim = env.kube.node_claims()[0]
+        env.kube.delete(claim)  # begins termination
+        env.provision(mk_pod(name="second", cpu=1.0), now=1.0)
+        live = [
+            c for c in env.kube.node_claims()
+            if c.metadata.deletion_timestamp is None
+        ]
+        assert len(live) == 1
+        assert live[0].metadata.name != claim.metadata.name
+
+    def test_registered_node_with_startup_taint_still_assumed(self):
+        # suite_test.go:2042/2112 — ephemeral/startup taints on an
+        # UNINITIALIZED node don't block assumption; pods without
+        # tolerations still plan onto it
+        env = Environment(
+            types=[make_instance_type("c4", cpu=4)], registration_delay=1.0
+        )
+        pool = mk_nodepool("default")
+        pool.spec.template.spec.startup_taints = [
+            Taint(key="example.com/starting", effect="NoSchedule")
+        ]
+        env.kube.create(pool)
+        env.provision(mk_pod(name="first", cpu=1.0), now=0.0)
+        # node registered (delay elapsed on tick at now=2) but startup
+        # taint still present -> uninitialized, in-flight
+        env.provision(mk_pod(name="second", cpu=1.0), now=2.0)
+        assert len(env.kube.node_claims()) == 1
+
+    def test_startup_taint_ignored_on_topology_slow_path(self):
+        # the per-pod path must apply the same rule as the batched
+        # path: startupTaints never gate placement (a topology-
+        # constrained pod on a startup-tainted pool still schedules,
+        # and a second pod joins the same open plan)
+        from karpenter_tpu.kube.objects import (
+            LabelSelector,
+            TopologySpreadConstraint,
+        )
+
+        env = Environment(types=[make_instance_type("c4", cpu=4)])
+        pool = mk_nodepool("default")
+        pool.spec.template.spec.startup_taints = [
+            Taint(key="example.com/starting", effect="NoSchedule")
+        ]
+        env.kube.create(pool)
+        pods = []
+        for i in range(2):
+            pod = mk_pod(name=f"t{i}", cpu=0.5)
+            pod.metadata.labels["app"] = "svc"
+            pod.spec.topology_spread_constraints = [
+                TopologySpreadConstraint(
+                    max_skew=2,
+                    topology_key="topology.kubernetes.io/zone",
+                    when_unsatisfiable="DoNotSchedule",
+                    label_selector=LabelSelector.of({"app": "svc"}),
+                )
+            ]
+            pods.append(pod)
+        results = env.provision(*pods)
+        assert results.scheduled_count == 2
+        assert not results.errors
+
+    def test_disrupted_taint_blocks_reuse(self):
+        # suite_test.go:2080 — a NON-ephemeral taint on the node is
+        # respected: pods are not assumed onto it
+        env = Environment(types=[make_instance_type("c4", cpu=4)])
+        env.kube.create(mk_nodepool("default"))
+        env.provision(mk_pod(name="first", cpu=1.0))
+        node = env.kube.nodes()[0]
+        node.spec.taints = list(node.spec.taints) + [
+            Taint(key=DISRUPTED_TAINT_KEY, effect="NoSchedule")
+        ]
+        env.kube.update(node)
+        env.provision(mk_pod(name="second", cpu=1.0))
+        assert len(env.kube.node_claims()) == 2
+
+
+class TestExistingNodes:
+    def _unowned_node(self, name="byo-1", cpu=8.0):
+        # a pre-existing node Karpenter does not manage (no claim)
+        return Node(
+            metadata=ObjectMeta(
+                name=name,
+                labels={
+                    "kubernetes.io/arch": "amd64",
+                    "kubernetes.io/os": "linux",
+                    "kubernetes.io/hostname": name,
+                },
+            ),
+            status=NodeStatus(
+                capacity={"cpu": cpu, "memory": 32 * GIB, "pods": 110.0},
+                allocatable={"cpu": cpu, "memory": 32 * GIB, "pods": 110.0},
+                conditions=[NodeCondition(type="Ready", status="True")],
+            ),
+        )
+
+    def test_pod_schedules_to_unowned_node(self):
+        # suite_test.go:2474
+        env = Environment(types=sized_catalog())
+        env.kube.create(mk_nodepool("default"))
+        env.kube.create(self._unowned_node())
+        results = env.provision(mk_pod(cpu=1.0))
+        assert results.scheduled_count == 1
+        assert not results.new_node_plans
+        assert "byo-1" in results.existing_assignments
+
+    def test_multiple_pods_fill_unowned_node_then_launch(self):
+        # suite_test.go:2500 + spill
+        env = Environment(types=sized_catalog())
+        env.kube.create(mk_nodepool("default"))
+        env.kube.create(self._unowned_node(cpu=2.0))
+        results = env.provision(
+            *[mk_pod(name=f"p{i}", cpu=1.0) for i in range(4)]
+        )
+        assert results.scheduled_count == 4
+        on_byo = len(results.existing_assignments.get("byo-1", []))
+        assert on_byo == 2
+        assert sum(len(p.pods) for p in results.new_node_plans) == 2
+
+    def test_provider_id_arrival_migrates_name_keyed_entry(self):
+        # a BYO node ingested before its providerID is stamped is
+        # name-keyed; the later MODIFIED event with the real
+        # providerID must not leave a duplicate StateNode behind
+        # (stale capacity would double-count)
+        env = Environment(types=sized_catalog())
+        env.kube.create(mk_nodepool("default"))
+        node = self._unowned_node()
+        env.kube.create(node)
+        assert len(env.cluster.deep_copy_nodes()) == 1
+        node.spec.provider_id = "cloud:///i-0abc"
+        env.kube.update(node)
+        snap = env.cluster.deep_copy_nodes()
+        assert len(snap) == 1
+        assert snap[0].node.spec.provider_id == "cloud:///i-0abc"
+
+    def test_delete_with_late_provider_id_clears_name_keyed_entry(self):
+        # if the update stamping providerID was coalesced away and the
+        # DELETE event is the first to carry it, the name-keyed entry
+        # must still be found and removed — not leak as phantom capacity
+        from karpenter_tpu.kube.client import KubeClient
+        from karpenter_tpu.state.cluster import Cluster
+
+        kube = KubeClient()
+        cluster = Cluster(kube)
+        node = self._unowned_node()
+        cluster.update_node(node)
+        assert len(cluster.deep_copy_nodes()) == 1
+        node.spec.provider_id = "cloud:///i-0late"  # stamped, update lost
+        cluster.delete_node(node)
+        assert cluster.deep_copy_nodes() == []
+
+    def test_synced_barrier_covers_byo_nodes(self):
+        # the sync barrier must hold until a providerID-less unmanaged
+        # node reaches cluster state — a solve that misses its
+        # capacity would launch a node the BYO machine could absorb
+        from karpenter_tpu.kube.client import KubeClient
+        from karpenter_tpu.state.cluster import Cluster
+
+        kube = KubeClient()
+        cluster = Cluster(kube)  # NO informers attached
+        kube.create(self._unowned_node())
+        assert not cluster.synced()
+        cluster.update_node(kube.nodes()[0])
+        assert cluster.synced()
+
+    def test_incompatible_with_node_but_compatible_with_pool(self):
+        # suite_test.go:2562 — pod can't land on the existing arm node
+        # but a fresh amd64 node serves it
+        env = Environment(types=sized_catalog())
+        env.kube.create(mk_nodepool("default"))
+        byo = self._unowned_node()
+        byo.metadata.labels["kubernetes.io/arch"] = "arm64"
+        env.kube.create(byo)
+        results = env.provision(
+            mk_pod(node_selector={"kubernetes.io/arch": "amd64"})
+        )
+        assert results.scheduled_count == 1
+        assert len(results.new_node_plans) == 1
