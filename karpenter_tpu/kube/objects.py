@@ -397,6 +397,9 @@ class PersistentVolumeClaimSpec:
 class PersistentVolumeClaim:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     spec: PersistentVolumeClaimSpec = field(default_factory=PersistentVolumeClaimSpec)
+    # claim phase; "Lost" marks a claim bound to a vanished volume
+    # (kube-scheduler rejects such pods, volumetopology.go:178-181)
+    phase: str = ""
 
     kind = "PersistentVolumeClaim"
 
@@ -410,6 +413,10 @@ class StorageClass:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     provisioner: str = ""
     zones: Optional[list[str]] = None  # allowedTopologies zones, None = any
+    # "Immediate" claims must already be bound before scheduling;
+    # "WaitForFirstConsumer" claims bind after placement. Default
+    # mirrors the API server's defaulting of an unset field.
+    volume_binding_mode: str = "Immediate"
 
     kind = "StorageClass"
 

@@ -29,6 +29,7 @@ from karpenter_tpu.apis.v1.nodeclaim import (
 )
 from karpenter_tpu.apis.v1.nodepool import NodePool, order_by_weight
 from karpenter_tpu.cloudprovider.types import CloudProvider
+from karpenter_tpu.provisioning import volume_topology
 from karpenter_tpu.kube.client import KubeClient
 from karpenter_tpu.kube.objects import ObjectMeta, Pod
 from karpenter_tpu.provisioning.scheduler import Scheduler, SchedulerResults
@@ -105,6 +106,15 @@ class Provisioner:
                 "karpenter",
             ):
                 continue
+            if pod.spec.volumes:
+                # kube-scheduler-rejected PVC states filter at intake
+                # (provisioner.go:509 ValidatePersistentVolumeClaims)
+                reason = volume_topology.validate_pvcs(pod, self.kube)
+                if reason is not None:
+                    log.debug(
+                        "pod %s not provisionable: %s", pod.key, reason
+                    )
+                    continue
             out.append(pod)
         return out
 

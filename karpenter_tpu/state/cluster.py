@@ -353,15 +353,24 @@ class Cluster:
                     return
             elif pid != node.metadata.name:
                 # the node may have been ingested name-keyed before the
-                # cloud controller stamped its providerID — drop the
-                # stale entry or its capacity double-counts forever
+                # cloud controller stamped its providerID — MIGRATE the
+                # entry (a delete-and-recreate would zero scheduling
+                # state like nominated_until mid-window; a leftover
+                # entry would double-count capacity forever)
                 stale = self._by_provider.get(node.metadata.name)
                 if (
                     stale is not None
                     and stale.node_claim is None
                     and self._by_name.get(node.metadata.name) == node.metadata.name
                 ):
-                    del self._by_provider[node.metadata.name]
+                    if pid not in self._by_provider:
+                        self._by_provider[pid] = self._by_provider.pop(
+                            node.metadata.name
+                        )
+                    else:
+                        # a claim-paired entry already owns the real
+                        # key; the name-keyed duplicate just goes
+                        del self._by_provider[node.metadata.name]
             state = self._by_provider.get(pid)
             if state is None:
                 claim_state = None
@@ -380,16 +389,16 @@ class Cluster:
 
     def delete_node(self, node: Node) -> None:
         with self._lock:
-            pid = node.spec.provider_id or node.metadata.name
-            # the node may still be tracked under its name if the
-            # update that stamped spec.providerID was coalesced away
-            # by a relist — without this fallback the phantom entry
-            # (and its capacity) would survive the delete forever
-            if (
-                pid not in self._by_provider
-                and self._by_name.get(node.metadata.name) == node.metadata.name
-            ):
-                pid = node.metadata.name
+            # resolve through the name index first: it tracks whatever
+            # key the node currently lives under (its providerID, or
+            # its name for BYO nodes, surviving providerID arrivals
+            # and deletes whose cached object predates the stamp) —
+            # a miss on any path would leak the entry's capacity
+            pid = (
+                self._by_name.get(node.metadata.name)
+                or node.spec.provider_id
+                or node.metadata.name
+            )
             state = self._by_provider.get(pid)
             if state is None:
                 return

@@ -342,11 +342,33 @@ class TestExistingNodes:
         node = self._unowned_node()
         env.kube.create(node)
         assert len(env.cluster.deep_copy_nodes()) == 1
+        # scheduling state set pre-migration must survive the re-key
+        env.cluster.node_for_name("byo-1").nominate(now=0.0)
         node.spec.provider_id = "cloud:///i-0abc"
         env.kube.update(node)
         snap = env.cluster.deep_copy_nodes()
         assert len(snap) == 1
         assert snap[0].node.spec.provider_id == "cloud:///i-0abc"
+        assert snap[0].nominated(now=1.0)
+
+    def test_delete_with_stale_cached_object_clears_migrated_entry(self):
+        # mirror case: state already migrated to the real providerID,
+        # but the DELETE event carries a cached object from before the
+        # stamp — the name index must still resolve it
+        from karpenter_tpu.kube.client import KubeClient
+        from karpenter_tpu.state.cluster import Cluster
+        import copy
+
+        kube = KubeClient()
+        cluster = Cluster(kube)
+        node = self._unowned_node()
+        stale_copy = copy.deepcopy(node)  # no provider_id yet
+        cluster.update_node(node)
+        node.spec.provider_id = "cloud:///i-0real"
+        cluster.update_node(node)
+        assert len(cluster.deep_copy_nodes()) == 1
+        cluster.delete_node(stale_copy)
+        assert cluster.deep_copy_nodes() == []
 
     def test_delete_with_late_provider_id_clears_name_keyed_entry(self):
         # if the update stamping providerID was coalesced away and the

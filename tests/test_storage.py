@@ -48,6 +48,7 @@ class TestVolumeTopologyInjection:
         env.kube.create(StorageClass(
             metadata=ObjectMeta(name="zonal-ssd"),
             provisioner="ebs.csi.aws.com",
+            volume_binding_mode="WaitForFirstConsumer",
             zones=["test-zone-3"],
         ))
         env.kube.create(PersistentVolumeClaim(
@@ -64,6 +65,7 @@ class TestVolumeTopologyInjection:
         env.kube.create(mk_nodepool("p"))
         env.kube.create(StorageClass(
             metadata=ObjectMeta(name="any"), provisioner="ebs.csi.aws.com",
+            volume_binding_mode="WaitForFirstConsumer",
         ))
         env.kube.create(PersistentVolumeClaim(
             metadata=ObjectMeta(name="data-2", namespace="default"),
@@ -87,6 +89,202 @@ class TestVolumeTopologyInjection:
         env.provision(pod)
         assert len(env.kube.nodes()) == 0
         assert not env.kube.get_pod("default", "db-x").spec.node_name
+
+
+class TestPvcValidation:
+    """kube-scheduler-rejected PVC states are filtered at pod intake
+    (volumetopology.go:160-215 ValidatePersistentVolumeClaims;
+    suite_test.go VolumeUsage family :3246-3404)."""
+
+    def _env(self):
+        env = Environment(types=instance_types(20))
+        env.kube.create(mk_nodepool("p"))
+        return env
+
+    def test_missing_pvc_blocks(self):
+        env = self._env()
+        env.provision(pvc_pod("db", "no-such-claim"))
+        assert env.kube.nodes() == []
+
+    def test_deleting_pvc_blocks(self):
+        env = self._env()
+        pvc = PersistentVolumeClaim(
+            metadata=ObjectMeta(name="going", namespace="default"),
+            spec=PersistentVolumeClaimSpec(storage_class_name="sc"),
+        )
+        env.kube.create(StorageClass(
+            metadata=ObjectMeta(name="sc"), provisioner="csi.x"
+        ))
+        env.kube.create(pvc)
+        pvc.metadata.deletion_timestamp = 1.0
+        env.kube.update(pvc)
+        env.provision(pvc_pod("db", "going"))
+        assert env.kube.nodes() == []
+
+    def test_lost_pvc_blocks(self):
+        env = self._env()
+        pvc = PersistentVolumeClaim(
+            metadata=ObjectMeta(name="lost", namespace="default"),
+            spec=PersistentVolumeClaimSpec(volume_name="gone-pv"),
+        )
+        pvc.phase = "Lost"
+        env.kube.create(pvc)
+        env.provision(pvc_pod("db", "lost"))
+        assert env.kube.nodes() == []
+
+    def test_bound_pvc_with_missing_pv_blocks(self):
+        env = self._env()
+        env.kube.create(PersistentVolumeClaim(
+            metadata=ObjectMeta(name="dangling", namespace="default"),
+            spec=PersistentVolumeClaimSpec(volume_name="nonexistent-pv"),
+        ))
+        env.provision(pvc_pod("db", "dangling"))
+        assert env.kube.nodes() == []
+
+    def test_unbound_pvc_without_storage_class_blocks(self):
+        env = self._env()
+        env.kube.create(PersistentVolumeClaim(
+            metadata=ObjectMeta(name="naked", namespace="default"),
+            spec=PersistentVolumeClaimSpec(),
+        ))
+        env.provision(pvc_pod("db", "naked"))
+        assert env.kube.nodes() == []
+
+    def test_immediate_binding_mode_unbound_blocks(self):
+        env = self._env()
+        env.kube.create(StorageClass(
+            metadata=ObjectMeta(name="fast"), provisioner="csi.x",
+            volume_binding_mode="Immediate",
+        ))
+        env.kube.create(PersistentVolumeClaim(
+            metadata=ObjectMeta(name="early", namespace="default"),
+            spec=PersistentVolumeClaimSpec(storage_class_name="fast"),
+        ))
+        env.provision(pvc_pod("db", "early"))
+        assert env.kube.nodes() == []
+
+    def test_immediate_binding_mode_bound_schedules(self):
+        env = self._env()
+        env.kube.create(PersistentVolume(metadata=ObjectMeta(name="pv-b")))
+        env.kube.create(StorageClass(
+            metadata=ObjectMeta(name="fast"), provisioner="csi.x",
+            volume_binding_mode="Immediate",
+        ))
+        env.kube.create(PersistentVolumeClaim(
+            metadata=ObjectMeta(name="early", namespace="default"),
+            spec=PersistentVolumeClaimSpec(
+                storage_class_name="fast", volume_name="pv-b"
+            ),
+        ))
+        env.provision(pvc_pod("db", "early"))
+        assert len(env.kube.nodes()) == 1
+
+    def test_unsupported_provisioner_blocks(self):
+        from karpenter_tpu.provisioning import volume_topology
+
+        env = self._env()
+        env.kube.create(StorageClass(
+            metadata=ObjectMeta(name="weird"), provisioner="other-provider",
+            volume_binding_mode="WaitForFirstConsumer",
+        ))
+        env.kube.create(PersistentVolumeClaim(
+            metadata=ObjectMeta(name="odd", namespace="default"),
+            spec=PersistentVolumeClaimSpec(storage_class_name="weird"),
+        ))
+        volume_topology.UNSUPPORTED_PROVISIONERS.add("other-provider")
+        try:
+            env.provision(pvc_pod("db", "odd"))
+            assert env.kube.nodes() == []
+        finally:
+            volume_topology.UNSUPPORTED_PROVISIONERS.discard("other-provider")
+
+    def test_non_pvc_volumes_unaffected(self):
+        # NFS/emptyDir-style volumes carry no claim: nothing to check
+        # (suite_test.go:2878 "should not fail for NFS volumes")
+        env = self._env()
+        pod = mk_pod(name="db")
+        pod.spec.volumes = [PodVolume(name="share")]  # no pvc_name
+        env.provision(pod)
+        assert len(env.kube.nodes()) == 1
+
+    def test_ephemeral_name_collision_with_foreign_claim_blocks(self):
+        # a pre-existing claim under the ephemeral '<pod>-<vol>' name
+        # that the pod does NOT own is a permanent kube-scheduler
+        # rejection — must filter at intake
+        env = self._env()
+        env.kube.create(StorageClass(
+            metadata=ObjectMeta(name="sc"), provisioner="csi.x",
+            volume_binding_mode="WaitForFirstConsumer",
+        ))
+        env.kube.create(PersistentVolumeClaim(
+            metadata=ObjectMeta(name="db-scratch", namespace="default"),
+            spec=PersistentVolumeClaimSpec(storage_class_name="sc"),
+        ))  # no owner reference to the pod
+        pod = mk_pod(name="db")
+        pod.spec.volumes = [PodVolume(name="scratch", ephemeral=True)]
+        env.provision(pod)
+        assert env.kube.nodes() == []
+
+    def test_ephemeral_owned_claim_schedules(self):
+        from karpenter_tpu.kube.objects import OwnerReference
+
+        env = self._env()
+        env.kube.create(StorageClass(
+            metadata=ObjectMeta(name="sc"), provisioner="csi.x",
+            volume_binding_mode="WaitForFirstConsumer",
+        ))
+        pod = mk_pod(name="db")
+        pod.spec.volumes = [PodVolume(name="scratch", ephemeral=True)]
+        env.kube.create(PersistentVolumeClaim(
+            metadata=ObjectMeta(
+                name="db-scratch", namespace="default",
+                owner_references=[
+                    OwnerReference(
+                        kind="Pod", name="db", uid=pod.metadata.uid
+                    )
+                ],
+            ),
+            spec=PersistentVolumeClaimSpec(storage_class_name="sc"),
+        ))
+        env.provision(pod)
+        assert len(env.kube.nodes()) == 1
+
+    def test_ephemeral_claim_of_prior_pod_incarnation_blocks(self):
+        # same name, different pod UID: kube-scheduler's UID check
+        # rejects the stale claim, so intake must too
+        from karpenter_tpu.kube.objects import OwnerReference
+
+        env = self._env()
+        env.kube.create(StorageClass(
+            metadata=ObjectMeta(name="sc"), provisioner="csi.x",
+            volume_binding_mode="WaitForFirstConsumer",
+        ))
+        env.kube.create(PersistentVolumeClaim(
+            metadata=ObjectMeta(
+                name="db-scratch", namespace="default",
+                owner_references=[
+                    OwnerReference(kind="Pod", name="db", uid="old-uid")
+                ],
+            ),
+            spec=PersistentVolumeClaimSpec(storage_class_name="sc"),
+        ))
+        pod = mk_pod(name="db")  # fresh incarnation, new uid
+        pod.spec.volumes = [PodVolume(name="scratch", ephemeral=True)]
+        env.provision(pod)
+        assert env.kube.nodes() == []
+
+    def test_ephemeral_volume_pvc_created_later_schedules(self):
+        # a generic ephemeral volume's PVC appears only after the pod
+        # schedules; its absence must not block intake
+        env = self._env()
+        env.kube.create(StorageClass(
+            metadata=ObjectMeta(name="default-sc"), provisioner="csi.x",
+            volume_binding_mode="WaitForFirstConsumer",
+        ))
+        pod = mk_pod(name="db")
+        pod.spec.volumes = [PodVolume(name="scratch", ephemeral=True)]
+        env.provision(pod)
+        assert len(env.kube.nodes()) == 1
 
 
 class TestInjectionAtSolveEntry:
@@ -151,6 +349,7 @@ class TestVolumeLimits:
         env.kube.create(mk_nodepool("p"))
         env.kube.create(StorageClass(
             metadata=ObjectMeta(name="ssd"), provisioner="ebs.csi.aws.com",
+            volume_binding_mode="WaitForFirstConsumer",
         ))
         env.provision(mk_pod(name="warm", cpu=0.25))  # materialize a node
         node = env.kube.nodes()[0]
