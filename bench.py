@@ -175,7 +175,11 @@ def _timed_cost_solve(pods, pools, bound_gap: bool = False, repeats: int = 1):
 
     ffd = solve(pods, pools, objective="ffd")
     t0 = time.perf_counter()
-    solve(pods, pools, objective="cost")  # warm: compile + shape buckets
+    # warm TWICE: the first solve compiles the estimated node axis and
+    # remembers a tighter one; the second compiles THAT axis, so the
+    # timed runs below are pure steady state (no hidden XLA compile)
+    solve(pods, pools, objective="cost")
+    solve(pods, pools, objective="cost")
     warm_wall = time.perf_counter() - t0
     samples = []
     sol = None

@@ -206,6 +206,9 @@ class Container:
     # Dynamic Resource Allocation: names of pod-level resourceClaims
     # this container consumes (corev1 Container.Resources.Claims)
     resource_claims: list[str] = field(default_factory=list)
+    # init containers with restartPolicy=Always are native sidecars:
+    # their requests persist for the pod's lifetime
+    restart_policy: Optional[str] = None
 
 
 @dataclass
@@ -224,6 +227,9 @@ class PodSpec:
     containers: list[Container] = field(default_factory=list)
     init_containers: list[Container] = field(default_factory=list)
     overhead: ResourceList = field(default_factory=dict)
+    # pod-level resource requests (PodLevelResources feature): when
+    # set, these replace container aggregation for scheduling
+    resources: ResourceList = field(default_factory=dict)
     volumes: list[PodVolume] = field(default_factory=list)
     node_name: str = ""
     priority: int = 0

@@ -92,10 +92,11 @@ def group_pods(pods: Sequence[Pod], required_only: bool = False) -> list[PodGrou
                 for c in spec.containers
             ),
             tuple(
-                frozenset(c.requests.items())
+                (frozenset(c.requests.items()), c.restart_policy)
                 for c in spec.init_containers
             ) if spec.init_containers else None,
             frozenset(spec.overhead.items()) if spec.overhead else None,
+            frozenset(spec.resources.items()) if spec.resources else None,
         )
         hit = parsed.get(raw)
         if hit is None:

@@ -92,13 +92,39 @@ def positive(rl: Mapping[str, float]) -> ResourceList:
 def pod_requests(pod: "Pod") -> ResourceList:
     """Effective pod resource requests.
 
-    k8s semantics (mirrored from resources.PodRequests): the max of
-    (sum of container requests, each init-container's requests),
-    plus pod overhead, plus one implicit "pods" unit.
+    k8s semantics (mirrored from resources.PodRequests, which defers
+    to k8s resource helpers):
+
+    - pod-level resources, when set, replace container aggregation
+      (PodLevelResources feature; suite_test.go:684);
+    - otherwise: walk init containers in order, where a RESTARTABLE
+      init container (restartPolicy=Always — a native sidecar) keeps
+      its requests for the pod's whole life and stacks under every
+      later init container and the main containers, while a regular
+      init container only peaks during its own run
+      (suite_test.go:531-683 sidecar families);
+    - plus pod overhead, plus one implicit "pods" unit.
     """
-    containers = merge(*(c.requests for c in pod.spec.containers)) if pod.spec.containers else {}
-    init = max_resources(*(c.requests for c in pod.spec.init_containers)) if pod.spec.init_containers else {}
-    out = max_resources(containers, init)
+    sidecar_sum: ResourceList = {}
+    init_peak: ResourceList = {}
+    for c in pod.spec.init_containers:
+        if c.restart_policy == "Always":
+            sidecar_sum = merge(sidecar_sum, c.requests)
+        else:
+            init_peak = max_resources(
+                init_peak, merge(sidecar_sum, c.requests)
+            )
+    main = merge(
+        sidecar_sum, *(c.requests for c in pod.spec.containers)
+    )
+    out = max_resources(main, init_peak)
+    if pod.spec.resources:
+        # pod-level values override aggregation ONLY for the resources
+        # k8s supports at pod level (cpu/memory/hugepages); extended
+        # resources and everything else stay container-aggregated
+        for key, value in pod.spec.resources.items():
+            if key in (CPU, MEMORY) or key.startswith("hugepages-"):
+                out[key] = value
     if pod.spec.overhead:
         out = merge(out, pod.spec.overhead)
     out[PODS] = out.get(PODS, 0.0) + 1.0

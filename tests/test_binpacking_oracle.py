@@ -136,6 +136,113 @@ class TestBinpacking:
         results = env.provision(pod)
         assert results.scheduled_count == 0
 
+    def test_sidecar_requests_stack_under_main_containers(self):
+        # provisioning/suite_test.go:582 — a restartable init container
+        # (native sidecar) keeps its requests for the pod's life:
+        # effective = sidecar + main, not max(init, main)
+        from karpenter_tpu.utils.resources import pod_requests
+
+        pod = mk_pod(cpu=2.0)
+        pod.spec.init_containers = [
+            Container(
+                name="sidecar", requests={"cpu": 3.0},
+                restart_policy="Always",
+            )
+        ]
+        assert pod_requests(pod)["cpu"] == 5.0
+
+    def test_sidecar_stacks_under_later_init_containers(self):
+        # provisioning/suite_test.go:531 — init container AFTER the
+        # sidecar peaks at sidecar+init; the pod's effective request
+        # is max(that peak, sidecar+main)
+        from karpenter_tpu.utils.resources import pod_requests
+
+        pod = mk_pod(cpu=1.0)
+        pod.spec.init_containers = [
+            Container(
+                name="sidecar", requests={"cpu": 2.0},
+                restart_policy="Always",
+            ),
+            Container(name="init", requests={"cpu": 4.0}),
+        ]
+        # init phase peak: 2 + 4 = 6; run phase: 2 + 1 = 3
+        assert pod_requests(pod)["cpu"] == 6.0
+
+    def test_plain_init_before_sidecar_does_not_stack(self):
+        # an init container BEFORE any sidecar runs alone: peak is its
+        # own request, not summed with sidecars that start later
+        from karpenter_tpu.utils.resources import pod_requests
+
+        pod = mk_pod(cpu=1.0)
+        pod.spec.init_containers = [
+            Container(name="init", requests={"cpu": 4.0}),
+            Container(
+                name="sidecar", requests={"cpu": 2.0},
+                restart_policy="Always",
+            ),
+        ]
+        # init phase peak: 4; run phase: 2 + 1 = 3
+        assert pod_requests(pod)["cpu"] == 4.0
+
+    def test_pod_level_resources_take_precedence(self):
+        # provisioning/suite_test.go:684 — pod-level requests override
+        # container aggregation for the resources k8s supports at pod
+        # level; extended resources stay container-aggregated
+        from karpenter_tpu.utils.resources import pod_requests
+
+        pod = mk_pod(cpu=1.0)
+        pod.spec.containers[0].requests["example.com/accel"] = 4.0
+        pod.spec.resources = {"cpu": 6.0, "memory": 2 * GIB}
+        reqs = pod_requests(pod)
+        assert reqs["cpu"] == 6.0
+        assert reqs["memory"] == 2 * GIB
+        assert reqs["example.com/accel"] == 4.0
+
+    def test_sidecar_and_plain_twin_pods_not_conflated(self):
+        # two pods identical except one's init container is a sidecar
+        # must encode with different effective requests (dedupe-cache
+        # key regression)
+        env = Environment(types=sized_catalog())
+        env.kube.create(mk_nodepool("default"))
+        plain = mk_pod(name="plain", cpu=2.0)
+        plain.spec.init_containers = [
+            Container(name="init", requests={"cpu": 3.0})
+        ]
+        sidecar = mk_pod(name="sidecar", cpu=2.0)
+        sidecar.spec.init_containers = [
+            Container(
+                name="init", requests={"cpu": 3.0},
+                restart_policy="Always",
+            )
+        ]
+        results = env.provision(plain, sidecar)
+        assert results.scheduled_count == 2
+        # plain: effective 3.0; sidecar: effective 5.0 — both on one
+        # s-16 or split, but the sidecar pod must never land on a node
+        # sized for 3.0 alone alongside claims of full fit
+        per_node = {
+            n.metadata.name: n.metadata.labels[INSTANCE_TYPE_LABEL]
+            for n in env.kube.nodes()
+        }
+        live = env.kube.get_pod("default", "sidecar")
+        node_type = per_node[live.spec.node_name]
+        assert node_type in ("s-8", "s-16")
+
+    def test_sidecar_pod_lands_on_adequate_instance(self):
+        # end to end: the solver sizes the node for sidecar + main
+        env = Environment(types=sized_catalog())
+        env.kube.create(mk_nodepool("default"))
+        pod = mk_pod(cpu=2.0, memory=GIB)
+        pod.spec.init_containers = [
+            Container(
+                name="mesh-proxy", requests={"cpu": 3.0},
+                restart_policy="Always",
+            )
+        ]
+        env.provision(pod)
+        # 5.0 cpu effective -> s-8 (s-4's ~3.9 allocatable too small)
+        assert node_types(env) == ["s-8"]
+
     def test_runtime_class_overhead_counted(self):
         # suite_test.go:1539 — pod overhead joins the request
         env = Environment(types=sized_catalog())
