@@ -98,6 +98,12 @@ class NodeClaimLifecycle:
         claim.status.capacity = launched.status.capacity
         claim.status.allocatable = launched.status.allocatable
         claim.metadata.labels = launched.metadata.labels
+        # single-valued requirements resolve to labels on the launched
+        # claim (launch.go:131), so registration later stamps them onto
+        # the node — e.g. a custom tier the scheduler pinned
+        for spec in claim.spec.requirements:
+            if spec.operator == "In" and len(spec.values) == 1:
+                claim.metadata.labels.setdefault(spec.key, spec.values[0])
         claim.status_conditions.set_true(COND_LAUNCHED, now=now)
         self.kube.update(claim)
 

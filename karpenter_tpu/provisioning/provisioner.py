@@ -33,7 +33,9 @@ from karpenter_tpu.provisioning import volume_topology
 from karpenter_tpu.kube.client import KubeClient
 from karpenter_tpu.kube.objects import ObjectMeta, Pod
 from karpenter_tpu.provisioning.scheduler import Scheduler, SchedulerResults
-from karpenter_tpu.scheduling.requirement import IN
+from karpenter_tpu.apis.v1.labels import is_restricted_label
+from karpenter_tpu.scheduling.requirement import IN, Requirement
+from karpenter_tpu.scheduling.requirements import Requirements
 from karpenter_tpu.solver.solver import NodePlan
 from karpenter_tpu.state.cluster import Cluster
 from karpenter_tpu.utils import resources as resutil
@@ -41,6 +43,44 @@ from karpenter_tpu.utils import resources as resutil
 log = logging.getLogger("karpenter.provisioner")
 
 _claim_counter = itertools.count(1)
+
+
+def _specs_from_requirement(req: Requirement, relaxed: bool) -> list[RequirementSpec]:
+    """Serialize one algebraic Requirement back into claim spec
+    entries. Gt/Lt bounds live outside the value set (complement
+    representation), so they emit as their own Gt/Lt entries — a
+    flattening to operator()/value_list() alone would collapse a bare
+    bound into Exists and lose it. A BestEffort-relaxed plan drops a
+    minValues floor ONLY where the surviving value set no longer
+    satisfies it (the min-values-relaxed annotation records why)."""
+    specs: list[RequirementSpec] = []
+    if req.greater_than is not None:
+        specs.append(
+            RequirementSpec(key=req.key, operator="Gt",
+                            values=(str(req.greater_than),))
+        )
+    if req.less_than is not None:
+        specs.append(
+            RequirementSpec(key=req.key, operator="Lt",
+                            values=(str(req.less_than),))
+        )
+    op = req.operator()
+    if specs and op == "Exists" and not req.values:
+        return specs  # the bounds already imply existence
+    values = tuple(req.value_list())
+    min_values = req.min_values
+    # only an In value set can fall below its floor (complement sets
+    # allow unboundedly many values)
+    if (
+        relaxed and min_values is not None and op == IN
+        and len(values) < min_values
+    ):
+        min_values = None
+    specs.append(
+        RequirementSpec(key=req.key, operator=op, values=values,
+                        min_values=min_values)
+    )
+    return specs
 
 
 @dataclass
@@ -255,6 +295,33 @@ class Provisioner:
             requirements.append(
                 RequirementSpec(key="karpenter.sh/reservation-id", operator=IN,
                                 values=rids)
+            )
+
+        # tighten with the scheduled pods' own requirements: the
+        # reference's in-flight NodeClaim accumulates every added
+        # pod's requirement set (nodeclaim.go:114-167 Add), so a claim
+        # serving tier=gold pods pins the tier label even when the
+        # template admits several values
+        combined = Requirements(
+            Requirement(r.key, r.operator, list(r.values), r.min_values)
+            for r in requirements
+        )
+        for pod in plan.pods:
+            combined.add(
+                *(
+                    r
+                    for r in Requirements.from_pod(pod, required_only=True)
+                    # keys the claim may not carry as requirements
+                    # (karpenter.sh/nodepool rides the label; fully
+                    # restricted domains are admission-rejected)
+                    if r.key != NODEPOOL_LABEL
+                    and is_restricted_label(r.key) is None
+                )
+            )
+        requirements = []
+        for req in combined:
+            requirements.extend(
+                _specs_from_requirement(req, plan.min_values_relaxed)
             )
 
         name = f"{pool.metadata.name}-{next(_claim_counter):05d}"

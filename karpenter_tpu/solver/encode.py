@@ -351,6 +351,55 @@ def encode(
             if tolerates(cfg.taints, list(group.tolerations)) is not None:
                 compat[gi, ci] = False
 
+    # Mutual exclusion: two groups can each be compatible with a
+    # config yet unable to SHARE one node — their requirements pin a
+    # key the config leaves open to several values (tier=gold vs
+    # tier=silver on a template admitting both). The reference's
+    # in-flight NodeClaim catches this by tightening its requirement
+    # set per added pod (nodeclaim.go:114-167); here it becomes a
+    # pairwise conflict row. Keys every launchable config pins to ONE
+    # value (zone, arch, ...) cannot cause it — disjoint pins already
+    # make the compat columns disjoint — so only groups constraining
+    # an open key enter the quadratic check (almost always none).
+    launch_cfgs = [c for c in configs if c.existing_index < 0]
+    if launch_cfgs and G > 1:
+        from karpenter_tpu.scheduling.requirement import IN as _IN
+
+        # pinning is judged over ALL config columns, existing nodes
+        # included: a BYO node missing a well-known label (say
+        # capacity-type) leaves that key open even though every launch
+        # config pins it, and two groups pinning different values must
+        # not share that node
+        pin_ok: dict[str, bool] = {}
+        n_have: dict[str, int] = {}
+        for cfg in configs:
+            for req in cfg.requirements:
+                single = req.operator() == _IN and len(req.values) == 1
+                n_have[req.key] = n_have.get(req.key, 0) + 1
+                pin_ok[req.key] = pin_ok.get(req.key, True) and single
+        always_pinned = {
+            k for k, ok in pin_ok.items()
+            if ok and n_have[k] == len(configs)
+        }
+        cand = [
+            gi for gi, g in enumerate(groups)
+            if any(k not in always_pinned for k in g.requirements.keys())
+        ]
+        mutual = None
+        for i, a in enumerate(cand):
+            for b in cand[i + 1 :]:
+                if (
+                    groups[a].requirements.intersects(
+                        groups[b].requirements
+                    )
+                    is not None
+                ):
+                    if mutual is None:
+                        mutual = np.zeros((G, G), bool)
+                    mutual[a, b] = mutual[b, a] = True
+        if mutual is not None:
+            conflict = mutual if conflict is None else (conflict | mutual)
+
     n_pools = len(pools_with_types)
     pool_overhead = np.zeros((n_pools + 1, R), np.float32)
     if daemon_overhead:

@@ -1,0 +1,233 @@
+"""Custom-label operator matrix oracle.
+
+The reference's "Well Known Labels" / "Scheduling Logic" contexts
+(provisioning/scheduling/suite_test.go:932-1105): how each node-
+affinity operator behaves against a label the NodePool does and does
+not define, end to end through provisioning — plus the co-scheduling
+consequences (compatible pods share a node, incompatible pods split)
+and the Exists-does-not-overwrite rule.
+"""
+
+import pytest
+
+from karpenter_tpu.cloudprovider.fake import make_instance_type
+from karpenter_tpu.kube.objects import (
+    Affinity,
+    NodeAffinity,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+)
+from karpenter_tpu.testing import Environment, mk_nodepool, mk_pod
+
+LABEL = "example.com/tier"
+
+
+def affinity_pod(name, op, values=(), key=LABEL):
+    pod = mk_pod(name=name, cpu=0.5)
+    pod.spec.affinity = Affinity(
+        node_affinity=NodeAffinity(
+            required=(
+                NodeSelectorTerm(
+                    match_expressions=(
+                        NodeSelectorRequirement(
+                            key=key, operator=op, values=tuple(values)
+                        ),
+                    )
+                ),
+            )
+        )
+    )
+    return pod
+
+
+def env_with_pool(pool_labels=None):
+    env = Environment(types=[make_instance_type("c8", cpu=8)])
+    pool = mk_nodepool("default")
+    if pool_labels:
+        pool.spec.template.labels.update(pool_labels)
+    env.kube.create(pool)
+    return env
+
+
+class TestUndefinedKeyOperators:
+    """suite_test.go:932-970 — the pool does NOT define the label."""
+
+    @pytest.mark.parametrize(
+        "op,values,schedules",
+        [
+            ("In", ["gold"], False),        # :932
+            ("NotIn", ["gold"], True),      # :941
+            ("Exists", [], False),          # :951
+            ("DoesNotExist", [], True),     # :960
+        ],
+    )
+    def test_operator_vs_undefined_key(self, op, values, schedules):
+        env = env_with_pool()
+        results = env.provision(affinity_pod("p", op, values))
+        assert (results.scheduled_count == 1) == schedules
+        assert (len(env.kube.nodes()) == 1) == schedules
+
+
+class TestDefinedKeyOperators:
+    """suite_test.go:979-1047 — the pool defines tier=gold."""
+
+    @pytest.mark.parametrize(
+        "op,values,schedules",
+        [
+            ("In", ["gold"], True),          # :979 matching value
+            ("In", ["silver"], False),       # :1026 different value
+            ("NotIn", ["gold"], False),      # :991 matching value
+            ("NotIn", ["silver"], True),     # :1037 different value
+            ("Exists", [], True),            # :1002
+            ("DoesNotExist", [], False),     # :1014
+        ],
+    )
+    def test_operator_vs_defined_key(self, op, values, schedules):
+        env = env_with_pool({LABEL: "gold"})
+        results = env.provision(affinity_pod("p", op, values))
+        assert (results.scheduled_count == 1) == schedules
+
+    def test_unconstrained_pod_ignores_pool_label(self):
+        # suite_test.go:970 — a pod with no matching selector still
+        # schedules onto the labeled pool
+        env = env_with_pool({LABEL: "gold"})
+        results = env.provision(mk_pod(cpu=0.5))
+        assert results.scheduled_count == 1
+
+
+class TestCoScheduling:
+    def test_compatible_pods_share_a_node(self):
+        # suite_test.go:1049 — In['gold'] and Exists agree: one node
+        env = env_with_pool({LABEL: "gold"})
+        env.provision(
+            affinity_pod("a", "In", ["gold"]),
+            affinity_pod("b", "Exists"),
+        )
+        assert len(env.kube.nodes()) == 1
+        assert env.all_pods_bound()
+
+    def test_incompatible_pods_split_nodes(self):
+        # suite_test.go:1069 — In['gold'] and In['silver'] on a pool
+        # whose template leaves the label free: two nodes, each
+        # labeled for its pod
+        env = Environment(types=[make_instance_type("c8", cpu=8)])
+        pool = mk_nodepool("default")
+        pool.spec.template.spec.requirements = [
+            # pool admits both tiers; each claim resolves to one
+            __import__(
+                "karpenter_tpu.apis.v1.nodeclaim", fromlist=["RequirementSpec"]
+            ).RequirementSpec(
+                key=LABEL, operator="In", values=["gold", "silver"]
+            )
+        ]
+        env.kube.create(pool)
+        env.provision(
+            affinity_pod("a", "In", ["gold"]),
+            affinity_pod("b", "In", ["silver"]),
+        )
+        nodes = env.kube.nodes()
+        assert len(nodes) == 2
+        assert env.all_pods_bound()
+        # each node materializes its pod's tier (launch.go:131 label
+        # resolution -> registration sync)
+        assert sorted(n.metadata.labels[LABEL] for n in nodes) == [
+            "gold",
+            "silver",
+        ]
+
+    def test_gt_bound_survives_onto_claim(self):
+        # a numeric Gt template requirement must reach the created
+        # claim as Gt, not collapse to Exists (the provider re-checks
+        # it at launch)
+        from karpenter_tpu.apis.v1.nodeclaim import RequirementSpec
+        from karpenter_tpu.cloudprovider.fake import make_instance_type
+
+        env = Environment(
+            types=[
+                make_instance_type(
+                    "big", cpu=8,
+                    extra_labels={"example.com/size": "4"},
+                ),
+            ]
+        )
+        pool = mk_nodepool("default")
+        pool.spec.template.spec.requirements = [
+            RequirementSpec(
+                key="example.com/size", operator="Gt", values=("2",)
+            )
+        ]
+        env.kube.create(pool)
+        results = env.provision(mk_pod(cpu=0.5))
+        assert results.scheduled_count == 1
+        claim = env.kube.node_claims()[0]
+        size = [r for r in claim.spec.requirements
+                if r.key == "example.com/size"]
+        assert size and size[0].operator == "Gt"
+        assert list(size[0].values) == ["2"]
+
+    def test_capacity_type_split_on_byo_node(self):
+        # a BYO node without a capacity-type label leaves the key open:
+        # a spot-requiring and an on-demand-requiring pod must not
+        # share it (the reference's ExistingNode.Add tightens per pod)
+        from karpenter_tpu.cloudprovider.fake import GIB, make_instance_type
+        from karpenter_tpu.kube.objects import (
+            Node,
+            NodeCondition,
+            NodeStatus,
+            ObjectMeta,
+        )
+
+        env = Environment(types=[make_instance_type("c8", cpu=8)])
+        env.kube.create(mk_nodepool("default"))
+        env.kube.create(Node(
+            metadata=ObjectMeta(
+                name="byo",
+                labels={
+                    "kubernetes.io/arch": "amd64",
+                    "kubernetes.io/os": "linux",
+                    "kubernetes.io/hostname": "byo",
+                },
+            ),
+            status=NodeStatus(
+                capacity={"cpu": 8.0, "memory": 32 * GIB, "pods": 110.0},
+                allocatable={"cpu": 8.0, "memory": 32 * GIB, "pods": 110.0},
+                conditions=[NodeCondition(type="Ready", status="True")],
+            ),
+        ))
+        spot = mk_pod(
+            name="spot", cpu=0.5,
+            node_selector={"karpenter.sh/capacity-type": "spot"},
+        )
+        od = mk_pod(
+            name="od", cpu=0.5,
+            node_selector={"karpenter.sh/capacity-type": "on-demand"},
+        )
+        results = env.provision(spot, od)
+        assert results.scheduled_count == 2
+        byo_pods = results.existing_assignments.get("byo", [])
+        assert len(byo_pods) <= 1
+
+    def test_exists_does_not_overwrite_value(self):
+        # suite_test.go:1090 — pod A pins tier=gold on the claim; pod
+        # B's Exists must join that node without widening the value
+        env = Environment(types=[make_instance_type("c8", cpu=8)])
+        pool = mk_nodepool("default")
+        pool.spec.template.spec.requirements = [
+            __import__(
+                "karpenter_tpu.apis.v1.nodeclaim", fromlist=["RequirementSpec"]
+            ).RequirementSpec(
+                key=LABEL, operator="In", values=["gold", "silver"]
+            )
+        ]
+        env.kube.create(pool)
+        results = env.provision(
+            affinity_pod("a", "In", ["gold"]),
+            affinity_pod("b", "Exists"),
+        )
+        assert results.scheduled_count == 2
+        assert len(results.new_node_plans) == 1
+        claim = env.kube.node_claims()[0]
+        tier = [
+            r for r in claim.spec.requirements if r.key == LABEL
+        ]
+        assert tier and list(tier[0].values) == ["gold"]
