@@ -225,10 +225,22 @@ class Operator:
                     else:
                         unbound = True  # node still materializing
             for node_name, pods in results.existing_assignments.items():
+                # an in-flight assignment is keyed by CLAIM name; bind
+                # only once the claim's node materialized — a bind to
+                # the raw key would pin pods to a node that will never
+                # exist under that name
+                target = node_name
+                if self.cluster.node_for_name(node_name) is None:
+                    claim = self.kube.get_node_claim(node_name)
+                    if claim is not None:
+                        target = claim.status.node_name
+                        if not target:
+                            unbound = True
+                            continue
                 for pod in pods:
                     live = self.kube.get_pod(pod.metadata.namespace, pod.metadata.name)
                     if live is not None and not live.spec.node_name:
-                        self.kube.bind_pod(live, node_name)
+                        self.kube.bind_pod(live, target)
             if unbound:
                 remaining.append(results)
         self._pending_bindings = remaining

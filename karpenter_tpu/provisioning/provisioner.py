@@ -242,9 +242,12 @@ class Provisioner:
             # (provisioner.go:448-453)
             self.cluster.update_node_claim(claim)
             created.append(claim)
-        # nominate existing nodes receiving pods (provisioner.go:399)
+        # nominate existing nodes receiving pods (provisioner.go:399);
+        # node_for_key also resolves claim-name keys so in-flight
+        # nodes that just received assignments get their nomination
+        # window too (disruption must not treat them as empty)
         for node_name in results.existing_assignments:
-            state = self.cluster.node_for_name(node_name)
+            state = self.cluster.node_for_key(node_name)
             if state is not None:
                 state.nominate()
         return created

@@ -89,6 +89,18 @@ class SchedulerResults:
         )
 
 
+def _state_node_key(node: StateNode) -> str:
+    """Stable key for an existing-node assignment: the node name, or
+    the claim name while the node has not materialized (an in-flight
+    claim has no Node object yet; an empty key would collide every
+    in-flight assignment onto one entry)."""
+    if node.name:
+        return node.name
+    if node.node_claim is not None:
+        return node.node_claim.metadata.name
+    return ""
+
+
 def _pool_requirements(pool: NodePool) -> Requirements:
     """The pool template's requirement set, minValues included."""
     from karpenter_tpu.solver.encode import pool_template_requirements
@@ -173,6 +185,11 @@ class Scheduler:
         self.daemonsets = list(daemonsets)
         self.cluster_pods = list(cluster_pods)
 
+        # per-node daemon reservation, memoized: invariant within a
+        # scheduling round, but _existing_input re-runs per committed
+        # pod on the slow path
+        self._daemon_reserve_cache: dict[str, dict[str, float]] = {}
+
         # existing first, then in-flight fewest-pods-first (scheduler.go:552)
         live = [n for n in state_nodes if not n.deleting() and n.initialized()]
         inflight = [n for n in state_nodes if not n.deleting() and not n.initialized()]
@@ -246,14 +263,62 @@ class Scheduler:
         if node.node_claim is not None and not node.registered():
             for spec in node.node_claim.spec.requirements:
                 reqs.add(Requirement(spec.key, spec.operator, spec.values, spec.min_values))
+        available = resutil.positive(node.available())
+        reserve = self._daemon_reserve(node)
+        if reserve:
+            available = resutil.positive(
+                resutil.subtract(available, reserve)
+            )
         return ExistingNodeInput(
             name=node.name or (node.node_claim.metadata.name if node.node_claim else ""),
             requirements=reqs,
             taints=tuple(node.taints()),
-            available=resutil.positive(node.available()),
+            available=available,
             pool_name=node.nodepool_name(),
             pod_count=len(node.pod_keys),
         )
+
+    def _daemon_reserve(self, node: StateNode) -> dict[str, float]:
+        """Capacity still owed to daemonsets on this node: the
+        requests of every daemonset whose pods CAN land here, minus
+        daemon pods already bound, floored at zero (unexpected daemon
+        pods must not push the reservation negative) —
+        existingnode.go:41-52, scheduler.go isDaemonPodCompatibleWithNode.
+        """
+        if not self.daemonsets or not node.managed():
+            return {}
+        cache_key = _state_node_key(node)
+        cached = self._daemon_reserve_cache.get(cache_key)
+        if cached is not None:
+            return cached
+        from karpenter_tpu.utils.pod import has_dra_requirements
+
+        taints = list(node.taints())
+        node_reqs = Requirements.from_labels(node.labels())
+        expected: dict[str, float] = {}
+        for ds in self.daemonsets:
+            pod = Pod(spec=ds.spec.template.spec)
+            pod.metadata.labels = dict(ds.spec.template.metadata.labels)
+            if self.ignore_dra_requests and has_dra_requirements(pod):
+                continue
+            if tolerates_pod(taints, pod) is not None:
+                continue
+            if not node_reqs.is_compatible(
+                Requirements.from_pod(pod, required_only=True),
+                allow_undefined=WELL_KNOWN_LABELS,
+            ):
+                continue
+            expected = resutil.merge(expected, resutil.pod_requests(pod))
+        # net of daemon pods already bound to the node — cluster state
+        # tracks these (terminal pods excluded) so the reservation is
+        # not re-derived from the raw pod list
+        reserve = (
+            resutil.positive(resutil.subtract(expected, node.daemon_usage))
+            if expected
+            else {}
+        )
+        self._daemon_reserve_cache[cache_key] = reserve
+        return reserve
 
     def _daemon_overhead(self) -> dict[str, dict[str, float]]:
         """Per-pool daemonset resource overhead (scheduler.go:772-803):
@@ -404,16 +469,37 @@ class Scheduler:
             self._accept_plans(solution.new_nodes, open_plans, results, round_in_use)
             for assignment in solution.existing:
                 node = self.state_nodes[assignment.existing_index]
-                results.existing_assignments.setdefault(node.name, []).extend(
+                results.existing_assignments.setdefault(
+                    _state_node_key(node), []
+                ).extend(
                     assignment.pods
                 )
                 for pod in assignment.pods:
                     self._commit_existing(assignment.existing_index, pod)
+            evicted_keys = {p.key for p in solution.evicted}
             for pod in solution.unschedulable:
                 retried = False
                 if self._timed_out():
                     results.errors[pod.key] = TIMEOUT_ERROR
                     continue
+                if pod.key in evicted_keys:
+                    # displaced by the k-way requirement check, not
+                    # infeasible: retry as-is before any relaxation
+                    retry = self._batched_solve(
+                        [pod], reserved_in_use=round_in_use
+                    )
+                    if not retry.unschedulable:
+                        self._accept_plans(
+                            retry.new_nodes, open_plans, results, round_in_use
+                        )
+                        for a in retry.existing:
+                            node = self.state_nodes[a.existing_index]
+                            results.existing_assignments.setdefault(
+                                _state_node_key(node), []
+                            ).extend(a.pods)
+                            for p in a.pods:
+                                self._commit_existing(a.existing_index, p)
+                        continue
                 if self.honor_preferences:
                     relaxed = relax(pod)
                     if relaxed:
@@ -428,7 +514,7 @@ class Scheduler:
                             for a in retry.existing:
                                 node = self.state_nodes[a.existing_index]
                                 results.existing_assignments.setdefault(
-                                    node.name, []
+                                    _state_node_key(node), []
                                 ).extend(a.pods)
                                 for p in a.pods:
                                     self._commit_existing(a.existing_index, p)

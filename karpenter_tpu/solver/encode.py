@@ -184,6 +184,9 @@ class Encoded:
     conflict: np.ndarray = None           # [G, G] bool mutually exclusive groups
     existing_quota: np.ndarray = None     # [E, G] int32 remaining cap per
                                           # existing node (counts already there)
+    loose_groups: np.ndarray = None       # [G] bool groups constraining a key
+                                          # configs leave open (k-way check
+                                          # at decode)
 
 
 def pool_template_requirements(
@@ -361,8 +364,8 @@ def encode(
     # value (zone, arch, ...) cannot cause it — disjoint pins already
     # make the compat columns disjoint — so only groups constraining
     # an open key enter the quadratic check (almost always none).
-    launch_cfgs = [c for c in configs if c.existing_index < 0]
-    if launch_cfgs and G > 1:
+    loose_groups = np.zeros((G,), bool)
+    if configs and G > 0:
         from karpenter_tpu.scheduling.requirement import IN as _IN
 
         # pinning is judged over ALL config columns, existing nodes
@@ -385,6 +388,10 @@ def encode(
             gi for gi, g in enumerate(groups)
             if any(k not in always_pinned for k in g.requirements.keys())
         ]
+        # groups constraining an open key need k-way re-validation at
+        # decode: pairwise rows cannot see a three-way empty
+        # intersection (e.g. In[g,s] / In[s,b] / In[g,b])
+        loose_groups[cand] = True
         mutual = None
         for i, a in enumerate(cand):
             for b in cand[i + 1 :]:
@@ -464,6 +471,7 @@ def encode(
         group_cap=group_cap,
         conflict=conflict,
         existing_quota=existing_quota,
+        loose_groups=loose_groups,
     )
 
 

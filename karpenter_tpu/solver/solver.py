@@ -103,6 +103,10 @@ class Solution:
     new_nodes: list[NodePlan]
     existing: list[ExistingAssignment]
     unschedulable: list[Pod]
+    # subset of `unschedulable` displaced by the decode-time k-way
+    # requirement check (not kernel-infeasible): schedulable alone, so
+    # the caller should retry them unrelaxed
+    evicted: list[Pod] = field(default_factory=list)
     # cost-objective solves attach the planner's bounds here so callers
     # can report optimality gaps without re-running column generation:
     # {"lower_bound": linear resource bound, "estimate": master-LP value}
@@ -406,10 +410,32 @@ def _build_solution_arrays(
     first_col = sub_mask.argmax(axis=1)
     any_col = sub_mask.any(axis=1)
 
+    extra_unsched = np.zeros(len(enc.groups), np.int64)
+    loose = enc.loose_groups
     for row, ni in enumerate(active_idx):
         gs = np.nonzero(assign[ni])[0]
         if gs.size == 0 or not any_col[row]:
             continue
+        if gs.size > 1 and loose is not None and loose[gs].any():
+            # k-way re-validation: pairwise conflict rows cannot see a
+            # three-way empty intersection on an open key (In[g,s] /
+            # In[s,b] / In[g,b]); walk the node's groups in index
+            # order tightening like the reference's incremental Add
+            # (nodeclaim.go:114-167) and evict what no longer fits —
+            # evicted pods report unschedulable and re-enter the
+            # caller's retry path
+            running = enc.configs[int(first_col[row])].requirements.copy()
+            admitted = []
+            for gi in gs:
+                reqs = enc.groups[gi].requirements
+                if running.intersects(reqs) is not None:
+                    extra_unsched[gi] += int(assign[ni, gi])
+                    continue
+                running.add(*reqs.values())
+                admitted.append(gi)
+            gs = np.asarray(admitted, dtype=gs.dtype)
+            if gs.size == 0:
+                continue
         pods: list[Pod] = []
         for gi in gs:
             count = int(assign[ni, gi])
@@ -437,12 +463,20 @@ def _build_solution_arrays(
         new_nodes.append(plan)
 
     unschedulable: list[Pod] = []
-    for gi in np.nonzero(unsched)[0]:
-        # unplaced pods are the tail of the group after placements
+    evicted: list[Pod] = []
+    total_unsched = unsched.astype(np.int64) + extra_unsched
+    for gi in np.nonzero(total_unsched)[0]:
+        # unplaced pods are the tail of the group after placements;
+        # the deepest tail is the k-way-evicted share (interchangeable
+        # within the group, so any split is valid)
         group = enc.groups[gi]
-        unschedulable.extend(group.pods[len(group.pods) - int(unsched[gi]) :])
+        tail = group.pods[len(group.pods) - int(total_unsched[gi]) :]
+        unschedulable.extend(tail)
+        if extra_unsched[gi]:
+            evicted.extend(tail[len(tail) - int(extra_unsched[gi]) :])
     return Solution(
         new_nodes=new_nodes,
         existing=sorted(existing.values(), key=lambda e: e.existing_index),
         unschedulable=unschedulable,
+        evicted=evicted,
     )

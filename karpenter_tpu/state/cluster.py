@@ -243,6 +243,16 @@ class Cluster:
             pid = self._by_name.get(name)
             return self._by_provider.get(pid) if pid else None
 
+    def node_for_key(self, name: str) -> Optional[StateNode]:
+        """Resolve a node name OR an in-flight claim name — scheduling
+        results key existing-node assignments by whichever the state
+        node currently answers to (_state_node_key)."""
+        with self._lock:
+            pid = self._by_name.get(name) or self._claim_keys.get(name)
+            if pid:
+                return self._by_provider.get(pid)
+            return self._unpaired_claims.get(name)
+
     def deep_copy_nodes(self) -> list[StateNode]:
         """Snapshot for a scheduling run (cluster.go:249)."""
         with self._lock:

@@ -84,8 +84,15 @@ class Environment:
         self.kube = KubeClient()
         self.cluster = Cluster(self.kube)
         attach_informers(self.kube, self.cluster)
+        # one sim clock for the whole environment: every explicit
+        # `now=` advances it, and the cloud's instance timestamps use
+        # it too — mixing wall-clock created_at with simulated `now`
+        # would gate registration delays forever
+        self._sim_now: Optional[float] = None
         self.cloud = KwokCloudProvider(
-            self.kube, types=self.types, registration_delay=self.registration_delay
+            self.kube, types=self.types,
+            registration_delay=self.registration_delay,
+            clock=self._clock,
         )
         self.provisioner = Provisioner(self.kube, self.cluster, self.cloud)
         self.lifecycle = NodeClaimLifecycle(self.kube, self.cloud)
@@ -106,9 +113,21 @@ class Environment:
             options=self.options,
         )
 
+    def _clock(self) -> float:
+        import time as _time
+
+        return self._sim_now if self._sim_now is not None else _time.time()
+
+    def _advance(self, now: Optional[float]) -> None:
+        if now is not None:
+            self._sim_now = (
+                now if self._sim_now is None else max(self._sim_now, now)
+            )
+
     def reconcile_disruption(self, now: Optional[float] = None):
         """One disruption cycle: refresh conditions, run the engine,
         progress the orchestration queue and termination."""
+        self._advance(now)
         self.pod_events.reconcile_all(now=now)
         self.conditions.reconcile_all(now=now)
         command = self.disruption.reconcile(now=now)
@@ -130,6 +149,7 @@ class Environment:
     def reconcile_termination(self, now: Optional[float] = None, rounds: int = 4) -> None:
         """Drive claim finalize -> node drain -> instance delete to
         quiescence (each controller pass handles one stage)."""
+        self._advance(now)
         for _ in range(rounds):
             self.lifecycle.reconcile_all(now=now)
             self.termination.reconcile_all(now=now)
@@ -142,6 +162,7 @@ class Environment:
         provisioning cycle, launch claims through the lifecycle, tick
         the simulated cloud, register/initialize nodes, and bind pods
         to their planned nodes."""
+        self._advance(now)
         for pod in pods:
             if self.kube.get_pod(pod.metadata.namespace, pod.metadata.name) is None:
                 self.kube.create(pod)
@@ -167,7 +188,19 @@ class Environment:
                     self.kube.bind_pod(live, claim.status.node_name)
         for node_name, pods in results.existing_assignments.items():
             state = self.cluster.node_for_name(node_name)
-            target = state.name if state is not None else node_name
+            target = state.name if state is not None else ""
+            if not target:
+                # an in-flight assignment is keyed by claim name; by
+                # bind time the tick may have materialized its node.
+                # If it still hasn't, the pods stay pending and the
+                # next round re-plans them (the reference leaves
+                # binding to kube-scheduler once the node is Ready).
+                claim = self.kube.get_node_claim(node_name)
+                target = claim.status.node_name if claim is not None else ""
+                if not target and claim is None:
+                    target = node_name  # plain existing node, no claim
+                if not target:
+                    continue
             for pod in pods:
                 live = self.kube.get_pod(pod.metadata.namespace, pod.metadata.name)
                 if live is not None and not live.spec.node_name:

@@ -382,6 +382,82 @@ class TestInFlightNodes:
         assert results.scheduled_count == 2
         assert not results.errors
 
+    def test_in_flight_node_reserves_daemon_overhead(self):
+        # suite_test.go:2205 — daemonsets that will land on an
+        # in-flight node reserve its capacity even before their pods
+        # exist, so a later pod that would collide with the daemon's
+        # share opens a second node
+        from karpenter_tpu.kube.objects import (
+            DaemonSet,
+            DaemonSetSpec,
+            PodSpec,
+            PodTemplateSpec,
+        )
+
+        env = Environment(
+            types=[make_instance_type("c4", cpu=4)], registration_delay=5.0
+        )
+        env.kube.create(mk_nodepool("default"))
+        env.kube.create(DaemonSet(
+            metadata=ObjectMeta(name="agent"),
+            spec=DaemonSetSpec(
+                template=PodTemplateSpec(
+                    spec=PodSpec(
+                        containers=[Container(requests={"cpu": 1.0})]
+                    )
+                )
+            ),
+        ))
+        env.provision(mk_pod(name="first", cpu=1.0), now=0.0)
+        assert len(env.kube.node_claims()) == 1
+        # in-flight node: 3.9 alloc - 1.0 pod - 1.0 daemon ~= 1.9 left
+        env.provision(mk_pod(name="small", cpu=1.5), now=1.0)
+        assert len(env.kube.node_claims()) == 1  # fits beside daemon
+        env.provision(mk_pod(name="big", cpu=1.0), now=2.0)
+        # 0.4 left after daemon share -> must open a second node
+        assert len(env.kube.node_claims()) == 2
+
+    def test_unexpected_daemon_binding_does_not_go_negative(self):
+        # suite_test.go:2277 — a daemon pod bound with MORE than its
+        # expected share must clamp the reservation at zero, not
+        # corrupt the availability math
+        from karpenter_tpu.kube.objects import (
+            DaemonSet,
+            DaemonSetSpec,
+            OwnerReference,
+            PodSpec,
+            PodTemplateSpec,
+        )
+
+        env = Environment(types=[make_instance_type("c4", cpu=4)])
+        env.kube.create(mk_nodepool("default"))
+        env.kube.create(DaemonSet(
+            metadata=ObjectMeta(name="agent"),
+            spec=DaemonSetSpec(
+                template=PodTemplateSpec(
+                    spec=PodSpec(
+                        containers=[Container(requests={"cpu": 0.5})]
+                    )
+                )
+            ),
+        ))
+        env.provision(mk_pod(name="first", cpu=1.0))
+        node = env.kube.nodes()[0]
+        # daemon pod binds bigger than the template said (0.9 > 0.5)
+        daemon_pod = mk_pod(name="agent-x", cpu=0.9)
+        daemon_pod.metadata.owner_references = [
+            OwnerReference(
+                kind="DaemonSet", name="agent", uid="u-agent",
+                controller=True,
+            )
+        ]
+        daemon_pod.spec.node_name = node.metadata.name
+        env.kube.create(daemon_pod)
+        # remaining ~2.0: a 1.9 pod still fits on the standing node
+        results = env.provision(mk_pod(name="second", cpu=1.9))
+        assert results.scheduled_count == 1
+        assert len(env.kube.node_claims()) == 1
+
     def test_disrupted_taint_blocks_reuse(self):
         # suite_test.go:2080 — a NON-ephemeral taint on the node is
         # respected: pods are not assumed onto it

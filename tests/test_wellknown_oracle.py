@@ -135,6 +135,34 @@ class TestCoScheduling:
             "silver",
         ]
 
+    def test_three_way_empty_intersection_splits(self):
+        # In[g,s] / In[s,b] / In[g,b] intersect pairwise but jointly
+        # empty — the decode-time incremental tightening must split
+        # them instead of launching a claim whose tier requirement
+        # collapses to DoesNotExist
+        from karpenter_tpu.apis.v1.nodeclaim import RequirementSpec
+        from karpenter_tpu.cloudprovider.fake import make_instance_type
+
+        env = Environment(types=[make_instance_type("c8", cpu=8)])
+        pool = mk_nodepool("default")
+        pool.spec.template.spec.requirements = [
+            RequirementSpec(
+                key=LABEL, operator="In",
+                values=["gold", "silver", "bronze"],
+            )
+        ]
+        env.kube.create(pool)
+        results = env.provision(
+            affinity_pod("gs", "In", ["gold", "silver"]),
+            affinity_pod("sb", "In", ["silver", "bronze"]),
+            affinity_pod("gb", "In", ["gold", "bronze"]),
+        )
+        assert results.scheduled_count == 3
+        assert env.all_pods_bound()
+        for claim in env.kube.node_claims():
+            tier = [r for r in claim.spec.requirements if r.key == LABEL]
+            assert tier and tier[0].operator == "In" and tier[0].values
+
     def test_gt_bound_survives_onto_claim(self):
         # a numeric Gt template requirement must reach the created
         # claim as Gt, not collapse to Exists (the provider re-checks
