@@ -232,11 +232,21 @@ class Operator:
                 target = node_name
                 if self.cluster.node_for_name(node_name) is None:
                     claim = self.kube.get_node_claim(node_name)
-                    if claim is not None:
+                    if claim is not None and claim.metadata.deletion_timestamp is None:
                         target = claim.status.node_name
                         if not target:
                             unbound = True
                             continue
+                    elif not any(
+                        n.metadata.name == node_name
+                        for n in self.kube.nodes()
+                    ):
+                        # the claim died (ICE/liveness) before its node
+                        # existed, or the node vanished: never bind to
+                        # a name that will not materialize — re-queue
+                        # the pods through the batcher instead
+                        self.provisioner.batcher.trigger(now=now)
+                        continue
                 for pod in pods:
                     live = self.kube.get_pod(pod.metadata.namespace, pod.metadata.name)
                     if live is not None and not live.spec.node_name:

@@ -44,6 +44,11 @@ log = logging.getLogger("karpenter.provisioner")
 
 _claim_counter = itertools.count(1)
 
+# Runtime default for NodeClaim terminationGracePeriod when the pool
+# template leaves it unset — providers set this once at startup
+# (nodeclaimtemplate.go:34-37,119). Seconds; None = no default.
+DEFAULT_TERMINATION_GRACE_PERIOD: Optional[float] = None
+
 
 def _specs_from_requirement(req: Requirement, relaxed: bool) -> list[RequirementSpec]:
     """Serialize one algebraic Requirement back into claim spec
@@ -344,7 +349,11 @@ class Provisioner:
                 startup_taints=list(pool.spec.template.spec.startup_taints),
                 node_class_ref=pool.spec.template.spec.node_class_ref,
                 expire_after=pool.spec.template.spec.expire_after,
-                termination_grace_period=pool.spec.template.spec.termination_grace_period,
+                termination_grace_period=(
+                    pool.spec.template.spec.termination_grace_period
+                    if pool.spec.template.spec.termination_grace_period is not None
+                    else DEFAULT_TERMINATION_GRACE_PERIOD
+                ),
             ),
         )
         claim.metadata.annotations["karpenter.sh/nodepool-hash"] = pool.hash()

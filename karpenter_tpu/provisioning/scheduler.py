@@ -270,13 +270,59 @@ class Scheduler:
                 resutil.subtract(available, reserve)
             )
         return ExistingNodeInput(
-            name=node.name or (node.node_claim.metadata.name if node.node_claim else ""),
+            name=_state_node_key(node),
             requirements=reqs,
             taints=tuple(node.taints()),
             available=available,
             pool_name=node.nodepool_name(),
             pod_count=len(node.pod_keys),
         )
+
+    def _accept_solution(
+        self, solution: Solution, open_plans: list, results: SchedulerResults,
+        round_in_use: dict[str, int],
+    ) -> None:
+        """Fold a batched Solution into the round's results: accept
+        new plans and commit existing-node assignments (keyed via
+        _state_node_key so in-flight nodes key by claim name)."""
+        self._accept_plans(
+            solution.new_nodes, open_plans, results, round_in_use
+        )
+        for a in solution.existing:
+            node = self.state_nodes[a.existing_index]
+            results.existing_assignments.setdefault(
+                _state_node_key(node), []
+            ).extend(a.pods)
+            for p in a.pods:
+                self._commit_existing(a.existing_index, p)
+
+    def _daemon_expected(
+        self, node_reqs: Requirements, taints: list
+    ) -> dict[str, float]:
+        """Total requests of daemonsets whose pods can land on a node
+        with these taints/labels (isDaemonPodCompatibleWithNode,
+        scheduler.go:708-717) — the one filter shared by new-node
+        overhead budgeting and existing-node reservation."""
+        from karpenter_tpu.utils.pod import has_dra_requirements
+
+        expected: dict[str, float] = {}
+        for ds in self.daemonsets:
+            pod = Pod(spec=ds.spec.template.spec)
+            pod.metadata.labels = dict(ds.spec.template.metadata.labels)
+            # a DRA daemon pod can never be scheduled by us, so its
+            # requests must not inflate any budget
+            # (shouldSkipDaemonPod, scheduler.go:702-705)
+            if self.ignore_dra_requests and has_dra_requirements(pod):
+                continue
+            if tolerates_pod(taints, pod) is not None:
+                continue
+            if not node_reqs.is_compatible(
+                Requirements.from_pod(pod, required_only=True),
+                allow_undefined=WELL_KNOWN_LABELS,
+            ):
+                continue
+            expected = resutil.merge(expected, resutil.pod_requests(pod))
+        return expected
 
     def _daemon_reserve(self, node: StateNode) -> dict[str, float]:
         """Capacity still owed to daemonsets on this node: the
@@ -291,24 +337,9 @@ class Scheduler:
         cached = self._daemon_reserve_cache.get(cache_key)
         if cached is not None:
             return cached
-        from karpenter_tpu.utils.pod import has_dra_requirements
-
-        taints = list(node.taints())
-        node_reqs = Requirements.from_labels(node.labels())
-        expected: dict[str, float] = {}
-        for ds in self.daemonsets:
-            pod = Pod(spec=ds.spec.template.spec)
-            pod.metadata.labels = dict(ds.spec.template.metadata.labels)
-            if self.ignore_dra_requests and has_dra_requirements(pod):
-                continue
-            if tolerates_pod(taints, pod) is not None:
-                continue
-            if not node_reqs.is_compatible(
-                Requirements.from_pod(pod, required_only=True),
-                allow_undefined=WELL_KNOWN_LABELS,
-            ):
-                continue
-            expected = resutil.merge(expected, resutil.pod_requests(pod))
+        expected = self._daemon_expected(
+            Requirements.from_labels(node.labels()), list(node.taints())
+        )
         # net of daemon pods already bound to the node — cluster state
         # tracks these (terminal pods excluded) so the reservation is
         # not re-derived from the raw pod list
@@ -323,36 +354,17 @@ class Scheduler:
     def _daemon_overhead(self) -> dict[str, dict[str, float]]:
         """Per-pool daemonset resource overhead (scheduler.go:772-803):
         sum requests of daemon pods whose scheduling terms admit the
-        pool template."""
+        pool template. Uses the same full-compatibility filter
+        (undefined-key rules included) as the existing-node
+        reservation, via _daemon_expected."""
         from karpenter_tpu.solver.encode import pool_template_requirements
-        from karpenter_tpu.utils.pod import has_dra_requirements
 
         out: dict[str, dict[str, float]] = {}
         for pool, types in self.pools_with_types:
-            template_reqs = pool_template_requirements(pool, with_pool_pin=True)
-            taints = list(pool.spec.template.spec.taints)
-            total: dict[str, float] = {}
-            for ds in self.daemonsets:
-                pod = Pod(spec=ds.spec.template.spec)
-                pod.metadata.labels = dict(ds.spec.template.metadata.labels)
-                # a DRA daemon pod can never be scheduled by us, so
-                # its requests must not inflate the overhead budget
-                # (shouldSkipDaemonPod, scheduler.go:702-705)
-                if self.ignore_dra_requests and has_dra_requirements(pod):
-                    continue
-                if tolerates_pod(taints, pod) is not None:
-                    continue
-                pod_reqs = Requirements.from_pod(pod, required_only=True)
-                # full compatibility, not bare intersection: a daemonset
-                # requiring a custom label the template never defines
-                # can never land on the pool's nodes, so its overhead
-                # must not be budgeted (scheduler.go:772-803 uses
-                # IsCompatible with the undefined-key rules)
-                if not template_reqs.is_compatible(
-                    pod_reqs, allow_undefined=WELL_KNOWN_LABELS
-                ):
-                    continue
-                total = resutil.merge(total, resutil.pod_requests(pod))
+            total = self._daemon_expected(
+                pool_template_requirements(pool, with_pool_pin=True),
+                list(pool.spec.template.spec.taints),
+            )
             if total:
                 out[pool.metadata.name] = total
         return out
@@ -466,40 +478,42 @@ class Scheduler:
         open_plans: list[NodePlan] = []
         if simple:
             solution = self._batched_solve(simple, reserved_in_use=round_in_use)
-            self._accept_plans(solution.new_nodes, open_plans, results, round_in_use)
-            for assignment in solution.existing:
-                node = self.state_nodes[assignment.existing_index]
-                results.existing_assignments.setdefault(
-                    _state_node_key(node), []
-                ).extend(
-                    assignment.pods
-                )
-                for pod in assignment.pods:
-                    self._commit_existing(assignment.existing_index, pod)
+            self._accept_solution(solution, open_plans, results, round_in_use)
+
+            # k-way-evicted pods are schedulable alone: re-solve them
+            # in BATCHES (same-group pods stay co-placed) until none
+            # remain — every pass admits at least its first group, so
+            # the loop shrinks; kernel-infeasible stragglers fall
+            # through to the relaxation ladder below
             evicted_keys = {p.key for p in solution.evicted}
-            for pod in solution.unschedulable:
+            evicted = list(solution.evicted)
+            still_failed: list[Pod] = []
+            rounds = 0
+            while evicted and rounds < 16 and not self._timed_out():
+                retry = self._batched_solve(
+                    evicted, reserved_in_use=round_in_use
+                )
+                self._accept_solution(
+                    retry, open_plans, results, round_in_use
+                )
+                re_evicted = {p.key for p in retry.evicted}
+                still_failed.extend(
+                    p for p in retry.unschedulable
+                    if p.key not in re_evicted
+                )
+                evicted = list(retry.evicted)
+                rounds += 1
+            still_failed.extend(evicted)  # bound hit / timed out
+
+            pending = [
+                p for p in solution.unschedulable
+                if p.key not in evicted_keys
+            ] + still_failed
+            for pod in pending:
                 retried = False
                 if self._timed_out():
                     results.errors[pod.key] = TIMEOUT_ERROR
                     continue
-                if pod.key in evicted_keys:
-                    # displaced by the k-way requirement check, not
-                    # infeasible: retry as-is before any relaxation
-                    retry = self._batched_solve(
-                        [pod], reserved_in_use=round_in_use
-                    )
-                    if not retry.unschedulable:
-                        self._accept_plans(
-                            retry.new_nodes, open_plans, results, round_in_use
-                        )
-                        for a in retry.existing:
-                            node = self.state_nodes[a.existing_index]
-                            results.existing_assignments.setdefault(
-                                _state_node_key(node), []
-                            ).extend(a.pods)
-                            for p in a.pods:
-                                self._commit_existing(a.existing_index, p)
-                        continue
                 if self.honor_preferences:
                     relaxed = relax(pod)
                     if relaxed:
@@ -508,16 +522,9 @@ class Scheduler:
                             reserved_in_use=round_in_use,
                         )
                         if not retry.unschedulable:
-                            self._accept_plans(
-                                retry.new_nodes, open_plans, results, round_in_use
+                            self._accept_solution(
+                                retry, open_plans, results, round_in_use
                             )
-                            for a in retry.existing:
-                                node = self.state_nodes[a.existing_index]
-                                results.existing_assignments.setdefault(
-                                    _state_node_key(node), []
-                                ).extend(a.pods)
-                                for p in a.pods:
-                                    self._commit_existing(a.existing_index, p)
                             retried = True
                 if not retried:
                     results.errors[pod.key] = "no compatible instance types or nodes"

@@ -198,7 +198,15 @@ class Environment:
                 claim = self.kube.get_node_claim(node_name)
                 target = claim.status.node_name if claim is not None else ""
                 if not target and claim is None:
-                    target = node_name  # plain existing node, no claim
+                    # plain existing node — but only if it actually
+                    # exists; a dead claim's key must leave the pods
+                    # pending for re-planning, never pin them to a
+                    # name that will not materialize
+                    if any(
+                        n.metadata.name == node_name
+                        for n in self.kube.nodes()
+                    ):
+                        target = node_name
                 if not target:
                     continue
             for pod in pods:

@@ -205,6 +205,48 @@ class TestDaemonSets:
         assert len(env.kube.node_claims()) == 2
 
 
+def _tgp_types():
+    return [make_instance_type("c4", cpu=4)]
+
+
+class TestTerminationGracePeriodDefaulting:
+    """provisioning/suite_test.go:244-279 — claim TGP resolution:
+    pool value > global runtime default > nil."""
+
+    def _tgp(self, env):
+        env.provision(mk_pod())
+        return env.kube.node_claims()[0].spec.termination_grace_period
+
+    def test_global_default_used_when_pool_unset(self):
+        from karpenter_tpu.provisioning import provisioner as prov_mod
+
+        env = Environment(types=_tgp_types())
+        env.kube.create(mk_nodepool("default"))
+        prov_mod.DEFAULT_TERMINATION_GRACE_PERIOD = 98 * 3600.0
+        try:
+            assert self._tgp(env) == 98 * 3600.0
+        finally:
+            prov_mod.DEFAULT_TERMINATION_GRACE_PERIOD = None
+
+    def test_nil_when_neither_set(self):
+        env = Environment(types=_tgp_types())
+        env.kube.create(mk_nodepool("default"))
+        assert self._tgp(env) is None
+
+    def test_pool_value_wins_over_global(self):
+        from karpenter_tpu.provisioning import provisioner as prov_mod
+
+        env = Environment(types=_tgp_types())
+        pool = mk_nodepool("default")
+        pool.spec.template.spec.termination_grace_period = 60.0
+        env.kube.create(pool)
+        prov_mod.DEFAULT_TERMINATION_GRACE_PERIOD = 98 * 3600.0
+        try:
+            assert self._tgp(env) == 60.0
+        finally:
+            prov_mod.DEFAULT_TERMINATION_GRACE_PERIOD = None
+
+
 class TestBatcher:
     def test_idle_window_fires(self):
         # suite_test.go:118
