@@ -303,6 +303,7 @@ class DisruptionEngine:
             allow_reserved=self.options.feature_gates.reserved_capacity,
             min_values_policy=self.options.min_values_policy,
             ignore_dra_requests=self.options.ignore_dra_requests,
+            metrics_controller="disruption",
             kube=self.kube,
             clock=self.clock,
         )

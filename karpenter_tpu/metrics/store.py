@@ -147,6 +147,24 @@ DISRUPTION_EVALUATION_DURATION = REGISTRY.histogram(
     "karpenter_disruption_evaluation_duration_seconds",
     "Disruption method evaluation wall clock")
 
+# scheduler subsystem (provisioning/scheduling/metrics.go:33-95)
+SCHEDULER_SCHEDULING_DURATION = REGISTRY.histogram(
+    "karpenter_scheduler_scheduling_duration_seconds",
+    "Duration of scheduling simulations (provisioning and disruption)")
+SCHEDULER_QUEUE_DEPTH = REGISTRY.gauge(
+    "karpenter_scheduler_queue_depth",
+    "Pods currently waiting to be scheduled in an active solve")
+SCHEDULER_UNFINISHED_WORK = REGISTRY.gauge(
+    "karpenter_scheduler_unfinished_work_seconds",
+    "Seconds of in-progress solve work not yet observed by the "
+    "duration histogram")
+SCHEDULER_IGNORED_PODS = REGISTRY.gauge(
+    "karpenter_scheduler_ignored_pods_count",
+    "Pods ignored during scheduling (foreign scheduler, invalid PVCs)")
+SCHEDULER_UNSCHEDULABLE_PODS = REGISTRY.gauge(
+    "karpenter_scheduler_unschedulable_pods_count",
+    "Pods the last solve could not place")
+
 
 class Store:
     """Diff-publishing gauge set per object (store.go:33-110): Update

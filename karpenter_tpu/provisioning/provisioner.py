@@ -138,7 +138,10 @@ class Provisioner:
     # -- pod intake (provisioner.go:172-195, utils/node) ----------------------
 
     def get_pending_pods(self) -> list[Pod]:
+        from karpenter_tpu.metrics.store import SCHEDULER_IGNORED_PODS
+
         out = []
+        ignored = 0
         for pod in self.kube.pods():
             if pod.is_terminal() or pod.is_terminating():
                 continue
@@ -150,6 +153,7 @@ class Provisioner:
                 "default-scheduler",
                 "karpenter",
             ):
+                ignored += 1
                 continue
             if pod.spec.volumes:
                 # kube-scheduler-rejected PVC states filter at intake
@@ -159,8 +163,10 @@ class Provisioner:
                     log.debug(
                         "pod %s not provisionable: %s", pod.key, reason
                     )
+                    ignored += 1
                     continue
             out.append(pod)
+        SCHEDULER_IGNORED_PODS.set(float(ignored))
         return out
 
     def reschedulable_pods_from_deleting_nodes(self) -> list[Pod]:

@@ -39,6 +39,12 @@ from karpenter_tpu.cloudprovider.types import (
     truncate,
 )
 from karpenter_tpu.kube.objects import Pod
+from karpenter_tpu.metrics.store import (
+    SCHEDULER_QUEUE_DEPTH,
+    SCHEDULER_SCHEDULING_DURATION,
+    SCHEDULER_UNFINISHED_WORK,
+    SCHEDULER_UNSCHEDULABLE_PODS,
+)
 from karpenter_tpu.scheduling.hostports import HostPortUsage, pod_host_ports
 from karpenter_tpu.scheduling.volumeusage import VolumeUsage, pod_volume_drivers
 from karpenter_tpu.provisioning import volume_topology
@@ -139,9 +145,13 @@ class Scheduler:
         clock=None,
         solve_timeout: float = SOLVE_TIMEOUT_SECONDS,
         ignore_dra_requests: bool = True,
+        metrics_controller: str = "provisioner",
     ):
         self.min_values_policy = min_values_policy
         self.ignore_dra_requests = ignore_dra_requests
+        self.metrics_controller = metrics_controller
+        self._solve_start = 0.0
+        self._last_progress_publish = 0.0
         self.kube = kube
         import time as _time
 
@@ -407,9 +417,51 @@ class Scheduler:
     # -- solve ----------------------------------------------------------------
 
     def _timed_out(self) -> bool:
-        return self._deadline is not None and self.clock() > self._deadline
+        if self._deadline is None:
+            return False
+        now = self.clock()
+        # progress gauge for the in-flight solve (unfinished_work_
+        # seconds), published at most once a second — this predicate
+        # runs once per pod on the slow path and must stay a cheap
+        # comparison
+        if now - self._last_progress_publish >= 1.0:
+            self._last_progress_publish = now
+            SCHEDULER_UNFINISHED_WORK.set(
+                now - self._solve_start,
+                {"controller": self.metrics_controller},
+            )
+        return now > self._deadline
 
     def solve(self, pods: Sequence[Pod]) -> SchedulerResults:
+        # scheduler-subsystem metrics wrap the whole solve, labeled by
+        # controller so disruption SIMULATIONS never stomp the
+        # provisioner's series (provisioning/scheduling/metrics.go:33-95
+        # uses the same ControllerLabel disambiguation)
+        labels = {"controller": self.metrics_controller}
+        self._solve_start = self.clock()
+        self._last_progress_publish = self._solve_start
+        SCHEDULER_QUEUE_DEPTH.set(float(len(pods)), labels)
+        SCHEDULER_UNFINISHED_WORK.set(0.0, labels)
+        results: Optional[SchedulerResults] = None
+        try:
+            results = self._solve(pods)
+            return results
+        finally:
+            SCHEDULER_QUEUE_DEPTH.set(0.0, labels)
+            SCHEDULER_UNFINISHED_WORK.set(0.0, labels)
+            SCHEDULER_SCHEDULING_DURATION.observe(
+                self.clock() - self._solve_start, labels
+            )
+            if results is not None:
+                SCHEDULER_UNSCHEDULABLE_PODS.set(
+                    float(len(results.errors)), labels
+                )
+            else:
+                # the solve died: drop the series rather than leave a
+                # count from a different run next to a fresh duration
+                SCHEDULER_UNSCHEDULABLE_PODS.delete(labels)
+
+    def _solve(self, pods: Sequence[Pod]) -> SchedulerResults:
         # best-effort wall-clock bound for the whole round
         # (provisioner.go:365-368); work completed before the deadline
         # is kept, pods not yet placed report TIMEOUT_ERROR

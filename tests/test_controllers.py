@@ -322,6 +322,35 @@ class TestOperatorRuntime:
         assert all(o.price == 1.0 for it in out for o in it.offerings)
 
 
+class TestSchedulerMetrics:
+    """Scheduler-subsystem series (provisioning/scheduling/metrics.go:
+    33-95): duration histogram, queue depth, unschedulable and
+    ignored pod gauges, all updated by a real solve."""
+
+    def test_solve_updates_scheduler_series(self):
+        from karpenter_tpu.cloudprovider.fake import make_instance_type
+        from karpenter_tpu.metrics.store import (
+            SCHEDULER_IGNORED_PODS,
+            SCHEDULER_QUEUE_DEPTH,
+            SCHEDULER_SCHEDULING_DURATION,
+            SCHEDULER_UNSCHEDULABLE_PODS,
+        )
+        from karpenter_tpu.testing import Environment, mk_nodepool, mk_pod
+
+        env = Environment(types=[make_instance_type("c4", cpu=4)])
+        env.kube.create(mk_nodepool("p"))
+        before = SCHEDULER_SCHEDULING_DURATION.count({"controller": "provisioner"})
+        foreign = mk_pod(name="foreign")
+        foreign.spec.scheduler_name = "other-scheduler"
+        env.provision(mk_pod(name="ok"), mk_pod(name="giant", cpu=999.0),
+                      foreign)
+        labels = {"controller": "provisioner"}
+        assert SCHEDULER_SCHEDULING_DURATION.count(labels) > before
+        assert SCHEDULER_QUEUE_DEPTH.value(labels) == 0.0  # solve finished
+        assert SCHEDULER_UNSCHEDULABLE_PODS.value(labels) == 1.0  # the giant
+        assert SCHEDULER_IGNORED_PODS.value() == 1.0  # foreign scheduler
+
+
 class TestMetricsControllers:
     """metrics/{pod,node,nodepool} gauge republishing + latency
     histograms (controllers/metrics/pod/controller.go and siblings)."""
