@@ -34,6 +34,7 @@ from karpenter_tpu.kube.client import KubeClient
 from karpenter_tpu.kube.objects import ObjectMeta, Pod
 from karpenter_tpu.provisioning.scheduler import Scheduler, SchedulerResults
 from karpenter_tpu.apis.v1.labels import is_restricted_label
+from karpenter_tpu.metrics.store import SCHEDULER_IGNORED_PODS
 from karpenter_tpu.scheduling.requirement import IN, Requirement
 from karpenter_tpu.scheduling.requirements import Requirements
 from karpenter_tpu.solver.solver import NodePlan
@@ -138,8 +139,6 @@ class Provisioner:
     # -- pod intake (provisioner.go:172-195, utils/node) ----------------------
 
     def get_pending_pods(self) -> list[Pod]:
-        from karpenter_tpu.metrics.store import SCHEDULER_IGNORED_PODS
-
         out = []
         ignored = 0
         for pod in self.kube.pods():

@@ -426,10 +426,7 @@ class Scheduler:
         # comparison
         if now - self._last_progress_publish >= 1.0:
             self._last_progress_publish = now
-            SCHEDULER_UNFINISHED_WORK.set(
-                now - self._solve_start,
-                {"controller": self.metrics_controller},
-            )
+            self._publish_progress()
         return now > self._deadline
 
     def solve(self, pods: Sequence[Pod]) -> SchedulerResults:
@@ -440,7 +437,6 @@ class Scheduler:
         labels = {"controller": self.metrics_controller}
         self._solve_start = self.clock()
         self._last_progress_publish = self._solve_start
-        SCHEDULER_QUEUE_DEPTH.set(float(len(pods)), labels)
         SCHEDULER_UNFINISHED_WORK.set(0.0, labels)
         results: Optional[SchedulerResults] = None
         try:
@@ -518,6 +514,11 @@ class Scheduler:
         results = SchedulerResults(new_node_plans=[], existing_assignments={})
         for pod in dra_rejected:
             results.errors[pod.key] = DRA_ERROR
+        # queue depth counts pods actually entering the solve (gated
+        # pods never wait); drained at phase boundaries
+        self._publish_progress(
+            len(simple) + len(complex_) + len(volume_limited)
+        )
 
         # reservation budget for THIS round: live usage plus every plan
         # opened during the round, batched or per-pod, so later
@@ -561,6 +562,11 @@ class Scheduler:
                 p for p in solution.unschedulable
                 if p.key not in evicted_keys
             ] + still_failed
+            # the fast path drained: what's left is the retry backlog
+            # plus the slower paths
+            self._publish_progress(
+                len(pending) + len(complex_) + len(volume_limited)
+            )
             for pod in pending:
                 retried = False
                 if self._timed_out():
@@ -725,12 +731,25 @@ class Scheduler:
                 out[pod_key] = mapping
         return out
 
+    def _publish_progress(self, queue_depth: Optional[int] = None) -> None:
+        """Publish the in-flight solve's progress gauges. Called at
+        phase boundaries (device solves are single blocking calls, so
+        their interior cannot be sampled without a watcher thread —
+        the gauges reflect the last boundary)."""
+        labels = {"controller": self.metrics_controller}
+        SCHEDULER_UNFINISHED_WORK.set(
+            self.clock() - self._solve_start, labels
+        )
+        if queue_depth is not None:
+            SCHEDULER_QUEUE_DEPTH.set(float(queue_depth), labels)
+
     def _batched_solve(
         self,
         pods: Sequence[Pod],
         required_only: bool = False,
         reserved_in_use: Optional[dict[str, int]] = None,
     ) -> Solution:
+        self._publish_progress()
         groups = group_pods(pods, required_only=required_only)
         enc = encode(
             groups,

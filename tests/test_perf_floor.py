@@ -1,0 +1,79 @@
+"""Performance-floor tests (SURVEY §4 tier 4).
+
+The reference encodes its throughput contract as a build-tagged
+benchmark with `MinPodsPerSec = 100.0`
+(provisioning/scheduling/scheduling_benchmark_test.go:58,77-109): a
+matrix of diverse pods against a synthetic catalog must schedule at
+100+ pods/sec. The same floor is asserted here on the CPU backend —
+the TPU path only gets faster — over the same kind of diverse mix,
+steady-state (one warm solve per shape first).
+"""
+
+import os
+import time
+
+import pytest
+
+from karpenter_tpu.apis.v1.nodepool import NodePool
+from karpenter_tpu.cloudprovider.fake import instance_types
+from karpenter_tpu.kube.objects import Container, ObjectMeta, Pod, PodSpec
+from karpenter_tpu.solver.solver import solve
+
+MIN_PODS_PER_SEC = 100.0
+
+SHAPES = [
+    (0.5, 1.0), (1.0, 2.0), (2.0, 4.0), (4.0, 8.0),
+    (2.0, 0.5), (0.25, 4.0),
+]
+
+
+def diverse_pods(n: int) -> list[Pod]:
+    out = []
+    for i in range(n):
+        cpu, mem_gib = SHAPES[i % len(SHAPES)]
+        selector = {}
+        if i % 4 == 0:
+            selector["kubernetes.io/arch"] = "amd64"
+        out.append(Pod(
+            metadata=ObjectMeta(name=f"b-{i}"),
+            spec=PodSpec(
+                containers=[Container(requests={
+                    "cpu": cpu, "memory": mem_gib * 2**30,
+                })],
+                node_selector=selector,
+            ),
+        ))
+    return out
+
+
+@pytest.mark.parametrize(
+    "n_pods",
+    [
+        100,
+        1000,
+        # the large case mirrors the reference's build-tag gating
+        # (test_performance): opt in via env to keep shared CI stable
+        pytest.param(
+            5000,
+            marks=pytest.mark.skipif(
+                not os.environ.get("KARPENTER_PERF_TESTS"),
+                reason="set KARPENTER_PERF_TESTS=1 (reference gates "
+                       "its benchmark behind a build tag)",
+            ),
+        ),
+    ],
+)
+def test_scheduling_throughput_floor(n_pods):
+    pool = NodePool(metadata=ObjectMeta(name="default"))
+    pools = [(pool, instance_types(100))]
+    pods = diverse_pods(n_pods)
+    solve(pods, pools, objective="ffd")  # warm: compile the shapes
+    t0 = time.perf_counter()
+    sol = solve(pods, pools, objective="ffd")
+    wall = time.perf_counter() - t0
+    scheduled = sum(len(p.pods) for p in sol.new_nodes) + sum(
+        len(e.pods) for e in sol.existing
+    )
+    assert scheduled == n_pods
+    rate = scheduled / wall if wall > 0 else float("inf")
+    assert rate >= MIN_PODS_PER_SEC, f"{rate:.0f} pods/s below floor"
