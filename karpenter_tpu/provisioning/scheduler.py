@@ -426,7 +426,7 @@ class Scheduler:
         # comparison
         if now - self._last_progress_publish >= 1.0:
             self._last_progress_publish = now
-            self._publish_progress()
+            self._publish_progress(now=now)
         return now > self._deadline
 
     def solve(self, pods: Sequence[Pod]) -> SchedulerResults:
@@ -689,6 +689,7 @@ class Scheduler:
 
         # slow path: per-pod with topology + volume-limit filtering
         deferred.extend(volume_limited)
+        self._publish_progress(len(deferred))
         if deferred:
             self._solve_complex(
                 deferred, open_plans, topology_full, results, round_in_use
@@ -731,14 +732,20 @@ class Scheduler:
                 out[pod_key] = mapping
         return out
 
-    def _publish_progress(self, queue_depth: Optional[int] = None) -> None:
+    def _publish_progress(
+        self, queue_depth: Optional[int] = None,
+        now: Optional[float] = None,
+    ) -> None:
         """Publish the in-flight solve's progress gauges. Called at
         phase boundaries (device solves are single blocking calls, so
         their interior cannot be sampled without a watcher thread —
-        the gauges reflect the last boundary)."""
+        the gauges reflect the last boundary). `now` lets callers that
+        already read the clock avoid a second read (stepping fake
+        clocks would otherwise advance per publish)."""
         labels = {"controller": self.metrics_controller}
         SCHEDULER_UNFINISHED_WORK.set(
-            self.clock() - self._solve_start, labels
+            (self.clock() if now is None else now) - self._solve_start,
+            labels,
         )
         if queue_depth is not None:
             SCHEDULER_QUEUE_DEPTH.set(float(queue_depth), labels)

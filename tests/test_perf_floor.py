@@ -14,10 +14,9 @@ import time
 
 import pytest
 
-from karpenter_tpu.apis.v1.nodepool import NodePool
 from karpenter_tpu.cloudprovider.fake import instance_types
-from karpenter_tpu.kube.objects import Container, ObjectMeta, Pod, PodSpec
 from karpenter_tpu.solver.solver import solve
+from karpenter_tpu.testing import mk_nodepool, mk_pod
 
 MIN_PODS_PER_SEC = 100.0
 
@@ -27,21 +26,16 @@ SHAPES = [
 ]
 
 
-def diverse_pods(n: int) -> list[Pod]:
+def diverse_pods(n: int) -> list:
     out = []
     for i in range(n):
         cpu, mem_gib = SHAPES[i % len(SHAPES)]
-        selector = {}
-        if i % 4 == 0:
-            selector["kubernetes.io/arch"] = "amd64"
-        out.append(Pod(
-            metadata=ObjectMeta(name=f"b-{i}"),
-            spec=PodSpec(
-                containers=[Container(requests={
-                    "cpu": cpu, "memory": mem_gib * 2**30,
-                })],
-                node_selector=selector,
-            ),
+        selector = (
+            {"kubernetes.io/arch": "amd64"} if i % 4 == 0 else None
+        )
+        out.append(mk_pod(
+            name=f"b-{i}", cpu=cpu, memory=mem_gib * 2**30,
+            node_selector=selector,
         ))
     return out
 
@@ -64,8 +58,7 @@ def diverse_pods(n: int) -> list[Pod]:
     ],
 )
 def test_scheduling_throughput_floor(n_pods):
-    pool = NodePool(metadata=ObjectMeta(name="default"))
-    pools = [(pool, instance_types(100))]
+    pools = [(mk_nodepool("default"), instance_types(100))]
     pods = diverse_pods(n_pods)
     solve(pods, pools, objective="ffd")  # warm: compile the shapes
     t0 = time.perf_counter()
