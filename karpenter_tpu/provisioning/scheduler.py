@@ -388,23 +388,36 @@ class Scheduler:
         from karpenter_tpu.solver.encode import pool_template_requirements
 
         domains: dict[str, set[str]] = {}
+        # per-domain taint provenance: one taint tuple per SOURCE
+        # (pool template or live node) contributing the domain —
+        # consumed by nodeTaintsPolicy=Honor spread constraints
+        domain_taints: dict[str, dict[str, list]] = {}
+
+        def record(key: str, value: str, taints) -> None:
+            domains.setdefault(key, set()).add(value)
+            domain_taints.setdefault(key, {}).setdefault(value, []).append(
+                tuple(taints)
+            )
+
         for pool, types in self.pools_with_types:
             pool_reqs = pool_template_requirements(pool)
+            pool_taints = tuple(pool.spec.template.spec.taints)
             for it in types:
                 for key in (TOPOLOGY_ZONE_LABEL, CAPACITY_TYPE_LABEL):
                     req = it.requirements.get(key)
                     if req.operator() == IN:
                         gate = pool_reqs.get(key)
-                        domains.setdefault(key, set()).update(
-                            v for v in req.values if gate.has(v)
-                        )
+                        for v in req.values:
+                            if gate.has(v):
+                                record(key, v, pool_taints)
         pod_domains: dict[str, dict[str, str]] = {}
         for node in self.state_nodes:
             labels = node.labels()
+            node_taints = tuple(node.taints())
             for key, value in labels.items():
-                domains.setdefault(key, set()).add(value)
+                record(key, value, node_taints)
             if node.name:
-                domains.setdefault(HOSTNAME_LABEL, set()).add(node.name)
+                record(HOSTNAME_LABEL, node.name, node_taints)
             for pod_key in node.pod_keys:
                 mapping = {k: v for k, v in labels.items()}
                 mapping[HOSTNAME_LABEL] = node.name
@@ -412,7 +425,8 @@ class Scheduler:
         scheduled = [p for p in self.cluster_pods if p.spec.node_name]
         return Topology(domains=domains, cluster_pods=scheduled, pending_pods=[],
                         pod_domains=pod_domains,
-                        honor_schedule_anyway=self.honor_preferences)
+                        honor_schedule_anyway=self.honor_preferences,
+                        domain_taints=domain_taints)
 
     # -- solve ----------------------------------------------------------------
 
@@ -487,6 +501,7 @@ class Scheduler:
             pending_pods=list(pods),
             pod_domains=self._pod_domains(),
             honor_schedule_anyway=self.honor_preferences,
+            domain_taints=self.topology.domain_taints,
         )
         simple: list[Pod] = []
         complex_: list[Pod] = []
@@ -588,7 +603,10 @@ class Scheduler:
                     results.errors[pod.key] = "no compatible instance types or nodes"
             for plan in open_plans:
                 for pod in plan.pods:
-                    topology_full.register(pod, self._plan_domains(plan))
+                    topology_full.register(
+                        pod, self._plan_domains(plan),
+                        source_taints=tuple(plan.pool.spec.template.spec.taints),
+                    )
 
         # topology path: lower spread/affinity/ports to solver-native
         # form (domain pins + per-node caps + group conflicts) and run
@@ -970,7 +988,10 @@ class Scheduler:
             if pod_host_ports(pod):
                 self._host_ports[f"planned-{id(plan)}"].add(pod)
             plan.pods.append(pod)
-            topology.register(pod, self._plan_domains(plan))
+            topology.register(
+                pod, self._plan_domains(plan),
+                source_taints=tuple(plan.pool.spec.template.spec.taints),
+            )
             return True
 
         # 3) new node — permanent template taints only; startupTaints
@@ -979,15 +1000,29 @@ class Scheduler:
             taints = tuple(pool.spec.template.spec.taints)
             if tolerates_pod(list(taints), pod) is not None:
                 continue
+            # the pool's OWN template requirements (labels included)
+            # filter which types and offerings may launch under it —
+            # exactly as build_configs does for the batched path;
+            # without it this path can plan a node in a zone the pool
+            # forbids
+            from karpenter_tpu.solver.encode import pool_template_requirements
+
+            pool_reqs = pool_template_requirements(pool)
             fitting = []
             for it in types:
                 if it.requirements.intersects(pod_reqs) is not None:
+                    continue
+                if pool_reqs.intersects(it.requirements) is not None:
                     continue
                 overhead = self.daemon_overhead.get(pool.metadata.name, {})
                 need = resutil.merge(requests, overhead)
                 if not resutil.fits(need, it.allocatable):
                     continue
-                offerings = it.offerings.available().compatible(pod_reqs)
+                offerings = [
+                    o
+                    for o in it.offerings.available().compatible(pod_reqs)
+                    if pool_reqs.intersects(o.requirements) is None
+                ]
                 if not offerings:
                     continue
                 fitting.append((it, offerings))
@@ -1056,7 +1091,10 @@ class Scheduler:
                 usage = HostPortUsage()
                 usage.add(pod)
                 self._host_ports[f"planned-{id(plan)}"] = usage
-            topology.register(pod, self._plan_domains(plan))
+            topology.register(
+                pod, self._plan_domains(plan),
+                source_taints=tuple(plan.pool.spec.template.spec.taints),
+            )
             return True
         return False
 

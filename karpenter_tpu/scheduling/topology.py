@@ -42,6 +42,14 @@ class TopologyGroup:
     namespaces: frozenset[str]
     max_skew: int = 1
     min_domains: Optional[int] = None
+    # node-inclusion policies (topologynodefilter.go): affinity Honor
+    # (default) computes skew only over domains the pod can reach;
+    # Ignore counts every domain. Taints Ignore (default) counts all;
+    # Honor counts only domains reachable via tolerated taints.
+    node_affinity_policy: str = "Honor"
+    node_taints_policy: str = "Ignore"
+    # owner tolerations backing the taints=Honor filter
+    owner_tolerations: tuple = ()
     owners: set[str] = field(default_factory=set)   # pod keys owning it
     counts: dict[str, int] = field(default_factory=dict)  # domain -> matching pods
     # anti-affinity only: domains where an *owner* pod landed — future
@@ -63,6 +71,7 @@ class TopologyGroup:
         self,
         candidate_domains: Iterable[str],
         eligible: Optional[set[str]] = None,
+        taint_eligible: Optional[set[str]] = None,
     ) -> set[str]:
         """Domains where one more matching pod keeps the constraint
         satisfied (nextDomainTopologySpread topologygroup.go:226-311).
@@ -73,6 +82,20 @@ class TopologyGroup:
         could not land in."""
         candidates = set(candidate_domains)
         if self.type == TYPE_SPREAD:
+            if self.node_affinity_policy == "Ignore":
+                # skew is computed over EVERY domain, including ones
+                # the pod's own selector excludes (the caller still
+                # restricts actual placement via the candidate set)
+                eligible = None
+            if taint_eligible is not None:
+                # nodeTaintsPolicy=Honor: domains only reachable via
+                # taints the owner does not tolerate neither count in
+                # the skew minimum nor accept placement
+                # (topologynodefilter.go Matches)
+                eligible = (
+                    taint_eligible if eligible is None
+                    else eligible & taint_eligible
+                )
             if eligible is not None:
                 # a domain the pod's own required terms exclude is never
                 # a legal placement, and never part of the skew minimum
@@ -122,7 +145,7 @@ class TopologyGroup:
 
 
 def _spread_signature(pod: Pod, tsc: TopologySpreadConstraint) -> tuple:
-    return (
+    sig = (
         TYPE_SPREAD,
         tsc.topology_key,
         tsc.max_skew,
@@ -130,7 +153,15 @@ def _spread_signature(pod: Pod, tsc: TopologySpreadConstraint) -> tuple:
         tsc.when_unsatisfiable,
         tsc.label_selector,
         pod.metadata.namespace,
+        tsc.node_affinity_policy,
+        tsc.node_taints_policy,
     )
+    if tsc.node_taints_policy == "Honor":
+        # the taint filter is built from the OWNER pod's tolerations
+        # (MakeTopologyNodeFilter, topologynodefilter.go:38-65), so
+        # pods with different toleration sets cannot share a group
+        sig = sig + (tuple(pod.spec.tolerations),)
+    return sig
 
 
 def _term_signature(kind: str, pod: Pod, term: PodAffinityTerm) -> tuple:
@@ -148,6 +179,7 @@ class Topology:
         pending_pods: Iterable[Pod] = (),
         pod_domains: Optional[dict[str, dict[str, str]]] = None,
         honor_schedule_anyway: bool = True,
+        domain_taints: Optional[dict[str, dict[str, list]]] = None,
     ):
         """
         domains: topology key -> known domain values.
@@ -157,8 +189,21 @@ class Topology:
           pods (derived from their node's labels).
         honor_schedule_anyway: treat ScheduleAnyway spread constraints
           as required (relaxed later by the preference ladder).
+        domain_taints: topology key -> domain -> list of taint tuples,
+          one per SOURCE (pool template or live node) contributing the
+          domain; consumed by nodeTaintsPolicy=Honor constraints. A
+          domain absent from the map counts as reachable untainted.
         """
         self.domains = {k: set(v) for k, v in domains.items()}
+        # dedupe provenance: scheduler.record() appends one entry per
+        # (type, value) source; identical taint tuples collapse
+        self.domain_taints = {
+            key: {d: list(dict.fromkeys(srcs)) for d, srcs in per.items()}
+            for key, per in (domain_taints or {}).items()
+        }
+        # taint-eligibility caching (hot per-candidate-node loop)
+        self._domain_generation = 0
+        self._taint_elig_cache: dict[int, tuple[int, set]] = {}
         self.honor_schedule_anyway = honor_schedule_anyway
         self._groups: dict[tuple, TopologyGroup] = {}
         # required-only requirement sets, parsed once per pod per round
@@ -198,7 +243,10 @@ class Topology:
 
     def _ensure(self, sig: tuple, type_: str, key: str, selector: LabelSelector,
                 namespaces: Iterable[str], max_skew: int = 1,
-                min_domains: Optional[int] = None) -> TopologyGroup:
+                min_domains: Optional[int] = None,
+                node_affinity_policy: str = "Honor",
+                node_taints_policy: str = "Ignore",
+                owner_tolerations: tuple = ()) -> TopologyGroup:
         group = self._groups.get(sig)
         if group is None:
             group = TopologyGroup(
@@ -208,11 +256,42 @@ class Topology:
                 namespaces=frozenset(namespaces),
                 max_skew=max_skew,
                 min_domains=min_domains,
+                node_affinity_policy=node_affinity_policy,
+                node_taints_policy=node_taints_policy,
+                owner_tolerations=owner_tolerations,
             )
             for domain in self.domains.get(key, ()):  # known domains
                 group.register_domain(domain)
             self._groups[sig] = group
         return group
+
+    def _taint_eligible_domains(self, group: TopologyGroup) -> set[str]:
+        """Domains reachable through at least one source (pool or live
+        node) whose taints the group's owner tolerates. A domain with
+        no recorded taint provenance counts as reachable untainted.
+        Approximation vs the reference's per-NODE filter: counts from
+        pods already running behind intolerable taints still
+        contribute to domain totals (we track counts per domain, not
+        per node)."""
+        from karpenter_tpu.scheduling.taints import tolerates
+
+        cached = self._taint_elig_cache.get(id(group))
+        if cached is not None and cached[0] == self._domain_generation:
+            return cached[1]
+        provenance = self.domain_taints.get(group.key, {})
+        out = set()
+        for domain in self.domains.get(group.key, ()):
+            sources = provenance.get(domain)
+            if not sources:
+                out.add(domain)
+                continue
+            if any(
+                tolerates(list(src), list(group.owner_tolerations)) is None
+                for src in sources
+            ):
+                out.add(domain)
+        self._taint_elig_cache[id(group)] = (self._domain_generation, out)
+        return out
 
     def _groups_for_pod(self, pod: Pod, create: bool = False) -> list[TopologyGroup]:
         out = []
@@ -222,8 +301,14 @@ class Topology:
             sig = _spread_signature(pod, tsc)
             if create:
                 out.append(
-                    self._ensure(sig, TYPE_SPREAD, tsc.topology_key, tsc.label_selector,
-                                 (pod.metadata.namespace,), tsc.max_skew, tsc.min_domains)
+                    self._ensure(
+                        sig, TYPE_SPREAD, tsc.topology_key,
+                        tsc.label_selector, (pod.metadata.namespace,),
+                        tsc.max_skew, tsc.min_domains,
+                        node_affinity_policy=tsc.node_affinity_policy,
+                        node_taints_policy=tsc.node_taints_policy,
+                        owner_tolerations=tuple(pod.spec.tolerations),
+                    )
                 )
             elif sig in self._groups:
                 out.append(self._groups[sig])
@@ -303,7 +388,12 @@ class Topology:
             eligible = {
                 d for d in self.domains.get(group.key, ()) if gate.has(d)
             } or None
-            allowed = group.allowed_domains(domains, eligible=eligible)
+            taint_eligible = None
+            if group.node_taints_policy == "Honor":
+                taint_eligible = self._taint_eligible_domains(group)
+            allowed = group.allowed_domains(
+                domains, eligible=eligible, taint_eligible=taint_eligible
+            )
             if group.type == TYPE_AFFINITY and not group.has_occupied():
                 # first pod: legal only if the pod self-selects (it
                 # will satisfy its own affinity) — else any domain is
@@ -329,8 +419,22 @@ class Topology:
             result[group.key] = allowed
         return result
 
-    def register(self, pod: Pod, chosen: dict[str, str]) -> None:
-        """Commit a placement: update counts on all matching groups."""
+    def register(
+        self, pod: Pod, chosen: dict[str, str], source_taints: tuple = ()
+    ) -> None:
+        """Commit a placement: update counts on all matching groups.
+        `source_taints`: the placed node's taints, recorded as the new
+        domains' provenance so nodeTaintsPolicy=Honor constraints see
+        planned tainted nodes correctly."""
+        self._domain_generation += 1
+        for key, domain in chosen.items():
+            if domain not in self.domains.get(key, ()):
+                self.domains.setdefault(key, set()).add(domain)
+            srcs = self.domain_taints.setdefault(key, {}).setdefault(
+                domain, []
+            )
+            if tuple(source_taints) not in srcs:
+                srcs.append(tuple(source_taints))
         for group in self._groups.values():
             domain = chosen.get(group.key)
             if domain is None:

@@ -90,8 +90,20 @@ def prepare(
     """Partition constrained pods and lower each partition, or route it
     to `fallback` when the constraint mix is not expressible."""
     partitions: dict[tuple, _Partition] = {}
+    policy_fallback: list[Pod] = []
     for pod in pods:
         owned = topology._groups_for_pod(pod)
+        if any(
+            g.node_affinity_policy != "Honor"
+            or g.node_taints_policy != "Ignore"
+            for g in owned
+        ):
+            # non-default node-inclusion policies change the skew
+            # ACCOUNTING (not just placement), which the water-fill
+            # lowering does not express — the per-pod path implements
+            # them via TopologyGroup.allowed_domains
+            policy_fallback.append(pod)
+            continue
         owned_ids = frozenset(id(g) for g in owned)
         foreign = [
             g
@@ -110,7 +122,7 @@ def prepare(
 
     batch = TopoBatch(
         groups=[], group_cap=None, conflict=None, existing_quota=None,
-        assignments={}, fallback=[],
+        assignments={}, fallback=list(policy_fallback),
     )
     # local overlays so one prepare() run sees its own earlier
     # assignments without mutating the Topology before the solve

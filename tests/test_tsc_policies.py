@@ -1,0 +1,244 @@
+"""TopologySpreadConstraint node-inclusion policies.
+
+nodeAffinityPolicy / nodeTaintsPolicy semantics
+(topologynodefilter.go:38-95; topology_test.go policy families):
+which domains participate in the SKEW ACCOUNTING —
+
+- affinity Honor (default): only domains the pod's own selector /
+  required affinity can reach; Ignore: every domain, so an
+  unreachable empty domain pins the global minimum at 0.
+- taints Ignore (default): every domain; Honor: only domains
+  reachable through taints the pod tolerates.
+"""
+
+from karpenter_tpu.apis.v1.labels import TOPOLOGY_ZONE_LABEL
+from karpenter_tpu.cloudprovider.fake import make_instance_type
+from karpenter_tpu.kube.objects import (
+    LabelSelector,
+    Taint,
+    Toleration,
+    TopologySpreadConstraint,
+)
+from karpenter_tpu.testing import Environment, mk_nodepool, mk_pod
+
+ZONE = TOPOLOGY_ZONE_LABEL
+
+
+def spread_pod(name, *, affinity_policy="Honor", taints_policy="Ignore",
+               zones=None, tolerations=None):
+    pod = mk_pod(name=name, cpu=0.25)
+    pod.metadata.labels["app"] = "svc"
+    pod.spec.topology_spread_constraints = [
+        TopologySpreadConstraint(
+            max_skew=1,
+            topology_key=ZONE,
+            when_unsatisfiable="DoNotSchedule",
+            label_selector=LabelSelector.of({"app": "svc"}),
+            node_affinity_policy=affinity_policy,
+            node_taints_policy=taints_policy,
+        )
+    ]
+    if zones:
+        if isinstance(zones, str):
+            pod.spec.node_selector[ZONE] = zones
+        else:
+            from karpenter_tpu.kube.objects import (
+                Affinity,
+                NodeAffinity,
+                NodeSelectorRequirement,
+                NodeSelectorTerm,
+            )
+
+            pod.spec.affinity = Affinity(
+                node_affinity=NodeAffinity(
+                    required=(
+                        NodeSelectorTerm(
+                            match_expressions=(
+                                NodeSelectorRequirement(
+                                    key=ZONE, operator="In",
+                                    values=tuple(zones),
+                                ),
+                            )
+                        ),
+                    )
+                )
+            )
+    if tolerations:
+        pod.spec.tolerations = list(tolerations)
+    return pod
+
+
+def three_zone_env():
+    env = Environment(
+        types=[make_instance_type("c8", cpu=8,
+                                  zones=("test-zone-1", "test-zone-2",
+                                         "test-zone-3"))]
+    )
+    env.kube.create(mk_nodepool("default"))
+    return env
+
+
+class TestNodeAffinityPolicy:
+    def test_honor_skew_over_reachable_zones_only(self):
+        # default Honor: pods restricted to 2 of 3 zones can stack 2
+        # per reachable zone (the unreachable third zone is not part
+        # of the minimum)
+        env = three_zone_env()
+        pods = [
+            spread_pod(f"p{i}", zones=["test-zone-1", "test-zone-2"])
+            for i in range(4)
+        ]
+        results = env.provision(*pods)
+        assert results.scheduled_count == 4
+        assert not results.errors
+
+    def test_ignore_counts_unreachable_zone(self):
+        # Ignore: the empty unreachable zone-3 pins the global minimum
+        # at 0, so only maxSkew(1) pods per reachable zone may land —
+        # the 3rd and 4th pods are unschedulable
+        env = three_zone_env()
+        pods = [
+            spread_pod(
+                f"p{i}", affinity_policy="Ignore",
+                zones=["test-zone-1", "test-zone-2"],
+            )
+            for i in range(4)
+        ]
+        results = env.provision(*pods)
+        assert results.scheduled_count == 2
+        assert len(results.errors) == 2
+
+
+class TestNodeTaintsPolicy:
+    def _tainted_zone3_env(self):
+        # zone-3 reachable only through a tainted pool
+        env = Environment(
+            types=[make_instance_type(
+                "c8", cpu=8, zones=("test-zone-1", "test-zone-2"))]
+        )
+        env.kube.create(mk_nodepool("default"))
+        tainted = mk_nodepool("batch-only")
+        tainted.spec.template.spec.taints = [
+            Taint(key="dedicated", value="batch", effect="NoSchedule")
+        ]
+        env.kube.create(tainted)
+        env.cloud.types_by_pool = None  # same catalog for both pools
+        return env
+
+    def test_honor_excludes_intolerable_zone(self):
+        # with taints=Honor, zone-3 (tainted-pool-only) neither blocks
+        # the skew minimum nor accepts placement: 3 intolerant pods
+        # spread 2+1 over zones 1-2... maxSkew 1 allows exactly that
+        env = Environment(
+            types=[
+                make_instance_type(
+                    "c8", cpu=8, zones=("test-zone-1", "test-zone-2")),
+                make_instance_type(
+                    "z3", cpu=8, zones=("test-zone-3",)),
+            ]
+        )
+        open_pool = mk_nodepool("default")
+        # zone-3 only via the tainted pool
+        from karpenter_tpu.apis.v1.nodeclaim import RequirementSpec
+
+        open_pool.spec.template.spec.requirements = [
+            RequirementSpec(key=ZONE, operator="In",
+                            values=["test-zone-1", "test-zone-2"])
+        ]
+        env.kube.create(open_pool)
+        tainted = mk_nodepool("z3-pool")
+        tainted.spec.template.spec.taints = [
+            Taint(key="dedicated", value="batch", effect="NoSchedule")
+        ]
+        tainted.spec.template.spec.requirements = [
+            RequirementSpec(key=ZONE, operator="In", values=["test-zone-3"])
+        ]
+        env.kube.create(tainted)
+
+        pods = [
+            spread_pod(f"p{i}", taints_policy="Honor") for i in range(3)
+        ]
+        results = env.provision(*pods)
+        # zone-3 is excluded from the accounting: 3 pods over 2 zones
+        # at maxSkew 1 (2+1) all schedule
+        assert results.scheduled_count == 3
+        assert not results.errors
+
+    def test_default_ignore_counts_tainted_zone(self):
+        # same cluster, default taints=Ignore: empty zone-3 counts in
+        # the minimum, so the 3rd pod (which cannot tolerate its way
+        # in) is unschedulable
+        env = Environment(
+            types=[
+                make_instance_type(
+                    "c8", cpu=8, zones=("test-zone-1", "test-zone-2")),
+                make_instance_type(
+                    "z3", cpu=8, zones=("test-zone-3",)),
+            ]
+        )
+        from karpenter_tpu.apis.v1.nodeclaim import RequirementSpec
+
+        open_pool = mk_nodepool("default")
+        open_pool.spec.template.spec.requirements = [
+            RequirementSpec(key=ZONE, operator="In",
+                            values=["test-zone-1", "test-zone-2"])
+        ]
+        env.kube.create(open_pool)
+        tainted = mk_nodepool("z3-pool")
+        tainted.spec.template.spec.taints = [
+            Taint(key="dedicated", value="batch", effect="NoSchedule")
+        ]
+        tainted.spec.template.spec.requirements = [
+            RequirementSpec(key=ZONE, operator="In", values=["test-zone-3"])
+        ]
+        env.kube.create(tainted)
+
+        pods = [spread_pod(f"p{i}") for i in range(3)]
+        results = env.provision(*pods)
+        # pods can't land in zone-3 (taint) but it still counts: only
+        # 2 schedule (1 per open zone at skew 1 vs empty zone-3)
+        assert results.scheduled_count == 2
+        assert len(results.errors) == 1
+
+    def test_tolerating_pods_use_the_tainted_zone(self):
+        # a pod tolerating the taint treats zone-3 as reachable under
+        # Honor and can spread into it
+        from karpenter_tpu.apis.v1.nodeclaim import RequirementSpec
+
+        env = Environment(
+            types=[
+                make_instance_type(
+                    "c8", cpu=8, zones=("test-zone-1", "test-zone-2")),
+                make_instance_type("z3", cpu=8, zones=("test-zone-3",)),
+            ]
+        )
+        open_pool = mk_nodepool("default")
+        open_pool.spec.template.spec.requirements = [
+            RequirementSpec(key=ZONE, operator="In",
+                            values=["test-zone-1", "test-zone-2"])
+        ]
+        env.kube.create(open_pool)
+        tainted = mk_nodepool("z3-pool")
+        tainted.spec.template.spec.taints = [
+            Taint(key="dedicated", value="batch", effect="NoSchedule")
+        ]
+        tainted.spec.template.spec.requirements = [
+            RequirementSpec(key=ZONE, operator="In", values=["test-zone-3"])
+        ]
+        env.kube.create(tainted)
+        tol = [Toleration(key="dedicated", operator="Equal", value="batch",
+                          effect="NoSchedule")]
+        pods = [
+            spread_pod(f"p{i}", taints_policy="Honor", tolerations=tol)
+            for i in range(3)
+        ]
+        results = env.provision(*pods)
+        assert results.scheduled_count == 3
+        zones = set()
+        for plan_pods in results.existing_assignments.values():
+            pass
+        for claim in env.kube.node_claims():
+            for r in claim.spec.requirements:
+                if r.key == ZONE and len(r.values) == 1:
+                    zones.add(r.values[0])
+        assert "test-zone-3" in zones
