@@ -132,6 +132,27 @@ def compatible(types: Iterable[InstanceType], reqs: Requirements) -> list[Instan
     return [it for it in types if it.requirements.intersects(reqs) is None]
 
 
+def min_values_coverage(
+    types: Sequence[InstanceType], reqs: Requirements
+) -> dict[str, int]:
+    """Per floored key, the count of distinct allowed values covered
+    across the instance types — the quantity SatisfiesMinValues
+    compares floors against (types.go:284-318), and the count a
+    BestEffort relaxation lowers an unsatisfiable floor to
+    (nodeclaim.go:147-150)."""
+    out: dict[str, int] = {}
+    for req in reqs:
+        if req.min_values is None:
+            continue
+        values: set[str] = set()
+        for it in types:
+            it_req = it.requirements.get(req.key)
+            if it_req.operator() == "In":
+                values.update(v for v in it_req.value_list() if req.has(v))
+        out[req.key] = len(values)
+    return out
+
+
 def satisfies_min_values(
     types: Sequence[InstanceType], reqs: Requirements
 ) -> tuple[int, Optional[str]]:
@@ -146,17 +167,14 @@ def satisfies_min_values(
         return (len(types), None)
     incompatible_key = ""
     max_satisfiable = len(types)
+    coverage = min_values_coverage(types, reqs)
     for req in reqs:
         if req.min_values is None:
             continue
-        values: set[str] = set()
-        for it in types:
-            it_req = it.requirements.get(req.key)
-            if it_req.operator() == "In":
-                values.update(v for v in it_req.value_list() if req.has(v))
-        if len(values) < req.min_values:
+        covered = coverage.get(req.key, 0)
+        if covered < req.min_values:
             incompatible_key = req.key
-            max_satisfiable = min(max_satisfiable, len(values))
+            max_satisfiable = min(max_satisfiable, covered)
     if incompatible_key:
         return (
             max_satisfiable,

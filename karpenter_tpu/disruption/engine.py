@@ -500,7 +500,13 @@ class DisruptionEngine:
         budgets = self.budget_mapping(REASON_UNDERUTILIZED, now)
         for pool_candidates in by_pool.values():
             self._rng.shuffle(pool_candidates)
-        pools = sorted(by_pool)
+        # zero-budget pools can never be probed this call (budgets are
+        # fixed for the round), so drop them from the rotation up front
+        # instead of burning rotation turns popping candidates only to
+        # skip them; with no budgeted pool at all, return immediately
+        pools = sorted(p for p in by_pool if budgets.get(p, 0) > 0)
+        if not pools:
+            return None
         idx = 0
         remaining = {p: list(by_pool[p]) for p in pools}
         deadline = self.clock() + SINGLE_NODE_TIMEOUT_SECONDS
@@ -514,9 +520,6 @@ class DisruptionEngine:
             if not remaining[pool]:
                 continue
             candidate = remaining[pool].pop()
-            # first success returns, so only a zero budget can block
-            if budgets.get(pool, 0) <= 0:
-                continue
             cmd = self.compute_consolidation([candidate])
             if cmd is not None:
                 return cmd

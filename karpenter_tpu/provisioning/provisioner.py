@@ -28,7 +28,7 @@ from karpenter_tpu.apis.v1.nodeclaim import (
     RequirementSpec,
 )
 from karpenter_tpu.apis.v1.nodepool import NodePool, order_by_weight
-from karpenter_tpu.cloudprovider.types import CloudProvider
+from karpenter_tpu.cloudprovider.types import CloudProvider, min_values_coverage
 from karpenter_tpu.provisioning import volume_topology
 from karpenter_tpu.kube.client import KubeClient
 from karpenter_tpu.kube.objects import ObjectMeta, Pod
@@ -53,39 +53,22 @@ DEFAULT_TERMINATION_GRACE_PERIOD: Optional[float] = None
 
 def _specs_from_requirement(req: Requirement, relaxed: bool) -> list[RequirementSpec]:
     """Serialize one algebraic Requirement back into claim spec
-    entries. Gt/Lt bounds live outside the value set (complement
-    representation), so they emit as their own Gt/Lt entries — a
-    flattening to operator()/value_list() alone would collapse a bare
-    bound into Exists and lose it. A BestEffort-relaxed plan drops a
-    minValues floor ONLY where the surviving value set no longer
-    satisfies it (the min-values-relaxed annotation records why)."""
+    entries via Requirement.spec_entries(). A BestEffort-relaxed plan
+    drops a minValues floor ONLY where the surviving value set no
+    longer satisfies it (the min-values-relaxed annotation records
+    why); only an In value set can fall below its floor (complement
+    sets allow unboundedly many values)."""
     specs: list[RequirementSpec] = []
-    if req.greater_than is not None:
+    for op, values, min_values in req.spec_entries():
+        if (
+            relaxed and min_values is not None and op == IN
+            and len(values) < min_values
+        ):
+            min_values = None
         specs.append(
-            RequirementSpec(key=req.key, operator="Gt",
-                            values=(str(req.greater_than),))
+            RequirementSpec(key=req.key, operator=op, values=values,
+                            min_values=min_values)
         )
-    if req.less_than is not None:
-        specs.append(
-            RequirementSpec(key=req.key, operator="Lt",
-                            values=(str(req.less_than),))
-        )
-    op = req.operator()
-    if specs and op == "Exists" and not req.values:
-        return specs  # the bounds already imply existence
-    values = tuple(req.value_list())
-    min_values = req.min_values
-    # only an In value set can fall below its floor (complement sets
-    # allow unboundedly many values)
-    if (
-        relaxed and min_values is not None and op == IN
-        and len(values) < min_values
-    ):
-        min_values = None
-    specs.append(
-        RequirementSpec(key=req.key, operator=op, values=values,
-                        min_values=min_values)
-    )
     return specs
 
 
@@ -331,6 +314,19 @@ class Provisioner:
                     and is_restricted_label(r.key) is None
                 )
             )
+        if plan.min_values_relaxed:
+            # BestEffort relaxation lowers an unsatisfiable floor to
+            # the count of values the launchable instance types still
+            # cover — the reference writes the satisfiable count back
+            # onto the requirement (nodeclaim.go:147-150) rather than
+            # dropping the floor outright
+            coverage = min_values_coverage(plan.instance_types, combined)
+            for req in combined:
+                if (
+                    req.min_values is not None
+                    and coverage.get(req.key, 0) < req.min_values
+                ):
+                    req.min_values = coverage[req.key] or None
         requirements = []
         for req in combined:
             requirements.extend(

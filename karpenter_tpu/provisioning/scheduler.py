@@ -723,14 +723,23 @@ class Scheduler:
     def _enforce_min_values(self, plan: NodePlan, results: SchedulerResults) -> bool:
         """minValues flexibility floor per planned node
         (types.go:284-318; relaxation annotation scheduler.go:649-658).
-        Strict: a plan whose instance-type options can't satisfy the
-        pool's minValues is rejected and its pods report the reason.
-        BestEffort: the plan survives, marked relaxed so the claim gets
+        The floors are checked against the TIGHTENED requirement set —
+        pool requirements intersected with the scheduled pods' own —
+        exactly as the reference filters with nodeClaimRequirements
+        (nodeclaim.go:146,425-433): a pod selector can shrink a pool's
+        In set below its floor even when the raw pool requirements
+        remain satisfiable.
+        Strict: such a plan is rejected and its pods report the reason.
+        BestEffort: the plan survives, marked relaxed so serialization
+        lowers the floors to the satisfiable count and the claim gets
         the min-values-relaxed annotation."""
         pool_reqs = _pool_requirements(plan.pool)
         if not pool_reqs.has_min_values():
             return True
-        _, err = satisfies_min_values(plan.instance_types, pool_reqs)
+        tightened = Requirements(r.copy() for r in pool_reqs)
+        for pod in plan.pods:
+            tightened.add(*Requirements.from_pod(pod, required_only=True))
+        _, err = satisfies_min_values(plan.instance_types, tightened)
         if err is None:
             return True
         if self.min_values_policy == "BestEffort":

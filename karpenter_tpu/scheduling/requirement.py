@@ -112,6 +112,31 @@ class Requirement:
     def value_list(self) -> list[str]:
         return sorted(self.values)
 
+    def spec_entries(self) -> list[tuple[str, tuple[str, ...], Optional[int]]]:
+        """Serialize to (operator, values, minValues) claim-spec
+        entries whose conjunction denotes exactly this requirement.
+        Gt/Lt bounds live outside the value set (complement
+        representation), so they emit as their own entries — a
+        flattening to operator()/value_list() alone would collapse a
+        bare bound into Exists and lose it (the claim-tightening path
+        in nodeclaim.go keeps Gt/Lt as separate NodeSelectorRequirement
+        entries for the same reason)."""
+        entries: list[tuple[str, tuple[str, ...], Optional[int]]] = []
+        if self.greater_than is not None:
+            entries.append((GT, (str(self.greater_than),), None))
+        if self.less_than is not None:
+            entries.append((LT, (str(self.less_than),), None))
+        op = self.operator()
+        if entries and op == EXISTS and not self.values:
+            # the bounds already imply existence; a minValues floor
+            # must still ride one of the surviving entries
+            if self.min_values is not None:
+                last_op, last_values, _ = entries[-1]
+                entries[-1] = (last_op, last_values, self.min_values)
+            return entries
+        entries.append((op, tuple(self.value_list()), self.min_values))
+        return entries
+
     def any_value(self) -> str:
         """A representative allowed value (used to label nodes)."""
         if self.operator() == IN:

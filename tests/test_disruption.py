@@ -174,6 +174,60 @@ class TestConsolidation:
         assert len(env.kube.nodes()) == nodes_before
 
 
+class TestSingleNodeBudgets:
+    def test_zero_budget_pool_retains_candidates(self):
+        """A zero-budget pool's candidates must never be probed by
+        the round-robin, while every budgeted pool's candidates are
+        all probed (singlenodeconsolidation.go:56-160 budget
+        semantics)."""
+        from karpenter_tpu.apis.v1.labels import NODEPOOL_LABEL
+
+        env = Environment(types=consolidation_types())
+        zero = mk_nodepool("zero")
+        zero.spec.disruption.consolidate_after = "0s"
+        zero.spec.disruption.budgets = [Budget(nodes="0")]
+        env.kube.create(zero)
+        open_pool = mk_nodepool("open")
+        open_pool.spec.disruption.consolidate_after = "0s"
+        env.kube.create(open_pool)
+        for pool_name in ("zero", "open"):
+            for _ in range(2):
+                env.provision(
+                    mk_pod(cpu=1.0, memory=2 * GIB,
+                           node_selector={NODEPOOL_LABEL: pool_name})
+                )
+        now = time.time() + 120
+        env.pod_events.reconcile_all(now=now)
+        env.conditions.reconcile_all(now=now)
+        probed = []
+        env.disruption.compute_consolidation = lambda cands: (
+            probed.append([c.state_node.name for c in cands]) and None
+        )
+        env.disruption.single_node_consolidation(now)
+        probed_names = {name for group in probed for name in group}
+        zero_nodes = {
+            n.name for n in env.cluster.nodes()
+            if n.nodepool_name() == "zero"
+        }
+        open_nodes = {
+            n.name for n in env.cluster.nodes()
+            if n.nodepool_name() == "open"
+        }
+        assert zero_nodes and open_nodes
+        assert not (probed_names & zero_nodes)
+        assert probed_names == open_nodes
+
+    def test_all_pools_zero_budget_returns_none(self):
+        env = make_env()
+        pool = env.kube.get_node_pool("default")
+        pool.spec.disruption.budgets = [Budget(nodes="0")]
+        env.provision(mk_pod(cpu=1.0, memory=2 * GIB))
+        now = time.time() + 120
+        env.pod_events.reconcile_all(now=now)
+        env.conditions.reconcile_all(now=now)
+        assert env.disruption.single_node_consolidation(now) is None
+
+
 class TestDrift:
     def test_drifted_node_replaced(self):
         env = make_env(consolidate_after="Never")

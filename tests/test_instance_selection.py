@@ -160,6 +160,75 @@ class TestMinValues:
         assert not env.kube.node_claims()
 
 
+class TestMinValuesTightening:
+    """A pod selector can shrink a pool's In set below its minValues
+    floor even when the raw pool requirements stay satisfiable — the
+    floors must be checked against the TIGHTENED requirement set
+    (nodeclaim.go:146,425-433), and a BestEffort relaxation lowers the
+    floor to the satisfiable count (nodeclaim.go:147-150)."""
+
+    TIER = "example.com/tier"
+
+    def _env(self, policy):
+        from karpenter_tpu.operator.options import Options
+
+        types = []
+        for i in range(3):
+            it = make_instance_type(f"mv-{i}", cpu=4, memory=8 * GIB,
+                                    price=1.0 + i * 0.1)
+            # every type covers BOTH tier values, so the raw pool
+            # floor is satisfiable on any compatible subset
+            it.requirements.add(Requirement(self.TIER, IN, ["a", "b"]))
+            types.append(it)
+        env = Environment(types=types)
+        env.provisioner.options = Options(min_values_policy=policy)
+        pool = mk_nodepool("p")
+        pool.spec.template.spec.requirements = [
+            RequirementSpec(key=self.TIER, operator=IN, values=("a", "b"),
+                            min_values=2)
+        ]
+        env.kube.create(pool)
+        return env
+
+    def test_strict_rejects_pod_tightened_floor(self):
+        env = self._env("Strict")
+        env.provision(mk_pod(cpu=1.0, node_selector={self.TIER: "a"}))
+        # the claim would serialize tier In [a] with minValues 2 —
+        # admission-invalid; Strict must reject the plan instead
+        assert not env.kube.node_claims()
+
+    def test_strict_allows_unconstrained_pod(self):
+        env = self._env("Strict")
+        env.provision(mk_pod(cpu=1.0))
+        assert env.kube.node_claims()
+
+    def test_best_effort_lowers_floor_and_annotates(self):
+        from karpenter_tpu.apis.v1.labels import (
+            NODECLAIM_MIN_VALUES_RELAXED_ANNOTATION,
+        )
+
+        env = self._env("BestEffort")
+        env.provision(mk_pod(cpu=1.0, node_selector={self.TIER: "a"}))
+        claims = env.kube.node_claims()
+        assert claims, "BestEffort must still launch"
+        claim = claims[0]
+        tier_req = next(
+            r for r in claim.spec.requirements
+            if r.key == self.TIER and r.operator == IN
+        )
+        # floor lowered to exactly the satisfiable count (one tier
+        # value survives the pod selector), not dropped outright
+        assert tier_req.min_values == 1
+        assert (
+            claim.metadata.annotations.get(
+                NODECLAIM_MIN_VALUES_RELAXED_ANNOTATION
+            )
+            == "true"
+        )
+        pod = env.kube.pods()[0]
+        assert pod.spec.node_name, "pod must bind to the relaxed node"
+
+
 class TestTruncation:
     def test_max_instance_types_truncation(self):
         from karpenter_tpu.provisioning.scheduler import MAX_INSTANCE_TYPES

@@ -88,16 +88,45 @@ def test_intersection_associates(seed):
     assert left == right
 
 
+def roundtrip(req: Requirement) -> Requirement:
+    """Serialize through spec_entries() — the claim-tightening path —
+    and reconstruct by intersecting the emitted entries, exactly as
+    Requirements.add does when a claim spec is parsed back."""
+    rebuilt = None
+    for op, values, min_values in req.spec_entries():
+        entry = Requirement("k", op, values, min_values=min_values)
+        rebuilt = entry if rebuilt is None else rebuilt.intersection(entry)
+    assert rebuilt is not None
+    return rebuilt
+
+
 @pytest.mark.parametrize("seed", range(40))
 def test_operator_roundtrip_preserves_denotation(seed):
-    # serializing a requirement back to (operator, values) — the claim
-    # tightening path — must not change what it allows, modulo bounds
-    # that need their own Gt/Lt entries (those are covered by
-    # _specs_from_requirement, exercised here via fields)
+    # serializing a requirement to claim spec entries and parsing them
+    # back must not change what it allows — including Gt/Lt bounds,
+    # which emit as their own entries
     rng = random.Random(seed + 4000)
     a = random_requirement(rng)
-    op = a.operator()
-    if a.greater_than is not None or a.less_than is not None:
-        pytest.skip("bound requirements serialize as extra Gt/Lt entries")
-    rebuilt = Requirement("k", op, a.value_list())
-    assert denote(rebuilt) == denote(a), (repr(a), op, a.value_list())
+    rebuilt = roundtrip(a)
+    assert denote(rebuilt) == denote(a), (repr(a), a.spec_entries())
+
+
+@pytest.mark.parametrize("seed", range(40))
+def test_intersection_roundtrip_preserves_denotation(seed):
+    # intersections produce the hard shapes a single constructor never
+    # does (NotIn + bounds on one requirement); the round-trip must
+    # carry those exactly
+    rng = random.Random(seed + 5000)
+    a = random_requirement(rng).intersection(random_requirement(rng))
+    rebuilt = roundtrip(a)
+    assert denote(rebuilt) == denote(a), (repr(a), a.spec_entries())
+
+
+@pytest.mark.parametrize("seed", range(20))
+def test_roundtrip_preserves_min_values(seed):
+    rng = random.Random(seed + 6000)
+    a = random_requirement(rng)
+    a.min_values = rng.randint(1, 3)
+    rebuilt = roundtrip(a)
+    assert rebuilt.min_values == a.min_values
+    assert denote(rebuilt) == denote(a)
