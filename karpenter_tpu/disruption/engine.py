@@ -64,6 +64,10 @@ COMMAND_TIMEOUT_SECONDS = 10 * 60  # orchestration retry deadline (queue.go:86)
 # single-node stops mid-scan (singlenodeconsolidation.go:34)
 MULTI_NODE_TIMEOUT_SECONDS = 60.0
 SINGLE_NODE_TIMEOUT_SECONDS = 3 * 60.0
+# candidate cap for the one-shot global repack: bounds the cost solve
+# the way the prefix search caps at 100 (multinodeconsolidation.go:86)
+# while letting the batched objective see far more of the fleet
+GLOBAL_REPACK_MAX_CANDIDATES = 500
 # extra prefixes probed above the binary-search result (largest first):
 # the amortized-merge payoff concentrates just above the failing
 # midpoint, and an uncapped sweep would burn the whole timeout on O(N)
@@ -274,7 +278,7 @@ class DisruptionEngine:
     # -- simulation (helpers.go:52-143) ----------------------------------------
 
     def simulate_scheduling(
-        self, candidates: Sequence[Candidate]
+        self, candidates: Sequence[Candidate], objective: str = "ffd"
     ) -> tuple[SchedulerResults, bool]:
         """Re-run the scheduler with candidates removed. Returns
         (results, all_pods_scheduled)."""
@@ -306,6 +310,7 @@ class DisruptionEngine:
             metrics_controller="disruption",
             kube=self.kube,
             clock=self.clock,
+            objective=objective,
         )
         results = scheduler.solve(pods + pending)
         scheduled_keys = {
@@ -415,6 +420,91 @@ class DisruptionEngine:
                 return Command(reason=REASON_DRIFTED, candidates=[candidate],
                                results=results)
         return None
+
+    def global_repack_consolidation(self, now: float) -> Optional[Command]:
+        """One cost-objective re-solve of the whole candidate set — the
+        batched-device generalization of the reference's prefix binary
+        search (multinodeconsolidation.go:116-169). Where the prefix
+        search can only merge a disruption-cost-ordered prefix into a
+        SINGLE replacement node, this method hands every budget-allowed
+        candidate's workload to the LP cost objective at once and keeps
+        the resulting multi-node plan when the replacement fleet is
+        strictly cheaper than the candidates it retires. The command is
+        re-validated against fresh state before execution like every
+        other (validation.go:152-280)."""
+        candidates = self.get_candidates(REASON_UNDERUTILIZED, now)
+        if len(candidates) < 2:
+            return None
+        candidates.sort(key=lambda c: c.disruption_cost)
+        budgets = self.budget_mapping(REASON_UNDERUTILIZED, now)
+        candidates = self._budget_filter(candidates, budgets)
+        candidates = candidates[:GLOBAL_REPACK_MAX_CANDIDATES]
+        if len(candidates) < 2:
+            return None
+        results, all_ok = self.simulate_scheduling(candidates, objective="cost")
+        if not all_ok:
+            return None
+        current_price = sum(c.price for c in candidates)
+        all_spot = all(
+            c.capacity_type == CAPACITY_TYPE_SPOT for c in candidates
+        )
+        for plan in results.new_node_plans:
+            captypes = {o.capacity_type for o in plan.offerings}
+            if CAPACITY_TYPE_SPOT in captypes:
+                # spot-to-spot churn is gated (consolidation.go:233-311);
+                # the >=2-candidate set is exempt from the 15-type floor
+                # exactly as the reference's multi-node path is
+                if (
+                    all_spot
+                    and not self.options.feature_gates.spot_to_spot_consolidation
+                ):
+                    return None
+                if len(captypes) > 1:
+                    # the price estimate assumes the cheapest (spot)
+                    # offering launches, so pin the plan to spot
+                    # (consolidation.go:215-223)
+                    plan.offerings = [
+                        o for o in plan.offerings
+                        if o.capacity_type == CAPACITY_TYPE_SPOT
+                    ]
+                    names = {
+                        it.name for it in plan.instance_types
+                        if any(o in it.offerings for o in plan.offerings)
+                    }
+                    plan.instance_types = [
+                        it for it in plan.instance_types if it.name in names
+                    ]
+                    if not plan.instance_types:
+                        return None
+            plan.price = min(o.price for o in plan.offerings)
+        new_price = sum(p.price for p in results.new_node_plans)
+        if new_price >= current_price:
+            return None
+        # Price-prune each plan's fallback offerings the way
+        # compute_consolidation prunes its single replacement's
+        # (consolidation.go:190-214): a launch can land on any offering
+        # the claim keeps, so distribute the saving slack across plans
+        # and cap every plan's offerings below its share — then even if
+        # EVERY plan falls back to its most expensive surviving
+        # offering, the total stays strictly under the retired price.
+        plans = results.new_node_plans
+        if plans:
+            share = (current_price - new_price) / len(plans)
+            for plan in plans:
+                cap = plan.price + share
+                plan.offerings = [o for o in plan.offerings if o.price < cap]
+                names = {
+                    it.name for it in plan.instance_types
+                    if any(o in it.offerings for o in plan.offerings)
+                }
+                plan.instance_types = [
+                    it for it in plan.instance_types if it.name in names
+                ]
+                if not plan.instance_types:
+                    return None
+        return Command(
+            reason=REASON_UNDERUTILIZED, candidates=candidates, results=results
+        )
 
     def multi_node_consolidation(self, now: float) -> Optional[Command]:
         """Binary search the largest prefix replaceable by <=1 node
@@ -535,6 +625,7 @@ class DisruptionEngine:
         for method in (
             self.emptiness,
             self.drift,
+            self.global_repack_consolidation,
             self.multi_node_consolidation,
             self.single_node_consolidation,
         ):

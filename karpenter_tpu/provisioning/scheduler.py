@@ -146,7 +146,12 @@ class Scheduler:
         solve_timeout: float = SOLVE_TIMEOUT_SECONDS,
         ignore_dra_requests: bool = True,
         metrics_controller: str = "provisioner",
+        objective: str = "ffd",
     ):
+        # "cost" engages the LP planner on the batched fast path (the
+        # global-repack consolidation re-solve); topology/per-pod paths
+        # always pack FFD — their constraints aren't in the LP
+        self.objective = objective
         self.min_values_policy = min_values_policy
         self.ignore_dra_requests = ignore_dra_requests
         self.metrics_controller = metrics_controller
@@ -795,7 +800,7 @@ class Scheduler:
                 else self.reserved_in_use
             ),
         )
-        return solve_encoded(enc)
+        return solve_encoded(enc, objective=self.objective)
 
     def _rsv_remaining(self, rid: str, round_in_use: dict[str, int]) -> int:
         """Instances left on a reservation after live nodes AND plans
