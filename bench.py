@@ -220,10 +220,11 @@ def _timed_cost_solve(pods, pools, bound_gap: bool = False, repeats: int = 1):
         out["samples"] = len(ordered)
     if bound_gap and sol.lp is not None:
         # quantify optimality from the bounds the cost solve already
-        # computed: the master-LP value estimates the Gilmore-Gomory
-        # bound; the linear resource bound is always valid. gap_vs_lp
-        # ~ how much any packer could still recover.
-        out["lp_linear_lower_bound"] = round(sol.lp["lower_bound"], 2)
+        # computed: lp_lower_bound is PROVEN-VALID (the better of the
+        # linear resource bound and the Farley bound certified by
+        # exact knapsack upper bounds); lp_estimate is the master-LP
+        # value. gap_vs_lp ~ how much any packer could still recover.
+        out["lp_lower_bound"] = round(sol.lp["lower_bound"], 2)
         out["lp_estimate"] = round(sol.lp["estimate"], 2)
         if sol.lp["estimate"] > 0:
             out["gap_vs_lp"] = round(cost_price / sol.lp["estimate"] - 1, 4)
@@ -249,11 +250,23 @@ def scenario_homogeneous() -> dict:
 
 
 def scenario_mixed() -> dict:
+    """Selector/taint-fragmented demand on the family-priced catalog.
+
+    The catalog is `heterogeneous_instance_types` (like hetero_10k and
+    the kwok catalog's real price structure), NOT the linear-priced
+    `instance_types`: linear pricing makes any fleet with the same
+    resource total cost the same, so greedy FFD is near-optimal by
+    construction and a cost objective has nothing to win (see
+    fake.heterogeneous_instance_types docstring). What THIS scenario
+    measures is that selector/taint fragmentation does not defeat the
+    planner — the cost win must survive arch/zone selectors and a
+    tainted pool, not just the clean hetero demand."""
     from karpenter_tpu.apis.v1.nodepool import NodePool
-    from karpenter_tpu.cloudprovider.fake import instance_types
+    from karpenter_tpu.cloudprovider.fake import heterogeneous_instance_types
     from karpenter_tpu.kube.objects import ObjectMeta, Taint, Toleration
 
     pods, pools = build_problem(10000, 400)
+    pools = [(pools[0][0], heterogeneous_instance_types(400))]
     # a tainted, higher-weight pool that only tolerating pods may use
     # (taints.go ToleratesPod semantics)
     tainted = NodePool(metadata=ObjectMeta(name="tainted"))
@@ -267,7 +280,7 @@ def scenario_mixed() -> dict:
                 Toleration(key="dedicated", operator="Equal", value="batch",
                            effect="NoSchedule")
             ]
-    pools = [pools[0], (tainted, instance_types(60))]
+    pools = [pools[0], (tainted, heterogeneous_instance_types(60))]
     return _timed_cost_solve(pods, pools)
 
 

@@ -50,6 +50,65 @@ class TestCostObjective:
         bound = lp_plan.linear_lower_bound(enc)
         assert 0 < bound <= cost.total_price + 1e-6
 
+    def test_farley_bound_is_valid_and_nontrivial(self):
+        """The certified lower bound (max of linear and Farley) must
+        bound EVERY achievable fleet from below — FFD's and the cost
+        objective's — and on a family-priced catalog it must beat the
+        linear resource bound (the Farley scaling is doing work)."""
+        pods, pools = hetero_problem(2000, 80)
+        ffd = solve(pods, pools, objective="ffd")
+        cost = solve(pods, pools, objective="cost")
+        enc = encode(group_pods(pods), pools)
+        plan = lp_plan.plan(enc)
+        assert plan is not None
+        assert 0 < plan.lower_bound <= cost.total_price + 1e-6
+        assert plan.lower_bound <= ffd.total_price + 1e-6
+        assert plan.lower_bound <= plan.objective_estimate + 1e-6
+
+    def test_farley_bound_not_degenerate_on_reserved(self):
+        """Near-free reserved capacity made the linear bound vacuous
+        (~0 against a real fleet price); the Farley bound with cap
+        duals must certify a meaningful fraction of the fleet."""
+        pods, pools = build_problem(2000, 100, seed=3, reservations=True)
+        cost = solve(pods, pools, objective="cost")
+        enc = encode(group_pods(pods), pools)
+        plan = lp_plan.plan(enc)
+        assert plan is not None
+        assert plan.lower_bound <= cost.total_price + 1e-6
+        linear = lp_plan.linear_lower_bound(enc)
+        # the linear bound collapses to ~1% of fleet here; Farley must
+        # certify a meaningful fraction (its remaining slack is the
+        # config model's zone relaxation, which only weakens, never
+        # invalidates, the bound)
+        assert plan.lower_bound >= 0.25 * cost.total_price, (
+            f"bound {plan.lower_bound:.2f} vs fleet "
+            f"{cost.total_price:.2f} — degenerate"
+        )
+        assert plan.lower_bound >= 5 * max(linear, 1e-9)
+
+    def test_reservation_capacity_changes_fingerprint(self):
+        """Two problems identical but for reservation CAPACITY must
+        not share cached plans — a zero-capacity reservation handed
+        out by a stale cached rounding charges pods to capacity that
+        does not exist."""
+        from karpenter_tpu.testing import mk_nodepool
+        from karpenter_tpu.cloudprovider.fake import GIB, make_instance_type
+
+        def types(capacity):
+            return [
+                make_instance_type(
+                    "m", cpu=8, memory=32 * GIB,
+                    reservations=[("r1", "test-zone-1", capacity)],
+                ),
+                make_instance_type("n", cpu=8, memory=32 * GIB),
+            ]
+
+        pool = mk_nodepool("p")
+        pods, _ = build_problem(64, 4, seed=7)
+        with_rsv = solve(pods, [(pool, types(64))], objective="cost")
+        without = solve(pods, [(pool, types(0))], objective="cost")
+        assert without.total_price > with_rsv.total_price
+
     def test_lp_estimate_close_to_achieved(self):
         # the achieved fleet should sit within a few percent of the
         # master-LP estimate — the quantified "near-optimal" claim
