@@ -192,11 +192,22 @@ class NodeClaimLifecycle:
                     self.kube.delete(node, now=now)
             return
         if claim.status.provider_id:
+            # await instance termination (controller.go:269-290): issue
+            # the provider delete, mark InstanceTerminating, and hold
+            # the finalizer until the provider reports the instance
+            # GONE (NotFound) — dropping it on the first delete call
+            # would let the claim vanish while the instance still runs,
+            # leaking it to the garbage collector
+            instance_gone = False
             try:
                 self.cloud_provider.delete(claim)
             except NodeClaimNotFoundError:
-                pass
-        claim.status_conditions.set_true(COND_INSTANCE_TERMINATING, now=now)
+                instance_gone = True
+            claim.status_conditions.set_true(COND_INSTANCE_TERMINATING, now=now)
+            if not instance_gone:
+                return  # requeued; next pass re-checks the provider
+        else:
+            claim.status_conditions.set_true(COND_INSTANCE_TERMINATING, now=now)
         self.kube.remove_finalizer(claim, TERMINATION_FINALIZER)
 
     # -- helpers ---------------------------------------------------------------

@@ -228,6 +228,28 @@ class TerminationController:
         Drain), the first non-empty wave gates the rest — a
         do-not-disrupt pod in it stalls drain until the TGP deadline."""
         pods = self._blocking_pods(node)
+        if deadline is not None:
+            # ahead-of-deadline deletion (terminator.go:140-180): a pod
+            # whose terminationGracePeriodSeconds would run PAST the
+            # node's TGP deadline is deleted NOW — proactively, PDBs and
+            # waves notwithstanding — so it gets as much of its grace as
+            # the node has left (the remaining time is the clamped grace
+            # the reference passes in DeleteOptions)
+            expired = False
+            for pod in pods:
+                grace = pod.spec.termination_grace_period_seconds
+                if grace is None or pod.is_terminating():
+                    continue
+                if now >= deadline - grace:
+                    log.info(
+                        "deleting pod %s ahead of node TGP deadline "
+                        "(grace %ss clamped to %.0fs)",
+                        pod.key, grace, max(0.0, deadline - now),
+                    )
+                    self.queue.evict(pod, now=now, force=True)
+                    expired = True
+            if expired:
+                pods = self._blocking_pods(node)
         waves = _drain_waves([p for p in pods if not p.is_terminating()])
         if waves:
             force = deadline is not None and now >= deadline

@@ -203,3 +203,113 @@ class NodePoolMetricsController:
             self.weight.update(name, [(base, float(pool.spec.weight or 0))])
         for store in (self.usage, self.limit, self.count, self.weight):
             store.prune(live)
+
+
+# Exponential histogram buckets 0.5 * 2^k, 15 buckets (0.5s .. 8192s) —
+# the reference's transition histograms (controllers.go:113-131,
+# prometheus.ExponentialBuckets(0.5, 2, 15))
+TRANSITION_BUCKETS = tuple(0.5 * 2**k for k in range(15))
+
+STATUS_CONDITION_COUNT = REGISTRY.gauge(
+    "karpenter_status_condition_count",
+    "Current condition count per kind, condition type and status",
+)
+STATUS_CONDITION_TRANSITIONS = REGISTRY.counter(
+    "karpenter_status_condition_transitions_total",
+    "Condition status transitions per kind, condition type and new status",
+)
+STATUS_CONDITION_TRANSITION_SECONDS = REGISTRY.histogram(
+    "karpenter_status_condition_transition_seconds",
+    "Time a condition spent in its previous status before transitioning",
+    buckets=TRANSITION_BUCKETS,
+)
+STATUS_CONDITION_CURRENT_SECONDS = REGISTRY.gauge(
+    "karpenter_status_condition_current_status_seconds",
+    "Time the condition has spent in its current status",
+)
+
+
+class StatusConditionMetricsController:
+    """Status-condition observability for NodeClaim, NodePool and Node
+    (the operatorpkg status.Controller trio the reference registers at
+    controllers.go:113-131): per-kind/type/status condition-count
+    gauges, a transitions counter, and a transition-latency histogram
+    with exponential buckets that observes how long each condition
+    held its PREVIOUS status."""
+
+    def __init__(self, kube: KubeClient, clock=None):
+        import time as _time
+
+        self.kube = kube
+        self.clock = clock if clock is not None else _time.time
+        self.store = Store(STATUS_CONDITION_COUNT)
+        self.current = Store(STATUS_CONDITION_CURRENT_SECONDS)
+        # (kind, object name) -> {condition type: (status, since)}
+        self._seen: dict[tuple[str, str], dict[str, tuple[str, float]]] = {}
+
+    def _object_conditions(self):
+        for claim in self.kube.node_claims():
+            yield ("NodeClaim", claim.metadata.name, [
+                (c.type, c.status, c.last_transition_time)
+                for c in claim.status_conditions.conditions
+            ])
+        for pool in self.kube.node_pools():
+            yield ("NodePool", pool.metadata.name, [
+                (c.type, c.status, c.last_transition_time)
+                for c in pool.status_conditions.conditions
+            ])
+        for node in self.kube.nodes():
+            yield ("Node", node.metadata.name, [
+                (c.type, c.status, c.last_transition_time)
+                for c in node.status.conditions
+            ])
+
+    def reconcile_all(self, now: Optional[float] = None) -> None:
+        now = self.clock() if now is None else now
+        live: set[str] = set()
+        counts: dict[tuple[str, str, str], int] = {}
+        for kind, name, conditions in self._object_conditions():
+            obj_key = (kind, name)
+            obj_id = f"{kind}/{name}"
+            live.add(obj_id)
+            prev = self._seen.setdefault(obj_key, {})
+            current_rows = []
+            for ctype, status, since in conditions:
+                counts[(kind, ctype, status)] = (
+                    counts.get((kind, ctype, status), 0) + 1
+                )
+                old = prev.get(ctype)
+                if old is not None and old[0] != status:
+                    STATUS_CONDITION_TRANSITIONS.inc(
+                        {"kind": kind, "type": ctype, "status": status}
+                    )
+                    # the object's own lastTransitionTime bounds the
+                    # previous status's duration exactly; the poll
+                    # clock would inflate it by up to one reconcile
+                    # interval
+                    end = since if since > old[1] else now
+                    STATUS_CONDITION_TRANSITION_SECONDS.observe(
+                        max(0.0, end - old[1]),
+                        {"kind": kind, "type": ctype, "status": old[0]},
+                    )
+                if old is None or old[0] != status:
+                    prev[ctype] = (status, since if since > 0 else now)
+                current_rows.append((
+                    {"kind": kind, "type": ctype, "status": status,
+                     "name": name},
+                    max(0.0, now - prev[ctype][1]),
+                ))
+            # diff-published per object: a condition that flips status
+            # drops its old-status series instead of exporting both
+            self.current.update(obj_id, current_rows)
+        # one diff-published series set for all condition counts
+        self.store.update("all", [
+            ({"kind": k, "type": t, "status": s}, float(v))
+            for (k, t, s), v in counts.items()
+        ])
+        # drop tracking and current-status series for vanished objects
+        self.current.prune(live)
+        for key in [
+            key for key in self._seen if f"{key[0]}/{key[1]}" not in live
+        ]:
+            del self._seen[key]

@@ -44,6 +44,7 @@ from karpenter_tpu.metrics.controllers import (
     NodeMetricsController,
     NodePoolMetricsController,
     PodMetricsController,
+    StatusConditionMetricsController,
 )
 from karpenter_tpu.operator.options import Options
 from karpenter_tpu.provisioning.provisioner import Provisioner
@@ -115,6 +116,7 @@ class Operator:
         self.pod_metrics = PodMetricsController(self.kube, self.cluster)
         self.node_metrics = NodeMetricsController(self.kube, self.cluster)
         self.nodepool_metrics = NodePoolMetricsController(self.kube, self.cluster)
+        self.status_condition_metrics = StatusConditionMetricsController(self.kube)
 
         self._last_disruption = 0.0
         self._last_gc = 0.0
@@ -189,6 +191,7 @@ class Operator:
             self.pod_metrics.reconcile_all(now=now)
             self.node_metrics.reconcile_all(now=now)
             self.nodepool_metrics.reconcile_all(now=now)
+            self.status_condition_metrics.reconcile_all(now=now)
 
     def _bind_pending(self, now: Optional[float] = None) -> None:
         """Bind pods from completed scheduling results to their target

@@ -120,6 +120,95 @@ class TestTGPForce:
         env.reconcile_termination(now=now + 61)
         assert not env.kube.nodes()
 
+    def test_pod_deleted_ahead_of_deadline_for_its_grace_period(self):
+        """terminator.go:140-180: a pod with a 60s grace period on a
+        node 30s from its TGP deadline must be deleted NOW — waiting
+        for the deadline would truncate the pod's grace to 30s."""
+        env = Environment(
+            types=[make_instance_type("c8", cpu=8, memory=32 * GIB)]
+        )
+        env.kube.create(mk_nodepool("p"))
+        pod = _pod("slow-shutdown")
+        pod.spec.termination_grace_period_seconds = 60
+        # PDB-style blocker is irrelevant: ahead-of-deadline deletion
+        # bypasses eviction (direct delete in the reference)
+        pod.metadata.annotations["karpenter.sh/do-not-disrupt"] = "true"
+        env.provision(pod)
+        claim = env.kube.node_claims()[0]
+        now = time.time()
+        # node deadline 30s out; pod needs 60s of grace
+        claim.metadata.annotations[
+            NODECLAIM_TERMINATION_TIMESTAMP_ANNOTATION
+        ] = str(now + 30)
+        env.kube.delete(claim, now=now)
+        env.reconcile_termination(now=now + 1)
+        live = [
+            p for p in env.kube.pods()
+            if p.metadata.name == "slow-shutdown" and p.spec.node_name
+        ]
+        assert not live, "pod must be deleted ahead of the deadline"
+
+    def test_short_grace_pod_not_deleted_early(self):
+        """A pod whose grace FITS before the deadline is left to the
+        normal (PDB-respecting) eviction flow."""
+        env = Environment(
+            types=[make_instance_type("c8", cpu=8, memory=32 * GIB)]
+        )
+        env.kube.create(mk_nodepool("p"))
+        pod = _pod("quick")
+        pod.spec.termination_grace_period_seconds = 5
+        pod.metadata.annotations["karpenter.sh/do-not-disrupt"] = "true"
+        env.provision(pod)
+        claim = env.kube.node_claims()[0]
+        now = time.time()
+        claim.metadata.annotations[
+            NODECLAIM_TERMINATION_TIMESTAMP_ANNOTATION
+        ] = str(now + 600)
+        env.kube.delete(claim, now=now)
+        env.reconcile_termination(now=now + 1)
+        # do-not-disrupt still holds: deadline is far away
+        assert any(
+            p.metadata.name == "quick" and p.spec.node_name
+            for p in env.kube.pods()
+        )
+
+
+class TestInstanceTerminatingAwait:
+    def test_finalizer_waits_for_provider_notfound(self):
+        """node/termination/controller.go:269-290: the claim finalizer
+        drops only after the provider reports the instance GONE; the
+        first pass issues the delete and marks InstanceTerminating."""
+        from karpenter_tpu.apis.v1.nodeclaim import COND_INSTANCE_TERMINATING
+        from karpenter_tpu.apis.v1.labels import TERMINATION_FINALIZER
+
+        env = Environment(
+            types=[make_instance_type("c8", cpu=8, memory=32 * GIB)]
+        )
+        env.kube.create(mk_nodepool("p"))
+        env.provision(mk_pod(cpu=0.1))
+        claim = env.kube.node_claims()[0]
+        now = time.time()
+        env.kube.delete(claim, now=now)
+        # drive drain + node deletion to the instance-delete step, one
+        # controller pass at a time
+        for _ in range(6):
+            env.lifecycle.reconcile_all(now=now)
+            env.termination.reconcile_all(now=now)
+            live = env.kube.get_node_claim(claim.metadata.name)
+            if live is not None and live.status_conditions.is_true(
+                COND_INSTANCE_TERMINATING
+            ):
+                break
+        live = env.kube.get_node_claim(claim.metadata.name)
+        assert live is not None, "claim must persist while instance terminates"
+        assert live.status_conditions.is_true(COND_INSTANCE_TERMINATING)
+        assert TERMINATION_FINALIZER in live.metadata.finalizers
+        # provider still had the instance at mark time; the NEXT pass
+        # sees NotFound and releases the finalizer
+        env.lifecycle.reconcile_all(now=now)
+        assert env.kube.get_node_claim(claim.metadata.name) is None
+        assert not env.cloud.list()
+
     def test_rider_pod_rebirthed_when_node_dies(self):
         # review regression: a tolerating pod must not survive as a
         # ghost bound to a deleted node — it dies with the node and its
