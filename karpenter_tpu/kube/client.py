@@ -184,10 +184,6 @@ class KubeClient:
             self._notify(obj.kind, MODIFIED, obj)
             return obj
 
-    def touch(self, obj) -> object:
-        """Record a mutation made in place on a stored object."""
-        return self.update(obj)
-
     def delete(self, obj_or_kind, key: Optional[str] = None, now: Optional[float] = None):
         """Delete with finalizer semantics."""
         with self._lock:
@@ -208,6 +204,20 @@ class KubeClient:
             self._index_pod(obj, removed=True)
             self._notify(obj.kind, DELETED, obj)
             return None
+
+    def touch(self, obj) -> None:
+        """Publish a MODIFIED event for an object mutated in place.
+
+        Controllers that edit objects directly (conditions, timestamps,
+        annotations) bypass update() and would otherwise be invisible
+        to watch-driven consumers; touch restores the every-write-is-
+        an-event property the reference gets from the API server."""
+        with self._lock:
+            if self._bucket(obj.kind).get(obj.key) is not obj:
+                return  # deleted or replaced; nothing to announce
+            self._rv += 1
+            obj.metadata.resource_version = self._rv
+            self._notify(obj.kind, MODIFIED, obj)
 
     def remove_finalizer(self, obj, finalizer: str) -> None:
         with self._lock:

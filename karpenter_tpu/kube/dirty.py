@@ -1,0 +1,52 @@
+"""Watch-driven dirty tracking for incremental controller reconciles.
+
+The reference is watch-driven end to end (controllers.go:85-106): a
+controller touches an object only when an informer event names it. The
+tick-driven runtime here gets the same property via this tracker: each
+controller owns one, subscribes it to the kinds it cares about, and
+each tick drains only the keys that changed since the last drain —
+O(changes) instead of O(cluster) per tick. `KubeClient.watch` replays
+current state on subscribe (the informer initial LIST), so the first
+drain after startup is a full pass.
+
+In-place mutations bypass the API server analogue and therefore emit
+no watch events; controllers that mutate objects in place call
+`KubeClient.touch` so every tracker sees the change (the reference has
+no such path — every write goes through the API server — which is
+exactly the property touch() restores).
+"""
+
+from __future__ import annotations
+
+from karpenter_tpu.kube.client import KubeClient
+
+
+class DirtyTracker:
+    def __init__(self, kube: KubeClient):
+        self.kube = kube
+        self._sets: dict[str, set[str]] = {}
+        self._watched: set[str] = set()
+
+    def watch(self, *kinds: str) -> "DirtyTracker":
+        for kind in kinds:
+            if kind in self._watched:
+                continue
+            self._watched.add(kind)
+            self._sets.setdefault(kind, set())
+
+            def handler(event: str, obj, _k: str = kind) -> None:
+                self._sets[_k].add(obj.key)
+
+            self.kube.watch(kind, handler)
+        return self
+
+    def mark(self, kind: str, key: str) -> None:
+        self._sets.setdefault(kind, set()).add(key)
+
+    def drain(self, kind: str) -> set[str]:
+        out = self._sets.get(kind, set())
+        self._sets[kind] = set()
+        return out
+
+    def peek(self, kind: str) -> set[str]:
+        return set(self._sets.get(kind, set()))

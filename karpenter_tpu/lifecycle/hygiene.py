@@ -47,13 +47,38 @@ SHAPE_TOLERANCE = 0.10  # nodeshape.go:35
 
 class ConsistencyController:
     def __init__(self, kube: KubeClient, recorder: Optional[EventRecorder] = None):
+        from karpenter_tpu.kube.dirty import DirtyTracker
+
         self.kube = kube
         self.recorder = recorder or EventRecorder()
+        self.dirty = DirtyTracker(kube).watch("NodeClaim", "Node")
+
+    def reconcile_dirty(self, now: Optional[float] = None) -> None:
+        """O(changes): the shape invariant can only break when the
+        claim or its node changed."""
+        now = time.time() if now is None else now
+        claim_keys = self.dirty.drain("NodeClaim")
+        node_keys = self.dirty.drain("Node")
+        if not claim_keys and not node_keys:
+            return
+        pids = set()
+        for key in node_keys:
+            node = self.kube.get_node(key)
+            if node is not None:
+                pids.add(node.spec.provider_id)
+        claims = [
+            c for c in self.kube.node_claims()
+            if c.key in claim_keys or c.status.provider_id in pids
+        ]
+        self._check(claims, now)
 
     def reconcile_all(self, now: Optional[float] = None) -> None:
         now = time.time() if now is None else now
+        self._check(list(self.kube.node_claims()), now)
+
+    def _check(self, claims, now: float) -> None:
         nodes_by_pid = {n.spec.provider_id: n for n in self.kube.nodes()}
-        for claim in self.kube.node_claims():
+        for claim in claims:
             if not claim.status_conditions.is_true(COND_REGISTERED):
                 continue
             node = nodes_by_pid.get(claim.status.provider_id)
@@ -83,21 +108,47 @@ class ConsistencyController:
 
 class HydrationController:
     def __init__(self, kube: KubeClient):
+        from karpenter_tpu.kube.dirty import DirtyTracker
+
         self.kube = kube
+        self.dirty = DirtyTracker(kube).watch("NodeClaim", "Node")
+
+    def _hydrate(self, obj, pools) -> int:
+        pool = pools.get(obj.metadata.labels.get(NODEPOOL_LABEL, ""))
+        if pool is None:
+            return 0
+        if NODEPOOL_HASH_VERSION_ANNOTATION not in obj.metadata.annotations:
+            obj.metadata.annotations[NODEPOOL_HASH_VERSION_ANNOTATION] = (
+                NODEPOOL_HASH_VERSION
+            )
+            obj.metadata.annotations[NODEPOOL_HASH_ANNOTATION] = pool.hash()
+            return 1
+        return 0
 
     def reconcile_all(self) -> int:
         hydrated = 0
         pools = {p.metadata.name: p for p in self.kube.node_pools()}
         for obj in list(self.kube.node_claims()) + list(self.kube.nodes()):
-            pool = pools.get(obj.metadata.labels.get(NODEPOOL_LABEL, ""))
-            if pool is None:
-                continue
-            if NODEPOOL_HASH_VERSION_ANNOTATION not in obj.metadata.annotations:
-                obj.metadata.annotations[NODEPOOL_HASH_VERSION_ANNOTATION] = (
-                    NODEPOOL_HASH_VERSION
-                )
-                obj.metadata.annotations[NODEPOOL_HASH_ANNOTATION] = pool.hash()
-                hydrated += 1
+            hydrated += self._hydrate(obj, pools)
+        return hydrated
+
+    def reconcile_dirty(self) -> int:
+        """O(changes): hydration is a one-shot upgrade backfill — only
+        objects that just appeared or changed can need it."""
+        keys = self.dirty.drain("NodeClaim") | {
+            ("Node", k) for k in self.dirty.drain("Node")
+        }
+        if not keys:
+            return 0
+        pools = {p.metadata.name: p for p in self.kube.node_pools()}
+        hydrated = 0
+        for key in keys:
+            if isinstance(key, tuple):
+                obj = self.kube.get_node(key[1])
+            else:
+                obj = self.kube.get_node_claim(key)
+            if obj is not None:
+                hydrated += self._hydrate(obj, pools)
         return hydrated
 
 
@@ -105,10 +156,14 @@ class NodePoolStatusController:
     def __init__(self, kube: KubeClient, cluster: Cluster,
                  health: Optional[HealthTracker] = None,
                  nodeclass_ready: bool = True):
+        from karpenter_tpu.kube.dirty import DirtyTracker
+
         self.kube = kube
         self.cluster = cluster
         self.health = health or HealthTracker()
         self.nodeclass_ready = nodeclass_ready
+        self.dirty = DirtyTracker(kube).watch("NodeClaim", "Node")
+        self._pool_hashes: dict[str, str] = {}
 
     def reconcile_all(self, now: Optional[float] = None) -> None:
         now = time.time() if now is None else now
@@ -118,6 +173,35 @@ class NodePoolStatusController:
             self._registration_health(pool, now)
             self._validate(pool, now)
             self._hash_propagation(pool)
+
+    def reconcile_dirty(self, now: Optional[float] = None) -> None:
+        """Per-pool condition upkeep stays (pools are few and the work
+        is O(1) per pool); the O(cluster) parts — node-capacity
+        aggregation and hash propagation over owned claims — run only
+        when node/claim events or a pool-hash change demand it."""
+        now = time.time() if now is None else now
+        nodes_changed = bool(self.dirty.drain("Node"))
+        claim_keys = self.dirty.drain("NodeClaim")
+        for pool in self.kube.node_pools():
+            if nodes_changed or claim_keys:
+                self._counter(pool)
+            self._readiness(pool, now)
+            self._registration_health(pool, now)
+            self._validate(pool, now)
+            current = pool.hash()
+            if self._pool_hashes.get(pool.metadata.name) != current:
+                # template changed: every owned claim needs the stamp
+                self._pool_hashes[pool.metadata.name] = current
+                self._hash_propagation(pool)
+            elif claim_keys:
+                for key in claim_keys:
+                    claim = self.kube.get_node_claim(key)
+                    if (
+                        claim is not None
+                        and claim.metadata.labels.get(NODEPOOL_LABEL)
+                        == pool.metadata.name
+                    ):
+                        self._stamp_claim(claim, current)
 
     def _counter(self, pool) -> None:
         """nodepool/counter: aggregate owned capacity into status."""
@@ -183,9 +267,12 @@ class NodePoolStatusController:
         for claim in self.kube.node_claims():
             if claim.metadata.labels.get(NODEPOOL_LABEL) != pool.metadata.name:
                 continue
-            version = claim.metadata.annotations.get(NODEPOOL_HASH_VERSION_ANNOTATION)
-            if version != NODEPOOL_HASH_VERSION:
-                claim.metadata.annotations[NODEPOOL_HASH_VERSION_ANNOTATION] = (
-                    NODEPOOL_HASH_VERSION
-                )
-                claim.metadata.annotations[NODEPOOL_HASH_ANNOTATION] = current
+            self._stamp_claim(claim, current)
+
+    def _stamp_claim(self, claim, current: str) -> None:
+        version = claim.metadata.annotations.get(NODEPOOL_HASH_VERSION_ANNOTATION)
+        if version != NODEPOOL_HASH_VERSION:
+            claim.metadata.annotations[NODEPOOL_HASH_VERSION_ANNOTATION] = (
+                NODEPOOL_HASH_VERSION
+            )
+            claim.metadata.annotations[NODEPOOL_HASH_ANNOTATION] = current

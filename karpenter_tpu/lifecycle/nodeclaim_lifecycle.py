@@ -53,27 +53,82 @@ class NodeClaimLifecycle:
         cloud_provider: CloudProvider,
         health: Optional[HealthTracker] = None,
     ):
+        from karpenter_tpu.kube.dirty import DirtyTracker
+
         self.kube = kube
         self.cloud_provider = cloud_provider
         self.health = health or HealthTracker()
+        self.dirty = DirtyTracker(kube).watch("NodeClaim", "Node")
+        # claims mid-flight (not yet Initialized, or deleting): these
+        # progress on liveness clocks and cloud ticks that emit no
+        # object event, so they stay on the every-tick path until they
+        # settle — in steady state the set is empty
+        self._active: set[str] = set()
 
     # -- entry ----------------------------------------------------------------
 
     def reconcile(self, claim: NodeClaim, now: Optional[float] = None) -> None:
         now = time.time() if now is None else now
+        before = self._signature(claim)
         if claim.metadata.deletion_timestamp is not None:
             self._finalize(claim, now)
-            return
-        self._launch(claim, now)
-        if claim.status_conditions.is_true(COND_LAUNCHED):
-            self._register(claim, now)
-        if claim.status_conditions.is_true(COND_REGISTERED):
-            self._initialize(claim, now)
-        self._liveness(claim, now)
+        else:
+            self._launch(claim, now)
+            if claim.status_conditions.is_true(COND_LAUNCHED):
+                self._register(claim, now)
+            if claim.status_conditions.is_true(COND_REGISTERED):
+                self._initialize(claim, now)
+            self._liveness(claim, now)
+        if self._signature(claim) != before:
+            # conditions were set in place; announce so watch-driven
+            # consumers (conditions, hygiene, metrics) see the change
+            self.kube.touch(claim)
 
     def reconcile_all(self, now: Optional[float] = None) -> None:
         for claim in list(self.kube.node_claims()):
             self.reconcile(claim, now)
+
+    def reconcile_dirty(self, now: Optional[float] = None) -> None:
+        """O(changes + in-flight): dirty claims (object events, incl.
+        node events mapped back via nodeName) plus the active set of
+        claims still progressing through launch/register/initialize or
+        finalize."""
+        keys = self.dirty.drain("NodeClaim")
+        for node_key in self.dirty.drain("Node"):
+            node = self.kube.get_node(node_key)
+            if node is None:
+                continue
+            for claim in self.kube.node_claims():
+                if claim.status.provider_id == node.spec.provider_id:
+                    keys.add(claim.key)
+                    break
+        keys |= self._active
+        for key in keys:
+            claim = self.kube.get_node_claim(key)
+            if claim is None:
+                self._active.discard(key)
+                continue
+            self.reconcile(claim, now)
+            settled = (
+                claim.metadata.deletion_timestamp is None
+                and claim.status_conditions.is_true(COND_INITIALIZED)
+            )
+            live = self.kube.get_node_claim(key) is not None
+            if settled or not live:
+                self._active.discard(key)
+            else:
+                self._active.add(key)
+
+    def _signature(self, claim: NodeClaim) -> tuple:
+        return (
+            claim.status.provider_id,
+            claim.status.node_name,
+            tuple(
+                (c.type, c.status)
+                for c in claim.status_conditions.conditions
+            ),
+            len(claim.metadata.finalizers),
+        )
 
     # -- launch (launch.go:45-125) --------------------------------------------
 

@@ -147,9 +147,16 @@ def _tolerates_disrupted(pod: Pod) -> bool:
 
 class TerminationController:
     def __init__(self, kube: KubeClient, cluster=None):
+        from karpenter_tpu.kube.dirty import DirtyTracker
+
         self.kube = kube
         self.cluster = cluster
         self.queue = EvictionQueue(kube)
+        self.dirty = DirtyTracker(kube).watch("Node")
+        # nodes mid-termination: drain retries and volume waits emit no
+        # further node events, so they stay on the every-tick path
+        # until their finalizer drops — empty in steady state
+        self._terminating: set[str] = set()
 
     def reconcile(self, node: Node, now: Optional[float] = None) -> None:
         now = time.time() if now is None else now
@@ -196,6 +203,29 @@ class TerminationController:
         for node in list(self.kube.nodes()):
             self.reconcile(node, now=now)
         self.queue.prune()
+
+    def reconcile_dirty(self, now: Optional[float] = None) -> None:
+        """O(terminating nodes): only nodes carrying a deletion
+        timestamp ever need this controller, and they're tracked from
+        node events; drain/volume retries keep them in the set until
+        the finalizer drops."""
+        for key in self.dirty.drain("Node"):
+            node = self.kube.get_node(key)
+            if node is not None and node.metadata.deletion_timestamp is not None:
+                self._terminating.add(key)
+        if not self._terminating:
+            return
+        for key in list(self._terminating):
+            node = self.kube.get_node(key)
+            if node is None or node.metadata.deletion_timestamp is None:
+                self._terminating.discard(key)
+                continue
+            self.reconcile(node, now=now)
+            if self.kube.get_node(key) is None:
+                self._terminating.discard(key)
+        # eviction bookkeeping only exists while something drains
+        if self.queue.blocked or self.queue._retry_at:
+            self.queue.prune()
 
     # -- helpers ---------------------------------------------------------------
 

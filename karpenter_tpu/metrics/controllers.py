@@ -273,6 +273,13 @@ class StatusConditionMetricsController:
             obj_id = f"{kind}/{name}"
             live.add(obj_id)
             prev = self._seen.setdefault(obj_key, {})
+            # conditions REMOVED from the object (ConditionSet.clear —
+            # the normal Consolidatable churn pattern) must leave the
+            # tracking too: a later re-set is a fresh start, not a
+            # continuation of the pre-clear status
+            present = {ctype for ctype, _, _ in conditions}
+            for stale in [t for t in prev if t not in present]:
+                del prev[stale]
             current_rows = []
             for ctype, status, since in conditions:
                 counts[(kind, ctype, status)] = (

