@@ -30,17 +30,20 @@ import sys
 import time
 
 
-def _init_backend(probe_timeout: float = 90.0, retries: int = 2) -> str | None:
+def _init_backend(probe_timeout: float = 90.0, retries: int = 4) -> dict:
     """Make sure a JAX backend is usable before the parent process
     touches it. The TPU chip is single-tenant behind a tunnel and a
     dead tunnel makes backend init HANG (not error), so the probe runs
-    in a subprocess with a hard timeout; on persistent failure the
-    parent pins CPU and the bench still emits its JSON line with an
-    "error" note instead of hanging or crashing."""
+    in a subprocess with a hard timeout and RETRIES WITH BACKOFF — a
+    transient tunnel outage must not cost a round its only hardware
+    evidence. Only after every attempt fails does the parent pin CPU,
+    and the emitted JSON stamps full provenance (attempts, per-attempt
+    errors, which backend actually ran) either way."""
     import subprocess
 
-    err = None
+    provenance: dict = {"probe_attempts": 0, "probe_errors": []}
     for attempt in range(retries):
+        provenance["probe_attempts"] = attempt + 1
         try:
             proc = subprocess.run(
                 [sys.executable, "-c", "import jax; jax.devices()"],
@@ -48,22 +51,30 @@ def _init_backend(probe_timeout: float = 90.0, retries: int = 2) -> str | None:
                 capture_output=True,
             )
             if proc.returncode == 0:
-                return None
+                return provenance
             err = (proc.stderr or b"").decode(errors="replace")[-300:].strip()
         except subprocess.TimeoutExpired:
             err = f"backend probe hung >{probe_timeout:.0f}s (tunnel down?)"
+        provenance["probe_errors"].append(err)
         if attempt < retries - 1:
-            time.sleep(2.0 * (attempt + 1))
+            time.sleep(min(30.0, 3.0 * 2**attempt))
     from karpenter_tpu.utils.platform import force_cpu_mesh
 
+    last = provenance["probe_errors"][-1] if provenance["probe_errors"] else ""
     try:
         force_cpu_mesh()
         import jax
 
         jax.devices()
     except Exception as e2:
-        return f"tpu unavailable ({err}); cpu fallback also failed: {e2}"
-    return f"tpu backend unavailable ({err}); ran on cpu"
+        provenance["error"] = (
+            f"tpu unavailable ({last}); cpu fallback also failed: {e2}"
+        )
+        return provenance
+    provenance["error"] = (
+        f"tpu backend unavailable after {retries} probes ({last}); ran on cpu"
+    )
+    return provenance
 
 
 def _setup_jax_cache() -> None:
@@ -549,7 +560,8 @@ def main() -> int:
     n_types = int(os.environ.get("BENCH_TYPES", "500"))
     only = os.environ.get("BENCH_SCENARIOS", "")
 
-    backend_error = _init_backend()
+    provenance = _init_backend()
+    backend_error = provenance.get("error")
     if backend_error and "fallback also failed" in backend_error:
         # No usable backend at all — emit the JSON line and stop
         # before any further jax touch can crash or hang.
@@ -557,6 +569,7 @@ def main() -> int:
             "metric": "scheduler_throughput", "value": 0.0,
             "unit": "pods/sec", "vs_baseline": 0.0,
             "error": backend_error,
+            "backend_provenance": provenance,
         }))
         return 1
     _setup_jax_cache()
@@ -584,7 +597,8 @@ def main() -> int:
     errors = []
     if backend_error:
         errors.append(backend_error)
-    detail = {"backend": jax.default_backend()}
+    detail = {"backend": jax.default_backend(),
+              "backend_provenance": provenance}
     for name, fn in runners.items():
         try:
             detail[name] = fn()
@@ -593,7 +607,9 @@ def main() -> int:
             errors.append(f"{name}: {type(e).__name__}: {e}")
 
     headline = detail.get("reserved_50k") or next(
-        (v for k, v in detail.items() if k != "backend"), {}
+        (v for k, v in detail.items()
+         if k not in ("backend", "backend_provenance")),
+        {},
     )
     pods_per_sec = headline.get("pods_per_sec", 0.0)
     out = {

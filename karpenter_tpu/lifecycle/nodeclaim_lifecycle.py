@@ -94,14 +94,23 @@ class NodeClaimLifecycle:
         claims still progressing through launch/register/initialize or
         finalize."""
         keys = self.dirty.drain("NodeClaim")
-        for node_key in self.dirty.drain("Node"):
-            node = self.kube.get_node(node_key)
-            if node is None:
-                continue
-            for claim in self.kube.node_claims():
-                if claim.status.provider_id == node.spec.provider_id:
-                    keys.add(claim.key)
-                    break
+        node_keys = self.dirty.drain("Node")
+        if node_keys:
+            # one pid->claim index per pass, not a claim scan per node
+            # (mass registration would otherwise cost
+            # O(dirty_nodes x claims))
+            by_pid = {
+                c.status.provider_id: c.key
+                for c in self.kube.node_claims()
+                if c.status.provider_id
+            }
+            for node_key in node_keys:
+                node = self.kube.get_node(node_key)
+                if node is None:
+                    continue
+                hit = by_pid.get(node.spec.provider_id)
+                if hit is not None:
+                    keys.add(hit)
         keys |= self._active
         for key in keys:
             claim = self.kube.get_node_claim(key)

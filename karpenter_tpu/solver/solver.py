@@ -249,19 +249,6 @@ def _decode_device(
     # headroom, so no size gate is needed.
     from karpenter_tpu.solver import lp_plan
 
-    ffd_pending = _solve_packing_async(enc, mode="ffd", shards=shards)
-    plan = lp_plan.plan(enc)
-    cost_pending = (
-        _solve_packing_async(enc, mode="cost", plan=plan, shards=shards)
-        if plan is not None
-        else None
-    )
-    ffd_result = ffd_pending.result()
-    candidates = [(ffd_result, _downsize_masks(enc, ffd_result))]
-    if cost_pending is not None:
-        cost_result = cost_pending.result()
-        candidates.append((cost_result, _downsize_masks(enc, cost_result)))
-
     def key(item):
         # Only nodes that actually hold pods count: pre-opened planned
         # slots the packer never filled are skipped by decode, so they
@@ -274,6 +261,63 @@ def _decode_device(
         prices = np.where(masks[act], enc.cfg_price[None, :], np.inf).min(axis=1)
         fleet = float(np.where(np.isfinite(prices), prices, 0.0).sum())
         return (int(result.unschedulable.sum()), fleet, len(act))
+
+    # Steady-state race skip: FFD is deterministic per problem, so its
+    # full race key from the last identical solve IS what re-running
+    # it would produce. When the planned pack STRICTLY beats that
+    # recorded floor (min() prefers the FFD candidate on full ties),
+    # the answer is identical to racing — and the wall clock drops by
+    # the whole FFD kernel (the two kernels serialize on one device).
+    fp = _race_fingerprint(enc)
+    floor = _ffd_floor.get(fp)
+    plan = None
+    cost_tuple = None
+    if floor is not None:
+        plan = lp_plan.plan(enc)
+        if plan is not None:
+            cost_result = _solve_packing(
+                enc, mode="cost", plan=plan, shards=shards
+            )
+            masks = _downsize_masks(enc, cost_result)
+            cost_tuple = (cost_result, masks)
+            if key(cost_tuple) < floor:
+                solution = _build_solution_arrays(
+                    enc,
+                    np.flatnonzero(
+                        cost_result.node_active[: cost_result.node_count]
+                    ),
+                    masks,
+                    cost_result.assign,
+                    cost_result.unschedulable,
+                )
+                solution.lp = {
+                    "lower_bound": plan.lower_bound,
+                    "estimate": plan.objective_estimate,
+                }
+                return solution
+        # planned pack missing or not strictly better than the
+        # recorded floor: fall through to the race, reusing the plan
+        # AND the already-computed cost pack
+
+    ffd_pending = _solve_packing_async(enc, mode="ffd", shards=shards)
+    if plan is None:
+        plan = lp_plan.plan(enc)
+    cost_pending = (
+        _solve_packing_async(enc, mode="cost", plan=plan, shards=shards)
+        if plan is not None and cost_tuple is None
+        else None
+    )
+    ffd_result = ffd_pending.result()
+    candidates = [(ffd_result, _downsize_masks(enc, ffd_result))]
+    if cost_tuple is not None:
+        candidates.append(cost_tuple)
+    elif cost_pending is not None:
+        cost_result = cost_pending.result()
+        candidates.append((cost_result, _downsize_masks(enc, cost_result)))
+
+    if len(_ffd_floor) >= 32:
+        _ffd_floor.pop(next(iter(_ffd_floor)))
+    _ffd_floor[fp] = key(candidates[0])
 
     result, masks = min(candidates, key=key)
     solution = _build_solution_arrays(
@@ -289,6 +333,36 @@ def _decode_device(
             "estimate": plan.objective_estimate,
         }
     return solution
+
+
+# last FFD race key per problem fingerprint: (unschedulable, fleet
+# price, active node count) — the FULL race key, so the steady-state
+# skip reproduces min()'s exact tiebreaks. Bounded dict (oldest
+# evicted at 32 entries).
+_ffd_floor: dict[bytes, tuple[int, float, int]] = {}
+
+
+def _race_fingerprint(enc: Encoded) -> bytes:
+    """Digest of everything the FFD kernel's outcome depends on."""
+    import hashlib
+
+    h = hashlib.blake2b(digest_size=16)
+    for buf in (
+        enc.group_count, enc.group_req, enc.cfg_price, enc.cfg_alloc,
+        np.ascontiguousarray(enc.compat), enc.cfg_pool,
+        enc.pool_overhead, enc.existing_used,
+    ):
+        h.update(np.ascontiguousarray(buf).tobytes())
+    for opt in (
+        enc.cfg_rsv, enc.rsv_cap, enc.group_cap, enc.conflict,
+        enc.existing_quota, enc.loose_groups,
+    ):
+        h.update(
+            b"\x00" if opt is None
+            else np.ascontiguousarray(opt).tobytes()
+        )
+    h.update(enc.n_existing.to_bytes(4, "little"))
+    return h.digest()
 
 
 def _downsize_masks(enc: Encoded, result) -> np.ndarray:

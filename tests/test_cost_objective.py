@@ -119,3 +119,32 @@ class TestCostObjective:
         assert plan is not None
         gap = cost.total_price / plan.objective_estimate - 1
         assert gap < 0.08, f"fleet {gap:.1%} above LP estimate"
+
+
+class TestRaceSkip:
+    def test_steady_state_skip_matches_full_race(self):
+        """The FFD-floor cache must be invisible in results: a repeat
+        cost solve (which skips the FFD race arm) returns exactly what
+        the cold full race returned."""
+        from karpenter_tpu.solver import solver as solver_mod
+
+        pods, pools = hetero_problem(1500, 60, seed=9)
+        cold = solve(pods, pools, objective="cost")
+        enc = encode(group_pods(pods), pools)
+        assert solver_mod._race_fingerprint(enc) in solver_mod._ffd_floor
+        warm = solve(pods, pools, objective="cost")
+        assert warm.total_price == pytest.approx(cold.total_price)
+        assert len(warm.new_nodes) == len(cold.new_nodes)
+        assert not warm.unschedulable
+
+    def test_catalog_change_misses_floor_cache(self):
+        from karpenter_tpu.solver import solver as solver_mod
+
+        pods, pools = hetero_problem(400, 24, seed=31)
+        solve(pods, pools, objective="cost")
+        # different catalog -> different fingerprint -> full race
+        pods2, pools2 = hetero_problem(400, 32, seed=31)
+        enc2 = encode(group_pods(pods2), pools2)
+        assert solver_mod._race_fingerprint(enc2) not in solver_mod._ffd_floor
+        out = solve(pods2, pools2, objective="cost")
+        assert not out.unschedulable

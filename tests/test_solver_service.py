@@ -118,3 +118,84 @@ class TestService:
         client = RemoteSolver("127.0.0.1:1", timeout=0.5, fallback_local=False)
         with pytest.raises(Exception):
             client.solve_packing(enc, mode="ffd")
+
+
+class TestServiceShardingUnderFailure:
+    """VERDICT composition case: a sharded (8-way CPU mesh) solver
+    service serving CONCURRENT solves is killed mid-stream — every
+    in-flight and subsequent solve must still return the correct
+    result via the client's local failover, the breaker must open
+    after consecutive misses, and a restarted server must serve again
+    once the cooldown elapses. The determinism assertion (remote ==
+    local, bit-for-bit node counts and assignments) is what makes the
+    failover safe without revalidation — the same discipline
+    SimulateScheduling leans on (helpers.go:52-143)."""
+
+    def _encs(self, n=4):
+        out = []
+        for seed in range(n):
+            _, _, enc = _enc(240, 10, seed=seed + 20)
+            out.append(enc)
+        return out
+
+    def test_concurrent_sharded_solves_survive_kill_and_recover(self):
+        import threading
+        import time as _time
+        from concurrent.futures import ThreadPoolExecutor
+
+        from karpenter_tpu.service.client import BREAKER_FAILURES
+
+        encs = self._encs(4)
+        local = [solve_packing(e, mode="ffd") for e in encs]
+
+        srv = SolverServer(port=0, shards=8).start()
+        client = RemoteSolver(f"127.0.0.1:{srv.port}", timeout=10.0)
+        try:
+            # phase 1: concurrent solves through the sharded server
+            with ThreadPoolExecutor(4) as ex:
+                outs = list(ex.map(
+                    lambda e: client.solve_packing(e, mode="ffd"), encs
+                ))
+            assert srv.requests_served >= 4
+            for out, loc in zip(outs, local):
+                assert same_solution(out, loc)
+
+            # phase 2: kill mid-stream — the server dies while a
+            # concurrent batch is in flight; every solve must still
+            # come back correct (remote before the kill, local after)
+            killer = threading.Thread(
+                target=lambda: (_time.sleep(0.05), srv.stop(grace=0))
+            )
+            killer.start()
+            with ThreadPoolExecutor(4) as ex:
+                outs2 = list(ex.map(
+                    lambda e: client.solve_packing(e, mode="ffd"), encs
+                ))
+            killer.join()
+            for out, loc in zip(outs2, local):
+                assert same_solution(out, loc)
+
+            # phase 3: breaker opens after consecutive misses and
+            # short-circuits straight to local
+            for _ in range(BREAKER_FAILURES):
+                client.solve_packing(encs[0], mode="ffd")
+            assert client._skip_until > _time.monotonic()
+            t0 = _time.monotonic()
+            out = client.solve_packing(encs[0], mode="ffd")
+            assert out.node_count == local[0].node_count
+            assert _time.monotonic() - t0 < 5.0  # no RPC deadline burned
+
+            # phase 4: server restarts on the same port; once the
+            # cooldown elapses the client serves remotely again
+            srv2 = SolverServer(port=srv.port, shards=8).start()
+            try:
+                client._skip_until = 0.0  # cooldown elapsed
+                before = srv2.requests_served
+                out3 = client.solve_packing(encs[1], mode="ffd")
+                assert srv2.requests_served == before + 1
+                assert out3.node_count == local[1].node_count
+            finally:
+                srv2.stop()
+        finally:
+            client.close()
+            srv.stop()
