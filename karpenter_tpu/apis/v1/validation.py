@@ -44,10 +44,54 @@ _SYSTEM_REQUIREMENT_KEYS = frozenset({RESERVATION_ID_LABEL})
 
 VALID_OPERATORS = frozenset({"In", "NotIn", "Exists", "DoesNotExist", "Gt", "Lt"})
 VALID_TAINT_EFFECTS = frozenset({"NoSchedule", "PreferNoSchedule", "NoExecute"})
+VALID_CONSOLIDATION_POLICIES = frozenset(
+    {"WhenEmpty", "WhenEmptyOrUnderutilized"}  # nodepool.go:92
+)
+VALID_BUDGET_REASONS = frozenset(
+    {"Underutilized", "Empty", "Drifted"}  # nodepool.go:152
+)
 _DURATION_RE = re.compile(r"^([0-9]+(s|m|h))+$")
 _BUDGET_NODES_RE = re.compile(r"^((100|[0-9]{1,2})%|[0-9]+)$")
+# budget window length: hours/minutes only (nodepool.go:138)
+_BUDGET_DURATION_RE = re.compile(r"^((([0-9]+(h|m))|([0-9]+h[0-9]+m))(0s)?)$")
+# budget schedule: @-macros or 5-field cron (nodepool.go:129; the
+# alternation is parenthesized as a whole so BOTH branches anchor)
+_BUDGET_SCHEDULE_RE = re.compile(
+    r"^(@(annually|yearly|monthly|weekly|daily|midnight|hourly)"
+    r"|(.+\s){4}.+)$"
+)
+# label / taint qualified-name syntax (hack/validation/{labels,taint,
+# requirements}.sh: key <= 316 chars with optional DNS-subdomain
+# prefix; values <= 63 chars of [A-Za-z0-9-_.] with alnum ends)
+_QUALIFIED_KEY_RE = re.compile(
+    r"^([a-z0-9]([-a-z0-9]*[a-z0-9])?(\.[a-z0-9]([-a-z0-9]*[a-z0-9])?)*(\/))?"
+    r"([A-Za-z0-9][-A-Za-z0-9_.]*)?[A-Za-z0-9]$"
+)
+_LABEL_VALUE_RE = re.compile(r"^(([A-Za-z0-9][-A-Za-z0-9_.]*)?[A-Za-z0-9])?$")
 MAX_REQUIREMENTS = 100
 MAX_BUDGETS = 50
+MAX_KEY_LENGTH = 316
+MAX_VALUE_LENGTH = 63
+MAX_TEMPLATE_LABELS = 100
+MAX_WEIGHT = 100  # nodepool.go:60-61
+
+
+def _validate_qualified_key(key: str, where: str) -> list[str]:
+    errs = []
+    if not key or len(key) > MAX_KEY_LENGTH:
+        errs.append(f"{where}: key must be 1-{MAX_KEY_LENGTH} characters")
+    elif not _QUALIFIED_KEY_RE.match(key):
+        errs.append(f"{where}: key must be a qualified name")
+    return errs
+
+
+def _validate_label_value(value: str, where: str) -> list[str]:
+    errs = []
+    if len(value) > MAX_VALUE_LENGTH:
+        errs.append(f"{where}: value must be at most {MAX_VALUE_LENGTH} characters")
+    elif not _LABEL_VALUE_RE.match(value):
+        errs.append(f"{where}: invalid label value syntax")
+    return errs
 
 
 class ValidationError(ValueError):
@@ -72,6 +116,11 @@ def validate_requirements(requirements, field: str) -> list[str]:
         errs.append(f"{field}: more than {MAX_REQUIREMENTS} requirements")
     for spec in requirements:
         where = f"{field}[{spec.key}]"
+        errs += _validate_qualified_key(spec.key, where)
+        for value in spec.values:
+            # Gt/Lt operands are integers, exempt from label-value
+            # syntax (they pass it anyway); In/NotIn values are labels
+            errs += _validate_label_value(str(value), where)
         if spec.key == NODEPOOL_LABEL:
             # well-known on nodes, but user specs may not constrain it
             # (hack/validation/labels.sh: 'karpenter.sh/nodepool' is
@@ -118,6 +167,12 @@ def _validate_taints(taints, field: str) -> list[str]:
     for taint in taints:
         if not taint.key:
             errs.append(f"{field}: taint key must not be empty")
+        else:
+            errs += _validate_qualified_key(taint.key, f"{field}[{taint.key}]")
+        if taint.value:
+            errs += _validate_label_value(
+                taint.value, f"{field}[{taint.key}].value"
+            )
         if taint.effect not in VALID_TAINT_EFFECTS:
             errs.append(f"{field}: invalid taint effect {taint.effect!r}")
     return errs
@@ -127,10 +182,18 @@ def _validate_template(template) -> list[str]:
     errs = validate_requirements(
         template.spec.requirements, "spec.template.spec.requirements"
     )
-    for key in template.labels:
+    if len(template.labels) > MAX_TEMPLATE_LABELS:
+        errs.append(
+            f"spec.template.labels: more than {MAX_TEMPLATE_LABELS} labels"
+        )
+    for key, value in template.labels.items():
         restricted = is_restricted_label(key)
         if restricted:
             errs.append(f"spec.template.labels[{key}]: {restricted}")
+        errs += _validate_qualified_key(key, f"spec.template.labels[{key}]")
+        errs += _validate_label_value(
+            str(value), f"spec.template.labels[{key}]"
+        )
     errs += _validate_taints(template.spec.taints, "spec.template.spec.taints")
     errs += _validate_taints(
         template.spec.startup_taints, "spec.template.spec.startupTaints"
@@ -161,6 +224,11 @@ def validate_node_pool(pool, old=None) -> None:
     )
     if err:
         errs.append(err)
+    if disruption.consolidation_policy not in VALID_CONSOLIDATION_POLICIES:
+        errs.append(
+            "spec.disruption.consolidationPolicy: must be one of "
+            f"{sorted(VALID_CONSOLIDATION_POLICIES)}"
+        )
     if len(disruption.budgets) > MAX_BUDGETS:
         errs.append(f"spec.disruption.budgets: more than {MAX_BUDGETS} budgets")
     for i, budget in enumerate(disruption.budgets):
@@ -169,13 +237,30 @@ def validate_node_pool(pool, old=None) -> None:
             errs.append(f"{where}.nodes: must be an integer or percentage")
         if (budget.schedule is None) != (budget.duration is None):
             errs.append(f"{where}: 'schedule' must be set with 'duration'")
-        if budget.duration is not None:
-            err = _validate_duration(budget.duration, f"{where}.duration",
-                                     allow_never=False)
-            if err:
-                errs.append(err)
-    if not 0 <= pool.spec.weight <= 10000:
-        errs.append("spec.weight: must be in [0, 10000]")
+        if budget.schedule is not None and not _BUDGET_SCHEDULE_RE.match(
+            str(budget.schedule)
+        ):
+            errs.append(f"{where}.schedule: invalid cron schedule")
+        if budget.duration is not None and not isinstance(
+            budget.duration, (int, float)
+        ) and not _BUDGET_DURATION_RE.match(str(budget.duration)):
+            errs.append(
+                f"{where}.duration: must be hours/minutes (e.g. 30m, 1h30m)"
+            )
+        if budget.reasons is not None:
+            for reason in budget.reasons:
+                if reason not in VALID_BUDGET_REASONS:
+                    errs.append(
+                        f"{where}.reasons: {reason!r} not in "
+                        f"{sorted(VALID_BUDGET_REASONS)}"
+                    )
+    # reference weight is 1-100, nil = unset; 0 plays nil here. The cap
+    # RATCHETS: it binds on create and on writes that change weight, so
+    # an object stored under an older, wider rule stays updatable as
+    # long as the weight itself is untouched
+    weight_changed = old is None or old.spec.weight != pool.spec.weight
+    if weight_changed and not 0 <= pool.spec.weight <= MAX_WEIGHT:
+        errs.append(f"spec.weight: must be in [1, {MAX_WEIGHT}] (0 = unset)")
     for key, value in pool.spec.limits.items():
         if value < 0:
             errs.append(f"spec.limits[{key}]: must be non-negative")
@@ -186,13 +271,20 @@ def validate_node_pool(pool, old=None) -> None:
             errs.append("'weight' is not supported on static NodePools")
         if pool.spec.limits and set(pool.spec.limits) != {"nodes"}:
             errs.append("only 'limits.nodes' is supported on static NodePools")
-    if old is not None and (old.spec.replicas is None) != (
-        pool.spec.replicas is None
-    ):
-        errs.append(
-            "Cannot transition NodePool between static (replicas set) and "
-            "dynamic (replicas unset) provisioning modes"
-        )
+    if old is not None:
+        if (old.spec.replicas is None) != (pool.spec.replicas is None):
+            errs.append(
+                "Cannot transition NodePool between static (replicas set) "
+                "and dynamic (replicas unset) provisioning modes"
+            )
+        # nodeClassRef group/kind immutability (nodepool.go:204-205)
+        old_ref = old.spec.template.spec.node_class_ref
+        new_ref = pool.spec.template.spec.node_class_ref
+        if old_ref is not None and new_ref is not None:
+            if getattr(old_ref, "group", "") != getattr(new_ref, "group", ""):
+                errs.append("nodeClassRef.group is immutable")
+            if getattr(old_ref, "kind", "") != getattr(new_ref, "kind", ""):
+                errs.append("nodeClassRef.kind is immutable")
     if errs:
         raise ValidationError("; ".join(errs))
 

@@ -113,6 +113,137 @@ class TestAdmissionValidation:
         with pytest.raises(InvalidError):
             kube.update(changed)
 
+    def test_consolidation_policy_enum(self):
+        pool = mk_nodepool("p")
+        pool.spec.disruption.consolidation_policy = "WhenBored"
+        self._reject(pool)
+
+    def test_budget_reasons_enum(self):
+        pool = mk_nodepool("p")
+        pool.spec.disruption.budgets = [
+            Budget(nodes="5", reasons=["Tuesday"])
+        ]
+        self._reject(pool)
+
+    def test_budget_duration_hours_minutes_only(self):
+        # nodepool.go:138: the window length takes h/m, not seconds
+        pool = mk_nodepool("p")
+        pool.spec.disruption.budgets = [
+            Budget(nodes="5", schedule="0 9 * * *", duration="45s")
+        ]
+        self._reject(pool)
+
+    def test_budget_schedule_syntax(self):
+        pool = mk_nodepool("p")
+        pool.spec.disruption.budgets = [
+            Budget(nodes="5", schedule="whenever", duration="1h")
+        ]
+        self._reject(pool)
+        ok = mk_nodepool("ok")
+        ok.spec.disruption.budgets = [
+            Budget(nodes="5", schedule="@daily", duration="1h")
+        ]
+        KubeClient().create(ok)  # @-macros admitted
+
+    def test_weight_bounds(self):
+        pool = mk_nodepool("p")
+        pool.spec.weight = 101
+        self._reject(pool)
+
+    def test_weight_cap_ratchets_on_update(self):
+        """An object stored under an older, wider weight rule stays
+        updatable as long as the weight itself is untouched."""
+        import copy
+
+        kube = KubeClient()
+        pool = mk_nodepool("p")
+        kube.create(pool)
+        # simulate a legacy stored object outside the new cap
+        pool.spec.weight = 500
+        changed = copy.deepcopy(pool)
+        changed.spec.limits = {"cpu": 64.0}
+        kube.update(changed)  # unrelated edit: admitted
+        worse = copy.deepcopy(changed)
+        worse.spec.weight = 600
+        with pytest.raises(InvalidError):
+            kube.update(worse)  # touching weight engages the cap
+
+    def test_budget_schedule_macro_is_fully_anchored(self):
+        # regression: '@dailygarbage' must NOT pass as a macro
+        pool = mk_nodepool("p")
+        pool.spec.disruption.budgets = [
+            Budget(nodes="5", schedule="@dailygarbage", duration="1h")
+        ]
+        self._reject(pool)
+
+    def test_label_syntax_rules(self):
+        pool = mk_nodepool("p")
+        pool.spec.template.labels = {"example.com/ok": "-leading-dash"}
+        self._reject(pool)
+        pool2 = mk_nodepool("p2")
+        pool2.spec.template.spec.requirements = [
+            RequirementSpec(key="UPPER/lower!", operator="Exists", values=())
+        ]
+        self._reject(pool2)
+
+    def test_taint_qualified_name(self):
+        pool = mk_nodepool("p")
+        pool.spec.template.spec.taints = [
+            Taint(key="bad key with spaces", value="v", effect="NoSchedule")
+        ]
+        self._reject(pool)
+
+    def test_nodeclass_ref_group_kind_immutable(self):
+        import copy
+
+        from karpenter_tpu.apis.v1.nodeclaim import NodeClassRef
+
+        kube = KubeClient()
+        pool = mk_nodepool("p")
+        pool.spec.template.spec.node_class_ref = NodeClassRef(
+            group="karpenter.k8s.aws", kind="EC2NodeClass", name="default"
+        )
+        kube.create(pool)
+        changed = copy.deepcopy(pool)
+        changed.spec.template.spec.node_class_ref = NodeClassRef(
+            group="karpenter.k8s.aws", kind="OtherClass", name="default"
+        )
+        with pytest.raises(InvalidError):
+            kube.update(changed)
+
+    def test_crd_schema_artifacts_in_sync(self):
+        """The published CRD schema artifacts must match what the
+        validation constants generate — the `make verify` codegen
+        check: a rule change without a regenerated artifact fails."""
+        import os
+
+        from karpenter_tpu.apis import crds
+
+        rendered = crds.render()
+        for name, content in rendered.items():
+            path = os.path.join(crds.ARTIFACT_DIR, name)
+            assert os.path.exists(path), f"missing artifact {name}"
+            with open(path) as fh:
+                assert fh.read() == content, (
+                    f"{name} stale: run python -m karpenter_tpu.apis.crds"
+                )
+
+    def test_crd_schema_carries_cel_rules(self):
+        from karpenter_tpu.apis import crds
+
+        pool_schema = crds.nodepool_schema()
+        spec_schema = pool_schema["openAPIV3Schema"]["properties"]["spec"]
+        rules = [
+            r["rule"] for r in spec_schema["x-kubernetes-validations"]
+        ]
+        assert any("has(self.replicas) == has(oldSelf.replicas)" in r
+                   for r in rules)
+        reqs = pool_schema["openAPIV3Schema"]["properties"]["spec"][
+            "properties"]["template"]["properties"]["spec"]["properties"][
+            "requirements"]
+        req_rules = [r["rule"] for r in reqs["x-kubernetes-validations"]]
+        assert any("minValues" in r for r in req_rules)
+
     def test_valid_pool_admitted(self):
         kube = KubeClient()
         pool = mk_nodepool("p")
