@@ -331,13 +331,44 @@ class Scheduler:
                 continue
             if tolerates_pod(taints, pod) is not None:
                 continue
-            if not node_reqs.is_compatible(
-                Requirements.from_pod(pod, required_only=True),
-                allow_undefined=WELL_KNOWN_LABELS,
-            ):
+            if not self._daemon_compatible(node_reqs, pod):
                 continue
             expected = resutil.merge(expected, resutil.pod_requests(pod))
         return expected
+
+    def _daemon_compatible(self, node_reqs: Requirements, pod: Pod) -> bool:
+        """Daemon-pod schedulability against a node/template: required
+        node-affinity terms are ORed — ANY matching term admits the
+        pod (the kube-scheduler semantic the reference's per-term check
+        follows) — and hostname affinity is dropped first: a daemonset
+        pinned to an EXISTING node's hostname says nothing about new
+        capacity (suite_test.go "remove daemonset node hostname
+        affinity when considering daemonset schedulability")."""
+        base = Requirements.from_labels(dict(pod.spec.node_selector))
+        if pod.spec.injected_requirements:
+            base.add(*pod.spec.injected_requirements)
+        aff = pod.spec.affinity
+        terms = ()
+        if aff is not None and aff.node_affinity is not None:
+            terms = aff.node_affinity.required or ()
+        if not terms:
+            return node_reqs.is_compatible(
+                base, allow_undefined=WELL_KNOWN_LABELS
+            )
+        for term in terms:
+            reqs = Requirements(r.copy() for r in base)
+            reqs.add(*(
+                r
+                for r in Requirements.from_node_selector_requirements(
+                    term.match_expressions
+                ).values()
+                if r.key != HOSTNAME_LABEL
+            ))
+            if node_reqs.is_compatible(
+                reqs, allow_undefined=WELL_KNOWN_LABELS
+            ):
+                return True
+        return False
 
     def _daemon_reserve(self, node: StateNode) -> dict[str, float]:
         """Capacity still owed to daemonsets on this node: the

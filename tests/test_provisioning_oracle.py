@@ -181,6 +181,95 @@ class TestDaemonSets:
         env.provision(mk_pod(cpu=3.0))
         assert len(env.kube.node_claims()) == 1
 
+    def test_daemonset_or_terms_any_match_counts(self):
+        """suite_test.go:1249: required node-affinity terms are ORed —
+        a daemonset whose FIRST term can never match the pool but
+        whose second can is schedulable, so its overhead counts."""
+        env = Environment(types=[types()[0]])
+        env.kube.create(mk_nodepool("p"))
+        affinity = Affinity(
+            node_affinity=NodeAffinity(
+                preferred=(),
+                required=(
+                    NodeSelectorTerm(match_expressions=(
+                        NodeSelectorRequirement(
+                            "kubernetes.io/os", "In", ("windows",)
+                        ),
+                    )),
+                    NodeSelectorTerm(match_expressions=(
+                        NodeSelectorRequirement(
+                            "kubernetes.io/os", "In", ("linux",)
+                        ),
+                    )),
+                ),
+            )
+        )
+        env.kube.create(mk_daemonset(cpu=2.0, affinity=affinity))
+        results = env.provision(mk_pod(cpu=3.0), bind=False)
+        # 3 + 2 daemon > c4's allocatable: the overhead MUST count,
+        # leaving the pod unschedulable on a c4-only catalog
+        assert results.errors
+
+    def test_daemonset_hostname_pin_ignored_for_new_capacity(self):
+        """suite_test.go:1177: a daemonset pinned to an EXISTING
+        node's hostname says nothing about new capacity — the
+        hostname term is dropped before the schedulability check, so
+        the overhead still counts on fresh nodes."""
+        env = Environment(types=[types()[0]])
+        env.kube.create(mk_nodepool("p"))
+        affinity = Affinity(
+            node_affinity=NodeAffinity(
+                preferred=(),
+                required=(
+                    NodeSelectorTerm(match_expressions=(
+                        NodeSelectorRequirement(
+                            "kubernetes.io/hostname", "In", ("node-x",)
+                        ),
+                    )),
+                ),
+            )
+        )
+        env.kube.create(mk_daemonset(cpu=2.0, affinity=affinity))
+        results = env.provision(mk_pod(cpu=3.0), bind=False)
+        assert results.errors  # overhead counted despite the pin
+
+    def test_daemonset_notin_unspecified_key_counts(self):
+        """suite_test.go:1154: NotIn over a key the template leaves
+        undefined is satisfiable — the daemonset schedules, so its
+        overhead counts."""
+        env = Environment(types=[types()[0]])
+        env.kube.create(mk_nodepool("p"))
+        affinity = Affinity(
+            node_affinity=NodeAffinity(
+                preferred=(),
+                required=(
+                    NodeSelectorTerm(match_expressions=(
+                        NodeSelectorRequirement(
+                            "example.com/lane", "NotIn", ("slow",)
+                        ),
+                    )),
+                ),
+            )
+        )
+        env.kube.create(mk_daemonset(cpu=2.0, affinity=affinity))
+        results = env.provision(mk_pod(cpu=3.0), bind=False)
+        assert results.errors
+
+    def test_daemonset_prefer_no_schedule_taint_counts(self):
+        """suite_test.go:1337: a PreferNoSchedule pool taint never
+        blocks a daemonset, so the overhead counts untolerated."""
+        env = Environment(types=[types()[0]])
+        pool = mk_nodepool("p")
+        pool.spec.template.spec.taints = [
+            Taint(key="example.com/soft", value="x",
+                  effect="PreferNoSchedule")
+        ]
+        env.kube.create(pool)
+        env.kube.create(mk_daemonset(cpu=2.0))
+        pod = mk_pod(cpu=3.0)
+        results = env.provision(pod, bind=False)
+        assert results.errors
+
     def test_daemonset_preference_does_not_block(self):
         # suite_test.go:1309: an incompatible PREFERENCE still leaves
         # the daemonset schedulable -> overhead counted
