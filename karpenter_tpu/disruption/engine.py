@@ -229,6 +229,25 @@ class DisruptionEngine:
             disruption_cost=sum(pod_disruption_cost(p) for p in pods) * lifetime_factor,
         )
 
+    def offering_price_index(
+        self, pool_name: str, available_only: bool = False
+    ) -> dict[tuple[str, str, str], float]:
+        """(instance-type, zone, capacity-type) -> price for one pool's
+        current catalog. Shared by candidate pricing and execution-time
+        validation; fetch errors raise so callers decide whether the
+        failure is skippable (candidate pricing) or retryable
+        (validation)."""
+        prices: dict[tuple[str, str, str], float] = {}
+        pool = self.kube.get_node_pool(pool_name)
+        if pool is None:
+            return prices
+        for it in self.cloud.get_instance_types(pool):
+            for off in it.offerings:
+                if available_only and not off.available:
+                    continue
+                prices[(it.name, off.zone, off.capacity_type)] = off.price
+        return prices
+
     def _node_price(self, labels: dict[str, str]) -> Optional[float]:
         it_name = labels.get(INSTANCE_TYPE_LABEL, "")
         zone = labels.get(TOPOLOGY_ZONE_LABEL, "")
@@ -236,16 +255,12 @@ class DisruptionEngine:
         pool_name = labels.get(NODEPOOL_LABEL, "")
         index = self._price_index
         if pool_name not in index:
-            prices: dict[tuple[str, str, str], float] = {}
-            pool = self.kube.get_node_pool(pool_name)
             try:
-                for it in self.cloud.get_instance_types(pool):
-                    for off in it.offerings:
-                        prices[(it.name, off.zone, off.capacity_type)] = off.price
+                index[pool_name] = self.offering_price_index(pool_name)
             except Exception as err:
                 log.warning("price catalog fetch failed for pool %s: %s",
                             pool_name, err)
-            index[pool_name] = prices
+                index[pool_name] = {}
         return index[pool_name].get((it_name, zone, captype))
 
     # -- budgets (helpers.go:231-280) ------------------------------------------
@@ -278,10 +293,14 @@ class DisruptionEngine:
     # -- simulation (helpers.go:52-143) ----------------------------------------
 
     def simulate_scheduling(
-        self, candidates: Sequence[Candidate], objective: str = "ffd"
+        self, candidates: Sequence[Candidate], objective: str = "ffd",
+        include_pending: bool = True,
     ) -> tuple[SchedulerResults, bool]:
         """Re-run the scheduler with candidates removed. Returns
-        (results, all_pods_scheduled)."""
+        (results, all_pods_scheduled). `include_pending=False` solves
+        the candidates' pods alone — execution-time validation uses it
+        so an unrelated pending pod forcing a new node can't be
+        mistaken for the command going stale."""
         deleting_names = {c.state_node.name for c in candidates}
         snapshot = []
         for node in self.cluster.deep_copy_nodes():
@@ -298,7 +317,7 @@ class DisruptionEngine:
                 )
             snapshot.append(node)
         pods = [p for c in candidates for p in c.reschedulable_pods]
-        pending = self.provisioner.get_pending_pods()
+        pending = self.provisioner.get_pending_pods() if include_pending else []
         scheduler = Scheduler(
             pools_with_types=self.provisioner.ready_pools_with_types(),
             state_nodes=snapshot,
@@ -707,7 +726,7 @@ class OrchestrationQueue:
             if any(not p.claim_name for p in command.results.new_node_plans):
                 log.warning("replacement creation failed; rolling back %s command",
                             command.reason)
-                self._rollback(command)
+                self._rollback(command, now=now)
                 return
         self.active.append(command)
 
@@ -723,8 +742,23 @@ class OrchestrationQueue:
         for command in self.active:
             state = self._replacements_state(command)
             if state == "ready":
-                if self.validator is not None and not self._validate(command, now):
-                    self._rollback(command)
+                verdict = self._validate(command, now)
+                if verdict == "retry":
+                    # transient failure (e.g. catalog fetch blip): keep
+                    # the command active; the COMMAND_TIMEOUT deadline
+                    # bounds how long it can retry before rolling back
+                    if now - command.started_at > COMMAND_TIMEOUT_SECONDS:
+                        log.warning(
+                            "disruption command %s rolled back: validation "
+                            "still failing transiently after retry deadline",
+                            command.reason,
+                        )
+                        self._rollback(command, now=now)
+                    else:
+                        still_active.append(command)
+                    continue
+                if verdict == "invalid":
+                    self._rollback(command, now=now)
                     continue
                 for candidate in command.candidates:
                     claim = candidate.state_node.node_claim
@@ -733,19 +767,28 @@ class OrchestrationQueue:
             elif state == "failed" or now - command.started_at > COMMAND_TIMEOUT_SECONDS:
                 log.warning("disruption command %s rolled back (%s)", command.reason,
                             state)
-                self._rollback(command)
+                self._rollback(command, now=now)
             else:
                 still_active.append(command)
         self.active = still_active
 
-    def _validate(self, command: Command, now: float) -> bool:
+    def _validate(self, command: Command, now: float) -> str:
+        """'ok' | 'invalid' | 'retry'."""
+        if self.validator is None:
+            return "ok"
+        from karpenter_tpu.disruption.validation import ValidationRetry
+
         try:
             self.validator.validate_for_execution(command, now)
-            return True
+            return "ok"
+        except ValidationRetry as err:
+            log.warning("disruption command %s validation deferred: %s",
+                        command.reason, err)
+            return "retry"
         except Exception as err:
             log.warning("disruption command %s failed validation: %s",
                         command.reason, err)
-            return False
+            return "invalid"
 
     def _replacements_state(self, command: Command) -> str:
         """ready | waiting | failed."""
@@ -762,7 +805,8 @@ class OrchestrationQueue:
                 return "waiting"
         return "ready"
 
-    def _rollback(self, command: Command) -> None:
+    def _rollback(self, command: Command, now: Optional[float] = None) -> None:
+        now = time.time() if now is None else now
         for candidate in command.candidates:
             node = candidate.state_node
             node.marked_for_deletion = False
@@ -774,3 +818,34 @@ class OrchestrationQueue:
                 self.kube.update(node.node)
             if node.node_claim is not None:
                 node.node_claim.status_conditions.clear(COND_DISRUPTION_REASON)
+        # Replacements launched eagerly at start_command (the reference
+        # launches only after validation, queue.go:287): on rollback,
+        # retire the ones that never took load so a stale decision does
+        # not leave paid-for empty capacity waiting for emptiness to
+        # collect it. Replacements that host non-daemon pods OR have
+        # pending pods nominated onto them are kept — deleting those
+        # would disrupt (or strand) workloads.
+        if command.results is None:
+            return
+        for plan in command.results.new_node_plans:
+            if not plan.claim_name:
+                continue
+            claim = self.kube.get_node_claim(plan.claim_name)
+            if claim is None or claim.metadata.deletion_timestamp is not None:
+                continue
+            state_node = self.cluster.node_for_key(plan.claim_name)
+            hosts_load = False
+            if state_node is not None:
+                if state_node.nominated(now):
+                    hosts_load = True
+                else:
+                    for pod_key in state_node.pod_keys:
+                        pod = self.kube.get_pod(*pod_key.split("/", 1))
+                        if pod is None or pod.is_terminal() or pod.is_terminating():
+                            continue
+                        if pod.owner_kind() == "DaemonSet":
+                            continue
+                        hosts_load = True
+                        break
+            if not hosts_load:
+                self.kube.delete(claim, now=now)

@@ -1,0 +1,317 @@
+"""Execution-time validation of disruption commands.
+
+The reference re-verifies a consolidation command against fresh state
+after a TTL before executing it (disruption/validation.go:152-316):
+candidates must still be disruptable AND the command must still make
+economic sense. These tests exercise the window between compute and
+execute — prices move, offerings vanish, pods become unschedulable —
+and assert the command rolls back instead of executing stale.
+"""
+
+import time
+
+from karpenter_tpu.apis.v1.labels import DISRUPTED_NO_SCHEDULE_TAINT
+from karpenter_tpu.cloudprovider.fake import GIB, make_instance_type
+from karpenter_tpu.disruption.validation import (
+    VALIDATION_TTL_SECONDS,
+    ValidationError,
+    Validator,
+)
+from karpenter_tpu.testing import Environment, mk_nodepool, mk_pod
+
+
+def consolidation_types():
+    return [
+        make_instance_type("c2", cpu=2, memory=8 * GIB, price=2.0),
+        make_instance_type("c4", cpu=4, memory=16 * GIB, price=3.0),
+        make_instance_type("c8", cpu=8, memory=32 * GIB, price=5.0),
+    ]
+
+
+def make_env(**pool_kwargs):
+    env = Environment(types=consolidation_types())
+    pool = mk_nodepool("default")
+    pool.spec.disruption.consolidate_after = "0s"
+    for key, value in pool_kwargs.items():
+        setattr(pool.spec.disruption, key, value)
+    env.kube.create(pool)
+    return env
+
+
+def start_multi_node_command(env):
+    """Provision 3 one-cpu pods onto 3 small nodes, then compute the
+    multi-node consolidation command (3 x c2 @ 2.0 -> 1 x c4 @ 3.0)
+    WITHOUT progressing the queue: the replacement claims exist but are
+    not yet initialized, so nothing validates or executes yet."""
+    pods = []
+    for _ in range(3):
+        pod = mk_pod(cpu=1.0, memory=2 * GIB)
+        env.provision(pod)
+        pods.append(pod)
+    assert len(env.kube.nodes()) == 3
+    now = time.time() + 120
+    env.pod_events.reconcile_all(now=now)
+    env.conditions.reconcile_all(now=now)
+    command = env.disruption.reconcile(now=now)
+    assert command is not None and len(command.candidates) >= 2
+    assert command.replacement_count == 1
+    return command, now
+
+
+def initialize_replacements(env, now):
+    env.lifecycle.reconcile_all(now=now)
+    env.cloud.tick(now=now)
+    env.lifecycle.reconcile_all(now=now)
+
+
+def candidate_nodes_intact(env, command):
+    """Candidates not deleting and un-tainted (rollback happened)."""
+    for candidate in command.candidates:
+        claim = env.kube.get_node_claim(
+            candidate.state_node.node_claim.metadata.name
+        )
+        if claim is None or claim.metadata.deletion_timestamp is not None:
+            return False
+        node = candidate.state_node.node
+        if node is not None and any(
+            t.key == DISRUPTED_NO_SCHEDULE_TAINT.key for t in node.spec.taints
+        ):
+            return False
+    return True
+
+
+def reprice_replacement_types(env, command, price):
+    """Move every offering of every type a replacement plan could still
+    launch (the plan keeps fallback types, e.g. c8 behind c4 — all must
+    move for the economics to change)."""
+    plan_types = {
+        it.name for plan in command.results.new_node_plans
+        for it in plan.instance_types
+    }
+    for it in env.cloud.types:
+        if it.name in plan_types:
+            for off in it.offerings:
+                off.price = price
+
+
+class TestEconomicsRevalidation:
+    def test_replacement_price_rise_rolls_back(self):
+        """Every replacement offering's price jumps above the retired
+        price between compute and execute: the command must NOT delete
+        the candidates (validation.go:297-310 economics guard)."""
+        env = make_env()
+        command, now = start_multi_node_command(env)
+        retired = sum(c.price for c in command.candidates)
+        reprice_replacement_types(env, command, retired * 1.5)
+        initialize_replacements(env, now)
+        env.disruption.queue.reconcile(now=now)
+        assert candidate_nodes_intact(env, command)
+        # the never-loaded replacement claim is retired on rollback
+        replacement = command.results.new_node_plans[0].claim_name
+        claim = env.kube.get_node_claim(replacement)
+        assert claim is None or claim.metadata.deletion_timestamp is not None
+
+    def test_replacement_offering_vanished_rolls_back(self):
+        """Every instance type a plan could launch disappears from the
+        catalog (sold out / retired) before execution."""
+        env = make_env()
+        command, now = start_multi_node_command(env)
+        plan_types = {
+            it.name for plan in command.results.new_node_plans
+            for it in plan.instance_types
+        }
+        env.cloud.types = [
+            it for it in env.cloud.types if it.name not in plan_types
+        ]
+        initialize_replacements(env, now)
+        env.disruption.queue.reconcile(now=now)
+        assert candidate_nodes_intact(env, command)
+
+    def test_candidate_price_drop_rolls_back(self):
+        """The CANDIDATES' own offerings get cheaper so the merge no
+        longer wins (retired total falls below the replacement's
+        cheapest surviving price)."""
+        env = make_env()
+        command, now = start_multi_node_command(env)
+        cheapest_replacement = min(
+            o.price
+            for plan in command.results.new_node_plans
+            for o in plan.offerings
+        )
+        per_candidate = cheapest_replacement / (len(command.candidates) + 1)
+        for it in env.cloud.types:
+            if it.name == "c2":
+                for off in it.offerings:
+                    off.price = per_candidate
+        initialize_replacements(env, now)
+        env.disruption.queue.reconcile(now=now)
+        assert candidate_nodes_intact(env, command)
+
+    def test_unchanged_prices_execute(self):
+        """Prices stay put -> the command executes and candidates
+        drain (no false rollback from the new checks)."""
+        env = make_env()
+        command, now = start_multi_node_command(env)
+        initialize_replacements(env, now)
+        env.disruption.queue.reconcile(now=now)
+        # candidates now deleting
+        deleting = sum(
+            1
+            for candidate in command.candidates
+            if (claim := env.kube.get_node_claim(
+                candidate.state_node.node_claim.metadata.name
+            )) is None or claim.metadata.deletion_timestamp is not None
+        )
+        assert deleting == len(command.candidates)
+
+    def test_replacement_offering_unavailable_still_executes(self):
+        """An offering going unavailable for NEW launches must not roll
+        back a replacement that is already running on it — availability
+        gates launchability, not existing nodes."""
+        env = make_env()
+        command, now = start_multi_node_command(env)
+        initialize_replacements(env, now)
+        plan_types = {
+            it.name for plan in command.results.new_node_plans
+            for it in plan.instance_types
+        }
+        for it in env.cloud.types:
+            if it.name in plan_types:
+                for off in it.offerings:
+                    off.available = False
+        env.disruption.queue.reconcile(now=now)
+        assert not candidate_nodes_intact(env, command)
+
+    def test_price_rise_within_margin_still_executes(self):
+        """A replacement price move that KEEPS the strict win executes:
+        every replacement offering rises but stays just below the
+        retired price."""
+        env = make_env()
+        command, now = start_multi_node_command(env)
+        retired = sum(c.price for c in command.candidates)
+        reprice_replacement_types(env, command, retired * 0.95)
+        initialize_replacements(env, now)
+        env.disruption.queue.reconcile(now=now)
+        assert not candidate_nodes_intact(env, command)
+
+
+class TestTTLResimulation:
+    def test_resimulation_runs_after_ttl_and_executes(self):
+        """Past the TTL with nothing changed, re-simulation passes (the
+        launched replacement is live capacity) and the command
+        executes."""
+        env = make_env()
+        command, now = start_multi_node_command(env)
+        initialize_replacements(env, now)
+        late = now + VALIDATION_TTL_SECONDS + 1
+        env.disruption.queue.reconcile(now=late)
+        assert not candidate_nodes_intact(env, command)
+
+    def test_resimulation_unschedulable_pods_roll_back(self):
+        """After the TTL, candidate pods that can no longer reschedule
+        anywhere (selector now impossible) roll the command back
+        (validateCommand, validation.go:262-268)."""
+        env = make_env()
+        command, now = start_multi_node_command(env)
+        for candidate in command.candidates:
+            for pod in candidate.reschedulable_pods:
+                pod.spec.node_selector = {"no-such-label": "true"}
+        initialize_replacements(env, now)
+        late = now + VALIDATION_TTL_SECONDS + 1
+        env.disruption.queue.reconcile(now=late)
+        assert candidate_nodes_intact(env, command)
+
+    def test_within_ttl_skips_resimulation(self):
+        """Inside the TTL the re-simulation is skipped (the reference
+        validates exactly once after the TTL; cheap checks still run):
+        impossible selectors go unnoticed and the command executes."""
+        env = make_env()
+        command, now = start_multi_node_command(env)
+        for candidate in command.candidates:
+            for pod in candidate.reschedulable_pods:
+                pod.spec.node_selector = {"no-such-label": "true"}
+        initialize_replacements(env, now)
+        env.disruption.queue.reconcile(now=now + 1)
+        assert not candidate_nodes_intact(env, command)
+
+
+class TestTransientFailures:
+    def test_catalog_fetch_blip_retries_then_executes(self):
+        """A transient provider error during the validation-time
+        catalog re-fetch must NOT roll the command back (the queue has
+        a retry deadline for exactly this): the command stays active
+        and executes once the catalog is reachable again."""
+        env = make_env()
+        command, now = start_multi_node_command(env)
+        initialize_replacements(env, now)
+        real = env.cloud.get_instance_types
+
+        def flaky(pool):
+            raise RuntimeError("API blip")
+
+        env.cloud.get_instance_types = flaky
+        env.disruption.queue.reconcile(now=now)
+        # not rolled back, not executed: still active (candidates stay
+        # tainted while in flight), and no candidate is deleting yet
+        assert command in env.disruption.queue.active
+        for candidate in command.candidates:
+            claim = env.kube.get_node_claim(
+                candidate.state_node.node_claim.metadata.name
+            )
+            assert claim is not None
+            assert claim.metadata.deletion_timestamp is None
+        env.cloud.get_instance_types = real
+        env.disruption.queue.reconcile(now=now + 1)
+        assert not candidate_nodes_intact(env, command)
+
+    def test_catalog_outage_past_deadline_rolls_back(self):
+        """A catalog outage that outlives the command's retry deadline
+        rolls the command back instead of retrying forever."""
+        from karpenter_tpu.disruption.engine import COMMAND_TIMEOUT_SECONDS
+
+        env = make_env()
+        command, now = start_multi_node_command(env)
+        initialize_replacements(env, now)
+
+        def down(pool):
+            raise RuntimeError("API down")
+
+        env.cloud.get_instance_types = down
+        env.disruption.queue.reconcile(now=now + COMMAND_TIMEOUT_SECONDS + 1)
+        assert command not in env.disruption.queue.active
+        assert candidate_nodes_intact(env, command)
+
+
+class TestValidatorUnit:
+    def test_direct_validate_raises_on_price_move(self):
+        env = make_env()
+        command, now = start_multi_node_command(env)
+        retired = sum(c.price for c in command.candidates)
+        reprice_replacement_types(env, command, retired * 2)
+        validator = Validator(env.disruption)
+        try:
+            validator.validate_for_execution(command, now=now)
+            raised = False
+        except ValidationError:
+            raised = True
+        assert raised
+
+    def test_direct_validate_ok_when_fresh(self):
+        env = make_env()
+        command, now = start_multi_node_command(env)
+        Validator(env.disruption).validate_for_execution(command, now=now)
+
+    def test_nominated_candidate_rolls_back(self):
+        """A candidate nominated for a pod during the in-flight window
+        fails validation (validation.go:242-246)."""
+        env = make_env()
+        command, now = start_multi_node_command(env)
+        live = env.cluster.node_for_name(command.candidates[0].state_node.name)
+        live.nominate(now=now)
+        validator = Validator(env.disruption)
+        try:
+            validator.validate_for_execution(command, now=now)
+            raised = False
+        except ValidationError:
+            raised = True
+        assert raised
