@@ -1,0 +1,26 @@
+#!/bin/bash
+# In-round TPU watcher (VERDICT r03 item 1): probe the tunnel every
+# 10 min for up to 11 h; the moment the TPU answers, run the full
+# bench — bench.py persists BENCH_tpu_latest.json incrementally as
+# each TPU-backed scenario lands, so even a tunnel that dies mid-run
+# leaves durable hardware evidence. Touch .tpu_watcher_stop to halt.
+cd /root/repo || exit 1
+end=$((SECONDS + 39600))
+echo "$(date -u +%FT%TZ) watcher started (pid $$)" >> /root/repo/.tpu_watcher.log
+while [ $SECONDS -lt $end ]; do
+  if [ -f /root/repo/.tpu_watcher_stop ]; then
+    echo "$(date -u +%FT%TZ) stop file seen; exiting" >> /root/repo/.tpu_watcher.log
+    exit 0
+  fi
+  if timeout 60 python -c "import jax; assert any(d.platform=='tpu' for d in jax.devices())" >/dev/null 2>&1; then
+    echo "$(date -u +%FT%TZ) tunnel up; running bench" >> /root/repo/.tpu_watcher.log
+    timeout 5400 python bench.py > /root/repo/.tpu_watcher_bench.json 2>> /root/repo/.tpu_watcher.log
+    if [ -f /root/repo/BENCH_tpu_latest.json ]; then
+      echo "$(date -u +%FT%TZ) TPU evidence persisted; watcher done" >> /root/repo/.tpu_watcher.log
+      exit 0
+    fi
+    echo "$(date -u +%FT%TZ) bench ran but no TPU evidence; will retry" >> /root/repo/.tpu_watcher.log
+  fi
+  sleep 600
+done
+echo "$(date -u +%FT%TZ) watcher window closed without TPU" >> /root/repo/.tpu_watcher.log

@@ -77,6 +77,34 @@ def _init_backend(probe_timeout: float = 90.0, retries: int = 4) -> dict:
     return provenance
 
 
+def _persist_tpu_partial(detail: dict) -> None:
+    """Write/refresh BENCH_tpu_latest.json with whatever TPU-backed
+    scenario results exist so far (VERDICT r03 item 1: a mid-round TPU
+    window must leave durable evidence even if the end-of-round bench
+    finds the tunnel down again)."""
+    headline = detail.get("reserved_50k") or next(
+        (v for k, v in detail.items()
+         if isinstance(v, dict) and "pods_per_sec" in v),
+        {},
+    )
+    pods_per_sec = headline.get("pods_per_sec", 0.0)
+    out = {
+        "metric": "scheduler_throughput",
+        "value": pods_per_sec,
+        "unit": "pods/sec",
+        "vs_baseline": round(pods_per_sec / 100.0, 2),
+        "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "detail": detail,
+    }
+    tmp = "/root/repo/BENCH_tpu_latest.json.tmp"
+    try:
+        with open(tmp, "w") as fh:
+            json.dump(out, fh)
+        os.replace(tmp, "/root/repo/BENCH_tpu_latest.json")
+    except OSError as err:
+        print(f"could not persist TPU bench result: {err}", file=sys.stderr)
+
+
 def _setup_jax_cache() -> None:
     """Persistent compile cache keyed by backend + host CPU features so
     an artifact compiled on one machine is never loaded on another
@@ -555,10 +583,46 @@ def scenario_hetero(n_pods: int = 10000, n_types: int = 200) -> dict:
     return _timed_cost_solve(pods, pools, bound_gap=True)
 
 
+def _wait_for_tpu(max_wait_s: float, probe_timeout: float = 60.0) -> bool:
+    """Poll until the TPU backend answers or the window closes. Used by
+    the in-round watcher (BENCH_WAIT_TPU_S): three rounds produced zero
+    hardware evidence because the tunnel was down at the single moment
+    the bench probed — a tunnel that comes up at ANY point during a
+    round should yield a TPU-backed result."""
+    import subprocess
+
+    deadline = time.time() + max_wait_s
+    while True:
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; assert any(d.platform == 'tpu' "
+                 "for d in jax.devices())"],
+                timeout=probe_timeout,
+                capture_output=True,
+            )
+            if proc.returncode == 0:
+                return True
+        except subprocess.TimeoutExpired:
+            pass
+        if time.time() >= deadline:
+            return False
+        time.sleep(min(120.0, max(10.0, deadline - time.time())))
+
+
 def main() -> int:
     n_pods = int(os.environ.get("BENCH_PODS", "50000"))
     n_types = int(os.environ.get("BENCH_TYPES", "500"))
     only = os.environ.get("BENCH_SCENARIOS", "")
+    wait_tpu_s = float(os.environ.get("BENCH_WAIT_TPU_S", "0"))
+
+    if wait_tpu_s > 0 and not _wait_for_tpu(wait_tpu_s):
+        print(json.dumps({
+            "metric": "scheduler_throughput", "value": 0.0,
+            "unit": "pods/sec", "vs_baseline": 0.0,
+            "error": f"tpu did not come up within {wait_tpu_s:.0f}s wait window",
+        }))
+        return 3
 
     provenance = _init_backend()
     backend_error = provenance.get("error")
@@ -597,14 +661,23 @@ def main() -> int:
     errors = []
     if backend_error:
         errors.append(backend_error)
-    detail = {"backend": jax.default_backend(),
-              "backend_provenance": provenance}
+    backend = jax.default_backend()
+    detail = {"backend": backend, "backend_provenance": provenance}
     for name, fn in runners.items():
         try:
             detail[name] = fn()
+            # per-scenario backend stamp: a partial TPU run (tunnel died
+            # mid-bench) still counts as hardware evidence scenario by
+            # scenario
+            detail[name]["backend"] = backend
         except Exception as e:
-            detail[name] = {"error": f"{type(e).__name__}: {e}"}
+            detail[name] = {"error": f"{type(e).__name__}: {e}",
+                            "backend": backend}
             errors.append(f"{name}: {type(e).__name__}: {e}")
+        if backend == "tpu":
+            # persist incrementally THE MOMENT any TPU scenario lands —
+            # evidence must survive a crash/timeout later in the run
+            _persist_tpu_partial(detail)
 
     headline = detail.get("reserved_50k") or next(
         (v for k, v in detail.items()

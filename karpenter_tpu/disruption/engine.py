@@ -316,6 +316,27 @@ class DisruptionEngine:
                     False,
                 )
             snapshot.append(node)
+        return self._simulate_on_snapshot(candidates, snapshot, objective,
+                                          include_pending)
+
+    def has_uninitialized_capacity(
+        self, exclude_names: Optional[set] = None
+    ) -> bool:
+        """True while any managed node outside `exclude_names` is still
+        materializing — the condition under which the uninitialized-node
+        guard aborts a simulation. Execution-time validation checks it
+        FIRST so the transient abort maps to retry, not rollback."""
+        exclude = exclude_names or set()
+        return any(
+            node.managed() and not node.initialized() and not node.deleting()
+            for node in self.cluster.nodes()
+            if node.name not in exclude
+        )
+
+    def _simulate_on_snapshot(
+        self, candidates: Sequence[Candidate], snapshot: list,
+        objective: str, include_pending: bool,
+    ) -> tuple[SchedulerResults, bool]:
         pods = [p for c in candidates for p in c.reschedulable_pods]
         pending = self.provisioner.get_pending_pods() if include_pending else []
         scheduler = Scheduler(

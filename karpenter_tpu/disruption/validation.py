@@ -261,6 +261,16 @@ class Validator:
             )
             for c in command.candidates
         ]
+        # unrelated capacity still materializing (routine during any
+        # provisioning) aborts the simulation via the uninitialized-node
+        # guard — a TRANSIENT condition, so defer rather than roll back
+        # a still-valid command and destroy its replacements
+        if self.engine.has_uninitialized_capacity(
+            exclude_names={c.state_node.name for c in fresh}
+        ):
+            raise ValidationRetry(
+                "cluster has uninitialized capacity; deferring re-simulation"
+            )
         results, all_ok = self.engine.simulate_scheduling(
             fresh, include_pending=False
         )
