@@ -366,6 +366,280 @@ def pack_flat(*args, max_nodes: int, mode: str = "ffd", quota=None,
     )
 
 
+@functools.partial(jax.jit, static_argnames=("max_free", "mode"))
+def pack_split(
+    compat: jnp.ndarray,        # [G, C] bool
+    group_req: jnp.ndarray,     # [G, R] f32
+    group_count: jnp.ndarray,   # [G] i32
+    cfg_alloc: jnp.ndarray,     # [C, R] f32
+    cfg_pool: jnp.ndarray,      # [C] i32 (-1 for pseudo-configs)
+    pool_overhead: jnp.ndarray,  # [P+1, R] f32
+    bound_compat: jnp.ndarray,  # [G, B] bool — compat[g, bound_cfg[b]]
+    bound_alloc: jnp.ndarray,   # [B, R] f32 — cfg_alloc[bound_cfg]
+    bound_used0: jnp.ndarray,   # [B, R] f32 initial usage
+    bound_slot: jnp.ndarray,    # [B] i32 reservation slot (K = none)
+    bound_live: jnp.ndarray,    # [B] bool real row (not padding)
+    cfg_price: jnp.ndarray,     # [C] f32
+    max_free: int,
+    mode: str = "ffd",
+    bound_quota: jnp.ndarray | None = None,  # [B, G] i16 per-node caps
+    cfg_rsv: jnp.ndarray | None = None,
+    rsv_cap: jnp.ndarray | None = None,
+    group_cap: jnp.ndarray | None = None,
+    conflict: jnp.ndarray | None = None,
+):
+    """`pack` with the node axis SPLIT by config breadth.
+
+    Existing and LP-planned nodes are one-hot — each holds exactly one
+    (pseudo-)config column — so their per-group capacity is a dense
+    [B, R] computation against a pre-gathered alloc vector, NOT a slice
+    of the [N, C, R] broadcast. Only fresh rows (multi-config masks the
+    bulk-open writes) pay the [F, C, R] work. A planned 50k-pod solve
+    carries ~5k one-hot rows against a ~200-row fresh spill axis, so
+    the per-iteration work drops ~25x vs the dense kernel while the
+    semantics stay bit-identical: bound rows sit at the low indices
+    (existing first, planned next, fresh last — the reference's
+    existing -> in-flight -> new order, scheduler.go:515-587), the
+    unified prefix fill runs over the concatenated capacity vector, and
+    one-hot rows never tighten (their mask is the single column the
+    capacity was computed from). `pack` remains as the dense oracle the
+    equivalence tests compare against.
+    """
+    G, C = compat.shape
+    R = group_req.shape[1]
+    B = bound_alloc.shape[0]
+    F = max_free
+    if bound_quota is not None:
+        bound_quota = bound_quota.astype(jnp.int32)
+
+    free_mask = jnp.zeros((F, C), bool)
+    free_used = jnp.zeros((F, R), jnp.float32)
+    assign = jnp.zeros((B + F, G), jnp.int32)
+    unschedulable = jnp.zeros((G,), jnp.int32)
+    if cfg_rsv is None:
+        cfg_rsv = jnp.full((C,), -1, jnp.int32)
+    if rsv_cap is None:
+        rsv_cap = jnp.zeros((0,), jnp.float32)
+    K = rsv_cap.shape[0]
+    capped = cfg_rsv >= 0
+    rsv_cap_ext = jnp.concatenate([rsv_cap, jnp.full((1,), BIG, jnp.float32)])
+    cfg_slot = jnp.where(capped, cfg_rsv, K)
+    # bound rows on capped columns consumed their reservation budget
+    # when they were opened/planned (same init as the dense kernel's
+    # existing_mask column sums)
+    rsv_used0 = (
+        jnp.zeros((K + 1,), jnp.float32)
+        .at[bound_slot]
+        .add(jnp.where(bound_live & (bound_slot < K), 1.0, 0.0))
+    )
+
+    def capacity(used_j, req):
+        safe_req = jnp.where(req > 0, req, 1.0)
+        head = cfg_alloc - used_j[None, :]
+        k = jnp.floor((head + 1e-4) / safe_req[None, :])
+        k = jnp.where(req[None, :] > 0, k, BIG)
+        return jnp.clip(jnp.min(k, axis=-1), 0.0, BIG).astype(jnp.int32)
+
+    def body(g, state):
+        (free_mask, free_used, node_count, assign, unsched,
+         rsv_used, bound_used) = state
+        req = group_req[g]
+        row = compat[g]
+        remaining = group_count[g]
+        safe_req = jnp.where(req > 0, req, 1.0)
+        alloc_minus_req = cfg_alloc - req[None, :]
+
+        blocked = None
+        if conflict is not None:
+            blocked = (assign * conflict[g][None, :]).sum(axis=1) > 0
+
+        # ---- bound rows: one config each, O(B x R)
+        kb = jnp.floor(
+            (bound_alloc - bound_used + 1e-4) / safe_req[None, :]
+        )
+        kb = jnp.where(req[None, :] > 0, kb, BIG).min(axis=-1)
+        kb = jnp.clip(kb, 0.0, 2.0e9).astype(jnp.int32)
+        ok_b = bound_compat[g] & bound_live & (kb >= 1)
+        kb = kb * ok_b
+        if bound_quota is not None:
+            kb = jnp.minimum(kb, bound_quota[:, g])
+        if group_cap is not None:
+            kb = jnp.minimum(
+                kb, jnp.maximum(group_cap[g] - assign[:B, g], 0)
+            )
+        if blocked is not None:
+            kb = jnp.where(blocked[:B], 0, kb)
+
+        # ---- fresh rows: multi-config masks, O(F x C x R)
+        kmat = jnp.floor(
+            (cfg_alloc[None, :, :] - free_used[:, None, :] + 1e-4)
+            / safe_req[None, None, :]
+        )
+        kmat = jnp.where(req[None, None, :] > 0, kmat, BIG).min(axis=-1)
+        kmat = jnp.clip(kmat, 0.0, 2.0e9).astype(jnp.int32)
+        okf = free_mask & row[None, :] & (kmat >= 1)
+        pinned = free_mask & capped[None, :]
+        is_pinned = pinned.any(axis=1)
+        pin_ok = (okf & pinned).any(axis=1)
+        okf = okf & jnp.where(is_pinned[:, None], pin_ok[:, None], True)
+        kmat = kmat * okf
+        kf = jnp.where(
+            is_pinned, (kmat * pinned).max(axis=1), kmat.max(axis=1)
+        )
+        if group_cap is not None:
+            kf = jnp.minimum(
+                kf, jnp.maximum(group_cap[g] - assign[B:, g], 0)
+            )
+        if blocked is not None:
+            kf = jnp.where(blocked[B:], 0, kf)
+
+        # ---- unified prefix fill (bound rows precede fresh in index
+        # order, preserving existing -> in-flight/planned -> new)
+        k = jnp.concatenate([kb, kf])
+        prefix = jnp.cumsum(k) - k
+        take = jnp.clip(remaining - prefix, 0, k)
+        take_b = take[:B]
+        take_f = take[B:]
+        touched_f = take_f > 0
+        free_mask = jnp.where(
+            touched_f[:, None], okf & (kmat >= take_f[:, None]), free_mask
+        )
+        bound_used = bound_used + take_b[:, None].astype(jnp.float32) * req[None, :]
+        free_used = free_used + take_f[:, None].astype(jnp.float32) * req[None, :]
+        assign = assign.at[:, g].add(take)
+        remaining = remaining - take.sum()
+
+        # ---- bulk open on the fresh axis (identical to the dense
+        # kernel; node indices offset by the bound block)
+        fits_fresh = row & jnp.all(
+            pool_overhead[cfg_pool] <= alloc_minus_req, axis=-1
+        ) & (cfg_pool >= 0)
+
+        def open_cond(args):
+            _, _, node_count, _, remaining, rsv_used = args
+            can = fits_fresh & (rsv_used[cfg_slot] < rsv_cap_ext[cfg_slot])
+            return (remaining > 0) & can.any() & (node_count < B + F)
+
+        def open_round(args):
+            (free_mask, free_used, node_count, assign,
+             remaining, rsv_used) = args
+            fresh_ok = fits_fresh & (rsv_used[cfg_slot] < rsv_cap_ext[cfg_slot])
+            chosen_pool = jnp.min(jnp.where(fresh_ok, cfg_pool, INT_BIG))
+            mask = fresh_ok & (cfg_pool == chosen_pool)
+            overhead = pool_overhead[chosen_pool]
+            kf = capacity(overhead, req) * mask
+            if mode == "cost":
+                ppp = jnp.where(kf >= 1, cfg_price / jnp.maximum(kf, 1), BIG)
+                c_star = jnp.argmin(ppp)
+            else:
+                kf_ok = kf >= 1
+                min_uncapped = jnp.min(
+                    jnp.where(kf_ok & ~capped, cfg_price, BIG)
+                )
+                res_mask = kf_ok & capped & (cfg_price < min_uncapped)
+                c_res = jnp.argmax(jnp.where(res_mask, kf, -1))
+                c_star = jnp.where(res_mask.any(), c_res, jnp.argmax(kf))
+            m_star = jnp.maximum(kf[c_star], 1)
+            if group_cap is not None:
+                m_star = jnp.clip(group_cap[g], 1, m_star)
+            slot_star = cfg_slot[c_star]
+            cap_left = jnp.minimum(
+                rsv_cap_ext[slot_star] - rsv_used[slot_star], 2.0e9
+            )
+            q = jnp.minimum((remaining + m_star - 1) // m_star,
+                            B + F - node_count)
+            q = jnp.minimum(q, jnp.maximum(cap_left, 0).astype(jnp.int32))
+            q = jnp.maximum(q, 1)
+            rem_last = jnp.clip(remaining - (q - 1) * m_star, 1, m_star)
+            free_base = node_count - B
+            idx = jnp.arange(F, dtype=jnp.int32)
+            sel_full = (idx >= free_base) & (idx < free_base + q - 1)
+            sel_last = idx == free_base + q - 1
+            fill = (
+                sel_full.astype(jnp.int32) * m_star
+                + sel_last.astype(jnp.int32) * rem_last
+            )
+            is_capped = capped[c_star]
+            one_hot = jnp.arange(C) == c_star
+            base_full = mask & ~capped & (kf >= m_star)
+            base_last = mask & ~capped & (kf >= rem_last)
+            open_mask_full = jnp.where(is_capped, one_hot | base_full, base_full)
+            open_mask_last = jnp.where(is_capped, one_hot | base_last, base_last)
+            free_mask = jnp.where(
+                sel_full[:, None], open_mask_full[None, :],
+                jnp.where(sel_last[:, None], open_mask_last[None, :], free_mask),
+            )
+            free_used = jnp.where(
+                (sel_full | sel_last)[:, None],
+                overhead[None, :] + fill[:, None].astype(jnp.float32) * req[None, :],
+                free_used,
+            )
+            placed = (q - 1) * m_star + rem_last
+            fill_all = jnp.concatenate(
+                [jnp.zeros((B,), jnp.int32), fill]
+            )
+            return (
+                free_mask,
+                free_used,
+                node_count + q,
+                assign.at[:, g].add(fill_all),
+                remaining - placed,
+                rsv_used.at[slot_star].add(q.astype(jnp.float32)),
+            )
+
+        (free_mask, free_used, node_count, assign, remaining,
+         rsv_used) = jax.lax.while_loop(
+            open_cond,
+            open_round,
+            (free_mask, free_used, node_count, assign,
+             remaining, rsv_used),
+        )
+        unsched = unsched.at[g].add(jnp.maximum(remaining, 0))
+        return (free_mask, free_used, node_count, assign,
+                unsched, rsv_used, bound_used)
+
+    state = jax.lax.fori_loop(
+        0,
+        G,
+        body,
+        (free_mask, free_used, jnp.int32(B), assign,
+         unschedulable, rsv_used0, bound_used0),
+    )
+    (free_mask, free_used, node_count, assign, unsched,
+     _, _) = state
+    return assign, free_mask, node_count, unsched
+
+
+@functools.partial(jax.jit, static_argnames=("max_free", "mode"))
+def pack_split_flat(*args, max_free: int, mode: str = "ffd",
+                    bound_quota=None, cfg_rsv=None, rsv_cap=None,
+                    group_cap=None, conflict=None):
+    """`pack_split` with outputs fused into ONE compact uint32 vector
+    (see pack_flat for the transport rationale). Bound rows ship no
+    masks at all — the host rebuilds their one-hot rows from the
+    bound_cfg vector it computed, so the payload shrinks by the whole
+    [B, C] block."""
+    assign, free_mask, node_count, unsched = pack_split(
+        *args, max_free=max_free, mode=mode, bound_quota=bound_quota,
+        cfg_rsv=cfg_rsv, rsv_cap=rsv_cap, group_cap=group_cap,
+        conflict=conflict,
+    )
+    f, cp = free_mask.shape
+    words = cp // 32
+    packed = (
+        free_mask.reshape(f, words, 32).astype(jnp.uint32)
+        << jnp.arange(32, dtype=jnp.uint32)[None, None, :]
+    ).sum(axis=-1, dtype=jnp.uint32)
+    return jnp.concatenate(
+        [
+            assign.astype(jnp.uint32).ravel(),
+            packed.ravel(),
+            node_count.astype(jnp.uint32)[None],
+            unsched.astype(jnp.uint32).ravel(),
+        ]
+    )
+
+
 # problem-shape signature -> node-axis bucket that fit last time.
 # Guarded by a lock: the cost objective runs its FFD and planned solves
 # on separate threads, and an unsynchronized clear-at-cap could drop a
@@ -620,7 +894,11 @@ def _run_pack(
     shards: int = 0,
 ):
     """Dispatch one kernel attempt; returns a zero-arg callable that
-    blocks on the device buffer and decodes it into a PackResult."""
+    blocks on the device buffer and decodes it into a PackResult.
+
+    Existing/planned one-hot rows become the split kernel's BOUND block
+    (config index + pre-gathered alloc vector, host-computed); only the
+    fresh axis keeps full [F, C] masks."""
     import math
 
     G, C = enc.compat.shape
@@ -632,6 +910,12 @@ def _run_pack(
     step = math.lcm(32, shards) if shards > 1 else 32
     Cp = -(-Cp // step) * step
     N = max_nodes
+
+    # every call path guarantees the node axis holds the existing
+    # slots (the explicit-max_nodes path clamps to reserved_p; the
+    # auto-sized path starts there)
+    assert N >= Ep, (N, Ep)
+    F = N - Ep  # fresh axis
 
     compat = np.zeros((Gp, Cp), bool)
     compat[:G, :C] = enc.compat
@@ -645,31 +929,43 @@ def _run_pack(
     cfg_pool[:C] = enc.cfg_pool
     cfg_price = np.zeros((Cp,), np.float32)
     cfg_price[:C] = enc.cfg_price
-    emask = np.zeros((Ep, Cp), bool)
-    eused = np.zeros((Ep, R), np.float32)
-    if E:
-        emask[:E, :C] = existing_mask
-        eused[:E] = existing_used
 
-    quota_full = None
-    if quota is not None or enc.group_cap is not None:
+    # ---- bound block: one-hot rows flattened to per-row vectors
+    bound_cfg = np.full((Ep,), -1, np.int32)
+    bound_used_h = np.zeros((Ep, R), np.float32)
+    if E:
+        any_col = existing_mask.any(axis=1)
+        # rows are strictly one-hot by construction (one pseudo-config
+        # per existing node, one planned column per planned slot)
+        assert (existing_mask.sum(axis=1) <= 1).all()
+        bound_cfg[:E] = np.where(any_col, existing_mask.argmax(axis=1), -1)
+        bound_used_h[:E] = existing_used
+    bound_live_h = bound_cfg >= 0
+    safe_cfg = np.maximum(bound_cfg, 0)
+    bound_alloc_h = np.where(
+        bound_live_h[:, None], cfg_alloc[safe_cfg], 0.0
+    ).astype(np.float32)
+    bound_compat_h = np.zeros((Gp, Ep), bool)
+    if Ep:
+        bound_compat_h[:, :] = compat[:, safe_cfg] & bound_live_h[None, :]
+
+    bound_quota_h = None
+    if quota is not None:
         # int16 on the wire: per-node pod counts are bounded by the
         # 'pods' capacity (hundreds), so 32767 is an honest "no cap"
         # sentinel at half the transfer bytes; the kernel widens back
-        # to int32 before comparing.
-        quota_full = np.full((N, Gp), np.int16(32767), np.int16)
+        # to int32 before comparing. No quota rows ship for group_cap
+        # alone — the kernel's dynamic max(group_cap[g] - assign, 0)
+        # clamp is always at least as tight as the static min would be.
+        bound_quota_h = np.full((Ep, Gp), np.int16(32767), np.int16)
+        bound_quota_h[: quota.shape[0], :G] = np.minimum(
+            quota[:, :G], 32767
+        ).astype(np.int16)
         if enc.group_cap is not None:
-            # per-node caps apply to every node slot, fresh ones included
-            quota_full[:, :G] = np.minimum(
-                quota_full[:, :G],
+            bound_quota_h[:, :G] = np.minimum(
+                bound_quota_h[:, :G],
                 np.minimum(enc.group_cap, 32767)[None, :].astype(np.int16),
             )
-        if quota is not None:
-            quota_full[: quota.shape[0], :G] = np.minimum(
-                np.minimum(quota[:, :G], 32767).astype(np.int16),
-                quota_full[: quota.shape[0], :G],
-            )
-        quota_full = jnp.asarray(quota_full)
     group_cap_full = None
     if enc.group_cap is not None:
         gc = np.full((Gp,), np.iinfo(np.int32).max, np.int32)
@@ -682,22 +978,38 @@ def _run_pack(
         conflict_full = jnp.asarray(cf)
     cfg_rsv = None
     rsv_cap = None
+    K = 0
     if enc.rsv_cap is not None and enc.rsv_cap.size:
+        K = int(enc.rsv_cap.size)
         rsvp = np.full((Cp,), -1, np.int32)
         rsvp[:C] = enc.cfg_rsv
         cfg_rsv = jnp.asarray(rsvp)
         rsv_cap = jnp.asarray(enc.rsv_cap.astype(np.float32))
+        cfg_rsv_h = rsvp
+    else:
+        cfg_rsv_h = np.full((Cp,), -1, np.int32)
+    bound_slot_h = np.where(
+        bound_live_h & (cfg_rsv_h[safe_cfg] >= 0), cfg_rsv_h[safe_cfg], K
+    ).astype(np.int32)
 
     compat_j = jnp.asarray(compat)
     cfg_alloc_j = jnp.asarray(cfg_alloc)
     cfg_pool_j = jnp.asarray(cfg_pool)
     cfg_price_j = jnp.asarray(cfg_price)
-    emask_j = jnp.asarray(emask)
+    bound = {
+        "bound_compat": jnp.asarray(bound_compat_h),
+        "bound_alloc": jnp.asarray(bound_alloc_h),
+        "bound_used0": jnp.asarray(bound_used_h),
+        "bound_slot": jnp.asarray(bound_slot_h),
+        "bound_live": jnp.asarray(bound_live_h),
+    }
+    bound_quota_j = (
+        jnp.asarray(bound_quota_h) if bound_quota_h is not None else None
+    )
     rest = {
         "group_req": jnp.asarray(group_req),
         "group_count": jnp.asarray(group_count),
         "pool_overhead": jnp.asarray(enc.pool_overhead),
-        "eused": jnp.asarray(eused),
     }
     if shards > 1:
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -709,35 +1021,39 @@ def _run_pack(
         replicated = NamedSharding(mesh, P())
         # committed input shardings drive GSPMD: the jitted kernel
         # compiles with the config axis split over ICI and everything
-        # else replicated
+        # else (including the bound block, whose per-row work has no
+        # config axis) replicated
         compat_j = jax.device_put(compat_j, shard_nc)
         cfg_alloc_j = jax.device_put(cfg_alloc_j, shard_cr)
         cfg_pool_j = jax.device_put(cfg_pool_j, shard_cfg)
         cfg_price_j = jax.device_put(cfg_price_j, shard_cfg)
-        emask_j = jax.device_put(emask_j, shard_nc)
+        bound = {k: jax.device_put(v, replicated) for k, v in bound.items()}
         rest = {k: jax.device_put(v, replicated) for k, v in rest.items()}
         if cfg_rsv is not None:
             cfg_rsv = jax.device_put(cfg_rsv, shard_cfg)
             rsv_cap = jax.device_put(rsv_cap, replicated)
-        if quota_full is not None:
-            quota_full = jax.device_put(quota_full, replicated)
+        if bound_quota_j is not None:
+            bound_quota_j = jax.device_put(bound_quota_j, replicated)
         if group_cap_full is not None:
             group_cap_full = jax.device_put(group_cap_full, replicated)
         if conflict_full is not None:
             conflict_full = jax.device_put(conflict_full, replicated)
-    flat_dev = pack_flat(
+    flat_dev = pack_split_flat(
         compat_j,
         rest["group_req"],
         rest["group_count"],
         cfg_alloc_j,
         cfg_pool_j,
         rest["pool_overhead"],
-        emask_j,
-        rest["eused"],
+        bound["bound_compat"],
+        bound["bound_alloc"],
+        bound["bound_used0"],
+        bound["bound_slot"],
+        bound["bound_live"],
         cfg_price_j,
-        max_nodes=max_nodes,
+        max_free=F,
         mode=mode,
-        quota=quota_full,
+        bound_quota=bound_quota_j,
         cfg_rsv=cfg_rsv,
         rsv_cap=rsv_cap,
         group_cap=group_cap_full,
@@ -747,29 +1063,33 @@ def _run_pack(
     # only host arrays in the closure so the fetch can rebuild what the
     # compact buffer leaves out
     W = Cp // 32
-    emask_any = emask.any(axis=1) if Ep else np.zeros((0,), bool)
     group_req_h = enc.group_req.astype(np.float32)
     pool_overhead_h = enc.pool_overhead
     cfg_pool_h = cfg_pool  # host copy, padded
-
-    # every call path guarantees the node axis holds the existing
-    # slots (the explicit-max_nodes path clamps to reserved_p; the
-    # auto-sized path starts there) — the kernel's .at[:Ep] writes
-    # would fail to trace otherwise
-    assert N >= Ep, (N, Ep)
+    eused = bound_used_h
 
     def fetch() -> PackResult:
         flat = np.asarray(flat_dev)  # the one device->host fetch
         o0 = N * Gp
-        o1 = o0 + N * W
+        o1 = o0 + F * W
         assign = flat[:o0].reshape(N, Gp)[:, :G].astype(np.int32)
-        words = np.ascontiguousarray(flat[o0:o1].reshape(N, W))
-        bits = np.unpackbits(
-            words.view(np.uint8).reshape(N, W * 4), axis=1, bitorder="little"
+        node_mask = np.zeros((N, C), bool)
+        if Ep:
+            # bound rows: one-hot reconstruction from the host-side
+            # config vector (the kernel never tightens a one-hot row)
+            rows = np.flatnonzero(bound_live_h)
+            node_mask[rows, bound_cfg[rows]] = True
+        if F:
+            words = np.ascontiguousarray(flat[o0:o1].reshape(F, W))
+            bits = np.unpackbits(
+                words.view(np.uint8).reshape(F, W * 4), axis=1,
+                bitorder="little",
+            )
+            node_mask[Ep:] = bits[:, :C].astype(bool)
+        node_count = int(flat[o0 + F * W])
+        unsched = flat[o0 + F * W + 1 : o0 + F * W + 1 + Gp][:G].astype(
+            np.int32
         )
-        node_mask = bits[:, :C].astype(bool)
-        node_count = int(flat[o1])
-        unsched = flat[o1 + 1 : o1 + 1 + Gp][:G].astype(np.int32)
         # node_active / node_used are pure functions of the shipped
         # state: active = holds pods or is a live existing slot;
         # used = base (existing usage / fresh pool overhead) + the
@@ -781,7 +1101,7 @@ def _run_pack(
         # could let _downsize_masks resize a node below its true fill.
         node_active = assign.sum(axis=1) > 0
         if Ep:
-            node_active[:Ep] |= emask_any
+            node_active[:Ep] |= bound_live_h
         base = np.zeros((N, R), np.float64)
         if Ep:
             base[:Ep] = eused

@@ -273,7 +273,7 @@ def _decode_device(
     plan = None
     cost_tuple = None
     if floor is not None:
-        plan = lp_plan.plan(enc)
+        plan = _plan_for(fp, enc)
         if plan is not None:
             cost_result = _solve_packing(
                 enc, mode="cost", plan=plan, shards=shards
@@ -301,7 +301,7 @@ def _decode_device(
 
     ffd_pending = _solve_packing_async(enc, mode="ffd", shards=shards)
     if plan is None:
-        plan = lp_plan.plan(enc)
+        plan = _plan_for(fp, enc)
     cost_pending = (
         _solve_packing_async(enc, mode="cost", plan=plan, shards=shards)
         if plan is not None and cost_tuple is None
@@ -340,6 +340,29 @@ def _decode_device(
 # skip reproduces min()'s exact tiebreaks. Bounded dict (oldest
 # evicted at 32 entries).
 _ffd_floor: dict[bytes, tuple[int, float, int]] = {}
+
+# column-generation plan per problem fingerprint: the plan is a pure
+# function of the encoded problem (deterministic pricing rounds), so a
+# repeated solve reuses it instead of re-running ~150ms of host LP.
+# The fingerprint covers every array the LP reads (demand, prices,
+# allocs, compat, reservations), and consumers never mutate a
+# FleetPlan, so a hit is exactly the plan a fresh run would build.
+_plan_cache: dict[bytes, object] = {}
+
+
+def _plan_for(fp: bytes, enc: Encoded):
+    from karpenter_tpu.solver import lp_plan
+
+    if fp in _plan_cache:
+        return _plan_cache[fp]
+    plan = lp_plan.plan(enc)
+    # small cap: a FleetPlan carries planned_quota [Np, G] (MBs at 50k
+    # pods), so unlike _ffd_floor's 3-tuples this cache trades real RAM
+    # for the ~150ms LP — keep only the working set
+    if len(_plan_cache) >= 4:
+        _plan_cache.pop(next(iter(_plan_cache)))
+    _plan_cache[fp] = plan
+    return plan
 
 
 def _race_fingerprint(enc: Encoded) -> bytes:

@@ -1,0 +1,220 @@
+"""Split-kernel equivalence: pack_split must reproduce the dense
+kernel (`pack`) bit-for-bit.
+
+The split kernel moves one-hot rows (existing + LP-planned nodes) out
+of the [N, C] mask state into a per-row vector block; the dense kernel
+stays as the oracle. Any divergence in assignment, masks, node count,
+or unschedulable tallies on randomized problems is a correctness bug,
+not a tolerance issue — every kernel choice is an index-tie-broken
+arg-reduction, so results are exactly reproducible.
+"""
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.cloudprovider.fake import GIB, instance_types, make_instance_type
+from karpenter_tpu.solver.encode import encode, group_pods
+from karpenter_tpu.solver.pack import (
+    _pad_axis,
+    pack,
+    pack_split,
+)
+from karpenter_tpu.testing import mk_nodepool, mk_pod
+
+import jax.numpy as jnp
+
+
+def _run_both(enc, existing_mask, existing_used, max_nodes, mode,
+              quota=None):
+    """Run dense and split kernels on identical padded inputs and
+    compare every output."""
+    G, C = enc.compat.shape
+    R = enc.group_req.shape[1]
+    E = existing_mask.shape[0]
+    Gp, Cp = _pad_axis(G), _pad_axis(C)
+    Cp = -(-Cp // 32) * 32
+    Ep = _pad_axis(E) if E else 0
+    N = max_nodes
+
+    compat = np.zeros((Gp, Cp), bool)
+    compat[:G, :C] = enc.compat
+    group_req = np.zeros((Gp, R), np.float32)
+    group_req[:G] = enc.group_req
+    group_count = np.zeros((Gp,), np.int32)
+    group_count[:G] = enc.group_count
+    cfg_alloc = np.zeros((Cp, R), np.float32)
+    cfg_alloc[:C] = enc.cfg_alloc
+    cfg_pool = np.full((Cp,), -1, np.int32)
+    cfg_pool[:C] = enc.cfg_pool
+    cfg_price = np.zeros((Cp,), np.float32)
+    cfg_price[:C] = enc.cfg_price
+    emask = np.zeros((Ep, Cp), bool)
+    eused = np.zeros((Ep, R), np.float32)
+    if E:
+        emask[:E, :C] = existing_mask
+        eused[:E] = existing_used
+
+    cfg_rsv = None
+    rsv_cap = None
+    K = 0
+    if enc.rsv_cap is not None and enc.rsv_cap.size:
+        K = int(enc.rsv_cap.size)
+        rsvp = np.full((Cp,), -1, np.int32)
+        rsvp[:C] = enc.cfg_rsv
+        cfg_rsv = jnp.asarray(rsvp)
+        rsv_cap = jnp.asarray(enc.rsv_cap.astype(np.float32))
+        cfg_rsv_h = rsvp
+    else:
+        cfg_rsv_h = np.full((Cp,), -1, np.int32)
+
+    quota_full = None
+    bound_quota = None
+    if quota is not None:
+        quota_full = np.full((N, Gp), np.int16(32767), np.int16)
+        quota_full[: quota.shape[0], :G] = np.minimum(
+            quota[:, :G], 32767
+        ).astype(np.int16)
+        bound_quota = np.full((Ep, Gp), np.int16(32767), np.int16)
+        bound_quota[: quota.shape[0], :G] = np.minimum(
+            quota[:, :G], 32767
+        ).astype(np.int16)
+        quota_full = jnp.asarray(quota_full)
+        bound_quota = jnp.asarray(bound_quota)
+
+    dense = pack(
+        jnp.asarray(compat), jnp.asarray(group_req), jnp.asarray(group_count),
+        jnp.asarray(cfg_alloc), jnp.asarray(cfg_pool),
+        jnp.asarray(enc.pool_overhead), jnp.asarray(emask),
+        jnp.asarray(eused), jnp.asarray(cfg_price),
+        max_nodes=N, mode=mode, quota=quota_full,
+        cfg_rsv=cfg_rsv, rsv_cap=rsv_cap,
+    )
+    d_assign, d_mask, _, d_active, d_count, d_unsched = [
+        np.asarray(x) for x in dense
+    ]
+
+    bound_cfg = np.full((Ep,), -1, np.int32)
+    if E:
+        bound_cfg[:E] = np.where(
+            existing_mask.any(axis=1), existing_mask.argmax(axis=1), -1
+        )
+    bound_live = bound_cfg >= 0
+    safe_cfg = np.maximum(bound_cfg, 0)
+    bound_alloc = np.where(bound_live[:, None], cfg_alloc[safe_cfg], 0.0)
+    bound_compat = compat[:, safe_cfg] & bound_live[None, :] if Ep else np.zeros((Gp, 0), bool)
+    bound_slot = np.where(
+        bound_live & (cfg_rsv_h[safe_cfg] >= 0), cfg_rsv_h[safe_cfg], K
+    ).astype(np.int32)
+
+    split = pack_split(
+        jnp.asarray(compat), jnp.asarray(group_req), jnp.asarray(group_count),
+        jnp.asarray(cfg_alloc), jnp.asarray(cfg_pool),
+        jnp.asarray(enc.pool_overhead),
+        jnp.asarray(bound_compat), jnp.asarray(bound_alloc.astype(np.float32)),
+        jnp.asarray(eused), jnp.asarray(bound_slot), jnp.asarray(bound_live),
+        jnp.asarray(cfg_price),
+        max_free=N - Ep, mode=mode, bound_quota=bound_quota,
+        cfg_rsv=cfg_rsv, rsv_cap=rsv_cap,
+    )
+    s_assign, s_free_mask, s_count, s_unsched = [np.asarray(x) for x in split]
+
+    np.testing.assert_array_equal(d_assign, s_assign)
+    assert d_count == s_count
+    np.testing.assert_array_equal(d_unsched, s_unsched)
+    # dense mask rows [Ep:] must equal split free rows; bound rows stay
+    # one-hot in the dense kernel (never tightened)
+    np.testing.assert_array_equal(d_mask[Ep:], s_free_mask)
+    if Ep:
+        for b in range(Ep):
+            expected = np.zeros((Cp,), bool)
+            if bound_live[b]:
+                expected[bound_cfg[b]] = True
+            np.testing.assert_array_equal(d_mask[b], expected)
+
+
+def _random_problem(seed, n_pods=300, n_types=20, reservations=False):
+    rng = np.random.default_rng(seed)
+    if reservations:
+        types = []
+        for i in range(n_types):
+            cpu = float(rng.choice([2, 4, 8, 16]))
+            rsv = (
+                [(f"rsv-{i}", "test-zone-1", int(rng.integers(1, 4)))]
+                if rng.random() < 0.3
+                else None
+            )
+            types.append(
+                make_instance_type(
+                    f"t-{i}", cpu=cpu, memory=cpu * 4 * GIB,
+                    price=cpu * float(rng.uniform(0.8, 1.2)),
+                    reservations=rsv,
+                )
+            )
+    else:
+        types = instance_types(n_types)
+    pool = mk_nodepool("default")
+    pods = []
+    for i in range(n_pods):
+        cpu = float(rng.choice([0.25, 0.5, 1.0, 2.0, 4.0]))
+        mem = float(rng.choice([0.5, 1.0, 2.0, 8.0])) * GIB
+        sel = {}
+        if rng.random() < 0.3:
+            sel["kubernetes.io/arch"] = "amd64"
+        pods.append(mk_pod(name=f"p-{i}", cpu=cpu, memory=mem,
+                           node_selector=sel))
+    enc = encode(group_pods(pods), [(pool, types)], [])
+    return enc
+
+
+class TestSplitEquivalence:
+    @pytest.mark.parametrize("seed", [0, 1, 2, 3])
+    @pytest.mark.parametrize("mode", ["ffd", "cost"])
+    def test_fresh_only(self, seed, mode):
+        enc = _random_problem(seed)
+        existing_mask = np.zeros((0, enc.compat.shape[1]), bool)
+        existing_used = np.zeros((0, enc.group_req.shape[1]), np.float32)
+        _run_both(enc, existing_mask, existing_used, 256, mode)
+
+    @pytest.mark.parametrize("seed", [5, 6])
+    @pytest.mark.parametrize("mode", ["ffd", "cost"])
+    def test_with_reservations(self, seed, mode):
+        enc = _random_problem(seed, reservations=True)
+        existing_mask = np.zeros((0, enc.compat.shape[1]), bool)
+        existing_used = np.zeros((0, enc.group_req.shape[1]), np.float32)
+        _run_both(enc, existing_mask, existing_used, 256, mode)
+
+    @pytest.mark.parametrize("seed", [7, 8])
+    def test_with_existing_rows(self, seed):
+        enc = _random_problem(seed)
+        C = enc.compat.shape[1]
+        R = enc.group_req.shape[1]
+        rng = np.random.default_rng(seed + 100)
+        E = 6
+        existing_mask = np.zeros((E, C), bool)
+        existing_used = np.zeros((E, R), np.float32)
+        launchable = np.flatnonzero(enc.cfg_pool >= 0)
+        for e in range(E):
+            c = int(rng.choice(launchable))
+            existing_mask[e, c] = True
+            existing_used[e] = enc.cfg_alloc[c] * float(rng.uniform(0, 0.5))
+        _run_both(enc, existing_mask, existing_used, 256, "ffd")
+
+    def test_planned_quota_rows(self):
+        """Planned slots with per-group quotas (the LP path shape)."""
+        enc = _random_problem(11)
+        C = enc.compat.shape[1]
+        R = enc.group_req.shape[1]
+        G = enc.compat.shape[0]
+        rng = np.random.default_rng(42)
+        P = 8
+        existing_mask = np.zeros((P, C), bool)
+        existing_used = np.zeros((P, R), np.float32)
+        launchable = np.flatnonzero(enc.cfg_pool >= 0)
+        quota = np.zeros((P, G), np.int32)
+        for p in range(P):
+            c = int(rng.choice(launchable))
+            existing_mask[p, c] = True
+            existing_used[p] = enc.pool_overhead[enc.cfg_pool[c]]
+            quota[p] = rng.integers(0, 5, size=G)
+        _run_both(enc, existing_mask, existing_used, 256, "cost",
+                  quota=quota)
