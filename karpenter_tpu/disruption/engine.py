@@ -47,6 +47,10 @@ from karpenter_tpu.apis.v1.nodepool import (
 )
 from karpenter_tpu.cloudprovider.types import CloudProvider
 from karpenter_tpu.kube.client import KubeClient
+from karpenter_tpu.metrics.store import (
+    DISRUPTION_EVALUATION_DURATION,
+    NODECLAIMS_DISRUPTED,
+)
 from karpenter_tpu.kube.objects import Pod
 from karpenter_tpu.provisioning.provisioner import Provisioner
 from karpenter_tpu.provisioning.scheduler import Scheduler, SchedulerResults
@@ -669,7 +673,12 @@ class DisruptionEngine:
             self.multi_node_consolidation,
             self.single_node_consolidation,
         ):
+            t0 = time.perf_counter()
             command = method(now)
+            DISRUPTION_EVALUATION_DURATION.observe(
+                time.perf_counter() - t0,
+                {"method": method.__name__},
+            )
             if command is not None:
                 self.queue.start_command(command, now)
                 return command
@@ -785,6 +794,10 @@ class OrchestrationQueue:
                     claim = candidate.state_node.node_claim
                     if claim is not None and claim.metadata.deletion_timestamp is None:
                         self.kube.delete(claim, now=now)
+                        NODECLAIMS_DISRUPTED.inc({
+                            "reason": command.reason,
+                            "nodepool": candidate.node_pool.metadata.name,
+                        })
             elif state == "failed" or now - command.started_at > COMMAND_TIMEOUT_SECONDS:
                 log.warning("disruption command %s rolled back (%s)", command.reason,
                             state)

@@ -34,6 +34,7 @@ from karpenter_tpu.cloudprovider.types import (
     NodeClassNotReadyError,
 )
 from karpenter_tpu.kube.client import KubeClient
+from karpenter_tpu.metrics.store import NODECLAIMS_TERMINATED
 from karpenter_tpu.kube.objects import Node
 from karpenter_tpu.scheduling.taints import is_ephemeral
 from karpenter_tpu.state.nodepoolhealth import HealthTracker
@@ -273,6 +274,9 @@ class NodeClaimLifecycle:
         else:
             claim.status_conditions.set_true(COND_INSTANCE_TERMINATING, now=now)
         self.kube.remove_finalizer(claim, TERMINATION_FINALIZER)
+        NODECLAIMS_TERMINATED.inc({
+            "nodepool": claim.metadata.labels.get(NODEPOOL_LABEL, "")
+        })
 
     # -- helpers ---------------------------------------------------------------
 

@@ -40,6 +40,10 @@ class Counter:
     def total(self) -> float:
         return sum(self._values.values())
 
+    def samples(self) -> list[tuple[LabelPairs, float]]:
+        with self._lock:
+            return list(self._values.items())
+
 
 class Gauge:
     def __init__(self, name: str, help: str = ""):
@@ -61,6 +65,10 @@ class Gauge:
 
     def series(self) -> dict[LabelPairs, float]:
         return dict(self._values)
+
+    def samples(self) -> list[tuple[LabelPairs, float]]:
+        with self._lock:
+            return list(self._values.items())
 
 
 class Histogram:
@@ -90,6 +98,15 @@ class Histogram:
 
     def sum(self, labels: Optional[dict[str, str]] = None) -> float:
         return self._sums.get(_labels(labels), 0.0)
+
+    def samples(self) -> list[tuple[LabelPairs, list[int], float, int]]:
+        """(labels, per-bucket counts, sum, total) per series."""
+        with self._lock:
+            return [
+                (key, list(counts), self._sums.get(key, 0.0),
+                 self._totals.get(key, 0))
+                for key, counts in self._counts.items()
+            ]
 
 
 class Registry:
@@ -122,6 +139,10 @@ class Registry:
             elif isinstance(metric, Histogram):
                 out[name] = {"type": "histogram"}
         return out
+
+    def collect(self) -> list[tuple[str, object]]:
+        """Stable-order (name, metric) pairs for exposition."""
+        return sorted(self._metrics.items())
 
 
 # The process-wide registry and the reference's core series

@@ -1,0 +1,111 @@
+"""Observability over HTTP: /metrics, /healthz, /readyz, /debug/profile.
+
+Counterpart of the ports the reference mounts on its manager
+(pkg/operator/operator.go:183-222: metrics server, healthz/readyz
+probes, pprof handlers behind --enable-profiling). One threaded stdlib
+server carries all routes — the split metrics/health ports of the
+reference collapse onto one listener per process here, with the port
+taken from Options.metrics_port (0 picks an ephemeral port, exposed as
+`.port` for tests).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+
+log = logging.getLogger("karpenter.operator.http")
+
+
+class ObservabilityServer:
+    """Serves Prometheus text metrics and health probes for an
+    operator. Probe callables return {"ok": bool, "checks": {...}};
+    not-ok maps to HTTP 503 the way controller-runtime's checkers do."""
+
+    def __init__(
+        self,
+        healthz: Callable[[], dict],
+        readyz: Callable[[], dict],
+        port: int = 8080,
+        host: str = "127.0.0.1",
+        profile_report: Optional[Callable[[], dict]] = None,
+    ):
+        self._healthz = healthz
+        self._readyz = readyz
+        self._profile_report = profile_report
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self) -> None:  # noqa: N802 (stdlib API)
+                try:
+                    outer._route(self)
+                except BrokenPipeError:  # client went away mid-write
+                    pass
+
+            def log_message(self, fmt: str, *args) -> None:
+                log.debug("http: " + fmt, *args)
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._server.daemon_threads = True
+        self.port = self._server.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="observability-http",
+            daemon=True,
+        )
+        self._thread.start()
+        log.info("observability server on :%d (/metrics /healthz /readyz)",
+                 self.port)
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    # -- routing -----------------------------------------------------------
+
+    def _route(self, handler: BaseHTTPRequestHandler) -> None:
+        path = handler.path.split("?", 1)[0]
+        if path == "/metrics":
+            from karpenter_tpu.metrics.exposition import render
+
+            body = render().encode()
+            handler.send_response(200)
+            handler.send_header(
+                "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+            )
+            handler.send_header("Content-Length", str(len(body)))
+            handler.end_headers()
+            handler.wfile.write(body)
+        elif path in ("/healthz", "/readyz"):
+            probe = self._healthz if path == "/healthz" else self._readyz
+            try:
+                result = probe()
+            except Exception as err:  # a probe must never crash the server
+                result = {"ok": False, "checks": {"error": str(err)}}
+            body = json.dumps(result).encode()
+            handler.send_response(200 if result.get("ok") else 503)
+            handler.send_header("Content-Type", "application/json")
+            handler.send_header("Content-Length", str(len(body)))
+            handler.end_headers()
+            handler.wfile.write(body)
+        elif path == "/debug/profile" and self._profile_report is not None:
+            body = json.dumps(self._profile_report()).encode()
+            handler.send_response(200)
+            handler.send_header("Content-Type", "application/json")
+            handler.send_header("Content-Length", str(len(body)))
+            handler.end_headers()
+            handler.wfile.write(body)
+        else:
+            handler.send_response(404)
+            handler.send_header("Content-Length", "0")
+            handler.end_headers()

@@ -308,10 +308,55 @@ class Operator:
             "checks": {"informers_synced": synced, "leader": leader},
         }
 
-    def run(self, stop_after: Optional[float] = None, tick_seconds: float = 1.0) -> None:
+    def serve_observability(self, port: Optional[int] = None):
+        """Mount /metrics (Prometheus text), /healthz, /readyz and —
+        with profiling enabled — /debug/profile on an HTTP port
+        (operator.go:183-222). Returns the running server; idempotent,
+        but an explicit `port` conflicting with the running server is
+        an error (a silent wrong-port server would scrape nothing)."""
+        from karpenter_tpu.operator.httpserv import ObservabilityServer
+
+        running = getattr(self, "_observability", None)
+        if running is not None:
+            if port is not None and port != 0 and port != running.port:
+                raise ValueError(
+                    f"observability server already on :{running.port}; "
+                    f"requested :{port}"
+                )
+            return running
+        self._observability = ObservabilityServer(
+            healthz=self.healthz,
+            readyz=self.readyz,
+            port=self.options.metrics_port if port is None else port,
+            host=self.options.metrics_bind_host,
+            profile_report=(
+                self.profiler.report if self.options.enable_profiling else None
+            ),
+        )
+        self._observability.start()
+        return self._observability
+
+    def stop_observability(self) -> None:
+        server = getattr(self, "_observability", None)
+        if server is not None:
+            server.stop()
+            self._observability = None
+
+    def run(self, stop_after: Optional[float] = None, tick_seconds: float = 1.0,
+            serve: bool = True, should_stop=None) -> None:
         """Wall-clock loop (operator.Start). `stop_after` bounds the
-        run for embedding in tests/sims."""
-        deadline = None if stop_after is None else time.time() + stop_after
-        while deadline is None or time.time() < deadline:
-            self.step()
-            time.sleep(tick_seconds)
+        run for embedding in tests/sims; `serve` mounts the
+        observability endpoints for the duration of the loop;
+        `should_stop` is polled each tick (signal handlers)."""
+        if serve:
+            self.serve_observability()
+        try:
+            deadline = None if stop_after is None else time.time() + stop_after
+            while deadline is None or time.time() < deadline:
+                if should_stop is not None and should_stop():
+                    break
+                self.step()
+                time.sleep(tick_seconds)
+        finally:
+            if serve:
+                self.stop_observability()

@@ -49,6 +49,10 @@ class Options:
     feature_gates: FeatureGates = field(default_factory=FeatureGates)
     metrics_port: int = 8080
     health_probe_port: int = 8081
+    # bind-all default so external scrapers / kubelet probes reach the
+    # endpoints in a pod (the reference's metrics server behavior);
+    # tests override to loopback or pass port=0
+    metrics_bind_host: str = "0.0.0.0"
     kube_client_qps: int = 200
     kube_client_burst: int = 300
     log_level: str = "info"

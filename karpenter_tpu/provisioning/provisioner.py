@@ -31,6 +31,7 @@ from karpenter_tpu.apis.v1.nodepool import NodePool, order_by_weight
 from karpenter_tpu.cloudprovider.types import CloudProvider, min_values_coverage
 from karpenter_tpu.provisioning import volume_topology
 from karpenter_tpu.kube.client import KubeClient
+from karpenter_tpu.metrics.store import NODECLAIMS_CREATED
 from karpenter_tpu.kube.objects import ObjectMeta, Pod
 from karpenter_tpu.provisioning.scheduler import Scheduler, SchedulerResults
 from karpenter_tpu.apis.v1.labels import is_restricted_label
@@ -235,6 +236,15 @@ class Provisioner:
             # (provisioner.go:448-453)
             self.cluster.update_node_claim(claim)
             created.append(claim)
+            # capacity type from the plan's resolved (cheapest) offering
+            # — the launch target; the claim's own label lands only at
+            # registration
+            NODECLAIMS_CREATED.inc({
+                "nodepool": plan.pool.metadata.name,
+                "capacity_type": (
+                    plan.offerings[0].capacity_type if plan.offerings else ""
+                ),
+            })
         # nominate existing nodes receiving pods (provisioner.go:399);
         # node_for_key also resolves claim-name keys so in-flight
         # nodes that just received assignments get their nomination
