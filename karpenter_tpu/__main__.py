@@ -58,6 +58,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="seconds a kwok instance takes to register as a Node")
     p.add_argument("--state-file", default="",
                    help="checkpoint path: load on boot, save on shutdown")
+    p.add_argument("--api-server", default=os.environ.get("KUBE_API_SERVER", ""),
+                   help="real API server URL; empty = in-memory store")
+    p.add_argument("--api-token-file",
+                   default=os.environ.get("KUBE_TOKEN_FILE", ""),
+                   help="bearer token file for --api-server")
+    p.add_argument("--api-ca-file", default=os.environ.get("KUBE_CA_FILE", ""),
+                   help="CA bundle for --api-server TLS")
     p.add_argument("--solver-endpoint",
                    default=os.environ.get("KARPENTER_SOLVER_ENDPOINT", ""),
                    help="gRPC solver service (TPU hosts); empty = in-process")
@@ -119,7 +126,21 @@ def main(argv=None) -> int:
         enable_profiling=args.enable_profiling,
     )
 
-    if args.state_file and os.path.exists(args.state_file):
+    if args.api_server:
+        # real cluster: the adapter speaks CRs over HTTP with
+        # resourceVersion conflict semantics (kube/real.py)
+        from karpenter_tpu.kube.real import HTTPTransport, RealKubeClient
+
+        token = ""
+        if args.api_token_file:
+            with open(args.api_token_file) as fh:
+                token = fh.read().strip()
+        kube = RealKubeClient(HTTPTransport(
+            args.api_server, token=token,
+            ca_file=args.api_ca_file or None,
+        ))
+        log.info("connected to API server %s", args.api_server)
+    elif args.state_file and os.path.exists(args.state_file):
         kube = KubeClient.load(args.state_file)
         log.info("state loaded from %s", args.state_file)
     else:
@@ -163,7 +184,7 @@ def main(argv=None) -> int:
             should_stop=lambda: stop["flag"],
         )
     finally:
-        if args.state_file:
+        if args.state_file and hasattr(kube, "save"):
             kube.save(args.state_file)
             log.info("state saved to %s", args.state_file)
     nodes = len(kube.nodes())

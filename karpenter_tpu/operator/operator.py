@@ -343,11 +343,13 @@ class Operator:
             self._observability = None
 
     def run(self, stop_after: Optional[float] = None, tick_seconds: float = 1.0,
-            serve: bool = True, should_stop=None) -> None:
+            serve: bool = False, should_stop=None) -> None:
         """Wall-clock loop (operator.Start). `stop_after` bounds the
-        run for embedding in tests/sims; `serve` mounts the
-        observability endpoints for the duration of the loop;
-        `should_stop` is polled each tick (signal handlers)."""
+        run for embedding in tests/sims; `serve=True` mounts the
+        observability endpoints for the duration of the loop (opt-in:
+        embedders must not grow a listening port as a side effect —
+        the binary serves explicitly); `should_stop` is polled each
+        tick (signal handlers)."""
         if serve:
             self.serve_observability()
         try:
