@@ -71,7 +71,17 @@ class OverlayStore:
     (store.go:47-260). Overlays sorted by weight descending; the
     heaviest matching overlay wins per attribute."""
 
-    def __init__(self, overlays: list[NodeOverlay]):
+    def __init__(self, overlays: list[NodeOverlay], snapshot: bool = True):
+        # snapshot the SPECS: a controller-owned store must be immutable
+        # under overlay churn (store.go's internal store is rebuilt,
+        # never mutated) — holding live references would leak spec edits
+        # into an already-taken snapshot between controller passes. The
+        # lazy read-through path (no controller) builds a throwaway
+        # store per call and skips the copy.
+        if snapshot:
+            import copy
+
+            overlays = [copy.deepcopy(o) for o in overlays]
         self.overlays = sorted(
             overlays, key=lambda o: (-o.spec.weight, o.metadata.name)
         )
@@ -132,109 +142,324 @@ class OverlayStore:
         )
 
 
-def detect_conflicts(overlays: list[NodeOverlay]) -> dict[str, str]:
-    """Equal-weight overlays that can select the same instances AND
-    write the same attribute with different values conflict; the
-    lexicographically-later one is flagged (nodeoverlay/controller.go
-    conflict detection by weight)."""
-    conflicts: dict[str, str] = {}
-    by_weight: dict[int, list[NodeOverlay]] = {}
-    for o in overlays:
-        by_weight.setdefault(o.spec.weight, []).append(o)
-    for weight, group in by_weight.items():
-        group = sorted(group, key=lambda o: o.metadata.name)
-        reqs = {
-            o.metadata.name: Requirements.from_node_selector_requirements(
-                o.spec.requirements
+# well-known resources an overlay may NOT override — capacity injection
+# is for EXTENDED resources only (nodeoverlay_validation.go:50-57)
+WELL_KNOWN_RESOURCES = frozenset(
+    ("cpu", "memory", "pods", "ephemeral-storage", "hugepages-2Mi",
+     "hugepages-1Gi")
+)
+_VALID_OPERATORS = frozenset(
+    ("In", "NotIn", "Exists", "DoesNotExist", "Gt", "Lt")
+)
+
+
+def runtime_validate(overlay: NodeOverlay) -> Optional[str]:
+    """RuntimeValidate (nodeoverlay_validation.go:31-57): the rules a
+    webhook would enforce beyond CRD schema — requirement operator
+    sanity, capacity restricted to extended resources, parseable price
+    fields. Returns a reason string, or None when valid."""
+    spec = overlay.spec
+    for req in spec.requirements:
+        if req.operator not in _VALID_OPERATORS:
+            return f"invalid operator {req.operator!r} for key {req.key}"
+        if req.operator in ("In", "NotIn") and not req.values:
+            return (
+                f"key {req.key} with operator {req.operator} must have a "
+                f"value defined"
             )
-            for o in group
-        }
-        for i, a in enumerate(group):
-            for b in group[i + 1 :]:
-                # disjoint selectors can never target the same instance
-                if reqs[a.metadata.name].intersects(reqs[b.metadata.name]) is not None:
-                    continue
-                a_price = a.spec.price is not None or a.spec.price_adjustment is not None
-                b_price = b.spec.price is not None or b.spec.price_adjustment is not None
-                price_conflict = (
-                    a_price and b_price
-                    and (a.spec.price, a.spec.price_adjustment)
-                    != (b.spec.price, b.spec.price_adjustment)
-                )
-                capacity_conflict = any(
-                    a.spec.capacity[k] != b.spec.capacity[k]
-                    for k in set(a.spec.capacity) & set(b.spec.capacity)
-                )
-                if price_conflict or capacity_conflict:
-                    conflicts[b.metadata.name] = (
-                        f"conflicts with {a.metadata.name} at weight {weight}"
-                    )
+    for resource in spec.capacity:
+        if resource in WELL_KNOWN_RESOURCES:
+            return f"invalid capacity: {resource} is restricted"
+    if spec.price is not None and spec.price_adjustment is not None:
+        return "price and priceAdjustment are mutually exclusive"
+    if spec.price is not None:
+        try:
+            if float(spec.price) < 0:
+                return f"price {spec.price!r} must be non-negative"
+        except ValueError:
+            return f"price {spec.price!r} is not a number"
+    if spec.price_adjustment is not None:
+        raw = spec.price_adjustment
+        body = raw[:-1] if raw.endswith("%") else raw
+        try:
+            float(body)
+        except ValueError:
+            return f"priceAdjustment {raw!r} is malformed"
+    return None
+
+
+def detect_conflicts(
+    overlays: list[NodeOverlay],
+    instance_types_by_pool: dict[Optional[str], list[InstanceType]],
+) -> dict[str, str]:
+    """Conflicts against ACTUAL instance types, the reference's
+    semantics (store.go:185-258 + controller.go:144-160): walk overlays
+    in descending weight (name-ascending on ties), record which overlay
+    last wrote each (pool, instance, offering) price cell and each
+    (pool, instance, capacity-resource) cell, and flag an overlay that
+    writes a cell already written AT THE SAME WEIGHT by a different
+    overlay — regardless of the value; equal-weight double-writes are
+    ambiguous by definition. A flagged overlay is excluded from the
+    store ENTIRELY (atomicity: validate-then-store,
+    controller.go:152-159). Selector algebra alone would flag overlays
+    whose selectors intersect but never co-match a real offering; the
+    concrete evaluation does not."""
+    ordered = sorted(
+        overlays, key=lambda o: (-o.spec.weight, o.metadata.name)
+    )
+    reqs = {
+        o.metadata.name: Requirements.from_node_selector_requirements(
+            o.spec.requirements
+        )
+        for o in ordered
+    }
+    conflicts: dict[str, str] = {}
+    # cell -> (weight, overlay name) of the last writer
+    price_writer: dict[tuple, tuple[int, str]] = {}
+    capacity_writer: dict[tuple, tuple[int, str]] = {}
+    for overlay in ordered:
+        name = overlay.metadata.name
+        writes_price = (
+            overlay.spec.price is not None
+            or overlay.spec.price_adjustment is not None
+        )
+        clash: Optional[str] = None
+        touched_price: list[tuple] = []
+        touched_capacity: list[tuple] = []
+        for pool_name, its in instance_types_by_pool.items():
+            for it in its:
+                combined_base = it.requirements
+                for offering in it.offerings:
+                    combined = combined_base.copy()
+                    combined.add(*offering.requirements.values())
+                    if combined.intersects(reqs[name]) is not None:
+                        continue
+                    if writes_price:
+                        cell = (pool_name, it.name,
+                                offering.zone, offering.capacity_type,
+                                offering.reservation_id)
+                        prior = price_writer.get(cell)
+                        if (
+                            prior is not None
+                            and prior[0] == overlay.spec.weight
+                            and prior[1] != name
+                        ):
+                            clash = (
+                                f"price conflicts with {prior[1]} at weight "
+                                f"{overlay.spec.weight} on {it.name}"
+                            )
+                            break
+                        touched_price.append(cell)
+                    for resource in overlay.spec.capacity:
+                        cell = (pool_name, it.name, resource)
+                        prior = capacity_writer.get(cell)
+                        if (
+                            prior is not None
+                            and prior[0] == overlay.spec.weight
+                            and prior[1] != name
+                        ):
+                            clash = (
+                                f"capacity {resource} conflicts with "
+                                f"{prior[1]} at weight {overlay.spec.weight} "
+                                f"on {it.name}"
+                            )
+                            break
+                        touched_capacity.append(cell)
+                    if clash:
+                        break
+                if clash:
+                    break
+            if clash:
+                break
+        if clash:
+            conflicts[name] = clash
+            continue  # atomic: none of its writes land
+        # record this overlay as the LATEST writer of its cells: the
+        # heaviest writer owns the value (apply() honors that), while
+        # clash checks above compare against the most recent — i.e.
+        # lowest-so-far — weight, exactly the reference's lowestWeight
+        # tracking (store.go:198-205, 232-246)
+        for cell in touched_price:
+            price_writer[cell] = (overlay.spec.weight, name)
+        for cell in touched_capacity:
+            capacity_writer[cell] = (overlay.spec.weight, name)
     return conflicts
 
 
 class UnevaluatedNodePoolError(Exception):
-    """GetInstanceTypes called before the overlay controller produced
-    its first store snapshot (nodeoverlay/controller.go:69-140) — the
-    provisioner skips the pool until evaluation completes."""
+    """GetInstanceTypes called for a pool the overlay controller has
+    not evaluated yet (store.go:64-67, 121-124) — new pools stay gated
+    until the next controller pass; the provisioner skips them."""
 
 
 class NodeOverlayController:
-    """Singleton revalidation loop: builds immutable store snapshots
-    from the live overlays, flags conflicts via status conditions, and
-    hands the snapshot to the decorator (controller.go:69-140)."""
+    """Singleton revalidation loop (controller.go:69-160): runtime-
+    validates every overlay, detects conflicts against each pool's
+    ACTUAL instance types, publishes results to overlay status
+    conditions and Warning events, then atomically swaps an immutable
+    snapshot (valid overlays + the evaluated-pool set) into the
+    decorator and marks the cluster unconsolidated so consolidation
+    re-evaluates against the new prices."""
 
-    def __init__(self, kube, provider: "OverlayCloudProvider"):
+    # full re-evaluation cadence with an unchanged input set — catches
+    # provider catalog drift the object watch can't see (the reference
+    # requeues on a long timer, controller.go:120 RequeueAfter)
+    REEVALUATE_SECONDS = 6 * 3600.0
+
+    def __init__(self, kube, provider: "OverlayCloudProvider",
+                 recorder=None, cluster=None):
         self.kube = kube
         self.provider = provider
+        self.recorder = recorder
+        self.cluster = cluster
+        self._fingerprint: Optional[tuple] = None
+        self._evaluated_at = 0.0
         provider.gated = True  # serve only controller snapshots
 
+    def _publish(self, overlay: NodeOverlay, reason: str, message: str,
+                 now: Optional[float]) -> None:
+        changed = overlay.status_conditions.set_false(
+            COND_OVERLAY_VALIDATION, reason=reason, message=message, now=now
+        )
+        if changed:
+            # announce the transition (and push it to a real API server)
+            self.kube.touch(overlay)
+        if self.recorder is not None:
+            from karpenter_tpu.events.recorder import Event
+
+            self.recorder.publish(Event(
+                kind="NodeOverlay", name=overlay.metadata.name,
+                type="Warning", reason=reason, message=message,
+            ), now=now)
+
     def reconcile(self, now: Optional[float] = None) -> None:
+        import time as _time
+
         overlays = list(self.kube.list("NodeOverlay"))
-        conflicts = detect_conflicts(overlays)
-        valid = []
+        # deleting pools stay evaluated: their nodes serve (and may be
+        # disrupted/priced) until they are actually gone — permanent
+        # gating would wedge disruption's price lookups for them
+        pools = list(self.kube.list("NodePool"))
+        # change detection: re-evaluation is O(overlays x pools x
+        # catalog); skip it while the input objects are unchanged (the
+        # reference controller is watch-triggered), re-running on a
+        # long timer to catch provider catalog drift
+        fingerprint = (
+            tuple(sorted(
+                (o.metadata.name, o.metadata.resource_version)
+                for o in overlays
+            )),
+            tuple(sorted(
+                (p.metadata.name, p.metadata.resource_version)
+                for p in pools
+            )),
+        )
+        wall = _time.monotonic()
+        if (
+            fingerprint == self._fingerprint
+            and wall - self._evaluated_at < self.REEVALUATE_SECONDS
+        ):
+            return
+        # conflict evaluation runs against the RAW catalog (the inner
+        # provider) per pool — reserved offerings are injected per pool,
+        # so an overlay targeting them must be validated per pool
+        # (controller.go:144-150). A pool whose catalog fetch FAILS is
+        # neither conflict-checked nor marked evaluated: degrading to
+        # "no conflicts" would open the gate on an unchecked snapshot.
+        inner = self.provider.inner
+        its_by_pool: dict[Optional[str], list[InstanceType]] = {}
+        fetch_failed: set[str] = set()
+        for pool in pools:
+            try:
+                its_by_pool[pool.metadata.name] = inner.get_instance_types(pool)
+            except Exception:
+                fetch_failed.add(pool.metadata.name)
+        if not pools:
+            # poolless (direct/simulation) use still needs a catalog to
+            # validate against
+            try:
+                its_by_pool[None] = inner.get_instance_types(None)
+            except Exception:
+                return  # no catalog at all: keep the previous snapshot
+
+        valid: list[NodeOverlay] = []
+        evaluatable: list[NodeOverlay] = []
         for overlay in overlays:
-            reason = conflicts.get(overlay.metadata.name)
-            if reason:
-                overlay.status_conditions.set_false(
-                    COND_OVERLAY_VALIDATION, reason="Conflict", message=reason,
-                    now=now,
-                )
+            reason = runtime_validate(overlay)
+            if reason is not None:
+                self._publish(overlay, "ValidationFailed", reason, now)
             else:
-                overlay.status_conditions.set_true(
+                evaluatable.append(overlay)
+        conflicts = detect_conflicts(evaluatable, its_by_pool)
+        for overlay in evaluatable:
+            message = conflicts.get(overlay.metadata.name)
+            if message:
+                self._publish(overlay, "Conflict", message, now)
+            else:
+                if overlay.status_conditions.set_true(
                     COND_OVERLAY_VALIDATION, now=now
-                )
+                ):
+                    self.kube.touch(overlay)
                 valid.append(overlay)
-        self.provider.set_store(OverlayStore(valid))
+        self.provider.set_store(
+            OverlayStore(valid),
+            evaluated_pools={
+                p.metadata.name for p in pools
+                if p.metadata.name not in fetch_failed
+            },
+        )
+        self._fingerprint = fingerprint
+        self._evaluated_at = wall
+        if self.cluster is not None:
+            # prices moved: force consolidation to re-evaluate
+            # (controller.go:119 MarkUnconsolidated) — only on a real
+            # snapshot swap, never on the per-tick no-op path above
+            self.cluster.mark_unconsolidated(now=now)
 
 
 class OverlayCloudProvider(CloudProvider):
     """Decorator applying the overlay store to GetInstanceTypes
     (overlay/cloudprovider.go:30-60). Serves the controller's snapshot;
-    before the first evaluation, pools are gated behind
-    UnevaluatedNodePoolError."""
+    before the first evaluation — and per pool, for pools created AFTER
+    the snapshot was built — requests are gated behind
+    UnevaluatedNodePoolError (store.go:64-67)."""
 
     def __init__(self, inner: CloudProvider, kube):
         self.inner = inner
         self.kube = kube
         self._snapshot: Optional[OverlayStore] = None
+        self._evaluated_pools: set[str] = set()
         # set by NodeOverlayController: once a controller owns this
         # decorator, only its snapshots are served (the reference's
         # UnevaluatedNodePoolError gate); standalone use builds lazily
         self.gated = False
 
-    def set_store(self, store: OverlayStore) -> None:
+    def set_store(self, store: OverlayStore,
+                  evaluated_pools: Optional[set[str]] = None) -> None:
+        self._evaluated_pools = set(evaluated_pools or ())
         self._snapshot = store
 
-    def _store(self) -> OverlayStore:
+    def _store(self, node_pool: Optional[NodePool]) -> OverlayStore:
         if self._snapshot is not None:
+            if (
+                self.gated
+                and node_pool is not None
+                and node_pool.metadata.name not in self._evaluated_pools
+            ):
+                # a pool created after the snapshot: its (possibly
+                # reserved) offerings were never conflict-checked —
+                # gate it until the next controller pass
+                raise UnevaluatedNodePoolError(
+                    f"node pool {node_pool.metadata.name} not yet evaluated"
+                )
             return self._snapshot
         if self.gated:
             raise UnevaluatedNodePoolError("node overlays not yet evaluated")
         # standalone (no controller): read-through, no caching
-        return OverlayStore(list(self.kube.list("NodeOverlay")))
+        return OverlayStore(list(self.kube.list("NodeOverlay")), snapshot=False)
 
     def get_instance_types(self, node_pool: Optional[NodePool]) -> list[InstanceType]:
-        store = self._store()
+        store = self._store(node_pool)
         return [store.apply(it) for it in self.inner.get_instance_types(node_pool)]
 
     # passthrough SPI

@@ -367,9 +367,17 @@ class RealKubeClient:
         return FROM_CR[kind](item)
 
     def sync(self) -> None:
-        """Initial LIST per kind (informer start)."""
-        for kind in self.kinds:
+        """Initial LIST per kind (informer start). A 404 means the
+        kind's CRD is not installed (e.g. the alpha NodeOverlay CRD
+        behind a disabled feature gate): drop the kind and keep booting
+        — steady-state _pump tolerates the same absence. Any other
+        error is a real connectivity problem and fails fast."""
+        for kind in list(self.kinds):
             status, body = self.transport.request("GET", _path(kind))
+            if status == 404:
+                self.kinds.remove(kind)
+                self._mirror.pop(kind, None)
+                continue
             if status != 200:
                 raise ApiError(status, str(body))
             for item in body.get("items", []):

@@ -90,6 +90,11 @@ class Operator:
         attach_informers(self.kube, self.cluster)
         self.recorder = EventRecorder()
         self.health = HealthTracker()
+        if self.overlay_controller is not None:
+            # conflict events + consolidation invalidation need the
+            # recorder/cluster built just above
+            self.overlay_controller.recorder = self.recorder
+            self.overlay_controller.cluster = self.cluster
 
         self.provisioner = Provisioner(
             self.kube, self.cluster, provider, options=self.options

@@ -1,0 +1,291 @@
+"""NodeOverlay lifecycle depth: per-pool evaluation gating, concrete
+conflict detection, runtime validation, status + event publication,
+and snapshot semantics under churn.
+
+Parity targets: nodeoverlay/store.go:47-260 (evaluatedNodePools gate,
+lowestWeight conflict cells, atomic validate-then-store),
+controller.go:69-160 (statuses, MarkUnconsolidated),
+nodeoverlay_validation.go:31-57 (RuntimeValidate).
+"""
+
+import pytest
+
+from karpenter_tpu.apis.v1alpha1.nodeoverlay import (
+    COND_OVERLAY_VALIDATION,
+    NodeOverlay,
+    NodeOverlayController,
+    NodeOverlaySpec,
+    OverlayCloudProvider,
+    UnevaluatedNodePoolError,
+    runtime_validate,
+)
+from karpenter_tpu.cloudprovider.fake import (
+    GIB,
+    FakeCloudProvider,
+    make_instance_type,
+)
+from karpenter_tpu.events.recorder import EventRecorder
+from karpenter_tpu.kube.client import KubeClient
+from karpenter_tpu.kube.objects import NodeSelectorRequirement, ObjectMeta
+from karpenter_tpu.testing import mk_nodepool
+
+
+def _types():
+    return [
+        make_instance_type("small", cpu=2, memory=8 * GIB, price=1.0),
+        make_instance_type("big", cpu=16, memory=64 * GIB, price=8.0,
+                           arch="arm64"),
+    ]
+
+
+def _env(*overlays, pools=("default",)):
+    kube = KubeClient()
+    for pool_name in pools:
+        kube.create(mk_nodepool(pool_name))
+    for i, overlay in enumerate(overlays):
+        if not overlay.metadata.name or overlay.metadata.name.startswith("pool-"):
+            overlay.metadata.name = f"ov-{i}"
+        kube.create(overlay)
+    provider = OverlayCloudProvider(FakeCloudProvider(_types()), kube)
+    recorder = EventRecorder()
+    controller = NodeOverlayController(kube, provider, recorder=recorder)
+    return kube, provider, controller, recorder
+
+
+class TestRuntimeValidation:
+    def test_notin_without_values_rejected(self):
+        overlay = NodeOverlay(spec=NodeOverlaySpec(requirements=[
+            NodeSelectorRequirement(key="kubernetes.io/arch",
+                                    operator="NotIn")
+        ]))
+        assert "must have a value" in runtime_validate(overlay)
+
+    def test_bad_operator_rejected(self):
+        overlay = NodeOverlay(spec=NodeOverlaySpec(requirements=[
+            NodeSelectorRequirement(key="k", operator="Matches",
+                                    values=("x",))
+        ]))
+        assert "invalid operator" in runtime_validate(overlay)
+
+    def test_well_known_capacity_rejected(self):
+        """Capacity injection is for extended resources only
+        (nodeoverlay_validation.go:50-57)."""
+        overlay = NodeOverlay(spec=NodeOverlaySpec(capacity={"cpu": 64.0}))
+        assert "restricted" in runtime_validate(overlay)
+
+    def test_price_and_adjustment_exclusive(self):
+        overlay = NodeOverlay(spec=NodeOverlaySpec(
+            price="2.0", price_adjustment="-10%"))
+        assert "mutually exclusive" in runtime_validate(overlay)
+
+    @pytest.mark.parametrize("value", ["abc", "--5", "5%%"])
+    def test_malformed_adjustment(self, value):
+        overlay = NodeOverlay(spec=NodeOverlaySpec(price_adjustment=value))
+        assert runtime_validate(overlay) is not None
+
+    def test_valid_overlay_passes(self):
+        overlay = NodeOverlay(spec=NodeOverlaySpec(
+            requirements=[NodeSelectorRequirement(
+                key="kubernetes.io/arch", operator="In", values=("amd64",))],
+            price_adjustment="-15%",
+            capacity={"example.com/gpu": 2.0},
+        ))
+        assert runtime_validate(overlay) is None
+
+    def test_invalid_overlay_gets_condition_and_event(self):
+        bad = NodeOverlay(metadata=ObjectMeta(name="bad"),
+                          spec=NodeOverlaySpec(capacity={"memory": 1.0}))
+        kube, provider, controller, recorder = _env(bad)
+        controller.reconcile(now=100.0)
+        cond = bad.status_conditions.get(COND_OVERLAY_VALIDATION)
+        assert cond.status == "False" and cond.reason == "ValidationFailed"
+        events = [r.event for r in recorder.events]
+        assert any(
+            e.kind == "NodeOverlay" and e.name == "bad"
+            and e.type == "Warning" and e.reason == "ValidationFailed"
+            for e in events
+        )
+        # invalid overlay is not applied
+        for it in provider.get_instance_types(kube.get_node_pool("default")):
+            assert it.capacity["memory"] == 8 * GIB or it.capacity["memory"] == 64 * GIB
+
+
+class TestPerPoolEvaluationGate:
+    def test_new_pool_gated_until_next_pass(self):
+        """A pool created AFTER the snapshot stays gated (its reserved
+        offerings were never conflict-checked) while evaluated pools
+        keep serving (store.go:64-67)."""
+        kube, provider, controller, _ = _env(
+            NodeOverlay(metadata=ObjectMeta(name="o"),
+                        spec=NodeOverlaySpec(price="0.5")),
+        )
+        controller.reconcile()
+        old_pool = kube.get_node_pool("default")
+        assert provider.get_instance_types(old_pool)  # evaluated: serves
+        late = mk_nodepool("late")
+        kube.create(late)
+        with pytest.raises(UnevaluatedNodePoolError):
+            provider.get_instance_types(late)
+        controller.reconcile()  # next pass evaluates it
+        out = provider.get_instance_types(late)
+        assert all(o.price == 0.5 for it in out for o in it.offerings)
+
+    def test_unpooled_requests_serve_after_first_snapshot(self):
+        kube, provider, controller, _ = _env()
+        with pytest.raises(UnevaluatedNodePoolError):
+            provider.get_instance_types(None)
+        controller.reconcile()
+        assert provider.get_instance_types(None)
+
+
+class TestConcreteConflicts:
+    def test_same_weight_same_offering_conflict_even_equal_values(self):
+        """The reference flags equal-weight double-writes of the same
+        offering regardless of value (store.go:240-258): ambiguity is
+        the problem, not the arithmetic."""
+        a = NodeOverlay(metadata=ObjectMeta(name="a"),
+                        spec=NodeOverlaySpec(weight=5, price="2.0"))
+        b = NodeOverlay(metadata=ObjectMeta(name="b"),
+                        spec=NodeOverlaySpec(weight=5, price="2.0"))
+        kube, provider, controller, recorder = _env(a, b)
+        controller.reconcile(now=10.0)
+        assert a.status_conditions.is_true(COND_OVERLAY_VALIDATION)
+        cond = b.status_conditions.get(COND_OVERLAY_VALIDATION)
+        assert cond.status == "False" and cond.reason == "Conflict"
+        assert any(
+            r.event.reason == "Conflict" and r.event.name == "b"
+            for r in recorder.events
+        )
+
+    def test_selectors_that_never_comatch_do_not_conflict(self):
+        """Selector-intersecting overlays whose selectors never match
+        the same REAL offering are not conflicts — the concrete
+        evaluation is more precise than selector algebra."""
+        # amd64-only and arm64-only: both price writers at one weight,
+        # but no instance carries both arches
+        a = NodeOverlay(metadata=ObjectMeta(name="a"), spec=NodeOverlaySpec(
+            weight=3, price="0.9",
+            requirements=[NodeSelectorRequirement(
+                key="kubernetes.io/arch", operator="In", values=("amd64",))],
+        ))
+        b = NodeOverlay(metadata=ObjectMeta(name="b"), spec=NodeOverlaySpec(
+            weight=3, price="0.8",
+            requirements=[NodeSelectorRequirement(
+                key="kubernetes.io/arch", operator="In", values=("arm64",))],
+        ))
+        kube, provider, controller, _ = _env(a, b)
+        controller.reconcile()
+        assert a.status_conditions.is_true(COND_OVERLAY_VALIDATION)
+        assert b.status_conditions.is_true(COND_OVERLAY_VALIDATION)
+        prices = {
+            it.name: {o.price for o in it.offerings}
+            for it in provider.get_instance_types(kube.get_node_pool("default"))
+        }
+        assert prices["small"] == {0.9}   # amd64
+        assert prices["big"] == {0.8}     # arm64
+
+    def test_different_weights_never_conflict(self):
+        a = NodeOverlay(metadata=ObjectMeta(name="a"),
+                        spec=NodeOverlaySpec(weight=9, price="2.0"))
+        b = NodeOverlay(metadata=ObjectMeta(name="b"),
+                        spec=NodeOverlaySpec(weight=1, price="5.0"))
+        kube, provider, controller, _ = _env(a, b)
+        controller.reconcile()
+        assert a.status_conditions.is_true(COND_OVERLAY_VALIDATION)
+        assert b.status_conditions.is_true(COND_OVERLAY_VALIDATION)
+        out = provider.get_instance_types(kube.get_node_pool("default"))
+        assert all(o.price == 2.0 for it in out for o in it.offerings)
+
+    def test_conflicting_overlay_excluded_atomically(self):
+        """A conflicted overlay contributes NOTHING — not even its
+        non-conflicting capacity writes (controller.go:152-159)."""
+        a = NodeOverlay(metadata=ObjectMeta(name="a"), spec=NodeOverlaySpec(
+            weight=5, price="2.0"))
+        b = NodeOverlay(metadata=ObjectMeta(name="b"), spec=NodeOverlaySpec(
+            weight=5, price="3.0", capacity={"example.com/gpu": 4.0}))
+        kube, provider, controller, _ = _env(a, b)
+        controller.reconcile()
+        assert b.status_conditions.is_false(COND_OVERLAY_VALIDATION)
+        for it in provider.get_instance_types(kube.get_node_pool("default")):
+            assert "example.com/gpu" not in it.capacity
+            assert all(o.price == 2.0 for o in it.offerings)
+
+    def test_same_weight_capacity_same_resource_conflicts(self):
+        a = NodeOverlay(metadata=ObjectMeta(name="a"), spec=NodeOverlaySpec(
+            weight=2, capacity={"example.com/gpu": 1.0}))
+        b = NodeOverlay(metadata=ObjectMeta(name="b"), spec=NodeOverlaySpec(
+            weight=2, capacity={"example.com/gpu": 2.0}))
+        kube, provider, controller, _ = _env(a, b)
+        controller.reconcile()
+        assert a.status_conditions.is_true(COND_OVERLAY_VALIDATION)
+        assert b.status_conditions.is_false(COND_OVERLAY_VALIDATION)
+
+    def test_same_weight_disjoint_capacity_keys_coexist(self):
+        a = NodeOverlay(metadata=ObjectMeta(name="a"), spec=NodeOverlaySpec(
+            weight=2, capacity={"example.com/a": 1.0}))
+        b = NodeOverlay(metadata=ObjectMeta(name="b"), spec=NodeOverlaySpec(
+            weight=2, capacity={"example.com/b": 2.0}))
+        kube, provider, controller, _ = _env(a, b)
+        controller.reconcile()
+        assert a.status_conditions.is_true(COND_OVERLAY_VALIDATION)
+        assert b.status_conditions.is_true(COND_OVERLAY_VALIDATION)
+
+
+class TestSnapshotChurn:
+    def test_snapshot_immutable_under_overlay_churn(self):
+        """Consumers of an already-taken snapshot keep seeing it; churn
+        lands only at the next reconcile (atomic swap, store.go:58-60)."""
+        overlay = NodeOverlay(metadata=ObjectMeta(name="o"),
+                              spec=NodeOverlaySpec(price="0.5"))
+        kube, provider, controller, _ = _env(overlay)
+        controller.reconcile()
+        pool = kube.get_node_pool("default")
+        assert all(
+            o.price == 0.5
+            for it in provider.get_instance_types(pool)
+            for o in it.offerings
+        )
+        # churn: price changes, a second overlay appears — snapshot
+        # unchanged until the controller runs again
+        overlay.spec.price = "0.25"
+        kube.create(NodeOverlay(metadata=ObjectMeta(name="extra"),
+                                spec=NodeOverlaySpec(weight=50, price="9.9")))
+        assert all(
+            o.price == 0.5
+            for it in provider.get_instance_types(pool)
+            for o in it.offerings
+        )
+        controller.reconcile()
+        assert all(
+            o.price == 9.9
+            for it in provider.get_instance_types(pool)
+            for o in it.offerings
+        )
+
+    def test_deleting_all_overlays_restores_base_prices(self):
+        overlay = NodeOverlay(metadata=ObjectMeta(name="o"),
+                              spec=NodeOverlaySpec(price="0.5"))
+        kube, provider, controller, _ = _env(overlay)
+        controller.reconcile()
+        kube.delete(overlay)
+        controller.reconcile()
+        pool = kube.get_node_pool("default")
+        prices = {
+            o.price
+            for it in provider.get_instance_types(pool)
+            for o in it.offerings
+        }
+        assert 0.5 not in prices
+
+    def test_reconcile_marks_cluster_unconsolidated(self):
+        from karpenter_tpu.state.cluster import Cluster, attach_informers
+
+        overlay = NodeOverlay(metadata=ObjectMeta(name="o"),
+                              spec=NodeOverlaySpec(price="0.5"))
+        kube, provider, controller, _ = _env(overlay)
+        cluster = Cluster(kube)
+        attach_informers(kube, cluster)
+        controller.cluster = cluster
+        before = cluster.consolidation_state()
+        controller.reconcile(now=500.0)
+        assert cluster.consolidation_state() != before
