@@ -172,19 +172,26 @@ def runtime_validate(overlay: NodeOverlay) -> Optional[str]:
             return f"invalid capacity: {resource} is restricted"
     if spec.price is not None and spec.price_adjustment is not None:
         return "price and priceAdjustment are mutually exclusive"
+    import math
+
     if spec.price is not None:
         try:
-            if float(spec.price) < 0:
-                return f"price {spec.price!r} must be non-negative"
+            value = float(spec.price)
         except ValueError:
             return f"price {spec.price!r} is not a number"
+        if not math.isfinite(value) or value < 0:
+            # nan slips past a `< 0` check and max(0, nan) zero-prices
+            # every matched offering downstream
+            return f"price {spec.price!r} must be a non-negative number"
     if spec.price_adjustment is not None:
         raw = spec.price_adjustment
         body = raw[:-1] if raw.endswith("%") else raw
         try:
-            float(body)
+            value = float(body)
         except ValueError:
             return f"priceAdjustment {raw!r} is malformed"
+        if not math.isfinite(value):
+            return f"priceAdjustment {raw!r} must be finite"
     return None
 
 
@@ -344,19 +351,21 @@ class NodeOverlayController:
         # catalog); skip it while the input objects are unchanged (the
         # reference controller is watch-triggered), re-running on a
         # long timer to catch provider catalog drift
-        fingerprint = (
-            tuple(sorted(
-                (o.metadata.name, o.metadata.resource_version)
-                for o in overlays
-            )),
-            tuple(sorted(
-                (p.metadata.name, p.metadata.resource_version)
-                for p in pools
-            )),
-        )
+        def current_fingerprint():
+            return (
+                tuple(sorted(
+                    (o.metadata.name, o.metadata.resource_version)
+                    for o in overlays
+                )),
+                tuple(sorted(
+                    (p.metadata.name, p.metadata.resource_version)
+                    for p in pools
+                )),
+            )
+
         wall = _time.monotonic()
         if (
-            fingerprint == self._fingerprint
+            current_fingerprint() == self._fingerprint
             and wall - self._evaluated_at < self.REEVALUATE_SECONDS
         ):
             return
@@ -408,8 +417,15 @@ class NodeOverlayController:
                 if p.metadata.name not in fetch_failed
             },
         )
-        self._fingerprint = fingerprint
-        self._evaluated_at = wall
+        if not fetch_failed:
+            # re-read AFTER the touch loop above bumped overlay rvs —
+            # storing the pre-touch fingerprint would force one wasted
+            # full re-evaluation (and a spurious unconsolidated mark)
+            # on the very next pass. A pass with failed fetches commits
+            # nothing, so the gated pools are retried next tick instead
+            # of staying gated for REEVALUATE_SECONDS.
+            self._fingerprint = current_fingerprint()
+            self._evaluated_at = wall
         if self.cluster is not None:
             # prices moved: force consolidation to re-evaluate
             # (controller.go:119 MarkUnconsolidated) — only on a real

@@ -366,15 +366,20 @@ class RealKubeClient:
         cluster."""
         return FROM_CR[kind](item)
 
+    # kinds whose CRD may legitimately be absent (alpha, feature-gated);
+    # a 404 for anything else is a misconfiguration and must fail boot
+    OPTIONAL_KINDS = frozenset({"NodeOverlay"})
+
     def sync(self) -> None:
-        """Initial LIST per kind (informer start). A 404 means the
-        kind's CRD is not installed (e.g. the alpha NodeOverlay CRD
-        behind a disabled feature gate): drop the kind and keep booting
-        — steady-state _pump tolerates the same absence. Any other
-        error is a real connectivity problem and fails fast."""
+        """Initial LIST per kind (informer start). A 404 for an
+        OPTIONAL kind means its CRD is not installed (e.g. the alpha
+        NodeOverlay CRD behind a disabled feature gate): drop the kind
+        and keep booting — steady-state _pump tolerates the same
+        absence. A 404 for a core kind, or any other error, is a real
+        connectivity/configuration problem and fails fast."""
         for kind in list(self.kinds):
             status, body = self.transport.request("GET", _path(kind))
-            if status == 404:
+            if status == 404 and kind in self.OPTIONAL_KINDS:
                 self.kinds.remove(kind)
                 self._mirror.pop(kind, None)
                 continue

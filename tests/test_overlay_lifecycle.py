@@ -78,9 +78,16 @@ class TestRuntimeValidation:
             price="2.0", price_adjustment="-10%"))
         assert "mutually exclusive" in runtime_validate(overlay)
 
-    @pytest.mark.parametrize("value", ["abc", "--5", "5%%"])
+    @pytest.mark.parametrize("value", ["abc", "--5", "5%%", "nan%", "inf"])
     def test_malformed_adjustment(self, value):
         overlay = NodeOverlay(spec=NodeOverlaySpec(price_adjustment=value))
+        assert runtime_validate(overlay) is not None
+
+    @pytest.mark.parametrize("value", ["nan", "inf", "-1"])
+    def test_nonfinite_or_negative_price_rejected(self, value):
+        """nan passes a naive `< 0` check and max(0, nan) would
+        zero-price every matched offering downstream."""
+        overlay = NodeOverlay(spec=NodeOverlaySpec(price=value))
         assert runtime_validate(overlay) is not None
 
     def test_valid_overlay_passes(self):
