@@ -41,6 +41,7 @@ from karpenter_tpu.scheduling.requirements import Requirements
 from karpenter_tpu.solver.solver import NodePlan
 from karpenter_tpu.state.cluster import Cluster
 from karpenter_tpu.utils import resources as resutil
+from karpenter_tpu.utils.resources import ResourceList
 
 log = logging.getLogger("karpenter.provisioner")
 
@@ -224,12 +225,22 @@ class Provisioner:
 
     def create_node_claims(self, results: SchedulerResults) -> list[NodeClaim]:
         created = []
+        # one usage snapshot per round (an O(nodes) scan under the
+        # cluster lock — not per plan), advanced in-loop with each
+        # created claim's expected capacity so the plans of one call
+        # cannot jointly blow a pool limit
+        usage_by_pool = self.cluster.nodepool_resources()
         for plan in results.new_node_plans:
-            claim = self._claim_from_plan(plan)
+            claim = self._claim_from_plan(plan, usage_by_pool)
             if claim is None:
                 for pod in plan.pods:
                     results.errors[pod.key] = "nodepool limits exceeded"
                 continue
+            if claim.status.capacity:
+                pool_name = plan.pool.metadata.name
+                usage_by_pool[pool_name] = resutil.merge(
+                    usage_by_pool.get(pool_name, {}), claim.status.capacity
+                )
             self.kube.create(claim)
             plan.claim_name = claim.metadata.name
             # sync-write into state so back-to-back solves see it
@@ -255,16 +266,40 @@ class Provisioner:
                 state.nominate()
         return created
 
-    def _claim_from_plan(self, plan: NodePlan) -> Optional[NodeClaim]:
+    def _claim_from_plan(
+        self, plan: NodePlan,
+        usage_by_pool: Optional[dict[str, ResourceList]] = None,
+    ) -> Optional[NodeClaim]:
         pool = plan.pool
-        # limits check (reference checks at create: nodepool.go Limits)
+        # limits check (reference checks at create: nodepool.go Limits).
+        # The claim keeps instance-type flexibility, so the LAUNCH may
+        # resolve onto any admitted type: drop the types that would
+        # breach the remaining limit headroom — then whichever type the
+        # provider picks, the pool stays within its limits.
         if pool.spec.limits:
-            usage = self.cluster.nodepool_resources().get(pool.metadata.name, {})
-            biggest = plan.instance_types[0].capacity if plan.instance_types else {}
-            projected = resutil.merge(usage, biggest)
-            for key, limit in pool.spec.limits.items():
-                if projected.get(key, 0.0) > limit:
-                    return None
+            if usage_by_pool is not None:
+                usage = usage_by_pool.get(pool.metadata.name, {})
+            else:
+                usage = self.cluster.nodepool_resources().get(
+                    pool.metadata.name, {}
+                )
+            fitting = [
+                it for it in plan.instance_types
+                if all(
+                    usage.get(key, 0.0) + it.capacity.get(key, 0.0) <= limit
+                    for key, limit in pool.spec.limits.items()
+                )
+            ]
+            if not fitting:
+                return None
+            plan.instance_types = fitting
+            names = {it.name for it in fitting}
+            plan.offerings = [
+                o for o in plan.offerings
+                if any(o in it.offerings for it in fitting)
+            ]
+            if not plan.offerings:
+                return None
 
         requirements = [
             RequirementSpec(key=spec.key, operator=spec.operator,
@@ -367,6 +402,14 @@ class Provisioner:
                 ),
             ),
         )
+        # expected capacity from the plan's primary (cheapest) type: an
+        # unlaunched claim must still count against pool limits in
+        # cluster state (StateNode.capacity falls back to this; the
+        # provider's ACTUAL launch overwrites it, launch.go analogue) —
+        # otherwise back-to-back rounds before a lifecycle tick see
+        # zero committed capacity and jointly blow the limit
+        if plan.instance_types:
+            claim.status.capacity = dict(plan.instance_types[0].capacity)
         claim.metadata.annotations["karpenter.sh/nodepool-hash"] = pool.hash()
         if plan.min_values_relaxed:
             claim.metadata.annotations[

@@ -1,0 +1,303 @@
+"""Disruption orchestration depth: per-reason budgets, concurrent
+command isolation, replacement-failure rollback, and retry deadlines.
+
+Ported scenario families: disruption/budgets (per-reason budget caps,
+helpers.go:231-280 + nodepool.go:345-389), orchestration queue
+(queue.go:137-246 waitOrTerminate, rollback on replacement death,
+retry deadline), and the cross-reason method ordering
+(controller.go:98-112).
+"""
+
+import time
+
+from karpenter_tpu.apis.v1.labels import DISRUPTED_NO_SCHEDULE_TAINT
+from karpenter_tpu.apis.v1.nodeclaim import COND_DRIFTED
+from karpenter_tpu.apis.v1.nodepool import (
+    Budget,
+    REASON_DRIFTED,
+    REASON_EMPTY,
+    REASON_UNDERUTILIZED,
+)
+from karpenter_tpu.cloudprovider.fake import GIB, make_instance_type
+from karpenter_tpu.disruption.engine import COMMAND_TIMEOUT_SECONDS
+from karpenter_tpu.testing import Environment, mk_nodepool, mk_pod
+
+
+def consolidation_types():
+    return [
+        make_instance_type("c2", cpu=2, memory=8 * GIB, price=2.0),
+        make_instance_type("c4", cpu=4, memory=16 * GIB, price=3.0),
+        make_instance_type("c8", cpu=8, memory=32 * GIB, price=5.0),
+    ]
+
+
+def make_env(budgets=None, consolidate_after="0s"):
+    env = Environment(types=consolidation_types())
+    pool = mk_nodepool("default")
+    pool.spec.disruption.consolidate_after = consolidate_after
+    if budgets is not None:
+        pool.spec.disruption.budgets = budgets
+    env.kube.create(pool)
+    return env
+
+
+def empty_nodes(env, count):
+    """Provision `count` pods one at a time (one small node each) then
+    delete the pods, leaving empty consolidatable nodes."""
+    pods = []
+    for _ in range(count):
+        pod = mk_pod(cpu=1.0, memory=2 * GIB)
+        env.provision(pod)
+        pods.append(pod)
+    for pod in pods:
+        env.kube.delete(env.kube.get_pod("default", pod.metadata.name))
+    return pods
+
+
+class TestPerReasonBudgets:
+    def test_reason_scoped_budget_caps_only_that_reason(self):
+        """A zero budget scoped to Underutilized leaves Empty free
+        (nodepool.go:345-367 reasons filter)."""
+        env = make_env(budgets=[
+            Budget(nodes="0", reasons=[REASON_UNDERUTILIZED]),
+        ])
+        empty_nodes(env, 2)
+        command = env.reconcile_disruption(now=time.time() + 60)
+        assert command is not None and command.reason == REASON_EMPTY
+        assert not env.kube.nodes()
+
+    def test_empty_scoped_zero_budget_blocks_emptiness(self):
+        """With consolidation policy WhenEmpty (so no Underutilized
+        method can pick the nodes up under ITS budget), a zero Empty
+        budget pins the empty nodes."""
+        env = Environment(types=consolidation_types())
+        pool = mk_nodepool("default")
+        pool.spec.disruption.consolidate_after = "0s"
+        pool.spec.disruption.consolidation_policy = "WhenEmpty"
+        pool.spec.disruption.budgets = [
+            Budget(nodes="0", reasons=[REASON_EMPTY]),
+        ]
+        env.kube.create(pool)
+        empty_nodes(env, 2)
+        command = env.reconcile_disruption(now=time.time() + 60)
+        assert command is None
+        assert len(env.kube.nodes()) == 2
+
+    def test_consolidation_may_delete_empty_nodes_under_its_own_budget(self):
+        """An Empty-scoped zero budget does NOT stop the Underutilized
+        methods from retiring empty nodes — each method consumes its
+        own reason's budget (controller.go:98-112 + helpers.go:231)."""
+        env = make_env(budgets=[
+            Budget(nodes="0", reasons=[REASON_EMPTY]),
+        ])
+        empty_nodes(env, 2)
+        command = env.reconcile_disruption(now=time.time() + 60)
+        assert command is not None
+        assert command.reason == REASON_UNDERUTILIZED
+        assert not env.kube.nodes()
+
+    def test_unscoped_budget_caps_all_reasons(self):
+        env = make_env(budgets=[Budget(nodes="1")])
+        empty_nodes(env, 3)
+        now = time.time() + 60
+        command = env.reconcile_disruption(now=now)
+        assert command is not None and command.reason == REASON_EMPTY
+        # only one node may go this round
+        assert len(command.candidates) == 1
+        assert len(env.kube.nodes()) == 2
+
+    def test_percentage_budget_rounds_up(self):
+        """'10%' of 3 nodes allows ceil(0.3) = 1 disruption
+        (nodepool.go MaxUnavailable semantics)."""
+        env = make_env(budgets=[Budget(nodes="34%")])
+        empty_nodes(env, 3)
+        command = env.reconcile_disruption(now=time.time() + 60)
+        assert command is not None
+        assert len(command.candidates) == 2  # ceil(0.34 * 3) = 2
+
+    def test_multiple_budgets_minimum_wins(self):
+        env = make_env(budgets=[
+            Budget(nodes="2"),
+            Budget(nodes="1", reasons=[REASON_EMPTY]),
+        ])
+        empty_nodes(env, 3)
+        command = env.reconcile_disruption(now=time.time() + 60)
+        assert command is not None and command.reason == REASON_EMPTY
+        assert len(command.candidates) == 1
+
+    def test_drift_budget_blocks_drift_only(self):
+        env = make_env(budgets=[
+            Budget(nodes="0", reasons=[REASON_DRIFTED]),
+        ])
+        pod = mk_pod(cpu=1.0, memory=2 * GIB)
+        env.provision(pod)
+        claim = env.kube.node_claims()[0]
+        claim.status_conditions.set_true(COND_DRIFTED, now=time.time())
+        command = env.disruption.reconcile(now=time.time() + 60)
+        # drift blocked by its zero budget; nothing else eligible
+        assert command is None or command.reason != REASON_DRIFTED
+        assert env.kube.get_node_claim(claim.metadata.name) is not None
+
+
+class TestMethodOrdering:
+    def test_emptiness_wins_over_consolidation(self):
+        """controller.go:98-112: the first successful Method ends the
+        round — empty nodes go via Emptiness even when consolidation
+        could also act."""
+        env = make_env()
+        pods = [mk_pod(cpu=1.0, memory=2 * GIB) for _ in range(2)]
+        for pod in pods:
+            env.provision(pod)
+        env.kube.delete(env.kube.get_pod("default", pods[0].metadata.name))
+        now = time.time() + 60
+        env.pod_events.reconcile_all(now=now)
+        env.conditions.reconcile_all(now=now)
+        command = env.disruption.reconcile(now=now)
+        assert command is not None and command.reason == REASON_EMPTY
+        assert len(command.candidates) == 1
+
+    def test_one_command_per_round(self):
+        env = make_env()
+        empty_nodes(env, 3)
+        now = time.time() + 60
+        env.pod_events.reconcile_all(now=now)
+        env.conditions.reconcile_all(now=now)
+        first = env.disruption.reconcile(now=now)
+        assert first is not None
+        # the same reconcile call never starts a second command; the
+        # queue holds exactly one active command
+        assert len(env.disruption.queue.active) <= 1
+
+
+class TestReplacementFailureRollback:
+    def test_replacement_launch_failure_rolls_back(self):
+        """queue.go:137-246: replacements that die (ICE -> lifecycle
+        deletes the claim) roll the command back — candidates untainted
+        and still alive."""
+        env = make_env()
+        pods = []
+        for _ in range(3):
+            pod = mk_pod(cpu=1.0, memory=2 * GIB)
+            env.provision(pod)
+            pods.append(pod)
+        now = time.time() + 120
+        env.pod_events.reconcile_all(now=now)
+        env.conditions.reconcile_all(now=now)
+        # every future create fails with ICE
+        from karpenter_tpu.cloudprovider.types import InsufficientCapacityError
+
+        env.cloud.next_create_error = InsufficientCapacityError("ICE")
+        command = env.disruption.reconcile(now=now)
+        assert command is not None and command.replacement_count >= 1
+        # lifecycle processes the replacement claim: launch fails, the
+        # claim dies; the queue sees 'failed' and rolls back
+        env.lifecycle.reconcile_all(now=now)
+        env.disruption.queue.reconcile(now=now)
+        assert command not in env.disruption.queue.active
+        for candidate in command.candidates:
+            claim = env.kube.get_node_claim(
+                candidate.state_node.node_claim.metadata.name
+            )
+            assert claim is not None
+            assert claim.metadata.deletion_timestamp is None
+            node = candidate.state_node.node
+            assert not any(
+                t.key == DISRUPTED_NO_SCHEDULE_TAINT.key
+                for t in node.spec.taints
+            )
+
+    def test_command_timeout_rolls_back(self):
+        """A command whose replacements never initialize rolls back at
+        the retry deadline (queue.go:86)."""
+        env = make_env()
+        pods = []
+        now = time.time()
+        for _ in range(3):
+            pod = mk_pod(cpu=1.0, memory=2 * GIB)
+            env.provision(pod, now=now)
+            pods.append(pod)
+        # replacements launched from here on never register
+        env.cloud.registration_delay = 10_000.0
+        now += 120
+        env.pod_events.reconcile_all(now=now)
+        env.conditions.reconcile_all(now=now)
+        command = env.disruption.reconcile(now=now)
+        assert command is not None
+        # replacements stay unregistered (huge registration delay);
+        # past the deadline the queue gives up
+        late = now + COMMAND_TIMEOUT_SECONDS + 1
+        env.disruption.queue.reconcile(now=late)
+        assert command not in env.disruption.queue.active
+        for candidate in command.candidates:
+            node = candidate.state_node.node
+            assert not any(
+                t.key == DISRUPTED_NO_SCHEDULE_TAINT.key
+                for t in node.spec.taints
+            )
+
+    def test_rollback_then_retry_succeeds(self):
+        """After a rollback, a later round recomputes and executes."""
+        env = make_env()
+        pods = []
+        for _ in range(3):
+            pod = mk_pod(cpu=1.0, memory=2 * GIB)
+            env.provision(pod)
+            pods.append(pod)
+        now = time.time() + 120
+        env.pod_events.reconcile_all(now=now)
+        env.conditions.reconcile_all(now=now)
+        from karpenter_tpu.cloudprovider.types import InsufficientCapacityError
+
+        env.cloud.next_create_error = InsufficientCapacityError("ICE")
+        first = env.disruption.reconcile(now=now)
+        assert first is not None
+        env.lifecycle.reconcile_all(now=now)
+        env.disruption.queue.reconcile(now=now)
+        assert first not in env.disruption.queue.active
+        # provider recovers; next rounds consolidate successfully
+        later = now + 30
+        for _ in range(4):
+            env.reconcile_disruption(now=later)
+            later += 5
+        assert len(env.kube.nodes()) < 3
+        assert env.all_pods_bound()
+
+
+class TestCandidateProtection:
+    def test_in_flight_candidates_not_recandidated(self):
+        """Nodes already marked by an active command are not offered to
+        the next round's methods (helpers.go deleting exclusion)."""
+        env = make_env()
+        now = time.time()
+        for _ in range(3):
+            env.provision(mk_pod(cpu=1.0, memory=2 * GIB), now=now)
+        env.cloud.registration_delay = 10_000.0
+        now += 120
+        env.pod_events.reconcile_all(now=now)
+        env.conditions.reconcile_all(now=now)
+        first = env.disruption.reconcile(now=now)
+        assert first is not None
+        # replacements can't initialize (registration delay), command
+        # stays active; a second reconcile must not build a command
+        # from the same marked candidates
+        second = env.disruption.reconcile(now=now + 11)
+        if second is not None:
+            first_names = {c.state_node.name for c in first.candidates}
+            second_names = {c.state_node.name for c in second.candidates}
+            assert not (first_names & second_names)
+
+    def test_nominated_node_not_a_candidate(self):
+        """A node holding a nomination window is not disruptable
+        (statenode.go Nominate)."""
+        env = make_env(consolidate_after="0s")
+        pod = mk_pod(cpu=1.0, memory=2 * GIB)
+        env.provision(pod)
+        env.kube.delete(env.kube.get_pod("default", pod.metadata.name))
+        now = time.time() + 60
+        state = env.cluster.node_for_name(env.kube.nodes()[0].metadata.name)
+        state.nominate(now=now)
+        env.pod_events.reconcile_all(now=now)
+        env.conditions.reconcile_all(now=now)
+        command = env.disruption.reconcile(now=now)
+        assert command is None
+        assert len(env.kube.nodes()) == 1

@@ -1,0 +1,291 @@
+"""Provisioning suite edge cases, ported from the reference's
+provisioning/suite_test.go families not yet covered: init-container /
+native-sidecar resource math end to end (suite_test.go:531-683),
+pod-level resources (suite_test.go:684), partial scheduling under
+limits, deleting-node reschedule consolidation onto one in-flight
+node, and nodeclaim request shaping from pod resource requests.
+"""
+
+import time
+
+from karpenter_tpu.cloudprovider.fake import GIB, make_instance_type
+from karpenter_tpu.kube.objects import Container
+from karpenter_tpu.testing import Environment, mk_nodepool, mk_pod
+
+
+def sized_types():
+    return [
+        make_instance_type("s", cpu=2, memory=8 * GIB, price=1.0),
+        make_instance_type("m", cpu=4, memory=16 * GIB, price=2.0),
+        make_instance_type("l", cpu=8, memory=32 * GIB, price=4.0),
+        make_instance_type("xl", cpu=16, memory=64 * GIB, price=8.0),
+    ]
+
+
+def make_env(**pool_kwargs):
+    env = Environment(types=sized_types())
+    pool = mk_nodepool("default", **pool_kwargs)
+    env.kube.create(pool)
+    return env
+
+
+def node_cpu(env):
+    """cpu capacity of each launched node, sorted."""
+    return sorted(
+        n.status.capacity.get("cpu", 0) for n in env.kube.nodes()
+    )
+
+
+class TestInitAndSidecarContainers:
+    """suite_test.go:531-683: effective requests are
+    max(init-peak, sidecars + main), where a restartPolicy=Always init
+    container (native sidecar) stacks under everything after it."""
+
+    def test_init_peak_dominates_when_larger(self):
+        # init 6cpu runs alone; main needs 1 -> node must fit 6
+        pod = mk_pod(cpu=1.0, memory=GIB)
+        pod.spec.init_containers = [Container(requests={"cpu": 6.0})]
+        env = make_env()
+        env.provision(pod)
+        assert node_cpu(env) == [8]  # l, not s
+
+    def test_main_sum_dominates_when_larger(self):
+        pod = mk_pod(cpu=3.0, memory=GIB)
+        pod.spec.containers.append(Container(name="second",
+                                             requests={"cpu": 3.0}))
+        pod.spec.init_containers = [Container(requests={"cpu": 1.0})]
+        env = make_env()
+        env.provision(pod)
+        assert node_cpu(env) == [8]  # 6 cpu main sum
+
+    def test_sidecar_first_stacks_under_init_and_main(self):
+        """sidecar (3cpu) + later plain init (4cpu) peak at 7; main
+        (1cpu) + sidecar = 4 -> init phase dominates."""
+        pod = mk_pod(cpu=1.0, memory=GIB)
+        pod.spec.init_containers = [
+            Container(name="sidecar", requests={"cpu": 3.0},
+                      restart_policy="Always"),
+            Container(name="prep", requests={"cpu": 4.0}),
+        ]
+        env = make_env()
+        env.provision(pod)
+        assert node_cpu(env) == [8]  # peak 7
+
+    def test_sidecar_after_init_does_not_stack_under_it(self):
+        """a plain init that runs BEFORE the sidecar peaks alone: init
+        3cpu, then sidecar 2cpu; peak = max(3, 1 + 2) = 3 — contrast
+        with sidecar-first where the same numbers stack to 5."""
+        pod = mk_pod(cpu=1.0, memory=GIB)
+        pod.spec.init_containers = [
+            Container(name="prep", requests={"cpu": 3.0}),
+            Container(name="sidecar", requests={"cpu": 2.0},
+                      restart_policy="Always"),
+        ]
+        env = make_env()
+        env.provision(pod)
+        assert node_cpu(env) == [4]  # m fits the 3-cpu peak (+overhead)
+
+    def test_same_numbers_sidecar_first_stack_to_five(self):
+        pod = mk_pod(cpu=1.0, memory=GIB)
+        pod.spec.init_containers = [
+            Container(name="sidecar", requests={"cpu": 2.0},
+                      restart_policy="Always"),
+            Container(name="prep", requests={"cpu": 3.0}),
+        ]
+        env = make_env()
+        env.provision(pod)
+        assert node_cpu(env) == [8]  # peak 2 + 3 = 5
+
+    def test_small_init_resources_do_not_inflate(self):
+        pod = mk_pod(cpu=3.0, memory=GIB)
+        pod.spec.init_containers = [Container(requests={"cpu": 0.5})]
+        env = make_env()
+        env.provision(pod)
+        assert node_cpu(env) == [4]
+
+
+class TestPodLevelResources:
+    def test_pod_level_requests_replace_container_sum(self):
+        """PodLevelResources (suite_test.go:684): explicit pod-level
+        values override container aggregation for those resources."""
+        pod = mk_pod(cpu=1.0, memory=GIB)
+        pod.spec.containers.append(Container(name="b",
+                                             requests={"cpu": 1.0}))
+        pod.spec.resources = {"cpu": 6.0, "memory": 2 * GIB}
+        env = make_env()
+        env.provision(pod)
+        assert node_cpu(env) == [8]  # pod-level 6cpu, not 2
+
+    def test_pod_level_partial_override_keeps_other_axes(self):
+        pod = mk_pod(cpu=1.0, memory=20 * GIB)
+        pod.spec.resources = {"cpu": 3.0}  # memory still from containers
+        env = make_env()
+        env.provision(pod)
+        nodes = env.kube.nodes()
+        assert len(nodes) == 1
+        assert nodes[0].status.capacity["memory"] >= 20 * GIB
+
+
+class TestLimitsPartialScheduling:
+    def test_partial_schedule_when_limits_allow_some(self):
+        """suite_test.go 'should partially schedule if limits would be
+        exceeded': capacity up to the limit launches; the rest pends."""
+        # no xl in the catalog: one node cannot hold all four pods, so
+        # the plan splits and the limit admits exactly one node
+        env = Environment(types=sized_types()[:3])
+        pool = mk_nodepool("default")
+        pool.spec.limits = {"cpu": 8.0}
+        env.kube.create(pool)
+        pods = [mk_pod(cpu=3.0, memory=GIB) for _ in range(4)]  # 12 cpu
+        env.provision(*pods)
+        bound = [p for p in pods
+                 if env.kube.get_pod("default", p.metadata.name).spec.node_name]
+        assert 0 < len(bound) < 4
+        total_cpu = sum(n.status.capacity.get("cpu", 0)
+                        for n in env.kube.nodes())
+        assert total_cpu <= 8
+
+    def test_limits_hold_across_back_to_back_rounds_without_launch(self):
+        """Back-to-back create rounds BEFORE any lifecycle tick: the
+        unlaunched claim's expected capacity must already count against
+        the limit (claims carry zero provider capacity until launch)."""
+        from karpenter_tpu.provisioning.provisioner import Provisioner
+
+        env = Environment(types=sized_types())
+        pool = mk_nodepool("default")
+        pool.spec.limits = {"cpu": 4.0}
+        env.kube.create(pool)
+        prov = Provisioner(env.kube, env.cluster, env.cloud)
+        env.kube.create(mk_pod(name="r1", cpu=3.0, memory=GIB))
+        prov.create_node_claims(prov.schedule())  # no lifecycle tick
+        env.kube.create(mk_pod(name="r2", cpu=3.0, memory=GIB))
+        prov.create_node_claims(prov.schedule())
+        committed = sum(
+            c.status.capacity.get("cpu", 0) for c in env.kube.node_claims()
+        )
+        assert committed <= 4.0, committed
+        assert len(env.kube.node_claims()) == 1
+
+    def test_limit_filters_oversized_types_from_claim(self):
+        """The claim's instance-type flexibility is trimmed to types
+        fitting the remaining limit headroom, so a provider fallback
+        can never launch past the limit."""
+        env = Environment(types=sized_types())
+        pool = mk_nodepool("default")
+        pool.spec.limits = {"cpu": 8.0}
+        env.kube.create(pool)
+        env.provision(mk_pod(cpu=3.0, memory=GIB))
+        claim = env.kube.node_claims()[0]
+        type_req = next(
+            r for r in claim.spec.requirements
+            if r.key == "node.kubernetes.io/instance-type"
+        )
+        assert "xl" not in type_req.values  # 16 cpu > 8 cpu limit
+
+    def test_limits_apply_across_scheduling_rounds(self):
+        env = Environment(types=sized_types())
+        pool = mk_nodepool("default")
+        pool.spec.limits = {"cpu": 4.0}
+        env.kube.create(pool)
+        env.provision(mk_pod(cpu=3.0, memory=GIB))
+        assert len(env.kube.nodes()) == 1
+        # second round: the pool is at its limit
+        late = mk_pod(cpu=3.0, memory=GIB)
+        env.provision(late)
+        assert len(env.kube.nodes()) == 1
+        assert not env.kube.get_pod("default", late.metadata.name).spec.node_name
+
+
+class TestDeletingNodeReschedule:
+    def test_all_pods_from_deleting_node_pack_one_inflight_node(self):
+        """suite_test.go 'should schedule all pods on one inflight node
+        when node is in deleting state': reschedulables from a draining
+        node solve together onto ONE replacement."""
+        env = make_env()
+        pods = [mk_pod(cpu=1.0, memory=GIB) for _ in range(3)]
+        env.provision(*pods)
+        assert len(env.kube.nodes()) == 1
+        victim_claim = env.kube.node_claims()[0]
+        env.kube.delete(victim_claim)
+        env.kube.deliver() if env.kube.async_delivery else None
+        results = env.provisioner.schedule()
+        # one new node hosts all three reschedulables
+        assert len(results.new_node_plans) == 1
+        assert len(results.new_node_plans[0].pods) == 3
+
+    def test_deleting_node_pods_not_double_counted_when_bound(self):
+        env = make_env()
+        pod = mk_pod(cpu=1.0, memory=GIB)
+        env.provision(pod)
+        claim = env.kube.node_claims()[0]
+        env.kube.delete(claim)
+        results = env.provisioner.schedule()
+        placed = [p.metadata.name
+                  for plan in results.new_node_plans for p in plan.pods]
+        assert placed.count(pod.metadata.name) == 1
+
+
+class TestNodeClaimRequestShape:
+    def test_claim_resources_reflect_pod_requests(self):
+        """'should create a nodeclaim with resource requests': the
+        claim's spec.resources carries the solved pods' totals."""
+        env = make_env()
+        env.provision(mk_pod(cpu=2.0, memory=4 * GIB))
+        claim = env.kube.node_claims()[0]
+        assert claim.spec.resources.get("cpu", 0) >= 2.0
+        assert claim.spec.resources.get("memory", 0) >= 4 * GIB
+
+    def test_claim_restricts_types_by_resource_fit(self):
+        """'restricting instance types based on pod resource requests':
+        types too small for the pod never appear as options."""
+        env = make_env()
+        env.provision(mk_pod(cpu=6.0, memory=GIB))
+        claim = env.kube.node_claims()[0]
+        type_req = next(
+            (r for r in claim.spec.requirements
+             if r.key == "node.kubernetes.io/instance-type"), None
+        )
+        assert type_req is not None
+        assert "s" not in type_req.values and "m" not in type_req.values
+
+    def test_claim_owner_and_nodepool_label(self):
+        env = make_env()
+        env.provision(mk_pod(cpu=1.0, memory=GIB))
+        claim = env.kube.node_claims()[0]
+        assert claim.metadata.labels.get("karpenter.sh/nodepool") == "default"
+
+    def test_nodeclass_ref_propagates(self):
+        from karpenter_tpu.apis.v1.nodeclaim import NodeClassRef
+
+        env = Environment(types=sized_types())
+        pool = mk_nodepool("default")
+        pool.spec.template.spec.node_class_ref = NodeClassRef(
+            group="karpenter.kwok.sh", kind="KWOKNodeClass", name="default"
+        )
+        env.kube.create(pool)
+        env.provision(mk_pod(cpu=1.0, memory=GIB))
+        claim = env.kube.node_claims()[0]
+        assert claim.spec.node_class_ref is not None
+        assert claim.spec.node_class_ref.kind == "KWOKNodeClass"
+
+
+class TestSchedulerRequestMath:
+    def test_no_requests_schedules_on_smallest(self):
+        """'should be able to schedule pods if resource requests and
+        limits are not defined'."""
+        pod = mk_pod(cpu=0.0, memory=0.0)
+        pod.spec.containers = [Container(requests={})]
+        env = make_env()
+        env.provision(pod)
+        assert len(env.kube.nodes()) == 1
+        assert node_cpu(env) == [2]
+
+    def test_oversized_combined_requests_unschedulable(self):
+        """'should not schedule if combined max resources are too large
+        for any node'."""
+        pod = mk_pod(cpu=10.0, memory=GIB)
+        pod.spec.init_containers = [Container(requests={"cpu": 20.0})]
+        env = make_env()
+        env.provision(pod)
+        assert len(env.kube.nodes()) == 0
+        assert not env.kube.get_pod("default", pod.metadata.name).spec.node_name
