@@ -59,6 +59,10 @@ class EvictionQueue:
         self.blocked: dict[str, str] = {}  # pod key -> blocking pdb
         self._attempts: dict[str, int] = {}  # pod key -> 429 count
         self._retry_at: dict[str, float] = {}  # pod key -> next attempt
+        # successors owed to finalizer-wedged pods: created the moment
+        # the old pod finally leaves the store (prune), so a wedge
+        # delays — never loses — the workload replica
+        self._pending_rebirth: dict[str, Pod] = {}
 
     def evict(self, pod: Pod, now: Optional[float] = None, force: bool = False) -> bool:
         now = time.time() if now is None else now
@@ -80,8 +84,18 @@ class EvictionQueue:
                 return False
         self._forget(pod.key)
         self.kube.delete(pod, now=now)
+        # rebirth only once the old pod actually left the store: a pod
+        # wedged terminating (finalizers) still owns its name, and a
+        # real ReplicaSet would not have its successor admitted under a
+        # colliding identity either — the successor is OWED and created
+        # by prune() when the wedge finally clears
         if pod.owner_kind() != "DaemonSet":
-            self.kube.create(rebirth_pod(pod))
+            if self.kube.get_pod(
+                pod.metadata.namespace, pod.metadata.name
+            ) is None:
+                self.kube.create(rebirth_pod(pod))
+            else:
+                self._pending_rebirth[pod.key] = rebirth_pod(pod)
         return True
 
     def _forget(self, pod_key: str) -> None:
@@ -91,11 +105,16 @@ class EvictionQueue:
 
     def prune(self) -> None:
         """Drop bookkeeping for pods that no longer exist (the
-        reference's queue removes items on pod deletion events)."""
+        reference's queue removes items on pod deletion events), and
+        deliver successors owed to since-cleared wedged pods."""
         live = {p.key for p in self.kube.pods()}
         for key in list(self.blocked.keys() | self._retry_at.keys()):
             if key not in live:
                 self._forget(key)
+        for key, successor in list(self._pending_rebirth.items()):
+            if key not in live:
+                del self._pending_rebirth[key]
+                self.kube.create(successor)
 
 
 def rebirth_pod(pod: Pod) -> Pod:
@@ -243,21 +262,33 @@ class TerminationController:
         )
         return float(raw) if raw else None
 
-    def _blocking_pods(self, node: Node) -> list[Pod]:
-        """Pods whose presence blocks drain completion: live, and not
-        riding the node down via a disrupted-taint toleration."""
-        return [
-            p
-            for p in self.kube.pods_on_node(node.metadata.name)
-            if not p.is_terminal() and not _tolerates_disrupted(p)
-        ]
+    def _blocking_pods(self, node: Node, now: Optional[float] = None) -> list[Pod]:
+        """Pods whose presence blocks drain completion: live, not
+        riding the node down via a disrupted-taint toleration, and not
+        STUCK terminating past their own grace period (terminator.go
+        'bypass pods which are stuck terminating past their grace
+        period' — a wedged finalizer must not hold the node hostage)."""
+        now = time.time() if now is None else now
+        out = []
+        for p in self.kube.pods_on_node(node.metadata.name):
+            if p.is_terminal() or _tolerates_disrupted(p):
+                continue
+            if p.is_terminating():
+                # nil grace means the k8s default (30s), not zero — a
+                # zero here would bypass the pod the tick it was evicted
+                grace = p.spec.termination_grace_period_seconds
+                grace = 30.0 if grace is None else grace
+                if now >= (p.metadata.deletion_timestamp or now) + grace:
+                    continue  # stuck past grace: bypassed
+            out.append(p)
+        return out
 
     def _drain(self, node: Node, deadline: Optional[float], now: float) -> list[Pod]:
         """Evict one wave at a time; returns pods still on the node
         that block completion. Like the reference (terminator.go
         Drain), the first non-empty wave gates the rest — a
         do-not-disrupt pod in it stalls drain until the TGP deadline."""
-        pods = self._blocking_pods(node)
+        pods = self._blocking_pods(node, now)
         if deadline is not None:
             # ahead-of-deadline deletion (terminator.go:140-180): a pod
             # whose terminationGracePeriodSeconds would run PAST the
@@ -279,7 +310,7 @@ class TerminationController:
                     self.queue.evict(pod, now=now, force=True)
                     expired = True
             if expired:
-                pods = self._blocking_pods(node)
+                pods = self._blocking_pods(node, now)
         waves = _drain_waves([p for p in pods if not p.is_terminating()])
         if waves:
             force = deadline is not None and now >= deadline
@@ -291,10 +322,33 @@ class TerminationController:
                     continue
                 # TGP enforcement bypasses PDBs (terminator.go:140)
                 self.queue.evict(pod, now=now, force=force)
-        return self._blocking_pods(node)
+        return self._blocking_pods(node, now)
 
     def _volumes_detached(self, node: Node) -> bool:
-        for pv in self.kube.list("PersistentVolume"):
-            if pv.attached_node == node.metadata.name:
-                return False
-        return True
+        """Only volumes of DRAINABLE pods gate termination
+        (controller.go 'should only wait for volume attachments
+        associated with drainable pods'): a volume still claimed by a
+        pod riding the node down (disrupted-taint tolerator) will never
+        detach before the node dies and must not wedge the finalizer."""
+        attached = [
+            pv for pv in self.kube.list("PersistentVolume")
+            if pv.attached_node == node.metadata.name
+        ]
+        if not attached:
+            return True
+        riders = [
+            p for p in self.kube.pods_on_node(node.metadata.name)
+            if not p.is_terminal() and _tolerates_disrupted(p)
+        ]
+        from karpenter_tpu.provisioning.volume_topology import _pvc_name_for
+
+        rider_pv_names = set()
+        for pod in riders:
+            for volume in pod.spec.volumes:
+                pvc_name = _pvc_name_for(pod, volume)
+                if not pvc_name:
+                    continue
+                pvc = self.kube.get_pvc(pod.metadata.namespace, pvc_name)
+                if pvc is not None and pvc.spec.volume_name:
+                    rider_pv_names.add(pvc.spec.volume_name)
+        return all(pv.metadata.name in rider_pv_names for pv in attached)

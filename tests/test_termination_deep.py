@@ -1,0 +1,299 @@
+"""Termination depth: the node/termination suite families beyond the
+basics — stuck-terminating bypass, drainable-volume filtering,
+disrupted-taint tolerations (Equal and Exists), nodes without claims,
+unmanaged nodes, eviction-queue key reuse, and full four-wave order.
+
+Parity targets: node/termination/suite_test.go scenarios and
+terminator/{terminator,eviction}.go.
+"""
+
+import time
+
+from karpenter_tpu.apis.v1.labels import (
+    DISRUPTED_NO_SCHEDULE_TAINT,
+    NODECLAIM_TERMINATION_TIMESTAMP_ANNOTATION,
+    TERMINATION_FINALIZER,
+)
+from karpenter_tpu.cloudprovider.fake import GIB, make_instance_type
+from karpenter_tpu.kube.objects import (
+    Node,
+    ObjectMeta,
+    PersistentVolume,
+    PersistentVolumeClaim,
+    PersistentVolumeClaimSpec,
+    PodVolume,
+    Toleration,
+)
+from karpenter_tpu.lifecycle.termination import TerminationController
+from karpenter_tpu.testing import Environment, mk_nodepool, mk_pod
+
+
+def make_env():
+    env = Environment(types=[
+        make_instance_type("c4", cpu=4, memory=16 * GIB, price=2.0),
+        make_instance_type("c8", cpu=8, memory=32 * GIB, price=4.0),
+    ])
+    env.kube.create(mk_nodepool("default"))
+    return env
+
+
+def provisioned_node(env, *pods):
+    env.provision(*pods)
+    return env.kube.nodes()[0]
+
+
+class TestTolerationRideDown:
+    def _ride(self, toleration):
+        env = make_env()
+        pod = mk_pod(cpu=1.0, memory=GIB)
+        pod.spec.tolerations = [toleration]
+        node = provisioned_node(env, pod)
+        env.kube.delete(node)
+        now = time.time()
+        env.termination.reconcile(node, now=now)
+        # the tolerating pod was neither evicted nor did it block the
+        # drain: the node went away and the pod died with it
+        assert env.kube.get_node(node.metadata.name) is None
+
+    def test_equal_operator_toleration_rides_down(self):
+        self._ride(Toleration(
+            key=DISRUPTED_NO_SCHEDULE_TAINT.key, operator="Equal",
+            value=DISRUPTED_NO_SCHEDULE_TAINT.value,
+            effect="NoSchedule",
+        ))
+
+    def test_exists_operator_toleration_rides_down(self):
+        self._ride(Toleration(
+            key=DISRUPTED_NO_SCHEDULE_TAINT.key, operator="Exists",
+        ))
+
+    def test_non_tolerating_pod_is_evicted_and_reborn(self):
+        env = make_env()
+        pod = mk_pod(cpu=1.0, memory=GIB)
+        node = provisioned_node(env, pod)
+        env.kube.delete(node)
+        env.termination.reconcile(node, now=time.time())
+        assert env.kube.get_node(node.metadata.name) is None
+        reborn = env.kube.get_pod("default", pod.metadata.name)
+        assert reborn is not None and not reborn.spec.node_name
+
+
+class TestStuckTerminatingBypass:
+    def test_pod_stuck_past_grace_does_not_block_drain(self):
+        """terminator.go 'should bypass pods which are stuck
+        terminating past their grace period': a wedged finalizer on a
+        pod must not hold the node hostage."""
+        env = make_env()
+        pod = mk_pod(cpu=1.0, memory=GIB)
+        pod.metadata.finalizers = ["example.com/wedged"]
+        pod.spec.termination_grace_period_seconds = 30
+        node = provisioned_node(env, pod)
+        env.kube.delete(node)
+        now = time.time()
+        env.termination.reconcile(node, now=now)  # evicts -> terminating
+        live = env.kube.get_pod("default", pod.metadata.name)
+        assert live is not None and live.is_terminating()
+        # within grace: still blocks
+        env.termination.reconcile(node, now=now + 5)
+        assert env.kube.get_node(node.metadata.name) is not None
+        # past grace: bypassed, node completes
+        env.termination.reconcile(node, now=now + 31)
+        assert env.kube.get_node(node.metadata.name) is None
+
+    def test_wedged_pod_successor_delivered_when_wedge_clears(self):
+        """A finalizer-wedged pod's replacement is owed, not lost: the
+        moment the wedge clears, the successor appears pending."""
+        env = make_env()
+        pod = mk_pod(cpu=1.0, memory=GIB)
+        pod.metadata.finalizers = ["example.com/wedged"]
+        pod.spec.termination_grace_period_seconds = 10
+        node = provisioned_node(env, pod)
+        env.kube.delete(node)
+        now = time.time()
+        env.termination.reconcile(node, now=now)
+        wedged = env.kube.get_pod("default", pod.metadata.name)
+        assert wedged is not None and wedged.is_terminating()
+        env.termination.reconcile(node, now=now + 11)  # bypassed; node goes
+        assert env.kube.get_node(node.metadata.name) is None
+        # the wedge clears: the successor is delivered on the next prune
+        env.kube.remove_finalizer(wedged, "example.com/wedged")
+        env.termination.reconcile_all(now=now + 12)
+        successor = env.kube.get_pod("default", pod.metadata.name)
+        assert successor is not None
+        assert not successor.spec.node_name
+        assert successor.metadata.uid != wedged.metadata.uid
+
+    def test_pod_within_grace_blocks_drain(self):
+        env = make_env()
+        pod = mk_pod(cpu=1.0, memory=GIB)
+        pod.metadata.finalizers = ["example.com/wedged"]
+        pod.spec.termination_grace_period_seconds = 300
+        node = provisioned_node(env, pod)
+        env.kube.delete(node)
+        now = time.time()
+        env.termination.reconcile(node, now=now)
+        env.termination.reconcile(node, now=now + 60)
+        assert env.kube.get_node(node.metadata.name) is not None
+
+
+class TestDrainableVolumeFiltering:
+    def _attach(self, env, pod, pv_name):
+        env.kube.create(PersistentVolume(
+            metadata=ObjectMeta(name=pv_name),
+            attached_node=pod.spec.node_name,
+        ))
+        env.kube.create(PersistentVolumeClaim(
+            metadata=ObjectMeta(name=f"claim-{pv_name}",
+                                namespace=pod.metadata.namespace),
+            spec=PersistentVolumeClaimSpec(volume_name=pv_name),
+        ))
+        pod.spec.volumes = [
+            PodVolume(name="data", pvc_name=f"claim-{pv_name}")
+        ]
+
+    def test_drained_pod_volume_blocks_until_detached(self):
+        env = make_env()
+        pod = mk_pod(cpu=1.0, memory=GIB)
+        node = provisioned_node(env, pod)
+        self._attach(env, pod, "pv-1")
+        env.kube.delete(node)
+        now = time.time()
+        env.termination.reconcile(node, now=now)
+        # drained, but the volume is still attached: node waits
+        assert env.kube.get_node(node.metadata.name) is not None
+        pv = env.kube.get_pv("pv-1")
+        pv.attached_node = ""
+        env.termination.reconcile(node, now=now + 1)
+        assert env.kube.get_node(node.metadata.name) is None
+
+    def test_rider_pod_volume_does_not_block(self):
+        """'should only wait for volume attachments associated with
+        drainable pods': a volume used by a pod riding the node down
+        can never detach first and must not wedge the finalizer."""
+        env = make_env()
+        rider = mk_pod(cpu=1.0, memory=GIB)
+        rider.spec.tolerations = [Toleration(
+            key=DISRUPTED_NO_SCHEDULE_TAINT.key, operator="Exists",
+        )]
+        node = provisioned_node(env, rider)
+        self._attach(env, rider, "pv-rider")
+        env.kube.delete(node)
+        env.termination.reconcile(node, now=time.time())
+        assert env.kube.get_node(node.metadata.name) is None
+
+
+class TestNodesWithoutClaims:
+    def test_orphan_managed_node_terminates(self):
+        """'should delete nodes without nodeclaims': the termination
+        finalizer path needs no claim."""
+        env = make_env()
+        node = Node(metadata=ObjectMeta(
+            name="orphan",
+            labels={"karpenter.sh/nodepool": "default"},
+            finalizers=[TERMINATION_FINALIZER],
+        ))
+        env.kube.create(node)
+        env.kube.delete(node)
+        env.termination.reconcile(node, now=time.time())
+        assert env.kube.get_node("orphan") is None
+
+    def test_unmanaged_node_ignored(self):
+        """'should ignore nodes not managed by this Karpenter
+        instance': no termination finalizer -> not ours to drain."""
+        env = make_env()
+        node = Node(metadata=ObjectMeta(name="foreign"))
+        env.kube.create(node)
+        env.kube.delete(node)
+        env.termination.reconcile(node, now=time.time())
+        # no finalizer: the delete simply completed; nothing crashed
+        assert env.kube.get_node("foreign") is None
+
+    def test_node_not_deleting_is_noop(self):
+        env = make_env()
+        pod = mk_pod(cpu=1.0, memory=GIB)
+        node = provisioned_node(env, pod)
+        env.termination.reconcile(node, now=time.time())
+        assert env.kube.get_node(node.metadata.name) is not None
+        assert env.kube.get_pod("default", pod.metadata.name).spec.node_name
+
+
+class TestEvictionQueueKeyReuse:
+    def test_new_pod_with_same_name_gets_fresh_backoff(self):
+        """'should not evict a new pod with the same name using the old
+        pod's eviction queue key': backoff state must not leak onto a
+        successor pod."""
+        from karpenter_tpu.kube.objects import (
+            LabelSelector,
+            PodDisruptionBudget,
+            PodDisruptionBudgetSpec,
+        )
+
+        env = make_env()
+        pod = mk_pod(cpu=1.0, memory=GIB, labels={"app": "a"})
+        node = provisioned_node(env, pod)
+        env.kube.create(PodDisruptionBudget(
+            metadata=ObjectMeta(name="pdb"),
+            spec=PodDisruptionBudgetSpec(
+                selector=LabelSelector.of({"app": "a"}),
+                min_available=1,
+            ),
+        ))
+        now = time.time()
+        queue = env.termination.queue
+        assert not queue.evict(pod, now=now)  # PDB blocks, backoff set
+        assert pod.key in queue._retry_at
+        # the pod vanishes and a NEW pod with the same name appears
+        env.kube.delete(env.kube.get_pod("default", pod.metadata.name))
+        queue.prune()
+        assert pod.key not in queue._retry_at
+        successor = mk_pod(name=pod.metadata.name, cpu=1.0, memory=GIB)
+        env.kube.create(successor)
+        env.kube.delete(env.kube.get("PodDisruptionBudget", "default/pdb"))
+        assert queue.evict(successor, now=now)  # no inherited backoff
+
+
+class TestFourWaveOrder:
+    def test_waves_evict_in_priority_order(self):
+        """terminator.go groupPodsByPriority: non-critical non-daemon,
+        non-critical daemon, critical non-daemon, critical daemon."""
+        from karpenter_tpu.kube.objects import OwnerReference
+
+        env = make_env()
+        plain = mk_pod(name="plain", cpu=0.5, memory=GIB)
+        daemon = mk_pod(name="daemon", cpu=0.5, memory=GIB)
+        daemon.metadata.owner_references = [
+            OwnerReference(kind="DaemonSet", name="ds", uid="u1", controller=True)
+        ]
+        crit = mk_pod(name="crit", cpu=0.5, memory=GIB)
+        crit.spec.priority_class_name = "system-cluster-critical"
+        crit_daemon = mk_pod(name="crit-daemon", cpu=0.5, memory=GIB)
+        crit_daemon.metadata.owner_references = [
+            OwnerReference(kind="DaemonSet", name="ds", uid="u1", controller=True)
+        ]
+        crit_daemon.spec.priority_class_name = "system-node-critical"
+        node = provisioned_node(env, plain, crit)
+        # place the daemons on the node directly (daemonset pods are
+        # not provisionable workload)
+        for p in (daemon, crit_daemon):
+            env.kube.create(p)
+            env.kube.bind_pod(p, node.metadata.name)
+        env.kube.delete(node)
+        now = time.time()
+        order = []
+        seen = {p.metadata.name for p in env.kube.pods_on_node(node.metadata.name)}
+        for i in range(8):
+            env.termination.reconcile(node, now=now + i)
+            still = {
+                p.metadata.name
+                for p in env.kube.pods_on_node(node.metadata.name)
+                if not p.is_terminal()
+            }
+            for name in sorted(seen - still):
+                order.append(name)
+            seen = still
+            if env.kube.get_node(node.metadata.name) is None:
+                break
+        assert env.kube.get_node(node.metadata.name) is None
+        assert order.index("plain") < order.index("daemon")
+        assert order.index("daemon") < order.index("crit")
+        assert order.index("crit") < order.index("crit-daemon")
