@@ -750,7 +750,7 @@ class OrchestrationQueue:
                 )
             node.marked_for_deletion = True
         if command.results is not None:
-            self.provisioner.create_node_claims(command.results)
+            self.provisioner.create_node_claims(command.results, now=now)
             # a plan that produced no claim (e.g. nodepool limits) means
             # replacement capacity will never come: roll back now
             if any(not p.claim_name for p in command.results.new_node_plans):

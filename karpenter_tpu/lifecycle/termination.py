@@ -88,13 +88,18 @@ class EvictionQueue:
         # wedged terminating (finalizers) still owns its name, and a
         # real ReplicaSet would not have its successor admitted under a
         # colliding identity either — the successor is OWED and created
-        # by prune() when the wedge finally clears
+        # by prune() when the wedge finally clears. The debt is durable:
+        # the wedged pod is annotated so a restarted operator rebuilds
+        # the pending set from the store (restore()).
         if pod.owner_kind() != "DaemonSet":
             if self.kube.get_pod(
                 pod.metadata.namespace, pod.metadata.name
             ) is None:
                 self.kube.create(rebirth_pod(pod))
             else:
+                if pod.metadata.annotations.get(REBIRTH_OWED_ANNOTATION) != "true":
+                    pod.metadata.annotations[REBIRTH_OWED_ANNOTATION] = "true"
+                    self.kube.touch(pod)
                 self._pending_rebirth[pod.key] = rebirth_pod(pod)
         return True
 
@@ -116,6 +121,22 @@ class EvictionQueue:
                 del self._pending_rebirth[key]
                 self.kube.create(successor)
 
+    def restore(self) -> int:
+        """Rebuild the owed-successor set from the store after a
+        restart: any pod still wedged terminating with the rebirth-owed
+        annotation re-enters _pending_rebirth (checkpoint/resume — the
+        store is the durable record). Returns how many were owed."""
+        n = 0
+        for pod in self.kube.pods():
+            if (
+                pod.is_terminating()
+                and pod.metadata.annotations.get(REBIRTH_OWED_ANNOTATION)
+                == "true"
+            ):
+                self._pending_rebirth[pod.key] = rebirth_pod(pod)
+                n += 1
+        return n
+
 
 def rebirth_pod(pod: Pod) -> Pod:
     """A controller-owned pod's successor: same spec, unbound, new uid."""
@@ -123,12 +144,14 @@ def rebirth_pod(pod: Pod) -> Pod:
 
     spec = copy.deepcopy(pod.spec)
     spec.node_name = ""
+    annotations = dict(pod.metadata.annotations)
+    annotations.pop(REBIRTH_OWED_ANNOTATION, None)
     return Pod(
         metadata=ObjectMeta(
             name=pod.metadata.name,
             namespace=pod.metadata.namespace,
             labels=dict(pod.metadata.labels),
-            annotations=dict(pod.metadata.annotations),
+            annotations=annotations,
             owner_references=list(pod.metadata.owner_references),
         ),
         spec=spec,
@@ -155,6 +178,21 @@ def _drain_waves(pods: list[Pod]) -> list[list[Pod]]:
     return [w for w in waves if w]
 
 
+REBIRTH_OWED_ANNOTATION = "karpenter.sh/rebirth-owed"
+
+
+def _stuck_past_grace(pod: Pod, now: float) -> bool:
+    """Terminating pod wedged past its grace period (nil grace = the
+    k8s default 30s): bypassed by drain AND exempt from volume waits —
+    it will die with the node, so neither it nor its volumes may hold
+    the finalizer."""
+    if not pod.is_terminating():
+        return False
+    grace = pod.spec.termination_grace_period_seconds
+    grace = 30.0 if grace is None else grace
+    return now >= (pod.metadata.deletion_timestamp or now) + grace
+
+
 def _tolerates_disrupted(pod: Pod) -> bool:
     """Pods tolerating the karpenter.sh/disrupted:NoSchedule taint are
     NOT drained (IsDrainable, utils/pod): they opted to ride the node
@@ -171,6 +209,7 @@ class TerminationController:
         self.kube = kube
         self.cluster = cluster
         self.queue = EvictionQueue(kube)
+        self.queue.restore()  # owed rebirths survive operator restarts
         self.dirty = DirtyTracker(kube).watch("Node")
         # nodes mid-termination: drain retries and volume waits emit no
         # further node events, so they stay on the every-tick path
@@ -200,7 +239,7 @@ class TerminationController:
             claim.status_conditions.set_true(COND_DRAINED, now=now)
 
         # 3. volume detachment (controller.go:223-268)
-        if not self._volumes_detached(node):
+        if not self._volumes_detached(node, now):
             if deadline is None or now < deadline:
                 return
         if claim is not None:
@@ -233,6 +272,8 @@ class TerminationController:
             if node is not None and node.metadata.deletion_timestamp is not None:
                 self._terminating.add(key)
         if not self._terminating:
+            if self.queue._pending_rebirth:
+                self.queue.prune()
             return
         for key in list(self._terminating):
             node = self.kube.get_node(key)
@@ -242,8 +283,9 @@ class TerminationController:
             self.reconcile(node, now=now)
             if self.kube.get_node(key) is None:
                 self._terminating.discard(key)
-        # eviction bookkeeping only exists while something drains
-        if self.queue.blocked or self.queue._retry_at:
+        # eviction bookkeeping only exists while something drains;
+        # owed successors must be delivered the moment the wedge clears
+        if self.queue.blocked or self.queue._retry_at or self.queue._pending_rebirth:
             self.queue.prune()
 
     # -- helpers ---------------------------------------------------------------
@@ -273,13 +315,8 @@ class TerminationController:
         for p in self.kube.pods_on_node(node.metadata.name):
             if p.is_terminal() or _tolerates_disrupted(p):
                 continue
-            if p.is_terminating():
-                # nil grace means the k8s default (30s), not zero — a
-                # zero here would bypass the pod the tick it was evicted
-                grace = p.spec.termination_grace_period_seconds
-                grace = 30.0 if grace is None else grace
-                if now >= (p.metadata.deletion_timestamp or now) + grace:
-                    continue  # stuck past grace: bypassed
+            if _stuck_past_grace(p, now):
+                continue  # wedged past grace: bypassed
             out.append(p)
         return out
 
@@ -324,12 +361,13 @@ class TerminationController:
                 self.queue.evict(pod, now=now, force=force)
         return self._blocking_pods(node, now)
 
-    def _volumes_detached(self, node: Node) -> bool:
+    def _volumes_detached(self, node: Node, now: float) -> bool:
         """Only volumes of DRAINABLE pods gate termination
         (controller.go 'should only wait for volume attachments
         associated with drainable pods'): a volume still claimed by a
-        pod riding the node down (disrupted-taint tolerator) will never
-        detach before the node dies and must not wedge the finalizer."""
+        pod that will die WITH the node — a disrupted-taint rider or a
+        wedged pod the drain bypassed past its grace — can never detach
+        first and must not wedge the finalizer."""
         attached = [
             pv for pv in self.kube.list("PersistentVolume")
             if pv.attached_node == node.metadata.name
@@ -338,7 +376,8 @@ class TerminationController:
             return True
         riders = [
             p for p in self.kube.pods_on_node(node.metadata.name)
-            if not p.is_terminal() and _tolerates_disrupted(p)
+            if not p.is_terminal()
+            and (_tolerates_disrupted(p) or _stuck_past_grace(p, now))
         ]
         from karpenter_tpu.provisioning.volume_topology import _pvc_name_for
 

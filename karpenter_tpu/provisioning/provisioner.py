@@ -223,7 +223,8 @@ class Provisioner:
 
     # -- create (provisioner.go:407-459) --------------------------------------
 
-    def create_node_claims(self, results: SchedulerResults) -> list[NodeClaim]:
+    def create_node_claims(self, results: SchedulerResults,
+                           now: Optional[float] = None) -> list[NodeClaim]:
         created = []
         # one usage snapshot per round (an O(nodes) scan under the
         # cluster lock — not per plan), advanced in-loop with each
@@ -241,6 +242,12 @@ class Provisioner:
                 usage_by_pool[pool_name] = resutil.merge(
                     usage_by_pool.get(pool_name, {}), claim.status.capacity
                 )
+            if now is not None:
+                # stamp the driving clock: liveness deadlines compare
+                # claim age against the same `now` the controllers run
+                # on, so a simulated-future round must not create
+                # claims that look 15 minutes old already
+                claim.metadata.creation_timestamp = now
             self.kube.create(claim)
             plan.claim_name = claim.metadata.name
             # sync-write into state so back-to-back solves see it
@@ -424,6 +431,6 @@ class Provisioner:
         if not self.cluster.synced():
             return SchedulerResults(new_node_plans=[], existing_assignments={})
         results = self.schedule()
-        self.create_node_claims(results)
+        self.create_node_claims(results, now=now)
         self.batcher.reset()
         return results

@@ -1,0 +1,233 @@
+"""Regression-suite depth, ported from test/suites/regression: drift
+budget families (empty / non-empty delete / replace / fully-blocking /
+scheduled-window), drift protection when replacements never
+register/initialize or PDBs are unhealthy, expiration replacing a node
+while rescheduling all pods, and runaway guards under sustained churn
+with consolidation enabled.
+"""
+
+import time
+
+from karpenter_tpu.apis.v1.labels import DISRUPTED_NO_SCHEDULE_TAINT
+from karpenter_tpu.apis.v1.nodeclaim import COND_DRIFTED
+from karpenter_tpu.apis.v1.nodepool import Budget, REASON_DRIFTED
+from karpenter_tpu.cloudprovider.fake import GIB, make_instance_type
+from karpenter_tpu.kube.objects import (
+    LabelSelector,
+    ObjectMeta,
+    PodDisruptionBudget,
+    PodDisruptionBudgetSpec,
+)
+from karpenter_tpu.testing import Environment, mk_nodepool, mk_pod
+
+
+def types():
+    return [
+        make_instance_type("c4", cpu=4, memory=16 * GIB, price=2.0),
+        make_instance_type("c8", cpu=8, memory=32 * GIB, price=4.0),
+    ]
+
+
+def make_env(budgets=None):
+    env = Environment(types=types())
+    pool = mk_nodepool("default")
+    pool.spec.disruption.consolidate_after = "0s"
+    if budgets is not None:
+        pool.spec.disruption.budgets = budgets
+    env.kube.create(pool)
+    return env
+
+
+def mark_all_drifted(env, now):
+    """Induce REAL drift: bump the pool template so the stored
+    nodepool-hash annotations no longer match — the conditions
+    controller then marks every claim Drifted itself (and keeps it
+    marked across recomputes, unlike a hand-set condition)."""
+    pool = env.kube.get_node_pool("default")
+    pool.spec.template.labels["drift-rev"] = str(now)
+    env.kube.update(pool)
+    env.conditions.reconcile_all(now=now)
+
+
+class TestDriftBudgets:
+    def _fleet(self, env, n_nodes, pods_per_node=1):
+        pods = []
+        for _ in range(n_nodes):
+            batch = [mk_pod(cpu=2.0, memory=GIB) for _ in range(pods_per_node)]
+            env.provision(*batch)
+            pods.extend(batch)
+        assert len(env.kube.nodes()) == n_nodes
+        return pods
+
+    def test_budget_paces_nonempty_drift_roll(self):
+        """'should respect budgets for non-empty replace drift': one
+        drifted node rolls per round under nodes=1."""
+        env = make_env(budgets=[Budget(nodes="1")])
+        self._fleet(env, 3)
+        now = time.time() + 60
+        mark_all_drifted(env, now)
+        command = env.reconcile_disruption(now=now)
+        assert command is not None and command.reason == REASON_DRIFTED
+        assert len(command.candidates) == 1
+        # two originals remain this round (plus any replacement)
+        drifted_left = sum(
+            1 for c in env.kube.node_claims()
+            if c.status_conditions.is_true(COND_DRIFTED)
+        )
+        assert drifted_left >= 2
+
+    def test_fully_blocking_budget_stops_drift(self):
+        """'should not allow drift if the budget is fully blocking'."""
+        env = make_env(budgets=[Budget(nodes="0")])
+        self._fleet(env, 2)
+        now = time.time() + 60
+        mark_all_drifted(env, now)
+        command = env.reconcile_disruption(now=now)
+        assert command is None
+        assert len(env.kube.nodes()) == 2
+
+    def test_scheduled_window_blocks_outside_window(self):
+        """'fully blocking during a scheduled time': a 0-node budget
+        active in a cron window pins the fleet inside that window."""
+        import datetime
+
+        now = time.time() + 60
+        hour = datetime.datetime.fromtimestamp(now, datetime.UTC).hour
+        env = make_env(budgets=[
+            Budget(nodes="0", schedule=f"* {hour} * * *", duration="1h"),
+        ])
+        self._fleet(env, 2)
+        mark_all_drifted(env, now)
+        assert env.reconcile_disruption(now=now) is None
+        # outside the window (2h later) the default budget applies
+        later = now + 2 * 3600
+        mark_all_drifted(env, later)
+        env.pod_events.reconcile_all(now=later)
+        env.conditions.reconcile_all(now=later)
+        command = env.disruption.reconcile(now=later)
+        assert command is not None
+
+    def test_empty_drifted_nodes_roll_without_replacements(self):
+        """'should respect budgets for empty drift': empty drifted
+        nodes delete (no replacement) under the budget pace."""
+        env = make_env(budgets=[Budget(nodes="1")])
+        pods = self._fleet(env, 2)
+        for pod in pods:
+            env.kube.delete(env.kube.get_pod("default", pod.metadata.name))
+        now = time.time() + 60
+        mark_all_drifted(env, now)
+        command = env.reconcile_disruption(now=now)
+        assert command is not None
+        assert command.replacement_count == 0
+        assert len(env.kube.nodes()) == 1
+
+
+class TestDriftProtection:
+    def test_drifted_node_kept_while_replacement_unregistered(self):
+        """'should not disrupt a drifted node if the replacement node
+        never registers': the candidate holds until the replacement
+        initializes; the command eventually rolls back."""
+        env = make_env()
+        pod = mk_pod(cpu=2.0, memory=GIB)
+        env.provision(pod)
+        env.cloud.registration_delay = 10_000.0
+        now = time.time() + 60
+        mark_all_drifted(env, now)
+        env.pod_events.reconcile_all(now=now)
+        env.conditions.reconcile_all(now=now)
+        command = env.disruption.reconcile(now=now)
+        assert command is not None and command.reason == REASON_DRIFTED
+        for step in range(5):
+            env.lifecycle.reconcile_all(now=now + step)
+            env.disruption.queue.reconcile(now=now + step)
+        # the drifted claim is still alive — never deleted ahead of its
+        # replacement's initialization
+        victim = command.candidates[0].state_node.node_claim
+        live = env.kube.get_node_claim(victim.metadata.name)
+        assert live is not None and live.metadata.deletion_timestamp is None
+        assert env.all_pods_bound()
+
+    def test_drift_blocked_by_unhealthy_pdb(self):
+        """'should not drift any nodes if their PodDisruptionBudgets
+        are unhealthy'."""
+        env = make_env()
+        pod = mk_pod(cpu=2.0, memory=GIB, labels={"app": "guarded"})
+        env.provision(pod)
+        env.kube.create(PodDisruptionBudget(
+            metadata=ObjectMeta(name="pdb"),
+            spec=PodDisruptionBudgetSpec(
+                selector=LabelSelector.of({"app": "guarded"}),
+                min_available=1,
+            ),
+        ))
+        now = time.time() + 60
+        mark_all_drifted(env, now)
+        command = env.reconcile_disruption(now=now)
+        assert command is None
+        assert len(env.kube.nodes()) == 1
+
+
+class TestExpirationRoll:
+    def test_expired_node_replaced_single_node_all_pods(self):
+        """'should replace expired node with a single node and schedule
+        all pods': expiry force-deletes the claim; its pods reschedule
+        together onto one replacement."""
+        env = Environment(types=types())
+        pool = mk_nodepool("default")
+        pool.spec.template.spec.expire_after = "1h"
+        env.kube.create(pool)
+        pods = [mk_pod(cpu=1.0, memory=GIB) for _ in range(3)]
+        start = time.time()
+        env.provision(*pods, now=start)
+        assert len(env.kube.nodes()) == 1
+        first_node = env.kube.nodes()[0].metadata.name
+        later = start + 3700
+        for _ in range(6):
+            env.expiration.reconcile_all(now=later)
+            env.reconcile_disruption(now=later)
+            later += 2
+        nodes = env.kube.nodes()
+        assert len(nodes) == 1
+        assert nodes[0].metadata.name != first_node
+        assert env.all_pods_bound()
+
+
+class TestRunawayGuards:
+    def test_no_runaway_with_consolidation_under_churn(self):
+        """chaos_test.go 'Runaway Scale-Up' with consolidation on:
+        sustained create/delete churn must not grow the fleet beyond
+        the workload's true demand."""
+        env = make_env()
+        now = time.time()
+        peak = 0
+        for round_i in range(6):
+            pods = [mk_pod(cpu=2.0, memory=GIB) for _ in range(4)]
+            env.provision(*pods, now=now)
+            peak = max(peak, len(env.kube.nodes()))
+            # half the workload leaves; consolidation reacts
+            for pod in pods[:2]:
+                env.kube.delete(env.kube.get_pod("default", pod.metadata.name))
+            now += 120
+            env.reconcile_disruption(now=now)
+            now += 10
+        # demand never exceeds 4 pods x 2cpu = 8cpu = 2 c4 nodes (or 1
+        # c8); churn must not accumulate capacity beyond a small factor
+        assert len(env.kube.nodes()) <= 4
+        assert peak <= 6
+
+    def test_scale_to_zero_and_back(self):
+        env = make_env()
+        pods = [mk_pod(cpu=2.0, memory=GIB) for _ in range(4)]
+        env.provision(*pods)
+        assert env.kube.nodes()
+        for pod in pods:
+            env.kube.delete(env.kube.get_pod("default", pod.metadata.name))
+        now = time.time() + 120
+        for _ in range(4):
+            env.reconcile_disruption(now=now)
+            now += 5
+        assert not env.kube.nodes()
+        # and back up
+        env.provision(mk_pod(cpu=2.0, memory=GIB), now=now)
+        assert len(env.kube.nodes()) == 1
+        assert env.all_pods_bound()

@@ -182,6 +182,73 @@ class TestDrainableVolumeFiltering:
         assert env.kube.get_node(node.metadata.name) is None
 
 
+class TestWedgedPodEdges:
+    def _wedge(self, env, **pod_kwargs):
+        pod = mk_pod(cpu=1.0, memory=GIB, **pod_kwargs)
+        pod.metadata.finalizers = ["example.com/wedged"]
+        pod.spec.termination_grace_period_seconds = 10
+        node = provisioned_node(env, pod)
+        env.kube.delete(node)
+        return pod, node
+
+    def test_wedged_pod_volume_does_not_hold_node_hostage(self):
+        """A bypassed (stuck-past-grace) pod's attached volume must be
+        exempt from the volume wait like a rider's — it dies with the
+        node and its PV can never detach first."""
+        env = make_env()
+        pod, node = self._wedge(env)
+        env.kube.create(PersistentVolume(
+            metadata=ObjectMeta(name="pv-wedge"),
+            attached_node=node.metadata.name,
+        ))
+        env.kube.create(PersistentVolumeClaim(
+            metadata=ObjectMeta(name="claim-w", namespace="default"),
+            spec=PersistentVolumeClaimSpec(volume_name="pv-wedge"),
+        ))
+        pod.spec.volumes = [PodVolume(name="data", pvc_name="claim-w")]
+        now = time.time()
+        env.termination.reconcile(node, now=now)   # evict -> wedged
+        env.termination.reconcile(node, now=now + 11)  # bypassed
+        assert env.kube.get_node(node.metadata.name) is None
+
+    def test_dirty_path_delivers_owed_successor(self):
+        """The operator's per-tick reconcile_dirty path must deliver
+        the owed successor as soon as the wedge clears — not only the
+        periodic full resync."""
+        env = make_env()
+        pod, node = self._wedge(env)
+        now = time.time()
+        env.termination.reconcile_dirty(now=now)
+        env.termination.reconcile_dirty(now=now + 11)
+        assert env.kube.get_node(node.metadata.name) is None
+        wedged = env.kube.get_pod("default", pod.metadata.name)
+        assert wedged is not None and wedged.is_terminating()
+        env.kube.remove_finalizer(wedged, "example.com/wedged")
+        env.termination.reconcile_dirty(now=now + 12)
+        successor = env.kube.get_pod("default", pod.metadata.name)
+        assert successor is not None and not successor.is_terminating()
+
+    def test_owed_successor_survives_operator_restart(self):
+        """The rebirth debt is durable: a fresh controller over the
+        same store (restart) still delivers when the wedge clears."""
+        from karpenter_tpu.lifecycle.termination import TerminationController
+
+        env = make_env()
+        pod, node = self._wedge(env)
+        now = time.time()
+        env.termination.reconcile(node, now=now)
+        env.termination.reconcile(node, now=now + 11)
+        assert env.kube.get_node(node.metadata.name) is None
+        # restart: new controller, same store
+        fresh = TerminationController(env.kube, env.cluster)
+        wedged = env.kube.get_pod("default", pod.metadata.name)
+        env.kube.remove_finalizer(wedged, "example.com/wedged")
+        fresh.reconcile_all(now=now + 12)
+        successor = env.kube.get_pod("default", pod.metadata.name)
+        assert successor is not None and not successor.is_terminating()
+        assert "karpenter.sh/rebirth-owed" not in successor.metadata.annotations
+
+
 class TestNodesWithoutClaims:
     def test_orphan_managed_node_terminates(self):
         """'should delete nodes without nodeclaims': the termination
