@@ -131,12 +131,9 @@ def main(argv=None) -> int:
         # resourceVersion conflict semantics (kube/real.py)
         from karpenter_tpu.kube.real import HTTPTransport, RealKubeClient
 
-        token = ""
-        if args.api_token_file:
-            with open(args.api_token_file) as fh:
-                token = fh.read().strip()
         kube = RealKubeClient(HTTPTransport(
-            args.api_server, token=token,
+            args.api_server,
+            token_file=args.api_token_file or None,
             ca_file=args.api_ca_file or None,
         ))
         log.info("connected to API server %s", args.api_server)

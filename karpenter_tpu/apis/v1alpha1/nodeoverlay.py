@@ -170,6 +170,10 @@ def runtime_validate(overlay: NodeOverlay) -> Optional[str]:
     for resource in spec.capacity:
         if resource in WELL_KNOWN_RESOURCES:
             return f"invalid capacity: {resource} is restricted"
+    if not 0 <= spec.weight <= 100:
+        # same bound the published CRD schema enforces at admission —
+        # simulation and cluster behavior must agree
+        return f"weight {spec.weight} out of range [0, 100]"
     if spec.price is not None and spec.price_adjustment is not None:
         return "price and priceAdjustment are mutually exclusive"
     import math

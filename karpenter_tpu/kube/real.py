@@ -61,6 +61,7 @@ RESOURCES = {
     "DaemonSet": ("/apis/apps/v1", "daemonsets", True),
     "PodDisruptionBudget": ("/apis/policy/v1", "poddisruptionbudgets", True),
     "PersistentVolumeClaim": ("/api/v1", "persistentvolumeclaims", True),
+    "Lease": ("/apis/coordination.k8s.io/v1", "leases", True),
 }
 
 # kinds the simulation store carries that have no real-cluster codec
@@ -93,11 +94,31 @@ class HTTPTransport:
     single-threaded and maps exactly onto deliver())."""
 
     def __init__(self, base_url: str, token: str = "",
-                 ca_file: Optional[str] = None, timeout: float = 30.0):
+                 ca_file: Optional[str] = None, timeout: float = 30.0,
+                 token_file: Optional[str] = None):
         self.base_url = base_url.rstrip("/")
         self.token = token
+        # bound service-account tokens expire (~1h) and the kubelet
+        # refreshes the projected file: re-read per request (mtime-
+        # cached) instead of pinning the boot-time value
+        self.token_file = token_file
+        self._token_mtime = 0.0
         self.ca_file = ca_file
         self.timeout = timeout
+
+    def _bearer(self) -> str:
+        if self.token_file:
+            import os as _os
+
+            try:
+                mtime = _os.stat(self.token_file).st_mtime
+                if mtime != self._token_mtime:
+                    with open(self.token_file) as fh:
+                        self.token = fh.read().strip()
+                    self._token_mtime = mtime
+            except OSError:
+                pass
+        return self.token
 
     def request(self, method: str, path: str, body: Optional[dict] = None,
                 params: Optional[dict] = None) -> tuple[int, dict]:
@@ -113,8 +134,9 @@ class HTTPTransport:
         req = urllib.request.Request(url, data=data, method=method)
         req.add_header("Content-Type", "application/json")
         req.add_header("Accept", "application/json")
-        if self.token:
-            req.add_header("Authorization", f"Bearer {self.token}")
+        token = self._bearer()
+        if token:
+            req.add_header("Authorization", f"Bearer {token}")
         context = None
         if self.ca_file:
             context = ssl.create_default_context(cafile=self.ca_file)

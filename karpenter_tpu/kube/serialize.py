@@ -913,6 +913,34 @@ def pvc_from_cr(cr: dict):
     )
 
 
+# ---------------------------------------------------------------- Lease
+
+
+def lease_to_cr(lease) -> dict:
+    return {
+        "apiVersion": "coordination.k8s.io/v1",
+        "kind": "Lease",
+        "metadata": meta_to_cr(lease.metadata, namespaced=True),
+        "spec": _drop_none({
+            "holderIdentity": lease.holder,
+            "leaseDurationSeconds": int(lease.lease_duration),
+            "renewTime": ts_to_rfc3339(lease.renew_time),
+        }),
+    }
+
+
+def lease_from_cr(cr: dict):
+    from karpenter_tpu.operator.leader import Lease
+
+    spec = cr.get("spec", {})
+    return Lease(
+        metadata=meta_from_cr(cr),
+        holder=spec.get("holderIdentity", ""),
+        renew_time=ts_from_rfc3339(spec.get("renewTime")) or 0.0,
+        lease_duration=float(spec.get("leaseDurationSeconds", 15)),
+    )
+
+
 # ---------------------------------------------------------------- registry
 
 TO_CR = {
@@ -924,6 +952,7 @@ TO_CR = {
     "DaemonSet": daemonset_to_cr,
     "PodDisruptionBudget": pdb_to_cr,
     "PersistentVolumeClaim": pvc_to_cr,
+    "Lease": lease_to_cr,
 }
 
 FROM_CR = {
@@ -935,6 +964,7 @@ FROM_CR = {
     "DaemonSet": daemonset_from_cr,
     "PodDisruptionBudget": pdb_from_cr,
     "PersistentVolumeClaim": pvc_from_cr,
+    "Lease": lease_from_cr,
 }
 
 
