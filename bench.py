@@ -96,32 +96,45 @@ def _persist_tpu_partial(detail: dict) -> None:
         "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "detail": detail,
     }
-    tmp = "/root/repo/BENCH_tpu_latest.json.tmp"
+    here = os.path.dirname(os.path.abspath(__file__))
+    dest = os.path.join(here, "BENCH_tpu_latest.json")
+    tmp = dest + ".tmp"
     try:
         with open(tmp, "w") as fh:
             json.dump(out, fh)
-        os.replace(tmp, "/root/repo/BENCH_tpu_latest.json")
+        os.replace(tmp, dest)
     except OSError as err:
         print(f"could not persist TPU bench result: {err}", file=sys.stderr)
 
 
 def _setup_jax_cache() -> None:
-    """Persistent compile cache keyed by backend + host CPU features so
+    """Persistent compile cache keyed by backend + machine identity so
     an artifact compiled on one machine is never loaded on another
-    (XLA:CPU AOT results are machine-feature-specific)."""
+    (XLA:CPU AOT results are machine-feature-specific; /proc/cpuinfo
+    flags alone proved insufficient — two fleet machines hashed
+    identically while their XLA target features differed, and the
+    cross-loaded artifacts triggered cpu_aot_loader feature-mismatch
+    errors + in-run recompiles)."""
     import jax
 
+    parts = []
     try:
-        flags = ""
-        with open("/proc/cpuinfo") as fh:
-            for line in fh:
-                if line.startswith("flags"):
-                    flags = line
-                    break
-        tag = hashlib.md5(flags.encode()).hexdigest()[:8]
+        with open("/etc/machine-id") as fh:
+            parts.append(fh.read().strip())
     except OSError:
-        tag = "nocpuinfo"
-    cache = f"/root/repo/.jax_cache/{jax.default_backend()}-{tag}"
+        parts.append("no-machine-id")
+    try:  # stable cpuinfo lines only (cpu MHz etc. vary per boot)
+        with open("/proc/cpuinfo") as fh:
+            parts.extend(sorted({
+                line.strip() for line in fh
+                if line.startswith(("flags", "model name"))
+            }))
+    except OSError:
+        parts.append("no-cpuinfo")
+    parts.append(jax.__version__)
+    tag = hashlib.md5("\n".join(parts).encode()).hexdigest()[:8]
+    here = os.path.dirname(os.path.abspath(__file__))
+    cache = os.path.join(here, ".jax_cache", f"{jax.default_backend()}-{tag}")
     os.makedirs(cache, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", cache)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)

@@ -142,9 +142,10 @@ def _rbac_rules() -> list[dict]:
     RESOURCES) — read everywhere, write where the controllers write."""
     return [
         {"apiGroups": ["karpenter.sh"],
-         "resources": ["nodepools", "nodepools/status",
-                       "nodeclaims", "nodeclaims/status",
-                       "nodeoverlays", "nodeoverlays/status"],
+         # no */status entries: the generated CRDs deliberately omit
+         # the status subresource (see _crd comment above), so those
+         # RBAC resources would name nothing
+         "resources": ["nodepools", "nodeclaims", "nodeoverlays"],
          "verbs": ["get", "list", "watch", "create", "update", "patch",
                    "delete"]},
         {"apiGroups": [""],

@@ -84,7 +84,16 @@ def ts_from_rfc3339(value) -> Optional[float]:
         return None
     if isinstance(value, (int, float)):
         return float(value)
-    return float(calendar.timegm(time.strptime(value, "%Y-%m-%dT%H:%M:%SZ")))
+    # metav1.MicroTime (Lease renewTime, Event eventTime) carries
+    # fractional seconds: "2026-07-30T12:00:00.123456Z".
+    base, frac = value, 0.0
+    if "." in value:
+        head, tail = value.split(".", 1)
+        digits = tail.rstrip("Zz")
+        base = head + "Z"
+        if digits:
+            frac = float("0." + digits)
+    return float(calendar.timegm(time.strptime(base, "%Y-%m-%dT%H:%M:%SZ"))) + frac
 
 
 def _resources_to_cr(resources: dict) -> dict:

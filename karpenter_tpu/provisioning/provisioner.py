@@ -300,7 +300,6 @@ class Provisioner:
             if not fitting:
                 return None
             plan.instance_types = fitting
-            names = {it.name for it in fitting}
             plan.offerings = [
                 o for o in plan.offerings
                 if any(o in it.offerings for it in fitting)

@@ -88,6 +88,22 @@ def rich_nodepool():
 
 
 class TestRoundTrips:
+    def test_timestamp_fractional_seconds(self):
+        """metav1.MicroTime (Lease renewTime on kubelet heartbeats)
+        serializes with fractional seconds; the parser must accept
+        them — a live cluster LIST would crash the adapter otherwise."""
+        from karpenter_tpu.kube.serialize import ts_from_rfc3339, ts_to_rfc3339
+
+        micro = ts_from_rfc3339("2026-07-30T12:00:00.123456Z")
+        whole = ts_from_rfc3339("2026-07-30T12:00:00Z")
+        assert micro is not None and whole is not None
+        # double eps at ~1.8e9 magnitude is ~2.4e-7; compare loosely
+        assert abs(micro - whole - 0.123456) < 1e-5
+        # milli precision and bare trailing dot are also legal
+        assert ts_from_rfc3339("2026-07-30T12:00:00.5Z") == whole + 0.5
+        # our emitter truncates to whole seconds; round-trip is stable
+        assert ts_from_rfc3339(ts_to_rfc3339(micro)) == whole
+
     def test_nodepool(self):
         pool = rich_nodepool()
         back = nodepool_from_cr(nodepool_to_cr(pool))
