@@ -12,6 +12,7 @@ ExpectReconciled pattern).
 
 from __future__ import annotations
 
+import gc
 import logging
 import time
 from dataclasses import dataclass, field
@@ -359,10 +360,23 @@ class Operator:
             self.serve_observability()
         try:
             deadline = None if stop_after is None else time.time() + stop_after
+            first_tick = True
             while deadline is None or time.time() < deadline:
                 if should_stop is not None and should_stop():
                     break
                 self.step()
+                if first_tick:
+                    first_tick = False
+                    # Long-lived-service GC hygiene, AFTER the first
+                    # tick so the synced cluster mirror and the first
+                    # solve's jitted kernels exist: move them to the
+                    # permanent generation so CPython's stop-the-world
+                    # gen-2 scans stop re-walking ~1M mirror objects
+                    # on every threshold crossing (the Go reference's
+                    # GC is concurrent, so it never pays this).
+                    # Per-reconcile garbage is still collected.
+                    gc.collect()
+                    gc.freeze()
                 time.sleep(tick_seconds)
         finally:
             if serve:
