@@ -79,7 +79,9 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def seed_demo(kube, n_pods: int) -> None:
-    from karpenter_tpu.kube.objects import Container, ObjectMeta, Pod, PodSpec
+    from karpenter_tpu.kube.objects import (
+        Container, ObjectMeta, OwnerReference, Pod, PodSpec,
+    )
     from karpenter_tpu.apis.v1.nodepool import NodePool
 
     if kube.get_node_pool("default") is None:
@@ -88,7 +90,11 @@ def seed_demo(kube, n_pods: int) -> None:
         name = f"demo-{i}"
         if kube.get_pod("default", name) is None:
             kube.create(Pod(
-                metadata=ObjectMeta(name=name),
+                metadata=ObjectMeta(name=name, owner_references=[
+                    # ReplicaSet-owned so demo drains visibly reschedule
+                    OwnerReference(kind="ReplicaSet", name="demo",
+                                   uid="uid-demo-rs", controller=True),
+                ]),
                 spec=PodSpec(containers=[
                     Container(requests={"cpu": 1.0, "memory": 2.0 * 2**30})
                 ]),

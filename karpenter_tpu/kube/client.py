@@ -61,7 +61,27 @@ class InvalidError(Exception):
     pass
 
 
+class EvictionBlockedError(Exception):
+    """The API substrate's 429: a PodDisruptionBudget blocks the
+    eviction right now (terminator/eviction.go:170-185 retries these
+    with backoff rather than falling through to delete)."""
+
+    def __init__(self, pdb: str = ""):
+        self.pdb = pdb
+        super().__init__(
+            "Cannot evict pod as it would violate the pod's disruption "
+            f"budget: {pdb}"
+        )
+
+
 class KubeClient:
+    # This store IS the simulated cluster: there is no ReplicaSet
+    # controller or kube-scheduler behind it, so controllers that
+    # emulate workload-owner behavior (eviction successor pods) are
+    # entitled to do so. Real-cluster adapters set this False — there
+    # the actual controllers own that behavior.
+    simulates_workload_controllers = True
+
     def __init__(self, async_delivery: bool = False) -> None:
         self._lock = threading.RLock()
         self._store: dict[str, dict[str, object]] = {}
@@ -204,6 +224,25 @@ class KubeClient:
             self._index_pod(obj, removed=True)
             self._notify(obj.kind, DELETED, obj)
             return None
+
+    def evict(self, pod: Pod, now: Optional[float] = None) -> None:
+        """policy/v1 Eviction analogue: the store (playing the API
+        server) enforces PDBs SERVER-side and answers the eviction.go
+        429 with EvictionBlockedError; an allowed eviction proceeds as
+        a graceful delete (finalizer semantics included). Drains must
+        call this, never delete() — on a real cluster only the
+        eviction subresource consults PDBs."""
+        from karpenter_tpu.utils.pdb import PdbLimits
+
+        # check + delete under one lock (RLock: the nested reads and
+        # the delete re-enter safely) — the real API server evaluates
+        # the budget atomically per eviction, so two racing evictions
+        # can never both pass a disruptions_allowed=1 budget
+        with self._lock:
+            blocking = PdbLimits(self).can_evict(pod)
+            if blocking is not None:
+                raise EvictionBlockedError(blocking)
+            self.delete(pod, now=now)
 
     def touch(self, obj) -> None:
         """Publish a MODIFIED event for an object mutated in place.

@@ -50,6 +50,9 @@ class OwnerReference:
     name: str
     uid: str
     controller: bool = False
+    # required on the wire: a real apiserver 422s ownerReferences
+    # missing apiVersion
+    api_version: str = "apps/v1"
 
 
 # ---------------------------------------------------------------- taints

@@ -44,6 +44,7 @@ from karpenter_tpu.kube.client import (
     DELETED,
     MODIFIED,
     ConflictError,
+    EvictionBlockedError,
     InvalidError,
     NotFoundError,
     WatchHandler,
@@ -169,6 +170,34 @@ class HTTPTransport:
         return body.get("items", [])
 
 
+class _ServerPdbView:
+    """Just enough of the KubeClient read surface for PdbLimits to run
+    INSIDE the API server (the server enforces PDBs on the eviction
+    subresource; clients never see the math, only the 429)."""
+
+    def __init__(self, server: "InMemoryApiServer"):
+        self._server = server
+
+    def pdbs(self):
+        return [
+            from_cr(cr)
+            for cr in self._server._bucket("PodDisruptionBudget").values()
+        ]
+
+    def pods(self, namespace: Optional[str] = None, selector=None):
+        out = []
+        for cr in self._server._bucket("Pod").values():
+            if namespace and cr["metadata"].get("namespace", "") != namespace:
+                continue
+            pod = from_cr(cr)
+            if selector is not None and not selector.matches(
+                pod.metadata.labels
+            ):
+                continue
+            out.append(pod)
+        return out
+
+
 class InMemoryApiServer:
     """Server-side semantics of a real API server over CR dicts: RV
     counters, conflict checks, finalizer-aware deletion, a watch event
@@ -192,6 +221,8 @@ class InMemoryApiServer:
         with self._lock:
             if subresource == "binding" and method == "POST":
                 return self._bind(kind, namespace, name, body or {})
+            if subresource == "eviction" and method == "POST":
+                return self._evict(kind, namespace, name)
             if method == "GET" and not name:
                 items = list(self._bucket(kind).values())
                 if namespace:
@@ -344,6 +375,32 @@ class InMemoryApiServer:
         self._emit(kind, DELETED, cr)
         return 200, json.loads(json.dumps(cr))
 
+    def _evict(self, kind: str, namespace: str,
+               name: str) -> tuple[int, dict]:
+        """policy/v1 Eviction subresource: PDBs are consulted SERVER-
+        side (what the real API server does; eviction.go:170-185 is
+        the client reacting to this 429). Allowed evictions proceed as
+        graceful deletes, finalizer semantics included."""
+        if kind != "Pod":
+            return 404, {"message": "eviction is a pod subresource"}
+        key = self._key(kind, namespace, name)
+        cr = self._bucket(kind).get(key)
+        if cr is None:
+            return 404, {"message": "not found"}
+        from karpenter_tpu.utils.pdb import PdbLimits
+
+        blocking = PdbLimits(_ServerPdbView(self)).can_evict(from_cr(cr))
+        if blocking is not None:
+            # one source of truth for the denial text (the client's
+            # exception renders it identically)
+            return 429, {
+                "message": str(EvictionBlockedError(blocking)),
+                "reason": "TooManyRequests",
+                "details": {"causes": [{"reason": "DisruptionBudget",
+                                        "message": blocking}]},
+            }
+        return self._delete(kind, namespace, name)
+
     def _bind(self, kind: str, namespace: str, name: str,
               binding: dict) -> tuple[int, dict]:
         if kind != "Pod":
@@ -363,6 +420,11 @@ class InMemoryApiServer:
 
 class RealKubeClient:
     """KubeClient surface over a Transport (see module docstring)."""
+
+    # A real cluster HAS workload controllers (ReplicaSets recreate
+    # evicted replicas; kube-scheduler binds them): controllers must
+    # never fabricate pods here — see EvictionQueue.
+    simulates_workload_controllers = False
 
     def __init__(self, transport, kinds: Optional[Iterable[str]] = None):
         self.transport = transport
@@ -601,6 +663,56 @@ class RealKubeClient:
         except NotFoundError:
             with self._lock:
                 self._mirror[obj.kind].pop(obj.key, None)
+
+    def evict(self, pod, now: Optional[float] = None):
+        """Drain through the policy/v1 Eviction subresource so the API
+        SERVER enforces PDBs (terminator/eviction.go:170-185): 429 maps
+        to EvictionBlockedError for the caller's backoff queue; an
+        already-gone pod is success."""
+        path = _path("Pod", pod.metadata.name, pod.metadata.namespace)
+        status, body = self.transport.request("POST", path + "/eviction", {
+            "apiVersion": "policy/v1",
+            "kind": "Eviction",
+            "metadata": {"name": pod.metadata.name,
+                         "namespace": pod.metadata.namespace},
+        })
+        if status == 404:
+            with self._lock:
+                self._mirror["Pod"].pop(pod.key, None)
+                self._index_pod(pod, removed=True)
+            return None
+        if status == 429:
+            causes = (body.get("details") or {}).get("causes") or [{}]
+            raise EvictionBlockedError(causes[0].get("message", ""))
+        if status >= 400:
+            raise ApiError(status, body.get("message", ""))
+        # A REAL apiserver answers eviction with a Status object, not
+        # the pod; the in-memory one returns the pod CR. When the body
+        # carries no deletionTimestamp, GET the pod to learn whether it
+        # is terminating (grace period / finalizers) or already gone.
+        if not (body and body.get("metadata", {}).get("deletionTimestamp")):
+            st, got = self.transport.request("GET", path)
+            body = got if st == 200 else {}
+        # mirror bookkeeping identical to delete(): either the pod is
+        # wedged terminating behind a finalizer or it is gone
+        if body and body.get("metadata", {}).get("deletionTimestamp"):
+            from karpenter_tpu.kube.serialize import ts_from_rfc3339
+
+            pod.metadata.deletion_timestamp = (
+                now if now is not None else ts_from_rfc3339(
+                    body["metadata"]["deletionTimestamp"]
+                )
+            )
+            pod.metadata.resource_version = int(
+                body["metadata"].get("resourceVersion", "0") or 0
+            )
+            self._announce("Pod", MODIFIED, pod)
+            return pod
+        with self._lock:
+            self._mirror["Pod"].pop(pod.key, None)
+            self._index_pod(pod, removed=True)
+        self._announce("Pod", DELETED, pod)
+        return None
 
     def delete(self, obj_or_kind, key: Optional[str] = None,
                now: Optional[float] = None):

@@ -52,6 +52,7 @@ from karpenter_tpu.kube.objects import (
     NodeSpec,
     NodeStatus,
     ObjectMeta,
+    OwnerReference,
     Pod,
     PodAffinity,
     PodAffinityTerm,
@@ -123,6 +124,17 @@ def meta_to_cr(meta: ObjectMeta, namespaced: bool = False) -> dict:
         # resourceVersion is an opaque STRING on the wire
         "resourceVersion": str(meta.resource_version),
         "generation": meta.generation,
+        # controller ownership drives drain semantics (DaemonSet
+        # detection, rebirth gating) — losing it on the wire would
+        # make every real-cluster pod look bare
+        "ownerReferences": [
+            _drop_none({
+                "apiVersion": ref.api_version,
+                "kind": ref.kind, "name": ref.name, "uid": ref.uid,
+                "controller": ref.controller or None,
+            })
+            for ref in meta.owner_references
+        ] or None,
     }
     if namespaced:
         out["namespace"] = meta.namespace
@@ -143,6 +155,15 @@ def meta_from_cr(cr: dict) -> ObjectMeta:
         deletion_timestamp=ts_from_rfc3339(meta.get("deletionTimestamp")),
         resource_version=int(meta.get("resourceVersion", "0") or 0),
         generation=int(meta.get("generation", 0)),
+        owner_references=[
+            OwnerReference(
+                kind=ref.get("kind", ""), name=ref.get("name", ""),
+                uid=ref.get("uid", ""),
+                controller=bool(ref.get("controller", False)),
+                api_version=ref.get("apiVersion", "apps/v1"),
+            )
+            for ref in meta.get("ownerReferences", [])
+        ],
     )
 
 

@@ -23,9 +23,9 @@ from karpenter_tpu.apis.v1.labels import (
     TERMINATION_FINALIZER,
 )
 from karpenter_tpu.apis.v1.nodeclaim import COND_DRAINED, COND_VOLUMES_DETACHED
-from karpenter_tpu.kube.client import KubeClient
+from karpenter_tpu.kube.client import EvictionBlockedError, KubeClient
 from karpenter_tpu.kube.objects import Node, ObjectMeta, Pod
-from karpenter_tpu.utils.pdb import PdbLimits
+
 
 log = logging.getLogger("karpenter.termination")
 
@@ -42,16 +42,17 @@ EVICT_BACKOFF_MAX_SECONDS = 10.0
 class EvictionQueue:
     """Per-pod eviction with PDB 429 backoff (terminator/eviction.go).
 
-    A PDB rejection is the API substrate's 429: the pod is recorded
-    with an exponential next-retry time and skipped until it elapses,
-    mirroring the reference's rate-limited eviction workqueue.
+    Drain goes through the substrate's eviction subresource, so PDBs
+    are enforced server-side; a 429 (EvictionBlockedError) records the
+    pod with an exponential next-retry time and skips it until that
+    elapses, mirroring the reference's rate-limited eviction workqueue.
 
-    Eviction deletes the pod and — because this framework carries its
-    own API substrate with no ReplicaSet controller or kube-scheduler
-    behind it — resurrects non-daemon workload pods as fresh pending
-    pods, which is what a controller-owned pod does in a real cluster.
-    The provisioner then reschedules them (typically onto replacement
-    capacity the orchestration queue already launched).
+    On the SIMULATION substrate only (no ReplicaSet controller or
+    kube-scheduler behind the store), the queue additionally plays
+    workload-owner: controller-owned non-daemon pods are resurrected
+    as fresh pending pods, which the provisioner reschedules
+    (typically onto replacement capacity the orchestration queue
+    already launched). See _maybe_rebirth for the gating.
     """
 
     def __init__(self, kube: KubeClient):
@@ -69,10 +70,13 @@ class EvictionQueue:
         if not force:
             if now < self._retry_at.get(pod.key, 0.0):
                 return False  # still backing off from the last 429
-            limits = PdbLimits(self.kube)
-            blocking = limits.can_evict(pod)
-            if blocking is not None:
-                self.blocked[pod.key] = blocking
+            try:
+                # the eviction subresource: PDBs are enforced by the
+                # API substrate, never re-checked client-side
+                # (eviction.go:170-185)
+                self.kube.evict(pod, now=now)
+            except EvictionBlockedError as err:
+                self.blocked[pod.key] = err.pdb
                 n = self._attempts.get(pod.key, 0)
                 self._attempts[pod.key] = n + 1
                 # exponent capped: the backoff saturates at the max
@@ -82,26 +86,44 @@ class EvictionQueue:
                     EVICT_BACKOFF_BASE_SECONDS * 2 ** min(n, 7),
                 )
                 return False
+        else:
+            # terminal bypass (stuck pods / past the grace deadline):
+            # a direct delete, exactly the reference's forced path
+            self.kube.delete(pod, now=now)
         self._forget(pod.key)
-        self.kube.delete(pod, now=now)
-        # rebirth only once the old pod actually left the store: a pod
-        # wedged terminating (finalizers) still owns its name, and a
-        # real ReplicaSet would not have its successor admitted under a
-        # colliding identity either — the successor is OWED and created
-        # by prune() when the wedge finally clears. The debt is durable:
-        # the wedged pod is annotated so a restarted operator rebuilds
-        # the pending set from the store (restore()).
-        if pod.owner_kind() != "DaemonSet":
-            if self.kube.get_pod(
-                pod.metadata.namespace, pod.metadata.name
-            ) is None:
-                self.kube.create(rebirth_pod(pod))
-            else:
-                if pod.metadata.annotations.get(REBIRTH_OWED_ANNOTATION) != "true":
-                    pod.metadata.annotations[REBIRTH_OWED_ANNOTATION] = "true"
-                    self.kube.touch(pod)
-                self._pending_rebirth[pod.key] = rebirth_pod(pod)
+        self._maybe_rebirth(pod)
         return True
+
+    def _maybe_rebirth(self, pod: Pod) -> None:
+        """Successor fabrication, STRICTLY gated to the simulation
+        substrate: the in-memory store has no ReplicaSet controller or
+        kube-scheduler behind it, so the queue plays workload-owner
+        for controller-owned pods. On a real cluster
+        (simulates_workload_controllers=False) the actual workload
+        controller recreates replicas — creating pods there would
+        duplicate them. Bare (ownerless) pods are never recreated:
+        evicting one is terminal in a real cluster too.
+
+        Rebirth waits until the old pod actually left the store: a pod
+        wedged terminating (finalizers) still owns its name, and a
+        real ReplicaSet would not have its successor admitted under a
+        colliding identity either — the successor is OWED and created
+        by prune() when the wedge finally clears. The debt is durable:
+        the wedged pod is annotated so a restarted operator rebuilds
+        the pending set from the store (restore())."""
+        if not getattr(self.kube, "simulates_workload_controllers", False):
+            return
+        if pod.owner_kind() in ("", "DaemonSet", "Node"):
+            return
+        if self.kube.get_pod(
+            pod.metadata.namespace, pod.metadata.name
+        ) is None:
+            self.kube.create(rebirth_pod(pod))
+        else:
+            if pod.metadata.annotations.get(REBIRTH_OWED_ANNOTATION) != "true":
+                pod.metadata.annotations[REBIRTH_OWED_ANNOTATION] = "true"
+                self.kube.touch(pod)
+            self._pending_rebirth[pod.key] = rebirth_pod(pod)
 
     def _forget(self, pod_key: str) -> None:
         self.blocked.pop(pod_key, None)
@@ -127,6 +149,8 @@ class EvictionQueue:
         annotation re-enters _pending_rebirth (checkpoint/resume — the
         store is the durable record). Returns how many were owed."""
         n = 0
+        if not getattr(self.kube, "simulates_workload_controllers", False):
+            return 0  # real cluster: never fabricate pods (see above)
         for pod in self.kube.pods():
             if (
                 pod.is_terminating()

@@ -20,6 +20,7 @@ from karpenter_tpu.kube.client import KubeClient
 from karpenter_tpu.kube.objects import (
     Container,
     ObjectMeta,
+    OwnerReference,
     Pod,
     PodSpec,
 )
@@ -44,11 +45,21 @@ def mk_pod(
     memory: float = 2**30,
     labels: Optional[dict] = None,
     node_selector: Optional[dict] = None,
+    owner: Optional[str] = "ReplicaSet",
     **spec_kwargs,
 ) -> Pod:
+    """`owner` is the controlling workload kind (the reference's
+    test.Pod defaults to ReplicaSet-owned too — drain rebirth only
+    applies to controller-owned pods); pass owner=None for a bare pod,
+    which eviction terminates for good."""
+    name = name or f"pod-{next(_name_counter):05d}"
+    refs = []
+    if owner:
+        refs = [OwnerReference(kind=owner, name=f"{name}-owner",
+                               uid=f"uid-{name}-owner", controller=True)]
     return Pod(
         metadata=ObjectMeta(
-            name=name or f"pod-{next(_name_counter):05d}", labels=labels or {}
+            name=name, labels=labels or {}, owner_references=refs
         ),
         spec=PodSpec(
             containers=[Container(requests={"cpu": cpu, "memory": memory})],

@@ -545,3 +545,97 @@ class TestOperatorOnRealClient:
             later += 1
         assert len(kube.nodes()) == 0
         assert len(kube.node_claims()) == 0
+
+
+class TestEvictionSubresource:
+    """The policy/v1 Eviction path: the SERVER enforces PDBs and
+    answers 429 (eviction.go:170-185); the adapter maps it to
+    EvictionBlockedError; and nothing on the real path ever creates
+    pods — workload controllers own that on a live cluster."""
+
+    def _guarded(self, server):
+        from karpenter_tpu.kube.objects import (
+            PodDisruptionBudget, PodDisruptionBudgetSpec,
+        )
+
+        kube = RealKubeClient(server)
+        pod = mk_pod(name="guarded", cpu=0.5, labels={"app": "web"})
+        pod.spec.node_name = "n-1"
+        kube.create(pod)
+        kube.create(PodDisruptionBudget(
+            metadata=ObjectMeta(name="pdb"),
+            spec=PodDisruptionBudgetSpec(
+                selector=LabelSelector.of({"app": "web"}),
+                max_unavailable=0,
+            ),
+        ))
+        return kube, pod
+
+    def test_server_side_429(self):
+        from karpenter_tpu.kube.client import EvictionBlockedError
+        from karpenter_tpu.kube.real import _path
+
+        server = InMemoryApiServer()
+        kube, pod = self._guarded(server)
+        # raw subresource POST: the server itself answers 429
+        status, body = server.request(
+            "POST", _path("Pod", "guarded", "default") + "/eviction",
+            {"apiVersion": "policy/v1", "kind": "Eviction"},
+        )
+        assert status == 429
+        assert "disruption budget" in body["message"]
+        # adapter mapping
+        with pytest.raises(EvictionBlockedError):
+            kube.evict(pod)
+        assert kube.get_pod("default", "guarded") is not None
+
+    def test_evict_proceeds_without_pdb_block(self):
+        server = InMemoryApiServer()
+        kube, pod = self._guarded(server)
+        kube.delete(kube.pdbs()[0])
+        assert kube.evict(pod) is None
+        assert kube.get_pod("default", "guarded") is None
+        # server agrees
+        status, _ = server.request(
+            "GET", "/api/v1/namespaces/default/pods/guarded"
+        )
+        assert status == 404
+
+    def test_real_drain_never_creates_pods(self):
+        """Operator e2e over RealKubeClient: a drained node's evicted
+        pods are NOT resurrected (the real cluster's ReplicaSet would
+        do that) — zero karpenter-created pods, ever."""
+        import time as _time
+
+        from karpenter_tpu.cloudprovider.fake import GIB, make_instance_type
+        from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
+        from karpenter_tpu.operator.operator import Operator
+
+        server = InMemoryApiServer()
+        kube = RealKubeClient(server)
+        assert kube.simulates_workload_controllers is False
+        cloud = KwokCloudProvider(kube, types=[
+            make_instance_type("c8", cpu=8, memory=32 * GIB),
+        ])
+        operator = Operator(kube=kube, cloud_provider=cloud)
+        user = RealKubeClient(server)
+        user.create(mk_nodepool("default"))
+        for i in range(3):
+            user.create(mk_pod(name=f"w-{i}", cpu=1.0))
+        now = _time.time()
+        for i in range(6):
+            operator.step(now=now + 2.0 * i)
+        assert len(kube.nodes()) == 1
+        created_by_user = {"w-0", "w-1", "w-2"}
+        # drain: delete the claim; every pod eviction goes through the
+        # subresource; NO successor pods are fabricated
+        claim = kube.node_claims()[0]
+        kube.delete(claim, now=now + 60)
+        later = now + 61
+        for _ in range(12):
+            operator.step(now=later)
+            later += 11
+        assert len(kube.nodes()) == 0
+        names = {p.metadata.name for p in kube.pods()}
+        assert names <= created_by_user  # nothing fabricated
+        assert names == set()  # and evictions were terminal here

@@ -98,3 +98,58 @@ class TestTermination:
         # after the grace period the pod is force-deleted
         env.reconcile_termination(now=now + 3601)
         assert not env.kube.nodes()
+
+
+class TestEvictionApiSemantics:
+    """Drain rides the eviction subresource (terminator/eviction.go):
+    PDBs are enforced by the API substrate, and successor fabrication
+    is gated to the simulation store + controller-owned pods."""
+
+    def test_store_evict_blocked_raises(self):
+        from karpenter_tpu.kube.client import EvictionBlockedError, KubeClient
+
+        import pytest
+
+        kube = KubeClient()
+        pod = mk_pod(name="guarded", cpu=0.5, labels={"app": "web"})
+        pod.spec.node_name = "n-1"
+        kube.create(pod)
+        kube.create(PodDisruptionBudget(
+            metadata=ObjectMeta(name="pdb"),
+            spec=PodDisruptionBudgetSpec(
+                selector=LabelSelector.of({"app": "web"}), max_unavailable=0
+            ),
+        ))
+        with pytest.raises(EvictionBlockedError) as err:
+            kube.evict(pod)
+        assert err.value.pdb == "default/pdb"
+        assert kube.get_pod("default", "guarded") is not None
+        # PDB gone: the same eviction proceeds as a graceful delete
+        kube.delete(kube.pdbs()[0])
+        kube.evict(pod)
+        assert kube.get_pod("default", "guarded") is None
+
+    def test_owned_pod_reborn_in_sim(self):
+        env, pods = provisioned_env(n_pods=2)
+        before = {p.metadata.name for p in env.kube.pods()}
+        claim = env.kube.node_claims()[0]
+        env.kube.delete(claim)
+        env.reconcile_termination()
+        # ReplicaSet-owned pods (mk_pod default) come back pending:
+        # the sim store plays the workload controller
+        after = {p.metadata.name for p in env.kube.pods()}
+        assert after == before
+        assert all(not p.spec.node_name for p in env.kube.pods())
+
+    def test_bare_pod_not_reborn(self):
+        env = Environment(types=one_type())
+        env.kube.create(mk_nodepool("default"))
+        env.provision(mk_pod(name="bare", cpu=0.5, owner=None),
+                      mk_pod(name="owned", cpu=0.5))
+        claim = env.kube.node_claims()[0]
+        env.kube.delete(claim)
+        env.reconcile_termination()
+        names = {p.metadata.name for p in env.kube.pods()}
+        # evicting a bare pod is terminal — real clusters don't
+        # resurrect it either; the owned one is reborn pending
+        assert names == {"owned"}
