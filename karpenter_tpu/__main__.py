@@ -190,6 +190,8 @@ def main(argv=None) -> int:
         if args.state_file and hasattr(kube, "save"):
             kube.save(args.state_file)
             log.info("state saved to %s", args.state_file)
+        if hasattr(kube, "close"):
+            kube.close()  # tear down watch-stream readers
     nodes = len(kube.nodes())
     bound = sum(1 for p in kube.pods() if p.spec.node_name)
     log.info("shutdown: %d nodes, %d bound pods", nodes, bound)

@@ -87,16 +87,156 @@ class ApiError(Exception):
         super().__init__(f"HTTP {status}: {message}")
 
 
+class _KindWatch:
+    """One kind's long-lived watch stream: a daemon thread holds the
+    chunked `watch=true` response open, parses line-delimited watch
+    events, and queues (event, object-CR, rv) tuples for the pump.
+    Reconnects from the last seen rv when the server closes the
+    stream (timeoutSeconds); BOOKMARK events advance rv without
+    queueing; an ERROR/410 marks the stream `gone` for re-list."""
+
+    def __init__(self, transport: "HTTPTransport", kind: str, since_rv: int):
+        self.transport = transport
+        self.kind = kind
+        self.rv = since_rv
+        self._queue: list[tuple[str, dict, int]] = []
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self.gone = False
+        self.dead = False
+        self._resp = None
+        self._thread = threading.Thread(
+            target=self._run, name=f"watch-{kind}", daemon=True
+        )
+        self._thread.start()
+
+    def drain(self) -> list[tuple[str, dict, int]]:
+        with self._lock:
+            out, self._queue = self._queue, []
+        return out
+
+    def stop(self) -> None:
+        self._stop.set()
+        resp = self._resp
+        if resp is not None:
+            # close() alone does NOT interrupt a readline blocked in
+            # recv(); shutting the socket down does, immediately
+            try:
+                import socket as _socket
+
+                sock = getattr(getattr(resp, "fp", None), "raw", None)
+                sock = getattr(sock, "_sock", None)
+                if sock is not None:
+                    sock.shutdown(_socket.SHUT_RDWR)
+            except Exception:
+                pass
+            try:
+                resp.close()
+            except Exception:
+                pass
+        self._thread.join(timeout=2.0)
+
+    # -- reader thread ---------------------------------------------------
+
+    def _run(self) -> None:
+        import urllib.error
+
+        backoff = 0.2
+        while not self._stop.is_set():
+            try:
+                self._read_stream()
+                if self.gone:
+                    break  # in-band ERROR/410: caller must re-list
+                backoff = 0.2  # clean server-side timeout; reconnect
+            except urllib.error.HTTPError as err:
+                if err.code == 410:
+                    self.gone = True
+                    break
+                self._stop.wait(backoff)
+                backoff = min(10.0, backoff * 2)
+            except Exception:
+                if self._stop.is_set():
+                    break
+                self._stop.wait(backoff)
+                backoff = min(10.0, backoff * 2)
+        self.dead = True
+
+    def _read_stream(self) -> None:
+        import ssl
+        import urllib.parse
+        import urllib.request
+
+        params = {
+            "watch": "true",
+            "resourceVersion": str(self.rv),
+            "allowWatchBookmarks": "true",
+            # never 0: sub-second configs would truncate to "expire
+            # immediately" and tight-loop reconnects
+            "timeoutSeconds": str(max(
+                1, int(self.transport.watch_timeout_seconds)
+            )),
+        }
+        url = (self.transport.base_url + _path(self.kind)
+               + "?" + urllib.parse.urlencode(params))
+        req = urllib.request.Request(url, method="GET")
+        req.add_header("Accept", "application/json")
+        token = self.transport._bearer()
+        if token:
+            req.add_header("Authorization", f"Bearer {token}")
+        context = None
+        if self.transport.ca_file:
+            context = ssl.create_default_context(cafile=self.transport.ca_file)
+        # read timeout must outlast server-side quiet periods between
+        # bookmarks; a timeout just forces a clean reconnect
+        with urllib.request.urlopen(
+            req, timeout=self.transport.watch_timeout_seconds + 30.0,
+            context=context,
+        ) as resp:
+            self._resp = resp
+            try:
+                for raw in resp:
+                    if self._stop.is_set():
+                        return
+                    line = raw.strip()
+                    if not line:
+                        continue
+                    self._handle(json.loads(line))
+                    if self.gone:
+                        return
+            finally:
+                self._resp = None
+
+    def _handle(self, event: dict) -> None:
+        etype = event.get("type", "")
+        obj = event.get("object", {}) or {}
+        if etype == "ERROR":
+            if obj.get("code") == 410:
+                self.gone = True
+            return
+        rv = int(obj.get("metadata", {}).get("resourceVersion", "0") or 0)
+        if rv:
+            self.rv = max(self.rv, rv)
+        if etype == "BOOKMARK":
+            return
+        with self._lock:
+            self._queue.append((etype, obj, rv))
+
+
 class HTTPTransport:
     """Kubernetes REST over stdlib urllib (kubeconfig-lite: host +
-    bearer token). Watch uses the incremental `resourceVersion` poll
-    form of the protocol (`watch=true&timeoutSeconds=0` chunked
-    streams need a background reader; the poll form keeps the client
-    single-threaded and maps exactly onto deliver())."""
+    bearer token). Watch is the real protocol: one background reader
+    per kind holds a `watch=true&allowWatchBookmarks=true` chunked
+    stream open (operator.go:157-201's informer machinery), queueing
+    events that `watch_events()` drains on each deliver() pump; a
+    410 Gone surfaces as ApiError(410) so the client re-lists. The
+    old LIST-diff snapshot poll remains available as an explicit
+    fallback (`snapshot_watch=True`) for API servers without watch."""
 
     def __init__(self, base_url: str, token: str = "",
                  ca_file: Optional[str] = None, timeout: float = 30.0,
-                 token_file: Optional[str] = None):
+                 token_file: Optional[str] = None,
+                 snapshot_watch: bool = False,
+                 watch_timeout_seconds: float = 290.0):
         self.base_url = base_url.rstrip("/")
         self.token = token
         # bound service-account tokens expire (~1h) and the kubelet
@@ -106,6 +246,11 @@ class HTTPTransport:
         self._token_mtime = 0.0
         self.ca_file = ca_file
         self.timeout = timeout
+        self.snapshot_watch = snapshot_watch
+        self.watch_timeout_seconds = watch_timeout_seconds
+        self._streams: dict[str, _KindWatch] = {}
+        self._gone_pending: set[str] = set()  # kinds owing a 410
+        self._streams_lock = threading.Lock()
 
     def _bearer(self) -> str:
         if self.token_file:
@@ -155,19 +300,51 @@ class HTTPTransport:
                 detail = {"message": payload.decode(errors="replace")}
             return err.code, detail
 
-    # LIST-diff watch: the client diffs snapshots (and synthesizes
-    # DELETED for vanished keys). A full LIST per kind per pump is
-    # O(cluster) apiserver load, so RealKubeClient throttles pumps on
-    # snapshot transports (snapshot_poll_seconds); a streaming
-    # `watch=true` reader per kind is the upgrade path.
-    snapshot_watch = True
+    # LIST-diff fallback (snapshot_watch=True): the client re-lists
+    # every kind per pump and diffs against its mirror. O(cluster)
+    # apiserver load, so RealKubeClient throttles these pumps
+    # (snapshot_poll_seconds); streaming is the default.
     snapshot_poll_seconds = 5.0
 
-    def list_snapshot(self, kind: str) -> list[dict]:
-        status, body = self.request("GET", _path(kind))
-        if status != 200:
-            raise ApiError(status, str(body))
-        return body.get("items", [])
+    def watch_events(self, kind: str, since_rv: int) -> list:
+        """Drain the kind's background stream (starting it on first
+        use at `since_rv`). Raises ApiError(410) when the server
+        declared the resourceVersion too old — the caller re-lists
+        and the next call restarts the stream from the fresh rv."""
+        with self._streams_lock:
+            if kind in self._gone_pending:
+                # consume the deferred 410 exactly once; the NEXT call
+                # (post-re-list) starts a fresh stream
+                self._gone_pending.discard(kind)
+                raise ApiError(410, f"watch of {kind} too old")
+            stream = self._streams.get(kind)
+            if stream is None or stream.dead:
+                if stream is not None and stream.gone:
+                    self._streams.pop(kind, None)
+                    stream.stop()
+                    raise ApiError(410, f"watch of {kind} too old")
+                stream = _KindWatch(self, kind, since_rv)
+                self._streams[kind] = stream
+        events = stream.drain()
+        if stream.gone:
+            with self._streams_lock:
+                self._streams.pop(kind, None)
+            stream.stop()
+            if events:
+                # deliver what arrived; the 410 stays PENDING so the
+                # next pump re-lists instead of spinning up another
+                # stream at a still-compacted rv
+                with self._streams_lock:
+                    self._gone_pending.add(kind)
+                return events
+            raise ApiError(410, f"watch of {kind} too old")
+        return events
+
+    def close(self) -> None:
+        with self._streams_lock:
+            streams, self._streams = dict(self._streams), {}
+        for stream in streams.values():
+            stream.stop()
 
 
 class _ServerPdbView:
@@ -210,6 +387,9 @@ class InMemoryApiServer:
         self._store: dict[str, dict[str, dict]] = {}
         self._rv = 0
         self._events: list[tuple[str, str, dict, int]] = []  # kind, ev, cr, rv
+        # rv horizon: events at or below this were compacted away; a
+        # watch resuming from below it gets 410 Gone (etcd compaction)
+        self._compacted_rv = 0
 
     # -- request API (the Transport protocol) ---------------------------
 
@@ -247,11 +427,26 @@ class InMemoryApiServer:
 
     def watch_events(self, kind: str, since_rv: int) -> list[tuple[str, dict, int]]:
         with self._lock:
+            if since_rv < self._compacted_rv:
+                raise ApiError(
+                    410, f"resourceVersion {since_rv} is too old "
+                         f"(compacted through {self._compacted_rv})"
+                )
             return [
                 (ev, json.loads(json.dumps(cr)), rv)
                 for k, ev, cr, rv in self._events
                 if k == kind and rv > since_rv
             ]
+
+    def compact(self, keep: int = 0) -> None:
+        """Discard the event log except the last `keep` entries (etcd
+        compaction analogue — watchers resuming from before the new
+        horizon get 410 Gone and must re-list)."""
+        with self._lock:
+            cut = len(self._events) - keep
+            if cut > 0:
+                self._compacted_rv = self._events[cut - 1][3]
+                del self._events[:cut]
 
     # -- internals -------------------------------------------------------
 
@@ -304,7 +499,7 @@ class InMemoryApiServer:
     def _emit(self, kind: str, event: str, cr: dict) -> None:
         self._events.append((kind, event, json.loads(json.dumps(cr)), self._rv))
         if len(self._events) > 100_000:
-            del self._events[:50_000]
+            self.compact(keep=50_000)
 
     def _create(self, kind: str, cr: dict) -> tuple[int, dict]:
         meta = cr.setdefault("metadata", {})
@@ -503,28 +698,19 @@ class RealKubeClient:
                 return
             self._last_pump = now
             for kind in self.kinds:
-                try:
-                    items = self.transport.list_snapshot(kind)
-                except ApiError:
-                    continue
-                live_keys = set()
-                for item in items:
-                    rv = int(item["metadata"].get("resourceVersion", "0") or 0)
-                    obj = self._from_item(kind, item)
-                    live_keys.add(obj.key)
-                    self._apply(kind, obj, rv)
-                with self._lock:
-                    for key in set(self._mirror[kind]) - live_keys:
-                        gone = self._mirror[kind].pop(key)
-                        self._index_pod(gone, removed=True)
-                        self._pending_events.append((kind, DELETED, gone))
+                self._relist(kind)  # snapshot pump IS a relist per kind
             return
         for kind in self.kinds:
             try:
                 events = self.transport.watch_events(
                     kind, self._last_rv[kind]
                 )
-            except ApiError:
+            except ApiError as err:
+                if err.status == 410:
+                    # watch fell off the server's event horizon:
+                    # re-LIST and diff (informer relist), then the
+                    # next pump restarts the stream at the fresh rv
+                    self._relist(kind)
                 continue
             for event, cr, rv in events:
                 with self._lock:
@@ -546,6 +732,35 @@ class RealKubeClient:
                     continue
                 self._apply(kind, self._from_item(kind, cr), rv, event)
 
+    def _relist(self, kind: str) -> None:
+        """Full LIST + mirror diff for one kind (the informer's
+        reaction to 410 Gone), synthesizing DELETED for keys that
+        vanished while the watch was stale."""
+        status, body = self.transport.request("GET", _path(kind))
+        if status != 200:
+            return  # transient; the next pump retries
+        live_keys = set()
+        for item in body.get("items", []):
+            rv = int(item["metadata"].get("resourceVersion", "0") or 0)
+            obj = self._from_item(kind, item)
+            live_keys.add(obj.key)
+            self._apply(kind, obj, rv)
+        with self._lock:
+            for key in set(self._mirror[kind]) - live_keys:
+                gone = self._mirror[kind].pop(key)
+                self._index_pod(gone, removed=True)
+                self._pending_events.append((kind, DELETED, gone))
+            list_rv = int(
+                body.get("metadata", {}).get("resourceVersion", "0") or 0
+            )
+            self._last_rv[kind] = max(self._last_rv[kind], list_rv)
+
+    def close(self) -> None:
+        """Tear down transport-side watch machinery (stream threads)."""
+        close = getattr(self.transport, "close", None)
+        if close is not None:
+            close()
+
     def _apply(self, kind: str, obj, rv: int, event: str = MODIFIED) -> None:
         """Merge one fresh object into the mirror, preserving the
         identity of the canonical instance controllers hold."""
@@ -556,13 +771,14 @@ class RealKubeClient:
             if current is not None:
                 # refresh the CANONICAL instance in place so controller
                 # references stay valid (informer cache replace, minus
-                # the identity break)
+                # the identity break). Not every kind is spec/status
+                # shaped (Lease carries holder/renew fields), so copy
+                # whatever data attributes the fresh object has.
                 current.metadata = obj.metadata
-                current.spec = obj.spec
-                if hasattr(obj, "status"):
-                    current.status = obj.status
-                if hasattr(obj, "status_conditions"):
-                    current.status_conditions = obj.status_conditions
+                for attr in ("spec", "status", "status_conditions",
+                             "holder", "renew_time", "lease_duration"):
+                    if hasattr(obj, attr):
+                        setattr(current, attr, getattr(obj, attr))
                 obj = current
             else:
                 self._mirror[kind][obj.key] = obj

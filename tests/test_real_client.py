@@ -434,14 +434,6 @@ class SnapshotTransport:
                 item.pop("apiVersion", None)
         return status, resp
 
-    def list_snapshot(self, kind):
-        from karpenter_tpu.kube.real import _path
-
-        status, body = self.request("GET", _path(kind))
-        assert status == 200
-        return body.get("items", [])
-
-
 class TestSnapshotWatch:
     def test_list_diff_sees_remote_creates_and_deletes(self):
         """Against a real-cluster-shaped transport (TypeMeta-less
