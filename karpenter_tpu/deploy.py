@@ -158,8 +158,10 @@ def _rbac_rules() -> list[dict]:
         {"apiGroups": [""],
          "resources": ["pods/binding", "pods/eviction"],
          "verbs": ["create"]},
+        # update: the recorder bumps count/lastTimestamp on deduped
+        # Events via PUT (the reference's record.EventRecorder patches)
         {"apiGroups": [""], "resources": ["events"],
-         "verbs": ["create", "patch"]},
+         "verbs": ["create", "patch", "update"]},
         {"apiGroups": ["apps"], "resources": ["daemonsets"],
          "verbs": ["get", "list", "watch"]},
         {"apiGroups": ["policy"], "resources": ["poddisruptionbudgets"],

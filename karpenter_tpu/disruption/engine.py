@@ -137,6 +137,7 @@ class DisruptionEngine:
         seed: int = 0,
         options=None,
         clock=None,
+        recorder=None,
     ):
         from karpenter_tpu.operator.options import Options
 
@@ -145,7 +146,9 @@ class DisruptionEngine:
         self.cluster = cluster
         self.cloud = cloud
         self.provisioner = provisioner
-        self.queue = queue or OrchestrationQueue(kube, cluster, provisioner)
+        self.queue = queue or OrchestrationQueue(
+            kube, cluster, provisioner, recorder=recorder
+        )
         self.options = options or Options()
         self._rng = random.Random(seed)
         # per-round offering price index; reset by get_candidates
@@ -727,16 +730,43 @@ class OrchestrationQueue:
     """Executes commands: taint + mark + replace, then delete once
     replacements initialize (disruption/queue.go:94-370)."""
 
-    def __init__(self, kube: KubeClient, cluster: Cluster, provisioner: Provisioner):
+    def __init__(self, kube: KubeClient, cluster: Cluster, provisioner: Provisioner,
+                 recorder=None):
         self.kube = kube
         self.cluster = cluster
         self.provisioner = provisioner
+        self.recorder = recorder
         self.active: list[Command] = []
         self.validator = None  # set by DisruptionEngine
+
+    def _record(self, command: Command, now: float) -> None:
+        """DisruptionTerminating on every candidate (disruption/
+        events/events.go:56-63 posts to both the Node and the
+        NodeClaim)."""
+        if self.recorder is None:
+            return
+        from karpenter_tpu.events.recorder import Event
+
+        for candidate in command.candidates:
+            node = candidate.state_node
+            message = f"Disrupting Node: {command.reason}"
+            if node.node is not None:
+                self.recorder.publish(Event(
+                    kind="Node", name=node.node.metadata.name,
+                    type="Normal", reason="DisruptionTerminating",
+                    message=message,
+                ), now=now)
+            if node.node_claim is not None:
+                self.recorder.publish(Event(
+                    kind="NodeClaim", name=node.node_claim.metadata.name,
+                    type="Normal", reason="DisruptionTerminating",
+                    message=message,
+                ), now=now)
 
     def start_command(self, command: Command, now: Optional[float] = None) -> None:
         now = time.time() if now is None else now
         command.started_at = now
+        self._record(command, now)
         for candidate in command.candidates:
             node = candidate.state_node
             if node.node is not None and not any(

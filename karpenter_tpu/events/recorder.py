@@ -1,15 +1,23 @@
-"""Deduplicated event recorder.
+"""Deduplicated event recorder, flushed to the API substrate.
 
 Counterpart of pkg/events/recorder.go:47-120: events identical in
 (kind, object, reason, message) within a 10s TTL are dropped; a simple
-per-reason token bucket guards against floods.
+per-reason token bucket guards against floods. With a `kube` sink the
+recorder also publishes real corev1 Event objects (recorder.go:52-72
+goes through record.EventRecorder to the API server — that is what
+`kubectl describe` shows an operator debugging a live cluster):
+fresh events are created, deduped repeats bump the existing Event's
+count/lastTimestamp, rate-limited floods never reach the server.
 """
 
 from __future__ import annotations
 
+import itertools
 import time
 from dataclasses import dataclass, field
 from typing import Optional
+
+_seq = itertools.count(1)
 
 
 @dataclass(frozen=True)
@@ -19,6 +27,7 @@ class Event:
     type: str          # Normal | Warning
     reason: str
     message: str
+    namespace: str = ""  # empty for cluster-scoped objects
 
 
 @dataclass
@@ -33,12 +42,14 @@ class EventRecorder:
     RATE_LIMIT_PER_REASON = 10  # events per TTL window
     MAX_EVENTS = 1000           # ring buffer: long-running loops must not leak
 
-    def __init__(self) -> None:
+    def __init__(self, kube=None) -> None:
         from collections import deque
 
+        self.kube = kube  # optional API sink for corev1 Events
         self.events: "deque[RecordedEvent]" = deque(maxlen=self.MAX_EVENTS)
         self._last_seen: dict[Event, float] = {}
         self._reason_counts: dict[str, list[float]] = {}
+        self._posted: dict[Event, object] = {}  # event -> KubeEvent CR
 
     def publish(self, event: Event, now: Optional[float] = None) -> bool:
         now = time.time() if now is None else now
@@ -49,12 +60,16 @@ class EventRecorder:
                 e: t for e, t in self._last_seen.items()
                 if now - t < self.DEDUPE_TTL
             }
+            self._posted = {
+                e: o for e, o in self._posted.items() if e in self._last_seen
+            }
         last = self._last_seen.get(event)
         if last is not None and now - last < self.DEDUPE_TTL:
             for rec in reversed(self.events):
                 if rec.event == event:
                     rec.count += 1
                     break
+            self._bump_posted(event, now)
             return False
         window = [t for t in self._reason_counts.get(event.reason, []) if now - t < self.DEDUPE_TTL]
         if len(window) >= self.RATE_LIMIT_PER_REASON:
@@ -64,7 +79,53 @@ class EventRecorder:
         self._reason_counts[event.reason] = window
         self._last_seen[event] = now
         self.events.append(RecordedEvent(event=event, timestamp=now))
+        self._post(event, now)
         return True
+
+    # -- corev1 Event sink ----------------------------------------------
+
+    def _post(self, event: Event, now: float) -> None:
+        if self.kube is None:
+            return
+        from karpenter_tpu.kube.objects import KubeEvent, ObjectMeta
+
+        obj = KubeEvent(
+            metadata=ObjectMeta(
+                # the real recorder's unique-name convention:
+                # <object>.<time-based suffix> (UnixNano upstream) —
+                # time-seeded so a restarted operator never regenerates
+                # a name that still exists server-side (Events live ~1h;
+                # a collision 409s and the event would be lost). _seq
+                # disambiguates same-microsecond publishes in sims.
+                name=f"{event.name}.{int(now * 1e6):x}{next(_seq):04x}",
+                namespace=event.namespace or "default",
+            ),
+            involved_kind=event.kind,
+            involved_name=event.name,
+            involved_namespace=event.namespace,
+            type=event.type,
+            reason=event.reason,
+            message=event.message,
+            count=1,
+            first_timestamp=now,
+            last_timestamp=now,
+        )
+        try:
+            self.kube.create(obj)
+        except Exception:
+            return  # event loss is tolerable; controllers never block on it
+        self._posted[event] = obj
+
+    def _bump_posted(self, event: Event, now: float) -> None:
+        obj = self._posted.get(event)
+        if obj is None or self.kube is None:
+            return
+        obj.count += 1
+        obj.last_timestamp = now
+        try:
+            self.kube.update(obj)
+        except Exception:
+            self._posted.pop(event, None)  # deleted/expired server-side
 
     def for_reason(self, reason: str) -> list[RecordedEvent]:
         return [r for r in self.events if r.event.reason == reason]

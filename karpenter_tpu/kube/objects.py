@@ -460,3 +460,29 @@ class CSINode:
     @property
     def key(self) -> str:
         return self.metadata.name
+
+
+@dataclass
+class KubeEvent:
+    """corev1.Event, trimmed to what the recorder emits
+    (pkg/events/recorder.go:52-72 publishes through
+    record.EventRecorder; operators debug real clusters by reading
+    these off `kubectl describe`)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    involved_kind: str = ""
+    involved_name: str = ""
+    involved_namespace: str = ""
+    type: str = "Normal"      # Normal | Warning
+    reason: str = ""
+    message: str = ""
+    count: int = 1
+    first_timestamp: float = 0.0
+    last_timestamp: float = 0.0
+    source_component: str = "karpenter"
+
+    kind = "Event"
+
+    @property
+    def key(self) -> str:
+        return f"{self.metadata.namespace}/{self.metadata.name}"

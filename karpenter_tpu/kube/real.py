@@ -63,7 +63,14 @@ RESOURCES = {
     "PodDisruptionBudget": ("/apis/policy/v1", "poddisruptionbudgets", True),
     "PersistentVolumeClaim": ("/api/v1", "persistentvolumeclaims", True),
     "Lease": ("/apis/coordination.k8s.io/v1", "leases", True),
+    "Event": ("/api/v1", "events", True),
 }
+
+# kinds the client writes but neither LISTs on boot nor watches —
+# Events flow one way (recorder -> apiserver), and LISTing every Event
+# cluster-wide would be pure load (the reference's EventRecorder never
+# reads them back either)
+WRITE_ONLY_KINDS = ("Event",)
 
 # kinds the simulation store carries that have no real-cluster codec
 # yet; list() returns empty for them rather than failing the operator
@@ -566,6 +573,10 @@ class InMemoryApiServer:
                 self._emit(kind, MODIFIED, cr)
             return 200, json.loads(json.dumps(cr))
         self._rv += 1
+        # stamp the deletion rv (real apiservers do): watch clients
+        # advance their cursor from the OBJECT's rv, so a stale
+        # embedded rv would make them replay this DELETED forever
+        meta["resourceVersion"] = str(self._rv)
         del bucket[key]
         self._emit(kind, DELETED, cr)
         return 200, json.loads(json.dumps(cr))
@@ -623,7 +634,8 @@ class RealKubeClient:
 
     def __init__(self, transport, kinds: Optional[Iterable[str]] = None):
         self.transport = transport
-        self.kinds = list(kinds) if kinds is not None else list(RESOURCES)
+        self.kinds = (list(kinds) if kinds is not None
+                      else [k for k in RESOURCES if k not in WRITE_ONLY_KINDS])
         self._lock = threading.RLock()
         self._mirror: dict[str, dict[str, object]] = {k: {} for k in self.kinds}
         self._last_rv: dict[str, int] = {k: 0 for k in self.kinds}
@@ -848,6 +860,8 @@ class RealKubeClient:
     def create(self, obj):
         self._push("POST", obj, _path(obj.kind, namespace=obj.metadata.namespace))
         obj.metadata.generation = 1
+        if obj.kind not in self._mirror:
+            return obj  # write-only kind (Events): push, don't cache
         with self._lock:
             self._mirror[obj.kind][obj.key] = obj
             self._index_pod(obj)
@@ -859,6 +873,8 @@ class RealKubeClient:
             "PUT", obj,
             _path(obj.kind, obj.metadata.name, obj.metadata.namespace),
         )
+        if obj.kind not in self._mirror:
+            return obj  # write-only kind (Events): push, don't cache
         with self._lock:
             self._mirror[obj.kind][obj.key] = obj
             self._index_pod(obj)

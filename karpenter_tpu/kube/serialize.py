@@ -973,7 +973,51 @@ def lease_from_cr(cr: dict):
 
 # ---------------------------------------------------------------- registry
 
+def event_to_cr(ev) -> dict:
+    """corev1.Event wire form (pkg/events/recorder.go publishes these
+    through record.EventRecorder; kubectl describe joins them on
+    involvedObject)."""
+    return {
+        "apiVersion": "v1",
+        "kind": "Event",
+        "metadata": meta_to_cr(ev.metadata, namespaced=True),
+        "involvedObject": _drop_none({
+            "kind": ev.involved_kind,
+            "name": ev.involved_name,
+            "namespace": ev.involved_namespace or None,
+        }),
+        "type": ev.type,
+        "reason": ev.reason,
+        "message": ev.message,
+        "count": ev.count,
+        "firstTimestamp": ts_to_rfc3339(ev.first_timestamp or None),
+        "lastTimestamp": ts_to_rfc3339(ev.last_timestamp or None),
+        "source": {"component": ev.source_component},
+        "reportingComponent": ev.source_component,
+    }
+
+
+def event_from_cr(cr: dict):
+    from karpenter_tpu.kube.objects import KubeEvent
+
+    involved = cr.get("involvedObject", {})
+    return KubeEvent(
+        metadata=meta_from_cr(cr),
+        involved_kind=involved.get("kind", ""),
+        involved_name=involved.get("name", ""),
+        involved_namespace=involved.get("namespace", ""),
+        type=cr.get("type", "Normal"),
+        reason=cr.get("reason", ""),
+        message=cr.get("message", ""),
+        count=int(cr.get("count", 1)),
+        first_timestamp=ts_from_rfc3339(cr.get("firstTimestamp")) or 0.0,
+        last_timestamp=ts_from_rfc3339(cr.get("lastTimestamp")) or 0.0,
+        source_component=(cr.get("source") or {}).get("component", ""),
+    )
+
+
 TO_CR = {
+    "Event": event_to_cr,
     "NodePool": nodepool_to_cr,
     "NodeClaim": nodeclaim_to_cr,
     "NodeOverlay": nodeoverlay_to_cr,
@@ -986,6 +1030,7 @@ TO_CR = {
 }
 
 FROM_CR = {
+    "Event": event_from_cr,
     "NodePool": nodepool_from_cr,
     "NodeClaim": nodeclaim_from_cr,
     "NodeOverlay": nodeoverlay_from_cr,

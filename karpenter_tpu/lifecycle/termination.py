@@ -55,8 +55,9 @@ class EvictionQueue:
     already launched). See _maybe_rebirth for the gating.
     """
 
-    def __init__(self, kube: KubeClient):
+    def __init__(self, kube: KubeClient, recorder=None):
         self.kube = kube
+        self.recorder = recorder
         self.blocked: dict[str, str] = {}  # pod key -> blocking pdb
         self._attempts: dict[str, int] = {}  # pod key -> 429 count
         self._retry_at: dict[str, float] = {}  # pod key -> next attempt
@@ -91,8 +92,20 @@ class EvictionQueue:
             # a direct delete, exactly the reference's forced path
             self.kube.delete(pod, now=now)
         self._forget(pod.key)
+        self._record_evicted(pod, now)
         self._maybe_rebirth(pod)
         return True
+
+    def _record_evicted(self, pod: Pod, now: float) -> None:
+        if self.recorder is None:
+            return
+        from karpenter_tpu.events.recorder import Event
+
+        self.recorder.publish(Event(
+            kind="Pod", name=pod.metadata.name,
+            namespace=pod.metadata.namespace, type="Normal",
+            reason="Evicted", message="Evicted pod from terminating node",
+        ), now=now)  # terminator/events/events.go:37
 
     def _maybe_rebirth(self, pod: Pod) -> None:
         """Successor fabrication, STRICTLY gated to the simulation
@@ -227,12 +240,13 @@ def _tolerates_disrupted(pod: Pod) -> bool:
 
 
 class TerminationController:
-    def __init__(self, kube: KubeClient, cluster=None):
+    def __init__(self, kube: KubeClient, cluster=None, recorder=None):
         from karpenter_tpu.kube.dirty import DirtyTracker
 
         self.kube = kube
         self.cluster = cluster
-        self.queue = EvictionQueue(kube)
+        self.recorder = recorder
+        self.queue = EvictionQueue(kube, recorder=recorder)
         self.queue.restore()  # owed rebirths survive operator restarts
         self.dirty = DirtyTracker(kube).watch("Node")
         # nodes mid-termination: drain retries and volume waits emit no
@@ -258,6 +272,20 @@ class TerminationController:
         # 2. drain (terminator.go:96-180)
         remaining = self._drain(node, deadline, now)
         if remaining:
+            # only THIS node's PDB-blocked pods justify the warning —
+            # the queue is shared across every terminating node, and
+            # pods merely riding out their grace period are fine
+            this_blocked = [p for p in remaining
+                            if p.key in self.queue.blocked]
+            if self.recorder is not None and this_blocked:
+                from karpenter_tpu.events.recorder import Event
+
+                self.recorder.publish(Event(
+                    kind="Node", name=node.metadata.name, type="Warning",
+                    reason="FailedDraining",
+                    message=f"Failed to drain node, {len(remaining)} pods "
+                            "are waiting to be evicted",
+                ), now=now)  # terminator/events/events.go:57
             return  # wait for evictions / PDBs; retried next reconcile
         if claim is not None:
             claim.status_conditions.set_true(COND_DRAINED, now=now)

@@ -89,7 +89,9 @@ class Operator:
 
         self.cluster = Cluster(self.kube)
         attach_informers(self.kube, self.cluster)
-        self.recorder = EventRecorder()
+        # the recorder flushes corev1 Events through the API substrate
+        # (events/recorder.go:52-72) — kubectl-describe visibility
+        self.recorder = EventRecorder(kube=self.kube)
         self.health = HealthTracker()
         if self.overlay_controller is not None:
             # conflict events + consolidation invalidation need the
@@ -98,10 +100,13 @@ class Operator:
             self.overlay_controller.cluster = self.cluster
 
         self.provisioner = Provisioner(
-            self.kube, self.cluster, provider, options=self.options
+            self.kube, self.cluster, provider, options=self.options,
+            recorder=self.recorder,
         )
         self.lifecycle = NodeClaimLifecycle(self.kube, provider, health=self.health)
-        self.termination = TerminationController(self.kube, self.cluster)
+        self.termination = TerminationController(
+            self.kube, self.cluster, recorder=self.recorder
+        )
         self.conditions = DisruptionConditionsController(
             self.kube, self.cluster, provider
         )
@@ -109,7 +114,7 @@ class Operator:
         self.expiration = ExpirationController(self.kube)
         self.disruption = DisruptionEngine(
             self.kube, self.cluster, provider, self.provisioner,
-            options=self.options,
+            options=self.options, recorder=self.recorder,
         )
         self.gc = GarbageCollectionController(self.kube, provider)
         self.node_health = NodeHealthController(self.kube, provider, self.options)

@@ -113,12 +113,14 @@ class Provisioner:
         cloud_provider: CloudProvider,
         options=None,
         clock=None,
+        recorder=None,
     ):
         self.kube = kube
         self.cluster = cluster
         self.cloud_provider = cloud_provider
         self.options = options
         self.clock = clock if clock is not None else time.monotonic
+        self.recorder = recorder
         self.batcher = Batcher()
 
     # -- pod intake (provisioner.go:172-195, utils/node) ----------------------
@@ -431,5 +433,47 @@ class Provisioner:
             return SchedulerResults(new_node_plans=[], existing_assignments={})
         results = self.schedule()
         self.create_node_claims(results, now=now)
+        self._record_events(results, now=now)
         self.batcher.reset()
         return results
+
+    def _record_events(self, results: SchedulerResults,
+                       now: Optional[float] = None) -> None:
+        """Pod-facing scheduling events (scheduling/events.go:46-68:
+        Nominated on placement, FailedScheduling with the reason on
+        the unschedulable remainder)."""
+        if self.recorder is None:
+            return
+        from karpenter_tpu.events.recorder import Event
+
+        for target, pods in results.existing_assignments.items():
+            for pod in pods:
+                self.recorder.publish(Event(
+                    kind="Pod", name=pod.metadata.name,
+                    namespace=pod.metadata.namespace, type="Normal",
+                    reason="Nominated",
+                    message=f"Pod should schedule on node {target}",
+                ), now=now)
+        for plan in results.new_node_plans:
+            if not plan.claim_name:
+                continue  # limits rejected the claim; errors carry it
+            for pod in plan.pods:
+                self.recorder.publish(Event(
+                    kind="Pod", name=pod.metadata.name,
+                    namespace=pod.metadata.namespace, type="Normal",
+                    reason="Nominated",
+                    message="Pod should schedule on nodeclaim "
+                            f"{plan.claim_name}",
+                ), now=now)
+        if results.errors:
+            by_key = {p.key: p for p in self.kube.pods()}
+            for key, reason in results.errors.items():
+                pod = by_key.get(key)
+                if pod is None:
+                    continue
+                self.recorder.publish(Event(
+                    kind="Pod", name=pod.metadata.name,
+                    namespace=pod.metadata.namespace, type="Warning",
+                    reason="FailedScheduling",
+                    message=f"Failed to schedule pod: {reason}",
+                ), now=now)

@@ -105,9 +105,14 @@ class Environment:
             registration_delay=self.registration_delay,
             clock=self._clock,
         )
-        self.provisioner = Provisioner(self.kube, self.cluster, self.cloud)
+        from karpenter_tpu.events.recorder import EventRecorder
+
+        self.recorder = EventRecorder(kube=self.kube)
+        self.provisioner = Provisioner(self.kube, self.cluster, self.cloud,
+                                       recorder=self.recorder)
         self.lifecycle = NodeClaimLifecycle(self.kube, self.cloud)
-        self.termination = TerminationController(self.kube, self.cluster)
+        self.termination = TerminationController(self.kube, self.cluster,
+                                                 recorder=self.recorder)
         self.conditions = DisruptionConditionsController(
             self.kube, self.cluster, self.cloud
         )
@@ -121,7 +126,7 @@ class Environment:
             )
         self.disruption = DisruptionEngine(
             self.kube, self.cluster, self.cloud, self.provisioner,
-            options=self.options,
+            options=self.options, recorder=self.recorder,
         )
 
     def _clock(self) -> float:

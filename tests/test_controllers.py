@@ -588,3 +588,132 @@ class TestMetricsControllers:
             now += 2
             op.step(now=now)
         assert [p for p in kube.pods() if p.spec.node_name]
+
+
+class TestKubeEvents:
+    """corev1 Events reach the API substrate (events/recorder.go:52-72:
+    the reference posts through record.EventRecorder; operators debug
+    via kubectl describe). Dedupe bumps count on the SAME Event object
+    instead of spamming new ones."""
+
+    def test_provision_and_disruption_cycle_posts_events(self):
+        import time as _time
+
+        from karpenter_tpu.cloudprovider.fake import GIB, make_instance_type
+        from karpenter_tpu.testing import Environment, mk_nodepool, mk_pod
+
+        env = Environment(types=[
+            make_instance_type("c2", cpu=2, memory=8 * GIB, price=2.0),
+            make_instance_type("c8", cpu=8, memory=32 * GIB, price=5.0),
+        ])
+        pool = mk_nodepool("default")
+        pool.spec.disruption.consolidate_after = "0s"
+        # pin to on-demand: a spot-launched candidate would put
+        # single-node consolidation behind the 15-type spot-to-spot
+        # rule, which this 2-type catalog can't satisfy
+        from karpenter_tpu.apis.v1.labels import CAPACITY_TYPE_LABEL
+        from karpenter_tpu.apis.v1.nodeclaim import RequirementSpec
+
+        pool.spec.template.spec.requirements = [
+            RequirementSpec(key=CAPACITY_TYPE_LABEL, operator="In",
+                            values=("on-demand",)),
+        ]
+        env.kube.create(pool)
+        env.provision(*[mk_pod(cpu=0.5) for _ in range(4)])
+        events = env.kube.list("Event")
+        nominated = [e for e in events if e.reason == "Nominated"]
+        assert len(nominated) == 4
+        assert all(e.involved_kind == "Pod" for e in nominated)
+        assert all(e.metadata.namespace == "default" for e in nominated)
+        # unschedulable pod -> FailedScheduling Warning
+        env.provision(mk_pod(name="huge", cpu=10000.0))
+        failed = [e for e in env.kube.list("Event")
+                  if e.reason == "FailedScheduling"]
+        assert failed and failed[0].type == "Warning"
+        assert failed[0].involved_name == "huge"
+        # consolidation cycle (most of the workload leaves -> the node
+        # is underutilized) -> DisruptionTerminating on the candidates
+        # and Evicted on the drained pods. The pending huge pod must go
+        # first: unschedulable pods gate disruption.
+        env.kube.delete(env.kube.get_pod("default", "huge"))
+        for pod in [p for p in env.kube.pods() if p.spec.node_name][:3]:
+            env.kube.delete(pod)
+        now = _time.time() + 120
+        for i in range(12):
+            env.reconcile_disruption(now=now + i * 11)
+        reasons = {e.reason for e in env.kube.list("Event")}
+        assert "DisruptionTerminating" in reasons
+        assert "Evicted" in reasons
+
+    def test_dedupe_bumps_count_on_posted_event(self):
+        from karpenter_tpu.events.recorder import Event, EventRecorder
+        from karpenter_tpu.kube.client import KubeClient
+
+        kube = KubeClient()
+        rec = EventRecorder(kube=kube)
+        ev = Event(kind="Node", name="n-1", type="Normal",
+                   reason="Waiting", message="same thing")
+        assert rec.publish(ev, now=100.0)
+        assert not rec.publish(ev, now=103.0)  # deduped
+        assert not rec.publish(ev, now=106.0)
+        posted = kube.list("Event")
+        assert len(posted) == 1
+        assert posted[0].count == 3
+        assert posted[0].last_timestamp == 106.0
+        assert posted[0].first_timestamp == 100.0
+        # past the TTL: a fresh Event object is posted
+        assert rec.publish(ev, now=120.0)
+        assert len(kube.list("Event")) == 2
+
+    def test_rate_limited_events_never_reach_the_server(self):
+        from karpenter_tpu.events.recorder import Event, EventRecorder
+        from karpenter_tpu.kube.client import KubeClient
+
+        kube = KubeClient()
+        rec = EventRecorder(kube=kube)
+        for i in range(25):
+            rec.publish(Event(kind="Node", name=f"n-{i}", type="Warning",
+                              reason="Flood", message=f"m{i}"), now=50.0)
+        assert len(kube.list("Event")) == rec.RATE_LIMIT_PER_REASON
+
+    def test_event_cr_round_trip(self):
+        from karpenter_tpu.kube.objects import KubeEvent, ObjectMeta
+        from karpenter_tpu.kube.serialize import event_from_cr, event_to_cr
+
+        ev = KubeEvent(
+            metadata=ObjectMeta(name="n-1.0001", namespace="default"),
+            involved_kind="NodeClaim", involved_name="n-1",
+            type="Normal", reason="DisruptionTerminating",
+            message="Disrupting Node: Underutilized",
+            count=4, first_timestamp=1000.0, last_timestamp=1030.0,
+        )
+        back = event_from_cr(event_to_cr(ev))
+        assert back.involved_kind == "NodeClaim"
+        assert back.involved_name == "n-1"
+        assert back.reason == "DisruptionTerminating"
+        assert back.count == 4
+        assert back.first_timestamp == 1000.0
+        assert back.last_timestamp == 1030.0
+        assert back.source_component == "karpenter"
+
+    def test_events_flow_over_real_client(self):
+        """RealKubeClient pushes Events (write-only kind: no LIST on
+        boot, no watch), and they land namespaced on the server."""
+        from karpenter_tpu.events.recorder import Event, EventRecorder
+        from karpenter_tpu.kube.real import InMemoryApiServer, RealKubeClient
+
+        server = InMemoryApiServer()
+        kube = RealKubeClient(server)
+        assert "Event" not in kube.kinds  # write-only
+        rec = EventRecorder(kube=kube)
+        rec.publish(Event(kind="Pod", name="w-1", namespace="default",
+                          type="Normal", reason="Nominated", message="m"),
+                    now=10.0)
+        status, body = server.request(
+            "GET", "/api/v1/namespaces/default/events"
+        )
+        assert status == 200 and len(body["items"]) == 1
+        item = body["items"][0]
+        assert item["reason"] == "Nominated"
+        assert item["involvedObject"] == {"kind": "Pod", "name": "w-1",
+                                          "namespace": "default"}

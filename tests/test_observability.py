@@ -215,9 +215,14 @@ class TestDeployManifests:
                 return ""
             return prefix.split("/")[2]
 
-        # reads: every kind the client LISTs at sync
+        from karpenter_tpu.kube.real import WRITE_ONLY_KINDS
+
+        # reads: every kind the client LISTs at sync; write-only kinds
+        # (Events) instead need the recorder's write verbs
         for kind, (prefix, plural, _ns) in RESOURCES.items():
-            for verb in ("get", "list", "watch"):
+            verbs = (("create", "update") if kind in WRITE_ONLY_KINDS
+                     else ("get", "list", "watch"))
+            for verb in verbs:
                 assert granted(group_of(prefix), plural, verb), \
                     f"RBAC missing {verb} on {plural}"
         # writes the controllers perform
