@@ -50,6 +50,12 @@ class EventRecorder:
         self._last_seen: dict[Event, float] = {}
         self._reason_counts: dict[str, list[float]] = {}
         self._posted: dict[Event, object] = {}  # event -> KubeEvent CR
+        self._last_flush: dict[Event, float] = {}  # bump-PUT throttle
+        # sink-side retention for the SIMULATION store only: a real
+        # apiserver expires Events (~1h TTL); the in-memory store has
+        # no TTL, so the recorder deletes its oldest posts beyond
+        # MAX_EVENTS to keep long sims from leaking
+        self._sink_fifo: "deque" = deque()
 
     def publish(self, event: Event, now: Optional[float] = None) -> bool:
         now = time.time() if now is None else now
@@ -115,6 +121,15 @@ class EventRecorder:
         except Exception:
             return  # event loss is tolerable; controllers never block on it
         self._posted[event] = obj
+        self._last_flush[event] = now
+        if getattr(self.kube, "simulates_workload_controllers", False):
+            self._sink_fifo.append(obj)
+            while len(self._sink_fifo) > self.MAX_EVENTS:
+                old = self._sink_fifo.popleft()
+                try:
+                    self.kube.delete(old)
+                except Exception:
+                    pass
 
     def _bump_posted(self, event: Event, now: float) -> None:
         obj = self._posted.get(event)
@@ -122,6 +137,14 @@ class EventRecorder:
             return
         obj.count += 1
         obj.last_timestamp = now
+        # throttle the write: a pod stuck behind a PDB republishes every
+        # reconcile, and a synchronous PUT per tick per stuck object
+        # would put apiserver round-trips on the hot path (the reference
+        # posts through an async broadcaster). The local count keeps
+        # accumulating; at most one flush per second carries it up.
+        if now - self._last_flush.get(event, 0.0) < 1.0:
+            return
+        self._last_flush[event] = now
         try:
             self.kube.update(obj)
         except Exception:

@@ -447,12 +447,16 @@ class Provisioner:
         from karpenter_tpu.events.recorder import Event
 
         for target, pods in results.existing_assignments.items():
+            # the assignment key is a node name OR an in-flight claim
+            # name (scheduler._state_node_key) — say which, so kubectl
+            # readers don't grep for a Node that doesn't exist yet
+            noun = "node" if self.kube.get_node(target) else "nodeclaim"
             for pod in pods:
                 self.recorder.publish(Event(
                     kind="Pod", name=pod.metadata.name,
                     namespace=pod.metadata.namespace, type="Normal",
                     reason="Nominated",
-                    message=f"Pod should schedule on node {target}",
+                    message=f"Pod should schedule on {noun} {target}",
                 ), now=now)
         for plan in results.new_node_plans:
             if not plan.claim_name:
@@ -466,9 +470,8 @@ class Provisioner:
                             f"{plan.claim_name}",
                 ), now=now)
         if results.errors:
-            by_key = {p.key: p for p in self.kube.pods()}
             for key, reason in results.errors.items():
-                pod = by_key.get(key)
+                pod = self.kube.get_pod(*key.split("/", 1))
                 if pod is None:
                     continue
                 self.recorder.publish(Event(
