@@ -197,27 +197,55 @@ class DisruptionEngine:
                 return None
         if reason == REASON_DRIFTED and not claim.status_conditions.is_true(COND_DRIFTED):
             return None
-        # pods must be evictable (ValidatePodsDisruptable statenode.go:234)
+        # Drift is the EVENTUAL disruption class (drift.go:111): with a
+        # TerminationGracePeriod on the claim, pod-block errors — the
+        # do-not-disrupt annotation and zero-budget PDBs — do NOT
+        # disqualify the candidate (types.go:115-121), because the
+        # drain is bounded: termination force-completes at the TGP
+        # deadline. Consolidation/emptiness are GRACEFUL and always
+        # respect blocking pods.
+        eventual = (
+            reason == REASON_DRIFTED
+            and claim.spec.termination_grace_period is not None
+        )
+        # pods must be evictable (ValidatePodsDisruptable
+        # statenode.go:234): the do-not-disrupt check covers every
+        # ACTIVE pod (mirror and daemonset pods may block with the
+        # annotation too); the PDB check self-gates on evictability
+        # (mirror pods bypass it, daemonset pods do not)
         pods = []
         for pod_key in node.pod_keys:
             pod = self.kube.get_pod(*pod_key.split("/", 1))
             if pod is None or pod.is_terminal() or pod.is_terminating():
                 continue
-            if pod.metadata.annotations.get(DO_NOT_DISRUPT_ANNOTATION) == "true":
+            if (
+                pod.metadata.annotations.get(DO_NOT_DISRUPT_ANNOTATION)
+                == "true"
+                and not eventual
+            ):
+                return None
+            if pdb.can_evict(pod) is not None and not eventual:
                 return None
             if pod.owner_kind() == "DaemonSet":
                 continue
-            if pdb.can_evict(pod) is not None:
-                return None
             pods.append(pod)
         labels = node.labels()
         price = self._node_price(labels)
         if price is None:
-            # unpriceable candidates are excluded rather than priced at 0,
-            # which would poison the cheaper-than comparison
-            # (getCandidatePrices errors skip the candidate)
-            log.warning("no offering price for node %s; skipping candidate", node.name)
-            return None
+            if reason == REASON_UNDERUTILIZED:
+                # unpriceable candidates are excluded from consolidation
+                # rather than priced at 0, which would poison the
+                # cheaper-than comparison (getCandidatePrices errors
+                # skip the candidate)
+                log.warning(
+                    "no offering price for node %s; skipping candidate",
+                    node.name,
+                )
+                return None
+            # emptiness/drift never price-compare: a candidate with a
+            # missing/unresolvable instance type is still disruptable
+            # (types.go:107-108 resolves the type best-effort)
+            price = 0.0
         lifetime_factor = 1.0
         from karpenter_tpu.utils.duration import parse_duration
 
@@ -272,17 +300,46 @@ class DisruptionEngine:
 
     # -- budgets (helpers.go:231-280) ------------------------------------------
 
-    def budget_mapping(self, reason: str, now: float) -> dict[str, int]:
+    def budget_mapping(self, reason: str, now: float,
+                       exclude_names: frozenset = frozenset()) -> dict[str, int]:
+        """helpers.go BuildDisruptionBudgetMapping: the TOTAL counts
+        only managed + initialized nodes whose claims are not
+        InstanceTerminating (uninitialized replacements padding the
+        percentage denominator would allow extra disruption of active
+        nodes); NotReady and marked/deleting nodes then CONSUME
+        allowance, floored at zero. `exclude_names` are nodes whose
+        disruption is the QUESTION being asked (an in-flight command's
+        own candidates at validation time): they count in the total
+        but never as consumers, so a command can't collide with its
+        own marks."""
+        from karpenter_tpu.apis.v1.nodeclaim import COND_INSTANCE_TERMINATING
+
+        num: dict[str, int] = {}
+        disrupting: dict[str, int] = {}
+        for n in self.cluster.nodes():
+            if not n.managed() or not n.initialized():
+                continue
+            claim = n.node_claim
+            if claim is not None and claim.status_conditions.is_true(
+                COND_INSTANCE_TERMINATING
+            ):
+                continue
+            pool_name = n.nodepool_name()
+            if not pool_name:
+                continue
+            num[pool_name] = num.get(pool_name, 0) + 1
+            if n.name in exclude_names:
+                continue
+            not_ready = n.node is not None and not n.node.is_ready()
+            if not_ready or n.deleting():
+                disrupting[pool_name] = disrupting.get(pool_name, 0) + 1
         out = {}
         for pool in self.kube.node_pools():
-            total = self.cluster.nodepool_node_count(pool.metadata.name)
-            allowed = pool.must_get_allowed_disruptions(now, total, reason)
-            deleting = sum(
-                1
-                for n in self.cluster.nodes()
-                if n.nodepool_name() == pool.metadata.name and n.deleting()
+            name = pool.metadata.name
+            allowed = pool.must_get_allowed_disruptions(
+                now, num.get(name, 0), reason
             )
-            out[pool.metadata.name] = max(0, allowed - deleting)
+            out[name] = max(0, allowed - disrupting.get(name, 0))
         return out
 
     def _budget_filter(

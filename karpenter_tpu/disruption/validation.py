@@ -51,7 +51,7 @@ from karpenter_tpu.apis.v1.labels import (
     INSTANCE_TYPE_LABEL,
     TOPOLOGY_ZONE_LABEL,
 )
-from karpenter_tpu.apis.v1.nodepool import REASON_UNDERUTILIZED
+from karpenter_tpu.apis.v1.nodepool import REASON_DRIFTED, REASON_UNDERUTILIZED
 from karpenter_tpu.utils.pdb import PdbLimits
 
 if TYPE_CHECKING:  # pragma: no cover
@@ -82,11 +82,20 @@ class Validator:
         now = time.time() if now is None else now
         kube = self.engine.kube
         pdb = PdbLimits(kube)
-        candidate_names = {
-            c.state_node.node_claim.metadata.name
+        # Execution-time revalidation applies the GRACEFUL pod-block
+        # rules, and the reference runs it for CONSOLIDATION commands
+        # only (queue.go validation; validation.go:224-225 hardcodes
+        # GracefulDisruptionClass). A drift command whose candidates
+        # carry a TerminationGracePeriod was admitted as EVENTUAL —
+        # re-judging it gracefully would invalidate it the moment a
+        # do-not-disrupt pod exists, which is exactly the case TGP is
+        # for. Skip the pod-block re-checks for those.
+        eventual = command.reason == REASON_DRIFTED and all(
+            c.state_node.node_claim is not None
+            and c.state_node.node_claim.spec.termination_grace_period
+            is not None
             for c in command.candidates
-            if c.state_node.node_claim is not None
-        }
+        )
         # live (current) reschedulable pods per candidate, rebuilt from
         # state the way the reference's validateCandidates re-runs
         # GetCandidates: pods that bound after compute time are counted,
@@ -114,39 +123,44 @@ class Validator:
                 pod = kube.get_pod(*pod_key.split("/", 1))
                 if pod is None or pod.is_terminal() or pod.is_terminating():
                     continue
-                if pod.owner_kind() == "DaemonSet":
-                    continue
-                if pod.metadata.annotations.get(DO_NOT_DISRUPT_ANNOTATION) == "true":
+                # blocking checks BEFORE the daemonset skip, mirroring
+                # _build_candidate: a daemonset pod freshly armed with
+                # do-not-disrupt (or a PDB dropping to zero) must fail
+                # revalidation just like it would fail admission
+                if (
+                    pod.metadata.annotations.get(DO_NOT_DISRUPT_ANNOTATION)
+                    == "true"
+                    and not eventual
+                ):
                     raise ValidationError(
                         f"pod {pod_key} on candidate {node.name} is do-not-disrupt"
                     )
-                if pdb.can_evict(pod) is not None:
+                if pdb.can_evict(pod) is not None and not eventual:
                     raise ValidationError(
                         f"pod {pod_key} on candidate {node.name} is PDB-blocked"
                     )
+                if pod.owner_kind() == "DaemonSet":
+                    continue
                 live_pods[node.name].append(pod)
         # budgets against current state, excluding this command's own marks
         needed: dict[str, int] = {}
         for candidate in command.candidates:
             pool = candidate.node_pool.metadata.name
             needed[pool] = needed.get(pool, 0) + 1
+        # the same accounting as admission (engine.budget_mapping —
+        # uninitialized/terminating excluded from the total, NotReady +
+        # deleting consume), with this command's own candidates carved
+        # out so it can't collide with its own marks
+        candidate_node_names = frozenset(
+            c.state_node.name for c in command.candidates
+        )
+        budgets = self.engine.budget_mapping(
+            command.reason, now, exclude_names=candidate_node_names
+        )
         for pool_name, count in needed.items():
-            pool = kube.get_node_pool(pool_name)
-            if pool is None:
+            if kube.get_node_pool(pool_name) is None:
                 raise ValidationError(f"nodepool {pool_name} vanished")
-            total = self.engine.cluster.nodepool_node_count(pool_name)
-            allowed = pool.must_get_allowed_disruptions(now, total, command.reason)
-            deleting_others = sum(
-                1
-                for n in self.engine.cluster.nodes()
-                if n.nodepool_name() == pool_name
-                and n.deleting()
-                and not (
-                    n.node_claim is not None
-                    and n.node_claim.metadata.name in candidate_names
-                )
-            )
-            if allowed - deleting_others < count:
+            if budgets.get(pool_name, 0) < count:
                 raise ValidationError(f"budget for nodepool {pool_name} closed")
         if command.reason == REASON_UNDERUTILIZED:
             self._validate_economics(command)

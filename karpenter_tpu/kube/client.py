@@ -239,7 +239,7 @@ class KubeClient:
         # the budget atomically per eviction, so two racing evictions
         # can never both pass a disruptions_allowed=1 budget
         with self._lock:
-            blocking = PdbLimits(self).can_evict(pod)
+            blocking = PdbLimits(self).can_evict(pod, server_side=True)
             if blocking is not None:
                 raise EvictionBlockedError(blocking)
             self.delete(pod, now=now)

@@ -595,7 +595,9 @@ class InMemoryApiServer:
             return 404, {"message": "not found"}
         from karpenter_tpu.utils.pdb import PdbLimits
 
-        blocking = PdbLimits(_ServerPdbView(self)).can_evict(from_cr(cr))
+        blocking = PdbLimits(_ServerPdbView(self)).can_evict(
+            from_cr(cr), server_side=True
+        )
         if blocking is not None:
             # one source of truth for the denial text (the client's
             # exception renders it identically)

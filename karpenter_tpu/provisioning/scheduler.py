@@ -279,6 +279,32 @@ class Scheduler:
             for spec in node.node_claim.spec.requirements:
                 reqs.add(Requirement(spec.key, spec.operator, spec.values, spec.min_values))
         available = resutil.positive(node.available())
+        claim = node.node_claim
+        if (
+            node.node is None
+            and claim is not None
+            and not claim.status.allocatable
+        ):
+            # no REAL allocatable yet = the provider hasn't launched
+            # (creation stamps only the plan's expected capacity); a
+            # launched-but-full node has allocatable set and correctly
+            # reports empty `available` above.
+            # A claim created but not yet LAUNCHED has no
+            # status.capacity: model it from its admissible instance
+            # types like the reference's in-flight NodeClaim scheduling
+            # nodes (scheduler.go builds them from instanceTypeOptions)
+            # — otherwise pods freed by a disruption command can't land
+            # on the command's own replacement and the provisioner buys
+            # duplicate capacity (suite_test.go:454). The MINIMUM
+            # allocatable across admissible types is conservative:
+            # whatever type the launch resolves can hold what we place.
+            # (Gated on the claim being truly unlaunched — a launched,
+            # full node legitimately has empty `available`.)
+            available = resutil.positive(
+                resutil.subtract(
+                    self._min_admissible_allocatable(node, reqs), node.used()
+                )
+            )
         reserve = self._daemon_reserve(node)
         if reserve:
             available = resutil.positive(
@@ -292,6 +318,30 @@ class Scheduler:
             pool_name=node.nodepool_name(),
             pod_count=len(node.pod_keys),
         )
+
+    def _min_admissible_allocatable(
+        self, node: StateNode, reqs: Requirements
+    ) -> ResourceList:
+        """Component-wise minimum allocatable over the pool's instance
+        types compatible with `reqs` (the caller's labels+claim
+        requirements) — the floor of what the launch can
+        materialize."""
+        floor: ResourceList = {}
+        for pool, types in self.pools_with_types:
+            if pool.metadata.name != node.nodepool_name():
+                continue
+            for it in types:
+                if it.requirements.intersects(reqs) is not None:
+                    continue
+                alloc = it.allocatable
+                if not floor:
+                    floor = dict(alloc)
+                else:
+                    floor = {
+                        k: min(v, alloc.get(k, 0.0))
+                        for k, v in floor.items()
+                    }
+        return floor
 
     def _accept_solution(
         self, solution: Solution, open_plans: list, results: SchedulerResults,

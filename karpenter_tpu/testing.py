@@ -128,6 +128,11 @@ class Environment:
             self.kube, self.cluster, self.cloud, self.provisioner,
             options=self.options, recorder=self.recorder,
         )
+        from karpenter_tpu.provisioning.static import StaticCapacityController
+
+        self.static = StaticCapacityController(
+            self.kube, self.cluster, self.options
+        )
 
     def _clock(self) -> float:
         import time as _time

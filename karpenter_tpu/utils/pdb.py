@@ -57,9 +57,47 @@ class PdbLimits:
             return max(0, healthy - min_available)
         return total
 
-    def can_evict(self, pod: Pod) -> Optional[str]:
-        """None if eviction is permitted, else the blocking PDB name."""
-        for pdb in self._matching(pod):
+    @staticmethod
+    def _evictable(pod: Pod, server_side: bool = False) -> bool:
+        """pdb.go isEvictable gate via pod.IsEvictable
+        (utils/pod/scheduling.go:56-61): pods karpenter will never
+        call the eviction API on bypass PDB math entirely — terminal/
+        terminating pods, mirror pods (Node-owned), pods tolerating the
+        disrupted taint (they ride the node down), and do-not-disrupt
+        pods (blocked earlier, by the annotation check).
+
+        `server_side` models the API SERVER's view on the eviction
+        subresource instead: it knows nothing of karpenter annotations
+        or taints, so only terminal/terminating and mirror pods bypass
+        the budget there."""
+        from karpenter_tpu.apis.v1.labels import (
+            DISRUPTED_NO_SCHEDULE_TAINT,
+            DO_NOT_DISRUPT_ANNOTATION,
+        )
+
+        if pod.is_terminal() or pod.is_terminating():
+            return False
+        if pod.owner_kind() == "Node":
+            return False
+        if server_side:
+            return True
+        if pod.metadata.annotations.get(DO_NOT_DISRUPT_ANNOTATION) == "true":
+            return False
+        from karpenter_tpu.scheduling.taints import tolerates_pod
+
+        return tolerates_pod([DISRUPTED_NO_SCHEDULE_TAINT], pod) is not None
+
+    def can_evict(self, pod: Pod, server_side: bool = False) -> Optional[str]:
+        """None if eviction is permitted, else the blocking PDB
+        name(s). Kubernetes refuses eviction outright when MULTIPLE
+        PDBs select one pod (eviction.go:L226 upstream), budgets
+        notwithstanding — pdb.go:98-103."""
+        if not self._evictable(pod, server_side=server_side):
+            return None
+        matching = self._matching(pod)
+        if len(matching) > 1:
+            return ",".join(sorted(p.key for p in matching))
+        for pdb in matching:
             if self.disruptions_allowed(pdb) <= 0:
                 return pdb.key
         return None

@@ -1,0 +1,151 @@
+"""Disruption-budget ACCOUNTING: which nodes count toward the total,
+which consume allowance.
+
+Ports suite_test.go:699-845 (BuildDisruptionBudgetMapping,
+helpers.go): unmanaged / uninitialized / InstanceTerminating nodes are
+excluded from the denominator; NotReady, deleting and
+MarkedForDeletion nodes consume allowance; the result never goes
+negative.
+"""
+
+import time
+
+from karpenter_tpu.apis.v1.labels import INSTANCE_TYPE_LABEL, NODEPOOL_LABEL
+from karpenter_tpu.apis.v1.nodeclaim import (
+    COND_INITIALIZED,
+    COND_INSTANCE_TERMINATING,
+)
+from karpenter_tpu.apis.v1.nodepool import Budget, REASON_UNDERUTILIZED
+from karpenter_tpu.cloudprovider.fake import GIB, make_instance_type
+from karpenter_tpu.kube.objects import Node, NodeSpec, NodeStatus, ObjectMeta
+from karpenter_tpu.testing import Environment, mk_nodepool, mk_pod
+
+
+def _fleet(n_nodes=10, budget_nodes="30%"):
+    """n_nodes one-pod c2 nodes under a single budget."""
+    env = Environment(types=[
+        make_instance_type("c2", cpu=2, memory=8 * GIB, price=2.0),
+    ])
+    pool = mk_nodepool("default")
+    pool.spec.disruption.consolidate_after = "0s"
+    pool.spec.disruption.budgets = [Budget(nodes=budget_nodes)]
+    env.kube.create(pool)
+    for i in range(n_nodes):
+        env.provision(mk_pod(name=f"w-{i}", cpu=1.9))
+    assert len(env.kube.nodes()) == n_nodes
+    now = time.time() + 120
+    return env, now
+
+
+def _allowed(env, now, reason=REASON_UNDERUTILIZED):
+    return env.disruption.budget_mapping(reason, now)["default"]
+
+
+class TestBudgetDenominator:
+    def test_healthy_fleet_counts_fully(self):
+        env, now = _fleet(10, "30%")
+        assert _allowed(env, now) == 3
+
+    def test_unmanaged_nodes_not_counted(self):
+        # suite_test.go:699
+        env, now = _fleet(10, "30%")
+        for i in range(5):
+            env.kube.create(Node(
+                metadata=ObjectMeta(name=f"byo-{i}",
+                                    labels={INSTANCE_TYPE_LABEL: "c2"}),
+                spec=NodeSpec(provider_id=f"external://byo-{i}"),
+                status=NodeStatus(capacity={"cpu": 2.0}),
+            ))
+        # 15 nodes on the cluster, but 30% applies to the 10 managed
+        assert _allowed(env, now) == 3
+
+    def test_uninitialized_nodes_not_counted(self):
+        # suite_test.go:712: replacements that aren't initialized yet
+        # must not pad the percentage denominator
+        env, now = _fleet(10, "30%")
+        for claim in env.kube.node_claims()[:4]:
+            claim.status_conditions.set_false(
+                COND_INITIALIZED, "NotReady", "test", now=now
+            )
+        # denominator drops to 6 -> ceil? (30% of 6 = 1.8 -> floor..)
+        assert _allowed(env, now) == env.kube.get_node_pool(
+            "default"
+        ).must_get_allowed_disruptions(now, 6, REASON_UNDERUTILIZED)
+
+    def test_instance_terminating_claims_not_counted(self):
+        # suite_test.go:743
+        env, now = _fleet(10, "30%")
+        for claim in env.kube.node_claims()[:4]:
+            claim.status_conditions.set_true(COND_INSTANCE_TERMINATING, now=now)
+        assert _allowed(env, now) == env.kube.get_node_pool(
+            "default"
+        ).must_get_allowed_disruptions(now, 6, REASON_UNDERUTILIZED)
+
+
+class TestBudgetConsumers:
+    def test_deleting_nodes_consume_allowance(self):
+        # suite_test.go:796 (deletionTimestamp + MarkedForDeletion)
+        env, now = _fleet(10, "30%")
+        names = [n.metadata.name for n in env.kube.nodes()[:2]]
+        for state in env.cluster.nodes():
+            if state.name in names:
+                state.marked_for_deletion = True
+        assert _allowed(env, now) == 1
+
+    def test_not_ready_nodes_consume_allowance(self):
+        # suite_test.go:820
+        env, now = _fleet(10, "30%")
+        for node in env.kube.nodes()[:2]:
+            node.status.conditions[0].status = "False"
+        assert _allowed(env, now) == 1
+
+    def test_never_negative(self):
+        # suite_test.go:775
+        env, now = _fleet(10, "20%")
+        for node in env.kube.nodes()[:5]:
+            node.status.conditions[0].status = "False"
+        assert _allowed(env, now) == 0
+
+    def test_mixed_exclusion_and_consumption(self):
+        env, now = _fleet(10, "50%")
+        claims = env.kube.node_claims()
+        # 2 uninitialized (excluded), 2 marked (consume)
+        for claim in claims[:2]:
+            claim.status_conditions.set_false(
+                COND_INITIALIZED, "NotReady", "test", now=now
+            )
+        excluded_pids = {c.status.provider_id for c in claims[:2]}
+        marked = 0
+        for state in env.cluster.nodes():
+            claim = state.node_claim
+            if claim is None or claim.status.provider_id in excluded_pids:
+                continue
+            if marked < 2:
+                state.marked_for_deletion = True
+                marked += 1
+        # denominator 8 -> 4 allowed; minus 2 consuming = 2
+        assert _allowed(env, now) == 2
+
+
+class TestBudgetApplication:
+    def test_emptiness_respects_consumed_allowance(self):
+        """The engine stops short when in-flight deletions already
+        consume the budget (suite_test.go budgets x methods)."""
+        env, now = _fleet(6, "2")
+        # free up all nodes
+        for pod in list(env.kube.pods()):
+            env.kube.delete(pod)
+        # two nodes already on their way out
+        for state in env.cluster.nodes()[:2]:
+            state.marked_for_deletion = True
+        assert _allowed(env, now) == 0
+        command = env.reconcile_disruption(now=now)
+        assert command is None
+
+    def test_multi_node_consolidation_bounded_by_budget(self):
+        env, now = _fleet(6, "2")
+        for pod in list(env.kube.pods()):
+            env.kube.delete(pod)
+        command = env.reconcile_disruption(now=now)
+        assert command is not None
+        assert len(command.candidates) <= 2

@@ -1,0 +1,394 @@
+"""Candidate-eligibility matrix for the disruption engine.
+
+Ports the reference's candidate-filtering scenario family
+(/root/reference/pkg/controllers/disruption/suite_test.go:917-1866 and
+types.go NewCandidate / statenode.go ValidatePodsDisruptable /
+pdb.go isEvictable): do-not-disrupt × terminationGracePeriod ×
+disruption class, mirror/daemonset/terminal/terminating pod PDBs,
+multiple PDBs on one pod, representation and label edge cases.
+"""
+
+import time
+
+from karpenter_tpu.apis.v1.labels import (
+    CAPACITY_TYPE_LABEL,
+    DO_NOT_DISRUPT_ANNOTATION,
+    INSTANCE_TYPE_LABEL,
+    NODEPOOL_LABEL,
+    TOPOLOGY_ZONE_LABEL,
+)
+from karpenter_tpu.apis.v1.nodeclaim import COND_CONSOLIDATABLE, COND_DRIFTED
+from karpenter_tpu.apis.v1.nodepool import (
+    REASON_DRIFTED,
+    REASON_EMPTY,
+    REASON_UNDERUTILIZED,
+)
+from karpenter_tpu.cloudprovider.fake import GIB, make_instance_type
+from karpenter_tpu.kube.objects import (
+    LabelSelector,
+    ObjectMeta,
+    OwnerReference,
+    PodDisruptionBudget,
+    PodDisruptionBudgetSpec,
+    Toleration,
+)
+from karpenter_tpu.testing import Environment, mk_nodepool, mk_pod
+
+
+def _env(tgp=None):
+    env = Environment(types=[
+        make_instance_type("c2", cpu=2, memory=8 * GIB, price=2.0),
+        make_instance_type("c8", cpu=8, memory=32 * GIB, price=5.0),
+    ])
+    pool = mk_nodepool("default")
+    pool.spec.disruption.consolidate_after = "0s"
+    if tgp is not None:
+        pool.spec.template.spec.termination_grace_period = tgp
+    env.kube.create(pool)
+    return env
+
+
+def _provisioned(env, *pods):
+    if not pods:
+        pods = (mk_pod(cpu=0.5, labels={"app": "web"}),)
+    env.provision(*pods)
+    assert env.kube.nodes(), "setup: provisioning failed"
+    # refresh conditions (consolidatable etc.) once
+    now = time.time() + 120
+    env.reconcile_disruption(now=now)
+    return now + 11
+
+
+def _candidates(env, now, reason=REASON_UNDERUTILIZED):
+    return env.disruption.get_candidates(reason, now)
+
+
+def _blocking_pdb(env, labels=None, name="pdb"):
+    env.kube.create(PodDisruptionBudget(
+        metadata=ObjectMeta(name=name),
+        spec=PodDisruptionBudgetSpec(
+            selector=LabelSelector.of(labels or {"app": "web"}),
+            max_unavailable=0,
+        ),
+    ))
+
+
+def _mirror_pod(node_name, labels=None):
+    pod = mk_pod(cpu=0.1, labels=labels or {"app": "web"}, owner=None)
+    pod.metadata.owner_references = [
+        OwnerReference(kind="Node", name=node_name, uid="uid-node",
+                       controller=True, api_version="v1"),
+    ]
+    pod.spec.node_name = node_name
+    return pod
+
+
+def _daemon_pod(node_name, labels=None):
+    pod = mk_pod(cpu=0.1, labels=labels or {"app": "web"}, owner="DaemonSet")
+    pod.spec.node_name = node_name
+    return pod
+
+
+class TestDoNotDisruptPods:
+    """suite_test.go:917-1304: the annotation blocks GRACEFUL
+    disruption unconditionally; EVENTUAL disruption (drift) proceeds
+    when the claim carries a TerminationGracePeriod."""
+
+    def test_do_not_disrupt_pod_blocks_graceful(self):
+        env = _env()
+        pod = mk_pod(cpu=0.5, labels={"app": "web"})
+        pod.metadata.annotations[DO_NOT_DISRUPT_ANNOTATION] = "true"
+        now = _provisioned(env, pod)
+        assert _candidates(env, now) == []
+
+    def test_do_not_disrupt_pod_blocks_graceful_even_with_tgp(self):
+        # suite_test.go:1083: TGP does NOT unlock consolidation
+        env = _env(tgp="1h")
+        pod = mk_pod(cpu=0.5, labels={"app": "web"})
+        pod.metadata.annotations[DO_NOT_DISRUPT_ANNOTATION] = "true"
+        now = _provisioned(env, pod)
+        assert _candidates(env, now, REASON_UNDERUTILIZED) == []
+        assert _candidates(env, now, REASON_EMPTY) == []
+
+    def test_do_not_disrupt_pod_allows_eventual_with_tgp(self):
+        # suite_test.go:1022: drift + TGP considers the candidate
+        env = _env(tgp="1h")
+        pod = mk_pod(cpu=0.5, labels={"app": "web"})
+        pod.metadata.annotations[DO_NOT_DISRUPT_ANNOTATION] = "true"
+        now = _provisioned(env, pod)
+        claim = env.kube.node_claims()[0]
+        claim.status_conditions.set_true(COND_DRIFTED, now=now)
+        assert len(_candidates(env, now, REASON_DRIFTED)) == 1
+
+    def test_do_not_disrupt_pod_blocks_eventual_without_tgp(self):
+        # suite_test.go:1148: no TGP -> the drain could hang forever
+        env = _env()
+        pod = mk_pod(cpu=0.5, labels={"app": "web"})
+        pod.metadata.annotations[DO_NOT_DISRUPT_ANNOTATION] = "true"
+        now = _provisioned(env, pod)
+        claim = env.kube.node_claims()[0]
+        claim.status_conditions.set_true(COND_DRIFTED, now=now)
+        assert _candidates(env, now, REASON_DRIFTED) == []
+
+    def test_do_not_disrupt_mirror_pod_blocks(self):
+        # suite_test.go:945: mirror pods may block via the annotation
+        env = _env()
+        now = _provisioned(env)
+        node = env.kube.nodes()[0]
+        mirror = _mirror_pod(node.metadata.name)
+        mirror.metadata.annotations[DO_NOT_DISRUPT_ANNOTATION] = "true"
+        env.kube.create(mirror)
+        env.kube.bind_pod(mirror, node.metadata.name)
+        assert _candidates(env, now) == []
+
+    def test_do_not_disrupt_daemonset_pod_blocks(self):
+        # suite_test.go:983
+        env = _env()
+        now = _provisioned(env)
+        node = env.kube.nodes()[0]
+        daemon = _daemon_pod(node.metadata.name)
+        daemon.metadata.annotations[DO_NOT_DISRUPT_ANNOTATION] = "true"
+        env.kube.create(daemon)
+        env.kube.bind_pod(daemon, node.metadata.name)
+        assert _candidates(env, now) == []
+
+    def test_do_not_disrupt_terminating_pod_does_not_block(self):
+        # suite_test.go:1211: only ACTIVE pods count
+        env = _env()
+        pod = mk_pod(cpu=0.5, labels={"app": "web"})
+        pod.metadata.annotations[DO_NOT_DISRUPT_ANNOTATION] = "true"
+        extra = mk_pod(cpu=0.5, labels={"app": "web"})
+        now = _provisioned(env, pod, extra)
+        live = env.kube.get_pod("default", pod.metadata.name)
+        live.metadata.deletion_timestamp = now  # terminating, not gone
+        live.metadata.finalizers.append("wedge")
+        assert len(_candidates(env, now)) == 1
+
+    def test_do_not_disrupt_terminal_pod_does_not_block(self):
+        # suite_test.go:1241
+        env = _env()
+        pod = mk_pod(cpu=0.5, labels={"app": "web"})
+        pod.metadata.annotations[DO_NOT_DISRUPT_ANNOTATION] = "true"
+        extra = mk_pod(cpu=0.5, labels={"app": "web"})
+        now = _provisioned(env, pod, extra)
+        env.kube.get_pod("default", pod.metadata.name).status.phase = "Succeeded"
+        assert len(_candidates(env, now)) == 1
+
+    def test_do_not_disrupt_node_annotation_blocks(self):
+        # suite_test.go:1279 (node-level annotation)
+        env = _env()
+        now = _provisioned(env)
+        node = env.kube.nodes()[0]
+        node.metadata.annotations[DO_NOT_DISRUPT_ANNOTATION] = "true"
+        assert _candidates(env, now) == []
+
+
+class TestPdbBlockedPods:
+    """suite_test.go:1051-1620: PDB semantics on the candidate gate."""
+
+    def test_fully_blocking_pdb_blocks_graceful(self):
+        env = _env()
+        now = _provisioned(env)
+        _blocking_pdb(env)
+        assert _candidates(env, now) == []
+
+    def test_pdb_blocked_allows_eventual_with_tgp(self):
+        # suite_test.go:1051: drift + TGP overrides the PDB block
+        env = _env(tgp="1h")
+        now = _provisioned(env)
+        _blocking_pdb(env)
+        claim = env.kube.node_claims()[0]
+        claim.status_conditions.set_true(COND_DRIFTED, now=now)
+        assert len(_candidates(env, now, REASON_DRIFTED)) == 1
+
+    def test_pdb_blocked_blocks_graceful_with_tgp(self):
+        # suite_test.go:1112
+        env = _env(tgp="1h")
+        now = _provisioned(env)
+        _blocking_pdb(env)
+        assert _candidates(env, now, REASON_UNDERUTILIZED) == []
+
+    def test_pdb_blocked_blocks_eventual_without_tgp(self):
+        # suite_test.go:1176
+        env = _env()
+        now = _provisioned(env)
+        _blocking_pdb(env)
+        claim = env.kube.node_claims()[0]
+        claim.status_conditions.set_true(COND_DRIFTED, now=now)
+        assert _candidates(env, now, REASON_DRIFTED) == []
+
+    def test_multiple_pdbs_on_same_pod_block(self):
+        # suite_test.go:1302: kube's eviction API refuses multi-PDB
+        # pods outright, so even two PERMISSIVE PDBs block
+        env = _env()
+        now = _provisioned(env)
+        for name in ("pdb-a", "pdb-b"):
+            env.kube.create(PodDisruptionBudget(
+                metadata=ObjectMeta(name=name),
+                spec=PodDisruptionBudgetSpec(
+                    selector=LabelSelector.of({"app": "web"}),
+                    max_unavailable=10,
+                ),
+            ))
+        assert _candidates(env, now) == []
+
+    def test_blocking_pdb_on_daemonset_pod_blocks(self):
+        # suite_test.go:1388: daemonset pods ARE evictable, their PDBs
+        # count against the candidate
+        env = _env()
+        now = _provisioned(env, mk_pod(cpu=0.5, labels={"app": "other"}))
+        node = env.kube.nodes()[0]
+        daemon = _daemon_pod(node.metadata.name, labels={"app": "ds"})
+        env.kube.create(daemon)
+        env.kube.bind_pod(daemon, node.metadata.name)
+        _blocking_pdb(env, labels={"app": "ds"})
+        assert _candidates(env, now) == []
+
+    def test_blocking_pdb_on_mirror_pod_does_not_block(self):
+        # suite_test.go:1435: mirror pods are never evicted through the
+        # API, so their PDBs are irrelevant
+        env = _env()
+        now = _provisioned(env, mk_pod(cpu=0.5, labels={"app": "other"}))
+        node = env.kube.nodes()[0]
+        mirror = _mirror_pod(node.metadata.name, labels={"app": "mirror"})
+        env.kube.create(mirror)
+        env.kube.bind_pod(mirror, node.metadata.name)
+        _blocking_pdb(env, labels={"app": "mirror"})
+        assert len(_candidates(env, now)) == 1
+
+    def test_blocking_pdb_on_terminal_pod_does_not_block(self):
+        # suite_test.go:1546
+        env = _env()
+        doomed = mk_pod(cpu=0.5, labels={"app": "web"})
+        keeper = mk_pod(cpu=0.5, labels={"app": "other"})
+        now = _provisioned(env, doomed, keeper)
+        env.kube.get_pod("default", doomed.metadata.name).status.phase = "Failed"
+        _blocking_pdb(env)
+        assert len(_candidates(env, now)) == 1
+
+    def test_blocking_pdb_on_terminating_pod_does_not_block(self):
+        # suite_test.go:1590
+        env = _env()
+        doomed = mk_pod(cpu=0.5, labels={"app": "web"})
+        keeper = mk_pod(cpu=0.5, labels={"app": "other"})
+        now = _provisioned(env, doomed, keeper)
+        live = env.kube.get_pod("default", doomed.metadata.name)
+        live.metadata.finalizers.append("wedge")
+        env.kube.delete(live, now=now)
+        assert len(_candidates(env, now)) == 1
+
+    def test_pod_tolerating_disrupted_taint_bypasses_pdb(self):
+        # pdb.go isEvictable via IsEvictable: pods that opted to ride
+        # the node down are not evicted, so their PDBs don't block
+        env = _env()
+        rider = mk_pod(cpu=0.5, labels={"app": "web"})
+        rider.spec.tolerations = [
+            Toleration(key="karpenter.sh/disrupted", operator="Exists")
+        ]
+        keeper = mk_pod(cpu=0.5, labels={"app": "other"})
+        now = _provisioned(env, rider, keeper)
+        _blocking_pdb(env)
+        assert len(_candidates(env, now)) == 1
+
+
+class TestRepresentationAndLabels:
+    """suite_test.go:1628-1866: node/claim representation and label
+    edge cases."""
+
+    def test_node_only_representation_not_a_candidate(self):
+        # suite_test.go:1628: a Node with no NodeClaim is unmanaged
+        env = _env()
+        now = _provisioned(env)
+        from karpenter_tpu.kube.objects import Node, NodeSpec, NodeStatus
+
+        env.kube.create(Node(
+            metadata=ObjectMeta(
+                name="orphan",
+                labels={NODEPOOL_LABEL: "default",
+                        INSTANCE_TYPE_LABEL: "c2"},
+            ),
+            spec=NodeSpec(provider_id="external://orphan"),
+            status=NodeStatus(capacity={"cpu": 2.0}),
+        ))
+        names = {c.state_node.name for c in _candidates(env, now)}
+        assert "orphan" not in names
+
+    def test_claim_only_representation_not_a_candidate(self):
+        # suite_test.go:1647: an in-flight claim (no Node yet) is not
+        # disruptable
+        env = _env()
+        now = _provisioned(env)
+        pool = env.kube.get_node_pool("default")
+        # launch a second claim without letting it register
+        env.kube.create(mk_pod(name="late", cpu=1.9))
+        env.provisioner.batcher.trigger()
+        env.provisioner.reconcile(now=now)
+        claims = env.kube.node_claims()
+        assert len(claims) == 2
+        assert len(env.kube.nodes()) == 1  # second claim not registered
+        cands = _candidates(env, now)
+        assert all(c.state_node.node is not None for c in cands)
+
+    def test_missing_capacity_type_label_still_considered(self):
+        # suite_test.go:1794
+        env = _env()
+        now = _provisioned(env)
+        node = env.kube.nodes()[0]
+        node.metadata.labels.pop(CAPACITY_TYPE_LABEL, None)
+        assert len(_candidates(env, now, REASON_EMPTY)) >= 0
+        # still a candidate for emptiness paths (no price needed)
+        env.kube.delete(env.kube.pods()[0])
+        assert len(_candidates(env, now, REASON_EMPTY)) == 1
+
+    def test_missing_zone_label_still_considered(self):
+        # suite_test.go:1811
+        env = _env()
+        now = _provisioned(env)
+        env.kube.nodes()[0].metadata.labels.pop(TOPOLOGY_ZONE_LABEL, None)
+        env.kube.delete(env.kube.pods()[0])
+        assert len(_candidates(env, now, REASON_EMPTY)) == 1
+
+    def test_unresolvable_instance_type_considered_for_emptiness(self):
+        # suite_test.go:1828-1845: price-free reasons tolerate an
+        # unknown instance type; consolidation excludes it
+        env = _env()
+        now = _provisioned(env)
+        env.kube.nodes()[0].metadata.labels[INSTANCE_TYPE_LABEL] = "ghost"
+        claim = env.kube.node_claims()[0]
+        claim.metadata.labels[INSTANCE_TYPE_LABEL] = "ghost"
+        env.kube.delete(env.kube.pods()[0])
+        assert len(_candidates(env, now, REASON_EMPTY)) == 1
+        assert _candidates(env, now, REASON_UNDERUTILIZED) == []
+
+    def test_nonexistent_nodepool_not_a_candidate(self):
+        # suite_test.go:1769
+        env = _env()
+        now = _provisioned(env)
+        env.kube.delete(env.kube.get_node_pool("default"))
+        assert _candidates(env, now) == []
+
+    def test_no_nodepool_label_not_a_candidate(self):
+        # suite_test.go:1750
+        env = _env()
+        now = _provisioned(env)
+        env.kube.nodes()[0].metadata.labels.pop(NODEPOOL_LABEL, None)
+        claim = env.kube.node_claims()[0]
+        claim.metadata.labels.pop(NODEPOOL_LABEL, None)
+        assert _candidates(env, now) == []
+
+    def test_queued_candidate_not_recandidated(self):
+        # suite_test.go:1866: nodes already being processed by the
+        # orchestration queue are off the table
+        env = _env()
+        pod_a = mk_pod(cpu=1.9, labels={"app": "a"},
+                       node_selector={INSTANCE_TYPE_LABEL: "c2"})
+        pod_b = mk_pod(cpu=1.9, labels={"app": "b"},
+                       node_selector={INSTANCE_TYPE_LABEL: "c2"})
+        now = _provisioned(env, pod_a, pod_b)
+        assert len(env.kube.nodes()) == 2
+        command = env.reconcile_disruption(now=now)
+        if command is None:
+            return  # nothing consolidatable in this shape; covered elsewhere
+        queued = {c.state_node.name for c in command.candidates}
+        still = {c.state_node.name for c in _candidates(env, now)}
+        assert not (queued & still)

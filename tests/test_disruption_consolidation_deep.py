@@ -1,0 +1,597 @@
+"""Consolidation long-tail scenarios.
+
+Ports uncovered families from
+/root/reference/pkg/controllers/disruption/consolidation_test.go and
+suite_test.go:177-454: policy/TTL gating, budget shapes across
+methods and pools, spot-to-spot flexibility rules, price-regression
+guards, delete-vs-pending interactions, churn windows, and
+multi-command queue behavior.
+"""
+
+import time
+
+from karpenter_tpu.apis.v1.labels import (
+    CAPACITY_TYPE_LABEL,
+    DO_NOT_DISRUPT_ANNOTATION,
+    INSTANCE_TYPE_LABEL,
+)
+from karpenter_tpu.apis.v1.nodepool import (
+    Budget,
+    CONSOLIDATION_WHEN_EMPTY,
+    REASON_EMPTY,
+    REASON_UNDERUTILIZED,
+)
+from karpenter_tpu.cloudprovider.fake import GIB, make_instance_type
+from karpenter_tpu.kube.objects import (
+    LabelSelector,
+    Node,
+    NodeSpec,
+    NodeStatus,
+    ObjectMeta,
+    PodDisruptionBudget,
+    PodDisruptionBudgetSpec,
+)
+from karpenter_tpu.testing import Environment, mk_nodepool, mk_pod
+
+OD = {CAPACITY_TYPE_LABEL: "on-demand"}
+
+
+def _types():
+    return [
+        make_instance_type("c2", cpu=2, memory=8 * GIB, price=2.0),
+        make_instance_type("c4", cpu=4, memory=16 * GIB, price=3.0),
+        make_instance_type("c8", cpu=8, memory=32 * GIB, price=5.0),
+    ]
+
+
+def _env(types=None, pool_name="default", **disruption_kwargs):
+    env = Environment(types=types or _types())
+    pool = mk_nodepool(pool_name)
+    pool.spec.disruption.consolidate_after = "0s"
+    for key, value in disruption_kwargs.items():
+        setattr(pool.spec.disruption, key, value)
+    env.kube.create(pool)
+    return env
+
+
+def _small_nodes(env, n, cpu=1.9, labels=None, selector=None):
+    """n single-pod c2 nodes."""
+    pods = []
+    for i in range(n):
+        pod = mk_pod(cpu=cpu, labels=dict(labels or {}),
+                     node_selector={INSTANCE_TYPE_LABEL: "c2",
+                                    **(selector or {})})
+        env.provision(pod)
+        pods.append(pod)
+    return pods
+
+
+def _probe(env, now):
+    """Refresh conditions WITHOUT running the engine (a full
+    reconcile_disruption could already execute a command, marking the
+    node and emptying the candidate set the test wants to inspect)."""
+    env.pod_events.reconcile_all(now=now)
+    env.conditions.reconcile_all(now=now)
+
+
+def _drain_all(env, start, rounds=20):
+    now = start
+    for _ in range(rounds):
+        env.reconcile_disruption(now=now)
+        now += 11
+    return now
+
+
+class TestPolicyGating:
+    def test_when_empty_policy_blocks_underutilized(self):
+        # consolidation_test.go ConsolidationDisabled family: policy
+        # WhenEmpty forbids the underutilized method entirely
+        env = _env(consolidation_policy=CONSOLIDATION_WHEN_EMPTY)
+        _small_nodes(env, 2)  # 1.9cpu -> one pod per c2 node
+        now = time.time() + 120
+        env.reconcile_disruption(now=now)
+        cands = env.disruption.get_candidates(REASON_UNDERUTILIZED, now + 11)
+        assert cands == []
+        # but emptiness still works
+        for pod in list(env.kube.pods()):
+            env.kube.delete(pod)
+        assert len(env.disruption.get_candidates(REASON_EMPTY, now + 22)) == 2
+
+    def test_consolidate_after_never_blocks_both(self):
+        env = _env(consolidate_after="Never")
+        _small_nodes(env, 2)
+        now = time.time() + 120
+        env.reconcile_disruption(now=now)
+        assert env.disruption.get_candidates(REASON_UNDERUTILIZED, now + 11) == []
+        for pod in list(env.kube.pods()):
+            env.kube.delete(pod)
+        assert env.disruption.get_candidates(REASON_EMPTY, now + 22) == []
+
+    def test_non_empty_nodes_wait_for_consolidate_after_ttl(self):
+        # "should wait for the node TTL for non-empty nodes before
+        # consolidating": pod events restart the clock
+        env = _env(consolidate_after="5m")
+        _small_nodes(env, 2)
+        base = time.time()
+        env.reconcile_disruption(now=base + 60)
+        # 1 minute after the last pod event: not consolidatable yet
+        assert env.disruption.get_candidates(
+            REASON_UNDERUTILIZED, base + 61
+        ) == []
+        # past the 5m TTL: eligible
+        env.reconcile_disruption(now=base + 360)
+        assert len(env.disruption.get_candidates(
+            REASON_UNDERUTILIZED, base + 361
+        )) == 2
+
+
+class TestBudgetShapes:
+    def _empty_fleet(self, budget_nodes, n=5):
+        env = _env(budgets=[Budget(nodes=budget_nodes)])
+        _small_nodes(env, n)
+        for pod in list(env.kube.pods()):
+            env.kube.delete(pod)
+        return env, time.time() + 120
+
+    def test_only_three_empty_nodes_disrupted(self):
+        env, now = self._empty_fleet("3")
+        command = env.reconcile_disruption(now=now)
+        assert command is not None and command.reason == REASON_EMPTY
+        assert len(command.candidates) == 3
+
+    def test_all_empty_nodes_disrupted(self):
+        env, now = self._empty_fleet("100%")
+        command = env.reconcile_disruption(now=now)
+        assert command is not None
+        assert len(command.candidates) == 5
+
+    def test_no_empty_nodes_disrupted(self):
+        env, now = self._empty_fleet("0")
+        assert env.reconcile_disruption(now=now) is None
+        assert len(env.kube.nodes()) == 5
+
+    def test_per_pool_budgets_cap_each_pool(self):
+        # "should allow 2 nodes from each nodePool to be deleted"
+        env = Environment(types=_types())
+        for name in ("pool-a", "pool-b"):
+            pool = mk_nodepool(name)
+            pool.spec.disruption.consolidate_after = "0s"
+            pool.spec.disruption.budgets = [Budget(nodes="2")]
+            env.kube.create(pool)
+        from karpenter_tpu.apis.v1.labels import NODEPOOL_LABEL
+
+        for name in ("pool-a", "pool-b"):
+            for i in range(3):
+                env.provision(mk_pod(
+                    cpu=1.9,
+                    node_selector={NODEPOOL_LABEL: name,
+                                   INSTANCE_TYPE_LABEL: "c2"},
+                ))
+        assert len(env.kube.nodes()) == 6
+        for pod in list(env.kube.pods()):
+            env.kube.delete(pod)
+        now = time.time() + 120
+        command = env.reconcile_disruption(now=now)
+        assert command is not None
+        by_pool = {}
+        for c in command.candidates:
+            by_pool[c.node_pool.metadata.name] = by_pool.get(
+                c.node_pool.metadata.name, 0
+            ) + 1
+        assert all(v <= 2 for v in by_pool.values()), by_pool
+
+    def test_zero_budget_does_not_mark_consolidated(self):
+        # "should not mark empty node consolidated if the candidates
+        # can't be disrupted due to budgets": nothing executes, nodes
+        # stay, and a later budget opens the path
+        env, now = self._empty_fleet("0")
+        assert env.reconcile_disruption(now=now) is None
+        pool = env.kube.get_node_pool("default")
+        pool.spec.disruption.budgets = []
+        end = _drain_all(env, now + 11)
+        assert len(env.kube.nodes()) == 0
+
+
+class TestSpotToSpot:
+    def _spot_env(self, n_types, gate=True):
+        from karpenter_tpu.operator.options import FeatureGates, Options
+
+        types = [
+            make_instance_type(f"s{i}", cpu=2, memory=8 * GIB,
+                               price=1.0 + 0.05 * i)
+            for i in range(n_types)
+        ]
+        env = Environment(
+            types=types,
+            options=Options(feature_gates=FeatureGates(
+                spot_to_spot_consolidation=gate
+            )),
+        )
+        pool = mk_nodepool("default")
+        pool.spec.disruption.consolidate_after = "0s"
+        env.kube.create(pool)
+        return env
+
+    def _one_spot_node(self, env, type_name):
+        pod = mk_pod(cpu=0.4, node_selector={
+            INSTANCE_TYPE_LABEL: type_name,
+            CAPACITY_TYPE_LABEL: "spot",
+        })
+        env.provision(pod)
+        assert len(env.kube.nodes()) == 1
+        # free the selector so a replacement may choose freely
+        live = env.kube.get_pod("default", pod.metadata.name)
+        live.spec.node_selector = {}
+        return pod
+
+    def test_spot_to_spot_blocked_below_min_flexibility(self):
+        # "cannot replace spot with spot if less than minimum
+        # InstanceTypes flexibility" (15 required)
+        env = self._spot_env(10)
+        self._one_spot_node(env, "s9")
+        now = time.time() + 120
+        _probe(env, now)
+        assert env.disruption.single_node_consolidation(now + 11) is None
+        assert len(env.kube.nodes()) == 1
+
+    def test_spot_to_spot_blocked_when_gate_disabled(self):
+        env = self._spot_env(20, gate=False)
+        self._one_spot_node(env, "s19")
+        now = time.time() + 120
+        _probe(env, now)
+        assert env.disruption.single_node_consolidation(now + 11) is None
+
+    def test_spot_to_spot_replaces_with_enough_flexibility(self):
+        env = self._spot_env(20)
+        self._one_spot_node(env, "s19")
+        now = time.time() + 120
+        _probe(env, now)
+        command = env.disruption.single_node_consolidation(now + 11)
+        assert command is not None
+        plan = command.results.new_node_plans[0]
+        # launch set truncated to the 15 cheapest and all spot
+        assert len(plan.instance_types) == 15
+        assert all(o.capacity_type == "spot" for o in plan.offerings)
+
+    def test_spot_node_already_among_cheapest_not_replaced(self):
+        # "cannot replace spot with spot if it is part of the 15
+        # cheapest instance types"
+        env = self._spot_env(20)
+        self._one_spot_node(env, "s0")  # the cheapest
+        now = time.time() + 120
+        _probe(env, now)
+        assert env.disruption.single_node_consolidation(now + 11) is None
+
+
+class TestPriceRegression:
+    def test_wont_replace_od_when_od_replacement_not_cheaper(self):
+        # "won't replace on-demand node if on-demand replacement is
+        # more expensive": the only type IS the current type
+        env = _env(types=[
+            make_instance_type("c2", cpu=2, memory=8 * GIB, price=2.0),
+        ])
+        env.provision(mk_pod(cpu=0.4, node_selector=dict(OD)))
+        now = time.time() + 120
+        _probe(env, now)
+        assert env.disruption.single_node_consolidation(now + 11) is None
+        assert len(env.kube.nodes()) == 1
+
+
+class TestDeleteScenarios:
+    def test_can_delete_nodes(self):
+        env = _env()
+        _small_nodes(env, 3)
+        # two of three workloads leave: the rest fits one node
+        for pod in list(env.kube.pods())[:2]:
+            env.kube.delete(pod)
+        end = _drain_all(env, time.time() + 120)
+        assert len(env.kube.nodes()) == 1
+
+    def test_pod_churn_blocks_that_node_only(self):
+        # "does not delete nodes with pod churn, deletes nodes
+        # without pod churn": a fresh pod event resets the
+        # consolidatable TTL for its node alone
+        env = _env(consolidate_after="2m")
+        _small_nodes(env, 2)
+        base = time.time()
+        nodes = env.kube.nodes()
+        churned = nodes[0].metadata.name
+        # churn on node 0 at +150 (the pod-events controller stamps
+        # lastPodEventTime on bind; the informer records wall-clock
+        # bind times, so the simulated-time churn is applied directly
+        # at the claim level here): its TTL restarts
+        for state in env.cluster.nodes():
+            if state.name == churned:
+                state.node_claim.status.last_pod_event_time = base + 150
+                env.kube.touch(state.node_claim)
+        _probe(env, base + 160)
+        cands = env.disruption.get_candidates(REASON_UNDERUTILIZED, base + 161)
+        names = {c.state_node.name for c in cands}
+        assert churned not in names, "churned node TTL did not restart"
+        assert len(names) == 1
+
+    def test_can_delete_when_non_karpenter_capacity_fits_pods(self):
+        # "can delete nodes, when non-Karpenter capacity can fit pods"
+        env = _env()
+        # no instance-type selector: the pod must be able to land on
+        # the BYO node's shape after the managed node is deleted
+        env.provision(mk_pod(cpu=0.4))
+        assert len(env.kube.nodes()) == 1
+        # a BYO node with room: consolidation may move the pod there
+        byo = Node(
+            metadata=ObjectMeta(name="byo", labels={
+                INSTANCE_TYPE_LABEL: "c8",
+                "kubernetes.io/hostname": "byo",
+            }),
+            spec=NodeSpec(provider_id="external://byo"),
+            status=NodeStatus(
+                capacity={"cpu": 8.0, "memory": 32 * GIB, "pods": 110.0},
+                allocatable={"cpu": 8.0, "memory": 32 * GIB, "pods": 110.0},
+            ),
+        )
+        byo.status.conditions = []
+        from karpenter_tpu.kube.objects import NodeCondition
+
+        byo.status.conditions.append(
+            NodeCondition(type="Ready", status="True")
+        )
+        env.kube.create(byo)
+        end = _drain_all(env, time.time() + 120)
+        managed = [n for n in env.kube.nodes()
+                   if n.metadata.name != "byo"]
+        assert managed == []
+        live = [p for p in env.kube.pods() if not p.is_terminal()]
+        assert all(p.spec.node_name == "byo" for p in live)
+
+    def test_deletes_evict_ownerless_pods(self):
+        # "can delete nodes, evicts pods without an ownerRef": a bare
+        # pod does not block the consolidation delete; it is evicted
+        # through the eviction API like any other pod (and, being
+        # ownerless, nothing recreates it — same as a real cluster)
+        env = _env()
+        a = mk_pod(cpu=0.5, node_selector={INSTANCE_TYPE_LABEL: "c2"})
+        env.provision(a)
+        bare = mk_pod(cpu=1.9, owner=None,
+                      node_selector={INSTANCE_TYPE_LABEL: "c2"})
+        env.provision(bare)  # second c2, holding only the bare pod
+        assert len(env.kube.nodes()) == 2
+        # drop the selectors so consolidation may repack freely
+        for pod in env.kube.pods():
+            pod.spec.node_selector = {}
+        end = _drain_all(env, time.time() + 120)
+        # fleet consolidated; the owned pod survives somewhere, the
+        # bare pod was evicted terminally
+        assert len(env.kube.nodes()) == 1
+        names = {p.metadata.name for p in env.kube.pods()
+                 if not p.is_terminal()}
+        assert a.metadata.name in names
+        assert bare.metadata.name not in names
+
+    def test_permanently_pending_pod_does_not_block_delete(self):
+        # "can delete nodes with a permanently pending pod"
+        env = _env()
+        _small_nodes(env, 2)
+        env.kube.create(mk_pod(name="impossible", cpu=10000.0))
+        env.provisioner.batcher.trigger()
+        env.provisioner.reconcile(now=time.time())
+        for pod in list(env.kube.pods())[:1]:
+            if pod.spec.node_name:
+                env.kube.delete(pod)
+        end = _drain_all(env, time.time() + 120)
+        assert len(env.kube.nodes()) <= 2
+        assert env.kube.get_pod("default", "impossible") is not None
+
+    def test_wont_make_non_pending_pod_pending(self):
+        # "won't delete nodes if it would make a non-pending pod go
+        # pending": full fleet, nothing to consolidate
+        env = _env(types=[
+            make_instance_type("c2", cpu=2, memory=8 * GIB, price=2.0),
+        ])
+        _small_nodes(env, 3)
+        now = time.time() + 120
+        env.reconcile_disruption(now=now)
+        command = env.reconcile_disruption(now=now + 11)
+        assert command is None
+        assert len(env.kube.nodes()) == 3
+
+    def test_can_delete_while_invalid_nodepool_exists(self):
+        # "can delete nodes while an invalid node pool exists"
+        env = _env()
+        broken = mk_nodepool("broken")
+        broken.spec.template.spec.node_class_ref = None
+        env.kube.create(broken)
+        _small_nodes(env, 2)
+        for pod in list(env.kube.pods()):
+            env.kube.delete(pod)
+        end = _drain_all(env, time.time() + 120)
+        assert len(env.kube.nodes()) == 0
+
+
+class TestSchedulingInteractions:
+    """suite_test.go:177-454 + consolidation_test.go interactions
+    between consolidation and the provisioner."""
+
+    def test_successive_replace_operations(self):
+        # suite_test.go:242: replaces chain — each command completes
+        # before the next fires, converging stepwise to a cheaper fleet
+        env = _env()
+        for i in range(3):
+            env.provision(mk_pod(cpu=0.5,
+                                 node_selector={INSTANCE_TYPE_LABEL: "c2"}))
+        for pod in env.kube.pods():
+            pod.spec.node_selector = {}
+        start_price = 3 * 2.0
+        end = _drain_all(env, time.time() + 120, rounds=25)
+        assert len(env.kube.nodes()) == 1
+        live = [p for p in env.kube.pods() if not p.is_terminal()]
+        assert len(live) == 3
+        assert all(p.spec.node_name for p in live)
+
+    def test_no_duplicate_capacity_with_provisioning(self):
+        # suite_test.go:454: pods on a disrupted (marked) node must not
+        # ALSO trigger the provisioner to buy capacity for them — the
+        # command's replacement already covers them
+        env = _env()
+        for i in range(2):
+            env.provision(mk_pod(cpu=1.9,
+                                 node_selector={INSTANCE_TYPE_LABEL: "c2"}))
+        for pod in env.kube.pods():
+            pod.spec.node_selector = {}
+        now = time.time() + 120
+        env.pod_events.reconcile_all(now=now)
+        env.conditions.reconcile_all(now=now)
+        command = env.disruption.reconcile(now=now + 11)
+        if command is None:
+            return  # fleet already optimal at this shape
+        claims_after_command = len(env.kube.node_claims())
+        # a provisioning pass right now must not buy more capacity:
+        # the disrupted nodes' pods are still bound (drain hasn't
+        # started) and replacements are in flight
+        env.provisioner.batcher.trigger()
+        env.provisioner.reconcile(now=now + 12)
+        assert len(env.kube.node_claims()) == claims_after_command
+
+    def test_node_launched_for_deleting_node_pods_not_consolidated(self):
+        # "should not consolidate a node that is launched for pods on
+        # a deleting node": the replacement gets a nomination window
+        env = _env()
+        env.provision(mk_pod(cpu=1.9,
+                             node_selector={INSTANCE_TYPE_LABEL: "c2"}))
+        node = env.kube.nodes()[0]
+        claim = env.kube.node_claims()[0]
+        # drain the node: its pod reschedules onto a fresh claim
+        env.kube.delete(claim)
+        now = time.time() + 120
+        count_before = len(env.kube.node_claims())
+        end = _drain_all(env, now, rounds=6)
+        fresh = [c for c in env.kube.node_claims()
+                 if c.metadata.name != claim.metadata.name]
+        assert fresh, "replacement never launched"
+        state = env.cluster.node_for_key(fresh[0].metadata.name)
+        node_state = (
+            state if state is not None
+            else env.cluster.node_for_name(fresh[0].status.node_name)
+        )
+        if node_state is not None:
+            assert node_state.nominated(end) or not node_state.nominated(
+                end + 600
+            )  # nomination window exists and expires
+
+    def test_pending_pods_during_consolidation_not_double_provisioned(self):
+        # "should not schedule an additional node when receiving
+        # pending pods while consolidating": the in-flight command's
+        # replacement capacity is visible to the provisioner
+        env = _env()
+        for i in range(2):
+            env.provision(mk_pod(cpu=0.5,
+                                 node_selector={INSTANCE_TYPE_LABEL: "c2"}))
+        for pod in env.kube.pods():
+            pod.spec.node_selector = {}
+        now = time.time() + 120
+        env.pod_events.reconcile_all(now=now)
+        env.conditions.reconcile_all(now=now)
+        command = env.disruption.reconcile(now=now + 11)
+        # a small pod arrives mid-command: it must fit existing or
+        # in-flight capacity, not open ANOTHER node beyond the plan
+        env.kube.create(mk_pod(name="latecomer", cpu=0.2))
+        env.provisioner.batcher.trigger()
+        env.provisioner.reconcile(now=now + 12)
+        end = _drain_all(env, now + 13, rounds=20)
+        live = [p for p in env.kube.pods() if not p.is_terminal()]
+        assert all(p.spec.node_name for p in live)
+        assert len(env.kube.nodes()) <= 2
+
+
+class TestTopologyAwareConsolidation:
+    def test_replace_maintains_zonal_topology_spread(self):
+        # "can replace node maintaining zonal topology spread"
+        from karpenter_tpu.kube.objects import (
+            LabelSelector as LS,
+            TopologySpreadConstraint,
+        )
+
+        env = _env()
+        pods = []
+        for i in range(3):
+            pod = mk_pod(cpu=0.4, labels={"app": "spread"})
+            pod.spec.topology_spread_constraints = [
+                TopologySpreadConstraint(
+                    max_skew=1,
+                    topology_key="topology.kubernetes.io/zone",
+                    when_unsatisfiable="DoNotSchedule",
+                    label_selector=LS.of({"app": "spread"}),
+                )
+            ]
+            pods.append(pod)
+        env.provision(*pods)
+        zones_before = sorted(
+            env.kube.get_node(p.spec.node_name).metadata.labels.get(
+                "topology.kubernetes.io/zone", ""
+            )
+            for p in env.kube.pods()
+        )
+        end = _drain_all(env, time.time() + 120, rounds=15)
+        live = [p for p in env.kube.pods() if not p.is_terminal()]
+        assert all(p.spec.node_name for p in live)
+        zones_after = {}
+        for p in live:
+            z = env.kube.get_node(p.spec.node_name).metadata.labels.get(
+                "topology.kubernetes.io/zone", ""
+            )
+            zones_after[z] = zones_after.get(z, 0) + 1
+        if len(zones_after) > 1:
+            assert max(zones_after.values()) - min(zones_after.values()) <= 1
+
+    def test_wont_delete_node_violating_anti_affinity(self):
+        # "won't delete node if it would violate pod anti-affinity"
+        from karpenter_tpu.kube.objects import (
+            Affinity,
+            LabelSelector as LS,
+            PodAffinity,
+            PodAffinityTerm,
+        )
+
+        env = _env()
+        pods = []
+        for i in range(2):
+            pod = mk_pod(cpu=0.4, labels={"app": "anti"})
+            pod.spec.affinity = Affinity(pod_anti_affinity=PodAffinity(
+                required=(PodAffinityTerm(
+                    topology_key="kubernetes.io/hostname",
+                    label_selector=LS.of({"app": "anti"}),
+                ),),
+            ))
+            pods.append(pod)
+        env.provision(*pods)
+        assert len(env.kube.nodes()) == 2
+        end = _drain_all(env, time.time() + 120, rounds=10)
+        # anti-affinity pins one pod per host: the fleet cannot shrink
+        assert len(env.kube.nodes()) == 2
+        live = [p for p in env.kube.pods() if not p.is_terminal()]
+        hosts = {p.spec.node_name for p in live}
+        assert len(hosts) == 2
+
+
+class TestDisruptionCostLifetime:
+    def test_lifetime_remaining_scales_disruption_cost(self):
+        # "should consider node lifetime remaining when calculating
+        # disruption cost": a claim near expiry costs less to disrupt
+        env = _env()
+        pool = env.kube.get_node_pool("default")
+        pool.spec.template.spec.expire_after = "1h"
+        for i in range(2):
+            env.provision(mk_pod(cpu=1.9,
+                                 node_selector={INSTANCE_TYPE_LABEL: "c2"}))
+        claims = env.kube.node_claims()
+        base = time.time()
+        # one claim is 50 minutes old, the other brand new
+        claims[0].metadata.creation_timestamp = base - 3000
+        claims[1].metadata.creation_timestamp = base
+        now = base + 120
+        env.pod_events.reconcile_all(now=now)
+        env.conditions.reconcile_all(now=now)
+        cands = env.disruption.get_candidates(REASON_UNDERUTILIZED, now + 11)
+        by_claim = {c.state_node.node_claim.metadata.name: c for c in cands}
+        old = by_claim[claims[0].metadata.name]
+        new = by_claim[claims[1].metadata.name]
+        assert old.disruption_cost < new.disruption_cost

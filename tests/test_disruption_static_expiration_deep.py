@@ -1,0 +1,339 @@
+"""Static-pool drift/deprovisioning, forced expiration, mid-TTL
+validation races, reserved-offering consolidation, preference and
+minValues interactions, and disruption metrics.
+
+Ports uncovered families from
+/root/reference/pkg/controllers/disruption/{staticdrift_test.go,
+validation_test.go,consolidation_test.go} and
+nodeclaim/expiration/controller.go.
+"""
+
+import time
+
+from karpenter_tpu.apis.v1.labels import (
+    CAPACITY_TYPE_LABEL,
+    DO_NOT_DISRUPT_ANNOTATION,
+    INSTANCE_TYPE_LABEL,
+)
+from karpenter_tpu.apis.v1.nodeclaim import (
+    COND_DRIFTED,
+    COND_INITIALIZED,
+)
+from karpenter_tpu.apis.v1.nodepool import Budget, REASON_UNDERUTILIZED
+from karpenter_tpu.cloudprovider.fake import GIB, make_instance_type
+from karpenter_tpu.kube.objects import (
+    LabelSelector,
+    ObjectMeta,
+    PodDisruptionBudget,
+    PodDisruptionBudgetSpec,
+)
+from karpenter_tpu.testing import Environment, mk_nodepool, mk_pod
+
+
+def _types():
+    return [
+        make_instance_type("c2", cpu=2, memory=8 * GIB, price=2.0),
+        make_instance_type("c4", cpu=4, memory=16 * GIB, price=3.0),
+    ]
+
+
+class TestStaticPoolDeep:
+    def _static_env(self, replicas=2):
+        from karpenter_tpu.operator.options import FeatureGates, Options
+
+        env = Environment(types=_types(), options=Options(
+            feature_gates=FeatureGates(static_capacity=True),
+        ))
+        pool = mk_nodepool("static")
+        pool.spec.replicas = replicas
+        env.kube.create(pool)
+        now = time.time()
+        for _ in range(6):
+            env.static.reconcile_all(now=now)
+            env.lifecycle.reconcile_all(now=now)
+            env.cloud.tick(now=now)
+            env.lifecycle.reconcile_all(now=now)
+            now += 2
+        assert len(env.kube.node_claims()) == replicas
+        return env, now
+
+    def test_static_pool_excluded_from_consolidation(self):
+        # consolidation_test.go "should not consolidate static
+        # NodePool nodes"
+        env, now = self._static_env(2)
+        env.pod_events.reconcile_all(now=now + 120)
+        env.conditions.reconcile_all(now=now + 120)
+        assert env.disruption.get_candidates(
+            REASON_UNDERUTILIZED, now + 121
+        ) == []
+
+    def test_static_drift_rolls_replacement_first(self):
+        # staticdrift.go:50-116: the replacement launches BEFORE the
+        # drifted claim is removed; replica count never dips
+        env, now = self._static_env(2)
+        claim = env.kube.node_claims()[0]
+        claim.status_conditions.set_true(COND_DRIFTED, now=now)
+        env.static.reconcile_all(now=now)
+        # replacement launched: 3 claims during the roll
+        assert len(env.kube.node_claims()) == 3
+        # drive to convergence: replacement initializes, drifted leaves
+        for _ in range(10):
+            env.static.reconcile_all(now=now)
+            env.lifecycle.reconcile_all(now=now)
+            env.cloud.tick(now=now)
+            env.lifecycle.reconcile_all(now=now)
+            env.reconcile_termination(now=now)
+            now += 5
+        live = [c for c in env.kube.node_claims()
+                if c.metadata.deletion_timestamp is None]
+        assert len(live) == 2
+        assert all(
+            not c.status_conditions.is_true(COND_DRIFTED) for c in live
+        )
+
+    def test_static_drift_rolls_one_at_a_time(self):
+        # budget 1 (default allowed disruptions): with every claim
+        # drifted, the roll proceeds stepwise, never all at once
+        env, now = self._static_env(3)
+        for claim in env.kube.node_claims():
+            claim.status_conditions.set_true(COND_DRIFTED, now=now)
+        env.static.reconcile_all(now=now)
+        fresh = [c for c in env.kube.node_claims()
+                 if not c.status_conditions.is_true(COND_DRIFTED)]
+        assert len(fresh) == 1  # one replacement in flight
+
+    def test_static_scale_down_prefers_drifted(self):
+        env, now = self._static_env(3)
+        drifted = env.kube.node_claims()[1]
+        drifted.status_conditions.set_true(COND_DRIFTED, now=now)
+        pool = env.kube.get_node_pool("static")
+        pool.spec.replicas = 2
+        env.kube.touch(pool)
+        env.static.reconcile_all(now=now)
+        gone = [c for c in env.kube.node_claims()
+                if c.metadata.deletion_timestamp is not None]
+        assert [c.metadata.name for c in gone] == [drifted.metadata.name]
+
+    def test_static_scale_down_prefers_low_disruption_cost(self):
+        env, now = self._static_env(2)
+        claims = env.kube.node_claims()
+        # put an expensive-to-disrupt pod on claim 0's node
+        node_name = claims[0].status.node_name
+        pod = mk_pod(cpu=0.2)
+        pod.spec.priority = 100000
+        env.kube.create(pod)
+        env.kube.bind_pod(
+            env.kube.get_pod("default", pod.metadata.name), node_name
+        )
+        pool = env.kube.get_node_pool("static")
+        pool.spec.replicas = 1
+        env.kube.touch(pool)
+        env.static.reconcile_all(now=now)
+        gone = [c for c in env.kube.node_claims()
+                if c.metadata.deletion_timestamp is not None]
+        assert [c.metadata.name for c in gone] == [claims[1].metadata.name]
+
+
+class TestForcedExpiration:
+    def _env(self, expire_after="1h"):
+        env = Environment(types=_types())
+        pool = mk_nodepool("default")
+        pool.spec.disruption.consolidate_after = "0s"
+        pool.spec.template.spec.expire_after = expire_after
+        env.kube.create(pool)
+        return env
+
+    def test_claim_expires_at_lifetime(self):
+        env = self._env("1h")
+        env.provision(mk_pod(cpu=0.5))
+        claim = env.kube.node_claims()[0]
+        base = claim.metadata.creation_timestamp
+        env.expiration.reconcile_all(now=base + 3599)
+        assert claim.metadata.deletion_timestamp is None
+        env.expiration.reconcile_all(now=base + 3601)
+        assert claim.metadata.deletion_timestamp is not None
+
+    def test_expiration_is_forceful_ignores_pdbs(self):
+        # expiration is FORCEFUL (nodeclaim/expiration/controller.go:
+        # 57-64 — no budget, no PDB consult on the delete itself; the
+        # drain that follows still honors them via TGP)
+        env = self._env("1h")
+        env.provision(mk_pod(cpu=0.5, labels={"app": "web"}))
+        env.kube.create(PodDisruptionBudget(
+            metadata=ObjectMeta(name="pdb"),
+            spec=PodDisruptionBudgetSpec(
+                selector=LabelSelector.of({"app": "web"}),
+                max_unavailable=0,
+            ),
+        ))
+        claim = env.kube.node_claims()[0]
+        base = claim.metadata.creation_timestamp
+        env.expiration.reconcile_all(now=base + 3601)
+        assert claim.metadata.deletion_timestamp is not None
+
+    def test_never_expiring_claim(self):
+        env = self._env("Never")
+        env.provision(mk_pod(cpu=0.5))
+        claim = env.kube.node_claims()[0]
+        env.expiration.reconcile_all(
+            now=claim.metadata.creation_timestamp + 10 * 365 * 24 * 3600
+        )
+        assert claim.metadata.deletion_timestamp is None
+
+
+class TestValidationMidTtlRaces:
+    """consolidation_test.go TTL-wait family: between command compute
+    and execution, the world changes and validation must catch it."""
+
+    def _replace_command(self, env, now):
+        env.pod_events.reconcile_all(now=now)
+        env.conditions.reconcile_all(now=now)
+        return env.disruption.reconcile(now=now + 1)
+
+    def _env(self):
+        from karpenter_tpu.apis.v1.nodeclaim import RequirementSpec
+
+        env = Environment(types=[
+            make_instance_type("c1", cpu=1, memory=4 * GIB, price=1.2),
+            make_instance_type("c2", cpu=2, memory=8 * GIB, price=2.0),
+        ])
+        pool = mk_nodepool("default")
+        pool.spec.disruption.consolidate_after = "0s"
+        # on-demand: a spot candidate would hide the replace behind
+        # the 15-type spot-to-spot rule
+        pool.spec.template.spec.requirements = [
+            RequirementSpec(key=CAPACITY_TYPE_LABEL, operator="In",
+                            values=("on-demand",)),
+        ]
+        env.kube.create(pool)
+        env.provision(mk_pod(cpu=0.4,
+                             node_selector={INSTANCE_TYPE_LABEL: "c2"}))
+        for pod in env.kube.pods():
+            pod.spec.node_selector = {}
+        return env
+
+    def test_do_not_disrupt_pod_arriving_mid_wait_rolls_back(self):
+        # "should not replace node if a pod schedules with
+        # karpenter.sh/do-not-disrupt during the TTL wait"
+        env = self._env()
+        now = time.time() + 120
+        command = self._replace_command(env, now)
+        assert command is not None
+        node_name = env.kube.nodes()[0].metadata.name
+        guard = mk_pod(cpu=0.1)
+        guard.metadata.annotations[DO_NOT_DISRUPT_ANNOTATION] = "true"
+        env.kube.create(guard)
+        env.kube.bind_pod(
+            env.kube.get_pod("default", guard.metadata.name), node_name
+        )
+        for i in range(12):
+            env.reconcile_disruption(now=now + 11 * (i + 1))
+        # the candidate survived: validation saw the guard pod
+        assert any(n.metadata.name == node_name for n in env.kube.nodes())
+
+    def test_blocking_pdb_arriving_mid_wait_rolls_back(self):
+        # "should not replace node if a pod schedules with a blocking
+        # PDB during the TTL wait"
+        env = self._env()
+        now = time.time() + 120
+        command = self._replace_command(env, now)
+        assert command is not None
+        node_name = env.kube.nodes()[0].metadata.name
+        env.kube.create(PodDisruptionBudget(
+            metadata=ObjectMeta(name="pdb"),
+            spec=PodDisruptionBudgetSpec(
+                selector=LabelSelector.of({}), max_unavailable=0,
+            ),
+        ))
+        for i in range(12):
+            env.reconcile_disruption(now=now + 11 * (i + 1))
+        assert any(n.metadata.name == node_name for n in env.kube.nodes())
+
+    def test_candidate_vanishing_mid_wait_rolls_back(self):
+        env = self._env()
+        now = time.time() + 120
+        command = self._replace_command(env, now)
+        assert command is not None
+        # the candidate's claim is deleted out from under the command
+        claim = command.candidates[0].state_node.node_claim
+        env.kube.delete(claim, now=now + 2)
+        for i in range(12):
+            env.reconcile_disruption(now=now + 11 * (i + 1))
+        # no stuck command, fleet converges with the workload bound
+        live = [p for p in env.kube.pods() if not p.is_terminal()]
+        assert all(p.spec.node_name for p in live)
+        assert env.disruption.queue.active == []
+
+
+class TestReservedConsolidation:
+    def test_consolidates_onto_reserved_offering(self):
+        # "can consolidate from one reserved offering to another":
+        # reserved capacity prices ~0, so moving a workload onto a
+        # reservation is always a win
+        from karpenter_tpu.operator.options import FeatureGates, Options
+
+        types = [
+            make_instance_type("c2", cpu=2, memory=8 * GIB, price=2.0),
+            make_instance_type(
+                "r2", cpu=2, memory=8 * GIB, price=2.0,
+                reservations=[("res-1", "test-zone-1", 2)],
+            ),
+        ]
+        env = Environment(types=types, options=Options(
+            feature_gates=FeatureGates(reserved_capacity=True,
+                                       spot_to_spot_consolidation=True),
+        ))
+        pool = mk_nodepool("default")
+        pool.spec.disruption.consolidate_after = "0s"
+        env.kube.create(pool)
+        env.provision(mk_pod(
+            cpu=0.4,
+            node_selector={INSTANCE_TYPE_LABEL: "c2",
+                           CAPACITY_TYPE_LABEL: "on-demand"},
+        ))
+        for pod in env.kube.pods():
+            pod.spec.node_selector = {}
+        now = time.time() + 120
+        for i in range(10):
+            env.reconcile_disruption(now=now + 11 * i)
+        assert len(env.kube.nodes()) == 1
+        node = env.kube.nodes()[0]
+        assert node.metadata.labels.get(CAPACITY_TYPE_LABEL) == "reserved"
+
+
+class TestDisruptionMetrics:
+    def test_disrupted_counter_carries_reason_and_pool(self):
+        from karpenter_tpu.metrics.store import NODECLAIMS_DISRUPTED
+
+        env = Environment(types=_types())
+        pool = mk_nodepool("default")
+        pool.spec.disruption.consolidate_after = "0s"
+        env.kube.create(pool)
+        env.provision(mk_pod(cpu=0.5))
+        env.kube.delete(env.kube.pods()[0])
+        before = NODECLAIMS_DISRUPTED.value(
+            {"reason": "Empty", "nodepool": "default"}
+        )
+        now = time.time() + 120
+        for i in range(6):
+            env.reconcile_disruption(now=now + 11 * i)
+        assert len(env.kube.nodes()) == 0
+        after = NODECLAIMS_DISRUPTED.value(
+            {"reason": "Empty", "nodepool": "default"}
+        )
+        assert after == before + 1
+
+    def test_evaluation_duration_observed_per_method(self):
+        from karpenter_tpu.metrics.store import DISRUPTION_EVALUATION_DURATION
+
+        env = Environment(types=_types())
+        pool = mk_nodepool("default")
+        pool.spec.disruption.consolidate_after = "0s"
+        env.kube.create(pool)
+        env.provision(mk_pod(cpu=0.5))
+        now = time.time() + 120
+        env.reconcile_disruption(now=now)
+        for method in ("emptiness", "single_node_consolidation"):
+            assert DISRUPTION_EVALUATION_DURATION.count(
+                {"method": method}
+            ) >= 1
