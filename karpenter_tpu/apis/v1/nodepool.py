@@ -163,6 +163,17 @@ def template_requirements(pool: NodePool) -> list[RequirementSpec]:
     return out
 
 
+def nodepool_owner_ref(pool: "NodePool"):
+    """The controller reference a NodePool stamps on objects it owns
+    (claims; nodepool.go sets it so deleting the pool cascades)."""
+    from karpenter_tpu.kube.objects import OwnerReference
+
+    return OwnerReference(
+        kind="NodePool", name=pool.metadata.name, uid=pool.metadata.uid,
+        controller=True, api_version="karpenter.sh/v1",
+    )
+
+
 def order_by_weight(pools: list[NodePool]) -> list[NodePool]:
     """Descending weight, then name for determinism (utils/nodepool)."""
     return sorted(pools, key=lambda p: (-p.spec.weight, p.metadata.name))

@@ -27,7 +27,7 @@ from karpenter_tpu.apis.v1.nodeclaim import (
     NodeClaimSpec,
     RequirementSpec,
 )
-from karpenter_tpu.apis.v1.nodepool import NodePool, order_by_weight
+from karpenter_tpu.apis.v1.nodepool import NodePool, nodepool_owner_ref, order_by_weight
 from karpenter_tpu.cloudprovider.types import CloudProvider, min_values_coverage
 from karpenter_tpu.provisioning import volume_topology
 from karpenter_tpu.kube.client import KubeClient
@@ -395,6 +395,7 @@ class Provisioner:
                         **pool.spec.template.labels},
                 annotations=dict(pool.spec.template.annotations),
                 finalizers=[TERMINATION_FINALIZER],
+                owner_references=[nodepool_owner_ref(pool)],
             ),
             spec=NodeClaimSpec(
                 requirements=requirements,

@@ -29,7 +29,7 @@ from karpenter_tpu.apis.v1.nodeclaim import (
     NodeClaimSpec,
     RequirementSpec,
 )
-from karpenter_tpu.apis.v1.nodepool import NodePool
+from karpenter_tpu.apis.v1.nodepool import NodePool, nodepool_owner_ref
 from karpenter_tpu.disruption.engine import pod_disruption_cost
 from karpenter_tpu.kube.client import KubeClient
 from karpenter_tpu.kube.objects import ObjectMeta
@@ -122,6 +122,7 @@ class StaticCapacityController:
                     NODEPOOL_HASH_VERSION_ANNOTATION: NODEPOOL_HASH_VERSION,
                 },
                 finalizers=[TERMINATION_FINALIZER],
+                owner_references=[nodepool_owner_ref(pool)],
             ),
             spec=NodeClaimSpec(
                 requirements=requirements,

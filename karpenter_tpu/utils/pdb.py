@@ -8,7 +8,7 @@ pod set disruptable".
 from __future__ import annotations
 
 import math
-from typing import Optional, Sequence
+from typing import Optional
 
 from karpenter_tpu.kube.client import KubeClient
 from karpenter_tpu.kube.objects import Pod, PodDisruptionBudget
@@ -102,11 +102,3 @@ class PdbLimits:
                 return pdb.key
         return None
 
-    def blocking_pdbs(self, pods: Sequence[Pod]) -> dict[str, str]:
-        """pod key -> blocking pdb key for every blocked pod."""
-        out = {}
-        for pod in pods:
-            blocked = self.can_evict(pod)
-            if blocked is not None:
-                out[pod.key] = blocked
-        return out
