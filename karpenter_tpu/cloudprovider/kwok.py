@@ -158,6 +158,11 @@ class KwokCloudProvider(CloudProvider):
             node_name = f"{node_claim.metadata.name}-{seq}"
             provider_id = f"kwok://{node_name}"
             labels = {
+                # every representative label of the chosen type lands
+                # on the node (the reference's instance types expose
+                # Requirements().Labels(); custom catalog labels like
+                # accelerator families must be visible to selectors)
+                **chosen.requirements.labels(),
                 **node_claim.metadata.labels,
                 INSTANCE_TYPE_LABEL: chosen.name,
                 TOPOLOGY_ZONE_LABEL: offering.zone,

@@ -270,3 +270,89 @@ class TestTruncation:
         assert claims[0].metadata.annotations.get(
             NODECLAIM_MIN_VALUES_RELAXED_ANNOTATION
         ) == "true"
+
+
+class TestMinValuesOperatorMatrix:
+    """instance_selection_test.go minValues × operator family: Gt/Lt
+    carry minValues floors too, and multiple operators on one key take
+    the MAX of their floors."""
+
+    def _solve_with_requirements(self, requirement_specs, n_types=10):
+        types = [
+            make_instance_type(f"m{i}", cpu=2 * (i + 1),
+                               memory=(8 + 4 * i) * GIB,
+                               price=1.0 + 0.5 * i,
+                               extra_labels={"tier": str(i)})
+            for i in range(n_types)
+        ]
+        env = Environment(types=types)
+        pool = mk_nodepool("default")
+        pool.spec.template.spec.requirements = [
+            RequirementSpec(key=key, operator=op, values=tuple(values),
+                            min_values=mv)
+            for key, op, values, mv in requirement_specs
+        ]
+        env.kube.create(pool)
+        env.provision(mk_pod(cpu=0.5))
+        return env
+
+    def test_min_values_with_gt_satisfied(self):
+        # "should schedule respecting the minValues in Gt operator":
+        # tier > 2 leaves 7 types; floor of 3 is satisfiable
+        env = self._solve_with_requirements([
+            ("tier", "Gt", ("2",), 3),
+        ])
+        claims = env.kube.node_claims()
+        assert len(claims) == 1
+        node = env.kube.nodes()[0]
+        assert int(node.metadata.labels["tier"]) > 2
+
+    def test_min_values_with_gt_unsatisfiable_fails(self):
+        # "scheduler should fail if the minValues in Gt operator is
+        # not satisfied": tier > 8 leaves 1 type < floor of 3
+        env = self._solve_with_requirements([
+            ("tier", "Gt", ("8",), 3),
+        ])
+        assert env.kube.node_claims() == []
+
+    def test_min_values_with_lt_satisfied(self):
+        env = self._solve_with_requirements([
+            ("tier", "Lt", ("5",), 3),
+        ])
+        claims = env.kube.node_claims()
+        assert len(claims) == 1
+        node = env.kube.nodes()[0]
+        assert int(node.metadata.labels["tier"]) < 5
+
+    def test_min_values_with_lt_unsatisfiable_fails(self):
+        env = self._solve_with_requirements([
+            ("tier", "Lt", ("2",), 5),
+        ])
+        assert env.kube.node_claims() == []
+
+    def test_max_of_min_values_across_operators_same_key(self):
+        # "max of the minValues of In and NotIn operators": In floor 2,
+        # NotIn floor 4 -> effective floor 4; the value set (5 types
+        # after NotIn) satisfies it
+        env = self._solve_with_requirements([
+            ("tier", "In", tuple(str(i) for i in range(6)), 2),
+            ("tier", "NotIn", ("0",), 4),
+        ])
+        assert len(env.kube.node_claims()) == 1
+
+    def test_max_of_min_values_unsatisfiable_fails(self):
+        # In floor 2 ok, NotIn floor 5 but only 2 values survive
+        env = self._solve_with_requirements([
+            ("tier", "In", ("1", "2", "3"), 2),
+            ("tier", "NotIn", ("1",), 5),
+        ])
+        assert env.kube.node_claims() == []
+
+    def test_multiple_keys_with_min_values(self):
+        # "should schedule and respect multiple requirement keys with
+        # minValues"
+        env = self._solve_with_requirements([
+            ("tier", "In", tuple(str(i) for i in range(6)), 3),
+            (INSTANCE_TYPE_LABEL, "Exists", (), 4),
+        ])
+        assert len(env.kube.node_claims()) == 1
