@@ -187,6 +187,9 @@ class Encoded:
     loose_groups: np.ndarray = None       # [G] bool groups constraining a key
                                           # configs leave open (k-way check
                                           # at decode)
+    pool_min_values: np.ndarray = None    # [P+1] bool pools with minValues
+                                          # floors (host decode metadata;
+                                          # not shipped to the service)
 
 
 def pool_template_requirements(
@@ -414,6 +417,14 @@ def encode(
             if pname in pool_order:
                 for ri, key in enumerate(keys):
                     pool_overhead[pool_order[pname], ri] = overhead.get(key, 0.0)
+    # host-side decode metadata (not shipped to the solver service):
+    # pools whose templates carry minValues floors — mask-narrowing
+    # post-passes must leave their nodes alone or they could drop a
+    # plan's type coverage below the floor
+    pool_min_values = np.zeros(n_pools + 1, bool)
+    for pool, _types in pools_with_types:
+        if pool_template_requirements(pool).has_min_values():
+            pool_min_values[pool_order[pool.metadata.name]] = True
 
     # Column dedupe: launchable configs with identical (pool,
     # allocatable, compat column) are indistinguishable to the packer —
@@ -472,6 +483,7 @@ def encode(
         conflict=conflict,
         existing_quota=existing_quota,
         loose_groups=loose_groups,
+        pool_min_values=pool_min_values,
     )
 
 

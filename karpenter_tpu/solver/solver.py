@@ -11,6 +11,7 @@ which pods land where). Backend is the JAX packing kernel
 from __future__ import annotations
 
 import os
+import time as _time
 from dataclasses import dataclass, field
 from typing import Optional, Sequence
 
@@ -115,6 +116,32 @@ class Solution:
     @property
     def total_price(self) -> float:
         return sum(n.price for n in self.new_nodes)
+
+
+def _merge_budget_seconds() -> float:
+    """Wall budget for the post-pack merge improvement (host-side; the
+    pass is anytime — it harvests the biggest wins first and stops
+    cleanly). Read per call like every other solver env knob."""
+    return float(os.environ.get("KARPENTER_MERGE_BUDGET_SECONDS", "0.15"))
+
+
+def _fresh_uncapped_cols(enc: Encoded, masks: np.ndarray, ni: int):
+    """The shared eligibility gate of the mask post-passes (downsize,
+    merge): a node is resizable only if it is FRESH (not an existing
+    node) and its mask touches no reservation-capped column. Returns
+    the mask's columns, or None if the node is off-limits."""
+    cols = np.flatnonzero(masks[ni])
+    if cols.size == 0:
+        return None
+    if enc.configs[cols[0]].existing_index >= 0:
+        return None
+    uncapped = (
+        enc.cfg_rsv < 0 if enc.cfg_rsv is not None
+        else np.ones(len(enc.configs), bool)
+    )
+    if not uncapped[cols].all():
+        return None
+    return cols
 
 
 def _backend() -> str:
@@ -281,6 +308,7 @@ def _decode_device(
             masks = _downsize_masks(enc, cost_result)
             cost_tuple = (cost_result, masks)
             if key(cost_tuple) < floor:
+                _merge_underfilled(enc, cost_result, masks)
                 solution = _build_solution_arrays(
                     enc,
                     np.flatnonzero(
@@ -320,6 +348,10 @@ def _decode_device(
     _ffd_floor[fp] = key(candidates[0])
 
     result, masks = min(candidates, key=key)
+    # improvement pass on the WINNER only — after the race keys (and
+    # the recorded FFD floor) were computed, so selection semantics
+    # and the steady-state skip stay bit-identical
+    _merge_underfilled(enc, result, masks)
     solution = _build_solution_arrays(
         enc,
         np.flatnonzero(result.node_active[: result.node_count]),
@@ -388,6 +420,146 @@ def _race_fingerprint(enc: Encoded) -> bytes:
     return h.digest()
 
 
+def _merge_underfilled(enc: Encoded, result, masks: np.ndarray) -> None:
+    """Host-side improvement pass on a finished cost pack: greedily
+    merge pairs of FRESH nodes when one machine that holds both loads
+    is cheaper than the two they would launch as. FFD fragmentation
+    under selector/taint-split demand leaves tails of underfilled
+    nodes; the LP cannot see them (its patterns are per-class optimal
+    but integrality strands remainders). Mutates `result` and `masks`
+    in place.
+
+    Feasibility comes straight from the DOWNSIZED masks: downsize
+    widens each fresh node's mask to every same-pool config that is
+    compatible with all residents AND fits its current load — any
+    config fitting the merged load fits both current loads, so
+    mask_i & mask_j & fits(combined) is EXACTLY the merged node's
+    valid launch set (compat, pool and reservation rules included).
+    Additional guards: no loose-group residents (k-way legality is
+    re-judged at decode), per-node group caps, pairwise group
+    conflicts, pool daemon overhead counted once."""
+    n = result.node_count
+    if n == 0:
+        return
+    active = result.node_active[:n] & (result.assign[:n].sum(axis=1) > 0)
+    cand: list[int] = []
+    for ni in np.flatnonzero(active):
+        cols = _fresh_uncapped_cols(enc, masks, ni)
+        if cols is None:
+            continue
+        if enc.loose_groups is not None and (
+            enc.loose_groups & (result.assign[ni] > 0)
+        ).any():
+            continue
+        if enc.pool_min_values is not None and enc.pool_min_values[
+            enc.cfg_pool[cols[0]]
+        ]:
+            # a minValues pool: narrowing the mask could drop the
+            # plan's type coverage below the floor and turn an
+            # optional optimization into unschedulable pods
+            continue
+        # mergeable in principle: some masked config could hold about
+        # twice this load (cheap prefilter; exact check is per-pair)
+        pool = int(enc.cfg_pool[cols[0]])
+        oh = enc.pool_overhead[pool]
+        doubled = 2.0 * result.node_used[ni] - oh
+        if not (enc.cfg_alloc[cols] + 1e-4 >= doubled[None, :]).all(
+            axis=1
+        ).any():
+            continue
+        cand.append(int(ni))
+    if len(cand) < 2:
+        return
+    order = sorted(cand, key=lambda x: float(result.node_used[x].sum()))
+    caps = enc.group_cap
+    conflict = enc.conflict
+    # fast pair pruning: bit-packed masks for O(C/64) intersection
+    # tests, plus a per-pool "largest machine" envelope so partners
+    # whose combined load can't fit ANY config are skipped in one
+    # vectorized sweep per anchor
+    m = len(order)
+    packed = np.packbits(masks[order], axis=1)
+    used = result.node_used[np.array(order)]
+    pools = np.empty(m, np.int32)
+    for pos, ni in enumerate(order):
+        pools[pos] = enc.cfg_pool[np.flatnonzero(masks[ni])[0]]
+    launch_cols = enc.cfg_pool >= 0
+    pool_max: dict[int, np.ndarray] = {}
+    for pool in np.unique(pools):
+        pcols = launch_cols & (enc.cfg_pool == pool)
+        pool_max[int(pool)] = enc.cfg_alloc[pcols].max(axis=0)
+    alive = np.ones(m, bool)
+    # current cheapest launch price per candidate (decode's choice),
+    # maintained incrementally — recomputing it per pair would put two
+    # full-C reductions on every probe
+    p_cur = np.array([
+        float(enc.cfg_price[masks[ni]].min()) for ni in order
+    ])
+    deadline = _time.perf_counter() + _merge_budget_seconds()
+    for a in range(m):
+        if not alive[a] or _time.perf_counter() > deadline:
+            continue
+        merged_any = True
+        while merged_any:
+            merged_any = False
+            pool = int(pools[a])
+            oh = enc.pool_overhead[pool]
+            envelope = pool_max[pool] + oh
+            quick = (
+                alive
+                & (pools == pools[a])
+                & (
+                    (used + used[a][None, :])
+                    <= envelope[None, :] + 1e-4
+                ).all(axis=1)
+            )
+            quick[a] = False
+            # largest partner first: densest merged node
+            for b in np.flatnonzero(quick)[::-1]:
+                if _time.perf_counter() > deadline:
+                    break
+                if not (packed[a] & packed[b]).any():
+                    continue
+                na, nb = order[a], order[b]
+                shared = masks[na] & masks[nb]
+                cols = np.flatnonzero(shared)
+                combined = used[a] + used[b] - oh
+                fits = (
+                    enc.cfg_alloc[cols] + 1e-4 >= combined[None, :]
+                ).all(axis=1)
+                if not fits.any():
+                    continue
+                new_price = float(enc.cfg_price[cols[fits]].min())
+                if new_price + 1e-9 >= p_cur[a] + p_cur[b]:
+                    continue
+                comb_assign = result.assign[na] + result.assign[nb]
+                if caps is not None and (comb_assign > caps).any():
+                    continue
+                if conflict is not None:
+                    gi = np.flatnonzero(result.assign[na] > 0)
+                    gj = np.flatnonzero(result.assign[nb] > 0)
+                    if conflict[np.ix_(gi, gj)].any():
+                        continue
+                # merge nb into na
+                result.assign[na] = comb_assign
+                result.node_used[na] = combined
+                result.assign[nb] = 0
+                result.node_active[nb] = False
+                result.node_used[nb] = 0.0
+                masks[nb] = False
+                row = np.zeros_like(masks[na])
+                row[cols[fits]] = True
+                masks[na] = row
+                used[a] = combined
+                used[b] = 0.0
+                p_cur[a] = new_price
+                packed[a] = np.packbits(row)
+                packed[b] = 0
+                alive[b] = False
+                merged_any = True
+                break
+
+
 def _downsize_masks(enc: Encoded, result) -> np.ndarray:
     """Re-widen each planned/fresh node's config mask to every same-pool
     config that fits its *final* fill, so decode can pick a smaller,
@@ -407,15 +579,10 @@ def _downsize_masks(enc: Encoded, result) -> np.ndarray:
         if not result.node_active[ni]:
             continue
         row = masks[ni]
-        cols = np.flatnonzero(row)
-        if cols.size == 0:
-            continue
-        first = enc.configs[cols[0]]
-        if first.existing_index >= 0:
-            continue  # real existing node, nothing to resize
-        if not uncapped[cols].all():
-            # reservation-pinned node: the pin is the point
-            # (FinalizeScheduling, scheduling/nodeclaim.go:252)
+        # fresh + reservation-uncapped only (a pinned node's pin is the
+        # point: FinalizeScheduling, scheduling/nodeclaim.go:252)
+        cols = _fresh_uncapped_cols(enc, masks, ni)
+        if cols is None:
             continue
         pool = enc.cfg_pool[cols[0]]
         groups_on = np.flatnonzero(result.assign[ni] > 0)

@@ -148,3 +148,132 @@ class TestRaceSkip:
         assert solver_mod._race_fingerprint(enc2) not in solver_mod._ffd_floor
         out = solve(pods2, pools2, objective="cost")
         assert not out.unschedulable
+
+
+class TestMergePass:
+    """_merge_underfilled: the post-pack improvement that merges
+    same-compatibility underfilled fresh nodes onto one cheaper
+    machine. Properties: never loses pods, never violates caps/
+    conflicts/reservations, and only ever lowers the fleet price."""
+
+    def _fragmented_problem(self, n_services=6, pods_per=3):
+        from karpenter_tpu.cloudprovider.fake import (
+            GIB,
+            heterogeneous_instance_types,
+        )
+        from karpenter_tpu.testing import mk_nodepool, mk_pod
+
+        # many tiny selector-split services: FFD opens a node per
+        # batch tail and fragments
+        pods = []
+        for s in range(n_services):
+            for i in range(pods_per):
+                pods.append(mk_pod(name=f"s{s}-{i}", cpu=0.4,
+                                   memory=1 * GIB))
+        pool = mk_nodepool("default")
+        return pods, [(pool, heterogeneous_instance_types(40))]
+
+    def test_merge_never_loses_pods_and_only_cheapens(self):
+        from karpenter_tpu.solver.solver import solve
+
+        pods, pools = self._fragmented_problem()
+        ffd = solve(pods, pools, objective="ffd")
+        cost = solve(pods, pools, objective="cost")
+        sched = sum(len(n.pods) for n in cost.new_nodes) + sum(
+            len(e.pods) for e in cost.existing
+        )
+        assert sched == len(pods)
+        assert not cost.unschedulable
+        assert float(cost.total_price) <= float(ffd.total_price) + 1e-9
+        # every planned node's final load fits its cheapest launch type
+        from karpenter_tpu.utils import resources as resutil
+
+        for plan in cost.new_nodes:
+            used = resutil.requests_for_pods(plan.pods)
+            it = plan.instance_types[0]
+            assert all(
+                it.allocatable.get(k, 0.0) + 1e-4 >= v
+                for k, v in used.items()
+            ), (it.name, used)
+
+    def test_merge_respects_hostname_anti_affinity(self):
+        """Anti-affinity pods must stay on distinct nodes: the merge
+        pass may never fuse two nodes each carrying one."""
+        from karpenter_tpu.cloudprovider.fake import GIB, make_instance_type
+        from karpenter_tpu.kube.objects import (
+            Affinity,
+            LabelSelector,
+            PodAffinity,
+            PodAffinityTerm,
+        )
+        from karpenter_tpu.testing import Environment, mk_nodepool, mk_pod
+
+        env = Environment(types=[
+            make_instance_type("c8", cpu=8, memory=32 * GIB, price=5.0),
+            make_instance_type("c2", cpu=2, memory=8 * GIB, price=2.0),
+        ])
+        env.kube.create(mk_nodepool("default"))
+        pods = []
+        for i in range(3):
+            pod = mk_pod(cpu=0.3, labels={"app": "anti"})
+            pod.spec.affinity = Affinity(pod_anti_affinity=PodAffinity(
+                required=(PodAffinityTerm(
+                    topology_key="kubernetes.io/hostname",
+                    label_selector=LabelSelector.of({"app": "anti"}),
+                ),),
+            ))
+            pods.append(pod)
+        env.provision(*pods)
+        nodes = {p.spec.node_name for p in env.kube.pods()}
+        assert len(nodes) == 3, "anti-affinity pods fused onto one node"
+
+    def test_merge_skips_reservation_pinned_nodes(self):
+        """Reservation-pinned nodes carry a budget the merge may not
+        overspend: packing stays within the reserved instance count."""
+        from karpenter_tpu.cloudprovider.fake import GIB, make_instance_type
+        from karpenter_tpu.testing import mk_nodepool, mk_pod
+        from karpenter_tpu.solver.solver import solve
+
+        types = [
+            make_instance_type(
+                "r2", cpu=2, memory=8 * GIB, price=2.0,
+                reservations=[("res-1", "test-zone-1", 1)],
+            ),
+            make_instance_type("c8", cpu=8, memory=32 * GIB, price=5.0),
+        ]
+        pool = mk_nodepool("default")
+        pods = [mk_pod(cpu=0.4) for _ in range(8)]
+        sol = solve(pods, [(pool, types)], objective="cost")
+        reserved_nodes = [
+            n for n in sol.new_nodes
+            if n.offerings and n.offerings[0].reservation_id
+        ]
+        # at most the reserved instance count may land on the
+        # reservation
+        assert len(reserved_nodes) <= 1
+        assert not sol.unschedulable
+
+    def test_merge_skips_min_values_pools(self):
+        """A pool with a minValues floor must keep its plans' type
+        coverage: the merge pass leaves its nodes alone (narrowing the
+        mask could drop coverage below the floor and strand pods under
+        the Strict policy)."""
+        from karpenter_tpu.apis.v1.nodeclaim import RequirementSpec
+        from karpenter_tpu.cloudprovider.fake import (
+            GIB,
+            heterogeneous_instance_types,
+        )
+        from karpenter_tpu.testing import mk_nodepool, mk_pod
+        from karpenter_tpu.solver.solver import solve
+
+        pool = mk_nodepool("floors")
+        pool.spec.template.spec.requirements = [
+            RequirementSpec(key="node.kubernetes.io/instance-type",
+                            operator="Exists", min_values=3),
+        ]
+        pods = [mk_pod(cpu=0.4, memory=1 * GIB) for _ in range(9)]
+        sol = solve(pods, [(pool, heterogeneous_instance_types(40))],
+                    objective="cost")
+        assert not sol.unschedulable
+        for plan in sol.new_nodes:
+            assert len({it.name for it in plan.instance_types}) >= 3
