@@ -189,6 +189,19 @@ class NodeClaimLifecycle:
         ]
         if TERMINATION_FINALIZER not in node.metadata.finalizers:
             node.metadata.finalizers.append(TERMINATION_FINALIZER)
+        # the claim owns its Node (registration.go adds the controller
+        # reference so a deleted claim cascades to the node object)
+        if not any(
+            r.kind == "NodeClaim" and r.name == claim.metadata.name
+            for r in node.metadata.owner_references
+        ):
+            from karpenter_tpu.kube.objects import OwnerReference
+
+            node.metadata.owner_references.append(OwnerReference(
+                kind="NodeClaim", name=claim.metadata.name,
+                uid=claim.metadata.uid, controller=True,
+                api_version="karpenter.sh/v1",
+            ))
         self.kube.update(node)
         claim.status.node_name = node.metadata.name
         claim.status_conditions.set_true(COND_REGISTERED, now=now)

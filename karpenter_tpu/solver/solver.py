@@ -125,7 +125,16 @@ def _merge_budget_seconds() -> float:
     return float(os.environ.get("KARPENTER_MERGE_BUDGET_SECONDS", "0.15"))
 
 
-def _fresh_uncapped_cols(enc: Encoded, masks: np.ndarray, ni: int):
+def _uncapped_cols(enc: Encoded) -> np.ndarray:
+    """[C] bool: columns not drawing on a capacity reservation."""
+    return (
+        enc.cfg_rsv < 0 if enc.cfg_rsv is not None
+        else np.ones(len(enc.configs), bool)
+    )
+
+
+def _fresh_uncapped_cols(enc: Encoded, masks: np.ndarray, ni: int,
+                         uncapped: np.ndarray):
     """The shared eligibility gate of the mask post-passes (downsize,
     merge): a node is resizable only if it is FRESH (not an existing
     node) and its mask touches no reservation-capped column. Returns
@@ -135,10 +144,6 @@ def _fresh_uncapped_cols(enc: Encoded, masks: np.ndarray, ni: int):
         return None
     if enc.configs[cols[0]].existing_index >= 0:
         return None
-    uncapped = (
-        enc.cfg_rsv < 0 if enc.cfg_rsv is not None
-        else np.ones(len(enc.configs), bool)
-    )
     if not uncapped[cols].all():
         return None
     return cols
@@ -442,18 +447,19 @@ def _merge_underfilled(enc: Encoded, result, masks: np.ndarray) -> None:
     if n == 0:
         return
     active = result.node_active[:n] & (result.assign[:n].sum(axis=1) > 0)
+    uncapped = _uncapped_cols(enc)
     cand: list[int] = []
+    cand_pool: list[int] = []
     for ni in np.flatnonzero(active):
-        cols = _fresh_uncapped_cols(enc, masks, ni)
+        cols = _fresh_uncapped_cols(enc, masks, ni, uncapped)
         if cols is None:
             continue
         if enc.loose_groups is not None and (
             enc.loose_groups & (result.assign[ni] > 0)
         ).any():
             continue
-        if enc.pool_min_values is not None and enc.pool_min_values[
-            enc.cfg_pool[cols[0]]
-        ]:
+        pool = int(enc.cfg_pool[cols[0]])
+        if enc.pool_min_values is not None and enc.pool_min_values[pool]:
             # a minValues pool: narrowing the mask could drop the
             # plan's type coverage below the floor and turn an
             # optional optimization into unschedulable pods
@@ -468,8 +474,10 @@ def _merge_underfilled(enc: Encoded, result, masks: np.ndarray) -> None:
         ).any():
             continue
         cand.append(int(ni))
+        cand_pool.append(pool)
     if len(cand) < 2:
         return
+    pool_of = dict(zip(cand, cand_pool))
     order = sorted(cand, key=lambda x: float(result.node_used[x].sum()))
     caps = enc.group_cap
     conflict = enc.conflict
@@ -480,9 +488,7 @@ def _merge_underfilled(enc: Encoded, result, masks: np.ndarray) -> None:
     m = len(order)
     packed = np.packbits(masks[order], axis=1)
     used = result.node_used[np.array(order)]
-    pools = np.empty(m, np.int32)
-    for pos, ni in enumerate(order):
-        pools[pos] = enc.cfg_pool[np.flatnonzero(masks[ni])[0]]
+    pools = np.array([pool_of[ni] for ni in order], np.int32)
     launch_cols = enc.cfg_pool >= 0
     pool_max: dict[int, np.ndarray] = {}
     for pool in np.unique(pools):
@@ -571,17 +577,14 @@ def _downsize_masks(enc: Encoded, result) -> np.ndarray:
     """
     masks = result.node_mask.copy()
     launch = enc.cfg_pool >= 0
-    uncapped = (
-        enc.cfg_rsv < 0 if enc.cfg_rsv is not None
-        else np.ones(len(enc.configs), bool)
-    )
+    uncapped = _uncapped_cols(enc)
     for ni in range(result.node_count):
         if not result.node_active[ni]:
             continue
         row = masks[ni]
         # fresh + reservation-uncapped only (a pinned node's pin is the
         # point: FinalizeScheduling, scheduling/nodeclaim.go:252)
-        cols = _fresh_uncapped_cols(enc, masks, ni)
+        cols = _fresh_uncapped_cols(enc, masks, ni, uncapped)
         if cols is None:
             continue
         pool = enc.cfg_pool[cols[0]]

@@ -1,0 +1,298 @@
+"""NodeClaim lifecycle long tail.
+
+Ports uncovered families from
+/root/reference/pkg/controllers/nodeclaim/lifecycle/*_test.go:
+initialization gating (NotReady, missing resources, startup and
+ephemeral taints), registration sync (labels/annotations/taints,
+unregistered-taint removal, node owner reference), launch errors
+(ICE / NodeClassNotReady delete the claim), and liveness timeouts.
+"""
+
+import time
+
+from karpenter_tpu.apis.v1.labels import (
+    NODE_INITIALIZED_LABEL,
+    NODE_REGISTERED_LABEL,
+    UNREGISTERED_TAINT_KEY,
+)
+from karpenter_tpu.apis.v1.nodeclaim import (
+    COND_INITIALIZED,
+    COND_LAUNCHED,
+    COND_REGISTERED,
+)
+from karpenter_tpu.cloudprovider.fake import GIB, make_instance_type
+from karpenter_tpu.cloudprovider.types import (
+    InsufficientCapacityError,
+    NodeClassNotReadyError,
+)
+from karpenter_tpu.kube.objects import Taint
+from karpenter_tpu.lifecycle.nodeclaim_lifecycle import (
+    LAUNCH_TIMEOUT_SECONDS,
+    REGISTRATION_TIMEOUT_SECONDS,
+)
+from karpenter_tpu.testing import Environment, mk_nodepool, mk_pod
+
+
+def _env(**env_kwargs):
+    env = Environment(
+        types=[make_instance_type("c8", cpu=8, memory=32 * GIB)],
+        **env_kwargs,
+    )
+    env.kube.create(mk_nodepool("default"))
+    return env
+
+
+class TestRegistrationSync:
+    def test_registered_label_and_unregistered_taint(self):
+        env = _env()
+        env.provision(mk_pod(cpu=1.0))
+        node = env.kube.nodes()[0]
+        assert node.metadata.labels.get(NODE_REGISTERED_LABEL) == "true"
+        assert not any(
+            t.key == UNREGISTERED_TAINT_KEY for t in node.spec.taints
+        )
+
+    def test_claim_labels_annotations_sync_to_node(self):
+        env = Environment(types=[
+            make_instance_type("c8", cpu=8, memory=32 * GIB),
+        ])
+        pool = mk_nodepool("default")
+        pool.spec.template.labels["team"] = "ml"
+        pool.spec.template.annotations["contact"] = "oncall"
+        env.kube.create(pool)
+        env.provision(mk_pod(cpu=1.0))
+        node = env.kube.nodes()[0]
+        assert node.metadata.labels.get("team") == "ml"
+        assert node.metadata.annotations.get("contact") == "oncall"
+
+    def test_node_owned_by_claim(self):
+        # registration.go adds the NodeClaim controller reference
+        env = _env()
+        env.provision(mk_pod(cpu=1.0))
+        node = env.kube.nodes()[0]
+        claim = env.kube.node_claims()[0]
+        owners = [r for r in node.metadata.owner_references
+                  if r.kind == "NodeClaim"]
+        assert owners and owners[0].name == claim.metadata.name
+        assert owners[0].controller
+
+    def test_owner_reference_not_duplicated(self):
+        env = _env()
+        env.provision(mk_pod(cpu=1.0))
+        # re-running registration must not stack references
+        env.lifecycle.reconcile_all()
+        env.lifecycle.reconcile_all()
+        node = env.kube.nodes()[0]
+        owners = [r for r in node.metadata.owner_references
+                  if r.kind == "NodeClaim"]
+        assert len(owners) == 1
+
+    def test_pool_taints_sync_to_node(self):
+        env = Environment(types=[
+            make_instance_type("c8", cpu=8, memory=32 * GIB),
+        ])
+        pool = mk_nodepool("default")
+        pool.spec.template.spec.taints = [
+            Taint(key="dedicated", value="batch", effect="NoSchedule")
+        ]
+        env.kube.create(pool)
+        pod = mk_pod(cpu=1.0)
+        from karpenter_tpu.kube.objects import Toleration
+
+        pod.spec.tolerations = [
+            Toleration(key="dedicated", operator="Exists")
+        ]
+        env.provision(pod)
+        node = env.kube.nodes()[0]
+        assert any(t.key == "dedicated" for t in node.spec.taints)
+
+
+class TestInitializationGating:
+    def _stalled_claim(self, registration_delay=0.0, startup_taints=()):
+        env = Environment(
+            types=[make_instance_type("c8", cpu=8, memory=32 * GIB)],
+            registration_delay=registration_delay,
+        )
+        pool = mk_nodepool("default")
+        pool.spec.template.spec.startup_taints = list(startup_taints)
+        env.kube.create(pool)
+        return env
+
+    def test_not_initialized_before_registered(self):
+        env = self._stalled_claim(registration_delay=3600.0)
+        env.provision(mk_pod(cpu=1.0))
+        claim = env.kube.node_claims()[0]
+        assert claim.status_conditions.is_true(COND_LAUNCHED)
+        assert not claim.status_conditions.is_true(COND_REGISTERED)
+        assert not claim.status_conditions.is_true(COND_INITIALIZED)
+
+    def test_not_initialized_while_node_not_ready(self):
+        env = _env()
+        env.provision(mk_pod(cpu=1.0))
+        node = env.kube.nodes()[0]
+        claim = env.kube.node_claims()[0]
+        assert claim.status_conditions.is_true(COND_INITIALIZED)
+        # a NEW claim whose node goes NotReady never initializes
+        env.kube.create(mk_pod(name="more", cpu=7.5))
+        env.provisioner.batcher.trigger()
+        env.provisioner.reconcile()
+        env.lifecycle.reconcile_all()
+        env.cloud.tick()
+        fresh = [n for n in env.kube.nodes()
+                 if n.metadata.name != node.metadata.name]
+        if fresh:
+            fresh[0].status.conditions[0].status = "False"
+            env.lifecycle.reconcile_all()
+            fresh_claim = [
+                c for c in env.kube.node_claims()
+                if c.status.node_name == fresh[0].metadata.name
+            ][0]
+            assert not fresh_claim.status_conditions.is_true(COND_INITIALIZED)
+
+    def test_not_initialized_until_startup_taints_removed(self):
+        env = self._stalled_claim(startup_taints=[
+            Taint(key="cni.example.com/not-ready", value="",
+                  effect="NoExecute"),
+        ])
+        env.provision(mk_pod(cpu=1.0))
+        claim = env.kube.node_claims()[0]
+        node = env.kube.nodes()[0]
+        assert claim.status_conditions.is_true(COND_REGISTERED)
+        assert not claim.status_conditions.is_true(COND_INITIALIZED)
+        # the CNI daemon removes its taint: initialization completes
+        node.spec.taints = [
+            t for t in node.spec.taints
+            if t.key != "cni.example.com/not-ready"
+        ]
+        env.kube.update(node)
+        env.lifecycle.reconcile_all()
+        assert claim.status_conditions.is_true(COND_INITIALIZED)
+        assert node.metadata.labels.get(NODE_INITIALIZED_LABEL) == "true"
+
+    def test_not_initialized_until_ephemeral_taints_removed(self):
+        env = _env()
+        env.provision(mk_pod(cpu=1.0))
+        node = env.kube.nodes()[0]
+        # a fresh ephemeral taint (node.kubernetes.io/*) blocks a NEW
+        # claim's initialization; simulate by un-initializing state
+        claim = env.kube.node_claims()[0]
+        claim.status_conditions.set_false(
+            COND_INITIALIZED, "Test", "reset", now=time.time()
+        )
+        node.metadata.labels.pop(NODE_INITIALIZED_LABEL, None)
+        node.spec.taints.append(
+            Taint(key="node.kubernetes.io/not-ready", effect="NoExecute")
+        )
+        env.kube.update(node)
+        env.lifecycle.reconcile_all()
+        assert not claim.status_conditions.is_true(COND_INITIALIZED)
+        node.spec.taints = [
+            t for t in node.spec.taints
+            if t.key != "node.kubernetes.io/not-ready"
+        ]
+        env.kube.update(node)
+        env.lifecycle.reconcile_all()
+        assert claim.status_conditions.is_true(COND_INITIALIZED)
+
+    def test_not_initialized_until_extended_resources_registered(self):
+        from karpenter_tpu.cloudprovider.fake import make_instance_type as mit
+
+        env = Environment(types=[
+            mit("gpu8", cpu=8, memory=32 * GIB,
+                extra_resources={"example.com/gpu": 4.0}),
+        ])
+        env.kube.create(mk_nodepool("default"))
+        env.provision(mk_pod(cpu=1.0))
+        claim = env.kube.node_claims()[0]
+        node = env.kube.get_node(claim.status.node_name)
+        # simulate the device plugin not having advertised yet
+        claim.status_conditions.set_false(
+            COND_INITIALIZED, "Test", "reset", now=time.time()
+        )
+        node.metadata.labels.pop(NODE_INITIALIZED_LABEL, None)
+        claim.spec.resources = {"example.com/gpu": 2.0}
+        saved = node.status.allocatable.pop("example.com/gpu")
+        env.kube.update(node)
+        env.lifecycle.reconcile_all()
+        assert not claim.status_conditions.is_true(COND_INITIALIZED)
+        node.status.allocatable["example.com/gpu"] = saved
+        env.kube.update(node)
+        env.lifecycle.reconcile_all()
+        assert claim.status_conditions.is_true(COND_INITIALIZED)
+
+
+class TestLaunchErrors:
+    def test_insufficient_capacity_deletes_claim(self):
+        env = _env()
+        env.cloud.next_create_error = InsufficientCapacityError("sold out")
+        env.kube.create(mk_pod(name="w", cpu=1.0))
+        env.provisioner.batcher.trigger()
+        env.provisioner.reconcile()
+        env.lifecycle.reconcile_all()
+        # ICE is terminal for the claim (lifecycle deletes it; the pod
+        # reschedules through a fresh solve)
+        assert all(
+            c.metadata.deletion_timestamp is not None
+            or not c.status_conditions.is_true(COND_LAUNCHED)
+            for c in env.kube.node_claims()
+        )
+
+    def test_node_class_not_ready_deletes_claim(self):
+        env = _env()
+        env.cloud.next_create_error = NodeClassNotReadyError("nodeclass gone")
+        env.kube.create(mk_pod(name="w", cpu=1.0))
+        env.provisioner.batcher.trigger()
+        env.provisioner.reconcile()
+        env.lifecycle.reconcile_all()
+        assert all(
+            c.metadata.deletion_timestamp is not None
+            or not c.status_conditions.is_true(COND_LAUNCHED)
+            for c in env.kube.node_claims()
+        )
+
+
+class TestLivenessTimeouts:
+    def test_launch_timeout_deletes_after_window(self):
+        env = _env()
+        env.cloud.next_create_error = RuntimeError("transient API error")
+        env.kube.create(mk_pod(name="w", cpu=1.0))
+        env.provisioner.batcher.trigger()
+        now = time.time()
+        env.provisioner.reconcile(now=now)
+        claims = env.kube.node_claims()
+        assert claims and not claims[0].status_conditions.is_true(
+            COND_LAUNCHED
+        )
+        # inside the window: kept (retried)
+        env.cloud.next_create_error = RuntimeError("still failing")
+        env.lifecycle.reconcile_all(now=now + LAUNCH_TIMEOUT_SECONDS - 10)
+        assert env.kube.get_node_claim(claims[0].metadata.name) is not None
+        # past the window: deleted
+        env.cloud.next_create_error = RuntimeError("still failing")
+        env.lifecycle.reconcile_all(now=now + LAUNCH_TIMEOUT_SECONDS + 10)
+        env.reconcile_termination(now=now + LAUNCH_TIMEOUT_SECONDS + 11)
+        remaining = env.kube.get_node_claim(claims[0].metadata.name)
+        assert remaining is None or remaining.metadata.deletion_timestamp
+
+    def test_registration_timeout_deletes_after_window(self):
+        env = Environment(
+            types=[make_instance_type("c8", cpu=8, memory=32 * GIB)],
+            registration_delay=10 * REGISTRATION_TIMEOUT_SECONDS,
+        )
+        env.kube.create(mk_nodepool("default"))
+        env.kube.create(mk_pod(name="w", cpu=1.0))
+        env.provisioner.batcher.trigger()
+        now = time.time()
+        env.provisioner.reconcile(now=now)
+        env.lifecycle.reconcile_all(now=now)
+        claim = env.kube.node_claims()[0]
+        assert claim.status_conditions.is_true(COND_LAUNCHED)
+        assert not claim.status_conditions.is_true(COND_REGISTERED)
+        env.lifecycle.reconcile_all(
+            now=now + REGISTRATION_TIMEOUT_SECONDS - 10
+        )
+        assert claim.metadata.deletion_timestamp is None
+        env.lifecycle.reconcile_all(
+            now=now + REGISTRATION_TIMEOUT_SECONDS + 10
+        )
+        assert claim.metadata.deletion_timestamp is not None
