@@ -35,7 +35,7 @@ from karpenter_tpu.cloudprovider.types import (
 )
 from karpenter_tpu.kube.client import KubeClient
 from karpenter_tpu.metrics.store import NODECLAIMS_TERMINATED
-from karpenter_tpu.kube.objects import Node
+from karpenter_tpu.kube.objects import Node, OwnerReference
 from karpenter_tpu.scheduling.taints import is_ephemeral
 from karpenter_tpu.state.nodepoolhealth import HealthTracker
 from karpenter_tpu.utils.duration import parse_duration
@@ -195,8 +195,6 @@ class NodeClaimLifecycle:
             r.kind == "NodeClaim" and r.name == claim.metadata.name
             for r in node.metadata.owner_references
         ):
-            from karpenter_tpu.kube.objects import OwnerReference
-
             node.metadata.owner_references.append(OwnerReference(
                 kind="NodeClaim", name=claim.metadata.name,
                 uid=claim.metadata.uid, controller=True,

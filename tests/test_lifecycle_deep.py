@@ -140,14 +140,14 @@ class TestInitializationGating:
         env.cloud.tick()
         fresh = [n for n in env.kube.nodes()
                  if n.metadata.name != node.metadata.name]
-        if fresh:
-            fresh[0].status.conditions[0].status = "False"
-            env.lifecycle.reconcile_all()
-            fresh_claim = [
-                c for c in env.kube.node_claims()
-                if c.status.node_name == fresh[0].metadata.name
-            ][0]
-            assert not fresh_claim.status_conditions.is_true(COND_INITIALIZED)
+        assert fresh, "setup: second node never provisioned"
+        fresh[0].status.conditions[0].status = "False"
+        env.lifecycle.reconcile_all()
+        fresh_claim = [
+            c for c in env.kube.node_claims()
+            if c.status.node_name == fresh[0].metadata.name
+        ][0]
+        assert not fresh_claim.status_conditions.is_true(COND_INITIALIZED)
 
     def test_not_initialized_until_startup_taints_removed(self):
         env = self._stalled_claim(startup_taints=[
@@ -228,14 +228,14 @@ class TestLaunchErrors:
         env.kube.create(mk_pod(name="w", cpu=1.0))
         env.provisioner.batcher.trigger()
         env.provisioner.reconcile()
+        claims = env.kube.node_claims()
+        assert claims, "setup: no claim was created"
         env.lifecycle.reconcile_all()
         # ICE is terminal for the claim (lifecycle deletes it; the pod
         # reschedules through a fresh solve)
-        assert all(
-            c.metadata.deletion_timestamp is not None
-            or not c.status_conditions.is_true(COND_LAUNCHED)
-            for c in env.kube.node_claims()
-        )
+        for claim in claims:
+            live = env.kube.get_node_claim(claim.metadata.name)
+            assert live is None or live.metadata.deletion_timestamp is not None
 
     def test_node_class_not_ready_deletes_claim(self):
         env = _env()
@@ -243,12 +243,12 @@ class TestLaunchErrors:
         env.kube.create(mk_pod(name="w", cpu=1.0))
         env.provisioner.batcher.trigger()
         env.provisioner.reconcile()
+        claims = env.kube.node_claims()
+        assert claims, "setup: no claim was created"
         env.lifecycle.reconcile_all()
-        assert all(
-            c.metadata.deletion_timestamp is not None
-            or not c.status_conditions.is_true(COND_LAUNCHED)
-            for c in env.kube.node_claims()
-        )
+        for claim in claims:
+            live = env.kube.get_node_claim(claim.metadata.name)
+            assert live is None or live.metadata.deletion_timestamp is not None
 
 
 class TestLivenessTimeouts:

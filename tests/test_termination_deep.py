@@ -364,3 +364,97 @@ class TestFourWaveOrder:
         assert order.index("plain") < order.index("daemon")
         assert order.index("daemon") < order.index("crit")
         assert order.index("crit") < order.index("crit-daemon")
+
+
+class TestDrainWaveOrdering:
+    """terminator.go groupPodsByPriority / graceful-node-shutdown
+    ordering depth: non-critical non-daemon -> non-critical daemon ->
+    critical non-daemon -> critical daemon; a wave starts only when
+    the previous one fully cleared."""
+
+    @staticmethod
+    def _types():
+        return [make_instance_type("c8", cpu=8, memory=32 * GIB)]
+
+    def _mixed_node(self):
+        env = Environment(types=self._types())
+        env.kube.create(mk_nodepool("default"))
+        workload = mk_pod(name="workload", cpu=0.2)
+        critical = mk_pod(name="critical", cpu=0.2)
+        critical.spec.priority_class_name = "system-cluster-critical"
+        env.provision(workload, critical)
+        node = env.kube.nodes()[0]
+        daemon = mk_pod(name="daemon", cpu=0.1, owner="DaemonSet")
+        crit_daemon = mk_pod(name="crit-daemon", cpu=0.1, owner="DaemonSet")
+        crit_daemon.spec.priority = 2_000_000_000
+        for pod in (daemon, crit_daemon):
+            env.kube.create(pod)
+            env.kube.bind_pod(
+                env.kube.get_pod("default", pod.metadata.name),
+                node.metadata.name,
+            )
+        return env, node
+
+    def test_waves_drain_in_strict_order(self):
+        env, node = self._mixed_node()
+        claim = env.kube.node_claims()[0]
+        now = time.time()
+        env.kube.delete(claim, now=now)
+        evicted_order = []
+        seen = set()
+        for i in range(40):
+            env.reconcile_termination(now=now + 1 + i * 11)
+            on_node = {
+                p.metadata.name
+                for p in env.kube.pods_on_node(node.metadata.name)
+                if not p.is_terminal()
+            }
+            for name in ("workload", "daemon", "critical", "crit-daemon"):
+                if name not in on_node and name not in seen:
+                    seen.add(name)
+                    evicted_order.append(name)
+            if env.kube.get_node(node.metadata.name) is None:
+                break
+        assert env.kube.get_node(node.metadata.name) is None
+        # strict wave order: the non-critical workload leaves before
+        # the critical pod, and the critical daemon goes last
+        assert evicted_order.index("workload") < evicted_order.index("critical")
+        assert evicted_order.index("daemon") <= evicted_order.index("crit-daemon")
+        assert evicted_order[-1] == "crit-daemon"
+
+    def test_blocked_early_wave_holds_later_waves(self):
+        """A PDB pinning the first wave must keep critical pods
+        running: later waves never start early."""
+        from karpenter_tpu.kube.objects import (
+            LabelSelector,
+            PodDisruptionBudget,
+            PodDisruptionBudgetSpec,
+        )
+
+        env = Environment(types=self._types())
+        env.kube.create(mk_nodepool("default"))
+        workload = mk_pod(name="workload", cpu=0.2, labels={"app": "w"})
+        critical = mk_pod(name="critical", cpu=0.2)
+        critical.spec.priority_class_name = "system-cluster-critical"
+        env.provision(workload, critical)
+        env.kube.create(PodDisruptionBudget(
+            metadata=ObjectMeta(name="pdb"),
+            spec=PodDisruptionBudgetSpec(
+                selector=LabelSelector.of({"app": "w"}),
+                max_unavailable=0,
+            ),
+        ))
+        node = env.kube.nodes()[0]
+        claim = env.kube.node_claims()[0]
+        now = time.time()
+        env.kube.delete(claim, now=now)
+        for i in range(6):
+            env.reconcile_termination(now=now + 1 + i * 11)
+        live = {
+            p.metadata.name
+            for p in env.kube.pods_on_node(node.metadata.name)
+            if not p.is_terminal()
+        }
+        # wave 1 blocked by the PDB -> the critical pod (wave 3) stays
+        assert "critical" in live
+        assert env.kube.get_node(node.metadata.name) is not None
