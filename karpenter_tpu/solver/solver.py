@@ -11,7 +11,6 @@ which pods land where). Backend is the JAX packing kernel
 from __future__ import annotations
 
 import os
-import time as _time
 from dataclasses import dataclass, field
 from typing import Optional, Sequence
 
@@ -118,11 +117,14 @@ class Solution:
         return sum(n.price for n in self.new_nodes)
 
 
-def _merge_budget_seconds() -> float:
-    """Wall budget for the post-pack merge improvement (host-side; the
-    pass is anytime — it harvests the biggest wins first and stops
-    cleanly). Read per call like every other solver env knob."""
-    return float(os.environ.get("KARPENTER_MERGE_BUDGET_SECONDS", "0.15"))
+def _merge_budget_pairs() -> int:
+    """Work budget (pair feasibility checks) for the post-pack merge
+    improvement. A WORK budget, not a wall deadline: identical inputs
+    must produce identical fleets regardless of machine load — the
+    steady-state skip, the sharded-equality dryrun, and concurrent
+    solves all rely on solve() being a pure function of its inputs.
+    Read per call like every other solver env knob; 0 disables."""
+    return int(os.environ.get("KARPENTER_MERGE_BUDGET", "12000"))
 
 
 def _uncapped_cols(enc: Encoded) -> np.ndarray:
@@ -501,9 +503,9 @@ def _merge_underfilled(enc: Encoded, result, masks: np.ndarray) -> None:
     p_cur = np.array([
         float(enc.cfg_price[masks[ni]].min()) for ni in order
     ])
-    deadline = _time.perf_counter() + _merge_budget_seconds()
+    budget = _merge_budget_pairs()
     for a in range(m):
-        if not alive[a] or _time.perf_counter() > deadline:
+        if not alive[a] or budget <= 0:
             continue
         merged_any = True
         while merged_any:
@@ -522,8 +524,9 @@ def _merge_underfilled(enc: Encoded, result, masks: np.ndarray) -> None:
             quick[a] = False
             # largest partner first: densest merged node
             for b in np.flatnonzero(quick)[::-1]:
-                if _time.perf_counter() > deadline:
+                if budget <= 0:
                     break
+                budget -= 1
                 if not (packed[a] & packed[b]).any():
                     continue
                 na, nb = order[a], order[b]
