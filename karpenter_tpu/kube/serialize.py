@@ -10,10 +10,12 @@ object. Round-trip fidelity is tested field-for-field
 (tests/test_real_client.py) and the rendered CRs are checked against
 the generated openAPIV3Schema artifacts.
 
-Covered kinds: NodePool, NodeClaim, NodeOverlay (the CRDs), plus Pod
-and Node (the core-v1 kinds the controllers consume from a real
-cluster: requests, affinity, topology spread, tolerations, volumes,
-taints, conditions).
+Covered kinds (the TO_CR/FROM_CR registries below are the source of
+truth): NodePool, NodeClaim, NodeOverlay (the CRDs); Pod and Node
+(requests, affinity, topology spread, tolerations, volumes, taints,
+conditions, ownerReferences); DaemonSet, PodDisruptionBudget,
+PersistentVolumeClaim (read-side controller inputs); Lease (leader
+election); and Event (write-side recorder output).
 """
 
 from __future__ import annotations

@@ -631,3 +631,22 @@ class TestEvictionSubresource:
         names = {p.metadata.name for p in kube.pods()}
         assert names <= created_by_user  # nothing fabricated
         assert names == set()  # and evictions were terminal here
+
+
+class TestCodecRegistryDocs:
+    def test_docstring_names_every_codec_kind(self):
+        """The module docstring is the adapter's spec: every kind in
+        the codec registries must be named there (doc drift on exactly
+        this list was flagged two rounds running)."""
+        import re
+
+        import karpenter_tpu.kube.serialize as ser
+
+        assert set(ser.TO_CR) == set(ser.FROM_CR)
+        for kind in ser.TO_CR:
+            # word-boundary: 'Pod' must not ride along inside
+            # 'PodDisruptionBudget', nor 'Node' inside 'NodePool'
+            assert re.search(rf"\b{kind}\b", ser.__doc__), (
+                f"{kind} has a codec but is missing from the module "
+                "docstring's covered-kinds list"
+            )
