@@ -231,3 +231,86 @@ class TestRunawayGuards:
         env.provision(mk_pod(cpu=2.0, memory=GIB), now=now)
         assert len(env.kube.nodes()) == 1
         assert env.all_pods_bound()
+
+
+class TestPerfSmoke:
+    """The regression perf smoke (test/suites/regression/
+    perf_test.go:36-80): 100 replicas through provision, a full drift
+    roll, and a full expiration roll — interval-timed, with generous
+    wall bounds as the regression tripwire."""
+
+    REPLICAS = 100
+
+    def _fleet(self):
+        env = make_env()
+        pods = [mk_pod(name=f"w-{i}", cpu=1.0) for i in range(self.REPLICAS)]
+        t0 = time.perf_counter()
+        env.provision(*pods)
+        provision_s = time.perf_counter() - t0
+        bound = [p for p in env.kube.pods() if p.spec.node_name]
+        assert len(bound) == self.REPLICAS
+        return env, provision_s
+
+    def test_provision_100_replicas(self):
+        env, provision_s = self._fleet()
+        assert provision_s < 30.0, f"provisioning took {provision_s:.1f}s"
+        assert env.kube.nodes(), "no nodes launched"
+
+    def test_drift_roll_100_replicas(self):
+        env, _ = self._fleet()
+        before = {c.metadata.name for c in env.kube.node_claims()}
+        now = time.time() + 120
+        mark_all_drifted(env, now)
+        t0 = time.perf_counter()
+        for i in range(120):
+            if time.perf_counter() - t0 > 60.0:
+                break  # the wall bound below reports the regression
+            now += 11
+            env.reconcile_disruption(now=now)
+            claims = [c for c in env.kube.node_claims()
+                      if c.metadata.deletion_timestamp is None]
+            if claims and not (before & {c.metadata.name for c in claims}):
+                break
+        drift_s = time.perf_counter() - t0
+        live = [c for c in env.kube.node_claims()
+                if c.metadata.deletion_timestamp is None]
+        assert live and not (before & {c.metadata.name for c in live}), \
+            "drift roll never completed"
+        bound = [p for p in env.kube.pods()
+                 if p.spec.node_name and not p.is_terminal()]
+        assert len(bound) == self.REPLICAS, "pods lost during the roll"
+        assert drift_s < 60.0, f"drift roll took {drift_s:.1f}s"
+
+    def test_expiration_roll_100_replicas(self):
+        env, _ = self._fleet()
+        pool = env.kube.get_node_pool("default")
+        pool.spec.template.spec.expire_after = "1h"
+        env.kube.touch(pool)
+        # propagate expireAfter onto existing claims the way hygiene
+        # does, then jump past the lifetime
+        for claim in env.kube.node_claims():
+            claim.spec.expire_after = "1h"
+        before = {c.metadata.name for c in env.kube.node_claims()}
+        base = min(c.metadata.creation_timestamp
+                   for c in env.kube.node_claims())
+        now = base + 3601
+        t0 = time.perf_counter()
+        for i in range(120):
+            if time.perf_counter() - t0 > 60.0:
+                break  # the wall bound below reports the regression
+            env.expiration.reconcile_all(now=now)
+            env.reconcile_disruption(now=now)
+            now += 11
+            claims = [c for c in env.kube.node_claims()
+                      if c.metadata.deletion_timestamp is None]
+            if claims and not (before & {c.metadata.name for c in claims}):
+                break
+        expire_s = time.perf_counter() - t0
+        live = [c for c in env.kube.node_claims()
+                if c.metadata.deletion_timestamp is None]
+        assert live and not (before & {c.metadata.name for c in live}), \
+            "expiration roll never completed"
+        bound = [p for p in env.kube.pods()
+                 if p.spec.node_name and not p.is_terminal()]
+        assert len(bound) == self.REPLICAS, "pods lost during the roll"
+        assert expire_s < 60.0, f"expiration roll took {expire_s:.1f}s"
