@@ -167,14 +167,17 @@ class DisruptionEngine:
         # catalog) otherwise; the reference resolves prices from the
         # instance types already fetched for the scheduling run)
         self._price_index = {}
+        protected = self.queue.protected_claim_names()
         for node in self.cluster.nodes():
-            candidate = self._build_candidate(node, reason, pdb, now)
+            candidate = self._build_candidate(node, reason, pdb, now,
+                                              protected)
             if candidate is not None:
                 out.append(candidate)
         return out
 
     def _build_candidate(
-        self, node: StateNode, reason: str, pdb: PdbLimits, now: float
+        self, node: StateNode, reason: str, pdb: PdbLimits, now: float,
+        protected: frozenset = frozenset(),
     ) -> Optional[Candidate]:
         if node.deleting() or node.nominated(now):
             return None
@@ -183,6 +186,8 @@ class DisruptionEngine:
         claim = node.node_claim
         if claim is None:
             return None
+        if claim.metadata.name in protected:
+            return None  # an in-flight command's replacement
         pool = self.kube.get_node_pool(node.nodepool_name())
         if pool is None or pool.is_static():
             return None
@@ -796,6 +801,41 @@ class OrchestrationQueue:
         self.active: list[Command] = []
         self.validator = None  # set by DisruptionEngine
 
+    def protected_claim_names(self) -> set[str]:
+        """Replacement claims of in-flight commands: OFF LIMITS to the
+        candidate scan. Without this, emptiness eats a replace
+        command's still-empty replacement the moment its
+        consolidatable TTL elapses, the command sees its replacement
+        dying and rolls back, and the taint/launch/reap cycle livelocks
+        forever (the reference nominates replacement nodes for the
+        candidates' pods — disruption.go launchReplacementNodeClaims —
+        which keeps them out of the candidate set the same way)."""
+        return {
+            plan.claim_name
+            for command in self.active
+            if command.results is not None
+            for plan in command.results.new_node_plans
+            if plan.claim_name
+        }
+
+    def _nominate_replacements(self, command: Command,
+                               now: Optional[float] = None) -> None:
+        """Refresh the nomination window on every replacement's state
+        node while the command is in flight: the candidates' pods are
+        already spoken for onto this capacity."""
+        if command.results is None:
+            return
+        for plan in command.results.new_node_plans:
+            if not plan.claim_name:
+                continue
+            state = self.cluster.node_for_key(plan.claim_name)
+            if state is None:
+                claim = self.kube.get_node_claim(plan.claim_name)
+                if claim is not None and claim.status.node_name:
+                    state = self.cluster.node_for_name(claim.status.node_name)
+            if state is not None:
+                state.nominate(now=now)
+
     def _record(self, command: Command, now: float) -> None:
         """DisruptionTerminating on every candidate (disruption/
         events/events.go:56-63 posts to both the Node and the
@@ -845,6 +885,7 @@ class OrchestrationQueue:
                             command.reason)
                 self._rollback(command, now=now)
                 return
+            self._nominate_replacements(command, now=now)
         self.active.append(command)
 
     def reconcile(self, now: Optional[float] = None) -> None:
@@ -857,6 +898,9 @@ class OrchestrationQueue:
         now = time.time() if now is None else now
         still_active = []
         for command in self.active:
+            # keep the replacements' nomination windows fresh while
+            # the command waits (registration may outlive one window)
+            self._nominate_replacements(command, now=now)
             state = self._replacements_state(command)
             if state == "ready":
                 verdict = self._validate(command, now)
@@ -955,18 +999,24 @@ class OrchestrationQueue:
             if claim is None or claim.metadata.deletion_timestamp is not None:
                 continue
             state_node = self.cluster.node_for_key(plan.claim_name)
+            if state_node is None and claim.status.node_name:
+                state_node = self.cluster.node_for_name(claim.status.node_name)
             hosts_load = False
             if state_node is not None:
-                if state_node.nominated(now):
+                # the QUEUE's own in-flight protection nominated this
+                # replacement (see _nominate_replacements) — that must
+                # not read as "pending pods want it" at rollback, so
+                # withdraw it before judging real load. (A concurrent
+                # provisioner nomination is withdrawn too; its pods
+                # re-solve through the batcher when the claim retires.)
+                state_node.nominated_until = 0.0
+                for pod_key in state_node.pod_keys:
+                    pod = self.kube.get_pod(*pod_key.split("/", 1))
+                    if pod is None or pod.is_terminal() or pod.is_terminating():
+                        continue
+                    if pod.owner_kind() == "DaemonSet":
+                        continue
                     hosts_load = True
-                else:
-                    for pod_key in state_node.pod_keys:
-                        pod = self.kube.get_pod(*pod_key.split("/", 1))
-                        if pod is None or pod.is_terminal() or pod.is_terminating():
-                            continue
-                        if pod.owner_kind() == "DaemonSet":
-                            continue
-                        hosts_load = True
-                        break
+                    break
             if not hosts_load:
                 self.kube.delete(claim, now=now)

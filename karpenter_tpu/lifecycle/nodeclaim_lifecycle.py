@@ -45,6 +45,9 @@ log = logging.getLogger("karpenter.lifecycle")
 
 LAUNCH_TIMEOUT_SECONDS = 5 * 60       # liveness.go:51
 REGISTRATION_TIMEOUT_SECONDS = 15 * 60  # liveness.go:56
+# how often reconcile_dirty re-queues every deleting claim (wedge
+# recovery bound; event-tracked claims progress every pass regardless)
+DELETING_SWEEP_SECONDS = 30.0
 
 
 class NodeClaimLifecycle:
@@ -65,6 +68,7 @@ class NodeClaimLifecycle:
         # object event, so they stay on the every-tick path until they
         # settle — in steady state the set is empty
         self._active: set[str] = set()
+        self._last_deleting_sweep = 0.0
 
     # -- entry ----------------------------------------------------------------
 
@@ -94,7 +98,21 @@ class NodeClaimLifecycle:
         node events mapped back via nodeName) plus the active set of
         claims still progressing through launch/register/initialize or
         finalize."""
+        now = time.time() if now is None else now
         keys = self.dirty.drain("NodeClaim")
+        # Periodic deleting-claim sweep (controller-runtime requeues
+        # deleting objects until their finalizer clears): the finalize
+        # chain needs multiple passes, and an event race that drops a
+        # claim from the active set mid-chain would otherwise wedge it
+        # deleting forever with its instance still running (found by
+        # the round-5 randomized soak). Periodic, not per-pass, so the
+        # steady state stays O(changes + in-flight).
+        if now - self._last_deleting_sweep >= DELETING_SWEEP_SECONDS:
+            self._last_deleting_sweep = now
+            keys |= {
+                c.key for c in self.kube.node_claims()
+                if c.metadata.deletion_timestamp is not None
+            }
         node_keys = self.dirty.drain("Node")
         if node_keys:
             # one pid->claim index per pass, not a claim scan per node

@@ -253,6 +253,7 @@ class TerminationController:
         # further node events, so they stay on the every-tick path
         # until their finalizer drops — empty in steady state
         self._terminating: set[str] = set()
+        self._last_deleting_sweep = 0.0
 
     def reconcile(self, node: Node, now: Optional[float] = None) -> None:
         now = time.time() if now is None else now
@@ -319,10 +320,21 @@ class TerminationController:
         timestamp ever need this controller, and they're tracked from
         node events; drain/volume retries keep them in the set until
         the finalizer drops."""
+        now_mono = now if now is not None else time.time()
         for key in self.dirty.drain("Node"):
             node = self.kube.get_node(key)
             if node is not None and node.metadata.deletion_timestamp is not None:
                 self._terminating.add(key)
+        # periodic invariant sweep: every deleting node is tracked even
+        # if its deletion event was consumed elsewhere (a full-resync
+        # tick) — same wedge class as the lifecycle controller's
+        # deleting-claim re-queue; periodic so steady state stays
+        # O(terminating nodes)
+        if now_mono - self._last_deleting_sweep >= 30.0:
+            self._last_deleting_sweep = now_mono
+            for node in self.kube.nodes():
+                if node.metadata.deletion_timestamp is not None:
+                    self._terminating.add(node.metadata.name)
         if not self._terminating:
             if self.queue._pending_rebirth:
                 self.queue.prune()

@@ -133,6 +133,7 @@ class Operator:
         self._last_gc = 0.0
         self._last_metrics = 0.0
         self._last_resync = 0.0
+        self._last_pending_scan = 0.0
         # plans whose pods await binding (the kube-scheduler's job in a
         # real cluster; this runtime owns the whole substrate, so it
         # binds pods to the nodes the solver placed them on)
@@ -175,6 +176,26 @@ class Operator:
             self.hydration.reconcile_dirty()
             self.nodepool_status.reconcile_dirty(now=now)
         self.static.reconcile_all(now=now)
+
+        # Periodic re-solve backstop: the reference's provisioner is a
+        # singleton controller that reconciles on a steady requeue, so
+        # a pod left unschedulable by one solve is retried even with
+        # no further watch traffic (provisioner.go:116). The batcher
+        # here fires on events; without this, a pod that missed its
+        # window (capacity blip, PDB-held drain, ICE) wedges Pending
+        # forever once the event stream goes quiet.
+        if (
+            not self.provisioner.batcher._pending
+            and now - self._last_pending_scan
+            >= self.options.batch_max_duration
+        ):
+            self._last_pending_scan = now
+            # the provisioner's own intake filter decides what counts
+            # as provisionable — a pod it deliberately ignores
+            # (foreign scheduler, rejected PVC) must not re-arm the
+            # backstop forever
+            if self.provisioner.get_pending_pods():
+                self.provisioner.batcher.trigger(now=now)
 
         if self.provisioner.batcher.ready(now=now):
             with self.profiler.span("provisioning"):

@@ -15,6 +15,7 @@ from karpenter_tpu.apis.v1.nodepool import (
     Budget,
     REASON_DRIFTED,
     REASON_EMPTY,
+    REASON_UNDERUTILIZED,
 )
 from karpenter_tpu.cloudprovider.fake import GIB, make_instance_type
 from karpenter_tpu.testing import Environment, mk_nodepool, mk_pod
@@ -234,3 +235,65 @@ class TestQueueIndependence:
         assert not state.marked_for_deletion
         cands = env.disruption.get_candidates("Underutilized", now + 30)
         assert len(cands) == 1
+
+
+class TestReplacementProtection:
+    def test_emptiness_never_reaps_inflight_replacement(self):
+        """Round-5 soak livelock: a replace command's still-empty
+        replacement must be OFF LIMITS to emptiness (its
+        consolidatable TTL elapses before the candidates' pods move).
+        Without protection the command watches its replacement die,
+        rolls back, re-fires, and the fleet churns forever."""
+        env = _env()  # consolidate_after=0s: worst case
+        now = _nodes(env, 1)
+        claim = env.kube.node_claims()[0]
+        _mark_drifted(env, now=now)
+        command = env.disruption.reconcile(now=now)
+        assert command is not None and command.reason == REASON_DRIFTED
+        replacement_names = {
+            p.claim_name for p in command.results.new_node_plans
+        }
+        assert replacement_names
+        # WHILE the command is in flight, the replacement is excluded
+        # from every reason's candidate scan (after completion it is a
+        # legitimate candidate again)
+        checked = 0
+        for _ in range(6):
+            if not env.disruption.queue.active:
+                break
+            for reason in (REASON_EMPTY, REASON_UNDERUTILIZED):
+                names = {
+                    c.state_node.node_claim.metadata.name
+                    for c in env.disruption.get_candidates(reason, now)
+                }
+                assert not (names & replacement_names), (
+                    f"{reason} candidate scan grabbed an in-flight "
+                    "replacement"
+                )
+            checked += 1
+            now += 11
+            env.reconcile_disruption(now=now)
+        assert checked >= 1, "command completed before protection was probed"
+        # and the roll COMPLETES: drifted claim gone, replacement
+        # holds the workload
+        for _ in range(10):
+            now += 11
+            env.reconcile_disruption(now=now)
+        live = [c for c in env.kube.node_claims()
+                if c.metadata.deletion_timestamp is None]
+        assert claim.metadata.name not in {c.metadata.name for c in live}
+        bound = [p for p in env.kube.pods()
+                 if p.spec.node_name and not p.is_terminal()]
+        assert bound, "workload lost during the roll"
+
+    def test_completed_command_releases_protection(self):
+        env = _env()
+        now = _nodes(env, 1)
+        _mark_drifted(env, now=now)
+        command = env.disruption.reconcile(now=now)
+        assert command is not None
+        for _ in range(10):
+            now += 11
+            env.reconcile_disruption(now=now)
+        assert env.disruption.queue.active == []
+        assert env.disruption.queue.protected_claim_names() == set()
