@@ -55,6 +55,16 @@ from karpenter_tpu.state.nodepoolhealth import HealthTracker
 
 log = logging.getLogger("karpenter.operator")
 
+# how long a scheduling result's placements stay bindable: pods evicted
+# by a disruption command rebirth over several drain ticks and must
+# land on the command's planned capacity, not a fresh solve; pods that
+# never come back (deleted meanwhile) age the plan out. Command plans
+# live longer: draining may not even START until the command's
+# replacements initialize (bounded by the queue's 10-min retry
+# deadline), so their TTL covers that window plus the drain itself.
+BIND_RESULTS_TTL_SECONDS = 120.0
+COMMAND_BIND_TTL_SECONDS = 720.0
+
 
 @dataclass
 class Operator:
@@ -177,6 +187,16 @@ class Operator:
             self.nodepool_status.reconcile_dirty(now=now)
         self.static.reconcile_all(now=now)
 
+        # Planned placements bind BEFORE any fresh solve: pods evicted
+        # by an in-flight disruption command rebirth pending at the end
+        # of the previous tick, and re-solving them from scratch (the
+        # batcher fires on their create events) can buy a NEW node for
+        # pods the command already placed on existing capacity —
+        # consolidation then finds the new node underutilized and the
+        # fleet oscillates one command per poll, forever (seed-11
+        # soak). Binding first consumes them.
+        self._bind_pending(now=now)
+
         # Periodic re-solve backstop: the reference's provisioner is a
         # singleton controller that reconciles on a steady requeue, so
         # a pod left unschedulable by one solve is retried even with
@@ -200,7 +220,7 @@ class Operator:
         if self.provisioner.batcher.ready(now=now):
             with self.profiler.span("provisioning"):
                 results = self.provisioner.reconcile(now=now)
-            self._pending_bindings.append(results)
+            self._enqueue_bindings(results, now, BIND_RESULTS_TTL_SECONDS)
 
         with self.profiler.span("lifecycle"):
             if full:
@@ -229,7 +249,20 @@ class Operator:
         if now - self._last_disruption >= self.options.disruption_poll_seconds:
             self._last_disruption = now
             with self.profiler.span("disruption"):
-                self.disruption.reconcile(now=now)
+                command = self.disruption.reconcile(now=now)
+                if command is not None and command.results is not None:
+                    # the command's placements ARE the plan for the
+                    # candidates' pods: route them through the binding
+                    # queue so evicted pods land on the planned
+                    # capacity instead of re-solving from scratch (the
+                    # reference nominates pods onto the planned nodes
+                    # and the provisioner skips nominated pods —
+                    # without this, a fresh solve can buy a NEW node
+                    # for the displaced pods and consolidation
+                    # oscillates: found by the round-5 seed-11 soak)
+                    self._enqueue_bindings(
+                        command.results, now, COMMAND_BIND_TTL_SECONDS
+                    )
         self.disruption.queue.reconcile(now=now)
 
         with self.profiler.span("termination"):
@@ -252,13 +285,20 @@ class Operator:
             self.nodepool_metrics.reconcile_all(now=now)
             self.status_condition_metrics.reconcile_all(now=now)
 
+    def _enqueue_bindings(self, results, now: float, ttl: float) -> None:
+        results.bind_deadline = now + ttl
+        self._pending_bindings.append(results)
+
     def _bind_pending(self, now: Optional[float] = None) -> None:
         """Bind pods from completed scheduling results to their target
         nodes once those nodes exist (and immediately for placements on
         live nodes). Results are dropped once fully bound or once every
         pod found a different home."""
+        now = time.time() if now is None else now
         remaining = []
         for results in self._pending_bindings:
+            if now > getattr(results, "bind_deadline", float("inf")):
+                continue  # stale plan: its pods re-solve via the batcher
             unbound = False
             for plan in results.new_node_plans:
                 claim = (
@@ -271,8 +311,20 @@ class Operator:
                 )
                 for pod in plan.pods:
                     live = self.kube.get_pod(pod.metadata.namespace, pod.metadata.name)
-                    if live is None or live.spec.node_name:
+                    if live is None or (
+                        live.spec.node_name
+                        and node_name
+                        and live.spec.node_name != node_name
+                    ):
+                        # awaiting rebirth, or still bound to the node
+                        # the command is draining: HOLD the plan until
+                        # the pod comes free (deadline-bounded) — a
+                        # plan dropped while its pods are still bound
+                        # never fires at all (seed-11 oscillation)
+                        unbound = True
                         continue
+                    if live.spec.node_name:
+                        continue  # already home
                     if node_name and not claim_gone:
                         self.kube.bind_pod(live, node_name)
                     elif claim_gone:
@@ -313,6 +365,12 @@ class Operator:
                     live = self.kube.get_pod(pod.metadata.namespace, pod.metadata.name)
                     if live is not None and not live.spec.node_name:
                         self.kube.bind_pod(live, target)
+                    elif live is None or live.spec.node_name != target:
+                        # awaiting rebirth from the drain, or still
+                        # bound to the node being drained: HOLD the
+                        # plan (deadline-bounded) so the pod lands on
+                        # the planned capacity, not a fresh solve
+                        unbound = True
             if unbound:
                 remaining.append(results)
         self._pending_bindings = remaining

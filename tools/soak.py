@@ -1,0 +1,90 @@
+"""Randomized convergence soak (not part of the CI suite).
+
+Drives a full Operator through thousands of ticks of adversarial churn
+(pod create/delete, PDB flap, pool-template drift, provider ICE
+injection), then drains with no faults and requires TOTAL convergence:
+zero unbound pods, zero deleting claims, zero stale disrupted taints,
+an empty orchestration queue, and claims == provider instances.
+
+    PYTHONPATH=. JAX_PLATFORMS=cpu python tools/soak.py <seed> \
+        <churn_wall_seconds> <drain_wall_seconds>
+
+Round-5 findings fixed via this harness: the emptiness-eats-replacement
+livelock, deleting-object requeue wedges, the pending-pod backstop, and
+the planned-placement binding hold. Known residual: some seeds (e.g.
+11) keep the fleet churning under sustained drift-roll + rebirth
+interleavings — each individual command is valid, but the global
+sequence doesn't quiesce within the drain budget. Tracked as future
+work (the reference damps this class with pod-level nomination windows
+on planned capacity).
+"""
+
+import random, sys, time
+from karpenter_tpu.cloudprovider.fake import GIB, make_instance_type
+from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
+from karpenter_tpu.cloudprovider.types import InsufficientCapacityError
+from karpenter_tpu.kube.client import KubeClient
+from karpenter_tpu.operator.operator import Operator
+from karpenter_tpu.testing import mk_nodepool, mk_pod
+from karpenter_tpu.kube.objects import (LabelSelector, ObjectMeta,
+    PodDisruptionBudget, PodDisruptionBudgetSpec)
+
+seed = int(sys.argv[1]); budget = float(sys.argv[2]); drain_budget = float(sys.argv[3])
+rng = random.Random(seed)
+kube = KubeClient()
+types = [make_instance_type("c2", cpu=2, memory=8*GIB, price=2.0),
+         make_instance_type("c4", cpu=4, memory=16*GIB, price=3.0),
+         make_instance_type("c8", cpu=8, memory=32*GIB, price=5.0)]
+cloud = KwokCloudProvider(kube, types=types)
+op = Operator(kube, cloud)
+pool = mk_nodepool("default")
+pool.spec.disruption.consolidate_after = "30s"
+kube.create(pool)
+now = time.time(); pdb = None; created = 0; start = time.time()
+for tick in range(6000):
+    if time.time() - start > budget: break
+    now += rng.choice([1.0, 2.0, 11.0])
+    r = rng.random()
+    if r < 0.30:
+        created += 1
+        kube.create(mk_pod(name=f"w-{created}", cpu=rng.choice([0.3,0.5,1.0,1.9,3.5]),
+                           labels={"app": rng.choice(["a","b","c"])}))
+    elif r < 0.50:
+        live = [p for p in kube.pods() if not p.is_terminal() and p.metadata.deletion_timestamp is None]
+        if live: kube.delete(rng.choice(live))
+    elif r < 0.55:
+        if pdb is None:
+            pdb = PodDisruptionBudget(metadata=ObjectMeta(name="pdb"),
+                spec=PodDisruptionBudgetSpec(selector=LabelSelector.of({"app": "a"}),
+                                             max_unavailable=rng.choice([0,1])))
+            kube.create(pdb)
+        else:
+            kube.delete(pdb); pdb = None
+    elif r < 0.58:
+        pool.spec.template.labels["rev"] = str(tick); kube.touch(pool)
+    elif r < 0.62:
+        cloud.next_create_error = InsufficientCapacityError("flaky zone")
+    op.step(now=now)
+if pdb is not None: kube.delete(pdb)
+converged = None
+drain_start = time.time()
+i = -1
+for i in range(3000):
+    if time.time() - drain_start > drain_budget: break
+    now += 11; op.step(now=now)
+    live = [p for p in kube.pods() if not p.is_terminal() and p.metadata.deletion_timestamp is None]
+    unbound = [p for p in live if not p.spec.node_name]
+    deleting = [c for c in kube.node_claims() if c.metadata.deletion_timestamp is not None]
+    tainted = [n for n in kube.nodes()
+               if any(t.key == "karpenter.sh/disrupted" for t in n.spec.taints)
+               and n.metadata.deletion_timestamp is None]
+    if not unbound and not deleting and not tainted and not op.disruption.queue.active:
+        converged = i; break
+ok = converged is not None and len(kube.node_claims()) == len(cloud.list())
+print(f"seed={seed} ticks={tick} drain_ticks={i} converged_at={converged} claims={len(kube.node_claims())} instances={len(cloud.list())} {'OK' if ok else 'FAIL'}")
+if not ok:
+    live = [p for p in kube.pods() if not p.is_terminal() and p.metadata.deletion_timestamp is None]
+    print("unbound:", [p.metadata.name for p in live if not p.spec.node_name][:5])
+    print("deleting:", [c.metadata.name for c in kube.node_claims() if c.metadata.deletion_timestamp is not None][:5])
+    print("queue:", [(c.reason, round(now-c.started_at)) for c in op.disruption.queue.active])
+sys.exit(0 if ok else 1)
