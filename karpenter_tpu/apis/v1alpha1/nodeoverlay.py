@@ -91,6 +91,15 @@ class OverlayStore:
             Requirements.from_node_selector_requirements(o.spec.requirements)
             for o in self.overlays
         ]
+        # applied-result memo keyed by input object identity (the
+        # stored input ref keeps the id valid). A store is an immutable
+        # snapshot — rebuilt, never mutated, when overlays change — so
+        # the memo's lifetime is exactly the window the applied result
+        # stays correct. This keeps output OBJECT IDENTITY stable
+        # across calls, which the solver's encoder cache fingerprints
+        # on: without it, every overlay-touched tick rebuilds the
+        # whole catalog's InstanceTypes and busts the cache.
+        self._applied: dict[int, tuple[InstanceType, InstanceType]] = {}
 
     def _matching(self, it: InstanceType, offering: Offering) -> list[NodeOverlay]:
         out = []
@@ -102,6 +111,14 @@ class OverlayStore:
         return out
 
     def apply(self, it: InstanceType) -> InstanceType:
+        hit = self._applied.get(id(it))
+        if hit is not None and hit[0] is it:
+            return hit[1]
+        out = self._apply(it)
+        self._applied[id(it)] = (it, out)
+        return out
+
+    def _apply(self, it: InstanceType) -> InstanceType:
         new_offerings = Offerings()
         price_touched = False
         capacity_extra: ResourceList = {}

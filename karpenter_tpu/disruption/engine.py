@@ -420,6 +420,10 @@ class DisruptionEngine:
             kube=self.kube,
             clock=self.clock,
             objective=objective,
+            # share the provisioner's encoder cache: simulation rounds
+            # re-encode the same pod shapes against the same catalog,
+            # so only genuinely new signatures pay compat evaluation
+            compat_cache=self.provisioner.encode_cache,
         )
         results = scheduler.solve(pods + pending)
         scheduled_keys = {

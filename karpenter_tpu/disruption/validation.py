@@ -85,23 +85,28 @@ class Validator:
         # Execution-time revalidation applies the GRACEFUL pod-block
         # rules, and the reference runs it for CONSOLIDATION commands
         # only (queue.go validation; validation.go:224-225 hardcodes
-        # GracefulDisruptionClass). A drift command whose candidates
-        # carry a TerminationGracePeriod was admitted as EVENTUAL —
+        # GracefulDisruptionClass). A drift candidate whose claim
+        # carries a TerminationGracePeriod was admitted as EVENTUAL —
         # re-judging it gracefully would invalidate it the moment a
         # do-not-disrupt pod exists, which is exactly the case TGP is
-        # for. Skip the pod-block re-checks for those.
-        eventual = command.reason == REASON_DRIFTED and all(
-            c.state_node.node_claim is not None
-            and c.state_node.node_claim.spec.termination_grace_period
-            is not None
-            for c in command.candidates
-        )
+        # for. The gate is PER CANDIDATE (the reference's
+        # eventualDisruptionCandidate is evaluated per NodeClaim,
+        # types.go): a command mixing TGP and non-TGP candidates keeps
+        # graceful re-checks on the non-TGP ones only.
+        def _eventual(candidate) -> bool:
+            claim = candidate.state_node.node_claim
+            return (
+                command.reason == REASON_DRIFTED
+                and claim is not None
+                and claim.spec.termination_grace_period is not None
+            )
         # live (current) reschedulable pods per candidate, rebuilt from
         # state the way the reference's validateCandidates re-runs
         # GetCandidates: pods that bound after compute time are counted,
         # since-terminated pods are not
         live_pods: dict[str, list["Pod"]] = {}
         for candidate in command.candidates:
+            eventual = _eventual(candidate)
             node = candidate.state_node
             claim = node.node_claim
             if claim is None or kube.get_node_claim(claim.metadata.name) is None:

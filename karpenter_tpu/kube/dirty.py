@@ -50,3 +50,10 @@ class DirtyTracker:
 
     def peek(self, kind: str) -> set[str]:
         return set(self._sets.get(kind, set()))
+
+    def clear(self) -> None:
+        """Drop all pending dirt without reporting it (used after a
+        consumer rebuilt its state from scratch — a relist or a full
+        cache bust — so stale keys don't force a second rebuild)."""
+        for kind in self._sets:
+            self._sets[kind] = set()

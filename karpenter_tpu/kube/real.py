@@ -914,6 +914,11 @@ class RealKubeClient:
             with self._lock:
                 self._mirror["Pod"].pop(pod.key, None)
                 self._index_pod(pod, removed=True)
+            # in-process watch subscribers (dirty trackers, cluster
+            # state) must see the deletion like the post-eviction gone
+            # path below — without the announce they'd only learn of
+            # it from a later stream event or relist
+            self._announce("Pod", DELETED, pod)
             return None
         if status == 429:
             causes = (body.get("details") or {}).get("causes") or [{}]

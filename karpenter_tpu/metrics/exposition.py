@@ -65,15 +65,15 @@ def render(registry: Registry = REGISTRY) -> str:
                 cumulative = 0
                 for bound, count in zip(metric.buckets, counts):
                     cumulative += count
+                    le = 'le="' + _fmt_value(bound) + '"'
                     lines.append(
-                        f"{name}_bucket"
-                        f"{_fmt_labels(pairs, f'le=\"{_fmt_value(bound)}\"')}"
-                        f" {cumulative}"
+                        f"{name}_bucket{_fmt_labels(pairs, le)} {cumulative}"
                     )
                 # +Inf bucket carries observations above the largest
                 # bound too (observe() tallies them only in the total)
+                le_inf = 'le="+Inf"'
                 lines.append(
-                    f"{name}_bucket{_fmt_labels(pairs, 'le=\"+Inf\"')} {total}"
+                    f"{name}_bucket{_fmt_labels(pairs, le_inf)} {total}"
                 )
                 lines.append(
                     f"{name}_sum{_fmt_labels(pairs)} {_fmt_value(total_sum)}"

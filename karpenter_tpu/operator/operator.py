@@ -144,6 +144,22 @@ class Operator:
         self._last_metrics = 0.0
         self._last_resync = 0.0
         self._last_pending_scan = 0.0
+        self._gc_frozen = False
+        # AOT compile warm pool: background-compile the packing
+        # kernels' shape buckets (and enable the persistent compile
+        # cache) so the first tick's solve never waits on XLA — gated
+        # (tests and embedders must not grow compile threads as a side
+        # effect); KARPENTER_WARM_POOL=1 force-enables for deploys that
+        # can't thread Options through
+        self._warm_pool_thread = None
+        import os as _os
+
+        if self.options.solver_warm_pool or _os.environ.get(
+            "KARPENTER_WARM_POOL"
+        ) == "1":
+            from karpenter_tpu.solver import warm_pool
+
+            self._warm_pool_thread = warm_pool.start_background()
         # plans whose pods await binding (the kube-scheduler's job in a
         # real cluster; this runtime owns the whole substrate, so it
         # binds pods to the nodes the solver placed them on)
@@ -180,6 +196,18 @@ class Operator:
         full = now - self._last_resync >= self.options.full_resync_seconds
         if full:
             self._last_resync = now
+            if self._gc_frozen:
+                # Resync-boundary GC hygiene: freeze() after the first
+                # tick permanently exempts everything alive then from
+                # cycle collection, so first-tick scratch objects that
+                # were since replaced (relist swaps, first-solve
+                # structures) would leak forever if they sit in cycles.
+                # Unfreeze -> collect -> re-freeze here reclaims them
+                # at resync cadence while keeping the steady-state
+                # ticks free of full gen-2 scans (ADVICE r5).
+                gc.unfreeze()
+                gc.collect()
+                gc.freeze()
             self.hydration.reconcile_all()
             self.nodepool_status.reconcile_all(now=now)
         else:
@@ -324,7 +352,18 @@ class Operator:
                         unbound = True
                         continue
                     if live.spec.node_name:
-                        continue  # already home
+                        if not node_name and not claim_gone:
+                            # still bound to the node being drained
+                            # while the replacement claim has no
+                            # status.node_name yet (created this tick,
+                            # registers in a later lifecycle phase):
+                            # HOLD the plan like the
+                            # existing-assignments branch below —
+                            # treating this as "already home" silently
+                            # dropped pure-replace command plans before
+                            # their claims ever registered (ADVICE r5)
+                            unbound = True
+                        continue  # already home (or nothing to wait on)
                     if node_name and not claim_gone:
                         self.kube.bind_pod(live, node_name)
                     elif claim_gone:
@@ -458,9 +497,13 @@ class Operator:
                     # gen-2 scans stop re-walking ~1M mirror objects
                     # on every threshold crossing (the Go reference's
                     # GC is concurrent, so it never pays this).
-                    # Per-reconcile garbage is still collected.
+                    # Per-reconcile garbage is still collected, and
+                    # full-resync ticks unfreeze+collect+refreeze so
+                    # replaced first-tick objects in cycles are
+                    # reclaimed at resync cadence (see step()).
                     gc.collect()
                     gc.freeze()
+                    self._gc_frozen = True
                 time.sleep(tick_seconds)
         finally:
             if serve:

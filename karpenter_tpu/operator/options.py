@@ -68,6 +68,12 @@ class Options:
     # scheduling error while set (options.go:130 ignore-dra-requests;
     # default true upstream until formal DRA support lands)
     ignore_dra_requests: bool = True
+    # AOT compile warm pool at operator startup: background-compile
+    # the packing kernels' shape buckets and enable the persistent
+    # compile cache (solver/warm_pool.py). Off by default so tests and
+    # embedders don't grow compile threads; KARPENTER_WARM_POOL=1 in
+    # the environment force-enables it too.
+    solver_warm_pool: bool = False
 
 
 DEFAULT_OPTIONS = Options()

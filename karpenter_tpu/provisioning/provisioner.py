@@ -122,6 +122,18 @@ class Provisioner:
         self.clock = clock if clock is not None else time.monotonic
         self.recorder = recorder
         self.batcher = Batcher()
+        # Encoder compat-row/config cache shared across rounds
+        # (solver/incremental.EncodedCache): steady-state rounds with
+        # repeating pod shapes skip the G x C requirement rebuild. It
+        # self-invalidates on catalog fingerprint changes; the
+        # NodePool dirty tracker busts it eagerly too (belt and
+        # braces for in-place template mutations the fingerprint
+        # would only catch through pool.hash()).
+        from karpenter_tpu.kube.dirty import DirtyTracker
+        from karpenter_tpu.solver.incremental import EncodedCache
+
+        self.encode_cache = EncodedCache()
+        self._catalog_dirty = DirtyTracker(kube).watch("NodePool")
 
     # -- pod intake (provisioner.go:172-195, utils/node) ----------------------
 
@@ -198,6 +210,8 @@ class Provisioner:
         pods = list(extra_pods) or (
             self.get_pending_pods() + self.reschedulable_pods_from_deleting_nodes()
         )
+        if self._catalog_dirty.drain("NodePool"):
+            self.encode_cache.invalidate()
         pools = self.ready_pools_with_types()
         scheduler = Scheduler(
             pools_with_types=pools,
@@ -218,6 +232,7 @@ class Provisioner:
                 if self.options is not None else True
             ),
             clock=self.clock,
+            compat_cache=self.encode_cache,
         )
         results = scheduler.solve(pods)
         self.cluster.mark_pod_scheduling_decisions(pods)

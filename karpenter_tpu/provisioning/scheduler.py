@@ -147,11 +147,16 @@ class Scheduler:
         ignore_dra_requests: bool = True,
         metrics_controller: str = "provisioner",
         objective: str = "ffd",
+        compat_cache=None,
     ):
         # "cost" engages the LP planner on the batched fast path (the
         # global-repack consolidation re-solve); topology/per-pod paths
         # always pack FFD — their constraints aren't in the LP
         self.objective = objective
+        # incremental.EncodedCache shared across rounds by the owning
+        # provisioner: steady-state rounds re-encode only the group
+        # signatures that actually changed (dirty rows)
+        self.compat_cache = compat_cache
         self.min_values_policy = min_values_policy
         self.ignore_dra_requests = ignore_dra_requests
         self.metrics_controller = metrics_controller
@@ -730,6 +735,7 @@ class Scheduler:
                     group_cap=tb.group_cap,
                     conflict=tb.conflict,
                     existing_quota=tb.existing_quota,
+                    compat_cache=self.compat_cache,
                 )
                 solution = solve_encoded(enc)
                 n_before = len(open_plans)
@@ -880,6 +886,7 @@ class Scheduler:
                 reserved_in_use if reserved_in_use is not None
                 else self.reserved_in_use
             ),
+            compat_cache=self.compat_cache,
         )
         return solve_encoded(enc, objective=self.objective)
 

@@ -136,10 +136,10 @@ class ConfigInfo:
     existing_index: int = -1          # >=0 for pseudo-configs
     requirements: Requirements = field(default_factory=Requirements)
     taints: tuple[Taint, ...] = ()
-    # After column dedupe, every member (price, ConfigInfo) this column
-    # represents — identical (pool, allocatable, compat column) configs
-    # collapse to one device column and re-expand at decode.
-    alts: list = field(default_factory=list)
+    # NOTE: per-encode dedupe membership lives on Encoded.cfg_alts, NOT
+    # here — ConfigInfo objects are shared across encodes by the
+    # incremental cache, and a solution's lazy option lists must keep
+    # reading the members of the encode that produced them.
 
 
 @dataclass
@@ -190,6 +190,13 @@ class Encoded:
     pool_min_values: np.ndarray = None    # [P+1] bool pools with minValues
                                           # floors (host decode metadata;
                                           # not shipped to the service)
+    # After column dedupe, every member (price, ConfigInfo) each column
+    # represents — identical (pool, allocatable, compat column) configs
+    # collapse to one device column and re-expand at decode. Aligned
+    # with `configs`; empty for pseudo-configs. Host decode metadata
+    # (not shipped to the service) and PER-ENCODE: the lists belong to
+    # this Encoded, so a shared-config cache can never clobber them.
+    cfg_alts: list = None                 # [C] list[(price, ConfigInfo)]
 
 
 def pool_template_requirements(
@@ -228,6 +235,17 @@ def build_configs(
 ) -> list[ConfigInfo]:
     """Enumerate launchable configs (pool-weight order, then price) and
     append pseudo-configs for existing nodes."""
+    return launch_configs(pools_with_types) + pseudo_configs(existing)
+
+
+def launch_configs(
+    pools_with_types: Sequence[tuple[NodePool, Sequence[InstanceType]]],
+) -> list[ConfigInfo]:
+    """The launchable-config columns alone — a pure function of the
+    catalog, so the incremental encoder cache can reuse the list across
+    solves. Shared ConfigInfos are treated as immutable by encode:
+    per-encode dedupe membership lives on Encoded.cfg_alts, never
+    here."""
     configs: list[ConfigInfo] = []
     for pool, types in pools_with_types:
         # only the template's permanent taints gate pod placement:
@@ -261,6 +279,14 @@ def build_configs(
                         taints=taints,
                     )
                 )
+    return configs
+
+
+def pseudo_configs(
+    existing: Sequence[ExistingNodeInput] = (),
+) -> list[ConfigInfo]:
+    """One-hot pseudo-config columns for existing/in-flight nodes."""
+    configs: list[ConfigInfo] = []
     for idx, node in enumerate(existing):
         configs.append(
             ConfigInfo(
@@ -284,6 +310,7 @@ def encode(
     group_cap: Optional[np.ndarray] = None,
     conflict: Optional[np.ndarray] = None,
     existing_quota: Optional[np.ndarray] = None,
+    compat_cache=None,
 ) -> Encoded:
     """Build the dense problem. `daemon_overhead` maps pool name ->
     resource list of daemonset pods that will land on new nodes
@@ -291,8 +318,21 @@ def encode(
     reservation id -> instances already consumed by live nodes; the
     remainder caps how many nodes the solver may open against that
     reservation (ReservationManager semantics,
-    scheduling/reservationmanager.go:28-110)."""
-    configs = build_configs(pools_with_types, existing)
+    scheduling/reservationmanager.go:28-110).
+
+    `compat_cache` (solver/incremental.EncodedCache) memoizes the
+    launchable-column compat rows across solves keyed on group
+    signature: a steady-state tick whose pod shapes mostly repeat pays
+    the G x C requirement matmul only for NEW signatures (dirty rows);
+    pseudo-config columns for existing nodes are always computed fresh
+    (their labels/usage change tick to tick)."""
+    import time as _time
+
+    _t_encode = _time.perf_counter()
+    if compat_cache is not None:
+        configs = compat_cache.configs(pools_with_types, existing)
+    else:
+        configs = build_configs(pools_with_types, existing)
     n_launch = len(configs) - len(existing)
 
     # Resource axis: union of base + whatever appears anywhere.
@@ -322,40 +362,58 @@ def encode(
     rsv_cap_list: list[float] = []
     in_use = reserved_in_use or {}
     pool_order = {pool.metadata.name: i for i, (pool, _) in enumerate(pools_with_types)}
-    for ci, cfg in enumerate(configs):
-        if cfg.existing_index >= 0:
-            node = existing[cfg.existing_index]
+
+    def _reserve(ci: int, rid: str) -> None:
+        remaining = float(
+            max(0, configs[ci].offering.reservation_capacity - in_use.get(rid, 0))
+        )
+        slot = rsv_slots.get(rid)
+        if slot is None:
+            slot = len(rsv_cap_list)
+            rsv_slots[rid] = slot
+            rsv_cap_list.append(remaining)
+        else:
+            rsv_cap_list[slot] = max(rsv_cap_list[slot], remaining)
+        cfg_rsv[ci] = slot
+
+    if compat_cache is not None:
+        # launchable arrays are catalog-static per resource axis;
+        # only existing-node rows and reservation budgets (round
+        # usage) are per-call
+        la, lpr, lpo, lrids, lstatics = compat_cache.launch_arrays(
+            keys, configs, n_launch, pool_order
+        )
+        cfg_alloc[:n_launch] = la
+        cfg_price[:n_launch] = lpr
+        cfg_pool[:n_launch] = lpo
+        for ci in range(n_launch, C):
+            node = existing[configs[ci].existing_index]
             for ri, key in enumerate(keys):
                 cfg_alloc[ci, ri] = node.available.get(key, 0.0)
-            cfg_price[ci] = 0.0
-        else:
-            for ri, key in enumerate(keys):
-                cfg_alloc[ci, ri] = cfg.instance_type.allocatable.get(key, 0.0)
-            cfg_price[ci] = cfg.offering.price
-            cfg_pool[ci] = pool_order[cfg.pool.metadata.name]
-            rid = cfg.offering.reservation_id
-            if rid:
-                remaining = float(
-                    max(0, cfg.offering.reservation_capacity - in_use.get(rid, 0))
-                )
-                slot = rsv_slots.get(rid)
-                if slot is None:
-                    slot = len(rsv_cap_list)
-                    rsv_slots[rid] = slot
-                    rsv_cap_list.append(remaining)
-                else:
-                    rsv_cap_list[slot] = max(rsv_cap_list[slot], remaining)
-                cfg_rsv[ci] = slot
+        for ci, rid in lrids:
+            _reserve(ci, rid)
+    else:
+        for ci, cfg in enumerate(configs):
+            if cfg.existing_index >= 0:
+                node = existing[cfg.existing_index]
+                for ri, key in enumerate(keys):
+                    cfg_alloc[ci, ri] = node.available.get(key, 0.0)
+                cfg_price[ci] = 0.0
+            else:
+                for ri, key in enumerate(keys):
+                    cfg_alloc[ci, ri] = cfg.instance_type.allocatable.get(key, 0.0)
+                cfg_price[ci] = cfg.offering.price
+                cfg_pool[ci] = pool_order[cfg.pool.metadata.name]
+                rid = cfg.offering.reservation_id
+                if rid:
+                    _reserve(ci, rid)
 
-    compat = _compat_matrix(groups, configs)
-
-    # Taints: group must tolerate the config's taints.
-    for ci, cfg in enumerate(configs):
-        if not cfg.taints:
-            continue
-        for gi, group in enumerate(groups):
-            if tolerates(cfg.taints, list(group.tolerations)) is not None:
-                compat[gi, ci] = False
+    if compat_cache is not None:
+        # catalog already synced by the configs() call above — compat
+        # consults the row cache without re-fingerprinting
+        compat = compat_cache.compat(groups, configs, n_launch)
+    else:
+        compat = _full_compat(groups, configs)
 
     # Mutual exclusion: two groups can each be compatible with a
     # config yet unable to SHARE one node — their requirements pin a
@@ -376,9 +434,20 @@ def encode(
         # capacity-type) leaves that key open even though every launch
         # config pins it, and two groups pinning different values must
         # not share that node
-        pin_ok: dict[str, bool] = {}
-        n_have: dict[str, int] = {}
-        for cfg in configs:
+        if compat_cache is not None:
+            # launchable stats are catalog-static; fold in the
+            # per-call existing configs only
+            cached_ok, cached_have = compat_cache.pin_stats(
+                configs, n_launch
+            )
+            pin_ok = dict(cached_ok)
+            n_have = dict(cached_have)
+            scan = configs[n_launch:]
+        else:
+            pin_ok = {}
+            n_have = {}
+            scan = configs
+        for cfg in scan:
             for req in cfg.requirements:
                 single = req.operator() == _IN and len(req.values) == 1
                 n_have[req.key] = n_have.get(req.key, 0) + 1
@@ -435,27 +504,47 @@ def encode(
     # types) and cuts device time proportionally.
     keep: list[int] = []
     by_key: dict[tuple, int] = {}
-    for ci, cfg in enumerate(configs):
-        if cfg.existing_index >= 0:
-            keep.append(ci)
-            continue
-        key = (
-            int(cfg_pool[ci]),
-            # distinct reservations must not merge (their budgets would
-            # collapse to one cap instead of the sum)
-            cfg.offering.reservation_id if cfg.offering is not None else "",
-            cfg_alloc[ci].tobytes(),
-            compat[:, ci].tobytes(),
-        )
+    alts_by_ci: dict[int, list] = {}
+
+    def _dedupe_one(ci: int, key: tuple) -> None:
+        cfg = configs[ci]
         rep = by_key.get(key)
         if rep is None:
             by_key[key] = ci
-            cfg.alts = [(float(cfg_price[ci]), cfg)]
+            alts_by_ci[ci] = [(float(cfg_price[ci]), cfg)]
             keep.append(ci)
         else:
-            configs[rep].alts.append((float(cfg_price[ci]), cfg))
+            alts_by_ci[rep].append((float(cfg_price[ci]), cfg))
             if cfg_price[ci] < cfg_price[rep]:
                 cfg_price[rep] = cfg_price[ci]
+
+    if compat_cache is not None:
+        # cached path: (pool, reservation, alloc-bytes) prefixes come
+        # from the catalog-static table; the per-solve compat columns
+        # are bit-packed in ONE vectorized pass instead of C sliced
+        # copies (packbits is injective at fixed G, so key equality is
+        # exactly column equality)
+        col_bytes = np.ascontiguousarray(
+            np.packbits(compat[:, :n_launch], axis=0).T
+        )
+        for ci in range(n_launch):
+            _dedupe_one(ci, lstatics[ci] + (col_bytes[ci].tobytes(),))
+        keep.extend(range(n_launch, C))
+    else:
+        for ci, cfg in enumerate(configs):
+            if cfg.existing_index >= 0:
+                keep.append(ci)
+                continue
+            key = (
+                int(cfg_pool[ci]),
+                # distinct reservations must not merge (their budgets
+                # would collapse to one cap instead of the sum)
+                cfg.offering.reservation_id if cfg.offering is not None else "",
+                cfg_alloc[ci].tobytes(),
+                compat[:, ci].tobytes(),
+            )
+            _dedupe_one(ci, key)
+    cfg_alts = [alts_by_ci.get(i, []) for i in keep]
     if len(keep) < len(configs):
         configs = [configs[i] for i in keep]
         compat = np.ascontiguousarray(compat[:, keep])
@@ -464,6 +553,11 @@ def encode(
         cfg_pool = np.ascontiguousarray(cfg_pool[keep])
         cfg_rsv = np.ascontiguousarray(cfg_rsv[keep])
 
+    from karpenter_tpu.metrics.store import SOLVER_PHASE_DURATION
+
+    SOLVER_PHASE_DURATION.observe(
+        _time.perf_counter() - _t_encode, {"phase": "encode"}
+    )
     return Encoded(
         resource_keys=keys,
         groups=list(groups),
@@ -484,7 +578,25 @@ def encode(
         existing_quota=existing_quota,
         loose_groups=loose_groups,
         pool_min_values=pool_min_values,
+        cfg_alts=cfg_alts,
     )
+
+
+def _full_compat(
+    groups: Sequence[PodGroup], configs: Sequence[ConfigInfo]
+) -> np.ndarray:
+    """[G, C] compat = requirement compatibility AND taint tolerance.
+    The single compat assembly both the uncached encode and the
+    incremental cache's miss path go through, so a cached row can never
+    drift from what a fresh encode would compute."""
+    compat = _compat_matrix(groups, configs)
+    for ci, cfg in enumerate(configs):
+        if not cfg.taints:
+            continue
+        for gi, group in enumerate(groups):
+            if tolerates(cfg.taints, list(group.tolerations)) is not None:
+                compat[gi, ci] = False
+    return compat
 
 
 def _compat_matrix(groups: Sequence[PodGroup], configs: Sequence[ConfigInfo]) -> np.ndarray:

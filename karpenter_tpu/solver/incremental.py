@@ -1,0 +1,738 @@
+"""Incremental warm-start solve pipeline: dirty-set re-encoding and
+residual repack.
+
+BENCH_r05 showed the steady-state operator paying for a FULL encode +
+pack of the entire fleet every tick even when only a handful of pods
+changed. CvxCluster (PAPERS.md) gets its orders-of-magnitude wins by
+re-solving only the perturbed subproblem against a cached
+decomposition, and "Priority Matters" shows constraint-based packing
+amortizes when the encoding persists across rounds. The same structure
+applies to the tick loop here, in two layers:
+
+1. **EncodedCache** (dirty-set re-encoding): the launchable half of
+   the encoded problem — the ConfigInfo columns and the [G, C] compat
+   rows — is a pure function of (catalog, group signature). Cache it
+   across solves; a tick whose pod shapes mostly repeat recomputes
+   compat only for NEW signatures (k dirty rows instead of the full
+   G x C rebuild), and config construction is skipped entirely while
+   the catalog fingerprint holds. Pseudo-config columns for existing
+   nodes are always computed fresh (their labels/usage change tick to
+   tick, and they are O(dirty-groups x nodes) anyway).
+
+2. **IncrementalPipeline** (warm-start residual repack): the previous
+   solution IS the warm start. Each tick diffs the pod set against the
+   retained assignment, frees capacity for deleted pods, and routes
+   only displaced/new pods through the split packing kernel against
+   the residual node capacities (`pack_split`'s bound rows — existing
+   nodes first, the reference's scan order). The kernel's fori_loop
+   trip count drops from G (all groups) to G_dirty, and the dense
+   fresh axis shrinks to the spill. Correctness backstops: a full
+   re-solve when churn exceeds KARPENTER_INCR_CHURN_MAX, and a
+   periodic full re-solve every KARPENTER_INCR_FULL_EVERY ticks that
+   the incremental fleet must match within KARPENTER_INCR_DRIFT_EPS
+   on price or be replaced by.
+
+The pipeline is intentionally scoped to the batched fast path
+(selector/resource demand, no topology constraints — the same pods the
+scheduler's fast path batches); constrained pods keep going through
+the full Scheduler machinery. Encode calls sharing one cache must be
+serialized (the operator tick loop, the bench loop, and the pipeline
+all are); the cache's own tables are lock-guarded.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+import numpy as np
+
+from karpenter_tpu.apis.v1.labels import HOSTNAME_LABEL
+from karpenter_tpu.apis.v1.nodepool import NodePool
+from karpenter_tpu.cloudprovider.types import InstanceType, Offering
+from karpenter_tpu.kube.objects import Pod
+from karpenter_tpu.metrics.store import (
+    SOLVER_ENCODE_CACHE,
+    SOLVER_INCREMENTAL_TICKS,
+)
+from karpenter_tpu.scheduling.requirement import IN, Requirement
+from karpenter_tpu.scheduling.requirements import Requirements
+from karpenter_tpu.solver.encode import (
+    ConfigInfo,
+    ExistingNodeInput,
+    PodGroup,
+    _config_requirements,
+    _full_compat,
+    launch_configs,
+    pseudo_configs,
+)
+from karpenter_tpu.utils import resources as resutil
+
+
+def catalog_fingerprint(pools_with_types) -> tuple:
+    """Cheap identity of the launchable catalog: everything
+    build_configs reads that can change which config columns exist or
+    what they require. Instance types are fingerprinted by object
+    identity + name (providers rebuild the objects when a type
+    changes; the cache pins the referenced catalog so ids cannot be
+    recycled while cached); pools by their spec hash, which covers
+    template requirements, labels and taints."""
+    # zone/capacity-type/reservation-id are construction-time constants
+    # of an Offering (and reading them walks requirement lookups), so
+    # object identity covers them; price/availability ARE flipped in
+    # place by providers (ICE marking, overlays) and read as plain
+    # attributes into FLAT tuples (this runs twice per steady tick —
+    # nested per-offering tuples measurably showed up in profiles).
+    return tuple(
+        (
+            pool.metadata.name,
+            pool.hash(),
+            id(pool),
+            tuple(id(it) for it in types),
+            tuple(
+                x for it in types for o in it.offerings
+                for x in (o.price, o.available, o.reservation_capacity)
+            ),
+        )
+        for pool, types in pools_with_types
+    )
+
+
+class EncodedCache:
+    """Compat-row + config-column cache for encode() (dirty-set
+    re-encoding). Rows are keyed by (group requirements signature,
+    tolerations) under one catalog fingerprint; a catalog change busts
+    everything. Bounded LRU-ish (insertion-order eviction)."""
+
+    def __init__(self, max_rows: int = 4096):
+        self.max_rows = max_rows
+        self._lock = threading.Lock()
+        self._fp: Optional[tuple] = None
+        self._pin = None                  # strong ref: keeps catalog ids valid
+        self._launch: Optional[list[ConfigInfo]] = None
+        self._rows: dict[tuple, np.ndarray] = {}
+        # launchable cfg_alloc/price/pool arrays + reservation ids,
+        # keyed by the resource-axis tuple (extended resources extend
+        # the axis per demand mix)
+        self._arrays: dict[tuple, tuple] = {}
+        self._pin_stats: Optional[tuple[dict, dict]] = None
+
+    # -- invalidation ---------------------------------------------------------
+
+    def invalidate(self) -> None:
+        """Explicit bust (relist / resync boundary / NodePool event)."""
+        with self._lock:
+            if self._rows or self._launch is not None:
+                SOLVER_ENCODE_CACHE.inc({"outcome": "bust"})
+            self._fp = None
+            self._pin = None
+            self._launch = None
+            self._rows.clear()
+            self._arrays.clear()
+            self._pin_stats = None
+
+    def _sync_catalog(self, pools_with_types) -> None:
+        """Under lock: bust on catalog fingerprint change."""
+        fp = catalog_fingerprint(pools_with_types)
+        if fp != self._fp:
+            if self._fp is not None:
+                SOLVER_ENCODE_CACHE.inc({"outcome": "bust"})
+            self._fp = fp
+            self._pin = [(pool, tuple(types)) for pool, types in pools_with_types]
+            self._launch = None
+            self._rows.clear()
+            self._arrays.clear()
+            self._pin_stats = None
+
+    # -- encode() hooks -------------------------------------------------------
+
+    def configs(self, pools_with_types, existing=()) -> list[ConfigInfo]:
+        """build_configs with the launchable prefix cached per catalog.
+        The returned list is fresh; the launchable ConfigInfo objects
+        are shared across calls and treated as IMMUTABLE by encode
+        (per-encode dedupe membership lives on Encoded.cfg_alts)."""
+        with self._lock:
+            self._sync_catalog(pools_with_types)
+            if self._launch is None:
+                self._launch = launch_configs(pools_with_types)
+            launch = self._launch
+        return list(launch) + pseudo_configs(existing)
+
+    def launch_arrays(
+        self,
+        resource_keys: Sequence[str],
+        configs: Sequence[ConfigInfo],
+        n_launch: int,
+        pool_order: dict[str, int],
+    ):
+        """(cfg_alloc, cfg_price, cfg_pool, [(ci, reservation_id)])
+        for the launchable prefix — pure functions of the catalog and
+        the resource axis, cached per axis under the current catalog
+        fingerprint (encode copies the arrays into its padded output,
+        so the cached originals are never mutated). Reservation
+        BUDGETS are not cached: remaining capacity depends on
+        per-round usage and is recomputed by encode from the returned
+        (ci, rid) list."""
+        keys = tuple(resource_keys)
+        with self._lock:
+            hit = self._arrays.get(keys)
+            if hit is not None:
+                return hit
+        R = len(keys)
+        alloc = np.zeros((n_launch, R), np.float32)
+        price = np.zeros((n_launch,), np.float32)
+        pool = np.full((n_launch,), -1, np.int32)
+        rids: list[tuple[int, str]] = []
+        statics: list[tuple] = []
+        for ci in range(n_launch):
+            cfg = configs[ci]
+            allocatable = cfg.instance_type.allocatable
+            for ri, key in enumerate(keys):
+                alloc[ci, ri] = allocatable.get(key, 0.0)
+            price[ci] = cfg.offering.price
+            pool[ci] = pool_order[cfg.pool.metadata.name]
+            rid = cfg.offering.reservation_id
+            if rid:
+                rids.append((ci, rid))
+            # the catalog-static 3/4 of encode's dedupe key (the
+            # fourth, the compat column, is per-solve)
+            statics.append((int(pool[ci]), rid or "", alloc[ci].tobytes()))
+        out = (alloc, price, pool, rids, statics)
+        with self._lock:
+            if len(self._arrays) > 8:  # distinct resource axes are few
+                self._arrays.clear()
+            self._arrays[keys] = out
+        return out
+
+    def pin_stats(self, configs: Sequence[ConfigInfo], n_launch: int):
+        """(pin_ok, n_have) over the LAUNCHABLE configs for encode's
+        always-pinned-key analysis — catalog-static; encode merges the
+        per-call existing configs into copies."""
+        with self._lock:
+            if self._pin_stats is not None:
+                return self._pin_stats
+        pin_ok: dict[str, bool] = {}
+        n_have: dict[str, int] = {}
+        for ci in range(n_launch):
+            for req in configs[ci].requirements:
+                single = req.operator() == IN and len(req.values) == 1
+                n_have[req.key] = n_have.get(req.key, 0) + 1
+                pin_ok[req.key] = pin_ok.get(req.key, True) and single
+        with self._lock:
+            self._pin_stats = (pin_ok, n_have)
+        return self._pin_stats
+
+    def compat(
+        self,
+        groups: Sequence[PodGroup],
+        configs: Sequence[ConfigInfo],
+        n_launch: int,
+        pools_with_types=None,
+    ) -> np.ndarray:
+        """[G, C] compat with the launchable columns served from cache
+        per group signature; only signatures not seen under the current
+        catalog (the dirty rows) pay the requirement/taint evaluation.
+        Per-pair compat is independent of which other configs share the
+        call, so splitting launchable/pseudo columns is exact."""
+        G, C = len(groups), len(configs)
+        if pools_with_types is not None:
+            with self._lock:
+                self._sync_catalog(pools_with_types)
+        rows = np.empty((G, n_launch), dtype=bool)
+        missing: list[tuple[int, tuple]] = []
+        with self._lock:
+            for gi, group in enumerate(groups):
+                key = (group.requirements.signature(), group.tolerations)
+                hit = self._rows.get(key)
+                if hit is None or hit.shape[0] != n_launch:
+                    missing.append((gi, key))
+                else:
+                    rows[gi] = hit
+        hits = G - len(missing)
+        if hits:
+            SOLVER_ENCODE_CACHE.inc({"outcome": "hit"}, value=float(hits))
+        if missing:
+            SOLVER_ENCODE_CACHE.inc(
+                {"outcome": "miss"}, value=float(len(missing))
+            )
+            fresh = _full_compat(
+                [groups[gi] for gi, _ in missing], configs[:n_launch]
+            )
+            with self._lock:
+                for row_i, (gi, key) in enumerate(missing):
+                    rows[gi] = fresh[row_i]
+                    self._rows[key] = fresh[row_i].copy()
+                while len(self._rows) > self.max_rows:
+                    self._rows.pop(next(iter(self._rows)))
+        if n_launch < C:
+            pseudo = _full_compat(groups, configs[n_launch:])
+            return np.ascontiguousarray(
+                np.concatenate([rows, pseudo], axis=1)
+            )
+        return rows
+
+
+# -- residual repack ----------------------------------------------------------
+
+
+@dataclass
+class ResidualNode:
+    """One node retained from the previous tick's solution, with its
+    live load — the warm start the next tick packs against."""
+
+    name: str
+    pool: NodePool
+    instance_type: InstanceType
+    offering: Offering
+    price: float
+    requirements: Requirements
+    taints: tuple
+    used: dict[str, float]
+    pods: dict[str, Pod] = field(default_factory=dict)
+
+    def available(self) -> dict[str, float]:
+        return resutil.positive(
+            resutil.subtract(self.instance_type.allocatable, self.used)
+        )
+
+
+@dataclass
+class TickResult:
+    mode: str                  # "incremental" | "full"
+    reason: str                # "steady" | "cold" | "churn" | "catalog"
+                               # | "drift" | "checked" | "invalidate"
+    scheduled: int
+    unschedulable: int
+    fleet_price: float
+    nodes: int
+    churn: float = 0.0
+    placed: int = 0            # pods routed through the repack solve
+    drift: Optional[float] = None  # backstop ticks: inc/full price - 1
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+class IncrementalPipeline:
+    """Tick-to-tick warm-start solver over one pod population.
+
+    `solve_tick(pods, pools_with_types)` returns a TickResult. The
+    first tick (and any tick after invalidate()/catalog change/churn
+    blow-out) runs the normal full solve and adopts its fleet; steady
+    ticks diff the pod set, free capacity for deletions, and repack
+    only new/changed pods against the residual fleet.
+
+    With a kube client, a DirtyTracker on Pods feeds the changed set so
+    in-place mutations (which keep object identity) are still caught;
+    without one, object identity is the change signal — callers that
+    mutate pods in place must pass fresh objects or call mark_dirty().
+    """
+
+    def __init__(
+        self,
+        kube=None,
+        churn_max: Optional[float] = None,
+        full_every: Optional[int] = None,
+        drift_eps: Optional[float] = None,
+        daemon_overhead: Optional[dict[str, dict[str, float]]] = None,
+        repack_objective: str = "ffd",
+    ):
+        self.cache = EncodedCache()
+        self.churn_max = (
+            churn_max if churn_max is not None
+            else _env_float("KARPENTER_INCR_CHURN_MAX", 0.25)
+        )
+        self.full_every = (
+            full_every if full_every is not None
+            else int(_env_float("KARPENTER_INCR_FULL_EVERY", 16))
+        )
+        self.drift_eps = (
+            drift_eps if drift_eps is not None
+            else _env_float("KARPENTER_INCR_DRIFT_EPS", 0.01)
+        )
+        self.daemon_overhead = daemon_overhead or {}
+        self.repack_objective = repack_objective
+        self._fleet: Optional[list[ResidualNode]] = None
+        self._where: dict[str, ResidualNode] = {}
+        self._pods: dict[str, Pod] = {}
+        self._unplaced: set[str] = set()
+        self._marked: set[str] = set()
+        self._catalog_fp: Optional[tuple] = None
+        self._seq = 0
+        self._tick = 0
+        self._tracker = None
+        if kube is not None:
+            from karpenter_tpu.kube.dirty import DirtyTracker
+
+            self._tracker = DirtyTracker(kube).watch("Pod")
+
+    # -- state management -----------------------------------------------------
+
+    def invalidate(self) -> None:
+        """Full bust: relist/resync boundaries, or any time the caller
+        can no longer vouch for the retained assignment."""
+        self._fleet = None
+        self._where = {}
+        self._pods = {}
+        self._unplaced = set()
+        self._marked = set()
+        self._catalog_fp = None
+        self.cache.invalidate()
+        if self._tracker is not None:
+            # the next tick rebuilds from scratch anyway; stale dirty
+            # keys must not force a second rebuild after it
+            self._tracker.clear()
+
+    def mark_dirty(self, *pod_keys: str) -> None:
+        """Force pods into the next tick's changed set (the manual
+        analogue of the kube-wired DirtyTracker for in-place
+        mutations)."""
+        self._marked.update(pod_keys)
+
+    @property
+    def fleet_price(self) -> float:
+        return sum(n.price for n in self._fleet) if self._fleet else 0.0
+
+    def _node_from_plan(self, plan) -> Optional[ResidualNode]:
+        it, off = plan.primary()
+        if plan.pool is None or it is None or off is None:
+            return None
+        self._seq += 1
+        name = f"inc-{self._seq}"
+        reqs = _config_requirements(plan.pool, it, off)
+        reqs.add(Requirement(HOSTNAME_LABEL, IN, [name]))
+        used = resutil.merge(
+            self.daemon_overhead.get(plan.pool.metadata.name, {}),
+            resutil.requests_for_pods(plan.pods),
+        )
+        node = ResidualNode(
+            name=name,
+            pool=plan.pool,
+            instance_type=it,
+            offering=off,
+            price=float(plan.price),
+            requirements=reqs,
+            taints=tuple(plan.pool.spec.template.spec.taints),
+            used=used,
+        )
+        for p in plan.pods:
+            node.pods[p.key] = p
+            self._where[p.key] = node
+        return node
+
+    def adopt(self, pods: Sequence[Pod], solution, pools_with_types) -> None:
+        """Replace the retained fleet with a full Solution's (the drift
+        backstop's adoption path; also usable by an external backstop
+        that computed the full solve itself)."""
+        assert not solution.existing, (
+            "IncrementalPipeline models fresh fleets only (no "
+            "caller-provided existing nodes)"
+        )
+        self._fleet = []
+        self._where = {}
+        self._pods = {p.key: p for p in pods}
+        for plan in solution.new_nodes:
+            node = self._node_from_plan(plan)
+            if node is not None:
+                self._fleet.append(node)
+        self._unplaced = {p.key for p in solution.unschedulable}
+        self._catalog_fp = catalog_fingerprint(pools_with_types)
+
+    # -- solving --------------------------------------------------------------
+
+    def solve_tick(
+        self,
+        pods: Sequence[Pod],
+        pools_with_types,
+        objective: str = "cost",
+        delta: Optional[tuple[Sequence[Pod], Sequence[str]]] = None,
+    ) -> TickResult:
+        """One tick. `delta=(added_pods, removed_keys)` lets an
+        event-driven caller (watch stream / dirty tracker) skip the
+        O(pods) reconciliation scan — the delta is TRUSTED to be the
+        exact diff against the previous tick's pod set; `pods` must
+        still be the full population (the full-solve backstops need
+        it). Without `delta`, the diff is derived by scanning `pods`
+        against the retained assignment (object identity + any
+        dirty-tracker/mark_dirty keys as the change signal)."""
+        self._tick += 1
+        dirty = self._marked
+        self._marked = set()
+        if self._tracker is not None:
+            dirty = dirty | self._tracker.drain("Pod")
+
+        if self._fleet is None:
+            return self._full_tick(pods, pools_with_types, objective, "cold")
+        if self._catalog_fp != catalog_fingerprint(pools_with_types):
+            return self._full_tick(
+                pods, pools_with_types, objective, "catalog"
+            )
+
+        if delta is not None:
+            added_pods, removed_keys = delta
+            removed = [k for k in removed_keys if k in self._pods]
+            # a deleted pod's DELETED event also lands in the dirty
+            # set — it must not resurrect as a changed pod
+            removed_set = set(removed)
+            changed_keys: list[str] = [
+                k for k in dirty
+                if k in self._pods and k not in removed_set
+            ]
+            place_new = list(added_pods)
+            n_after = len(self._pods) - len(removed) + len(place_new)
+        else:
+            cur: dict[str, Pod] = {p.key: p for p in pods}
+            removed = [k for k in self._pods if k not in cur]
+            place_new = [p for k, p in cur.items() if k not in self._pods]
+            changed_keys = [
+                k for k, p in cur.items()
+                if k in self._pods and (k in dirty or self._pods[k] is not p)
+            ]
+            # pods that silently vanished from `cur` while unplaced
+            self._unplaced = {k for k in self._unplaced if k in cur}
+            n_after = len(cur)
+
+        churn = (
+            len(removed) + len(place_new) + len(changed_keys)
+        ) / max(1, n_after)
+        if churn > self.churn_max:
+            return self._full_tick(
+                pods, pools_with_types, objective, "churn", churn=churn
+            )
+
+        if delta is not None:
+            if changed_keys:
+                # dirty keys need the CURRENT objects: watch streams
+                # deliver fresh Pod objects on MODIFIED, so the stored
+                # ones may carry the pre-mutation spec. The O(pods)
+                # lookup build is paid only on ticks that actually saw
+                # in-place mutations.
+                current = {p.key: p for p in pods}
+                changed_pods = [
+                    current.get(k, self._pods[k]) for k in changed_keys
+                ]
+            else:
+                changed_pods = []
+        else:
+            changed_pods = [cur[k] for k in changed_keys]
+        result = self._incremental_tick(
+            pools_with_types, removed, changed_keys, changed_pods,
+            place_new, churn,
+        )
+        if self.full_every > 0 and self._tick % self.full_every == 0:
+            return self._drift_backstop(pods, pools_with_types, objective,
+                                        result)
+        SOLVER_INCREMENTAL_TICKS.inc(
+            {"mode": "incremental", "reason": result.reason}
+        )
+        return result
+
+    def _full_tick(
+        self, pods, pools_with_types, objective, reason, churn=0.0
+    ) -> TickResult:
+        from karpenter_tpu.solver.solver import solve
+
+        sol = solve(
+            pods, pools_with_types,
+            daemon_overhead=self.daemon_overhead or None,
+            objective=objective, compat_cache=self.cache,
+        )
+        self.adopt(pods, sol, pools_with_types)
+        SOLVER_INCREMENTAL_TICKS.inc({"mode": "full", "reason": reason})
+        return TickResult(
+            mode="full",
+            reason=reason,
+            scheduled=len(pods) - len(sol.unschedulable),
+            unschedulable=len(sol.unschedulable),
+            fleet_price=self.fleet_price,
+            nodes=len(self._fleet),
+            churn=churn,
+            placed=len(pods),
+        )
+
+    def _incremental_tick(
+        self, pools_with_types, removed, changed_keys, changed_pods,
+        place_new, churn,
+    ) -> TickResult:
+        from karpenter_tpu.solver.encode import encode, group_pods
+        from karpenter_tpu.solver.solver import solve_encoded
+
+        # free capacity held by deleted/changed pods
+        for key in list(removed) + list(changed_keys):
+            node = self._where.pop(key, None)
+            if node is not None:
+                pod = node.pods.pop(key)
+                node.used = resutil.positive(
+                    resutil.subtract(node.used, resutil.pod_requests(pod))
+                )
+            else:
+                self._unplaced.discard(key)
+        for key in removed:
+            self._pods.pop(key, None)
+        # emptied nodes are released (their price comes off the fleet)
+        if any(not n.pods for n in self._fleet):
+            self._fleet = [n for n in self._fleet if n.pods]
+
+        # place: new pods, changed pods (now freed), then the retry
+        # backlog of previously-unplaced pods — de-duped by key
+        retry = [
+            self._pods[k] for k in sorted(self._unplaced)
+            if k in self._pods
+        ]
+        seen: set[str] = set()
+        place: list[Pod] = []
+        for p in list(place_new) + list(changed_pods) + retry:
+            if p.key not in seen:
+                seen.add(p.key)
+                place.append(p)
+        for p in place_new:
+            self._pods[p.key] = p
+        for p in changed_pods:
+            self._pods[p.key] = p
+
+        placed_total = len(place)
+        new_unplaced: set[str] = set()
+        rounds = 0
+        while place and rounds < 8:
+            rounds += 1
+            groups = group_pods(place)
+            # Residual prune (exact): a node whose available capacity
+            # is below the componentwise MINIMUM request over the
+            # groups being placed can hold none of them now — and
+            # nodes only get fuller during a solve, so its capacity
+            # row would be zero at every step. Dropping it shrinks the
+            # bound axis from the whole fleet to the nodes with real
+            # headroom (most of a packed fleet is full) without
+            # changing the FFD outcome: first-feasible order over the
+            # survivors is first-feasible order over all. Only keys
+            # EVERY group requests (>0) can prune — a group that
+            # doesn't request a key imposes no floor on it, so its
+            # componentwise minimum is 0 and the key must drop out
+            # (e.g. a CPU-only pod must still see GPU-less nodes when
+            # a GPU pod shares the tick).
+            min_req: dict[str, float] = {}
+            req_counts: dict[str, int] = {}
+            for g in groups:
+                for k, v in g.resources.items():
+                    if v <= 0:
+                        continue
+                    req_counts[k] = req_counts.get(k, 0) + 1
+                    have = min_req.get(k)
+                    min_req[k] = v if have is None else min(have, v)
+            min_req = {
+                k: v for k, v in min_req.items()
+                if req_counts[k] == len(groups)
+            }
+            inputs = []
+            order: list[ResidualNode] = []
+            for node in self._fleet:
+                avail = node.available()
+                if any(
+                    avail.get(k, 0.0) < v for k, v in min_req.items()
+                ):
+                    continue
+                inputs.append(
+                    ExistingNodeInput(
+                        name=node.name,
+                        requirements=node.requirements,
+                        taints=node.taints,
+                        available=avail,
+                        pool_name=node.pool.metadata.name,
+                        pod_count=len(node.pods),
+                    )
+                )
+                order.append(node)
+            enc = encode(
+                groups, pools_with_types, inputs,
+                daemon_overhead=self.daemon_overhead or None,
+                compat_cache=self.cache,
+            )
+            sol = solve_encoded(enc, objective=self.repack_objective)
+            for a in sol.existing:
+                node = order[a.existing_index]
+                for p in a.pods:
+                    node.pods[p.key] = p
+                    self._where[p.key] = node
+                node.used = resutil.merge(
+                    node.used, resutil.requests_for_pods(a.pods)
+                )
+            for plan in sol.new_nodes:
+                node = self._node_from_plan(plan)
+                if node is not None:
+                    self._fleet.append(node)
+            evicted_keys = {p.key for p in sol.evicted}
+            new_unplaced.update(
+                p.key for p in sol.unschedulable
+                if p.key not in evicted_keys
+            )
+            # k-way-evicted pods are schedulable alone; retry them
+            # against the now-updated residual fleet (bounded)
+            place = list(sol.evicted)
+        new_unplaced.update(p.key for p in place)  # retry bound hit
+        self._unplaced = new_unplaced
+
+        return TickResult(
+            mode="incremental",
+            reason="steady",
+            scheduled=len(self._pods) - len(self._unplaced),
+            unschedulable=len(self._unplaced),
+            fleet_price=self.fleet_price,
+            nodes=len(self._fleet),
+            churn=churn,
+            placed=placed_total,
+        )
+
+    def _drift_backstop(
+        self, pods, pools_with_types, objective, result: TickResult
+    ) -> TickResult:
+        """Periodic correctness backstop: run the full solve and
+        compare. The incremental fleet survives only while it prices
+        within drift_eps of (or beats) the full re-solve AND places
+        exactly as many pods; otherwise the full solution is adopted."""
+        from karpenter_tpu.solver.solver import solve
+
+        sol = solve(
+            pods, pools_with_types,
+            daemon_overhead=self.daemon_overhead or None,
+            objective=objective, compat_cache=self.cache,
+        )
+        full_price = float(sol.total_price)
+        drift = (
+            (result.fleet_price - full_price) / full_price
+            if full_price > 0 else 0.0
+        )
+        # Adoption must never trade placed pods away: the incremental
+        # path retries k-way-evicted pods against the updated residual
+        # fleet, so it can legitimately place MORE pods than the
+        # single-shot full solve — keep that fleet regardless of
+        # price. Adopt only when the full solve places at least as
+        # many pods AND (the incremental fleet placed fewer, or its
+        # price drifted past epsilon).
+        placed_fewer = result.unschedulable > len(sol.unschedulable)
+        placed_more = result.unschedulable < len(sol.unschedulable)
+        if placed_fewer or (drift > self.drift_eps and not placed_more):
+            self.adopt(pods, sol, pools_with_types)
+            SOLVER_INCREMENTAL_TICKS.inc({"mode": "full", "reason": "drift"})
+            return TickResult(
+                mode="full",
+                reason="drift",
+                scheduled=len(pods) - len(sol.unschedulable),
+                unschedulable=len(sol.unschedulable),
+                fleet_price=self.fleet_price,
+                nodes=len(self._fleet),
+                churn=result.churn,
+                placed=result.placed,
+                drift=drift,
+            )
+        SOLVER_INCREMENTAL_TICKS.inc(
+            {"mode": "incremental", "reason": "checked"}
+        )
+        result.reason = "checked"
+        result.drift = drift
+        return result

@@ -807,14 +807,20 @@ def solve_packing_async(
     if remembered is not None:
         max_nodes = remembered
     else:
+        # the FRESH axis is bucketed separately from the (already
+        # padded) bound block: bucketing the TOTAL hands a bound-heavy
+        # solve up to 25% of the fleet size as fresh axis — the
+        # incremental warm-start repack (thousands of bound rows, a
+        # handful of spill opens) pays the whole [F, C, R] broadcast
+        # for fresh rows it can never use
         estimate = _estimate_nodes(enc)
         if plan is not None:
             # LP covered the bulk; fresh axis only absorbs rounding spill.
-            max_nodes = _bucket(reserved_p + max(32, estimate // 8 + 8))
+            max_nodes = reserved_p + _bucket(max(32, estimate // 8 + 8))
         else:
-            max_nodes = reserved_p + max(32, int(1.35 * estimate) + 16)
-            max_nodes = _bucket(
-                min(max_nodes, reserved_p + max(64, total_pods))
+            fresh = max(32, int(1.35 * estimate) + 16)
+            max_nodes = reserved_p + _bucket(
+                min(fresh, max(64, total_pods))
             )
     worst_case = reserved_p + total_pods
     pending = _run_pack(
@@ -835,12 +841,17 @@ def solve_packing_async(
                         if len(_axis_memory) > 256:
                             _axis_memory.clear()
                         # remember a TIGHT axis derived from the actual
-                        # node count, not the (possibly overgrown)
-                        # bucket we used — the [N, C] work is linear in
-                        # N, so next time pays for the nodes it needs
-                        # plus headroom, nothing more
-                        _axis_memory[axis_key] = _bucket(
-                            int(result.node_count * 1.15) + 16
+                        # FRESH node count, not the (possibly
+                        # overgrown) bucket we used — the [F, C] work
+                        # is linear in F, so next time pays for the
+                        # fresh nodes it needs plus headroom, nothing
+                        # more (node_count includes the padded bound
+                        # block, which is sized independently)
+                        fresh_used = max(
+                            0, result.node_count - reserved_p
+                        )
+                        _axis_memory[axis_key] = reserved_p + _bucket(
+                            int(fresh_used * 1.15) + 16
                         )
                 return result
             # grow proportionally to observed density, not blind
@@ -898,8 +909,19 @@ def _run_pack(
 
     Existing/planned one-hot rows become the split kernel's BOUND block
     (config index + pre-gathered alloc vector, host-computed); only the
-    fresh axis keeps full [F, C] masks."""
+    fresh axis keeps full [F, C] masks.
+
+    Per-phase wall clock lands in the karpenter_solver_phase_duration
+    histogram: "transfer" (host staging + H2D upload), "compile" (the
+    jitted dispatch — trace+XLA on a cache miss, sub-ms when the warm
+    pool / persistent cache already holds the shape bucket), "execute"
+    (blocking on the device buffer at fetch)."""
     import math
+    import time as _time
+
+    from karpenter_tpu.metrics.store import SOLVER_PHASE_DURATION
+
+    _t_stage = _time.perf_counter()
 
     G, C = enc.compat.shape
     R = enc.group_req.shape[1]
@@ -1038,6 +1060,10 @@ def _run_pack(
             group_cap_full = jax.device_put(group_cap_full, replicated)
         if conflict_full is not None:
             conflict_full = jax.device_put(conflict_full, replicated)
+    _t_dispatch = _time.perf_counter()
+    SOLVER_PHASE_DURATION.observe(
+        _t_dispatch - _t_stage, {"phase": "transfer"}
+    )
     flat_dev = pack_split_flat(
         compat_j,
         rest["group_req"],
@@ -1059,6 +1085,9 @@ def _run_pack(
         group_cap=group_cap_full,
         conflict=conflict_full,
     )
+    SOLVER_PHASE_DURATION.observe(
+        _time.perf_counter() - _t_dispatch, {"phase": "compile"}
+    )
     # dispatch returned immediately (async device execution); capture
     # only host arrays in the closure so the fetch can rebuild what the
     # compact buffer leaves out
@@ -1069,7 +1098,11 @@ def _run_pack(
     eused = bound_used_h
 
     def fetch() -> PackResult:
+        _t_exec = _time.perf_counter()
         flat = np.asarray(flat_dev)  # the one device->host fetch
+        SOLVER_PHASE_DURATION.observe(
+            _time.perf_counter() - _t_exec, {"phase": "execute"}
+        )
         o0 = N * Gp
         o1 = o0 + F * W
         assign = flat[:o0].reshape(N, Gp)[:, :G].astype(np.int32)

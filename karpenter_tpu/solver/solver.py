@@ -47,11 +47,13 @@ class NodePlan:
         price: float = 0.0,
         claim_name: str = "",
         lazy=None,
+        lazy_primary=None,
     ):
         self.pool = pool
         self._instance_types = instance_types
         self._offerings = offerings
         self._lazy = lazy
+        self._lazy_primary = lazy_primary
         self.pods: list[Pod] = pods if pods is not None else []
         self.price = price
         self.claim_name = claim_name
@@ -90,6 +92,20 @@ class NodePlan:
     @offerings.setter
     def offerings(self, value: list[Offering]) -> None:
         self._offerings = value
+
+    def primary(self) -> tuple[Optional[InstanceType], Optional[Offering]]:
+        """The resolved (cheapest) launch option WITHOUT materializing
+        the full option lists — the incremental pipeline adopts
+        thousands of plans per full solve and needs only the launch
+        target per node, not the sorted member expansion."""
+        if (
+            self._lazy_primary is not None
+            and self._instance_types is None
+            and self._offerings is None
+        ):
+            return self._lazy_primary()
+        its, offs = self.instance_types, self.offerings
+        return (its[0] if its else None), (offs[0] if offs else None)
 
 
 @dataclass
@@ -225,9 +241,16 @@ def solve(
     backend: Optional[str] = None,
     objective: str = "ffd",
     shards: int = 0,
+    compat_cache=None,
 ) -> Solution:
+    """`compat_cache` (solver/incremental.EncodedCache) memoizes the
+    launchable config columns + compat rows across solves — see
+    encode()."""
     groups = group_pods(pods, required_only=required_only)
-    enc = encode(groups, pools_with_types, existing, daemon_overhead)
+    enc = encode(
+        groups, pools_with_types, existing, daemon_overhead,
+        compat_cache=compat_cache,
+    )
     return solve_encoded(enc, backend=backend, objective=objective, shards=shards)
 
 
@@ -636,17 +659,21 @@ def _node_options(enc: Encoded, mask: np.ndarray):
     """Closure for NodePlan's lazy (instance_types, offerings): expand
     the config mask's members cheapest-first. Captures only the masked
     ConfigInfo slice (not the Encoded) so a surviving NodePlan doesn't
-    pin the solver's dense arrays and all pod groups in memory."""
+    pin the solver's dense arrays and all pod groups in memory. Dedupe
+    members come from THIS encode's cfg_alts lists (per-encode state:
+    a shared compat cache reuses ConfigInfo objects across encodes, so
+    membership must never live on them)."""
     cols = np.flatnonzero(mask)
     configs = enc.configs          # list ref only: no dense arrays, no pods
+    alts = enc.cfg_alts
     prices = enc.cfg_price[cols].tolist()
 
     def thunk():
         members: list[tuple[float, int, object]] = []
         for ci, price in zip(cols.tolist(), prices):
             cfg = configs[ci]
-            if cfg.alts:
-                members.extend((p, ci, m) for p, m in cfg.alts)
+            if alts is not None and alts[ci]:
+                members.extend((p, ci, m) for p, m in alts[ci])
             else:
                 members.append((price, ci, cfg))
         members.sort(key=lambda t: (t[0], t[1]))
@@ -660,6 +687,24 @@ def _node_options(enc: Encoded, mask: np.ndarray):
     return thunk
 
 
+def _node_primary(enc: Encoded, price_col: int):
+    """Closure for NodePlan.primary(): the cheapest (type, offering)
+    the decode resolved the node onto, from the one argmin column —
+    O(alts) instead of the full member sort. Captures this encode's
+    own member list, so later encodes (shared compat cache) can never
+    change the answer."""
+    cfg = enc.configs[price_col]
+    members = enc.cfg_alts[price_col] if enc.cfg_alts is not None else None
+
+    def thunk():
+        if members:
+            _, best = min(members, key=lambda t: t[0])
+            return best.instance_type, best.offering
+        return cfg.instance_type, cfg.offering
+
+    return thunk
+
+
 def _build_solution_arrays(
     enc: Encoded,
     active_idx: np.ndarray,    # node rows with pods
@@ -669,6 +714,9 @@ def _build_solution_arrays(
 ) -> Solution:
     """Vectorized decode: per-node price/first-config via one masked
     reduction each; option lists stay lazy (see NodePlan)."""
+    import time as _time
+
+    _t_decode = _time.perf_counter()
     new_nodes: list[NodePlan] = []
     existing: dict[int, ExistingAssignment] = {}
     group_cursor = np.zeros(len(enc.groups), np.int64)
@@ -724,6 +772,7 @@ def _build_solution_arrays(
             price=float(node_price[row]),
             pods=pods,
             lazy=_node_options(enc, sub_mask[row]),
+            lazy_primary=_node_primary(enc, int(price_col[row])),
         )
         # the decode resolves the claim onto the cheapest offering; if
         # that is a reserved one, the node consumes reservation budget
@@ -744,6 +793,11 @@ def _build_solution_arrays(
         unschedulable.extend(tail)
         if extra_unsched[gi]:
             evicted.extend(tail[len(tail) - int(extra_unsched[gi]) :])
+    from karpenter_tpu.metrics.store import SOLVER_PHASE_DURATION
+
+    SOLVER_PHASE_DURATION.observe(
+        _time.perf_counter() - _t_decode, {"phase": "decode"}
+    )
     return Solution(
         new_nodes=new_nodes,
         existing=sorted(existing.values(), key=lambda e: e.existing_index),

@@ -1,0 +1,276 @@
+"""AOT compile warm pool + persistent compilation cache.
+
+Cold-start JIT warmup on the headline 50k scenario costs ~10.8s
+(BENCH_r05 reserved_50k.warmup_s) — all XLA compilation of the packing
+kernels' shape buckets. Two layers remove it from the serving path:
+
+1. **Persistent compilation cache** (`enable_persistent_cache`): JAX's
+   on-disk cache keyed by HLO, tagged with a machine fingerprint so an
+   image reused across heterogeneous hosts never loads a stale
+   artifact. Restarts then skip XLA entirely for every shape bucket
+   ever compiled on the host. TPU-only by default: XLA:CPU AOT
+   artifacts serialize pseudo-features (+prefer-no-gather/-scatter)
+   the loader's host-feature detection never reports, so every load
+   fails validation and recompiles mid-run (measured 2x tail inflation
+   — see BENCH r04 postmortem).
+
+2. **AOT warm pool** (`warm`/`start_background`): at operator startup a
+   background thread compiles the split packing kernel for the
+   configured shape buckets via `jit(...).lower(...).compile()` —
+   shape-only tracing, no device execution, no input allocation. With
+   the persistent cache enabled the compiled artifacts land on disk,
+   so the first REAL solve of each bucket hits the cache instead of
+   XLA.
+
+Shape buckets come from KARPENTER_WARM_SHAPES ("G:C:E:N[:R[:P]]"
+semicolon list — pod groups, config columns, existing nodes, FRESH
+node axis, optional resource-axis width (default 4) and NodePool count
+(default 1); padded to the same buckets `_run_pack` uses) or a default
+family covering the small/medium/large unconstrained solves plus a
+bound-heavy steady-state shape. Clusters with several NodePools or
+extended resources must say so via R/P — the jit cache keys on exact
+shapes, so a (2, 4) pool_overhead program never serves a 3-pool
+cluster. Every compile is best-effort: a failure is counted and
+logged, never raised into the operator.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+import threading
+from typing import Iterable, Optional, Sequence
+
+log = logging.getLogger("karpenter.solver.warmpool")
+
+# (groups, configs, existing/bound rows, fresh node axis) per bucket. The
+# default family mirrors the shapes the bench matrix and a steady-state
+# operator actually hit: small catalog probes, the mid-size batched
+# solve, the 50k-pod headline, and a bound-row-heavy incremental tick.
+DEFAULT_SHAPES: tuple[tuple[int, int, int, int], ...] = (
+    (16, 256, 0, 64),
+    (64, 1024, 0, 512),
+    (128, 4096, 0, 2048),
+    (16, 1024, 1024, 64),
+)
+
+MODES = ("ffd", "cost")
+
+
+def cache_dir_default() -> str:
+    here = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)
+    )))
+    return os.path.join(here, ".jax_cache")
+
+
+def machine_tag() -> str:
+    """Stable host fingerprint for the cache directory: artifacts must
+    never be shared across machines with different CPU features or JAX
+    builds (stable cpuinfo lines only — MHz etc. vary per boot)."""
+    import jax
+
+    parts = []
+    try:
+        with open("/etc/machine-id") as fh:
+            parts.append(fh.read().strip())
+    except OSError:
+        parts.append("no-machine-id")
+    try:
+        with open("/proc/cpuinfo") as fh:
+            parts.extend(sorted({
+                line.strip() for line in fh
+                if line.startswith(("flags", "model name"))
+            }))
+    except OSError:
+        parts.append("no-cpuinfo")
+    parts.append(jax.__version__)
+    return hashlib.md5("\n".join(parts).encode()).hexdigest()[:8]
+
+
+def enable_persistent_cache(
+    cache_dir: Optional[str] = None, force: bool = False
+) -> Optional[str]:
+    """Point JAX's persistent compilation cache at a machine-tagged
+    directory (KARPENTER_JAX_CACHE_DIR overrides the repo-local
+    default). Returns the directory in use, or None when skipped
+    (CPU backend, unless `force`)."""
+    import jax
+
+    if jax.default_backend() == "cpu" and not force:
+        return None
+    base = (
+        cache_dir
+        or os.environ.get("KARPENTER_JAX_CACHE_DIR")
+        or cache_dir_default()
+    )
+    path = os.path.join(base, f"{jax.default_backend()}-{machine_tag()}")
+    os.makedirs(path, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    return path
+
+
+def shapes_from_env(spec: Optional[str] = None) -> list[tuple]:
+    """Parse KARPENTER_WARM_SHAPES ("G:C:E:N[:R[:P]];..."). R is the
+    resource-axis width (4 = the base resources; clusters with
+    extended resources must widen it or the warmed programs never
+    match) and P the NodePool count (pool_overhead ships as [P+1, R],
+    so a 2-pool cluster needs P=2). Malformed entries are dropped
+    (warm-up is best-effort by definition)."""
+    spec = spec if spec is not None else os.environ.get(
+        "KARPENTER_WARM_SHAPES", ""
+    )
+    if not spec:
+        return list(DEFAULT_SHAPES)
+    out = []
+    for part in spec.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        try:
+            fields = [int(x) for x in part.split(":")]
+            if len(fields) < 4 or len(fields) > 6:
+                raise ValueError(part)
+            g, c, e, n = fields[:4]
+            r = fields[4] if len(fields) > 4 else 4
+            p = fields[5] if len(fields) > 5 else 1
+            if g > 0 and c > 0 and e >= 0 and n > 0 and r > 0 and p > 0:
+                out.append((g, c, e, n, r, p))
+        except ValueError:
+            log.warning("ignoring malformed warm shape %r", part)
+    return out or list(DEFAULT_SHAPES)
+
+
+def _compile_bucket(
+    G: int, C: int, E: int, N: int, mode: str,
+    R: int = 4, P: int = 1, topo: bool = False,
+) -> None:
+    """AOT-compile pack_split_flat for one padded shape bucket using
+    ShapeDtypeStructs (no real arrays, no execution). The padding must
+    mirror _run_pack exactly or the warmed program never matches a real
+    solve."""
+    import jax.numpy as jnp
+    from jax import ShapeDtypeStruct as S
+
+    from karpenter_tpu.solver.pack import _bucket, _pad_axis, pack_split_flat
+
+    Gp = _pad_axis(G)
+    Cp = -(-_pad_axis(C) // 32) * 32
+    Ep = _pad_axis(E) if E else 0
+    # N names the FRESH node axis: solve_packing_async buckets the
+    # fresh axis independently of the (already padded) bound block, so
+    # only _bucket values ever reach the kernel as max_free — deriving
+    # F any other way would compile programs no real solve can reuse
+    F = _bucket(max(N, 1))
+    args = (
+        S((Gp, Cp), jnp.bool_),      # compat
+        S((Gp, R), jnp.float32),     # group_req
+        S((Gp,), jnp.int32),         # group_count
+        S((Cp, R), jnp.float32),     # cfg_alloc
+        S((Cp,), jnp.int32),         # cfg_pool
+        S((P + 1, R), jnp.float32),  # pool_overhead
+        S((Gp, Ep), jnp.bool_),      # bound_compat
+        S((Ep, R), jnp.float32),     # bound_alloc
+        S((Ep, R), jnp.float32),     # bound_used0
+        S((Ep,), jnp.int32),         # bound_slot
+        S((Ep,), jnp.bool_),         # bound_live
+        S((Cp,), jnp.float32),       # cfg_price
+    )
+    kw = {}
+    if topo:
+        kw["group_cap"] = S((Gp,), jnp.int32)
+        kw["conflict"] = S((Gp, Gp), jnp.bool_)
+        if Ep:
+            kw["bound_quota"] = S((Ep, Gp), jnp.int16)
+    pack_split_flat.lower(*args, max_free=F, mode=mode, **kw).compile()
+
+
+def warm(
+    shapes: Optional[Iterable[tuple[int, int, int, int]]] = None,
+    modes: Sequence[str] = MODES,
+    topo: bool = True,
+    stop: Optional[threading.Event] = None,
+) -> dict[str, int]:
+    """Compile every (shape bucket, mode[, topo variant]) combination;
+    returns {"ok": n, "error": n, "skipped": n}. Never raises. `stop`
+    is polled between compiles (one bucket compile is the atomic
+    unit); buckets run smallest-first so an early stop leaves the
+    cheapest work in flight."""
+    from karpenter_tpu.metrics.store import SOLVER_WARM_COMPILES
+
+    shapes = list(shapes) if shapes is not None else shapes_from_env()
+    shapes.sort(key=lambda s: s[0] * s[1] + s[2] + s[3])
+    counts = {"ok": 0, "error": 0, "skipped": 0}
+    for shape in shapes:
+        G, C, E, N = shape[:4]
+        R = shape[4] if len(shape) > 4 else 4
+        P = shape[5] if len(shape) > 5 else 1
+        for mode in modes:
+            for with_topo in ((False, True) if topo else (False,)):
+                if stop is not None and stop.is_set():
+                    counts["skipped"] += 1
+                    continue
+                try:
+                    _compile_bucket(G, C, E, N, mode, R=R, P=P,
+                                    topo=with_topo)
+                    counts["ok"] += 1
+                    SOLVER_WARM_COMPILES.inc({"outcome": "ok"})
+                except Exception as err:
+                    counts["error"] += 1
+                    SOLVER_WARM_COMPILES.inc({"outcome": "error"})
+                    log.warning(
+                        "warm compile (G=%d,C=%d,E=%d,N=%d,R=%d,P=%d,"
+                        "mode=%s,topo=%s) failed: %s",
+                        G, C, E, N, R, P, mode, with_topo, err,
+                    )
+    return counts
+
+
+def start_background(
+    shapes: Optional[Iterable[tuple[int, int, int, int]]] = None,
+    enable_cache: bool = True,
+) -> threading.Thread:
+    """Operator-startup entry: enable the persistent cache, then AOT
+    warm the shape buckets on a background thread so the first tick's
+    solve never waits on XLA. Returns the (started) thread; its `stop`
+    attribute is a threading.Event that abandons the remaining
+    buckets.
+
+    The thread is deliberately NON-daemon: a daemon thread killed
+    mid-XLA-compile at interpreter exit takes the process down with a
+    C++ `terminate` (observed: exit code 134 on a clean shutdown). The
+    stop event is registered via threading's internal shutdown hooks —
+    which run BEFORE non-daemon threads are joined, unlike atexit — so
+    process exit waits for at most the one in-flight bucket compile."""
+    stop = threading.Event()
+
+    def _run() -> None:
+        try:
+            if enable_cache:
+                path = enable_persistent_cache()
+                if path:
+                    log.info("persistent compile cache at %s", path)
+            counts = warm(shapes, stop=stop)
+            log.info(
+                "warm pool compiled %d shape buckets "
+                "(%d failed, %d skipped)",
+                counts["ok"], counts["error"], counts["skipped"],
+            )
+        except Exception:  # never take the operator down
+            log.exception("solver warm pool crashed")
+
+    thread = threading.Thread(
+        target=_run, name="solver-warm-pool", daemon=False
+    )
+    thread.stop = stop
+    register = getattr(threading, "_register_atexit", None)
+    if register is not None:  # CPython 3.9+ (concurrent.futures uses it)
+        register(stop.set)
+    else:  # pragma: no cover - very old interpreters: bounded daemon risk
+        import atexit
+
+        atexit.register(stop.set)
+    thread.start()
+    return thread

@@ -1,0 +1,473 @@
+"""Incremental warm-start pipeline: oracle equivalence + encoder-cache
+invalidation (SURVEY tiers 2/4).
+
+The oracle contract mirrors the bench steady_state_churn acceptance:
+on randomized churn sequences (tools/soak.py seeds), every incremental
+tick must place exactly as many pods as a from-scratch solve of the
+same population, and the periodic drift backstop must keep fleet price
+within the configured epsilon. The encoder cache must be EXACT: a
+cached encode equals a fresh encode array-for-array under pod
+mutation/deletion, catalog changes, and relists.
+"""
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.cloudprovider.fake import GIB, instance_types, make_instance_type
+from karpenter_tpu.kube.objects import ObjectMeta, Pod
+from karpenter_tpu.solver.encode import ExistingNodeInput, encode, group_pods
+from karpenter_tpu.solver.incremental import (
+    EncodedCache,
+    IncrementalPipeline,
+    catalog_fingerprint,
+)
+from karpenter_tpu.solver.solver import solve
+from karpenter_tpu.testing import mk_nodepool, mk_pod
+
+SHAPES = [(0.5, 1.0), (1.0, 2.0), (2.0, 4.0), (1.0, 0.5), (0.25, 2.0)]
+
+
+def _pod(name: str, i: int, rng) -> Pod:
+    cpu, mem = SHAPES[i % len(SHAPES)]
+    selector = None
+    if rng.random() < 0.3:
+        selector = {"kubernetes.io/arch": "amd64"}
+    elif rng.random() < 0.15:
+        selector = {"topology.kubernetes.io/zone": "test-zone-1"}
+    return mk_pod(name=name, cpu=cpu, memory=mem * GIB, node_selector=selector)
+
+
+ENCODED_ARRAYS = (
+    "compat", "cfg_alloc", "cfg_price", "cfg_pool", "group_req",
+    "group_count", "cfg_rsv", "rsv_cap", "loose_groups", "pool_overhead",
+)
+
+
+def assert_encode_parity(groups, pools, existing, cache, **kw):
+    fresh = encode(groups, pools, existing, **kw)
+    cached = encode(groups, pools, existing, compat_cache=cache, **kw)
+    for name in ENCODED_ARRAYS:
+        a, b = getattr(fresh, name), getattr(cached, name)
+        assert np.array_equal(a, b), f"{name} diverged under cache"
+    assert len(fresh.configs) == len(cached.configs)
+    return cached
+
+
+class TestEncodedCache:
+    def test_cached_encode_equals_fresh(self):
+        pools = [(mk_nodepool("default"), instance_types(30))]
+        rng = np.random.default_rng(7)
+        pods = [_pod(f"p-{i}", i, rng) for i in range(40)]
+        cache = EncodedCache()
+        groups = group_pods(pods)
+        assert_encode_parity(groups, pools, (), cache)
+        # second pass: warm rows must still be exact
+        assert_encode_parity(groups, pools, (), cache)
+
+    def test_pod_mutation_busts_its_row(self):
+        """A mutated pod changes its group signature; the cached-path
+        encode must produce the fresh row for the new signature."""
+        pools = [(mk_nodepool("default"), instance_types(30))]
+        rng = np.random.default_rng(7)
+        pods = [_pod(f"p-{i}", i, rng) for i in range(40)]
+        cache = EncodedCache()
+        assert_encode_parity(group_pods(pods), pools, (), cache)
+        pods[0].spec.node_selector = {"kubernetes.io/arch": "arm64"}
+        pods[1].spec.node_selector = {
+            "topology.kubernetes.io/zone": "test-zone-2"
+        }
+        assert_encode_parity(group_pods(pods), pools, (), cache)
+
+    def test_pod_delete_shrinks_counts(self):
+        pools = [(mk_nodepool("default"), instance_types(30))]
+        rng = np.random.default_rng(7)
+        pods = [_pod(f"p-{i}", i, rng) for i in range(40)]
+        cache = EncodedCache()
+        assert_encode_parity(group_pods(pods), pools, (), cache)
+        enc = assert_encode_parity(group_pods(pods[:25]), pools, (), cache)
+        assert int(enc.group_count.sum()) == 25
+
+    def test_catalog_change_busts_everything(self):
+        rng = np.random.default_rng(7)
+        pods = [_pod(f"p-{i}", i, rng) for i in range(20)]
+        cache = EncodedCache()
+        pools = [(mk_nodepool("default"), instance_types(20))]
+        assert_encode_parity(group_pods(pods), pools, (), cache)
+        # new catalog object (rebuilt types) -> fingerprint differs
+        pools2 = [(mk_nodepool("default"), instance_types(25))]
+        assert catalog_fingerprint(pools) != catalog_fingerprint(pools2)
+        assert_encode_parity(group_pods(pods), pools2, (), cache)
+
+    def test_offering_availability_flip_busts(self):
+        """ICE marking flips Offering.available in place — the
+        fingerprint must catch it (columns vanish from build_configs)."""
+        types = instance_types(10)
+        pools = [(mk_nodepool("default"), types)]
+        rng = np.random.default_rng(7)
+        pods = [_pod(f"p-{i}", i, rng) for i in range(15)]
+        cache = EncodedCache()
+        fp_before = catalog_fingerprint(pools)
+        assert_encode_parity(group_pods(pods), pools, (), cache)
+        offering = types[0].offerings[0]
+        offering.available = False
+        try:
+            # the fingerprint must change (in-place attribute flip,
+            # same object ids) AND the cached encode must still equal
+            # a fresh one — i.e. the bust actually happened
+            assert catalog_fingerprint(pools) != fp_before
+            assert_encode_parity(group_pods(pods), pools, (), cache)
+        finally:
+            offering.available = True
+
+    def test_relist_invalidate(self):
+        pools = [(mk_nodepool("default"), instance_types(20))]
+        rng = np.random.default_rng(7)
+        pods = [_pod(f"p-{i}", i, rng) for i in range(15)]
+        cache = EncodedCache()
+        assert_encode_parity(group_pods(pods), pools, (), cache)
+        cache.invalidate()
+        assert cache._fp is None and not cache._rows and not cache._arrays
+        assert_encode_parity(group_pods(pods), pools, (), cache)
+
+    def test_existing_nodes_and_reservations_not_cached_stale(self):
+        """Per-call inputs (existing-node capacity, reservation budget
+        remaining) must never be served stale from the cache."""
+        from karpenter_tpu.scheduling.requirements import Requirements
+
+        types = [
+            make_instance_type(
+                "r8", cpu=8, memory=32 * GIB,
+                reservations=[("rsv-a", "test-zone-1", 5)],
+            )
+        ] + instance_types(10)
+        pools = [(mk_nodepool("default"), types)]
+        rng = np.random.default_rng(7)
+        pods = [_pod(f"p-{i}", i, rng) for i in range(15)]
+        cache = EncodedCache()
+        groups = group_pods(pods)
+
+        def node(avail_cpu):
+            return ExistingNodeInput(
+                name="n-0",
+                requirements=Requirements.from_labels(
+                    {"kubernetes.io/arch": "amd64",
+                     "kubernetes.io/os": "linux"}
+                ),
+                taints=(),
+                available={"cpu": avail_cpu, "memory": 8 * GIB,
+                           "pods": 110.0},
+            )
+
+        for avail, in_use in ((4.0, {"rsv-a": 1}), (2.0, {"rsv-a": 4})):
+            fresh = encode(groups, pools, [node(avail)],
+                           reserved_in_use=in_use)
+            cached = encode(groups, pools, [node(avail)],
+                            reserved_in_use=in_use, compat_cache=cache)
+            for name in ENCODED_ARRAYS:
+                assert np.array_equal(
+                    getattr(fresh, name), getattr(cached, name)
+                ), name
+
+    def test_lazy_options_survive_later_encodes(self):
+        """A solution's lazy NodePlan option lists must expand to the
+        SAME members whether or not another encode (same shared cache,
+        different pods) ran in between — dedupe membership is
+        per-encode state, not shared-ConfigInfo state."""
+        pools = [(mk_nodepool("default"), instance_types(30))]
+        rng = np.random.default_rng(7)
+        pods = [_pod(f"p-{i}", i, rng) for i in range(30)]
+        cache = EncodedCache()
+        baseline = solve(pods, pools, objective="ffd")
+        expect = [
+            ([it.name for it in plan.instance_types],
+             [(o.zone, o.capacity_type, o.price) for o in plan.offerings])
+            for plan in baseline.new_nodes
+        ]
+        sol = solve(pods, pools, objective="ffd", compat_cache=cache)
+        # a second encode with DIFFERENT pods (capacity-type pinned ->
+        # different dedupe grouping) before materializing round 1
+        other = [
+            mk_pod(name=f"q-{i}", cpu=0.5,
+                   node_selector={"karpenter.sh/capacity-type": "spot"})
+            for i in range(5)
+        ]
+        solve(other, pools, objective="ffd", compat_cache=cache)
+        got = [
+            ([it.name for it in plan.instance_types],
+             [(o.zone, o.capacity_type, o.price) for o in plan.offerings])
+            for plan in sol.new_nodes
+        ]
+        assert got == expect
+
+    def test_row_cap_evicts(self):
+        cache = EncodedCache(max_rows=4)
+        pools = [(mk_nodepool("default"), instance_types(10))]
+        rng = np.random.default_rng(7)
+        for i in range(8):
+            pods = [mk_pod(name=f"p-{i}", cpu=0.1 * (i + 1))]
+            encode(group_pods(pods), pools, (), compat_cache=cache)
+        assert len(cache._rows) <= 4
+
+
+class TestIncrementalOracle:
+    @pytest.mark.parametrize("seed", [7, 11, 23, 42])  # tools/soak.py seeds
+    def test_incremental_matches_full_on_random_churn(self, seed):
+        """Randomized churn: every tick's scheduled/unschedulable
+        counts must equal a from-scratch solve's; checked ticks keep
+        price within the drift epsilon (else the backstop adopts)."""
+        import random
+
+        rng = random.Random(seed)
+        nrng = np.random.default_rng(seed)
+        pools = [(mk_nodepool("default"), instance_types(30))]
+        pipe = IncrementalPipeline(full_every=4, drift_eps=0.01,
+                                   repack_objective="ffd")
+        pods = [_pod(f"w-{i}", i, nrng) for i in range(300)]
+        counter = [300]
+        for tick in range(12):
+            # random churn: create/delete/mutate
+            for _ in range(rng.randrange(1, 12)):
+                op = rng.random()
+                if op < 0.45 or not pods:
+                    counter[0] += 1
+                    pods.append(_pod(f"w-{counter[0]}", counter[0], nrng))
+                elif op < 0.8:
+                    pods.pop(rng.randrange(len(pods)))
+                else:
+                    # mutate = replace the object (content change)
+                    i = rng.randrange(len(pods))
+                    name = pods[i].metadata.name
+                    counter[0] += 1
+                    pods[i] = _pod(name, counter[0], nrng)
+            result = pipe.solve_tick(pods, pools, objective="ffd")
+            full = solve(pods, pools, objective="ffd")
+            assert result.unschedulable == len(full.unschedulable), (
+                f"seed={seed} tick={tick}: incremental "
+                f"{result.unschedulable} unschedulable vs full "
+                f"{len(full.unschedulable)}"
+            )
+            assert result.scheduled == len(pods) - len(full.unschedulable)
+            if result.reason in ("checked", "drift"):
+                # the backstop's contract: post-tick fleet price within
+                # eps of (or equal to, after adoption) the full solve
+                full_price = float(full.total_price)
+                if full_price > 0:
+                    assert (
+                        result.fleet_price
+                        <= full_price * (1 + pipe.drift_eps) + 1e-9
+                    )
+
+    def test_cold_and_churn_blowout_run_full(self):
+        nrng = np.random.default_rng(3)
+        pools = [(mk_nodepool("default"), instance_types(20))]
+        pipe = IncrementalPipeline(churn_max=0.25, full_every=0)
+        pods = [_pod(f"a-{i}", i, nrng) for i in range(100)]
+        r = pipe.solve_tick(pods, pools, objective="ffd")
+        assert r.mode == "full" and r.reason == "cold"
+        # >25% churn -> full re-solve
+        pods = pods[:60] + [_pod(f"b-{i}", i, nrng) for i in range(40)]
+        r = pipe.solve_tick(pods, pools, objective="ffd")
+        assert r.mode == "full" and r.reason == "churn"
+        # small churn -> incremental; the repack routes only the
+        # changed pods plus the standing unschedulable retry backlog
+        pods = pods[1:] + [_pod("c-1", 1, nrng)]
+        before_unplaced = len(pipe._unplaced)
+        r = pipe.solve_tick(pods, pools, objective="ffd")
+        assert r.mode == "incremental"
+        assert r.placed <= 2 + before_unplaced
+
+    def test_catalog_change_forces_full(self):
+        nrng = np.random.default_rng(3)
+        pipe = IncrementalPipeline(full_every=0)
+        pods = [_pod(f"a-{i}", i, nrng) for i in range(50)]
+        pools = [(mk_nodepool("default"), instance_types(20))]
+        assert pipe.solve_tick(pods, pools, objective="ffd").mode == "full"
+        pools2 = [(mk_nodepool("default"), instance_types(22))]
+        r = pipe.solve_tick(pods, pools2, objective="ffd")
+        assert r.mode == "full" and r.reason == "catalog"
+
+    def test_delta_api_matches_scan(self):
+        """The trusted-delta fast path and the full reconciliation
+        scan must land in the same state."""
+        nrng = np.random.default_rng(5)
+        pools = [(mk_nodepool("default"), instance_types(20))]
+        a = IncrementalPipeline(full_every=0)
+        b = IncrementalPipeline(full_every=0)
+        pods = [_pod(f"a-{i}", i, nrng) for i in range(120)]
+        a.solve_tick(pods, pools, objective="ffd")
+        b.solve_tick(pods, pools, objective="ffd")
+        removed = [pods[i].key for i in (0, 5, 9)]
+        born = [_pod(f"n-{i}", i, nrng) for i in range(3)]
+        pods2 = [p for p in pods if p.key not in set(removed)] + born
+        ra = a.solve_tick(pods2, pools, objective="ffd")
+        rb = b.solve_tick(pods2, pools, objective="ffd",
+                          delta=(born, removed))
+        assert ra.mode == rb.mode == "incremental"
+        assert ra.scheduled == rb.scheduled
+        assert ra.unschedulable == rb.unschedulable
+        assert abs(ra.fleet_price - rb.fleet_price) < 1e-6
+
+    def test_dirty_tracker_catches_inplace_mutation(self):
+        """kube-wired pipeline: a pod mutated IN PLACE (same object)
+        is invisible to identity diffing; the Pod dirty tracker names
+        it and the pipeline re-places it."""
+        from karpenter_tpu.kube.client import KubeClient
+
+        kube = KubeClient()
+        pools = [(mk_nodepool("default"), instance_types(20))]
+        pipe = IncrementalPipeline(kube=kube, full_every=0)
+        pods = [mk_pod(name=f"a-{i}", cpu=0.5) for i in range(30)]
+        for p in pods:
+            kube.create(p)
+        kube.deliver()
+        pipe._tracker.drain("Pod")  # swallow the create replay
+        pipe.solve_tick(pods, pools, objective="ffd")
+        # in-place mutation + touch -> watch event -> dirty key
+        pods[3].spec.containers[0].requests["cpu"] = 1.0
+        kube.touch(pods[3])
+        kube.deliver()
+        r = pipe.solve_tick(pods, pools, objective="ffd")
+        assert r.mode == "incremental"
+        assert r.placed >= 1
+        full = solve(pods, pools, objective="ffd")
+        assert r.unschedulable == len(full.unschedulable)
+        # the re-placed pod's new requests are accounted on its node
+        node = pipe._where[pods[3].key]
+        assert pods[3].key in node.pods
+        assert node.used.get("cpu", 0.0) >= 1.0
+
+    def test_heterogeneous_resource_churn_not_overpruned(self):
+        """The residual prune must not hide resource-less nodes from
+        groups that don't request that resource: a CPU-only pod
+        sharing a tick with an extended-resource pod must still land
+        on existing CPU capacity instead of opening a fresh node."""
+        cpu_type = make_instance_type("c4", cpu=4.0, memory=16 * GIB,
+                                      price=1.0)
+        gpu_type = make_instance_type("g4", cpu=4.0, memory=16 * GIB,
+                                      price=5.0)
+        gpu_type.capacity["example.com/gpu"] = 2.0
+        pools = [(mk_nodepool("default"), [cpu_type, gpu_type])]
+        pipe = IncrementalPipeline(full_every=0)
+        pods = [mk_pod(name=f"c-{i}", cpu=1.0) for i in range(24)]
+        r0 = pipe.solve_tick(pods, pools, objective="ffd")
+        n_before = r0.nodes
+        gpu_pod = mk_pod(name="gpu-1", cpu=1.0)
+        gpu_pod.spec.containers[0].requests["example.com/gpu"] = 1.0
+        pods = pods[:-1] + [mk_pod(name="c-new", cpu=1.0), gpu_pod]
+        r1 = pipe.solve_tick(pods, pools, objective="ffd")
+        assert r1.mode == "incremental" and r1.unschedulable == 0
+        full = solve(pods, pools, objective="ffd")
+        assert len(full.unschedulable) == 0
+        # cpu churn absorbed by freed cpu capacity; only the gpu pod
+        # may open a node — fleet within one node of the full solve
+        assert r1.nodes <= n_before + 1
+
+    def test_unplaced_pods_retry_next_tick(self):
+        """A pod no catalog type can hold reports unschedulable every
+        tick (retried, not forgotten) and schedules the moment the
+        catalog can hold it (catalog change -> full solve)."""
+        small = [make_instance_type("s1", cpu=1.0, memory=4 * GIB, price=1.0)]
+        pools = [(mk_nodepool("default"), small)]
+        pipe = IncrementalPipeline(full_every=0)
+        pods = [mk_pod(name="big", cpu=8.0)] + [
+            mk_pod(name=f"s-{i}", cpu=0.5) for i in range(10)
+        ]
+        r = pipe.solve_tick(pods, pools, objective="ffd")
+        assert r.unschedulable == 1
+        pods.append(mk_pod(name="s-10", cpu=0.5))
+        r = pipe.solve_tick(pods, pools, objective="ffd")
+        assert r.mode == "incremental" and r.unschedulable == 1
+        big_pools = [(mk_nodepool("default"),
+                      small + [make_instance_type("b16", cpu=16.0,
+                                                  memory=64 * GIB,
+                                                  price=8.0)])]
+        r = pipe.solve_tick(pods, big_pools, objective="ffd")
+        assert r.unschedulable == 0
+
+
+class TestPhaseMetricsExposition:
+    def test_phases_exported_through_exposition(self):
+        from karpenter_tpu.metrics.exposition import render
+
+        pools = [(mk_nodepool("default"), instance_types(10))]
+        pods = [mk_pod(name=f"m-{i}", cpu=0.5) for i in range(10)]
+        solve(pods, pools, objective="ffd")
+        out = render()
+        for phase in ("encode", "transfer", "compile", "execute", "decode"):
+            assert (
+                f'karpenter_solver_phase_duration_seconds_bucket{{phase="{phase}"'
+                in out
+            ), f"phase {phase} not exported"
+        assert "karpenter_solver_phase_duration_seconds_sum" in out
+
+    def test_cache_and_tick_counters_exported(self):
+        from karpenter_tpu.metrics.exposition import render
+
+        pools = [(mk_nodepool("default"), instance_types(10))]
+        pipe = IncrementalPipeline(full_every=0)
+        pods = [mk_pod(name=f"c-{i}", cpu=0.5) for i in range(10)]
+        pipe.solve_tick(pods, pools, objective="ffd")
+        pods = pods[1:] + [mk_pod(name="c-new", cpu=0.5)]
+        pipe.solve_tick(pods, pools, objective="ffd")
+        out = render()
+        assert 'karpenter_solver_incremental_ticks_total{mode="full"' in out
+        assert (
+            'karpenter_solver_incremental_ticks_total{mode="incremental"'
+            in out
+        )
+        assert 'karpenter_solver_encode_cache_total{outcome="hit"}' in out
+
+
+class TestWarmPool:
+    def test_warm_compiles_default_signature(self):
+        """AOT warm-up of one tiny bucket must succeed (ShapeDtypeStruct
+        lowering, no execution) and count its outcome."""
+        from karpenter_tpu.metrics.store import SOLVER_WARM_COMPILES
+        from karpenter_tpu.solver import warm_pool
+
+        before = SOLVER_WARM_COMPILES.value({"outcome": "ok"})
+        counts = warm_pool.warm(
+            shapes=[(4, 64, 0, 32)], modes=("ffd",), topo=False
+        )
+        assert counts == {"ok": 1, "error": 0, "skipped": 0}
+        assert SOLVER_WARM_COMPILES.value({"outcome": "ok"}) == before + 1
+
+    def test_warmed_shape_is_what_a_real_solve_uses(self):
+        """The warm pool's padding must mirror _run_pack: a real solve
+        sized inside the warmed bucket reuses the compiled program
+        (smoke: solve simply succeeds and is fast-path consistent)."""
+        pools = [(mk_nodepool("default"), instance_types(8))]
+        pods = [mk_pod(name=f"w-{i}", cpu=0.5) for i in range(12)]
+        sol = solve(pods, pools, objective="ffd")
+        assert sum(len(n.pods) for n in sol.new_nodes) + sum(
+            len(e.pods) for e in sol.existing
+        ) == 12
+
+    def test_shapes_from_env_parsing(self):
+        from karpenter_tpu.solver import warm_pool
+
+        assert warm_pool.shapes_from_env("8:128:0:64;4:32:16:32") == [
+            (8, 128, 0, 64, 4, 1), (4, 32, 16, 32, 4, 1)
+        ]
+        # optional resource-axis width + pool count
+        assert warm_pool.shapes_from_env("8:128:0:64:6:3") == [
+            (8, 128, 0, 64, 6, 3)
+        ]
+        # malformed entries drop; empty spec -> defaults
+        assert warm_pool.shapes_from_env("bogus;;") == list(
+            warm_pool.DEFAULT_SHAPES
+        )
+        assert warm_pool.shapes_from_env("") == list(
+            warm_pool.DEFAULT_SHAPES
+        )
+
+    def test_persistent_cache_dir(self, tmp_path):
+        from karpenter_tpu.solver import warm_pool
+
+        path = warm_pool.enable_persistent_cache(
+            cache_dir=str(tmp_path), force=True
+        )
+        assert path is not None and path.startswith(str(tmp_path))
+        import os
+
+        assert os.path.isdir(path)

@@ -72,6 +72,55 @@ def test_scheduling_throughput_floor(n_pods):
     assert rate >= MIN_PODS_PER_SEC, f"{rate:.0f} pods/s below floor"
 
 
+def test_incremental_churn_tick_beats_full_resolve():
+    """Steady-state guard for the warm-start pipeline (small-scale
+    analogue of bench.py's steady_state_churn acceptance): with the
+    retained fleet as the warm start, a 1% churn tick must be cheaper
+    than re-solving the whole population — while placing exactly as
+    many pods as the full solve and pricing the fleet identically to
+    its own adopted baseline plus the patch."""
+    from karpenter_tpu.solver.incremental import IncrementalPipeline
+
+    pools = [(mk_nodepool("default"), instance_types(50))]
+    pods = diverse_pods(2000)
+    pipe = IncrementalPipeline(full_every=0, repack_objective="ffd")
+    pipe.solve_tick(pods, pools, objective="ffd")  # adopt + compile full
+    solve(pods, pools, objective="ffd")            # warm the full path
+
+    def churn(pods, tag):
+        k = max(1, len(pods) // 100)
+        kept = pods[k:]
+        born = diverse_pods(k)
+        for i, p in enumerate(born):
+            p.metadata.name = f"churn-{tag}-{i}"
+        return kept + born
+
+    # warm the incremental repack's shape buckets out of the timed
+    # region — THREE churn ticks, like bench.py's scenario: the
+    # repack's (group, bound-row) buckets wander a boundary as the
+    # fleet drifts, and a boundary crossed only by the timed tick
+    # would put an XLA compile inside the measurement
+    for t in range(3):
+        pods = churn(pods, f"w{t}")
+        pipe.solve_tick(pods, pools, objective="ffd")
+
+    pods = churn(pods, "timed")
+    t0 = time.perf_counter()
+    inc = pipe.solve_tick(pods, pools, objective="ffd")
+    inc_wall = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    full = solve(pods, pools, objective="ffd")
+    full_wall = time.perf_counter() - t0
+
+    assert inc.mode == "incremental"
+    assert inc.unschedulable == len(full.unschedulable)
+    assert inc.scheduled == len(pods) - len(full.unschedulable)
+    assert inc_wall < full_wall, (
+        f"incremental 1% churn tick ({inc_wall * 1000:.0f}ms) must beat "
+        f"the full re-solve ({full_wall * 1000:.0f}ms)"
+    )
+
+
 @pytest.mark.parametrize(
     "n_nodes",
     [
