@@ -491,11 +491,16 @@ def scenario_consolidation() -> dict:
         pods_on.append(kept)
     fleet_before = float(sum(prices))
     n_nodes_before = len(nodes)
+    # identical starting state for the batched-probe arm (c)
+    nodes0 = list(nodes)
+    prices0 = list(prices)
+    pods_on0 = [list(ps) for ps in pods_on]
 
     # (a) reference-style loop to convergence
     t0 = time.perf_counter()
     cycles = 0
     fresh_counter = [0]
+    decisions_a = []
     while cycles < 12:
         cycles += 1
         # emptiness (disruption/emptiness.go)
@@ -531,7 +536,8 @@ def scenario_consolidation() -> dict:
                 hi = mid - 1
         if best is None:
             break
-        n_star, _, sol = best
+        n_star, saving, sol = best
+        decisions_a.append((n_star, round(saving, 6)))
         cand = set(candidates[:n_star])
         rest_index = [i for i in range(len(nodes)) if i not in cand]
         new_nodes = [nodes[i] for i in rest_index]
@@ -570,7 +576,155 @@ def scenario_consolidation() -> dict:
     repack_wall = time.perf_counter() - t0
     after_global = float(target.total_price)
 
+    # (c) batched probe ladder: the SAME reference convergence loop,
+    # but each cycle's entire prefix ladder is evaluated as lanes of
+    # one vmapped device solve over one shared fleet encoding
+    # (solver/consolidation_batch.LaneSolver); the binary search then
+    # consults the lane verdicts, so the decisions must be IDENTICAL
+    # to (a) — asserted below — while the per-cycle probe cost drops
+    # from O(probes) snapshots+encodes+solves to one.
+    from karpenter_tpu.solver.consolidation_batch import LaneSolver, ProbeLane
+    from karpenter_tpu.solver.incremental import EncodedCache
+
+    nodes, prices, pods_on = (
+        list(nodes0), list(prices0), [list(ps) for ps in pods_on0]
+    )
+    probe_cache = EncodedCache()
+    # warm the probe kernel's shape buckets out of the timed region
+    # (the persistent compile cache / warm pool does this in
+    # production; every other scenario warms the same way)
+    warm_candidates = sorted(
+        range(len(nodes)), key=lambda i: (len(pods_on[i]), i)
+    )[:100]
+    warm_lanes = [
+        ProbeLane(
+            exclude_names=tuple(nodes[i].name for i in warm_candidates[:n]),
+            pods=[p for i in warm_candidates[:n] for p in pods_on[i]],
+        )
+        for n in range(1, len(warm_candidates) + 1)
+    ]
+    warm_solver = LaneSolver(pools, nodes, compat_cache=probe_cache)
+    warm_thunks = warm_solver.solve_lazy(warm_lanes)
+    # a spread of lane sizes covers every level-coupled shape the
+    # binary searches will touch, so no XLA compile lands in the
+    # timed region (production gets the same from the warm pool +
+    # persistent compile cache)
+    n_warm = len(warm_thunks)
+    for wi in {0, n_warm // 3, (2 * n_warm) // 3, n_warm - 1}:
+        warm_thunks[wi]()
+    # the fleet only shrinks cycle to cycle: pinning every cycle onto
+    # the first staging's padded shapes means the warm compile above
+    # covers the whole convergence loop (zero recompiles in the timed
+    # region, matching how the warm pool serves production)
+    shape_floors = dict(warm_solver.last_shapes)
+    t0 = time.perf_counter()
+    cycles_b = 0
+    lanes_total = 0
+    probe_wall = 0.0
+    decisions_b = []
+    while cycles_b < 12:
+        cycles_b += 1
+        occupied = [i for i, ps in enumerate(pods_on) if ps]
+        nodes = [nodes[i] for i in occupied]
+        prices = [prices[i] for i in occupied]
+        pods_on = [pods_on[i] for i in occupied]
+        candidates = sorted(
+            range(len(nodes)), key=lambda i: (len(pods_on[i]), i)
+        )[:100]
+        lanes = [
+            ProbeLane(
+                exclude_names=tuple(nodes[i].name for i in candidates[:n]),
+                pods=[p for i in candidates[:n] for p in pods_on[i]],
+            )
+            for n in range(1, len(candidates) + 1)
+        ]
+        t1 = time.perf_counter()
+        verdicts = LaneSolver(
+            pools, nodes, compat_cache=probe_cache,
+            shape_floors=shape_floors,
+        ).solve_lazy(lanes)
+        probe_wall += time.perf_counter() - t1
+        lanes_total += len(lanes)
+
+        def prefix_try_batched(n):
+            t2 = time.perf_counter()
+            sol = verdicts[n - 1]()
+            nonlocal_probe[0] += time.perf_counter() - t2
+            if sol.unschedulable or len(sol.new_nodes) > 1:
+                return None
+            removed = sum(prices[i] for i in candidates[:n])
+            added = sum(x.price for x in sol.new_nodes)
+            if removed <= added:
+                return None
+            return removed - added, sol
+
+        nonlocal_probe = [0.0]
+        lo, hi, best = 1, len(candidates), None
+        while lo <= hi:
+            mid = (lo + hi) // 2
+            out = prefix_try_batched(mid)
+            if out is not None:
+                best = (mid, out[0], out[1])
+                lo = mid + 1
+            else:
+                hi = mid - 1
+        probe_wall += nonlocal_probe[0]
+        if best is None:
+            break
+        n_star, saving, sol = best
+        decisions_b.append((n_star, round(saving, 6)))
+        cand = set(candidates[:n_star])
+        rest_index = [i for i in range(len(nodes)) if i not in cand]
+        pos = {full: j for j, full in enumerate(rest_index)}
+        new_nodes = [nodes[i] for i in rest_index]
+        new_prices = [prices[i] for i in rest_index]
+        new_pods_on = [list(pods_on[i]) for i in rest_index]
+        for ea in sol.existing:
+            # lane assignments index the FULL fleet encoding; map onto
+            # the retained list (masked-out rows can never hold pods)
+            j = pos[ea.existing_index]
+            new_pods_on[j] = new_pods_on[j] + ea.pods
+            used = resutil.requests_for_pods(ea.pods)
+            new_nodes[j] = ExistingNodeInput(
+                name=new_nodes[j].name,
+                requirements=new_nodes[j].requirements,
+                taints=new_nodes[j].taints,
+                available={
+                    k: max(0.0, v - used.get(k, 0.0))
+                    for k, v in new_nodes[j].available.items()
+                },
+                pool_name=new_nodes[j].pool_name,
+                pod_count=new_nodes[j].pod_count + len(ea.pods),
+            )
+        for plan in sol.new_nodes:
+            fresh_counter[0] += 1
+            new_nodes.append(
+                node_input(f"b-{fresh_counter[0]}", plan.instance_types[0],
+                           plan.offerings[0], plan.pool, plan.pods)
+            )
+            new_prices.append(plan.price)
+            new_pods_on.append(list(plan.pods))
+        nodes, prices, pods_on = new_nodes, new_prices, new_pods_on
+    batched_wall = time.perf_counter() - t0
+    after_batched = float(sum(prices))
+    eps = 1e-6 + 1e-4 * abs(after_reference)
+    decisions_identical = (
+        decisions_a == decisions_b
+        and abs(after_batched - after_reference) < eps
+    )
+
     return {
+        "batched_probe_wall_s": round(batched_wall, 3),
+        "batched_probe_solve_s": round(probe_wall, 3),
+        "batched_cycles": cycles_b,
+        "batched_converged_price": round(after_batched, 2),
+        "probe_lanes": lanes_total,
+        "probes_per_sec": round(lanes_total / probe_wall, 1)
+        if probe_wall > 0 else 0.0,
+        "batched_vs_reference_speedup": round(
+            reference_wall / batched_wall, 2
+        ) if batched_wall > 0 else 0.0,
+        "decisions_identical": decisions_identical,
         "nodes_before": n_nodes_before,
         "fleet_price_before": round(fleet_before, 2),
         "reference_converged_price": round(after_reference, 2),

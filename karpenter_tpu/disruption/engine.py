@@ -18,7 +18,9 @@ O(log N) sequential Go scheduling loops.
 from __future__ import annotations
 
 import logging
+import os
 import random
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Optional, Sequence
@@ -49,6 +51,7 @@ from karpenter_tpu.cloudprovider.types import CloudProvider
 from karpenter_tpu.kube.client import KubeClient
 from karpenter_tpu.metrics.store import (
     DISRUPTION_EVALUATION_DURATION,
+    DISRUPTION_PROBE_STARVATION,
     NODECLAIMS_DISRUPTED,
 )
 from karpenter_tpu.kube.objects import Pod
@@ -153,9 +156,65 @@ class DisruptionEngine:
         self._rng = random.Random(seed)
         # per-round offering price index; reset by get_candidates
         self._price_index: dict[str, dict[tuple[str, str, str], float]] = {}
+        # batched-probe state: a thread-local probe cache — the search
+        # methods prime it, simulate_scheduling consults it
+        self._probe_tls = threading.local()
         from karpenter_tpu.disruption.validation import Validator
 
         self.queue.validator = Validator(self)
+
+    # -- batched probes (solver/consolidation_batch.py) ------------------------
+
+    def _get_probe_cache(self) -> Optional[dict]:
+        return getattr(self._probe_tls, "cache", None)
+
+    def _set_probe_cache(self, value: Optional[dict]) -> None:
+        self._probe_tls.cache = value
+
+    def batch_probes_enabled(self) -> bool:
+        return os.environ.get(
+            "KARPENTER_BATCH_PROBES", "1"
+        ).lower() not in ("0", "false", "off")
+
+    def _probe_solver(self):
+        """A fresh shared-snapshot BatchProbeSolver per SEARCH METHOD
+        (not per reconcile round): watch events land on the cluster
+        mirror concurrently, so a snapshot shared across methods could
+        serve drift-era verdicts to the single-node scan. One snapshot
+        per ladder keeps freshness within the same window a sequential
+        scan has, while still amortizing deep_copy_nodes()/Scheduler/
+        encode across every probe of that ladder."""
+        return self._build_probe_solver()
+
+    def _build_probe_solver(self):
+        if not self.batch_probes_enabled():
+            return None
+        # the sequential probe aborts per-call while capacity is still
+        # materializing; skipping the batch reproduces that verdict
+        # through the unchanged sequential path
+        if self.has_uninitialized_capacity():
+            return None
+        from karpenter_tpu.solver.consolidation_batch import BatchProbeSolver
+
+        try:
+            solver = BatchProbeSolver(
+                pools_with_types=self.provisioner.ready_pools_with_types(),
+                snapshot=self.cluster.deep_copy_nodes(),
+                daemonsets=self.cluster.daemonsets(),
+                cluster_pods=self.kube.pods(),
+                pending_pods=self.provisioner.get_pending_pods(),
+                options=self.options,
+                kube=self.kube,
+                clock=self.clock,
+                compat_cache=self.provisioner.encode_cache,
+            )
+        except Exception:
+            log.exception("probe batch setup failed; probing sequentially")
+            return None
+        return solver if solver.usable() else None
+
+    def _probe_primer(self, lane_specs: list) -> "_ProbePrimer":
+        return _ProbePrimer(self, lane_specs)
 
     # -- candidates (helpers.go:174-193) ---------------------------------------
 
@@ -369,7 +428,33 @@ class DisruptionEngine:
         (results, all_pods_scheduled). `include_pending=False` solves
         the candidates' pods alone — execution-time validation uses it
         so an unrelated pending pod forcing a new node can't be
-        mistaken for the command going stale."""
+        mistaken for the command going stale.
+
+        The snapshot-once/probe-many path: while a search method has a
+        primed probe cache active (multi-node's prefix ladder, the
+        single-node rotation, drift's ranked scan — all evaluated as
+        lanes of ONE batched device solve against ONE shared
+        `deep_copy_nodes()` snapshot), a probe for a cached candidate
+        subset is a dict lookup; only cache misses (lanes the batch
+        could not reproduce exactly) pay the per-probe deep copy +
+        Scheduler below."""
+        cache = self._get_probe_cache()
+        if cache is not None and objective == "ffd" and include_pending:
+            thunk = cache.get(frozenset(c.state_node.name for c in candidates))
+            # capacity that started materializing AFTER the batch's
+            # snapshot must abort a cached probe exactly as the
+            # sequential path's per-probe guard would — the check is a
+            # cheap live-state scan, so cached verdicts keep the same
+            # uninitialized-node semantics as fresh ones
+            if thunk is not None and not self.has_uninitialized_capacity():
+                # lazily decoded: the batch shipped every lane in one
+                # device fetch, but per-lane decode runs only for the
+                # subsets the search actually consults. A lane that
+                # decodes to None needed sequential-only machinery —
+                # fall through to the per-probe path below.
+                hit = thunk()
+                if hit is not None:
+                    return hit
         deleting_names = {c.state_node.name for c in candidates}
         snapshot = []
         for node in self.cluster.deep_copy_nodes():
@@ -519,7 +604,9 @@ class DisruptionEngine:
         return Command(reason=REASON_EMPTY, candidates=allowed)
 
     def drift(self, now: float) -> Optional[Command]:
-        """Replace drifted nodes (drift.go:55-115); one at a time."""
+        """Replace drifted nodes (drift.go:55-115); one at a time. The
+        ranked candidates are simulated as lanes of one batched probe
+        solve; the scan below consults the primed verdicts in order."""
         candidates = self.get_candidates(REASON_DRIFTED, now)
         if not candidates:
             return None
@@ -527,12 +614,18 @@ class DisruptionEngine:
         allowed = self._budget_filter(candidates, budgets)
         # empty drifted nodes first (no disruption at all)
         allowed.sort(key=lambda c: (len(c.reschedulable_pods), -c.disruption_cost))
-        for candidate in allowed:
-            results, ok = self.simulate_scheduling([candidate])
-            if ok:
-                return Command(reason=REASON_DRIFTED, candidates=[candidate],
-                               results=results)
-        return None
+        primer = self._probe_primer([[c] for c in allowed])
+        self._set_probe_cache({})
+        try:
+            for candidate in allowed:
+                primer.ensure([candidate])
+                results, ok = self.simulate_scheduling([candidate])
+                if ok:
+                    return Command(reason=REASON_DRIFTED, candidates=[candidate],
+                                   results=results)
+            return None
+        finally:
+            self._set_probe_cache(None)
 
     def global_repack_consolidation(self, now: float) -> Optional[Command]:
         """One cost-objective re-solve of the whole candidate set — the
@@ -621,7 +714,13 @@ class DisruptionEngine:
 
     def multi_node_consolidation(self, now: float) -> Optional[Command]:
         """Binary search the largest prefix replaceable by <=1 node
-        (multinodeconsolidation.go:51-225)."""
+        (multinodeconsolidation.go:51-225). The WHOLE prefix ladder is
+        submitted up front as lanes of one batched device solve (one
+        shared snapshot, one encode); the search below then consults
+        the primed verdicts, so its control flow — full-prefix probe,
+        binary search, non-monotone sweep, wall-clock bound — is
+        unchanged while each probe costs a dict lookup instead of a
+        snapshot + Scheduler + solve."""
         candidates = self.get_candidates(REASON_UNDERUTILIZED, now)
         candidates.sort(key=lambda c: c.disruption_cost)
         budgets = self.budget_mapping(REASON_UNDERUTILIZED, now)
@@ -632,70 +731,121 @@ class DisruptionEngine:
         # minimum prefix is 2: single-node consolidation handles the rest
         # (multinodeconsolidation.go:118-121)
         deadline = self.clock() + MULTI_NODE_TIMEOUT_SECONDS
+        primer = self._probe_primer(
+            [candidates[:n] for n in range(2, len(candidates) + 1)]
+        )
+        self._set_probe_cache({})
+        try:
+            primer.prime_all()
+            best = self._multi_node_search(candidates, deadline)
+        finally:
+            self._set_probe_cache(None)
+        if best is not None and len(best.candidates) >= 2:
+            if not self._same_type_guard(best):
+                return None
+            return best
+        return None
+
+    def _multi_node_search(self, candidates: list[Candidate],
+                           deadline: float) -> Optional[Command]:
         # The valid-prefix set is NOT monotone: replacing 2 small nodes
         # can cost more than their price while replacing all 3 is
         # cheaper (the shared replacement amortizes). The reference's
         # binary search assumes monotonicity and misses such merges;
-        # each probe here is one batched device solve, so we probe the
-        # FULL prefix first (the largest possible saving), fall back to
-        # the reference-style binary search, and finish with a
-        # descending sweep over prefixes neither covered — all under
-        # the method's wall-clock bound.
+        # probe the FULL prefix first (the largest possible saving),
+        # fall back to the reference-style binary search, and finish
+        # with a descending sweep over prefixes neither covered — all
+        # under the method's wall-clock bound.
         best = self.compute_consolidation(candidates)
-        if best is None:
-            lo, hi = 2, len(candidates) - 1
-            probed = set()
-            timed_out = False
-            while lo <= hi:
-                if self.clock() > deadline:
-                    log.warning("multi-node consolidation timed out; "
-                                "keeping best command so far")
-                    timed_out = True
+        if best is not None:
+            return best
+        lo, hi = 2, len(candidates) - 1
+        probed = set()
+        timed_out = False
+        while lo <= hi:
+            if self.clock() > deadline:
+                log.warning("multi-node consolidation timed out; "
+                            "keeping best command so far")
+                self._starved("multi_node_consolidation", len(probed) + 1,
+                              hi - lo + 1)
+                timed_out = True
+                break
+            mid = (lo + hi) // 2
+            probed.add(mid)
+            cmd = self.compute_consolidation(candidates[:mid])
+            if cmd is not None:
+                best = cmd
+                lo = mid + 1
+            else:
+                hi = mid - 1
+        # descending sweep over every prefix LARGER than what the
+        # binary search settled on: under non-monotonicity a bigger
+        # (more saving) merge can hide above a failing midpoint
+        best_n = len(best.candidates) if best is not None else 1
+        if not timed_out:
+            sweeps = 0
+            for n in range(len(candidates) - 1, best_n, -1):
+                if n in probed:
+                    continue
+                if sweeps >= MULTI_NODE_SWEEP_PROBES:
                     break
-                mid = (lo + hi) // 2
-                probed.add(mid)
-                cmd = self.compute_consolidation(candidates[:mid])
+                if self.clock() > deadline:
+                    log.warning("multi-node consolidation timed out "
+                                "during prefix sweep; keeping best")
+                    self._starved("multi_node_consolidation",
+                                  len(probed) + 1 + sweeps,
+                                  MULTI_NODE_SWEEP_PROBES - sweeps)
+                    break
+                sweeps += 1
+                cmd = self.compute_consolidation(candidates[:n])
                 if cmd is not None:
                     best = cmd
-                    lo = mid + 1
-                else:
-                    hi = mid - 1
-            # descending sweep over every prefix LARGER than what the
-            # binary search settled on: under non-monotonicity a bigger
-            # (more saving) merge can hide above a failing midpoint
-            best_n = len(best.candidates) if best is not None else 1
-            if not timed_out:
-                sweeps = 0
-                for n in range(len(candidates) - 1, best_n, -1):
-                    if n in probed:
-                        continue
-                    if sweeps >= MULTI_NODE_SWEEP_PROBES:
-                        break
-                    if self.clock() > deadline:
-                        log.warning("multi-node consolidation timed out "
-                                    "during prefix sweep; keeping best")
-                        break
-                    sweeps += 1
-                    cmd = self.compute_consolidation(candidates[:n])
-                    if cmd is not None:
-                        best = cmd
-                        break
-        if best is not None and len(best.candidates) >= 2:
-            # same-instance-type guard (multinodeconsolidation.go:171-225):
-            # don't churn N nodes into one identical node without savings
-            if best.results and best.results.new_node_plans:
-                plan = best.results.new_node_plans[0]
-                names = {c.instance_type_name for c in best.candidates}
-                if len(names) == 1 and plan.instance_types and (
-                    plan.instance_types[0].name in names
-                ):
-                    return None
-            return best
-        return None
+                    break
+        return best
+
+    def _same_type_guard(self, best: Command) -> bool:
+        """Same-instance-type anti-churn (multinodeconsolidation.go:
+        171-225): N nodes of one type must never churn into one node
+        of that same type without savings. Judged over the FULL
+        surviving option set, not just the first type: a plan whose
+        first type differs but whose only launchable offerings belong
+        to the candidates' own type would otherwise slip through.
+        Mirroring the reference's filterOutSameOrInvalidType, the
+        candidates' type is filtered OUT of the replacement options;
+        the command survives only if a genuinely different type can
+        still launch. Returns False to drop the command."""
+        if not best.results or not best.results.new_node_plans:
+            return True
+        plan = best.results.new_node_plans[0]
+        names = {c.instance_type_name for c in best.candidates}
+        if len(names) != 1 or not plan.instance_types:
+            return True
+        keep = [it for it in plan.instance_types if it.name not in names]
+        offerings = [
+            o for o in plan.offerings
+            if any(o in it.offerings for it in keep)
+        ]
+        if not keep or not offerings:
+            return False
+        plan.instance_types = keep
+        plan.offerings = offerings
+        plan.price = min(o.price for o in offerings)
+        return True
+
+    def _starved(self, method: str, attempted: int, remaining: int) -> None:
+        DISRUPTION_PROBE_STARVATION.inc(
+            {"method": method, "count": "attempted"}, value=float(attempted)
+        )
+        DISRUPTION_PROBE_STARVATION.inc(
+            {"method": method, "count": "remaining"}, value=float(remaining)
+        )
 
     def single_node_consolidation(self, now: float) -> Optional[Command]:
         """Try candidates one at a time, round-robining nodepools
-        (singlenodeconsolidation.go:56-160)."""
+        (singlenodeconsolidation.go:56-160). The rotation's visitation
+        order is replayed up front so a full budget-allowed round of
+        probes can be primed as lanes of one batched solve; the loop
+        below then consults the primed verdicts in the same order."""
         candidates = self.get_candidates(REASON_UNDERUTILIZED, now)
         by_pool: dict[str, list[Candidate]] = {}
         for c in candidates:
@@ -712,21 +862,44 @@ class DisruptionEngine:
             return None
         idx = 0
         remaining = {p: list(by_pool[p]) for p in pools}
+        # materialize the rotation's pop order (a pure replay of the
+        # loop below) so the primer batches probes in visitation order
+        order: list[Candidate] = []
+        sim = {p: list(remaining[p]) for p in pools}
+        j = 0
+        while any(sim.values()):
+            pool = pools[j % len(pools)]
+            j += 1
+            if sim[pool]:
+                order.append(sim[pool].pop())
+        primer = self._probe_primer([[c] for c in order])
+        self._set_probe_cache({})
+        attempted = 0
         deadline = self.clock() + SINGLE_NODE_TIMEOUT_SECONDS
-        while any(remaining.values()):
-            if self.clock() > deadline:
-                log.warning("single-node consolidation timed out after "
-                            "%d candidates", idx)
-                return None
-            pool = pools[idx % len(pools)]
-            idx += 1
-            if not remaining[pool]:
-                continue
-            candidate = remaining[pool].pop()
-            cmd = self.compute_consolidation([candidate])
-            if cmd is not None:
-                return cmd
-        return None
+        try:
+            while any(remaining.values()):
+                if self.clock() > deadline:
+                    left = sum(len(v) for v in remaining.values())
+                    log.warning("single-node consolidation timed out after "
+                                "%d candidates (%d unprobed)", idx, left)
+                    # budget-starvation visibility: how far the scan got
+                    # vs how much it silently dropped
+                    self._starved("single_node_consolidation", attempted,
+                                  left)
+                    return None
+                pool = pools[idx % len(pools)]
+                idx += 1
+                if not remaining[pool]:
+                    continue
+                candidate = remaining[pool].pop()
+                primer.ensure([candidate])
+                attempted += 1
+                cmd = self.compute_consolidation([candidate])
+                if cmd is not None:
+                    return cmd
+            return None
+        finally:
+            self._set_probe_cache(None)
 
     # -- controller loop (controller.go:121-176) -------------------------------
 
@@ -790,6 +963,60 @@ class DisruptionEngine:
                         COND_DISRUPTION_REASON
                     )
                 node.marked_for_deletion = False
+
+
+class _ProbePrimer:
+    """Feeds a search method's candidate subsets to the batched probe
+    solver, filling the engine's probe cache with lazy verdicts. The
+    whole spec list primes in ONE call — priming only stages the
+    shared problem (one snapshot, one encode); device dispatch and
+    decode happen lane by lane as the search consults its probes, so
+    offering every subset up front costs nothing extra. Lanes the
+    batch cannot reproduce exactly are simply left out of the cache
+    (or decode to None later), and the caller's unchanged
+    `compute_consolidation` / `simulate_scheduling` probe runs
+    sequentially for exactly those.
+
+    The BatchProbeSolver (and its deep-copied snapshot) is acquired
+    lazily on the first ensure/prime_all — a search that never probes
+    (no candidates, early return) never pays for it.
+    """
+
+    def __init__(self, engine: DisruptionEngine, lane_specs: list):
+        self.engine = engine
+        self.specs = list(lane_specs)
+        self.primed = False
+        self.dead = not self.specs or not engine.batch_probes_enabled()
+
+    @staticmethod
+    def _key(spec) -> frozenset:
+        return frozenset(c.state_node.name for c in spec)
+
+    def prime_all(self) -> None:
+        if self.dead or self.primed:
+            return
+        self.primed = True
+        solver = self.engine._probe_solver()
+        if solver is None:
+            self.dead = True
+            return
+        verdicts = solver.prime(self.specs)
+        if verdicts is None:
+            # the whole batch is outside the fast path (topology /
+            # host-ports / volume limits): probe sequentially
+            self.dead = True
+            return
+        cache = self.engine._get_probe_cache()
+        if cache is None:
+            return
+        for spec, verdict in zip(self.specs, verdicts):
+            if verdict is not None:
+                cache[self._key(spec)] = verdict
+
+    def ensure(self, spec) -> None:
+        """Make sure `spec`'s lane has been offered to the batch before
+        the caller probes it."""
+        self.prime_all()
 
 
 class OrchestrationQueue:

@@ -205,6 +205,16 @@ SOLVER_INCREMENTAL_TICKS = REGISTRY.counter(
 SOLVER_WARM_COMPILES = REGISTRY.counter(
     "karpenter_solver_warm_compiles_total",
     "Kernel shape buckets AOT-compiled by the warm pool, by outcome")
+SOLVER_PROBE_BATCH = REGISTRY.counter(
+    "karpenter_solver_probe_batch_total",
+    "Batched consolidation probe activity: device dispatches (batch), "
+    "lanes evaluated (lane), node-axis regrow retries (capped_retry), "
+    "and lanes handed back to the sequential path (fallback_lane)")
+DISRUPTION_PROBE_STARVATION = REGISTRY.counter(
+    "karpenter_disruption_probe_starvation_total",
+    "Consolidation probes attempted vs still remaining when a method's "
+    "wall-clock budget expired, by method — a growing 'remaining' "
+    "series means the disruption budget is starving the scan")
 
 
 class Store:

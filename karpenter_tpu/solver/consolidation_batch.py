@@ -1,0 +1,752 @@
+"""Batched consolidation probe solver: candidate subsets as lanes of
+one device solve.
+
+BENCH_r05 showed `consolidation_500` burning ~33s because every probe
+of the disruption engine's searches — each binary-search prefix of
+`multi_node_consolidation`, each pool-rotation candidate of
+`single_node_consolidation`, each ranked candidate of `drift` — paid a
+full `deep_copy_nodes()` snapshot, a fresh Scheduler, a fresh encode
+(including the per-node pseudo-config compat columns, the dominant
+host cost), and an independent kernel dispatch. CvxCluster (PAPERS.md)
+gets its orders-of-magnitude by batching many small allocation
+problems into one solver call; the probes have exactly that shape:
+
+- every probe shares ONE cluster snapshot and ONE catalog — only the
+  *masked-out node subset* and the *pods to repack* differ;
+- a probe's pods are always a subset of the union of all probes' pods,
+  so one `group_pods` + `encode` over the union covers every lane
+  (groups a lane doesn't use carry count 0 and are exact no-ops in the
+  packing kernel — `remaining=0` never places or opens);
+- a probe's retained fleet is the full bound-row block with the
+  candidate rows' `bound_live` bits cleared — dead rows contribute
+  capacity 0 to the prefix fill, so the live rows keep both their
+  relative order and their exact per-row arithmetic.
+
+Two layers:
+
+1. **LaneSolver** — the encode-once core. Takes (pools, existing
+   inputs) once, then `solve(lanes)` stages the shared arrays exactly
+   like `pack._run_pack` (same padding buckets, so the warm pool can
+   AOT-compile probe shapes) and dispatches `pack_probe_lanes_flat`
+   (pack_split vmapped over the lane axis) in chunks of
+   `KARPENTER_PROBE_BATCH_WIDTH`. Each lane decodes through the same
+   `_build_solution_arrays` path a sequential solve uses, against a
+   per-lane view of the Encoded whose groups hold that lane's own
+   pods — so per-lane Solutions are bit-identical to solving the
+   subset problem alone (the oracle test asserts this for both pack
+   objectives).
+
+2. **BatchProbeSolver** — the DisruptionEngine wrapper that makes a
+   lane equal to one `simulate_scheduling(candidates)` call: it builds
+   ONE Scheduler over the full snapshot (existing inputs, daemon
+   overhead, reservation usage, minValues pool filtering — all paid
+   once per reconcile round instead of once per probe), injects volume
+   topology the way `Scheduler._solve` does, and converts each lane
+   Solution into a SchedulerResults with the same minValues
+   enforcement and instance-type finalization. Anything the batched
+   fast path cannot reproduce exactly falls back to the sequential
+   probe: topology-constrained / host-port / volume-limited pods and
+   reservation-holding candidates gate the whole batch; lanes whose
+   solve k-way-evicted pods or left relaxable pods unscheduled gate
+   just that lane (the engine's probe cache simply has no entry, and
+   `simulate_scheduling` runs as before).
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field, replace
+from typing import Optional, Sequence
+
+import numpy as np
+
+from karpenter_tpu.kube.objects import Pod
+from karpenter_tpu.metrics.store import SOLVER_PROBE_BATCH
+from karpenter_tpu.solver.encode import (
+    Encoded,
+    ExistingNodeInput,
+    PodGroup,
+    encode,
+    group_pods,
+)
+from karpenter_tpu.solver.solver import Solution, _build_solution_arrays
+
+log = logging.getLogger("karpenter.solver.probes")
+
+
+def _pow2(n: int, base: int) -> int:
+    out = base
+    while out < n:
+        out *= 2
+    return out
+
+
+@dataclass
+class ProbeLane:
+    """One candidate subset to evaluate: mask these nodes out, repack
+    these pods against what remains."""
+
+    exclude_names: tuple[str, ...]
+    pods: list[Pod] = field(default_factory=list)
+
+
+class LaneSolver:
+    """Encode-once, mask-per-lane probe driver over one fleet state.
+
+    `existing_inputs` is the FULL fleet (candidates included); each
+    lane names the nodes it removes. `pending` pods (shared backlog)
+    join every lane's demand, exactly as `simulate_scheduling` adds
+    them to every sequential probe.
+    """
+
+    def __init__(
+        self,
+        pools_with_types,
+        existing_inputs: Sequence[ExistingNodeInput],
+        daemon_overhead: Optional[dict] = None,
+        reserved_in_use: Optional[dict[str, int]] = None,
+        pending: Sequence[Pod] = (),
+        compat_cache=None,
+        shape_floors: Optional[dict[str, int]] = None,
+    ):
+        self.pools = list(pools_with_types)
+        self.inputs = list(existing_inputs)
+        self.daemon_overhead = daemon_overhead
+        self.reserved_in_use = dict(reserved_in_use or {})
+        self.pending = list(pending)
+        self.compat_cache = compat_cache
+        # padded-axis floors ({"G","C","E","F"}): a caller probing a
+        # SHRINKING fleet round after round (the consolidation
+        # convergence loop) pins later rounds onto the first round's
+        # compiled shapes — padding is semantically inert (zero-count
+        # groups, all-zero config columns, dead bound rows), so this
+        # trades a little wasted arithmetic for zero recompiles
+        self.shape_floors = dict(shape_floors or {})
+        # the padded shapes of the last staging, for chaining floors
+        self.last_shapes: dict[str, int] = {}
+        self._idx = {inp.name: i for i, inp in enumerate(self.inputs)}
+
+    def knows(self, name: str) -> bool:
+        return name in self._idx
+
+    # -- solve ----------------------------------------------------------------
+
+    def solve(self, lanes: Sequence[ProbeLane], mode: str = "ffd") -> list[Solution]:
+        """Per-lane Solutions, index-aligned with `lanes` (eagerly
+        decoded — see solve_lazy for the probe-search entry)."""
+        return [thunk() for thunk in self.solve_lazy(lanes, mode=mode)]
+
+    def solve_lazy(self, lanes: Sequence[ProbeLane], mode: str = "ffd"):
+        """Stage the whole lane batch eagerly (one encode, one set of
+        padded device arrays shared by every lane) and return per-lane
+        zero-arg thunks; DEVICE dispatch happens lazily per chunk of
+        `probe_batch_width()` lanes when a lane in that chunk is first
+        consulted, and decode lazily per lane. A prefix-ladder search
+        consults only O(log n) of its n primed lanes — lazy dispatch
+        keeps kernel AND decode cost proportional to probes actually
+        consulted, not lanes shipped, while the staging amortization
+        covers them all. Width 1 (the CPU default) dispatches the
+        plain `pack_split_flat` kernel per consulted lane — identical
+        layout, no lane axis, one compiled shape reused across every
+        probe of the search. Existing assignments index into THIS
+        solver's `existing_inputs` (the full fleet), never a
+        lane-local subset."""
+        import jax.numpy as jnp
+
+        from karpenter_tpu.solver.pack import (
+            _bucket,
+            _lane_bucket,
+            _pad_axis,
+            pack_probe_lanes_flat,
+            probe_batch_width,
+        )
+
+        lane_pod_lists = [list(lane.pods) + self.pending for lane in lanes]
+        union: dict[str, Pod] = {}
+        for pods in lane_pod_lists:
+            for p in pods:
+                union.setdefault(p.key, p)
+        if not union:
+            # nothing to place anywhere: every lane trivially succeeds
+            return [
+                lambda: Solution(new_nodes=[], existing=[], unschedulable=[])
+                for _ in lanes
+            ]
+        groups = group_pods(list(union.values()))
+        gi_by_key = {
+            p.key: gi for gi, g in enumerate(groups) for p in g.pods
+        }
+        enc = encode(
+            groups,
+            self.pools,
+            self.inputs,
+            self.daemon_overhead,
+            reserved_in_use=self.reserved_in_use,
+            compat_cache=self.compat_cache,
+        )
+
+        # the staging below intentionally omits the bound_quota /
+        # group_cap forwarding pack._run_pack does — probe-path encodes
+        # never produce them (they exist only on the topology-lowered
+        # path, which the probe gates route sequentially). If that
+        # assumption ever breaks, fail loudly rather than silently
+        # diverging from the sequential oracle.
+        assert enc.existing_quota is None and enc.group_cap is None, (
+            "probe staging does not forward existing_quota/group_cap; "
+            "route this solve through the sequential path"
+        )
+        G, C = enc.compat.shape
+        R = enc.group_req.shape[1]
+        E = enc.n_existing
+        L = len(lanes)
+
+        # per-lane demand over the UNPADDED axes (lane group pod lists
+        # materialize lazily at decode)
+        counts = np.zeros((L, G), np.int32)
+        for li, pods in enumerate(lane_pod_lists):
+            for p in pods:
+                counts[li, gi_by_key[p.key]] += 1
+        base_live = np.zeros((E,), bool)
+        bound_cfg_raw = np.full((E,), -1, np.int32)
+        for ci, cfg in enumerate(enc.configs):
+            if cfg.existing_index >= 0:
+                bound_cfg_raw[cfg.existing_index] = ci
+        base_live[:] = bound_cfg_raw >= 0
+        live = np.repeat(base_live[None, :], max(L, 1), axis=0)
+        for li, lane in enumerate(lanes):
+            for name in lane.exclude_names:
+                live[li, self._idx[name]] = False
+
+        # -- shared staging, mirroring pack._run_pack's padding exactly
+        # (then raised to any caller-pinned floors; see shape_floors)
+        Gp, Cp = _pad_axis(G), _pad_axis(C)
+        Cp = -(-Cp // 32) * 32
+        Ep = _pad_axis(E) if E else 0
+        Gp = max(Gp, self.shape_floors.get("G", 0))
+        Cp = -(-max(Cp, self.shape_floors.get("C", 0)) // 32) * 32
+        Ep = max(Ep, self.shape_floors.get("E", 0))
+
+        compat = np.zeros((Gp, Cp), bool)
+        compat[:G, :C] = enc.compat
+        group_req = np.zeros((Gp, R), np.float32)
+        group_req[:G] = enc.group_req
+        cfg_alloc = np.zeros((Cp, R), np.float32)
+        cfg_alloc[:C] = enc.cfg_alloc
+        cfg_pool = np.full((Cp,), -1, np.int32)
+        cfg_pool[:C] = enc.cfg_pool
+        cfg_price = np.zeros((Cp,), np.float32)
+        cfg_price[:C] = enc.cfg_price
+
+        bound_cfg = np.full((Ep,), -1, np.int32)
+        bound_cfg[:E] = bound_cfg_raw
+        bound_live_any = bound_cfg >= 0
+        safe_cfg = np.maximum(bound_cfg, 0)
+        bound_alloc = np.where(
+            bound_live_any[:, None], cfg_alloc[safe_cfg], 0.0
+        ).astype(np.float32)
+        bound_used0 = np.zeros((Ep, R), np.float32)
+        bound_compat = np.zeros((Gp, Ep), bool)
+        if Ep:
+            bound_compat[:, :] = compat[:, safe_cfg] & bound_live_any[None, :]
+
+        cfg_rsv_j = None
+        rsv_cap_j = None
+        K = 0
+        cfg_rsv_h = np.full((Cp,), -1, np.int32)
+        if enc.rsv_cap is not None and enc.rsv_cap.size:
+            K = int(enc.rsv_cap.size)
+            cfg_rsv_h[:C] = enc.cfg_rsv
+            cfg_rsv_j = jnp.asarray(cfg_rsv_h)
+            rsv_cap_j = jnp.asarray(enc.rsv_cap.astype(np.float32))
+        bound_slot = np.where(
+            bound_live_any & (cfg_rsv_h[safe_cfg] >= 0),
+            cfg_rsv_h[safe_cfg], K,
+        ).astype(np.int32)
+        conflict_j = None
+        if enc.conflict is not None and enc.conflict.any():
+            cf = np.zeros((Gp, Gp), bool)
+            cf[:G, :G] = enc.conflict
+            conflict_j = jnp.asarray(cf)
+
+        shared = (
+            jnp.asarray(compat),
+            jnp.asarray(group_req),
+            jnp.asarray(cfg_alloc),
+            jnp.asarray(cfg_pool),
+            jnp.asarray(enc.pool_overhead),
+            jnp.asarray(bound_compat),
+            jnp.asarray(bound_alloc),
+            jnp.asarray(bound_used0),
+            jnp.asarray(bound_slot),
+            jnp.asarray(cfg_price),
+        )
+
+        # fresh-axis estimate: per-group best single-node capacity once,
+        # then the max per-lane ceil-sum (same bound pack._estimate_nodes
+        # uses, per lane); capped first attempts regrow like
+        # solve_packing_async
+        launch = enc.cfg_pool >= 0
+        per_best = np.ones((G,))
+        for gi in range(G):
+            mask = enc.compat[gi] & launch
+            if not mask.any():
+                continue
+            req = enc.group_req[gi]
+            safe = np.where(req > 0, req, 1.0)
+            pn = np.floor((enc.cfg_alloc[mask] + 1e-4) / safe[None, :])
+            pn = np.where(req[None, :] > 0, pn, np.inf).min(axis=1)
+            per_best[gi] = max(1.0, float(pn.max()) if pn.size else 1.0)
+        lane_est = np.ceil(counts / per_best[None, :]).sum(axis=1)
+        lane_total = counts.sum(axis=1)
+        worst_case = int(lane_total.max()) if L else 0
+        F = _bucket(max(32, int(1.35 * float(lane_est.max() if L else 0)) + 16))
+        F = max(F, self.shape_floors.get("F", 0))
+        self.last_shapes = {"G": Gp, "C": Cp, "E": Ep, "F": F}
+
+        from karpenter_tpu.solver.pack import pack_split_flat
+
+        width = probe_batch_width()
+        # chunk index -> (flat [len(chunk), ...], F_used, Gp_used,
+        # rows-or-None): dispatched (and cap-regrown) on first
+        # consultation of any member lane
+        chunk_cache: dict[int, tuple] = {}
+
+        def dispatch(ci: int) -> tuple:
+            hit = chunk_cache.get(ci)
+            if hit is not None:
+                return hit
+            chunk = list(range(ci * width, min((ci + 1) * width, L)))
+            # counted once per chunk — cap-regrow retries re-dispatch
+            # (counted as batch + capped_retry) but don't re-ship lanes
+            SOLVER_PROBE_BATCH.inc(
+                {"outcome": "lane"}, value=float(len(chunk))
+            )
+            solo = len(chunk) == 1
+            if solo:
+                # solo fast path (the CPU default): the plain split
+                # kernel, no lane axis, with the group axis COMPACTED
+                # to this lane's nonzero groups and the fresh axis
+                # sized from this lane's own estimate — the dispatched
+                # program does exactly the work a sequential subset
+                # solve would (zero-count union groups cost full
+                # [F, C, R] sweeps otherwise), while the staging
+                # stays shared
+                li = chunk[0]
+                rows = np.flatnonzero(counts[li])
+                gsel = rows if rows.size else np.zeros((0,), np.int64)
+                # LEVEL-coupled power-of-two padding: solo probes
+                # compile one program per (G, F) shape combo, and the
+                # padded sweep is tens of ms where an XLA compile is
+                # ~1s — so both axes snap to ONE shared level k
+                # (G=16<<k, F=64<<k), collapsing the combo grid to its
+                # diagonal. A search's probes then touch at most a
+                # handful of compiled programs, all reusable across
+                # rounds while the fleet axes (pinned by shape_floors)
+                # hold still.
+                g_level = 0
+                while (16 << g_level) < max(int(gsel.size), 1):
+                    g_level += 1
+                f_req = max(32, int(1.35 * float(lane_est[li])) + 16)
+                f_level = 0
+                while (64 << f_level) < f_req:
+                    f_level += 1
+                k = max(g_level, f_level)
+                Gp_c = 16 << k
+                compat_c = np.zeros((Gp_c, Cp), bool)
+                compat_c[: gsel.size] = compat[gsel]
+                req_c = np.zeros((Gp_c, R), np.float32)
+                req_c[: gsel.size] = group_req[gsel]
+                counts_c = np.zeros((Gp_c,), np.int32)
+                counts_c[: gsel.size] = counts[li][gsel]
+                bcompat_c = np.zeros((Gp_c, Ep), bool)
+                bcompat_c[: gsel.size] = bound_compat[gsel]
+                conflict_c = None
+                if conflict_j is not None and gsel.size:
+                    cfc = np.zeros((Gp_c, Gp_c), bool)
+                    cfc[: gsel.size, : gsel.size] = (
+                        enc.conflict[np.ix_(gsel, gsel)]
+                        if enc.conflict is not None else False
+                    )
+                    conflict_c = jnp.asarray(cfc)
+                live_row = np.zeros((Ep,), bool)
+                live_row[:E] = live[li]
+                F_try = 64 << k
+                worst = int(lane_total[li])
+                Gp_used = Gp_c
+            else:
+                F_try = F
+                worst = int(lane_total[chunk].max())
+                Gp_used = Gp
+                gsel = None
+            while True:
+                N = Ep + F_try
+                W = Cp // 32
+                SOLVER_PROBE_BATCH.inc({"outcome": "batch"})
+                if solo:
+                    flat = np.asarray(pack_split_flat(
+                        jnp.asarray(compat_c), jnp.asarray(req_c),
+                        jnp.asarray(counts_c),
+                        shared[2], shared[3], shared[4],
+                        jnp.asarray(bcompat_c),
+                        shared[6], shared[7], shared[8],
+                        jnp.asarray(live_row), shared[9],
+                        max_free=F_try, mode=mode, cfg_rsv=cfg_rsv_j,
+                        rsv_cap=rsv_cap_j, conflict=conflict_c,
+                    ))[None, :]
+                else:
+                    Lp = _lane_bucket(len(chunk))
+                    counts_pad = np.zeros((Lp, Gp), np.int32)
+                    counts_pad[: len(chunk), :G] = counts[chunk]
+                    live_pad = np.zeros((Lp, Ep), bool)
+                    live_pad[: len(chunk), :E] = live[chunk]
+                    flat = np.asarray(pack_probe_lanes_flat(
+                        shared[0], shared[1], jnp.asarray(counts_pad),
+                        shared[2], shared[3], shared[4], shared[5],
+                        shared[6], shared[7], shared[8],
+                        jnp.asarray(live_pad), shared[9],
+                        max_free=F_try, mode=mode, cfg_rsv=cfg_rsv_j,
+                        rsv_cap=rsv_cap_j, conflict=conflict_j,
+                    ))
+                o1 = N * Gp_used + F_try * W
+                # cheap cap check (a few ints per lane): a capped
+                # lane's truncated answer must never be served, so the
+                # chunk regrows the fresh axis and redispatches
+                capped = any(
+                    int(flat[row, o1]) >= N
+                    and int(flat[row, o1 + 1 : o1 + 1 + Gp_used].sum()) > 0
+                    for row in range(len(chunk))
+                )
+                if capped and F_try <= worst:
+                    # one node holds >= one pod, so the largest lane's
+                    # pod count bounds any legal fresh axis
+                    grown = min(max(F_try * 2, F_try + 16), worst + 1)
+                    F_try = _pow2(grown, 32) if solo else _bucket(grown)
+                    SOLVER_PROBE_BATCH.inc({"outcome": "capped_retry"})
+                    continue
+                chunk_cache[ci] = (flat, F_try, Gp_used, gsel)
+                return chunk_cache[ci]
+
+        def make_thunk(li: int):
+            """Dispatch-if-needed + decode one lane on demand; memoized."""
+            cell: list = []
+
+            def thunk() -> Solution:
+                if cell:
+                    return cell[0]
+                flat, F_used, Gp_used, gsel = dispatch(li // width)
+                row = li % width
+                N = Ep + F_used
+                W = Cp // 32
+                o0 = N * Gp_used
+                o1 = o0 + F_used * W
+                packed_a = flat[row, :o0].reshape(N, Gp_used)
+                assign = np.zeros((N, G), np.int32)
+                packed_u = flat[row, o1 + 1 : o1 + 1 + Gp_used]
+                unsched = np.zeros((G,), np.int32)
+                if gsel is None:
+                    assign[:, :] = packed_a[:, :G]
+                    unsched[:] = packed_u[:G]
+                elif gsel.size:
+                    assign[:, gsel] = packed_a[:, : gsel.size]
+                    unsched[gsel] = packed_u[: gsel.size]
+                node_count = int(flat[row, o1])
+                node_mask = np.zeros((N, C), bool)
+                live_rows = np.flatnonzero(live[li])
+                if live_rows.size:
+                    node_mask[live_rows, bound_cfg[live_rows]] = True
+                if F_used:
+                    words = np.ascontiguousarray(
+                        flat[row, o0:o1].reshape(F_used, W)
+                    )
+                    bits = np.unpackbits(
+                        words.view(np.uint8).reshape(F_used, W * 4),
+                        axis=1, bitorder="little",
+                    )
+                    node_mask[Ep:] = bits[:, :C].astype(bool)
+                node_active = assign.sum(axis=1) > 0
+                node_active[:Ep] |= np.pad(live[li], (0, Ep - E))
+                per: dict[int, list[Pod]] = {}
+                for p in lane_pod_lists[li]:
+                    per.setdefault(gi_by_key[p.key], []).append(p)
+                lane_enc = replace(enc, groups=[
+                    replace(g, pods=per.get(gi, []))
+                    for gi, g in enumerate(groups)
+                ])
+                cell.append(_build_solution_arrays(
+                    lane_enc,
+                    np.flatnonzero(node_active[:node_count]),
+                    node_mask,
+                    assign,
+                    unsched,
+                ))
+                return cell[0]
+
+            return thunk
+
+        return [make_thunk(li) for li in range(L)]
+
+
+def _relaxable(pod: Pod) -> bool:
+    """True when preferences.relax() would strip something — the
+    sequential path retries such pods, so a batched lane that left one
+    unscheduled must be re-probed sequentially, not cached."""
+    aff = pod.spec.affinity
+    if aff is not None and aff.node_affinity is not None:
+        if aff.node_affinity.preferred:
+            return True
+        if len(aff.node_affinity.required) > 1:
+            return True
+    if any(
+        t.when_unsatisfiable == "ScheduleAnyway"
+        for t in pod.spec.topology_spread_constraints
+    ):
+        return True
+    if aff is not None:
+        if aff.pod_affinity is not None and aff.pod_affinity.preferred:
+            return True
+        if aff.pod_anti_affinity is not None and aff.pod_anti_affinity.preferred:
+            return True
+    return False
+
+
+class BatchProbeSolver:
+    """simulate_scheduling-faithful probe batching for the engine.
+
+    Construction pays the per-round costs once: one deep-copied
+    snapshot becomes one Scheduler (existing inputs, daemon overhead,
+    reservation ledger, catalog filtering). `prime(lane_specs)` then
+    evaluates many candidate subsets in one kernel batch and returns,
+    per lane, either the exact `(SchedulerResults, all_ok)` tuple the
+    sequential probe would compute, or None when that lane (or the
+    whole batch) must fall back to the sequential path.
+    """
+
+    def __init__(
+        self,
+        pools_with_types,
+        snapshot,
+        daemonsets,
+        cluster_pods,
+        pending_pods,
+        options,
+        kube,
+        clock,
+        compat_cache=None,
+    ):
+        from karpenter_tpu.provisioning.scheduler import Scheduler
+
+        self.kube = kube
+        self.scheduler = Scheduler(
+            pools_with_types=pools_with_types,
+            state_nodes=snapshot,
+            daemonsets=daemonsets,
+            cluster_pods=cluster_pods,
+            allow_reserved=options.feature_gates.reserved_capacity,
+            min_values_policy=options.min_values_policy,
+            ignore_dra_requests=options.ignore_dra_requests,
+            metrics_controller="disruption",
+            kube=kube,
+            clock=clock,
+            objective="ffd",
+            compat_cache=compat_cache,
+        )
+        self.pending = list(pending_pods)
+        self.lane_solver = LaneSolver(
+            self.scheduler.pools_with_types,
+            self.scheduler.existing_inputs,
+            daemon_overhead=self.scheduler.daemon_overhead,
+            reserved_in_use=dict(self.scheduler.reserved_in_use),
+            pending=self.pending,
+            compat_cache=compat_cache,
+        )
+        # which snapshot nodes hold a reservation: masking one out
+        # frees budget the shared encode cannot express per lane
+        from karpenter_tpu.apis.v1.labels import RESERVATION_ID_LABEL
+        from karpenter_tpu.provisioning.scheduler import _state_node_key
+
+        self._reserved_nodes: set[str] = set()
+        for node in snapshot:
+            rid = node.labels().get(RESERVATION_ID_LABEL, "")
+            if not rid and node.node_claim is not None:
+                for spec in node.node_claim.spec.requirements:
+                    if spec.key == RESERVATION_ID_LABEL and spec.values:
+                        rid = spec.values[0]
+                        break
+            if rid:
+                self._reserved_nodes.add(_state_node_key(node))
+
+    def usable(self) -> bool:
+        """False when the sequential path would not run the in-process
+        device kernel — matching its backend is part of the oracle
+        contract."""
+        import os
+
+        if os.environ.get("KARPENTER_SOLVER_BACKEND", "jax") == "host":
+            return False
+        try:
+            from karpenter_tpu.service.client import endpoint_from_env
+
+            if endpoint_from_env():
+                return False
+        except Exception:
+            pass
+        return True
+
+    def _batch_eligible(self, pods: Sequence[Pod]) -> tuple[bool, set[str]]:
+        """(eligible, dra_keys): the batched path only reproduces the
+        Scheduler's FAST path. Pods that would route to the topology /
+        host-port / volume-limited machinery gate the whole batch; DRA
+        pods are permanently errored exactly as Scheduler._solve does,
+        so they just report as unscheduled per lane."""
+        from karpenter_tpu.provisioning import volume_topology
+        from karpenter_tpu.scheduling.hostports import pod_host_ports
+        from karpenter_tpu.scheduling.volumeusage import pod_volume_drivers
+        from karpenter_tpu.utils.pod import has_dra_requirements
+
+        sched = self.scheduler
+        dra: set[str] = set()
+        limited = {
+            d for usage in sched._volume_usage.values() for d in usage.limits
+        }
+        for pod in pods:
+            if sched.ignore_dra_requests and has_dra_requirements(pod):
+                dra.add(pod.key)
+                continue
+            if self.kube is not None and (
+                pod.spec.volumes or pod.spec.injected_requirements
+            ):
+                # same per-solve re-derivation the sequential probe runs
+                volume_topology.inject(pod, self.kube)
+            if (
+                limited
+                and pod.spec.volumes
+                and limited & pod_volume_drivers(pod, self.kube).keys()
+            ):
+                return False, dra
+            if sched.topology.has_constraints(pod) or pod_host_ports(pod):
+                return False, dra
+        return True, dra
+
+    def prime(self, lane_specs) -> Optional[list]:
+        """Evaluate `lane_specs` (lists of Candidates) as one batch.
+        Returns None when the WHOLE batch is unsupported, else a list
+        aligned with lane_specs holding, per lane, a zero-arg thunk
+        that decodes to `(SchedulerResults, all_ok)` — or to None when
+        that lane turns out to need the sequential path — or None for
+        lanes known-unsupported up front. The device work happens here;
+        per-lane decode cost is deferred to the probes the search
+        actually consults."""
+        lanes: list[ProbeLane] = []
+        lane_pods: list[list[Pod]] = []
+        supported = [True] * len(lane_specs)
+        for i, spec in enumerate(lane_specs):
+            names = tuple(c.state_node.name for c in spec)
+            pods = [p for c in spec for p in c.reschedulable_pods]
+            if any(not self.lane_solver.knows(n) for n in names) or (
+                self._reserved_nodes and self._reserved_nodes & set(names)
+            ):
+                supported[i] = False
+                names, pods = (), []
+            lanes.append(ProbeLane(exclude_names=names, pods=pods))
+            lane_pods.append(pods)
+        union: dict[str, Pod] = {}
+        for pods in lane_pods:
+            for p in pods:
+                union.setdefault(p.key, p)
+        for p in self.pending:
+            union.setdefault(p.key, p)
+        ok_batch, dra = self._batch_eligible(list(union.values()))
+        if not ok_batch:
+            SOLVER_PROBE_BATCH.inc(
+                {"outcome": "fallback_lane"}, value=float(len(lane_specs))
+            )
+            return None
+        # DRA pods never enter the solve (Scheduler gates them first)
+        if dra:
+            lanes = [
+                ProbeLane(
+                    exclude_names=lane.exclude_names,
+                    pods=[p for p in lane.pods if p.key not in dra],
+                )
+                for lane in lanes
+            ]
+            self.lane_solver.pending = [
+                p for p in self.pending if p.key not in dra
+            ]
+        try:
+            lazy = self.lane_solver.solve_lazy(lanes, mode="ffd")
+        except Exception:
+            log.exception("probe batch failed; falling back to sequential")
+            SOLVER_PROBE_BATCH.inc(
+                {"outcome": "fallback_lane"}, value=float(len(lane_specs))
+            )
+            return None
+
+        def make_verdict(i, decode):
+            cell: list = []
+
+            def verdict():
+                if not cell:
+                    try:
+                        cell.append(
+                            self._to_results(lane_pods[i], decode(), dra)
+                        )
+                    except Exception:
+                        log.exception("probe lane decode failed; "
+                                      "falling back to sequential")
+                        cell.append(None)
+                    if cell[0] is None:
+                        SOLVER_PROBE_BATCH.inc({"outcome": "fallback_lane"})
+                return cell[0]
+
+            return verdict
+
+        out = []
+        for i, decode in enumerate(lazy):
+            if not supported[i]:
+                SOLVER_PROBE_BATCH.inc({"outcome": "fallback_lane"})
+                out.append(None)
+                continue
+            out.append(make_verdict(i, decode))
+        return out
+
+    def _to_results(self, lane_pods, sol: Solution, dra: set[str]):
+        """One lane's Solution -> the (SchedulerResults, all_ok) tuple
+        `simulate_scheduling` would return — or None when sequential-
+        only machinery (eviction retries, the preference-relaxation
+        ladder) would have engaged."""
+        from karpenter_tpu.provisioning.scheduler import (
+            DRA_ERROR,
+            SchedulerResults,
+        )
+
+        sched = self.scheduler
+        if sol.evicted:
+            return None
+        if sol.unschedulable and sched.honor_preferences and any(
+            _relaxable(p) for p in sol.unschedulable
+        ):
+            return None
+        results = SchedulerResults(new_node_plans=[], existing_assignments={})
+        kept = [
+            plan for plan in sol.new_nodes
+            if sched._enforce_min_values(plan, results)
+        ]
+        for a in sol.existing:
+            name = sched.existing_inputs[a.existing_index].name
+            results.existing_assignments.setdefault(name, []).extend(a.pods)
+        for pod in sol.unschedulable:
+            results.errors[pod.key] = "no compatible instance types or nodes"
+        for key in dra:
+            results.errors[key] = DRA_ERROR
+        for plan in kept:
+            sched._finalize_plan(plan)
+            if sched._enforce_min_values(plan, results):
+                results.new_node_plans.append(plan)
+        scheduled = {
+            p.key for plan in results.new_node_plans for p in plan.pods
+        } | {
+            p.key for ps in results.existing_assignments.values() for p in ps
+        }
+        all_ok = all(p.key in scheduled for p in lane_pods)
+        return results, all_ok

@@ -611,6 +611,104 @@ def pack_split(
 
 
 @functools.partial(jax.jit, static_argnames=("max_free", "mode"))
+def pack_probe_lanes_flat(
+    compat: jnp.ndarray,        # [G, C] bool (shared)
+    group_req: jnp.ndarray,     # [G, R] f32 (shared)
+    lane_counts: jnp.ndarray,   # [L, G] i32 — per-lane pod demand
+    cfg_alloc: jnp.ndarray,     # [C, R] f32 (shared)
+    cfg_pool: jnp.ndarray,      # [C] i32 (shared)
+    pool_overhead: jnp.ndarray,  # [P+1, R] f32 (shared)
+    bound_compat: jnp.ndarray,  # [G, B] bool (shared)
+    bound_alloc: jnp.ndarray,   # [B, R] f32 (shared)
+    bound_used0: jnp.ndarray,   # [B, R] f32 (shared)
+    bound_slot: jnp.ndarray,    # [B] i32 (shared)
+    lane_live: jnp.ndarray,     # [L, B] bool — per-lane retained rows
+    cfg_price: jnp.ndarray,     # [C] f32 (shared)
+    max_free: int,
+    mode: str = "ffd",
+    cfg_rsv: jnp.ndarray | None = None,
+    rsv_cap: jnp.ndarray | None = None,
+    conflict: jnp.ndarray | None = None,
+):
+    """The consolidation probe batch: `pack_split` vmapped over a LANE
+    axis. Every lane shares one encoded problem (the whole fleet's
+    bound rows, the full launchable catalog, the union of all probed
+    pods' groups) and differs only in (a) which bound rows are live —
+    a probe masks out its candidate subset's nodes — and (b) how many
+    pods of each group it must repack (a lane's excluded-candidate
+    pods plus the shared pending backlog; groups outside the lane
+    carry count 0 and are exact no-ops in the kernel). One dispatch
+    evaluates the entire prefix ladder / candidate rotation instead of
+    one sequential solve per probe; the flat uint32 output stacks one
+    pack_split_flat-layout row per lane so the host pays a single
+    device fetch for the whole batch."""
+
+    def one(counts, live):
+        return pack_split(
+            compat, group_req, counts, cfg_alloc, cfg_pool, pool_overhead,
+            bound_compat, bound_alloc, bound_used0, bound_slot, live,
+            cfg_price, max_free=max_free, mode=mode, cfg_rsv=cfg_rsv,
+            rsv_cap=rsv_cap, conflict=conflict,
+        )
+
+    assign, free_mask, node_count, unsched = jax.vmap(one)(
+        lane_counts, lane_live
+    )
+    L, f, cp = free_mask.shape
+    words = cp // 32
+    packed = (
+        free_mask.reshape(L, f, words, 32).astype(jnp.uint32)
+        << jnp.arange(32, dtype=jnp.uint32)[None, None, None, :]
+    ).sum(axis=-1, dtype=jnp.uint32)
+    return jnp.concatenate(
+        [
+            assign.astype(jnp.uint32).reshape(L, -1),
+            packed.reshape(L, -1),
+            node_count.astype(jnp.uint32)[:, None],
+            unsched.astype(jnp.uint32).reshape(L, -1),
+        ],
+        axis=1,
+    )
+
+
+def probe_batch_width() -> int:
+    """Probe lanes per device dispatch (KARPENTER_PROBE_BATCH_WIDTH).
+
+    Unset, the width is backend-aware: accelerators get 64 — the lane
+    axis genuinely parallelizes across the chip, so one wide dispatch
+    amortizes everything — while CPU gets 1: XLA:CPU serializes the
+    vmapped packing loop (per-lane execute measured ~4x a solo solve)
+    and its compile cost grows with the lane bucket, so probes there
+    dispatch the plain split kernel one consulted lane at a time and
+    take their win from the shared snapshot/encode/staging instead."""
+    raw = os.environ.get("KARPENTER_PROBE_BATCH_WIDTH", "").strip()
+    if raw:
+        try:
+            return max(1, int(raw))
+        except ValueError:
+            pass
+    try:
+        if jax.default_backend() != "cpu":
+            return 64
+    except Exception:
+        pass
+    return 1
+
+
+def _lane_bucket(n: int) -> int:
+    """Lane-axis shape bucket (KARPENTER_PROBE_LANE_BUCKET sets the
+    base): probes compile per (lane, problem) shape bucket, so lanes
+    pad to a small 1.25x-spaced family exactly like the node axis —
+    padded lanes carry zero demand and no live rows, making them
+    near-free no-ops."""
+    try:
+        base = max(1, int(os.environ.get("KARPENTER_PROBE_LANE_BUCKET", "8")))
+    except ValueError:
+        base = 8
+    return _pad_axis(n, base=base)
+
+
+@functools.partial(jax.jit, static_argnames=("max_free", "mode"))
 def pack_split_flat(*args, max_free: int, mode: str = "ffd",
                     bound_quota=None, cfg_rsv=None, rsv_cap=None,
                     group_cap=None, conflict=None):

@@ -57,6 +57,15 @@ DEFAULT_SHAPES: tuple[tuple[int, int, int, int], ...] = (
 
 MODES = ("ffd", "cost")
 
+# (lanes, groups, configs, existing/bound rows, fresh axis) buckets for
+# the batched consolidation probe kernel (consolidation_batch.LaneSolver
+# dispatches pack_probe_lanes_flat): a small-cluster rotation chunk and
+# a mid-size prefix ladder. Probes run the engine's ffd objective only.
+DEFAULT_PROBE_SHAPES: tuple[tuple[int, int, int, int, int], ...] = (
+    (8, 16, 256, 64, 32),
+    (32, 32, 512, 512, 32),
+)
+
 
 def cache_dir_default() -> str:
     here = os.path.dirname(os.path.dirname(os.path.dirname(
@@ -143,6 +152,108 @@ def shapes_from_env(spec: Optional[str] = None) -> list[tuple]:
     return out or list(DEFAULT_SHAPES)
 
 
+def probe_shapes_from_env(spec: Optional[str] = None) -> list[tuple]:
+    """Parse KARPENTER_WARM_PROBE_SHAPES ("L:G:C:E:N[:R[:P]];...") —
+    the lane-batched probe kernel's buckets. L is the probe lane count
+    (padded by the same lane bucket the LaneSolver uses); the rest
+    mirror shapes_from_env. Malformed entries are dropped."""
+    spec = spec if spec is not None else os.environ.get(
+        "KARPENTER_WARM_PROBE_SHAPES", ""
+    )
+    if not spec:
+        return [s + (4, 1) for s in DEFAULT_PROBE_SHAPES]
+    out = []
+    for part in spec.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        try:
+            fields = [int(x) for x in part.split(":")]
+            if len(fields) < 5 or len(fields) > 7:
+                raise ValueError(part)
+            l, g, c, e, n = fields[:5]
+            r = fields[5] if len(fields) > 5 else 4
+            p = fields[6] if len(fields) > 6 else 1
+            if l > 0 and g > 0 and c > 0 and e >= 0 and n > 0 and r > 0 and p > 0:
+                out.append((l, g, c, e, n, r, p))
+        except ValueError:
+            log.warning("ignoring malformed probe warm shape %r", part)
+    return out or [s + (4, 1) for s in DEFAULT_PROBE_SHAPES]
+
+
+def _compile_probe_bucket(
+    L: int, G: int, C: int, E: int, N: int, mode: str,
+    R: int = 4, P: int = 1,
+) -> None:
+    """AOT-compile the probe kernel(s) a real probe batch of this
+    bucket would dispatch. Padding must mirror
+    consolidation_batch.LaneSolver exactly (same _pad_axis /
+    _lane_bucket / _bucket / level-coupling) or the warmed program
+    never matches.
+
+    Backend-aware like probe_batch_width(): width > 1 (accelerators)
+    dispatches the vmapped pack_probe_lanes_flat, width == 1 (CPU)
+    dispatches solo pack_split_flat programs on the level-coupled
+    (G=16<<k, F=64<<k) diagonal — warming the vmapped kernel on CPU
+    would pay its expensive XLA:CPU compile for programs no probe
+    ever runs while leaving the solo shapes cold."""
+    import jax.numpy as jnp
+    from jax import ShapeDtypeStruct as S
+
+    from karpenter_tpu.solver.pack import (
+        _bucket,
+        _lane_bucket,
+        _pad_axis,
+        pack_probe_lanes_flat,
+        pack_split_flat,
+        probe_batch_width,
+    )
+
+    Cp = -(-_pad_axis(C) // 32) * 32
+    Ep = _pad_axis(E) if E else 0
+    if probe_batch_width() == 1:
+        k_max = 0
+        while (16 << k_max) < max(G, 1):
+            k_max += 1
+        for k in range(k_max + 1):
+            Gp = 16 << k
+            F = 64 << k
+            args = (
+                S((Gp, Cp), jnp.bool_),      # compat (compacted)
+                S((Gp, R), jnp.float32),     # group_req
+                S((Gp,), jnp.int32),         # group_count
+                S((Cp, R), jnp.float32),     # cfg_alloc
+                S((Cp,), jnp.int32),         # cfg_pool
+                S((P + 1, R), jnp.float32),  # pool_overhead
+                S((Gp, Ep), jnp.bool_),      # bound_compat
+                S((Ep, R), jnp.float32),     # bound_alloc
+                S((Ep, R), jnp.float32),     # bound_used0
+                S((Ep,), jnp.int32),         # bound_slot
+                S((Ep,), jnp.bool_),         # bound_live
+                S((Cp,), jnp.float32),       # cfg_price
+            )
+            pack_split_flat.lower(*args, max_free=F, mode=mode).compile()
+        return
+    Gp = _pad_axis(G)
+    Lp = _lane_bucket(L)
+    F = _bucket(max(N, 1))
+    args = (
+        S((Gp, Cp), jnp.bool_),      # compat
+        S((Gp, R), jnp.float32),     # group_req
+        S((Lp, Gp), jnp.int32),      # lane_counts
+        S((Cp, R), jnp.float32),     # cfg_alloc
+        S((Cp,), jnp.int32),         # cfg_pool
+        S((P + 1, R), jnp.float32),  # pool_overhead
+        S((Gp, Ep), jnp.bool_),      # bound_compat
+        S((Ep, R), jnp.float32),     # bound_alloc
+        S((Ep, R), jnp.float32),     # bound_used0
+        S((Ep,), jnp.int32),         # bound_slot
+        S((Lp, Ep), jnp.bool_),      # lane_live
+        S((Cp,), jnp.float32),       # cfg_price
+    )
+    pack_probe_lanes_flat.lower(*args, max_free=F, mode=mode).compile()
+
+
 def _compile_bucket(
     G: int, C: int, E: int, N: int, mode: str,
     R: int = 4, P: int = 1, topo: bool = False,
@@ -192,17 +303,48 @@ def warm(
     modes: Sequence[str] = MODES,
     topo: bool = True,
     stop: Optional[threading.Event] = None,
+    probe_shapes: Optional[Iterable[tuple]] = None,
 ) -> dict[str, int]:
-    """Compile every (shape bucket, mode[, topo variant]) combination;
-    returns {"ok": n, "error": n, "skipped": n}. Never raises. `stop`
-    is polled between compiles (one bucket compile is the atomic
-    unit); buckets run smallest-first so an early stop leaves the
-    cheapest work in flight."""
+    """Compile every (shape bucket, mode[, topo variant]) combination,
+    plus the batched consolidation probe buckets (ffd only — the
+    engine's probes always pack ffd); returns {"ok": n, "error": n,
+    "skipped": n}. Never raises. `stop` is polled between compiles
+    (one bucket compile is the atomic unit); buckets run
+    smallest-first so an early stop leaves the cheapest work in
+    flight."""
     from karpenter_tpu.metrics.store import SOLVER_WARM_COMPILES
 
     shapes = list(shapes) if shapes is not None else shapes_from_env()
     shapes.sort(key=lambda s: s[0] * s[1] + s[2] + s[3])
     counts = {"ok": 0, "error": 0, "skipped": 0}
+    if os.environ.get("KARPENTER_BATCH_PROBES", "1").lower() in (
+        "0", "false", "off"
+    ):
+        # batching disabled: no probe kernel will ever dispatch
+        probe_shapes = []
+    probes = (
+        list(probe_shapes) if probe_shapes is not None
+        else probe_shapes_from_env()
+    )
+    probes.sort(key=lambda s: s[0] * (s[1] * s[2] + s[3] + s[4]))
+    for shape in probes:
+        L, G, C, E, N = shape[:5]
+        R = shape[5] if len(shape) > 5 else 4
+        P = shape[6] if len(shape) > 6 else 1
+        if stop is not None and stop.is_set():
+            counts["skipped"] += 1
+            continue
+        try:
+            _compile_probe_bucket(L, G, C, E, N, "ffd", R=R, P=P)
+            counts["ok"] += 1
+            SOLVER_WARM_COMPILES.inc({"outcome": "ok"})
+        except Exception as err:
+            counts["error"] += 1
+            SOLVER_WARM_COMPILES.inc({"outcome": "error"})
+            log.warning(
+                "probe warm compile (L=%d,G=%d,C=%d,E=%d,N=%d,R=%d,P=%d) "
+                "failed: %s", L, G, C, E, N, R, P, err,
+            )
     for shape in shapes:
         G, C, E, N = shape[:4]
         R = shape[4] if len(shape) > 4 else 4

@@ -1,0 +1,227 @@
+"""Unit tests for the batched probe machinery: same-instance-type
+guard over the full surviving type set, starvation metrics on method
+timeout, fast-path gating fallbacks, and the warm pool's probe shape
+buckets.
+"""
+
+import time
+
+import pytest
+
+from karpenter_tpu.apis.v1.nodepool import REASON_UNDERUTILIZED
+from karpenter_tpu.cloudprovider.fake import GIB, make_instance_type
+from karpenter_tpu.disruption.engine import Candidate, Command
+from karpenter_tpu.metrics.store import DISRUPTION_PROBE_STARVATION
+from karpenter_tpu.provisioning.scheduler import SchedulerResults
+from karpenter_tpu.solver.solver import NodePlan
+from karpenter_tpu.testing import Environment, mk_nodepool, mk_pod
+
+
+def _types():
+    return [
+        make_instance_type("c2", cpu=2, memory=8 * GIB, price=2.0),
+        make_instance_type("c4", cpu=4, memory=16 * GIB, price=3.0),
+        make_instance_type("c8", cpu=8, memory=32 * GIB, price=5.0),
+    ]
+
+
+def _env(consolidate_after="0s"):
+    env = Environment(types=_types())
+    pool = mk_nodepool("default")
+    pool.spec.disruption.consolidate_after = consolidate_after
+    env.kube.create(pool)
+    return env
+
+
+def _candidate(it_name: str) -> Candidate:
+    return Candidate(
+        state_node=None, node_pool=None, reschedulable_pods=[],
+        instance_type_name=it_name, capacity_type="on-demand",
+        zone="test-zone-1", price=2.0, disruption_cost=1.0,
+    )
+
+
+def _command(plan: NodePlan, n_candidates: int = 2) -> Command:
+    return Command(
+        reason=REASON_UNDERUTILIZED,
+        candidates=[_candidate("c2") for _ in range(n_candidates)],
+        results=SchedulerResults(
+            new_node_plans=[plan], existing_assignments={}
+        ),
+    )
+
+
+class TestSameTypeGuard:
+    """multi_node's anti-churn guard must judge the FULL surviving
+    option set: previously it looked only at instance_types[0], so a
+    plan whose first type differed but whose only launchable offerings
+    belonged to the candidates' own type slipped through."""
+
+    def test_blocks_when_only_launchable_type_is_candidates_own(self):
+        env = _env()
+        c2, c4, _ = _types()
+        # first type differs (c4) but carries NO surviving offering —
+        # every launchable offering belongs to c2, the candidates' type
+        plan = NodePlan(
+            pool=mk_nodepool("default"),
+            instance_types=[c4, c2],
+            offerings=list(c2.offerings),
+            price=min(o.price for o in c2.offerings),
+        )
+        assert env.disruption._same_type_guard(_command(plan)) is False
+
+    def test_blocks_single_same_type_option(self):
+        env = _env()
+        c2, _, _ = _types()
+        plan = NodePlan(
+            pool=mk_nodepool("default"),
+            instance_types=[c2],
+            offerings=list(c2.offerings),
+            price=min(o.price for o in c2.offerings),
+        )
+        assert env.disruption._same_type_guard(_command(plan)) is False
+
+    def test_filters_same_type_but_keeps_real_alternative(self):
+        env = _env()
+        c2, c4, _ = _types()
+        plan = NodePlan(
+            pool=mk_nodepool("default"),
+            instance_types=[c2, c4],  # candidates' type resolves first
+            offerings=list(c2.offerings) + list(c4.offerings),
+            price=min(o.price for o in c2.offerings),
+        )
+        cmd = _command(plan)
+        assert env.disruption._same_type_guard(cmd) is True
+        # the candidates' own type was filtered out of the launch set
+        # (reference filterOutSameType): only the alternative remains
+        assert [it.name for it in plan.instance_types] == ["c4"]
+        assert all(o in c4.offerings for o in plan.offerings)
+        assert plan.price == min(o.price for o in c4.offerings)
+
+    def test_mixed_candidate_types_pass_through(self):
+        env = _env()
+        c2, _, _ = _types()
+        plan = NodePlan(
+            pool=mk_nodepool("default"),
+            instance_types=[c2],
+            offerings=list(c2.offerings),
+            price=2.0,
+        )
+        cmd = _command(plan)
+        cmd.candidates[1].instance_type_name = "c4"
+        assert env.disruption._same_type_guard(cmd) is True
+
+
+class FakeClock:
+    def __init__(self, step: float = 0.0):
+        self.now = 0.0
+        self.step = step
+
+    def __call__(self) -> float:
+        value = self.now
+        self.now += self.step
+        return value
+
+
+class TestStarvationMetrics:
+    def test_single_node_timeout_emits_attempted_and_remaining(self):
+        env = _env()
+        env.provision(mk_pod(name="big", cpu=1.0, node_selector={
+            "node.kubernetes.io/instance-type": "c8",
+            "karpenter.sh/capacity-type": "on-demand",
+        }))
+        env.kube.get_pod("default", "big").spec.node_selector = {}
+        now = time.time() + 60
+        env.pod_events.reconcile_all(now=now)
+        env.conditions.reconcile_all(now=now)
+        labels_a = {"method": "single_node_consolidation", "count": "attempted"}
+        labels_r = {"method": "single_node_consolidation", "count": "remaining"}
+        before_a = DISRUPTION_PROBE_STARVATION.value(labels_a)
+        before_r = DISRUPTION_PROBE_STARVATION.value(labels_r)
+        env.disruption.clock = FakeClock(step=200.0)  # deadline trips at once
+        assert env.disruption.single_node_consolidation(now) is None
+        assert DISRUPTION_PROBE_STARVATION.value(labels_a) == before_a
+        # nothing was attempted, one candidate was starved out
+        assert DISRUPTION_PROBE_STARVATION.value(labels_r) == before_r + 1
+
+
+class TestBatchGating:
+    def test_topology_constrained_pods_fall_back_to_sequential(self):
+        """A candidate whose pods the batched fast path cannot model
+        must make prime() decline — the engine then probes that lane
+        through the unchanged sequential simulate_scheduling."""
+        from karpenter_tpu.apis.v1.labels import TOPOLOGY_ZONE_LABEL
+        from karpenter_tpu.kube.objects import (
+            LabelSelector,
+            TopologySpreadConstraint,
+        )
+
+        env = _env()
+        pod = mk_pod(name="spread", cpu=1.0)
+        pod.metadata.labels["app"] = "web"
+        pod.spec.topology_spread_constraints = [
+            TopologySpreadConstraint(
+                max_skew=1,
+                topology_key=TOPOLOGY_ZONE_LABEL,
+                when_unsatisfiable="DoNotSchedule",
+                label_selector=LabelSelector.of({"app": "web"}),
+            )
+        ]
+        env.provision(pod)
+        now = time.time() + 60
+        env.pod_events.reconcile_all(now=now)
+        env.conditions.reconcile_all(now=now)
+        candidates = env.disruption.get_candidates(REASON_UNDERUTILIZED, now)
+        assert candidates
+        solver = env.disruption._build_probe_solver()
+        assert solver is not None
+        assert solver.prime([candidates[:1]]) is None
+        # the method itself still works end to end (sequential path)
+        cmd = env.disruption.single_node_consolidation(now)
+        assert cmd is None or cmd.candidates
+
+    def test_env_knob_disables_batching(self, monkeypatch):
+        env = _env()
+        monkeypatch.setenv("KARPENTER_BATCH_PROBES", "0")
+        assert env.disruption._build_probe_solver() is None
+
+    def test_reserved_candidate_gates_its_lane(self):
+        """Masking a reservation-holding node out would free budget the
+        shared encode cannot express per lane — those lanes must fall
+        back."""
+        from karpenter_tpu.apis.v1.labels import RESERVATION_ID_LABEL
+
+        env = _env()
+        env.provision(mk_pod(name="r", cpu=1.0))
+        now = time.time() + 60
+        env.pod_events.reconcile_all(now=now)
+        env.conditions.reconcile_all(now=now)
+        for node in env.kube.nodes():
+            node.metadata.labels[RESERVATION_ID_LABEL] = "rsv-1"
+        candidates = env.disruption.get_candidates(REASON_UNDERUTILIZED, now)
+        assert candidates
+        solver = env.disruption._build_probe_solver()
+        assert solver is not None
+        verdicts = solver.prime([candidates[:1]])
+        assert verdicts is not None and verdicts[0] is None
+
+
+class TestWarmPoolProbeShapes:
+    def test_probe_shapes_parse_and_default(self, monkeypatch):
+        from karpenter_tpu.solver.warm_pool import probe_shapes_from_env
+
+        monkeypatch.setenv("KARPENTER_WARM_PROBE_SHAPES", "8:16:256:64:32")
+        assert probe_shapes_from_env() == [(8, 16, 256, 64, 32, 4, 1)]
+        monkeypatch.setenv(
+            "KARPENTER_WARM_PROBE_SHAPES", "bogus;8:16:256:64:32:5:2"
+        )
+        assert probe_shapes_from_env() == [(8, 16, 256, 64, 32, 5, 2)]
+        monkeypatch.delenv("KARPENTER_WARM_PROBE_SHAPES")
+        assert probe_shapes_from_env()  # non-empty default family
+
+    def test_probe_bucket_compiles(self):
+        from karpenter_tpu.solver.warm_pool import _compile_probe_bucket
+
+        # tiny bucket: asserts the AOT shapes match what LaneSolver
+        # actually stages (a mismatch would silently warm nothing)
+        _compile_probe_bucket(2, 4, 8, 4, 8, "ffd")

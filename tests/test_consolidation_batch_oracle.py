@@ -1,0 +1,238 @@
+"""Batched-vs-sequential probe oracle (ISSUE 2 acceptance).
+
+The batched consolidation probe solver must be *indistinguishable*
+from looping the sequential probes:
+
+1. solver level — for randomized fleets and random candidate subsets,
+   every lane of one vmapped `LaneSolver.solve` call must decode to
+   the identical Solution a standalone subset encode + pack produces
+   (same feasibility verdict, same replacement plans and prices, same
+   pod-to-node mapping), under BOTH packing objectives;
+2. engine level — `multi_node_consolidation` / `single_node_
+   consolidation` / `drift` with batching on must pick the identical
+   command (same candidates retired, same replacement price, same
+   chosen prefix) as the sequential probe loop (KARPENTER_BATCH_PROBES=0).
+"""
+
+import random
+import time
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.apis.v1.labels import (
+    CAPACITY_TYPE_LABEL,
+    HOSTNAME_LABEL,
+    INSTANCE_TYPE_LABEL,
+    NODEPOOL_LABEL,
+    TOPOLOGY_ZONE_LABEL,
+)
+from karpenter_tpu.apis.v1.nodeclaim import COND_DRIFTED
+from karpenter_tpu.cloudprovider.fake import GIB, instance_types, make_instance_type
+from karpenter_tpu.scheduling.requirements import Requirements
+from karpenter_tpu.solver.consolidation_batch import LaneSolver, ProbeLane
+from karpenter_tpu.solver.encode import ExistingNodeInput, encode, group_pods
+from karpenter_tpu.solver.pack import solve_packing
+from karpenter_tpu.solver.solver import _build_solution_arrays, solve
+from karpenter_tpu.testing import Environment, mk_nodepool, mk_pod
+from karpenter_tpu.utils import resources as resutil
+
+SHAPES = [(0.5, 1.0), (1.0, 2.0), (2.0, 4.0), (4.0, 8.0), (2.0, 0.5), (0.25, 4.0)]
+ZONES = ["test-zone-1", "test-zone-2", "test-zone-3"]
+
+
+def build_fleet(seed: int, n_pods: int = 240, n_types: int = 24):
+    """A packed fleet after a random scale-down, bench-style: returns
+    (pools, existing_inputs for EVERY node, kept pods per node)."""
+    rng = np.random.default_rng(seed)
+    pool = mk_nodepool("default")
+    types = instance_types(n_types)
+    pods = []
+    for i in range(n_pods):
+        cpu, mem = SHAPES[int(rng.integers(len(SHAPES)))]
+        selector = {}
+        if rng.random() < 0.2:
+            selector[TOPOLOGY_ZONE_LABEL] = ZONES[int(rng.integers(3))]
+        if rng.random() < 0.15:
+            selector["kubernetes.io/arch"] = "amd64"
+        pods.append(mk_pod(
+            name=f"o-{seed}-{i}", cpu=cpu, memory=mem * GIB,
+            node_selector=selector or None,
+        ))
+    fleet = solve(pods, [(pool, types)], objective="ffd")
+    inputs, pods_on = [], []
+    for ni, plan in enumerate(fleet.new_nodes):
+        kept = [p for p in plan.pods if rng.random() >= 0.5]
+        it = plan.instance_types[0]
+        off = plan.offerings[0]
+        labels = {
+            NODEPOOL_LABEL: pool.metadata.name,
+            INSTANCE_TYPE_LABEL: it.name,
+            TOPOLOGY_ZONE_LABEL: off.zone,
+            CAPACITY_TYPE_LABEL: off.capacity_type,
+            HOSTNAME_LABEL: f"n-{ni}",
+        }
+        used = resutil.requests_for_pods(kept)
+        avail = {
+            k: max(0.0, v - used.get(k, 0.0))
+            for k, v in it.allocatable.items()
+        }
+        inputs.append(ExistingNodeInput(
+            name=f"n-{ni}",
+            requirements=Requirements.from_labels(labels),
+            taints=(),
+            available=avail,
+            pool_name=pool.metadata.name,
+            pod_count=len(kept),
+        ))
+        pods_on.append(kept)
+    return [(pool, types)], inputs, pods_on
+
+
+def summarize(sol, inputs):
+    """Order-insensitive identity of a Solution against a given
+    existing-input list (the lane solver indexes the full fleet, the
+    sequential solve the retained subset — names align them)."""
+    plans = sorted(
+        (
+            plan.pool.metadata.name,
+            round(float(plan.price), 6),
+            tuple(sorted(p.key for p in plan.pods)),
+            tuple(sorted(it.name for it in plan.instance_types)),
+        )
+        for plan in sol.new_nodes
+    )
+    existing = sorted(
+        (inputs[a.existing_index].name, tuple(sorted(p.key for p in a.pods)))
+        for a in sol.existing
+        if a.pods
+    )
+    unsched = tuple(sorted(p.key for p in sol.unschedulable))
+    return plans, existing, unsched
+
+
+@pytest.mark.parametrize("mode", ["ffd", "cost"])
+@pytest.mark.parametrize("seed", [3, 11])
+def test_batched_lanes_match_sequential_subset_solves(seed, mode):
+    pools, inputs, pods_on = build_fleet(seed)
+    assert len(inputs) >= 6, "fixture too small to probe"
+    # the candidate order consolidation uses: fewest pods first
+    order = sorted(range(len(inputs)), key=lambda i: (len(pods_on[i]), i))
+    lane_sets = [order[:n] for n in range(1, min(10, len(order)) + 1)]
+    rng = np.random.default_rng(seed + 99)
+    for _ in range(4):
+        k = int(rng.integers(1, min(8, len(inputs))))
+        lane_sets.append(
+            sorted(rng.choice(len(inputs), size=k, replace=False).tolist())
+        )
+    lanes = [
+        ProbeLane(
+            exclude_names=tuple(inputs[i].name for i in s),
+            pods=[p for i in s for p in pods_on[i]],
+        )
+        for s in lane_sets
+    ]
+    batched = LaneSolver(pools, inputs).solve(lanes, mode=mode)
+    assert len(batched) == len(lanes)
+    for s, lane, got in zip(lane_sets, lanes, batched):
+        excluded = set(s)
+        retained = [inp for i, inp in enumerate(inputs) if i not in excluded]
+        enc = encode(group_pods(lane.pods), pools, retained)
+        if enc.compat.shape[0] == 0:
+            assert not got.new_nodes and not got.unschedulable
+            continue
+        res = solve_packing(enc, mode=mode)
+        want = _build_solution_arrays(
+            enc,
+            np.flatnonzero(res.node_active[: res.node_count]),
+            res.node_mask,
+            res.assign,
+            res.unschedulable,
+        )
+        assert summarize(got, inputs) == summarize(want, retained), (
+            f"lane {s} diverged from the sequential subset solve ({mode})"
+        )
+
+
+def test_batched_lane_matches_public_solve_entry():
+    """The ffd lane must also equal the PUBLIC solve() path a
+    sequential probe takes (ties the oracle to the real entry point,
+    not just the kernel)."""
+    pools, inputs, pods_on = build_fleet(21)
+    order = sorted(range(len(inputs)), key=lambda i: (len(pods_on[i]), i))
+    s = order[:4]
+    lane = ProbeLane(
+        exclude_names=tuple(inputs[i].name for i in s),
+        pods=[p for i in s for p in pods_on[i]],
+    )
+    got = LaneSolver(pools, inputs).solve([lane], mode="ffd")[0]
+    retained = [inp for i, inp in enumerate(inputs) if i not in set(s)]
+    want = solve(lane.pods, pools, existing=retained, objective="ffd")
+    assert summarize(got, inputs) == summarize(want, retained)
+
+
+# -- engine level -------------------------------------------------------------
+
+
+def _mixed_env():
+    env = Environment(types=[
+        make_instance_type("c2", cpu=2, memory=8 * GIB, price=2.0),
+        make_instance_type("c4", cpu=4, memory=16 * GIB, price=3.0),
+        make_instance_type("c8", cpu=8, memory=32 * GIB, price=5.0),
+    ])
+    pool = mk_nodepool("default")
+    pool.spec.disruption.consolidate_after = "0s"
+    env.kube.create(pool)
+    # one small node per pod: provision in separate rounds
+    for i in range(5):
+        env.provision(mk_pod(name=f"m-{i}", cpu=1.0, memory=2 * GIB))
+    assert len(env.kube.nodes()) == 5
+    now = time.time() + 120
+    env.pod_events.reconcile_all(now=now)
+    env.conditions.reconcile_all(now=now)
+    return env, now
+
+
+def _command_identity(cmd):
+    if cmd is None:
+        return None
+    plans = []
+    if cmd.results is not None:
+        plans = sorted(
+            (
+                plan.pool.metadata.name,
+                round(float(plan.price), 6),
+                tuple(sorted(p.key for p in plan.pods)),
+                tuple(sorted(it.name for it in plan.instance_types)),
+            )
+            for plan in cmd.results.new_node_plans
+        )
+    return (
+        cmd.reason,
+        tuple(sorted(c.state_node.name for c in cmd.candidates)),
+        plans,
+    )
+
+
+@pytest.mark.parametrize(
+    "method",
+    ["multi_node_consolidation", "single_node_consolidation", "drift"],
+)
+def test_engine_methods_identical_with_and_without_batching(method, monkeypatch):
+    env, now = _mixed_env()
+    if method == "drift":
+        for claim in env.kube.node_claims():
+            claim.status_conditions.set_true(COND_DRIFTED, now=now)
+
+    def run(flag):
+        monkeypatch.setenv("KARPENTER_BATCH_PROBES", flag)
+        env.disruption._rng = random.Random(0)  # same rotation shuffle
+        return getattr(env.disruption, method)(now)
+
+    sequential = run("0")
+    batched = run("1")
+    assert _command_identity(batched) == _command_identity(sequential)
+    if method == "multi_node_consolidation":
+        # the fixture merges several small nodes: the probes must have
+        # found a real command, not vacuously agreed on None
+        assert batched is not None and len(batched.candidates) >= 2

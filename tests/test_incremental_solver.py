@@ -427,7 +427,8 @@ class TestWarmPool:
 
         before = SOLVER_WARM_COMPILES.value({"outcome": "ok"})
         counts = warm_pool.warm(
-            shapes=[(4, 64, 0, 32)], modes=("ffd",), topo=False
+            shapes=[(4, 64, 0, 32)], modes=("ffd",), topo=False,
+            probe_shapes=[],
         )
         assert counts == {"ok": 1, "error": 0, "skipped": 0}
         assert SOLVER_WARM_COMPILES.value({"outcome": "ok"}) == before + 1
