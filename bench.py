@@ -912,6 +912,37 @@ def _wait_for_tpu(max_wait_s: float, probe_timeout: float = 60.0) -> bool:
         time.sleep(min(120.0, max(10.0, deadline - time.time())))
 
 
+def _resilience_counts() -> dict:
+    """Flat {series: value} snapshot of the resilience-layer counters,
+    so bench arms under KARPENTER_FAULTS report exactly which rungs
+    served, which breakers tripped, and which deadlines were missed."""
+    from karpenter_tpu.metrics.store import (
+        SOLVER_BREAKER_TRANSITIONS,
+        SOLVER_DEADLINE_EXCEEDED,
+        SOLVER_FAULTS_INJECTED,
+        SOLVER_HEDGE,
+        SOLVER_LADDER,
+    )
+
+    out: dict[str, float] = {}
+    for metric in (SOLVER_LADDER, SOLVER_BREAKER_TRANSITIONS,
+                   SOLVER_DEADLINE_EXCEEDED, SOLVER_HEDGE,
+                   SOLVER_FAULTS_INJECTED):
+        for pairs, value in metric.samples():
+            key = metric.name + "{" + ",".join(
+                f"{k}={v}" for k, v in pairs) + "}"
+            out[key] = value
+    return out
+
+
+def _resilience_delta(before: dict, after: dict) -> dict:
+    return {
+        k: v - before.get(k, 0.0)
+        for k, v in after.items()
+        if v - before.get(k, 0.0) > 0
+    }
+
+
 def main() -> int:
     n_pods = int(os.environ.get("BENCH_PODS", "50000"))
     n_types = int(os.environ.get("BENCH_TYPES", "500"))
@@ -969,6 +1000,7 @@ def main() -> int:
     backend = jax.default_backend()
     detail = {"backend": backend, "backend_provenance": provenance}
     for name, fn in runners.items():
+        res_before = _resilience_counts()
         try:
             detail[name] = fn()
             # per-scenario backend stamp: a partial TPU run (tunnel died
@@ -979,6 +1011,12 @@ def main() -> int:
             detail[name] = {"error": f"{type(e).__name__}: {e}",
                             "backend": backend}
             errors.append(f"{name}: {type(e).__name__}: {e}")
+        # resilience activity delta (ladder rungs, breaker transitions,
+        # deadline misses, hedge wins, injected faults): chaos arms set
+        # KARPENTER_FAULTS and read the degradation story from here
+        res_delta = _resilience_delta(res_before, _resilience_counts())
+        if res_delta:
+            detail[name]["resilience"] = res_delta
         if backend == "tpu":
             # persist incrementally THE MOMENT any TPU scenario lands —
             # evidence must survive a crash/timeout later in the run

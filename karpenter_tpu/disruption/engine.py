@@ -194,6 +194,18 @@ class DisruptionEngine:
         # through the unchanged sequential path
         if self.has_uninitialized_capacity():
             return None
+        # device breaker open: don't even pay the snapshot + Scheduler
+        # + encode setup for a batch that would only re-fault — the
+        # sequential probes' own solves ride the resilience ladder to
+        # whichever rung still works (usable() re-checks post-build
+        # for the race where the breaker opens during setup)
+        from karpenter_tpu.solver import resilience
+
+        if resilience.shared().breaker("device").is_open():
+            log.warning(
+                "device breaker open; skipping batched probe setup for "
+                "this ladder")
+            return None
         from karpenter_tpu.solver.consolidation_batch import BatchProbeSolver
 
         try:

@@ -148,6 +148,12 @@ class _KindWatch:
     def _run(self) -> None:
         import urllib.error
 
+        from karpenter_tpu.utils.backoff import jitter
+
+        # reconnect backoff is jittered ([0.5, 1.0) of the exponential
+        # window): an API-server restart drops EVERY watcher at once,
+        # and synchronized un-jittered reconnects would stampede it at
+        # exactly 0.2s, 0.4s, ... after it comes back
         backoff = 0.2
         while not self._stop.is_set():
             try:
@@ -159,12 +165,12 @@ class _KindWatch:
                 if err.code == 410:
                     self.gone = True
                     break
-                self._stop.wait(backoff)
+                self._stop.wait(backoff * jitter())
                 backoff = min(10.0, backoff * 2)
             except Exception:
                 if self._stop.is_set():
                     break
-                self._stop.wait(backoff)
+                self._stop.wait(backoff * jitter())
                 backoff = min(10.0, backoff * 2)
         self.dead = True
 

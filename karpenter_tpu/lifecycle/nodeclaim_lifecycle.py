@@ -45,6 +45,12 @@ log = logging.getLogger("karpenter.lifecycle")
 
 LAUNCH_TIMEOUT_SECONDS = 5 * 60       # liveness.go:51
 REGISTRATION_TIMEOUT_SECONDS = 15 * 60  # liveness.go:56
+# transient launch failures retry with capped, full-jittered
+# exponential backoff: a provider outage fails every in-flight claim
+# at once, and tick-paced un-jittered retries would re-hammer the
+# provider API with the whole cohort in lockstep each reconcile
+LAUNCH_BACKOFF_BASE_SECONDS = 1.0
+LAUNCH_BACKOFF_MAX_SECONDS = 30.0
 # how often reconcile_dirty re-queues every deleting claim (wedge
 # recovery bound; event-tracked claims progress every pass regardless)
 DELETING_SWEEP_SECONDS = 30.0
@@ -69,6 +75,8 @@ class NodeClaimLifecycle:
         # settle — in steady state the set is empty
         self._active: set[str] = set()
         self._last_deleting_sweep = 0.0
+        # claim key -> (consecutive launch failures, next attempt at)
+        self._launch_retry: dict[str, tuple[int, float]] = {}
 
     # -- entry ----------------------------------------------------------------
 
@@ -135,6 +143,7 @@ class NodeClaimLifecycle:
             claim = self.kube.get_node_claim(key)
             if claim is None:
                 self._active.discard(key)
+                self._launch_retry.pop(key, None)
                 continue
             self.reconcile(claim, now)
             settled = (
@@ -163,19 +172,35 @@ class NodeClaimLifecycle:
     def _launch(self, claim: NodeClaim, now: float) -> None:
         if claim.status.provider_id:
             claim.status_conditions.set_true(COND_LAUNCHED, now=now)
+            self._launch_retry.pop(claim.key, None)
             return
+        retry = self._launch_retry.get(claim.key)
+        if retry is not None and now < retry[1]:
+            return  # still backing off from the last transient failure
         try:
             launched = self.cloud_provider.create(claim)
         except (InsufficientCapacityError, NodeClassNotReadyError) as err:
             # ICE: delete the claim so pods reschedule elsewhere
             log.info("launch failed for %s: %s; deleting claim", claim.metadata.name, err)
             self.health.record(claim.metadata.labels.get(NODEPOOL_LABEL, ""), False)
+            self._launch_retry.pop(claim.key, None)
             self._delete_claim(claim, now)
             return
         except Exception as err:
+            from karpenter_tpu.utils.backoff import (
+                capped_exponential,
+                jitter,
+            )
+
+            n = retry[0] + 1 if retry is not None else 1
+            window = capped_exponential(
+                n, LAUNCH_BACKOFF_BASE_SECONDS, LAUNCH_BACKOFF_MAX_SECONDS
+            )
+            self._launch_retry[claim.key] = (n, now + window * jitter())
             claim.status_conditions.set_false(COND_LAUNCHED, "LaunchFailed", str(err), now=now)
             self.kube.update(claim)
             return
+        self._launch_retry.pop(claim.key, None)
         claim.status.provider_id = launched.status.provider_id
         claim.status.image_id = launched.status.image_id
         claim.status.capacity = launched.status.capacity

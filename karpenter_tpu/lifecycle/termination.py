@@ -34,9 +34,31 @@ CRITICAL_PRIORITY = 2_000_000_000  # system-cluster-critical threshold
 
 # eviction retry limiter constants (terminator/eviction.go: the queue
 # uses an item-exponential rate limiter, 100ms base / 10s cap, so a
-# PDB-blocked pod is retried with backoff instead of hammered)
+# PDB-blocked pod is retried with backoff instead of hammered).
+# Retries are jittered (full jitter above the base floor): a drain
+# evicting dozens of pods behind one PDB blocks them all at the same
+# instant, and un-jittered exponential backoff would re-hammer the
+# eviction subresource with the whole cohort in lockstep forever.
 EVICT_BACKOFF_BASE_SECONDS = 0.1
 EVICT_BACKOFF_MAX_SECONDS = 10.0
+
+
+def _jittered_backoff(attempts: int, rng=None) -> float:
+    """Delay for the n-th consecutive 429 (1-based): the base floor
+    plus full jitter up to the capped exponential. Attempt 1 is the
+    deterministic base (an isolated 429 retries promptly); later
+    attempts spread the cohort."""
+    import random as _random
+
+    from karpenter_tpu.utils.backoff import capped_exponential
+
+    cap = capped_exponential(
+        attempts, EVICT_BACKOFF_BASE_SECONDS, EVICT_BACKOFF_MAX_SECONDS
+    )
+    r = (rng or _random).random()
+    return EVICT_BACKOFF_BASE_SECONDS + r * (
+        cap - EVICT_BACKOFF_BASE_SECONDS
+    )
 
 
 class EvictionQueue:
@@ -55,9 +77,10 @@ class EvictionQueue:
     already launched). See _maybe_rebirth for the gating.
     """
 
-    def __init__(self, kube: KubeClient, recorder=None):
+    def __init__(self, kube: KubeClient, recorder=None, rng=None):
         self.kube = kube
         self.recorder = recorder
+        self._rng = rng  # injectable for deterministic backoff tests
         self.blocked: dict[str, str] = {}  # pod key -> blocking pdb
         self._attempts: dict[str, int] = {}  # pod key -> 429 count
         self._retry_at: dict[str, float] = {}  # pod key -> next attempt
@@ -80,11 +103,8 @@ class EvictionQueue:
                 self.blocked[pod.key] = err.pdb
                 n = self._attempts.get(pod.key, 0)
                 self._attempts[pod.key] = n + 1
-                # exponent capped: the backoff saturates at the max
-                # long before 2**n overflows float range
-                self._retry_at[pod.key] = now + min(
-                    EVICT_BACKOFF_MAX_SECONDS,
-                    EVICT_BACKOFF_BASE_SECONDS * 2 ** min(n, 7),
+                self._retry_at[pod.key] = now + _jittered_backoff(
+                    n + 1, rng=self._rng
                 )
                 return False
         else:

@@ -210,6 +210,31 @@ SOLVER_PROBE_BATCH = REGISTRY.counter(
     "Batched consolidation probe activity: device dispatches (batch), "
     "lanes evaluated (lane), node-axis regrow retries (capped_retry), "
     "and lanes handed back to the sequential path (fallback_lane)")
+# resilience layer (solver/resilience.py): breaker state machine,
+# degradation ladder routing, watchdog deadline misses, hedge
+# outcomes, and the chaos injector's fired faults
+SOLVER_BREAKER_STATE = REGISTRY.gauge(
+    "karpenter_solver_breaker_state",
+    "Per-backend solver circuit breaker state "
+    "(0 closed / 1 half-open / 2 open)")
+SOLVER_BREAKER_TRANSITIONS = REGISTRY.counter(
+    "karpenter_solver_breaker_transitions_total",
+    "Solver circuit breaker transitions, by backend and target state")
+SOLVER_LADDER = REGISTRY.counter(
+    "karpenter_solver_ladder_total",
+    "Degradation-ladder rung attempts, by rung "
+    "(remote/sharded/device/host) and outcome (ok, skipped_open, "
+    "skipped_deadline, or the classified failure)")
+SOLVER_DEADLINE_EXCEEDED = REGISTRY.counter(
+    "karpenter_solver_deadline_exceeded_total",
+    "Watchdog deadline misses, by phase (compile/execute/total)")
+SOLVER_HEDGE = REGISTRY.counter(
+    "karpenter_solver_hedge_total",
+    "FFD hedge activity: fired (timer elapsed mid-solve), win "
+    "(hedged result served the decision), loss (device finished first)")
+SOLVER_FAULTS_INJECTED = REGISTRY.counter(
+    "karpenter_solver_faults_injected_total",
+    "Faults fired by the deterministic injector, by site and kind")
 DISRUPTION_PROBE_STARVATION = REGISTRY.counter(
     "karpenter_disruption_probe_starvation_total",
     "Consolidation probes attempted vs still remaining when a method's "

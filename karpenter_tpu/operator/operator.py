@@ -160,6 +160,19 @@ class Operator:
             from karpenter_tpu.solver import warm_pool
 
             self._warm_pool_thread = warm_pool.start_background()
+        # resilience knobs: Options export into the env the solver
+        # layer reads per call (already-set env vars win — a deploy's
+        # explicit environment outranks embedder defaults). The
+        # resilience ladder itself is always on; these only tune it.
+        for value, env_key in (
+            (self.options.solve_deadline_ms, "KARPENTER_SOLVE_DEADLINE_MS"),
+            (self.options.compile_deadline_ms,
+             "KARPENTER_COMPILE_DEADLINE_MS"),
+            (self.options.solve_hedge_ms, "KARPENTER_SOLVE_HEDGE_MS"),
+            (self.options.solver_faults, "KARPENTER_FAULTS"),
+        ):
+            if value and env_key not in _os.environ:
+                _os.environ[env_key] = str(value)
         # plans whose pods await binding (the kube-scheduler's job in a
         # real cluster; this runtime owns the whole substrate, so it
         # binds pods to the nodes the solver placed them on)

@@ -74,6 +74,15 @@ class Options:
     # embedders don't grow compile threads; KARPENTER_WARM_POOL=1 in
     # the environment force-enables it too.
     solver_warm_pool: bool = False
+    # Solver resilience layer (solver/resilience.py). The env knobs
+    # (KARPENTER_SOLVE_DEADLINE_MS etc.) stay authoritative — these
+    # options export into the environment at operator startup when the
+    # env doesn't already set them, so embedders configure resilience
+    # the same way they configure everything else. 0 disables.
+    solve_deadline_ms: int = 0      # hard per-solve wall budget
+    compile_deadline_ms: int = 0    # separate budget for the XLA compile
+    solve_hedge_ms: int = 0         # fire the host FFD hedge after this
+    solver_faults: str = ""         # KARPENTER_FAULTS spec (chaos/bench)
 
 
 DEFAULT_OPTIONS = Options()

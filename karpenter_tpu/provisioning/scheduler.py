@@ -19,6 +19,7 @@ Counterpart of provisioning/scheduling/scheduler.go. The flow
 
 from __future__ import annotations
 
+import logging
 import math
 from dataclasses import dataclass, field
 from typing import Optional, Sequence
@@ -64,6 +65,8 @@ from karpenter_tpu.state.cluster import StateNode
 from karpenter_tpu.utils import resources as resutil
 from karpenter_tpu.provisioning.preferences import relax
 
+log = logging.getLogger("karpenter.scheduler")
+
 # scheduler knob (nodeclaimtemplate.go:41)
 MAX_INSTANCE_TYPES = 600
 
@@ -87,6 +90,9 @@ class SchedulerResults:
     new_node_plans: list[NodePlan]
     existing_assignments: dict[str, list[Pod]]      # state-node name -> pods
     errors: dict[str, str] = field(default_factory=dict)  # pod key -> reason
+    # resilience ladder rungs (other than the primary) that served any
+    # kernel call of this solve — empty on a healthy tick
+    degraded_rungs: list[str] = field(default_factory=list)
 
     @property
     def scheduled_count(self) -> int:
@@ -544,10 +550,24 @@ class Scheduler:
         self._last_progress_publish = self._solve_start
         SCHEDULER_UNFINISHED_WORK.set(0.0, labels)
         results: Optional[SchedulerResults] = None
+        from karpenter_tpu.solver import resilience
+
+        resilience.pop_degraded()  # scope the report to THIS solve
         try:
             results = self._solve(pods)
             return results
         finally:
+            degraded = resilience.pop_degraded()
+            if degraded:
+                # the tick still decided — but through fallback rungs;
+                # say so once per solve, not once per kernel call
+                log.warning(
+                    "%s solve served degraded via rung(s) %s "
+                    "(see karpenter_solver_ladder_total)",
+                    self.metrics_controller, sorted(set(degraded)),
+                )
+                if results is not None:
+                    results.degraded_rungs = sorted(set(degraded))
             SCHEDULER_QUEUE_DEPTH.set(0.0, labels)
             SCHEDULER_UNFINISHED_WORK.set(0.0, labels)
             SCHEDULER_SCHEDULING_DURATION.observe(

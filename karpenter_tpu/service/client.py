@@ -25,7 +25,8 @@ log = logging.getLogger("karpenter.solver-client")
 
 DEFAULT_TIMEOUT_SECONDS = 55.0  # under the 60s Solve wall-clock bound
 BREAKER_FAILURES = 2            # consecutive failures that trip it
-BREAKER_COOLDOWN_SECONDS = 60.0
+BREAKER_COOLDOWN_SECONDS = 60.0      # base; doubles per consecutive open
+BREAKER_COOLDOWN_MAX_SECONDS = 600.0
 
 
 def endpoint_from_env() -> Optional[str]:
@@ -55,10 +56,22 @@ class RemoteSolver:
 
         self._breaker_lock = threading.Lock()
         self._failures = 0
+        self._open_cycles = 0
         self._skip_until = 0.0
 
     def solve_packing(self, enc, max_nodes: int = 0, mode: str = "ffd",
-                      plan=None, shards: int = 0) -> PackResult:
+                      plan=None, shards: int = 0,
+                      fallback: Optional[bool] = None) -> PackResult:
+        """`fallback` overrides `fallback_local` per call: the
+        resilience ladder passes False so an RPC failure propagates to
+        ITS ladder (which owns the device/host fallback and the
+        breaker bookkeeping) instead of silently solving here."""
+        from karpenter_tpu.utils.backoff import jitter
+
+        fallback_local = (
+            self.fallback_local if fallback is None else fallback
+        )
+
         def local() -> PackResult:
             return solve_packing(
                 enc, max_nodes=max_nodes, mode=mode, plan=plan, shards=shards
@@ -68,32 +81,53 @@ class RemoteSolver:
             # only the STATE read happens under the lock — the local
             # solve must run outside it or concurrent solves serialize
             # on one breaker for multiple seconds each
-            skip = self.fallback_local and time.monotonic() < self._skip_until
+            skip = fallback_local and time.monotonic() < self._skip_until
         if skip:
             return local()
-        request = codec.encode_request(enc, mode, max_nodes, shards, plan)
         try:
+            from karpenter_tpu.solver import faults
+
+            faults.fire("rpc")
+            request = codec.encode_request(enc, mode, max_nodes, shards, plan)
             response = self._solve(request, timeout=self.timeout)
             with self._breaker_lock:
                 self._failures = 0
+                self._open_cycles = 0
             return codec.decode_result(response)
         except Exception as err:
+            if not fallback_local:
+                # the caller (the resilience ladder) owns fallback AND
+                # breaker bookkeeping for this endpoint — running the
+                # internal breaker here too would log "open" cooldowns
+                # that never actually skip (skip is gated on
+                # fallback_local) and double-count every outage
+                raise
             with self._breaker_lock:
                 self._failures += 1
                 if self._failures >= BREAKER_FAILURES:
                     # cooldown from NOW, not from before the RPC — a
                     # deadline-miss failure burns the timeout first and
-                    # must still keep the breaker open a full cooldown
-                    self._skip_until = (
-                        time.monotonic() + BREAKER_COOLDOWN_SECONDS
+                    # must still keep the breaker open a full cooldown.
+                    # Jittered exponential: doubles per consecutive
+                    # open cycle (capped), scaled by a desynchronizing
+                    # [0.5, 1.0) factor so a fleet of control planes
+                    # tripped together never re-probes in lockstep.
+                    from karpenter_tpu.utils.backoff import (
+                        capped_exponential,
                     )
+
+                    cooldown = capped_exponential(
+                        self._open_cycles + 1,
+                        BREAKER_COOLDOWN_SECONDS,
+                        BREAKER_COOLDOWN_MAX_SECONDS,
+                    ) * jitter()
+                    self._open_cycles += 1
+                    self._skip_until = time.monotonic() + cooldown
                     log.warning(
                         "solver service %s: %d consecutive failures; "
                         "breaker open for %.0fs", self.endpoint,
-                        self._failures, BREAKER_COOLDOWN_SECONDS,
+                        self._failures, cooldown,
                     )
-            if not self.fallback_local:
-                raise
             log.warning(
                 "solver service %s unavailable (%s); solving in-process",
                 self.endpoint, type(err).__name__,

@@ -40,10 +40,20 @@ class SolverServer:
         self._default_shards = shards
         self._solve_lock = threading.Lock()
         self.requests_served = 0
+        self.requests_started = 0
+        # set the moment the FIRST request enters the handler: lets
+        # chaos/kill tests land a shutdown deterministically mid-stream
+        # instead of racing a sleep against the serve loop
+        self.request_started = threading.Event()
 
         def solve_handler(request: bytes, context) -> bytes:
+            from karpenter_tpu.solver import faults
             from karpenter_tpu.solver.pack import solve_packing
 
+            with self._solve_lock:
+                self.requests_started += 1
+            self.request_started.set()
+            faults.fire("rpc_server")
             enc, mode, max_nodes, _, plan = codec.decode_request(request)
             with self._solve_lock:
                 result = solve_packing(

@@ -315,6 +315,8 @@ class LaneSolver:
             hit = chunk_cache.get(ci)
             if hit is not None:
                 return hit
+            from karpenter_tpu.solver import faults, resilience
+
             chunk = list(range(ci * width, min((ci + 1) * width, L)))
             # counted once per chunk — cap-regrow retries re-dispatch
             # (counted as batch + capped_retry) but don't re-ship lanes
@@ -382,31 +384,49 @@ class LaneSolver:
                 N = Ep + F_try
                 W = Cp // 32
                 SOLVER_PROBE_BATCH.inc({"outcome": "batch"})
-                if solo:
-                    flat = np.asarray(pack_split_flat(
-                        jnp.asarray(compat_c), jnp.asarray(req_c),
-                        jnp.asarray(counts_c),
-                        shared[2], shared[3], shared[4],
-                        jnp.asarray(bcompat_c),
-                        shared[6], shared[7], shared[8],
-                        jnp.asarray(live_row), shared[9],
-                        max_free=F_try, mode=mode, cfg_rsv=cfg_rsv_j,
-                        rsv_cap=rsv_cap_j, conflict=conflict_c,
-                    ))[None, :]
-                else:
-                    Lp = _lane_bucket(len(chunk))
-                    counts_pad = np.zeros((Lp, Gp), np.int32)
-                    counts_pad[: len(chunk), :G] = counts[chunk]
-                    live_pad = np.zeros((Lp, Ep), bool)
-                    live_pad[: len(chunk), :E] = live[chunk]
-                    flat = np.asarray(pack_probe_lanes_flat(
-                        shared[0], shared[1], jnp.asarray(counts_pad),
-                        shared[2], shared[3], shared[4], shared[5],
-                        shared[6], shared[7], shared[8],
-                        jnp.asarray(live_pad), shared[9],
-                        max_free=F_try, mode=mode, cfg_rsv=cfg_rsv_j,
-                        rsv_cap=rsv_cap_j, conflict=conflict_j,
-                    ))
+                # device-bound probe dispatch: the fault site chaos
+                # drives (`...@probe`), with breaker bookkeeping so a
+                # faulting device stops attracting probe batches — the
+                # raised error falls through the verdict wrapper to
+                # the sequential path, whose own solve rides the
+                # resilience ladder down to the host oracle
+                try:
+                    faults.fire("probe")
+                    if solo:
+                        flat = np.asarray(pack_split_flat(
+                            jnp.asarray(compat_c), jnp.asarray(req_c),
+                            jnp.asarray(counts_c),
+                            shared[2], shared[3], shared[4],
+                            jnp.asarray(bcompat_c),
+                            shared[6], shared[7], shared[8],
+                            jnp.asarray(live_row), shared[9],
+                            max_free=F_try, mode=mode, cfg_rsv=cfg_rsv_j,
+                            rsv_cap=rsv_cap_j, conflict=conflict_c,
+                        ))[None, :]
+                    else:
+                        Lp = _lane_bucket(len(chunk))
+                        counts_pad = np.zeros((Lp, Gp), np.int32)
+                        counts_pad[: len(chunk), :G] = counts[chunk]
+                        live_pad = np.zeros((Lp, Ep), bool)
+                        live_pad[: len(chunk), :E] = live[chunk]
+                        flat = np.asarray(pack_probe_lanes_flat(
+                            shared[0], shared[1], jnp.asarray(counts_pad),
+                            shared[2], shared[3], shared[4], shared[5],
+                            shared[6], shared[7], shared[8],
+                            jnp.asarray(live_pad), shared[9],
+                            max_free=F_try, mode=mode, cfg_rsv=cfg_rsv_j,
+                            rsv_cap=rsv_cap_j, conflict=conflict_j,
+                        ))
+                except Exception as err:
+                    # only device-class failures charge the breaker: a
+                    # host-side staging bug (deterministic) must not
+                    # open it and exile ALL solves to the host oracle
+                    reason = resilience.classify(err)
+                    if reason in ("device_lost", "deadline",
+                                  "compile_timeout"):
+                        resilience.shared().breaker(
+                            "device").record_failure(reason)
+                    raise
                 o1 = N * Gp_used + F_try * W
                 # cheap cap check (a few ints per lane): a capped
                 # lane's truncated answer must never be served, so the
@@ -578,7 +598,10 @@ class BatchProbeSolver:
     def usable(self) -> bool:
         """False when the sequential path would not run the in-process
         device kernel — matching its backend is part of the oracle
-        contract."""
+        contract — or when the device breaker is open (a faulting
+        device must not attract whole probe batches that each burn a
+        failure before degrading; the sequential path's ladder goes
+        straight to the working rung)."""
         import os
 
         if os.environ.get("KARPENTER_SOLVER_BACKEND", "jax") == "host":
@@ -590,6 +613,12 @@ class BatchProbeSolver:
                 return False
         except Exception:
             pass
+        from karpenter_tpu.solver import resilience
+
+        if resilience.shared().breaker("device").is_open():
+            log.warning(
+                "device breaker open; consolidation probing sequentially")
+            return False
         return True
 
     def _batch_eligible(self, pods: Sequence[Pod]) -> tuple[bool, set[str]]:

@@ -1,0 +1,230 @@
+"""Deterministic, spec-driven fault injector for the solver stack.
+
+The resilience layer (solver/resilience.py) only earns trust if its
+failure paths can be driven on demand and REPLAYED exactly: a chaos
+test that sometimes loses the device on the 3rd solve and sometimes on
+the 4th proves nothing. This injector is therefore sequence-, not
+time-based: every instrumented call site ("solve", "compile",
+"execute", "probe", "warm", "rpc", "rpc_server") keeps a monotonically
+increasing per-site counter, and a rule fires on exact occurrence
+numbers of that counter. Two runs of the same workload under the same
+spec produce byte-identical fault sequences (see `snapshot_log`).
+
+Spec grammar (KARPENTER_FAULTS, comma-separated entries):
+
+    entry  = kind [ "@" site ] [ ":" occ ] [ "=" duration ]
+    kind   = device_lost | rpc_drop | compile_delay | exec_delay
+    occ    = "*" | N | N "+" | N "-" M        (1-based, per site)
+
+Examples:
+    device_lost@solve:3        third device solve raises DeviceLostError
+    rpc_drop@probe:*           every batched-probe dispatch drops
+    compile_delay=5s           every kernel dispatch sleeps 5s first
+    rpc_drop@rpc:2-4           RPCs 2..4 drop, then the service heals
+
+Default sites per kind: device_lost -> solve, rpc_drop -> rpc,
+compile_delay -> compile, exec_delay -> execute. Error kinds raise
+their exception at the site; delay kinds sleep there (inflating the
+phase the watchdog budgets). Instrumented sites:
+
+    solve       pack._run_pack, once per kernel attempt
+    compile     pack._run_pack, just before the jitted dispatch
+    execute     pack fetch, just before blocking on the device buffer
+    probe       consolidation_batch chunk dispatch (batched ladder)
+    warm        warm_pool per-bucket AOT compile
+    rpc         service client, before sending the RPC
+    rpc_server  service server, inside the Solve handler
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+log = logging.getLogger("karpenter.solver.faults")
+
+ENV_SPEC = "KARPENTER_FAULTS"
+
+SITES = ("solve", "compile", "execute", "probe", "warm", "rpc", "rpc_server")
+
+_DEFAULT_SITE = {
+    "device_lost": "solve",
+    "rpc_drop": "rpc",
+    "compile_delay": "compile",
+    "exec_delay": "execute",
+}
+
+_ERROR_KINDS = ("device_lost", "rpc_drop")
+
+
+class FaultError(RuntimeError):
+    """Base class for injected faults (classified by resilience)."""
+
+
+class DeviceLostError(FaultError):
+    """Injected stand-in for an XLA runtime / device-lost failure."""
+
+
+class RpcDropError(FaultError):
+    """Injected stand-in for an unreachable solver service."""
+
+
+@dataclass(frozen=True)
+class FaultRule:
+    kind: str
+    site: str
+    lo: int            # 1-based first occurrence; 0 == every occurrence
+    hi: int            # last occurrence inclusive; -1 == open-ended
+    delay: float = 0.0
+
+    def matches(self, seq: int) -> bool:
+        if self.lo == 0:
+            return True
+        if seq < self.lo:
+            return False
+        return self.hi < 0 or seq <= self.hi
+
+
+def _parse_duration(text: str) -> float:
+    text = text.strip().lower()
+    if text.endswith("ms"):
+        return float(text[:-2]) / 1000.0
+    if text.endswith("s"):
+        return float(text[:-1])
+    return float(text)
+
+
+def parse(spec: str) -> list[FaultRule]:
+    """Parse a KARPENTER_FAULTS spec. Malformed entries are dropped
+    with a warning — chaos knobs must never take the operator down."""
+    rules: list[FaultRule] = []
+    for raw in (spec or "").split(","):
+        raw = raw.strip()
+        if not raw:
+            continue
+        try:
+            body, _, param = raw.partition("=")
+            head, _, occ = body.partition(":")
+            kind, _, site = head.partition("@")
+            kind = kind.strip()
+            site = site.strip() or _DEFAULT_SITE.get(kind, "solve")
+            if kind not in _DEFAULT_SITE:
+                raise ValueError(f"unknown kind {kind!r}")
+            if site not in SITES:
+                raise ValueError(f"unknown site {site!r}")
+            occ = occ.strip()
+            if not occ or occ == "*":
+                lo, hi = 0, -1
+            elif occ.endswith("+"):
+                lo, hi = int(occ[:-1]), -1
+            elif "-" in occ:
+                a, b = occ.split("-", 1)
+                lo, hi = int(a), int(b)
+            else:
+                lo = hi = int(occ)
+            if (occ and occ != "*" and lo < 1) or (hi >= 0 and hi < lo):
+                raise ValueError(f"bad occurrence range {occ!r}")
+            delay = _parse_duration(param) if param else 0.0
+            if kind.endswith("_delay") and delay <= 0.0:
+                raise ValueError("delay kind needs a =duration")
+            rules.append(FaultRule(kind, site, lo, hi, delay))
+        except (ValueError, IndexError) as err:
+            log.warning("ignoring malformed fault entry %r: %s", raw, err)
+    return rules
+
+
+class FaultInjector:
+    """Applies parsed rules against per-site sequence counters.
+
+    Thread-safe; the counters (not wall time) are the replay clock, so
+    concurrent call sites interleave but each site's own sequence —
+    and therefore which of its calls fault — is deterministic."""
+
+    def __init__(self, rules: Sequence[FaultRule], sleep=time.sleep):
+        self.rules = list(rules)
+        self._sleep = sleep
+        self._seq: dict[str, int] = {}
+        self._lock = threading.Lock()
+        self.log: list[tuple[str, int, str]] = []  # (site, seq, kind)
+
+    def fire(self, site: str) -> None:
+        """Advance `site`'s sequence counter and apply matching rules:
+        delays sleep in the caller, then the first error kind raises."""
+        with self._lock:
+            seq = self._seq.get(site, 0) + 1
+            self._seq[site] = seq
+            hits = [r for r in self.rules
+                    if r.site == site and r.matches(seq)]
+            for rule in hits:
+                self.log.append((site, seq, rule.kind))
+        if not hits:
+            return
+        from karpenter_tpu.metrics.store import SOLVER_FAULTS_INJECTED
+
+        error: Optional[FaultError] = None
+        for rule in hits:
+            SOLVER_FAULTS_INJECTED.inc({"site": site, "kind": rule.kind})
+            if rule.kind.endswith("_delay"):
+                log.warning("fault injected: %s@%s:%d sleeping %.3fs",
+                            rule.kind, site, seq, rule.delay)
+                self._sleep(rule.delay)
+            elif error is None:
+                if rule.kind == "device_lost":
+                    error = DeviceLostError(
+                        f"injected device_lost@{site}:{seq}")
+                elif rule.kind == "rpc_drop":
+                    error = RpcDropError(f"injected rpc_drop@{site}:{seq}")
+        if error is not None:
+            log.warning("fault injected: %s", error)
+            raise error
+
+    def snapshot_log(self) -> list[tuple[str, int, str]]:
+        """Copy of the fired-fault log: (site, per-site seq, kind) in
+        firing order — the replay-identity artifact chaos tests diff."""
+        with self._lock:
+            return list(self.log)
+
+
+# -- env-driven singleton -----------------------------------------------------
+
+_active: Optional[FaultInjector] = None
+_active_spec: Optional[str] = None
+_active_lock = threading.Lock()
+
+
+def get() -> Optional[FaultInjector]:
+    """The active injector per KARPENTER_FAULTS, or None. A changed
+    spec builds a fresh injector with zeroed counters, so tests that
+    re-point the env replay from occurrence 1."""
+    spec = os.environ.get(ENV_SPEC, "")
+    global _active, _active_spec
+    if not spec:
+        if _active is not None:
+            with _active_lock:
+                _active, _active_spec = None, None
+        return None
+    if spec != _active_spec:
+        with _active_lock:
+            if spec != _active_spec:
+                _active = FaultInjector(parse(spec))
+                _active_spec = spec
+    return _active
+
+
+def reset() -> None:
+    """Zero the active injector's counters (fresh replay, same spec)."""
+    global _active, _active_spec
+    with _active_lock:
+        _active, _active_spec = None, None
+
+
+def fire(site: str) -> None:
+    """Module-level hook the instrumented sites call. No-op (one dict
+    lookup) when KARPENTER_FAULTS is unset."""
+    injector = get()
+    if injector is not None:
+        injector.fire(site)

@@ -1018,7 +1018,9 @@ def _run_pack(
     import time as _time
 
     from karpenter_tpu.metrics.store import SOLVER_PHASE_DURATION
+    from karpenter_tpu.solver import faults
 
+    faults.fire("solve")
     _t_stage = _time.perf_counter()
 
     G, C = enc.compat.shape
@@ -1162,6 +1164,7 @@ def _run_pack(
     SOLVER_PHASE_DURATION.observe(
         _t_dispatch - _t_stage, {"phase": "transfer"}
     )
+    faults.fire("compile")
     flat_dev = pack_split_flat(
         compat_j,
         rest["group_req"],
@@ -1186,6 +1189,11 @@ def _run_pack(
     SOLVER_PHASE_DURATION.observe(
         _time.perf_counter() - _t_dispatch, {"phase": "compile"}
     )
+    # compile finished: release the watchdog's compile budget (the
+    # execute budget keeps running until fetch)
+    from karpenter_tpu.solver import resilience
+
+    resilience.note_dispatched()
     # dispatch returned immediately (async device execution); capture
     # only host arrays in the closure so the fetch can rebuild what the
     # compact buffer leaves out
@@ -1196,6 +1204,7 @@ def _run_pack(
     eused = bound_used_h
 
     def fetch() -> PackResult:
+        faults.fire("execute")
         _t_exec = _time.perf_counter()
         flat = np.asarray(flat_dev)  # the one device->host fetch
         SOLVER_PHASE_DURATION.observe(

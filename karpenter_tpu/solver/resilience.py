@@ -1,0 +1,837 @@
+"""Solver resilience layer: deadlines, circuit breakers, degradation
+ladder, and an FFD hedge around every device-bound solve.
+
+The north star moves both hot paths (provisioning pack, consolidation
+probes) onto a TPU-backed solver — which means a wedged device, a hung
+XLA compile, or a dead gRPC solver service could stall the reconcile
+tick, the one thing the control plane must never do. This module is
+the answer: `ResilientSolver` wraps the `solver._solve_packing` seam
+with three mechanisms, and guarantees EVERY solve returns a decision —
+degraded, perhaps, but never absent and never late past the deadline.
+
+1. **Deadline watchdog** (`KARPENTER_SOLVE_DEADLINE_MS`,
+   `KARPENTER_COMPILE_DEADLINE_MS`): with a deadline set, each rung's
+   attempt runs on a watchdog thread. The compile phase is budgeted
+   separately — pack._run_pack signals `note_dispatched()` once the
+   jitted dispatch returns (compile done), so a hung XLA compile is
+   distinguished from a slow execute and classified `compile_timeout`.
+   A deadline miss abandons the attempt (the stuck thread keeps the
+   device; the breaker keeps callers off it) and falls down the
+   ladder. Unset (the default) the attempt runs inline — a try/except
+   around the exact code that ran before, so the healthy path pays
+   nothing.
+
+2. **Per-backend circuit breaker**: `closed -> open` after
+   `KARPENTER_BREAKER_THRESHOLD` consecutive classified failures
+   (device_lost / xla_runtime, compile_timeout, deadline,
+   rpc_unavailable); while open, the rung is skipped outright (no
+   deadline burned per tick). Cooldowns are jittered exponential
+   (KARPENTER_BREAKER_COOLDOWN_MS base, _MAX_COOLDOWN_MS cap, full
+   desynchronizing jitter). After the cooldown one half-open probe is
+   admitted; its success closes the breaker — gated, for device
+   backends with KARPENTER_REWARM_ON_CLOSE=1, on a warm-pool canary
+   re-compile proving XLA actually serves again — and its failure
+   re-opens with a doubled cooldown.
+
+3. **Degradation ladder**: sharded-device -> single-device -> remote
+   service -> host FFD oracle (`reference_ffd`). Rung order is derived
+   from the environment (`auto`): a configured
+   KARPENTER_SOLVER_ENDPOINT promotes the remote service to the first
+   rung (the operator's statement that the device lives off-host —
+   preserving the service seam's routing semantics), local device
+   rungs follow, and the host oracle is always last and cannot fail.
+   KARPENTER_SOLVE_LADDER="sharded,device,remote,host" overrides the
+   order explicitly. The optional **hedge**
+   (KARPENTER_SOLVE_HEDGE_MS) starts the host FFD solve on a timer
+   thread mid-attempt, so when a slow device does miss the deadline
+   the degraded answer is already computed — a hedge that supplies the
+   returned result counts as a `win` in karpenter_solver_hedge_total.
+
+Observability: karpenter_solver_breaker_state (0 closed / 1 half-open
+/ 2 open), _breaker_transitions_total, _ladder_total{rung,outcome},
+_deadline_exceeded_total{phase}, _hedge_total{outcome}. Degraded
+solves are recorded per-thread; the scheduler pops them
+(`pop_degraded`) to log which rung actually served its tick.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import random
+import threading
+import time
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+log = logging.getLogger("karpenter.solver.resilience")
+
+STATE_CLOSED = 0.0
+STATE_HALF_OPEN = 1.0
+STATE_OPEN = 2.0
+
+RUNGS = ("remote", "sharded", "device", "host")
+
+
+def _env_ms(name: str, default: float = 0.0) -> float:
+    """Millisecond env knob -> seconds; 0/unset/malformed disables."""
+    raw = os.environ.get(name, "")
+    if not raw:
+        return default
+    try:
+        return max(0.0, float(raw) / 1000.0)
+    except ValueError:
+        log.warning("ignoring malformed %s=%r", name, raw)
+        return default
+
+
+def _env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name, "")
+    if not raw:
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        return default
+
+
+class DeadlineExceeded(TimeoutError):
+    """The watchdog abandoned a rung attempt past its budget."""
+
+    phase = "execute"
+
+
+class CompileDeadlineExceeded(DeadlineExceeded):
+    """The kernel dispatch (trace + XLA compile) blew its own budget."""
+
+    phase = "compile"
+
+
+def classify(err: BaseException) -> str:
+    """Failure taxonomy driving the breaker: which class of fault a
+    rung failure belongs to. Anything unrecognized still degrades the
+    solve (the ladder catches every exception) but counts as plain
+    `error`."""
+    from karpenter_tpu.solver import faults
+
+    if isinstance(err, faults.RpcDropError):
+        return "rpc_unavailable"
+    if isinstance(err, faults.DeviceLostError):
+        return "device_lost"
+    if isinstance(err, CompileDeadlineExceeded):
+        return "compile_timeout"
+    if isinstance(err, DeadlineExceeded):
+        return "deadline"
+    tname = type(err).__name__
+    module = type(err).__module__ or ""
+    if tname in ("XlaRuntimeError", "InternalError") or module.startswith(
+        ("jaxlib", "jax")
+    ):
+        return "device_lost"
+    if tname in ("RpcError", "_InactiveRpcError", "_MultiThreadedRendezvous",
+                 "FutureTimeoutError") or module.startswith("grpc"):
+        return "rpc_unavailable"
+    if isinstance(err, (ConnectionError, OSError, TimeoutError)):
+        return "rpc_unavailable"
+    return "error"
+
+
+class CircuitBreaker:
+    """Closed -> open -> half-open breaker with jittered exponential
+    cooldowns and an optional gate on the close transition."""
+
+    def __init__(
+        self,
+        name: str,
+        threshold: Optional[int] = None,
+        base_cooldown: Optional[float] = None,
+        max_cooldown: Optional[float] = None,
+        rng: Optional[random.Random] = None,
+        close_gate: Optional[Callable[[], bool]] = None,
+    ):
+        self.name = name
+        self._threshold = threshold
+        self._base = base_cooldown
+        self._max = max_cooldown
+        self._rng = rng or random.Random()
+        self.close_gate = close_gate
+        self._lock = threading.Lock()
+        self._state = STATE_CLOSED
+        self._failures = 0
+        self._open_cycles = 0
+        self._retry_at = 0.0
+        self._publish(STATE_CLOSED, transition=False)
+
+    # knobs read per call so tests (and live re-tuning) take effect
+    # without rebuilding breakers
+    def _threshold_now(self) -> int:
+        if self._threshold is not None:
+            return self._threshold
+        return max(1, _env_int("KARPENTER_BREAKER_THRESHOLD", 2))
+
+    def _cooldown(self) -> float:
+        base = (
+            self._base
+            if self._base is not None
+            else _env_ms("KARPENTER_BREAKER_COOLDOWN_MS", 5.0)
+        ) or 5.0
+        cap = (
+            self._max
+            if self._max is not None
+            else _env_ms("KARPENTER_BREAKER_MAX_COOLDOWN_MS", 120.0)
+        ) or 120.0
+        from karpenter_tpu.utils.backoff import capped_exponential, jitter
+
+        # desynchronizing jitter: a fleet of control planes tripped by
+        # the same outage must not re-probe in lockstep when it heals
+        return capped_exponential(self._open_cycles, base, cap) * jitter(
+            self._rng
+        )
+
+    def _publish(self, state: float, transition: bool = True) -> None:
+        from karpenter_tpu.metrics.store import (
+            SOLVER_BREAKER_STATE,
+            SOLVER_BREAKER_TRANSITIONS,
+        )
+
+        self._state = state
+        SOLVER_BREAKER_STATE.set(state, {"backend": self.name})
+        if transition:
+            label = {STATE_CLOSED: "closed", STATE_HALF_OPEN: "half_open",
+                     STATE_OPEN: "open"}[state]
+            SOLVER_BREAKER_TRANSITIONS.inc(
+                {"backend": self.name, "to": label})
+
+    @property
+    def state(self) -> float:
+        return self._state
+
+    def is_open(self, now: Optional[float] = None) -> bool:
+        """Open AND still cooling down (a breaker past its cooldown is
+        about to half-open, so callers planning work may try it)."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            return self._state == STATE_OPEN and now < self._retry_at
+
+    def _probe_ttl(self) -> float:
+        """How long a half-open probe may stay verdict-less before the
+        breaker admits another (a probe abandoned by the deadline
+        watchdog must not wedge the breaker half-open forever)."""
+        base = (
+            self._base
+            if self._base is not None
+            else _env_ms("KARPENTER_BREAKER_COOLDOWN_MS", 5.0)
+        )
+        return base or 5.0
+
+    def allow(self, now: Optional[float] = None) -> bool:
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            if self._state == STATE_CLOSED:
+                return True
+            if self._state == STATE_OPEN and now >= self._retry_at:
+                # admit exactly one half-open probe; a concurrent
+                # caller arriving before its verdict stays skipped
+                self._publish(STATE_HALF_OPEN)
+                self._retry_at = now + self._probe_ttl()
+                log.info("breaker %s half-open: probing", self.name)
+                return True
+            if self._state == STATE_HALF_OPEN and now >= self._retry_at:
+                # the admitted probe never reported (abandoned attempt)
+                self._retry_at = now + self._probe_ttl()
+                return True
+            return False
+
+    def record_success(self) -> None:
+        with self._lock:
+            if self._state == STATE_CLOSED:
+                self._failures = 0
+                return
+            gate = self.close_gate
+        # the gate (warm-pool re-warm) runs OUTSIDE the lock: it can
+        # compile for seconds and concurrent solves must keep flowing
+        # through their own rungs meanwhile
+        gate_ok = True
+        if gate is not None:
+            try:
+                gate_ok = bool(gate())
+            except Exception:
+                log.exception("breaker %s close gate crashed", self.name)
+                gate_ok = False
+        with self._lock:
+            if not gate_ok:
+                self._open_cycles += 1
+                self._retry_at = time.monotonic() + self._cooldown()
+                self._publish(STATE_OPEN)
+                log.warning(
+                    "breaker %s: half-open probe succeeded but the "
+                    "re-warm gate failed; staying open", self.name)
+                return
+            self._failures = 0
+            self._open_cycles = 0
+            self._publish(STATE_CLOSED)
+            log.info("breaker %s closed", self.name)
+
+    def record_failure(self, reason: str) -> None:
+        with self._lock:
+            if self._state == STATE_HALF_OPEN:
+                self._open_cycles += 1
+            else:
+                self._failures += 1
+                if self._failures < self._threshold_now():
+                    return
+                self._open_cycles += 1
+            cooldown = self._cooldown()
+            self._retry_at = time.monotonic() + cooldown
+            self._failures = 0
+            self._publish(STATE_OPEN)
+            log.warning(
+                "breaker %s open (%s): cooling down %.2fs",
+                self.name, reason, cooldown)
+
+    def force_close(self) -> None:
+        """Test/ops escape hatch: reset to closed immediately."""
+        with self._lock:
+            self._failures = 0
+            self._open_cycles = 0
+            self._retry_at = 0.0
+            self._publish(STATE_CLOSED, transition=False)
+
+
+# -- host FFD oracle as a PackResult ------------------------------------------
+
+
+def host_pack_result(enc, max_nodes: int = 0, mode: str = "ffd"):
+    """The decision of last resort: the pure-Python FFD oracle
+    (`reference_ffd.solve_ffd_host`) decoded into the same PackResult
+    shape the device kernel produces, so the ladder degrades without
+    changing a single downstream decode path. `mode` is accepted for
+    signature parity; the oracle always packs FFD — exactly the floor
+    the cost objective races against, so a degraded cost solve returns
+    the race's guaranteed-no-worse baseline."""
+    from karpenter_tpu.solver.pack import PackResult
+    from karpenter_tpu.solver.reference_ffd import solve_ffd_host
+
+    nodes, unsched = solve_ffd_host(enc)
+    G, C = enc.compat.shape
+    R = enc.group_req.shape[1]
+    n = len(nodes)
+    assign = np.zeros((n, G), np.int32)
+    node_mask = np.zeros((n, C), bool)
+    node_used = np.zeros((n, R), np.float64)
+    for ni, node in enumerate(nodes):
+        node_mask[ni] = node.mask
+        node_used[ni] = node.used
+        for gi, count in node.assign.items():
+            assign[ni, gi] = count
+    unsched_arr = np.zeros(G, np.int32)
+    for gi, count in unsched.items():
+        unsched_arr[gi] = count
+    return PackResult(
+        assign=assign,
+        node_mask=node_mask,
+        node_used=node_used,
+        node_active=np.ones(n, bool),
+        node_count=n,
+        unschedulable=unsched_arr,
+    )
+
+
+# -- watchdog plumbing --------------------------------------------------------
+
+_tlocal = threading.local()
+
+# abandoned watchdog attempts still run their (possibly wedged) device
+# call on daemon threads; at interpreter shutdown a daemon thread
+# inside native XLA code dies with a C++ `terminate` (the same failure
+# warm_pool documents). The shutdown hook below — registered via
+# threading's internal hooks, which run BEFORE daemon threads are
+# killed — drains live attempts with a bounded join: injected-fault
+# attempts (sleeps) finish quickly; a truly wedged device forfeits the
+# budget and the process exits anyway (it was exiting regardless).
+_watchdog_threads: set = set()
+_watchdog_lock = threading.Lock()
+
+
+def _drain_watchdogs(budget: float = 10.0) -> None:
+    deadline = time.monotonic() + budget
+    with _watchdog_lock:
+        live = list(_watchdog_threads)
+    for thread in live:
+        thread.join(max(0.0, deadline - time.monotonic()))
+
+
+_register = getattr(threading, "_register_atexit", None)
+if _register is not None:  # CPython 3.9+
+    _register(_drain_watchdogs)
+else:  # pragma: no cover - very old interpreters: bounded daemon risk
+    import atexit
+
+    atexit.register(_drain_watchdogs)
+
+
+def note_dispatched() -> None:
+    """Called by pack._run_pack the moment the jitted dispatch returns
+    (== compile finished). Lets the watchdog budget the compile phase
+    separately from execute. No-op outside a watchdog attempt."""
+    ctx = getattr(_tlocal, "attempt", None)
+    if ctx is not None:
+        ctx["dispatched"].set()
+
+
+def _served_list() -> list:
+    """The CALLER thread's degradation accumulator. Captured at the
+    public solve entry points and passed through explicitly, so ladders
+    running on watchdog/executor threads still report into the thread
+    that will pop_degraded() (the scheduler's)."""
+    stack = getattr(_tlocal, "served", None)
+    if stack is None:
+        stack = _tlocal.served = []
+    return stack
+
+
+def _note_rung(served: Optional[list], rung: str, degraded: bool) -> None:
+    if degraded and served is not None:
+        served.append(rung)
+
+
+def pop_degraded() -> list[str]:
+    """Rungs (other than the primary) that served this thread's solves
+    since the last pop — the scheduler's per-tick degradation report."""
+    stack = getattr(_tlocal, "served", None)
+    if not stack:
+        return []
+    out = list(stack)
+    stack.clear()
+    return out
+
+
+class _LazyPending:
+    """PendingPack-compatible wrapper over a deferred resilient solve."""
+
+    def __init__(self, thunk):
+        self._thunk = thunk
+        self._result = None
+
+    def result(self):
+        if self._result is None:
+            self._result = self._thunk()
+        return self._result
+
+
+class _GuardedPending:
+    """A first-rung async dispatch whose fetch falls down the ladder."""
+
+    def __init__(self, solver: "ResilientSolver", rung: str, pending,
+                 ladder_tail: Callable):
+        self._solver = solver
+        self._rung = rung
+        self._pending = pending
+        self._tail = ladder_tail
+        self._result = None
+
+    def result(self):
+        if self._result is not None:
+            return self._result
+        br = self._solver.breaker(self._rung)
+        try:
+            out = self._pending.result()
+        except Exception as err:
+            reason = classify(err)
+            br.record_failure(reason)
+            _ladder_count(self._rung, reason)
+            log.warning("solver rung %s failed at fetch (%s: %s); "
+                        "degrading", self._rung, reason, err)
+            out = self._tail()
+        else:
+            br.record_success()
+            _ladder_count(self._rung, "ok")
+        self._result = out
+        return out
+
+
+def _ladder_count(rung: str, outcome: str) -> None:
+    from karpenter_tpu.metrics.store import SOLVER_LADDER
+
+    SOLVER_LADDER.inc({"rung": rung, "outcome": outcome})
+
+
+class ResilientSolver:
+    """The solve seam's resilience wrapper; one per process (shared())
+    so breaker state survives across ticks and callers."""
+
+    def __init__(self):
+        self._breakers: dict[str, CircuitBreaker] = {}
+        self._breaker_lock = threading.Lock()
+        self._executor = None
+        self._executor_lock = threading.Lock()
+
+    # -- breakers ------------------------------------------------------------
+
+    def breaker(self, name: str) -> CircuitBreaker:
+        with self._breaker_lock:
+            br = self._breakers.get(name)
+            if br is None:
+                gate = (
+                    self._rewarm_gate
+                    if name in ("device", "sharded") else None
+                )
+                br = CircuitBreaker(name, close_gate=gate)
+                self._breakers[name] = br
+            return br
+
+    def _rewarm_gate(self) -> bool:
+        """Close-transition gate for device backends: with
+        KARPENTER_REWARM_ON_CLOSE=1, a half-open success only closes
+        the breaker after a warm-pool canary compile proves XLA and
+        the device serve again (a device that answers one cached-shape
+        probe but can't compile would otherwise flap)."""
+        if os.environ.get("KARPENTER_REWARM_ON_CLOSE", "").lower() not in (
+            "1", "true", "on"
+        ):
+            return True
+        from karpenter_tpu.solver.warm_pool import rewarm_canary
+
+        return rewarm_canary()
+
+    def reset(self) -> None:
+        """Drop all breaker state (tests)."""
+        with self._breaker_lock:
+            self._breakers.clear()
+
+    # -- ladder --------------------------------------------------------------
+
+    def _rungs(self, shards: int) -> list[str]:
+        spec = os.environ.get("KARPENTER_SOLVE_LADDER", "auto").strip()
+        endpoint = self._endpoint()
+        if spec and spec != "auto":
+            names = [n.strip() for n in spec.split(",") if n.strip()]
+            names = [n for n in names if n in RUNGS]
+            names = [n for n in names if n != "remote" or endpoint]
+        else:
+            names = []
+            if endpoint:
+                # an explicit endpoint is the operator saying the
+                # device lives off-host: the service outranks the
+                # (typically device-less) local backend
+                names.append("remote")
+            if self._effective_shards(shards) > 1:
+                names.append("sharded")
+            names.append("device")
+        if "host" not in names:
+            names.append("host")
+        # host is the unconditional floor, always last
+        names = [n for n in names if n != "host"] + ["host"]
+        return names
+
+    @staticmethod
+    def _endpoint() -> Optional[str]:
+        from karpenter_tpu.service.client import endpoint_from_env
+
+        return endpoint_from_env()
+
+    @staticmethod
+    def _effective_shards(shards: int) -> int:
+        if shards > 1:
+            return shards
+        if shards == 0:
+            from karpenter_tpu.solver.pack import default_shards
+
+            return default_shards()
+        return 1
+
+    def _rung_fn(self, name: str, enc, max_nodes, mode, plan, shards):
+        if name == "sharded":
+            from karpenter_tpu.solver.pack import solve_packing
+
+            eff = self._effective_shards(shards)
+            return lambda: solve_packing(
+                enc, max_nodes=max_nodes, mode=mode, plan=plan, shards=eff)
+        if name == "device":
+            from karpenter_tpu.solver.pack import solve_packing
+
+            # shards=1 forces the unsharded program even when the env
+            # asks for a mesh — this rung IS the single-device fallback
+            eff = 1 if self._effective_shards(shards) > 1 else shards
+            return lambda: solve_packing(
+                enc, max_nodes=max_nodes, mode=mode, plan=plan, shards=eff)
+        if name == "remote":
+            client = self._remote_client()
+            if client is None:
+                raise LookupError("no remote endpoint configured")
+            return lambda: client.solve_packing(
+                enc, max_nodes=max_nodes, mode=mode, plan=plan,
+                shards=shards, fallback=False)
+        if name == "host":
+            return lambda: host_pack_result(enc, max_nodes, mode)
+        raise LookupError(name)
+
+    @staticmethod
+    def _remote_client():
+        # the client cache lives in solver.py (tests reset it there);
+        # lazy import avoids a module cycle
+        from karpenter_tpu.solver import solver as solver_mod
+
+        return solver_mod._remote_client()
+
+    # -- attempts ------------------------------------------------------------
+
+    def _attempt(self, name: str, fn: Callable, budget: Optional[float],
+                 compile_budget: float):
+        """One rung attempt. Without budgets: inline (zero overhead).
+        With budgets: on a watchdog thread, compile and execute phases
+        budgeted separately; a miss abandons the thread (daemon — the
+        wedged device call cannot hold a pool slot hostage)."""
+        if not budget and not compile_budget:
+            return fn()
+        ctx = {
+            "dispatched": threading.Event(),
+            "done": threading.Event(),
+            "result": None,
+            "error": None,
+        }
+
+        def run():
+            _tlocal.attempt = ctx
+            try:
+                ctx["result"] = fn()
+            except BaseException as err:  # noqa: BLE001 — re-raised below
+                ctx["error"] = err
+            finally:
+                _tlocal.attempt = None
+                ctx["done"].set()
+                # a failure BEFORE the kernel dispatch (dead device
+                # raising instantly) must release the compile-budget
+                # wait immediately, not let it sleep out the budget
+                ctx["dispatched"].set()
+                with _watchdog_lock:
+                    _watchdog_threads.discard(threading.current_thread())
+
+        thread = threading.Thread(
+            target=run, name=f"solve-watchdog-{name}", daemon=True)
+        with _watchdog_lock:
+            _watchdog_threads.add(thread)
+        start = time.monotonic()
+        thread.start()
+        from karpenter_tpu.metrics.store import SOLVER_DEADLINE_EXCEEDED
+
+        if compile_budget and name in ("device", "sharded"):
+            if not ctx["dispatched"].wait(compile_budget) and not ctx[
+                "done"
+            ].is_set():
+                SOLVER_DEADLINE_EXCEEDED.inc({"phase": "compile"})
+                raise CompileDeadlineExceeded(
+                    f"{name}: kernel dispatch exceeded "
+                    f"{compile_budget * 1000:.0f}ms compile budget")
+        if budget:
+            remaining = budget - (time.monotonic() - start)
+            if not ctx["done"].wait(max(0.0, remaining)):
+                SOLVER_DEADLINE_EXCEEDED.inc({"phase": "execute"})
+                raise DeadlineExceeded(
+                    f"{name}: solve exceeded {budget * 1000:.0f}ms budget")
+        else:
+            ctx["done"].wait()
+        if ctx["error"] is not None:
+            raise ctx["error"]
+        return ctx["result"]
+
+    # -- solve ---------------------------------------------------------------
+
+    def solve_packing(self, enc, max_nodes: int = 0, mode: str = "ffd",
+                      plan=None, shards: int = 0):
+        names = self._rungs(shards)
+        return self._ladder(names, enc, max_nodes, mode, plan, shards,
+                            served=_served_list())
+
+    def _ladder(self, names: Sequence[str], enc, max_nodes, mode, plan,
+                shards, served: Optional[list] = None,
+                primary: Optional[str] = None):
+        from karpenter_tpu.metrics.store import (
+            SOLVER_DEADLINE_EXCEEDED,
+            SOLVER_HEDGE,
+        )
+
+        deadline = _env_ms("KARPENTER_SOLVE_DEADLINE_MS")
+        compile_budget = _env_ms("KARPENTER_COMPILE_DEADLINE_MS")
+        hedge_delay = _env_ms("KARPENTER_SOLVE_HEDGE_MS")
+        t_end = time.monotonic() + deadline if deadline else None
+        # `primary` survives ladder truncation (a tail ladder resumed
+        # after an async fetch failure must still report host as
+        # degraded relative to the ORIGINAL first rung)
+        primary = primary or names[0]
+
+        hedge: Optional[dict] = None
+        timer: Optional[threading.Timer] = None
+        if hedge_delay and primary != "host" and len(names) > 1:
+            hedge = {"fired": threading.Event(), "done": threading.Event(),
+                     "result": None, "cancel": False}
+
+            def hedge_run():
+                if hedge["cancel"]:
+                    return
+                hedge["fired"].set()
+                SOLVER_HEDGE.inc({"outcome": "fired"})
+                try:
+                    hedge["result"] = host_pack_result(enc, max_nodes, mode)
+                except Exception:
+                    log.exception("hedged host solve failed")
+                finally:
+                    hedge["done"].set()
+
+            timer = threading.Timer(hedge_delay, hedge_run)
+            timer.daemon = True
+            timer.start()
+
+        try:
+            for name in names:
+                if name == "host":
+                    break
+                br = self.breaker(name)
+                if not br.allow():
+                    _ladder_count(name, "skipped_open")
+                    continue
+                budget = None
+                if t_end is not None:
+                    budget = t_end - time.monotonic()
+                    if budget <= 0:
+                        # out of wall budget: the half-open admission
+                        # above was consumed without a verdict — leave
+                        # the breaker as-is and degrade straight down
+                        SOLVER_DEADLINE_EXCEEDED.inc({"phase": "total"})
+                        _ladder_count(name, "skipped_deadline")
+                        break
+                try:
+                    fn = self._rung_fn(
+                        name, enc, max_nodes, mode, plan, shards)
+                    result = self._attempt(name, fn, budget, compile_budget)
+                except Exception as err:
+                    reason = classify(err)
+                    br.record_failure(reason)
+                    _ladder_count(name, reason)
+                    log.warning("solver rung %s failed (%s: %s); degrading",
+                                name, reason, err)
+                    continue
+                br.record_success()
+                _ladder_count(name, "ok")
+                _note_rung(served, name, degraded=(name != primary))
+                if hedge is not None and hedge["fired"].is_set():
+                    SOLVER_HEDGE.inc({"outcome": "loss"})
+                return result
+
+            # every device/remote rung failed, was skipped, or the
+            # deadline ran out: the host oracle answers, via the hedge
+            # if it already fired
+            if hedge is not None:
+                timer.cancel()
+                if hedge["fired"].is_set():
+                    hedge["done"].wait()
+                    if hedge["result"] is not None:
+                        SOLVER_HEDGE.inc({"outcome": "win"})
+                        _ladder_count("host", "ok")
+                        _note_rung(served, "host",
+                                   degraded=(primary != "host"))
+                        return hedge["result"]
+            result = host_pack_result(enc, max_nodes, mode)
+            _ladder_count("host", "ok")
+            _note_rung(served, "host", degraded=(primary != "host"))
+            return result
+        finally:
+            if timer is not None:
+                timer.cancel()
+            if hedge is not None:
+                hedge["cancel"] = True
+
+    def solve_packing_async(self, enc, max_nodes: int = 0, mode: str = "ffd",
+                            plan=None, shards: int = 0):
+        """Async variant preserving the kernel's true async dispatch on
+        the healthy path: when the first rung is a local device rung
+        with a closed breaker and no deadline is configured, dispatch
+        through pack.solve_packing_async unchanged and guard only the
+        fetch. Anything else (remote-first, open breaker, deadlines,
+        hedge) runs the full resilient solve on a worker thread — the
+        caller still overlaps host work against it."""
+        names = self._rungs(shards)
+        served = _served_list()  # the caller thread's report sink
+        deadline_mode = (
+            _env_ms("KARPENTER_SOLVE_DEADLINE_MS")
+            or _env_ms("KARPENTER_COMPILE_DEADLINE_MS")
+            or _env_ms("KARPENTER_SOLVE_HEDGE_MS")
+        )
+        first = names[0]
+        if not deadline_mode and first in ("device", "sharded"):
+            br = self.breaker(first)
+            if br.allow():
+                from karpenter_tpu.solver.pack import solve_packing_async
+
+                eff = (
+                    self._effective_shards(shards)
+                    if first == "sharded"
+                    else (1 if self._effective_shards(shards) > 1 else shards)
+                )
+                tail = names[1:]
+                try:
+                    pending = solve_packing_async(
+                        enc, max_nodes=max_nodes, mode=mode, plan=plan,
+                        shards=eff)
+                except Exception as err:
+                    reason = classify(err)
+                    br.record_failure(reason)
+                    _ladder_count(first, reason)
+                    log.warning(
+                        "solver rung %s failed at dispatch (%s: %s); "
+                        "degrading", first, reason, err)
+                    return _LazyPending(lambda: self._ladder(
+                        tail, enc, max_nodes, mode, plan, shards,
+                        served=served, primary=first))
+                return _GuardedPending(
+                    self, first, pending,
+                    lambda: self._ladder(
+                        tail, enc, max_nodes, mode, plan, shards,
+                        served=served, primary=first))
+            _ladder_count(first, "skipped_open")
+            names = names[1:]
+            if names == ["host"]:
+                return _LazyPending(
+                    lambda: self._ladder(
+                        names, enc, max_nodes, mode, plan, shards,
+                        served=served, primary=first))
+        ex = self._get_executor()
+        return ex.submit(
+            self._ladder, names, enc, max_nodes, mode, plan, shards,
+            served=served, primary=first)
+
+    def _get_executor(self):
+        with self._executor_lock:
+            if self._executor is None:
+                from concurrent.futures import ThreadPoolExecutor
+
+                # sized like solver._rpc_executor: the cost objective's
+                # two concurrent solves plus sibling simulations
+                self._executor = ThreadPoolExecutor(
+                    max_workers=4, thread_name_prefix="solver-resilient")
+            return self._executor
+
+
+# -- process-wide instance ----------------------------------------------------
+
+_shared: Optional[ResilientSolver] = None
+_shared_lock = threading.Lock()
+
+
+def shared() -> ResilientSolver:
+    global _shared
+    if _shared is None:
+        with _shared_lock:
+            if _shared is None:
+                _shared = ResilientSolver()
+    return _shared
+
+
+def reset() -> None:
+    """Tests: drop breaker state and thread-local degradation notes."""
+    global _shared
+    with _shared_lock:
+        _shared = None
+    if getattr(_tlocal, "served", None):
+        _tlocal.served.clear()

@@ -193,43 +193,27 @@ def _remote_client():
 
 
 def _solve_packing(enc, **kwargs):
-    """The solver seam: with KARPENTER_SOLVER_ENDPOINT set, device
-    solves go to the gRPC solver service on the TPU hosts (DCN) —
-    SURVEY §5.8 — and fall back to the in-process kernel when it is
-    unreachable. Without it, solve locally."""
-    client = _remote_client()
-    if client is not None:
-        return client.solve_packing(enc, **kwargs)
-    from karpenter_tpu.solver.pack import solve_packing
+    """The solver seam, routed through the resilience layer
+    (solver/resilience.py): the degradation ladder tries the remote
+    service (when KARPENTER_SOLVER_ENDPOINT points at the TPU hosts —
+    SURVEY §5.8), the sharded and single-device kernels, and finally
+    the host FFD oracle, under per-backend circuit breakers and the
+    optional watchdog deadline. Every call returns a PackResult —
+    degraded, perhaps, but never absent."""
+    from karpenter_tpu.solver import resilience
 
-    return solve_packing(enc, **kwargs)
-
-
-_rpc_executor = None
+    return resilience.shared().solve_packing(enc, **kwargs)
 
 
 def _solve_packing_async(enc, **kwargs):
-    """Dispatch a solve without blocking: local solves use the kernel's
-    true async dispatch (the device computes while the host keeps
-    working); remote solves run the RPC on a shared worker pool.
-    Returns an object with .result() -> PackResult."""
-    client = _remote_client()
-    if client is not None:
-        global _rpc_executor
-        with _remote_lock:
-            if _rpc_executor is None:
-                from concurrent.futures import ThreadPoolExecutor
+    """Dispatch a solve without blocking, with the same ladder
+    guarding the fetch: healthy local solves keep the kernel's true
+    async dispatch (the device computes while the host keeps working);
+    remote or deadline-budgeted solves run on a worker pool. Returns
+    an object with .result() -> PackResult."""
+    from karpenter_tpu.solver import resilience
 
-                # sized for the cost objective's two concurrent RPCs
-                # (FFD race + planned solve) with headroom for a
-                # sibling disruption simulation
-                _rpc_executor = ThreadPoolExecutor(
-                    max_workers=4, thread_name_prefix="solver-rpc"
-                )
-        return _rpc_executor.submit(client.solve_packing, enc, **kwargs)
-    from karpenter_tpu.solver.pack import solve_packing_async
-
-    return solve_packing_async(enc, **kwargs)
+    return resilience.shared().solve_packing_async(enc, **kwargs)
 
 
 def solve(

@@ -200,6 +200,7 @@ def _compile_probe_bucket(
     import jax.numpy as jnp
     from jax import ShapeDtypeStruct as S
 
+    from karpenter_tpu.solver import faults
     from karpenter_tpu.solver.pack import (
         _bucket,
         _lane_bucket,
@@ -208,6 +209,8 @@ def _compile_probe_bucket(
         pack_split_flat,
         probe_batch_width,
     )
+
+    faults.fire("warm")
 
     Cp = -(-_pad_axis(C) // 32) * 32
     Ep = _pad_axis(E) if E else 0
@@ -265,8 +268,10 @@ def _compile_bucket(
     import jax.numpy as jnp
     from jax import ShapeDtypeStruct as S
 
+    from karpenter_tpu.solver import faults
     from karpenter_tpu.solver.pack import _bucket, _pad_axis, pack_split_flat
 
+    faults.fire("warm")
     Gp = _pad_axis(G)
     Cp = -(-_pad_axis(C) // 32) * 32
     Ep = _pad_axis(E) if E else 0
@@ -296,6 +301,26 @@ def _compile_bucket(
         if Ep:
             kw["bound_quota"] = S((Ep, Gp), jnp.int16)
     pack_split_flat.lower(*args, max_free=F, mode=mode, **kw).compile()
+
+
+def rewarm_canary() -> bool:
+    """One cheap canary compile of the smallest shape bucket, proving
+    XLA and the device actually serve again. The resilience layer's
+    device breaker uses this (KARPENTER_REWARM_ON_CLOSE=1) to gate the
+    half-open -> closed transition: a device that answers one
+    cached-shape probe but cannot compile would otherwise flap the
+    breaker. Runs the `warm` fault site, so chaos specs keep the gate
+    failing while the injected fault is live."""
+    from karpenter_tpu.metrics.store import SOLVER_WARM_COMPILES
+
+    try:
+        _compile_bucket(*DEFAULT_SHAPES[0], "ffd")
+        SOLVER_WARM_COMPILES.inc({"outcome": "ok"})
+        return True
+    except Exception as err:
+        SOLVER_WARM_COMPILES.inc({"outcome": "error"})
+        log.warning("re-warm canary compile failed: %s", err)
+        return False
 
 
 def warm(

@@ -17,6 +17,20 @@ from karpenter_tpu.utils.platform import force_cpu_mesh
 force_cpu_mesh(8)
 
 
+def pytest_configure(config):
+    # chaos rides in tier-1 (the verify command runs -m 'not slow', so
+    # anything not marked slow is on by default); the marker exists so
+    # `-m chaos` can run the fault-injection suite alone
+    config.addinivalue_line(
+        "markers",
+        "chaos: deterministic fault-injection / resilience scenarios "
+        "(part of tier-1; select alone with -m chaos)",
+    )
+    config.addinivalue_line(
+        "markers", "slow: excluded from the tier-1 verify run"
+    )
+
+
 def same_solution(a, b):
     """Used-row PackResult equality: the node-axis SIZE may differ
     between calls (solve_packing remembers a tight axis after the

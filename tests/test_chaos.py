@@ -127,3 +127,219 @@ class TestRegistrationFlap:
             op.step(now=_now[0])
         live = [p for p in kube.pods() if not p.is_terminal()]
         assert live and all(p.spec.node_name for p in live)
+
+
+# -- solver-stack chaos (ISSUE 3): the deterministic fault injector
+# driving the resilience ladder across real control-plane flows -------------
+
+
+import pytest
+
+from karpenter_tpu.metrics.store import (
+    SOLVER_BREAKER_STATE,
+    SOLVER_BREAKER_TRANSITIONS,
+)
+from karpenter_tpu.solver import faults, resilience
+
+
+@pytest.fixture()
+def clean_resilience(monkeypatch):
+    """Chaos tests mutate process-global breaker/fault state; reset on
+    both sides so an opened breaker can't silently degrade the rest of
+    the suite's solves."""
+    monkeypatch.delenv("KARPENTER_FAULTS", raising=False)
+    resilience.reset()
+    faults.reset()
+    yield monkeypatch
+    resilience.reset()
+    faults.reset()
+
+
+def _consolidatable_env(n_nodes: int = 8):
+    """A sparse c8 fleet (one small pod per node) with a bigger c16 in
+    the catalog: multi-node consolidation wants many-into-one."""
+    env = Environment(types=[
+        make_instance_type("c2", cpu=2, memory=8 * GIB, price=2.0),
+        make_instance_type("c8", cpu=8, memory=32 * GIB, price=5.0),
+    ])
+    pool = mk_nodepool("default")
+    pool.spec.disruption.consolidate_after = "0s"
+    env.kube.create(pool)
+    env.provision(*[
+        mk_pod(name=f"f-{i}", cpu=1.5, memory=1 * GIB)
+        for i in range(5 * n_nodes)
+    ])
+    assert len(env.kube.nodes()) == n_nodes
+    env.cloud.types.append(
+        make_instance_type("c16", cpu=16, memory=64 * GIB, price=9.0)
+    )
+    keep_one = set()
+    for pod in env.kube.pods():
+        if pod.spec.node_name and pod.spec.node_name not in keep_one:
+            keep_one.add(pod.spec.node_name)
+            continue
+        env.kube.delete(pod)
+    now = time.time() + 120
+    env.pod_events.reconcile_all(now=now)
+    env.conditions.reconcile_all(now=now)
+    return env, now
+
+
+def _command_identity(cmd):
+    """Name-agnostic decision identity: the two arms build SEPARATE
+    environments whose auto-generated node names differ by a global
+    counter, so candidates compare by their per-env ordinal suffix and
+    plans by (pool, price, type set)."""
+    return (
+        sorted(c.state_node.name.rsplit("-", 1)[-1]
+               for c in cmd.candidates),
+        [
+            (p.pool.metadata.name, round(float(p.price), 6),
+             sorted(it.name for it in p.instance_types))
+            for p in cmd.results.new_node_plans
+        ],
+    )
+
+
+@pytest.mark.chaos
+class TestDeviceLostMidConsolidation:
+    def test_converges_to_host_oracle_decision_and_breaker_recloses(
+        self, clean_resilience
+    ):
+        """Device dies under the consolidation ladder: every probe and
+        kernel solve faults. The tick must still produce a decision —
+        identical to what the explicit host-FFD backend computes — the
+        breaker must open (observable in metrics), and once the fault
+        clears and the cooldown elapses the breaker must re-close with
+        the device serving again."""
+        monkeypatch = clean_resilience
+
+        # the oracle arm: the whole engine on the explicit host backend
+        monkeypatch.setenv("KARPENTER_SOLVER_BACKEND", "host")
+        env_host, now = _consolidatable_env()
+        want = env_host.disruption.multi_node_consolidation(now)
+        assert want is not None
+        monkeypatch.delenv("KARPENTER_SOLVER_BACKEND")
+
+        # the chaos arm: device backend, but the device is lost
+        monkeypatch.setenv("KARPENTER_BREAKER_COOLDOWN_MS", "100")
+        monkeypatch.setenv(
+            "KARPENTER_FAULTS", "device_lost@probe:*,device_lost@solve:*"
+        )
+        faults.reset()
+        resilience.reset()
+        env, now2 = _consolidatable_env()
+        opens_before = SOLVER_BREAKER_TRANSITIONS.value(
+            {"backend": "device", "to": "open"})
+        got = env.disruption.multi_node_consolidation(now2)
+        assert got is not None, "the tick must still decide under faults"
+        assert _command_identity(got) == _command_identity(want)
+        assert SOLVER_BREAKER_TRANSITIONS.value(
+            {"backend": "device", "to": "open"}) > opens_before
+        assert SOLVER_BREAKER_STATE.value({"backend": "device"}) == 2.0
+
+        # breaker state must be scrape-visible, not just in-process
+        from karpenter_tpu.metrics.exposition import render
+
+        text = render()
+        assert 'karpenter_solver_breaker_state{backend="device"} 2' in text
+
+        # fault clears; cooldown elapses; the device serves again and
+        # the breaker closes through its half-open probe
+        monkeypatch.delenv("KARPENTER_FAULTS")
+        faults.reset()
+        time.sleep(0.25)
+        env3, now3 = _consolidatable_env()
+        again = env3.disruption.multi_node_consolidation(now3)
+        assert again is not None
+        assert _command_identity(again) == _command_identity(want)
+        assert SOLVER_BREAKER_STATE.value({"backend": "device"}) == 0.0
+
+
+@pytest.mark.chaos
+class TestRpcDropMidProvisioning:
+    def test_ladder_serves_locally_then_breaker_recloses(
+        self, clean_resilience
+    ):
+        """The solver service drops every RPC mid-provisioning: solves
+        must degrade to the local kernel with unchanged decisions, the
+        remote breaker must open, and once the service heals (and the
+        cooldown elapses) solves must route remotely again."""
+        import karpenter_tpu.solver.solver as solver_mod
+        from bench import build_problem
+        from karpenter_tpu.service.server import SolverServer
+        from karpenter_tpu.solver.solver import solve
+
+        monkeypatch = clean_resilience
+        pods, pools = build_problem(250, 12, seed=21)
+        baseline = solve(pods, pools, objective="ffd")
+
+        srv = SolverServer(port=0).start()
+        monkeypatch.setenv(
+            "KARPENTER_SOLVER_ENDPOINT", f"127.0.0.1:{srv.port}")
+        monkeypatch.setenv("KARPENTER_BREAKER_COOLDOWN_MS", "100")
+        solver_mod._remote_solver = None
+        try:
+            served0 = srv.requests_served
+            healthy = solve(pods, pools, objective="ffd")
+            assert srv.requests_served > served0, "remote rung not used"
+            assert len(healthy.new_nodes) == len(baseline.new_nodes)
+
+            monkeypatch.setenv("KARPENTER_FAULTS", "rpc_drop@rpc:*")
+            faults.reset()
+            served1 = srv.requests_served
+            for _ in range(3):  # past the breaker threshold
+                dropped = solve(pods, pools, objective="ffd")
+                assert len(dropped.new_nodes) == len(baseline.new_nodes)
+                assert dropped.total_price == pytest.approx(
+                    baseline.total_price)
+            assert srv.requests_served == served1, (
+                "dropped RPCs must not reach the server")
+            assert SOLVER_BREAKER_STATE.value({"backend": "remote"}) == 2.0
+
+            # service heals: after the cooldown the half-open probe
+            # succeeds, the breaker closes, and traffic goes remote
+            monkeypatch.delenv("KARPENTER_FAULTS")
+            faults.reset()
+            time.sleep(0.25)
+            served2 = srv.requests_served
+            healed = solve(pods, pools, objective="ffd")
+            assert srv.requests_served > served2
+            assert len(healed.new_nodes) == len(baseline.new_nodes)
+            assert SOLVER_BREAKER_STATE.value({"backend": "remote"}) == 0.0
+        finally:
+            srv.stop()
+            solver_mod._remote_solver = None
+
+
+@pytest.mark.chaos
+class TestFaultReplayDeterminism:
+    def test_same_spec_same_workload_same_fault_log(self, clean_resilience):
+        """The injector's whole point: two runs of the same workload
+        under the same spec produce byte-identical fault sequences —
+        so a chaos failure found in CI replays exactly on a laptop."""
+        from bench import build_problem
+        from karpenter_tpu.solver.solver import solve
+
+        monkeypatch = clean_resilience
+        spec = "device_lost@solve:2,compile_delay:1=5ms"
+        pods, pools = build_problem(120, 8, seed=33)
+
+        def run():
+            monkeypatch.setenv("KARPENTER_FAULTS", spec)
+            faults.reset()
+            resilience.reset()
+            solutions = [
+                solve(pods, pools, objective="ffd") for _ in range(3)
+            ]
+            inj = faults.get()
+            assert inj is not None
+            log = inj.snapshot_log()
+            monkeypatch.delenv("KARPENTER_FAULTS")
+            return log, [len(s.new_nodes) for s in solutions]
+
+        log_a, counts_a = run()
+        log_b, counts_b = run()
+        assert log_a == log_b, "fault sequences must replay identically"
+        assert log_a, "the spec must actually have fired"
+        assert counts_a == counts_b

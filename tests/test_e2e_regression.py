@@ -92,7 +92,9 @@ class TestDriftBudgets:
         import datetime
 
         now = time.time() + 60
-        hour = datetime.datetime.fromtimestamp(now, datetime.UTC).hour
+        # timezone.utc, not datetime.UTC: the UTC alias only exists on
+        # py3.11+ and this suite must pass on 3.10
+        hour = datetime.datetime.fromtimestamp(now, datetime.timezone.utc).hour
         env = make_env(budgets=[
             Budget(nodes="0", schedule=f"* {hour} * * *", duration="1h"),
         ])

@@ -1,0 +1,406 @@
+"""Resilience layer unit tests: fault-spec parsing and replay
+determinism, breaker state machine (incl. the re-warm close gate),
+degradation ladder routing, watchdog deadlines, and the FFD hedge.
+
+Engine/e2e chaos scenarios (device-lost mid-consolidation, rpc-drop
+mid-provisioning) live in test_chaos.py; these pin the mechanisms.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from bench import build_problem
+from karpenter_tpu.metrics.store import (
+    SOLVER_BREAKER_STATE,
+    SOLVER_DEADLINE_EXCEEDED,
+    SOLVER_HEDGE,
+    SOLVER_LADDER,
+)
+from karpenter_tpu.solver import faults, resilience
+from karpenter_tpu.solver.encode import encode, group_pods
+from karpenter_tpu.solver.pack import solve_packing
+from karpenter_tpu.solver.resilience import (
+    CircuitBreaker,
+    STATE_CLOSED,
+    STATE_HALF_OPEN,
+    STATE_OPEN,
+    classify,
+    host_pack_result,
+)
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    """Every test starts from closed breakers, no faults, no leftover
+    degradation notes — and leaves the process the same way (breaker
+    state is global; a leaked open breaker would silently degrade
+    every later test's solves)."""
+    monkeypatch.delenv("KARPENTER_FAULTS", raising=False)
+    resilience.reset()
+    faults.reset()
+    yield
+    resilience.reset()
+    faults.reset()
+
+
+def _enc(n_pods=200, n_types=10, seed=7):
+    pods, pools = build_problem(n_pods, n_types, seed=seed)
+    return encode(group_pods(pods), pools)
+
+
+def _same_pack(a, b) -> bool:
+    n = a.node_count
+    return (
+        n == b.node_count
+        and np.array_equal(a.assign[:n], b.assign[:n])
+        and np.array_equal(a.unschedulable, b.unschedulable)
+    )
+
+
+class TestFaultSpec:
+    def test_parse_issue_example(self):
+        rules = faults.parse(
+            "device_lost@solve:3,rpc_drop@probe:*,compile_delay=5s"
+        )
+        assert [(r.kind, r.site, r.lo, r.hi) for r in rules] == [
+            ("device_lost", "solve", 3, 3),
+            ("rpc_drop", "probe", 0, -1),
+            ("compile_delay", "compile", 0, -1),
+        ]
+        assert rules[2].delay == 5.0
+
+    def test_parse_ranges_defaults_durations(self):
+        rules = faults.parse(
+            "rpc_drop:2-4,device_lost:5+,exec_delay=250ms"
+        )
+        assert (rules[0].site, rules[0].lo, rules[0].hi) == ("rpc", 2, 4)
+        assert (rules[1].lo, rules[1].hi) == (5, -1)
+        assert rules[2].site == "execute" and rules[2].delay == 0.25
+
+    def test_malformed_entries_dropped_not_fatal(self):
+        rules = faults.parse(
+            "nonsense@solve, device_lost@badsite, compile_delay, "
+            "device_lost@solve:0-0, ,device_lost@solve:2"
+        )
+        assert [(r.kind, r.lo) for r in rules] == [("device_lost", 2)]
+
+    def test_occurrence_matching_is_per_site(self):
+        inj = faults.FaultInjector(faults.parse("device_lost@solve:2"))
+        inj.fire("probe")           # other sites don't advance 'solve'
+        inj.fire("solve")           # occurrence 1: no fault
+        with pytest.raises(faults.DeviceLostError):
+            inj.fire("solve")       # occurrence 2: fires
+        inj.fire("solve")           # occurrence 3: clear again
+
+    def test_replay_is_byte_identical(self):
+        spec = "device_lost@solve:2,rpc_drop@rpc:1-2,compile_delay:3=10ms"
+
+        def run():
+            inj = faults.FaultInjector(
+                faults.parse(spec), sleep=lambda _t: None)
+            for site in ("solve", "rpc", "compile", "solve", "rpc",
+                         "compile", "solve", "compile"):
+                try:
+                    inj.fire(site)
+                except faults.FaultError:
+                    pass
+            return inj.snapshot_log()
+
+        first, second = run(), run()
+        assert first == second
+        assert first  # the spec actually fired something
+
+    def test_env_spec_change_resets_counters(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_FAULTS", "device_lost@solve:1")
+        with pytest.raises(faults.DeviceLostError):
+            faults.fire("solve")
+        faults.fire("solve")  # occurrence 2: clear
+        monkeypatch.setenv("KARPENTER_FAULTS", "device_lost@solve:1 ")
+        with pytest.raises(faults.DeviceLostError):
+            faults.fire("solve")  # fresh injector: occurrence 1 again
+
+
+class TestClassification:
+    def test_taxonomy(self):
+        assert classify(faults.DeviceLostError("x")) == "device_lost"
+        assert classify(faults.RpcDropError("x")) == "rpc_unavailable"
+        assert classify(resilience.CompileDeadlineExceeded("x")) == (
+            "compile_timeout"
+        )
+        assert classify(resilience.DeadlineExceeded("x")) == "deadline"
+        assert classify(ConnectionRefusedError("x")) == "rpc_unavailable"
+        assert classify(ValueError("x")) == "error"
+
+    def test_xla_runtime_error_is_device_lost(self):
+        try:
+            import jaxlib
+
+            err_cls = jaxlib.xla_extension.XlaRuntimeError
+        except Exception:
+            pytest.skip("jaxlib XlaRuntimeError not importable")
+        assert classify(err_cls("INTERNAL: device lost")) == "device_lost"
+
+
+class TestCircuitBreaker:
+    def _breaker(self, **kw):
+        kw.setdefault("threshold", 2)
+        kw.setdefault("base_cooldown", 0.05)
+        kw.setdefault("max_cooldown", 0.2)
+        return CircuitBreaker("test", **kw)
+
+    def test_opens_after_threshold_then_half_opens_then_closes(self):
+        br = self._breaker()
+        assert br.allow()
+        br.record_failure("device_lost")
+        assert br.state == STATE_CLOSED and br.allow()
+        br.record_failure("device_lost")
+        assert br.state == STATE_OPEN
+        assert not br.allow()
+        time.sleep(0.06)
+        assert br.allow()  # half-open probe admitted
+        assert br.state == STATE_HALF_OPEN
+        assert not br.allow()  # only ONE probe
+        br.record_success()
+        assert br.state == STATE_CLOSED
+        assert SOLVER_BREAKER_STATE.value({"backend": "test"}) == 0.0
+
+    def test_half_open_failure_reopens_with_longer_cooldown(self):
+        br = self._breaker(rng=__import__("random").Random(3))
+        br.record_failure("deadline")
+        br.record_failure("deadline")
+        first_retry = br._retry_at
+        time.sleep(0.06)
+        assert br.allow()
+        br.record_failure("deadline")
+        assert br.state == STATE_OPEN
+        assert br._retry_at > first_retry
+
+    def test_success_in_closed_resets_failure_streak(self):
+        br = self._breaker()
+        br.record_failure("error")
+        br.record_success()
+        br.record_failure("error")
+        assert br.state == STATE_CLOSED  # streak broken, never tripped
+
+    def test_close_gate_failure_keeps_breaker_open(self):
+        verdicts = [False, True]
+        br = self._breaker(close_gate=lambda: verdicts.pop(0))
+        br.record_failure("device_lost")
+        br.record_failure("device_lost")
+        time.sleep(0.06)
+        assert br.allow()
+        br.record_success()  # gate says the device still can't compile
+        assert br.state == STATE_OPEN
+        time.sleep(0.25)
+        assert br.allow()
+        br.record_success()  # gate passes now
+        assert br.state == STATE_CLOSED
+
+    def test_abandoned_half_open_probe_does_not_wedge(self):
+        br = self._breaker()
+        br.record_failure("deadline")
+        br.record_failure("deadline")
+        time.sleep(0.06)
+        assert br.allow()          # probe admitted ... then abandoned
+        time.sleep(0.06)           # probe TTL elapses with no verdict
+        assert br.allow()          # a new probe is admitted
+
+
+class TestLadder:
+    def test_healthy_path_serves_device_rung(self):
+        enc = _enc()
+        direct = solve_packing(enc, mode="ffd")
+        before = SOLVER_LADDER.value({"rung": "device", "outcome": "ok"})
+        out = resilience.shared().solve_packing(enc, mode="ffd")
+        assert _same_pack(out, direct)
+        assert SOLVER_LADDER.value(
+            {"rung": "device", "outcome": "ok"}) == before + 1
+
+    def test_device_lost_degrades_to_host_oracle(self, monkeypatch):
+        enc = _enc(seed=11)
+        monkeypatch.setenv("KARPENTER_FAULTS", "device_lost@solve:*")
+        faults.reset()
+        out = resilience.shared().solve_packing(enc, mode="ffd")
+        assert _same_pack(out, host_pack_result(enc))
+
+    def test_breaker_opens_and_skips_then_recloses(self, monkeypatch):
+        enc = _enc(seed=13)
+        # cooldown far beyond any suite-load stall: the skip assertion
+        # below must observe a breaker that is STILL cooling down, so
+        # the elapse is forced explicitly rather than slept for
+        monkeypatch.setenv("KARPENTER_BREAKER_COOLDOWN_MS", "60000")
+        monkeypatch.setenv("KARPENTER_FAULTS", "device_lost@solve:*")
+        faults.reset()
+        rs = resilience.shared()
+        rs.solve_packing(enc, mode="ffd")
+        rs.solve_packing(enc, mode="ffd")
+        assert rs.breaker("device").state == STATE_OPEN
+        before = SOLVER_LADDER.value(
+            {"rung": "device", "outcome": "skipped_open"})
+        rs.solve_packing(enc, mode="ffd")  # open: no device attempt
+        assert SOLVER_LADDER.value(
+            {"rung": "device", "outcome": "skipped_open"}) == before + 1
+        # fault clears; cooldown elapses (forced); half-open probe
+        # succeeds and closes the breaker
+        monkeypatch.delenv("KARPENTER_FAULTS")
+        faults.reset()
+        rs.breaker("device")._retry_at = 0.0
+        direct = solve_packing(enc, mode="ffd")
+        out = rs.solve_packing(enc, mode="ffd")
+        assert _same_pack(out, direct)
+        assert rs.breaker("device").state == STATE_CLOSED
+
+    def test_rewarm_gate_consulted_on_close(self, monkeypatch):
+        enc = _enc(seed=17)
+        monkeypatch.setenv("KARPENTER_BREAKER_COOLDOWN_MS", "30")
+        monkeypatch.setenv("KARPENTER_REWARM_ON_CLOSE", "1")
+        calls = []
+
+        import karpenter_tpu.solver.warm_pool as wp
+
+        monkeypatch.setattr(
+            wp, "rewarm_canary", lambda: calls.append(1) or True)
+        monkeypatch.setenv("KARPENTER_FAULTS", "device_lost@solve:1-2")
+        faults.reset()
+        rs = resilience.shared()
+        rs.solve_packing(enc, mode="ffd")
+        rs.solve_packing(enc, mode="ffd")
+        assert rs.breaker("device").state == STATE_OPEN
+        time.sleep(0.06)
+        rs.solve_packing(enc, mode="ffd")  # probe succeeds -> gate runs
+        assert calls, "re-warm gate was not consulted on close"
+        assert rs.breaker("device").state == STATE_CLOSED
+
+    def test_explicit_ladder_order_override(self, monkeypatch):
+        enc = _enc(seed=19)
+        monkeypatch.setenv("KARPENTER_SOLVE_LADDER", "host")
+        out = resilience.shared().solve_packing(enc, mode="ffd")
+        assert _same_pack(out, host_pack_result(enc))
+
+    def test_async_fetch_failure_falls_down_ladder(self, monkeypatch):
+        enc = _enc(seed=23)
+        # the dispatch succeeds; the EXECUTE fetch loses the device
+        monkeypatch.setenv("KARPENTER_FAULTS", "device_lost@execute:*")
+        faults.reset()
+        pending = resilience.shared().solve_packing_async(enc, mode="ffd")
+        out = pending.result()
+        assert _same_pack(out, host_pack_result(enc))
+
+
+class TestDeadlines:
+    def test_compile_stall_times_out_and_degrades(self, monkeypatch):
+        enc = _enc(seed=29)
+        monkeypatch.setenv("KARPENTER_FAULTS", "compile_delay=1.5s")
+        monkeypatch.setenv("KARPENTER_COMPILE_DEADLINE_MS", "150")
+        monkeypatch.setenv("KARPENTER_SOLVE_DEADLINE_MS", "400")
+        faults.reset()
+        before = SOLVER_DEADLINE_EXCEEDED.value({"phase": "compile"})
+        t0 = time.monotonic()
+        out = resilience.shared().solve_packing(enc, mode="ffd")
+        wall = time.monotonic() - t0
+        assert _same_pack(out, host_pack_result(enc))
+        assert SOLVER_DEADLINE_EXCEEDED.value(
+            {"phase": "compile"}) == before + 1
+        assert wall < 1.4, (
+            f"decision took {wall:.2f}s — the watchdog must not wait "
+            "out the stalled compile"
+        )
+        assert SOLVER_LADDER.value(
+            {"rung": "device", "outcome": "compile_timeout"}) >= 1
+
+    def test_execute_stall_times_out_within_deadline(self, monkeypatch):
+        enc = _enc(seed=31)
+        monkeypatch.setenv("KARPENTER_FAULTS", "exec_delay=1.5s")
+        monkeypatch.setenv("KARPENTER_SOLVE_DEADLINE_MS", "300")
+        faults.reset()
+        t0 = time.monotonic()
+        out = resilience.shared().solve_packing(enc, mode="ffd")
+        wall = time.monotonic() - t0
+        assert _same_pack(out, host_pack_result(enc))
+        assert wall < 1.4
+        assert SOLVER_LADDER.value(
+            {"rung": "device", "outcome": "deadline"}) >= 1
+
+    def test_hedge_precomputes_the_degraded_answer(self, monkeypatch):
+        enc = _enc(seed=37)
+        monkeypatch.setenv("KARPENTER_FAULTS", "exec_delay=1.5s")
+        monkeypatch.setenv("KARPENTER_SOLVE_DEADLINE_MS", "500")
+        monkeypatch.setenv("KARPENTER_SOLVE_HEDGE_MS", "50")
+        faults.reset()
+        wins = SOLVER_HEDGE.value({"outcome": "win"})
+        out = resilience.shared().solve_packing(enc, mode="ffd")
+        assert _same_pack(out, host_pack_result(enc))
+        assert SOLVER_HEDGE.value({"outcome": "win"}) == wins + 1
+
+    def test_instant_failure_does_not_burn_compile_budget(self, monkeypatch):
+        """A device that dies BEFORE the kernel dispatch must release
+        the watchdog immediately — not let the compile-budget wait
+        sleep out its full window per rung."""
+        enc = _enc(seed=47)
+        monkeypatch.setenv("KARPENTER_FAULTS", "device_lost@solve:*")
+        monkeypatch.setenv("KARPENTER_COMPILE_DEADLINE_MS", "5000")
+        monkeypatch.setenv("KARPENTER_SOLVE_DEADLINE_MS", "8000")
+        faults.reset()
+        t0 = time.monotonic()
+        out = resilience.shared().solve_packing(enc, mode="ffd")
+        wall = time.monotonic() - t0
+        assert _same_pack(out, host_pack_result(enc))
+        assert wall < 2.0, (
+            f"instant device failure took {wall:.2f}s — the compile "
+            "budget was slept out instead of released"
+        )
+
+    def test_degraded_report_survives_worker_thread_ladder(
+        self, monkeypatch
+    ):
+        """With a deadline set the ladder runs on a watchdog/executor
+        thread — the degradation note must still land on the CALLING
+        thread (the one the scheduler pops)."""
+        enc = _enc(seed=53)
+        monkeypatch.setenv("KARPENTER_FAULTS", "device_lost@solve:*")
+        monkeypatch.setenv("KARPENTER_SOLVE_DEADLINE_MS", "8000")
+        faults.reset()
+        resilience.pop_degraded()
+        pending = resilience.shared().solve_packing_async(enc, mode="ffd")
+        out = pending.result()
+        assert _same_pack(out, host_pack_result(enc))
+        assert "host" in resilience.pop_degraded()
+
+    def test_healthy_solve_ignores_generous_deadline(self, monkeypatch):
+        enc = _enc(seed=41)
+        monkeypatch.setenv("KARPENTER_SOLVE_DEADLINE_MS", "60000")
+        direct = solve_packing(enc, mode="ffd")
+        out = resilience.shared().solve_packing(enc, mode="ffd")
+        assert _same_pack(out, direct)
+
+
+class TestHostOracleParity:
+    def test_host_pack_result_matches_backend_host_decode(self):
+        """host_pack_result must be the SAME oracle `backend=host`
+        decodes — the ladder's floor and the explicit host backend can
+        never drift apart."""
+        from karpenter_tpu.solver.solver import (
+            _build_solution_arrays,
+            _decode_host,
+        )
+
+        enc = _enc(seed=43)
+        via_ladder = host_pack_result(enc)
+        sol_ladder = _build_solution_arrays(
+            enc,
+            np.flatnonzero(via_ladder.node_active[: via_ladder.node_count]),
+            via_ladder.node_mask,
+            via_ladder.assign,
+            via_ladder.unschedulable,
+        )
+        sol_host = _decode_host(enc)
+        assert len(sol_ladder.new_nodes) == len(sol_host.new_nodes)
+        assert [n.price for n in sol_ladder.new_nodes] == [
+            n.price for n in sol_host.new_nodes
+        ]
+        assert len(sol_ladder.unschedulable) == len(sol_host.unschedulable)

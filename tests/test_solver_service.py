@@ -28,6 +28,40 @@ def server():
     srv.stop()
 
 
+def _wait_for_port(port: int, timeout: float = 5.0) -> None:
+    """Block until something accepts on 127.0.0.1:port — the explicit
+    readiness gate the kill/recover phases key off instead of sleeps."""
+    import socket
+    import time as _time
+
+    deadline = _time.monotonic() + timeout
+    while _time.monotonic() < deadline:
+        try:
+            with socket.create_connection(("127.0.0.1", port), timeout=0.2):
+                return
+        except OSError:
+            _time.sleep(0.02)
+    pytest.fail(f"port {port} never came up within {timeout}s")
+
+
+def _rebind(port: int, shards: int = 0, timeout: float = 5.0) -> SolverServer:
+    """Restart a SolverServer on a specific port, retrying while the
+    previous listener's socket lingers; waits for connectivity."""
+    import time as _time
+
+    deadline = _time.monotonic() + timeout
+    while True:
+        srv = SolverServer(port=port, shards=shards)
+        if srv.port == port:  # grpc returns 0 when the bind failed
+            srv.start()
+            _wait_for_port(port, timeout)
+            return srv
+        srv.stop(grace=0)
+        if _time.monotonic() >= deadline:
+            pytest.fail(f"could not rebind port {port} within {timeout}s")
+        _time.sleep(0.05)
+
+
 def _enc(n_pods=400, n_types=24, seed=3):
     pods, pools = build_problem(n_pods, n_types, seed=seed)
     return pods, pools, encode(group_pods(pods), pools)
@@ -139,7 +173,6 @@ class TestServiceShardingUnderFailure:
         return out
 
     def test_concurrent_sharded_solves_survive_kill_and_recover(self):
-        import threading
         import time as _time
         from concurrent.futures import ThreadPoolExecutor
 
@@ -148,8 +181,13 @@ class TestServiceShardingUnderFailure:
         encs = self._encs(4)
         local = [solve_packing(e, mode="ffd") for e in encs]
 
+        # generous RPC timeout: the server serializes solves behind its
+        # device lock, so four queued sharded solves on a suite-loaded
+        # CPU can exceed a tight deadline and masquerade as failures —
+        # dead-endpoint phases fail fast on UNAVAILABLE regardless
         srv = SolverServer(port=0, shards=8).start()
-        client = RemoteSolver(f"127.0.0.1:{srv.port}", timeout=10.0)
+        _wait_for_port(srv.port)
+        client = RemoteSolver(f"127.0.0.1:{srv.port}", timeout=60.0)
         try:
             # phase 1: concurrent solves through the sharded server
             with ThreadPoolExecutor(4) as ex:
@@ -160,18 +198,25 @@ class TestServiceShardingUnderFailure:
             for out, loc in zip(outs, local):
                 assert same_solution(out, loc)
 
-            # phase 2: kill mid-stream — the server dies while a
-            # concurrent batch is in flight; every solve must still
-            # come back correct (remote before the kill, local after)
-            killer = threading.Thread(
-                target=lambda: (_time.sleep(0.05), srv.stop(grace=0))
-            )
-            killer.start()
+            # phase 2: kill mid-stream — deterministically: the server
+            # signals the moment a request ENTERS its handler
+            # (request_started), and the kill lands right then, while
+            # the batch is provably in flight. (The seed version raced
+            # a 50ms sleep against the serve loop and flaked both ways
+            # — kill landing before any RPC, or after all four.)
+            # Every solve must still come back correct: remote for
+            # whatever finished before the kill, local failover after.
+            srv.request_started.clear()
             with ThreadPoolExecutor(4) as ex:
-                outs2 = list(ex.map(
-                    lambda e: client.solve_packing(e, mode="ffd"), encs
-                ))
-            killer.join()
+                futs = [
+                    ex.submit(client.solve_packing, e, mode="ffd")
+                    for e in encs
+                ]
+                assert srv.request_started.wait(10.0), (
+                    "no solve reached the server handler"
+                )
+                srv.stop(grace=0)
+                outs2 = [f.result() for f in futs]
             for out, loc in zip(outs2, local):
                 assert same_solution(out, loc)
 
@@ -185,11 +230,21 @@ class TestServiceShardingUnderFailure:
             assert out.node_count == local[0].node_count
             assert _time.monotonic() - t0 < 5.0  # no RPC deadline burned
 
-            # phase 4: server restarts on the same port; once the
-            # cooldown elapses the client serves remotely again
-            srv2 = SolverServer(port=srv.port, shards=8).start()
+            # phase 4: server restarts on the same port (bind retried:
+            # the dead server's socket can linger briefly) and is
+            # waited for explicitly; once the cooldown elapses the
+            # client serves remotely again
+            srv2 = _rebind(port=srv.port, shards=8)
             try:
                 client._skip_until = 0.0  # cooldown elapsed
+                # the channel sat in TRANSIENT_FAILURE since the kill;
+                # an RPC issued before it reconnects fails fast and
+                # falls back local (the other half of the seed flake) —
+                # wait for readiness, which is exactly what a cooldown
+                # interval gives a production client
+                import grpc
+
+                grpc.channel_ready_future(client._channel).result(timeout=10)
                 before = srv2.requests_served
                 out3 = client.solve_packing(encs[1], mode="ffd")
                 assert srv2.requests_served == before + 1
