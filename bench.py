@@ -30,19 +30,29 @@ import sys
 import time
 
 
-def _init_backend(probe_timeout: float = 90.0, retries: int = 4) -> dict:
+def _init_backend(
+    probe_timeouts: tuple[float, ...] = (10.0, 30.0, 60.0, 90.0),
+) -> dict:
     """Make sure a JAX backend is usable before the parent process
     touches it. The TPU chip is single-tenant behind a tunnel and a
     dead tunnel makes backend init HANG (not error), so the probe runs
-    in a subprocess with a hard timeout and RETRIES WITH BACKOFF — a
+    in a subprocess with a hard timeout and retries with backoff — a
     transient tunnel outage must not cost a round its only hardware
-    evidence. Only after every attempt fails does the parent pin CPU,
-    and the emitted JSON stamps full provenance (attempts, per-attempt
-    errors, which backend actually ran) either way."""
+    evidence. Timeouts ESCALATE (10s first): a dead tunnel fails the
+    whole ladder in ~3.5 minutes instead of the flat-90s ladder's 6+
+    (BENCH_r05 burned 4 x 90s before its CPU fallback), while a merely
+    slow cold init still gets the long final probes. Only after every
+    attempt fails does the parent pin CPU, and the emitted JSON stamps
+    full provenance (attempts, per-attempt timeout + error, which
+    backend actually ran) either way."""
     import subprocess
 
-    provenance: dict = {"probe_attempts": 0, "probe_errors": []}
-    for attempt in range(retries):
+    provenance: dict = {
+        "probe_attempts": 0,
+        "probe_errors": [],
+        "probe_timeouts_s": list(probe_timeouts),
+    }
+    for attempt, probe_timeout in enumerate(probe_timeouts):
         provenance["probe_attempts"] = attempt + 1
         try:
             proc = subprocess.run(
@@ -56,7 +66,7 @@ def _init_backend(probe_timeout: float = 90.0, retries: int = 4) -> dict:
         except subprocess.TimeoutExpired:
             err = f"backend probe hung >{probe_timeout:.0f}s (tunnel down?)"
         provenance["probe_errors"].append(err)
-        if attempt < retries - 1:
+        if attempt < len(probe_timeouts) - 1:
             time.sleep(min(30.0, 3.0 * 2**attempt))
     from karpenter_tpu.utils.platform import force_cpu_mesh
 
@@ -72,7 +82,8 @@ def _init_backend(probe_timeout: float = 90.0, retries: int = 4) -> dict:
         )
         return provenance
     provenance["error"] = (
-        f"tpu backend unavailable after {retries} probes ({last}); ran on cpu"
+        f"tpu backend unavailable after {len(probe_timeouts)} probes "
+        f"({last}); ran on cpu"
     )
     return provenance
 
@@ -202,22 +213,148 @@ def build_problem(n_pods: int, n_types: int, seed: int = 42,
     return pods, [(pool, types)]
 
 
+def _steps_snapshot() -> dict:
+    """(sum, count) of the device-step histogram per kernel path."""
+    from karpenter_tpu.metrics.store import SOLVER_DEVICE_STEPS
+
+    out = {}
+    for pairs, _counts, total_sum, total in SOLVER_DEVICE_STEPS.samples():
+        out[dict(pairs).get("path", "")] = (total_sum, total)
+    return out
+
+
+def _steps_delta(before: dict, after: dict) -> dict:
+    """Per-path device-step activity between two snapshots."""
+    out = {}
+    for path, (s, n) in after.items():
+        s0, n0 = before.get(path, (0.0, 0))
+        if n > n0:
+            out[path] = {
+                "steps": int(s - s0),
+                "dispatches": n - n0,
+                "steps_per_dispatch": round((s - s0) / (n - n0), 1),
+            }
+    return out
+
+
+def _compile_seconds() -> float:
+    from karpenter_tpu.metrics.store import SOLVER_PHASE_DURATION
+
+    return SOLVER_PHASE_DURATION.sum({"phase": "compile"})
+
+
+def _wavefront_compare(
+    make_solve, wall: float, steps: dict, n_solves: int = 1
+) -> dict:
+    """Comparison arm for the wavefront kernel: re-run the scenario's
+    solve with the OTHER kernel (sequential when the timed region ran
+    wavefront — the accelerator default — or forced wavefront when it
+    ran sequential, the CPU default) and record the step reduction and
+    wall speedup in the scenario JSON. `make_solve` is a factory: each
+    call returns a fresh zero-arg solve thunk, with all problem /
+    scheduler construction done INSIDE the factory so only the solve
+    itself is timed — mirroring the primary samples (timing setup in
+    the arm would bias the comparison). One warm solve pays the arm's
+    shape compiles, then best-of-2 timed; `wall` must be the timed
+    region's own best-of (minimum), so both kernels are compared by
+    the same statistic. `wavefront_speedup` is always
+    sequential-wall / wavefront-wall, whichever side was the arm (< 1
+    means the wavefront loses wall clock on this backend — expected on
+    CPU, where the step cut still gets recorded).
+    BENCH_WAVEFRONT_COMPARE=0 skips the arm (it costs ~3 extra
+    solves)."""
+    if wall <= 0 or os.environ.get("BENCH_WAVEFRONT_COMPARE", "1").lower() in (
+        "0", "false", "off"
+    ):
+        return {}
+    if "wavefront" in steps:
+        arm_env, arm_label = "0", "sequential"
+    elif "sequential" in steps:
+        arm_env, arm_label = "force", "wavefront"
+    else:
+        return {}
+    prev = os.environ.get("KARPENTER_WAVEFRONT")
+    os.environ["KARPENTER_WAVEFRONT"] = arm_env
+    try:
+        make_solve()()  # warm: the arm kernel's jaxpr for these buckets
+        before = _steps_snapshot()
+        arm_wall = float("inf")
+        for _ in range(2):
+            fn = make_solve()  # construction outside the clock
+            t0 = time.perf_counter()
+            fn()
+            arm_wall = min(arm_wall, time.perf_counter() - t0)
+        arm_steps = _steps_delta(before, _steps_snapshot())
+    finally:
+        if prev is None:
+            os.environ.pop("KARPENTER_WAVEFRONT", None)
+        else:
+            os.environ["KARPENTER_WAVEFRONT"] = prev
+    if arm_label not in arm_steps:
+        # the arm didn't actually change kernels (e.g. the solve is
+        # below WAVEFRONT_MIN_GROUPS, so "force" still routes
+        # sequential) — reporting it would mislabel a same-kernel rerun
+        return {}
+    out = {f"{arm_label}_wall_s": round(arm_wall, 3)}
+    if arm_label == "sequential":
+        out["wavefront_speedup"] = round(arm_wall / wall, 2)
+        wf_region, wf_solves = steps, n_solves
+        seq_region, seq_solves = arm_steps, 2
+    else:
+        out["wavefront_speedup"] = round(wall / arm_wall, 2)
+        wf_region, wf_solves = arm_steps, 2
+        seq_region, seq_solves = steps, n_solves
+    arm_detail = arm_steps.get(arm_label)
+    if arm_detail:
+        out[f"{arm_label}_device_steps"] = arm_detail
+    # Step reduction on MATCHED populations, per solve: small solves
+    # below WAVEFRONT_MIN_GROUPS dispatch sequentially in BOTH arms and
+    # land in the wavefront region's own "sequential" pool — subtract
+    # their per-solve share from the sequential arm before dividing, or
+    # mixed scenarios would deflate the sequential side and misreport
+    # the per-solve reduction.
+    wf_pool = wf_region.get("wavefront")
+    seq_pool = seq_region.get("sequential")
+    shared = wf_region.get("sequential")
+    if wf_pool and seq_pool and wf_solves and seq_solves:
+        wf_per_solve = wf_pool["steps"] / wf_solves
+        seq_per_solve = seq_pool["steps"] / seq_solves
+        if shared:
+            seq_per_solve -= shared["steps"] / wf_solves
+        if wf_per_solve > 0 and seq_per_solve > 0:
+            out["wavefront_step_reduction"] = round(
+                seq_per_solve / wf_per_solve, 2
+            )
+    return out
+
+
 def _timed_cost_solve(pods, pools, bound_gap: bool = False, repeats: int = 1):
     """One warm-up solve (captures compile + cache population), then
     `repeats` timed steady-state solves. With repeats > 1 the detail
     carries the full latency distribution (p50/p90/p99) separately
     from the one-time compile cost — the BASELINE "<1s p99" target is
-    about the steady state, not the first trace."""
+    about the steady state, not the first trace.
+
+    Also reported: device-steps-per-solve from the kernel's own
+    counters and, when the wavefront kernel served the timed runs, a
+    sequential-mode comparison arm (KARPENTER_WAVEFRONT=0, its own
+    warm solve) so the JSON carries the wavefront step reduction and
+    wall-clock speedup per scenario."""
     from karpenter_tpu.solver.solver import solve
 
     ffd = solve(pods, pools, objective="ffd")
     t0 = time.perf_counter()
+    compile_before = _compile_seconds()
     # warm TWICE: the first solve compiles the estimated node axis and
     # remembers a tighter one; the second compiles THAT axis, so the
     # timed runs below are pure steady state (no hidden XLA compile)
     solve(pods, pools, objective="cost")
     solve(pods, pools, objective="cost")
     warm_wall = time.perf_counter() - t0
+    # compile-vs-execute split of the warmup: the compile share is what
+    # the warm pool / persistent cache can remove (shape buckets), the
+    # execute share is the two solves' real work
+    warm_compile = max(0.0, _compile_seconds() - compile_before)
     samples = []
     sol = None
     # Steady-state latency is measured the way a long-lived operator
@@ -229,6 +366,7 @@ def _timed_cost_solve(pods, pools, bound_gap: bool = False, repeats: int = 1):
     # the same way). Collection of per-solve garbage stays on.
     gc.collect()
     gc.freeze()
+    steps_before = _steps_snapshot()
     try:
         for _ in range(max(1, repeats)):
             t0 = time.perf_counter()
@@ -236,6 +374,7 @@ def _timed_cost_solve(pods, pools, bound_gap: bool = False, repeats: int = 1):
             samples.append(time.perf_counter() - t0)
     finally:
         gc.unfreeze()
+    steps = _steps_delta(steps_before, _steps_snapshot())
     wall = sorted(samples)[len(samples) // 2]  # p50 is the headline wall
     scheduled = sum(len(n.pods) for n in sol.new_nodes) + sum(
         len(e.pods) for e in sol.existing
@@ -255,6 +394,15 @@ def _timed_cost_solve(pods, pools, bound_gap: bool = False, repeats: int = 1):
             1 - cost_price / ffd_price, 4
         ) if ffd_price > 0 else 0.0,
     }
+    if steps:
+        out["device_steps"] = steps
+    # best-of over the timed samples: the arm reports a best-of-2
+    # minimum, so the comparison must pit minimum against minimum —
+    # p50-vs-min would bias wavefront_speedup toward the arm kernel
+    out.update(_wavefront_compare(
+        lambda: (lambda: solve(pods, pools, objective="cost")),
+        min(samples), steps, n_solves=len(samples),
+    ))
     if repeats > 1:
         ordered = sorted(samples)
 
@@ -268,6 +416,8 @@ def _timed_cost_solve(pods, pools, bound_gap: bool = False, repeats: int = 1):
             return round(ordered[lo] + (ordered[hi] - ordered[lo]) * (x - lo), 3)
 
         out["warmup_s"] = round(warm_wall, 3)  # compile + cache fill
+        out["warmup_compile_s"] = round(warm_compile, 3)
+        out["warmup_execute_s"] = round(max(0.0, warm_wall - warm_compile), 3)
         out["p50_s"] = pct(0.50)
         out["p90_s"] = pct(0.90)
         out["p99_s"] = pct(0.99)
@@ -403,14 +553,16 @@ def scenario_topology(n_pods: int = 1000, n_services: int = 20) -> dict:
         )
     samples = []
     res = None
+    steps_before = _steps_snapshot()
     for _ in range(3):
         pods = _topology_pods(n_pods, n_services)
         sched = Scheduler(pools_with_types=[(pool, types)])
         t0 = time.perf_counter()
         res = sched.solve(pods)
         samples.append(time.perf_counter() - t0)
+    steps = _steps_delta(steps_before, _steps_snapshot())
     wall = sorted(samples)[len(samples) // 2]
-    return {
+    out = {
         "pods": len(pods),
         "scheduled": res.scheduled_count,
         "nodes": len(res.new_node_plans),
@@ -418,6 +570,21 @@ def scenario_topology(n_pods: int = 1000, n_services: int = 20) -> dict:
         "wall_s": round(wall, 3),
         "pods_per_sec": round(res.scheduled_count / wall, 1) if wall else 0.0,
     }
+    if steps:
+        out["device_steps"] = steps
+    def make_topology_solve():
+        # pod + Scheduler construction happens here, outside the arm's
+        # clock — the primary samples above time sched.solve() alone
+        arm_pods = _topology_pods(n_pods, n_services)
+        arm_sched = Scheduler(pools_with_types=[(pool, types)])
+        return lambda: arm_sched.solve(arm_pods)
+
+    out.update(_wavefront_compare(
+        make_topology_solve,
+        min(samples), steps,  # min-vs-min, like _timed_cost_solve
+        n_solves=len(samples),
+    ))
+    return out
 
 
 def scenario_consolidation() -> dict:

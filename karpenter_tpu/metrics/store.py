@@ -202,6 +202,17 @@ SOLVER_ENCODE_CACHE = REGISTRY.counter(
 SOLVER_INCREMENTAL_TICKS = REGISTRY.counter(
     "karpenter_solver_incremental_ticks_total",
     "Warm-start pipeline ticks, by mode (incremental/full) and reason")
+SOLVER_DEVICE_STEPS = REGISTRY.histogram(
+    "karpenter_solver_device_steps",
+    "Outer-loop device steps per packing solve, by path "
+    "(sequential: one step per padded pod group; wavefront: one step "
+    "per committed round) — sum/count gives steps-per-solve",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 4096))
+SOLVER_WAVEFRONT_WIDTH = REGISTRY.histogram(
+    "karpenter_solver_wavefront_width",
+    "Pod groups committed per wavefront round (width 1 = the round "
+    "degenerated to a sequential step)",
+    buckets=(1, 2, 3, 4, 6, 8, 12, 16, 24, 32, 48, 64, 128))
 SOLVER_WARM_COMPILES = REGISTRY.counter(
     "karpenter_solver_warm_compiles_total",
     "Kernel shape buckets AOT-compiled by the warm pool, by outcome")

@@ -97,6 +97,11 @@ def decode_request(payload: bytes):
 
 def encode_result(result: PackResult) -> bytes:
     buf = io.BytesIO()
+    extra = {}
+    if result.device_steps:
+        extra["device_steps"] = np.asarray([result.device_steps], np.int64)
+    if result.wavefront_widths is not None:
+        extra["wavefront_widths"] = result.wavefront_widths
     np.savez_compressed(
         buf,
         assign=result.assign,
@@ -105,6 +110,7 @@ def encode_result(result: PackResult) -> bytes:
         node_active=result.node_active,
         node_count=np.asarray([result.node_count], np.int64),
         unschedulable=result.unschedulable,
+        **extra,
     )
     return buf.getvalue()
 
@@ -118,4 +124,14 @@ def decode_result(payload: bytes) -> PackResult:
         node_active=data["node_active"],
         node_count=int(data["node_count"][0]),
         unschedulable=data["unschedulable"],
+        # optional on the wire: an older server simply doesn't ship the
+        # step accounting, and the client-side metrics stay silent
+        device_steps=(
+            int(data["device_steps"][0])
+            if "device_steps" in data.files else 0
+        ),
+        wavefront_widths=(
+            data["wavefront_widths"]
+            if "wavefront_widths" in data.files else None
+        ),
     )

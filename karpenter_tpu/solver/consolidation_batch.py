@@ -61,7 +61,10 @@ from typing import Optional, Sequence
 import numpy as np
 
 from karpenter_tpu.kube.objects import Pod
-from karpenter_tpu.metrics.store import SOLVER_PROBE_BATCH
+from karpenter_tpu.metrics.store import (
+    SOLVER_DEVICE_STEPS,
+    SOLVER_PROBE_BATCH,
+)
 from karpenter_tpu.solver.encode import (
     Encoded,
     ExistingNodeInput,
@@ -159,6 +162,7 @@ class LaneSolver:
             _pad_axis,
             pack_probe_lanes_flat,
             probe_batch_width,
+            wavefront_plan,
         )
 
         lane_pod_lists = [list(lane.pods) + self.pending for lane in lanes]
@@ -392,7 +396,18 @@ class LaneSolver:
                 # resilience ladder down to the host oracle
                 try:
                     faults.fire("probe")
+                    # probes inherit the wavefront step reduction: the
+                    # width is judged per dispatch on the REAL group
+                    # count the kernel will walk (the lane's compacted
+                    # groups for a solo probe, the shared union for a
+                    # batch), exactly like pack._run_pack. The kwarg is
+                    # only PASSED when active (an explicit wavefront=0
+                    # would key a separate jit entry and recompile the
+                    # warm sequential programs); stats append after the
+                    # sequential layout, so the offset-based lane
+                    # decode below needs no awareness of them
                     if solo:
+                        wf = wavefront_plan(int(gsel.size))
                         flat = np.asarray(pack_split_flat(
                             jnp.asarray(compat_c), jnp.asarray(req_c),
                             jnp.asarray(counts_c),
@@ -400,7 +415,9 @@ class LaneSolver:
                             jnp.asarray(bcompat_c),
                             shared[6], shared[7], shared[8],
                             jnp.asarray(live_row), shared[9],
-                            max_free=F_try, mode=mode, cfg_rsv=cfg_rsv_j,
+                            max_free=F_try, mode=mode,
+                            **({"wavefront": wf} if wf > 1 else {}),
+                            cfg_rsv=cfg_rsv_j,
                             rsv_cap=rsv_cap_j, conflict=conflict_c,
                         ))[None, :]
                     else:
@@ -409,12 +426,15 @@ class LaneSolver:
                         counts_pad[: len(chunk), :G] = counts[chunk]
                         live_pad = np.zeros((Lp, Ep), bool)
                         live_pad[: len(chunk), :E] = live[chunk]
+                        wf = wavefront_plan(G)
                         flat = np.asarray(pack_probe_lanes_flat(
                             shared[0], shared[1], jnp.asarray(counts_pad),
                             shared[2], shared[3], shared[4], shared[5],
                             shared[6], shared[7], shared[8],
                             jnp.asarray(live_pad), shared[9],
-                            max_free=F_try, mode=mode, cfg_rsv=cfg_rsv_j,
+                            max_free=F_try, mode=mode,
+                            **({"wavefront": wf} if wf > 1 else {}),
+                            cfg_rsv=cfg_rsv_j,
                             rsv_cap=rsv_cap_j, conflict=conflict_j,
                         ))
                 except Exception as err:
@@ -443,6 +463,22 @@ class LaneSolver:
                     F_try = _pow2(grown, 32) if solo else _bucket(grown)
                     SOLVER_PROBE_BATCH.inc({"outcome": "capped_retry"})
                     continue
+                # device-step accounting, once per DISPATCH for both
+                # kernels (per-lane observation would multiply the one
+                # vmapped while_loop's rounds by the lane count): the
+                # wavefront batch executes max-rounds-across-lanes, the
+                # sequential kernel one step per padded group
+                if wf > 1:
+                    chunk_steps = int(max(
+                        int(flat[r, o1 + 1 + 2 * Gp_used])
+                        for r in range(len(chunk))
+                    ))
+                else:
+                    chunk_steps = Gp_used
+                SOLVER_DEVICE_STEPS.observe(
+                    chunk_steps,
+                    {"path": "wavefront" if wf > 1 else "sequential"},
+                )
                 chunk_cache[ci] = (flat, F_try, Gp_used, gsel)
                 return chunk_cache[ci]
 

@@ -653,6 +653,11 @@ class IncrementalPipeline:
                 daemon_overhead=self.daemon_overhead or None,
                 compat_cache=self.cache,
             )
+            # rides the wavefront routing at the _solve_packing seam:
+            # a churn-burst tick whose residual demand spans many group
+            # signatures commits them in batched rounds, while the
+            # typical small tick (few signatures) stays on the
+            # sequential kernel via pack.WAVEFRONT_MIN_GROUPS
             sol = solve_encoded(enc, objective=self.repack_objective)
             for a in sol.existing:
                 node = order[a.existing_index]

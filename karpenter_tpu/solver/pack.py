@@ -83,6 +83,11 @@ class PackResult:
     node_active: np.ndarray   # [N] bool
     node_count: int
     unschedulable: np.ndarray  # [G] int32 pods that found no placement
+    # outer-loop device steps the solve executed (sequential: one per
+    # padded group; wavefront: one per committed round) and, for
+    # wavefront solves, the groups committed per round
+    device_steps: int = 0
+    wavefront_widths: np.ndarray | None = None
 
 
 @functools.partial(jax.jit, static_argnames=("max_nodes", "mode"))
@@ -610,7 +615,531 @@ def pack_split(
     return assign, free_mask, node_count, unsched
 
 
-@functools.partial(jax.jit, static_argnames=("max_free", "mode"))
+# unrecognized KARPENTER_WAVEFRONT spellings already warned about
+_warned_wavefront: set[str] = set()
+
+
+def wavefront_width() -> int:
+    """Resolve the KARPENTER_WAVEFRONT knob into a lane width (0 =
+    sequential).
+
+    Unset / "1" / "on" / "auto" is backend-aware AUTO: wavefront on
+    accelerators (the round's plan fan-out rides chip lanes the serial
+    loop leaves idle), sequential on CPU — XLA:CPU pays the fan-out in
+    real FLOPs (measured on the bench mix: ~2.8x fewer device steps
+    but ~3x more wall). "0"/"off" disables everywhere; "force" (or an
+    integer >= 2, which IS the width) enables on any backend — tests
+    and step-count benchmarks use this. KARPENTER_WAVEFRONT_WIDTH
+    overrides the default width (16) without forcing the backend
+    choice."""
+    raw = os.environ.get("KARPENTER_WAVEFRONT", "auto").strip().lower()
+    if raw in ("0", "off", "false", "no"):
+        return 0
+    width = 0
+    force = raw == "force"
+    if raw not in ("", "1", "on", "true", "yes", "auto", "force"):
+        try:
+            width = int(raw)
+            if width <= 0:
+                # a non-positive width can only mean "off" — falling
+                # back to auto would enable the kernel against the
+                # operator's evident intent
+                return 0
+        except ValueError:
+            # unrecognized spelling: fall back to AUTO, but say so —
+            # an operator typing "seq"/"disabled" meant something, and
+            # silently auto-enabling on an accelerator would hide it.
+            # Warn once per spelling: this resolver runs on every
+            # dispatch, and a consolidation scan must not flood the
+            # log with the same line per probe.
+            if raw not in _warned_wavefront:
+                _warned_wavefront.add(raw)
+                import logging
+
+                logging.getLogger("karpenter.solver").warning(
+                    "unrecognized KARPENTER_WAVEFRONT=%r; using auto "
+                    "(accelerators on, CPU sequential — use 0/off to "
+                    "disable, force or an integer width to enable)", raw,
+                )
+            width = 0
+        force = width > 1
+    if width == 0:
+        wraw = os.environ.get("KARPENTER_WAVEFRONT_WIDTH", "").strip()
+        if wraw:
+            try:
+                width = max(0, int(wraw))
+            except ValueError:
+                width = 0
+    if width == 0:
+        width = 16
+    if not force:
+        try:
+            if jax.default_backend() == "cpu":
+                return 0
+        except Exception:
+            return 0
+    return 0 if width <= 1 else width
+
+
+# Below this many real groups the sequential loop wins: the wavefront
+# round plans `width` lanes to commit at most `remaining` groups, so a
+# tiny solve pays the fan-out without ever amortizing it.
+WAVEFRONT_MIN_GROUPS = 8
+
+
+def wavefront_plan(n_groups: int, shards: int = 0) -> int:
+    """Static wavefront width for a solve over `n_groups` real groups;
+    0 routes the sequential kernel (knob off, solve too small, or the
+    config axis is sharded — the wavefront program is kept off the
+    GSPMD path until it earns its own sharding story)."""
+    if shards > 1 or n_groups < WAVEFRONT_MIN_GROUPS:
+        return 0
+    return wavefront_width()
+
+
+@functools.partial(jax.jit, static_argnames=("max_free", "mode", "width"))
+def pack_split_wavefront(
+    compat: jnp.ndarray,        # [G, C] bool
+    group_req: jnp.ndarray,     # [G, R] f32
+    group_count: jnp.ndarray,   # [G] i32
+    cfg_alloc: jnp.ndarray,     # [C, R] f32
+    cfg_pool: jnp.ndarray,      # [C] i32 (-1 for pseudo-configs)
+    pool_overhead: jnp.ndarray,  # [P+1, R] f32
+    bound_compat: jnp.ndarray,  # [G, B] bool
+    bound_alloc: jnp.ndarray,   # [B, R] f32
+    bound_used0: jnp.ndarray,   # [B, R] f32
+    bound_slot: jnp.ndarray,    # [B] i32
+    bound_live: jnp.ndarray,    # [B] bool
+    cfg_price: jnp.ndarray,     # [C] f32
+    max_free: int,
+    mode: str = "ffd",
+    width: int = 8,
+    bound_quota: jnp.ndarray | None = None,
+    cfg_rsv: jnp.ndarray | None = None,
+    rsv_cap: jnp.ndarray | None = None,
+    group_cap: jnp.ndarray | None = None,
+    conflict: jnp.ndarray | None = None,
+):
+    """`pack_split` with the serial group loop collapsed into WAVEFRONT
+    rounds: each device step PLANS the next `width` uncommitted groups
+    in index order — vectorized, each against the same pre-round state,
+    computing exactly the placement the sequential body would produce —
+    then greedily ACCEPTS the maximal PREFIX of them whose plans
+    provably commute, and COMMITS all accepted plans in one scatter.
+    Results are bit-identical to `pack_split` (test-enforced:
+    tests/test_wavefront_oracle.py); width 1 degenerates to the
+    sequential kernel one group per round.
+
+    Acceptance walks the candidates in index order and STOPS at the
+    first rejection — a rejected group's plan is stale (that is what
+    rejection means), so nothing about it can clear the groups behind
+    it; committing past it would also reorder fresh-node indices
+    against the sequential solve. A candidate is accepted while, for
+    every group already accepted this round (all of them sequential
+    predecessors whose plans really commit):
+
+      * node disjointness — the rows they write (`take` > 0) intersect
+        none of its DEPENDENCE rows: rows with capacity > 0 up to its
+        last fill, or all such rows when it spills (commits only ever
+        shrink capacities, so a zero-capacity row can never start
+        mattering);
+      * no fresh-open interaction — their freshly opened nodes admit
+        no config compatible with it (the sequential solve would
+        prefix-fill them);
+      * no shared reservation slot — once an accepted group spends
+        capacity-reservation budget, a spilling candidate re-reads
+        those budgets and is deferred;
+      * clean index shift — a spilling candidate commits only when its
+        planned opens were not clamped by the node axis and still fit
+        after the accepted groups' opens shift its slots up.
+
+    The first remaining group is always accepted (its plan IS the
+    sequential step), so every round commits >= 1 group and the round
+    count is bounded by the longest dependency chain, not the group
+    count. Extra outputs: `steps` (rounds executed — the device-step
+    metric) and `widths[G]` (groups committed per round, for the
+    wavefront width histogram)."""
+    G, C = compat.shape
+    R = group_req.shape[1]
+    B = bound_alloc.shape[0]
+    F = max_free
+    N = B + F
+    W = width
+    if bound_quota is not None:
+        bound_quota = bound_quota.astype(jnp.int32)
+    if cfg_rsv is None:
+        cfg_rsv = jnp.full((C,), -1, jnp.int32)
+    if rsv_cap is None:
+        rsv_cap = jnp.zeros((0,), jnp.float32)
+    K = rsv_cap.shape[0]
+    capped = cfg_rsv >= 0
+    rsv_cap_ext = jnp.concatenate([rsv_cap, jnp.full((1,), BIG, jnp.float32)])
+    cfg_slot = jnp.where(capped, cfg_rsv, K)
+    rsv_used0 = (
+        jnp.zeros((K + 1,), jnp.float32)
+        .at[bound_slot]
+        .add(jnp.where(bound_live & (bound_slot < K), 1.0, 0.0))
+    )
+    node_idx = jnp.arange(N, dtype=jnp.int32)
+
+    def plan_one(g, valid, free_mask, free_used, bound_used, assign,
+                 rsv_used, node_count):
+        """The sequential body of `pack_split`, re-expressed as a pure
+        PLAN: identical capacity/fill/open arithmetic (expression for
+        expression — the oracle suite holds the two in lockstep), but
+        fresh opens land in slot-relative scratch arrays instead of the
+        live state, so many plans can be evaluated against one state
+        and committed by scatter."""
+        req = group_req[g]
+        row = compat[g] & valid
+        remaining = jnp.where(valid, group_count[g], 0)
+        safe_req = jnp.where(req > 0, req, 1.0)
+        alloc_minus_req = cfg_alloc - req[None, :]
+
+        blocked = None
+        if conflict is not None:
+            blocked = (assign * conflict[g][None, :]).sum(axis=1) > 0
+
+        # ---- bound rows (mirrors pack_split.body exactly)
+        kb = jnp.floor(
+            (bound_alloc - bound_used + 1e-4) / safe_req[None, :]
+        )
+        kb = jnp.where(req[None, :] > 0, kb, BIG).min(axis=-1)
+        kb = jnp.clip(kb, 0.0, 2.0e9).astype(jnp.int32)
+        ok_b = bound_compat[g] & bound_live & (kb >= 1)
+        kb = kb * ok_b
+        if bound_quota is not None:
+            kb = jnp.minimum(kb, bound_quota[:, g])
+        if group_cap is not None:
+            kb = jnp.minimum(
+                kb, jnp.maximum(group_cap[g] - assign[:B, g], 0)
+            )
+        if blocked is not None:
+            kb = jnp.where(blocked[:B], 0, kb)
+
+        # ---- fresh rows (mirrors pack_split.body exactly)
+        kmat = jnp.floor(
+            (cfg_alloc[None, :, :] - free_used[:, None, :] + 1e-4)
+            / safe_req[None, None, :]
+        )
+        kmat = jnp.where(req[None, None, :] > 0, kmat, BIG).min(axis=-1)
+        kmat = jnp.clip(kmat, 0.0, 2.0e9).astype(jnp.int32)
+        okf = free_mask & row[None, :] & (kmat >= 1)
+        pinned = free_mask & capped[None, :]
+        is_pinned = pinned.any(axis=1)
+        pin_ok = (okf & pinned).any(axis=1)
+        okf = okf & jnp.where(is_pinned[:, None], pin_ok[:, None], True)
+        kmat = kmat * okf
+        kf = jnp.where(
+            is_pinned, (kmat * pinned).max(axis=1), kmat.max(axis=1)
+        )
+        if group_cap is not None:
+            kf = jnp.minimum(
+                kf, jnp.maximum(group_cap[g] - assign[B:, g], 0)
+            )
+        if blocked is not None:
+            kf = jnp.where(blocked[B:], 0, kf)
+
+        k = jnp.concatenate([kb, kf])
+        prefix = jnp.cumsum(k) - k
+        take = jnp.clip(remaining - prefix, 0, k)
+        take_f = take[B:]
+        touched_f = take_f > 0
+        newmask_f = okf & (kmat >= take_f[:, None])
+        spill = (remaining - take.sum()) > 0
+
+        # ---- fresh-open plan, slot-RELATIVE (commit shifts it onto
+        # the node axis at this lane's acceptance offset)
+        fits_fresh = row & jnp.all(
+            pool_overhead[cfg_pool] <= alloc_minus_req, axis=-1
+        ) & (cfg_pool >= 0)
+
+        def open_cond(args):
+            _, _, _, n_open, rem, spend, _ = args
+            can = fits_fresh & (
+                (rsv_used + spend)[cfg_slot] < rsv_cap_ext[cfg_slot]
+            )
+            return (rem > 0) & can.any() & (node_count + n_open < N)
+
+        def open_round(args):
+            o_fill, o_mask, o_used, n_open, rem, spend, clamped = args
+            rsv_now = rsv_used + spend
+            fresh_ok = fits_fresh & (
+                rsv_now[cfg_slot] < rsv_cap_ext[cfg_slot]
+            )
+            chosen_pool = jnp.min(jnp.where(fresh_ok, cfg_pool, INT_BIG))
+            mask = fresh_ok & (cfg_pool == chosen_pool)
+            overhead = pool_overhead[chosen_pool]
+            head = cfg_alloc - overhead[None, :]
+            kfc = jnp.floor((head + 1e-4) / safe_req[None, :])
+            kfc = jnp.where(req[None, :] > 0, kfc, BIG)
+            kfc = jnp.clip(jnp.min(kfc, axis=-1), 0.0, BIG).astype(jnp.int32)
+            kf_open = kfc * mask
+            if mode == "cost":
+                ppp = jnp.where(
+                    kf_open >= 1, cfg_price / jnp.maximum(kf_open, 1), BIG
+                )
+                c_star = jnp.argmin(ppp)
+            else:
+                kf_ok = kf_open >= 1
+                min_uncapped = jnp.min(
+                    jnp.where(kf_ok & ~capped, cfg_price, BIG)
+                )
+                res_mask = kf_ok & capped & (cfg_price < min_uncapped)
+                c_res = jnp.argmax(jnp.where(res_mask, kf_open, -1))
+                c_star = jnp.where(res_mask.any(), c_res, jnp.argmax(kf_open))
+            m_star = jnp.maximum(kf_open[c_star], 1)
+            if group_cap is not None:
+                m_star = jnp.clip(group_cap[g], 1, m_star)
+            slot_star = cfg_slot[c_star]
+            cap_left = jnp.minimum(
+                rsv_cap_ext[slot_star] - rsv_now[slot_star], 2.0e9
+            )
+            axis_left = N - (node_count + n_open)
+            # min() terms commute, so splitting the sequential
+            # min(ceil, axis_left, cap) lets the plan see whether the
+            # AXIS was ever the binding constraint — a clamped plan
+            # cannot survive an index shift and is re-planned instead
+            q_noaxis = jnp.minimum(
+                (rem + m_star - 1) // m_star,
+                jnp.maximum(cap_left, 0).astype(jnp.int32),
+            )
+            q = jnp.maximum(jnp.minimum(q_noaxis, axis_left), 1)
+            clamped = clamped | (jnp.maximum(q_noaxis, 1) > axis_left)
+            rem_last = jnp.clip(rem - (q - 1) * m_star, 1, m_star)
+            idx = jnp.arange(F, dtype=jnp.int32)
+            sel_full = (idx >= n_open) & (idx < n_open + q - 1)
+            sel_last = idx == n_open + q - 1
+            fill = (
+                sel_full.astype(jnp.int32) * m_star
+                + sel_last.astype(jnp.int32) * rem_last
+            )
+            is_capped = capped[c_star]
+            one_hot = jnp.arange(C) == c_star
+            base_full = mask & ~capped & (kf_open >= m_star)
+            base_last = mask & ~capped & (kf_open >= rem_last)
+            open_mask_full = jnp.where(
+                is_capped, one_hot | base_full, base_full
+            )
+            open_mask_last = jnp.where(
+                is_capped, one_hot | base_last, base_last
+            )
+            o_mask = jnp.where(
+                sel_full[:, None], open_mask_full[None, :],
+                jnp.where(sel_last[:, None], open_mask_last[None, :], o_mask),
+            )
+            o_used = jnp.where(
+                (sel_full | sel_last)[:, None],
+                overhead[None, :]
+                + fill[:, None].astype(jnp.float32) * req[None, :],
+                o_used,
+            )
+            placed = (q - 1) * m_star + rem_last
+            return (
+                o_fill + fill,
+                o_mask,
+                o_used,
+                n_open + q,
+                rem - placed,
+                spend.at[slot_star].add(q.astype(jnp.float32)),
+                clamped,
+            )
+
+        (o_fill, o_mask, o_used, n_open, rem_after, spend,
+         clamped) = jax.lax.while_loop(
+            open_cond,
+            open_round,
+            (
+                jnp.zeros((F,), jnp.int32),
+                jnp.zeros((F, C), bool),
+                jnp.zeros((F, R), jnp.float32),
+                jnp.int32(0),
+                remaining - take.sum(),
+                jnp.zeros((K + 1,), jnp.float32),
+                jnp.array(False),
+            ),
+        )
+        # the loop exiting with demand left AND a willing config means
+        # the node axis was full: that decision too reads node_count
+        can_after = fits_fresh & (
+            (rsv_used + spend)[cfg_slot] < rsv_cap_ext[cfg_slot]
+        )
+        clamped = clamped | ((rem_after > 0) & can_after.any())
+
+        touched = take > 0
+        last = jnp.max(jnp.where(touched, node_idx, -1))
+        dep = (k > 0) & (spill | (node_idx <= last))
+        return (
+            take,
+            newmask_f,
+            touched_f,
+            touched,
+            dep,
+            row,
+            spill,
+            o_fill,
+            o_mask,
+            o_used,
+            n_open,
+            spend,
+            (spend[:K] > 0).any() if K else jnp.array(False),
+            clamped,
+            jnp.maximum(rem_after, 0),
+            o_mask.any(axis=0),
+        )
+
+    def round_body(state):
+        (free_mask, free_used, bound_used, node_count, assign, unsched,
+         rsv_used, done, steps, widths) = state
+
+        # ---- candidates: the first W uncommitted groups, index order
+        remaining_g = ~done
+        rank = (jnp.cumsum(remaining_g) - 1).astype(jnp.int32)
+        sel = remaining_g & (rank < W)
+        cand = (
+            jnp.full((W,), G, jnp.int32)
+            .at[jnp.where(sel, rank, W)]
+            .set(jnp.arange(G, dtype=jnp.int32), mode="drop")
+        )
+        valid = cand < G
+        gsafe = jnp.minimum(cand, G - 1)
+
+        # ---- plan all W lanes against the shared pre-round state
+        (take, newmask_f, touched_f, touched, dep, row, spill, o_fill,
+         o_mask, o_used, n_open, spend, capped_spend, clamped,
+         unsched_add, open_union) = jax.vmap(
+            lambda g, v: plan_one(
+                g, v, free_mask, free_used, bound_used, assign,
+                rsv_used, node_count,
+            )
+        )(gsafe, valid)
+
+        # ---- greedy PREFIX acceptance scan (lane order == group index
+        # order). Acceptance stops at the first rejection: a rejected
+        # group's plan is stale by definition (an earlier commit
+        # invalidated it), so its planned footprint cannot clear later
+        # lanes — only groups whose every sequential predecessor
+        # commits THIS round are safe to commit with it. The accepted
+        # set is therefore a contiguous prefix of the remaining
+        # sequence, and each member needs only one-directional
+        # independence from the (real, committing) plans before it.
+        def accept_step(carry, xs):
+            acc_touched, acc_open, acc_capped, shift, stopped = carry
+            (v, touched_w, dep_w, row_w, spill_w, n_open_w, capped_w,
+             clamped_w, open_u_w) = xs
+            indep = (
+                ~(acc_touched & dep_w).any()
+                & ~(row_w & acc_open).any()
+            )
+            spill_ok = ~spill_w | (
+                ~acc_capped
+                & (
+                    (shift == 0)
+                    | (~clamped_w & (node_count + shift + n_open_w <= N))
+                )
+            )
+            accept = v & ~stopped & indep & spill_ok
+            offset = node_count + shift
+            carry = (
+                acc_touched | (accept & touched_w),
+                acc_open | (accept & open_u_w),
+                acc_capped | (accept & capped_w),
+                shift + jnp.where(accept, n_open_w, 0),
+                stopped | ~accept,
+            )
+            return carry, (accept, offset)
+
+        carry0 = (
+            jnp.zeros((N,), bool),
+            jnp.zeros((C,), bool),
+            jnp.array(False),
+            jnp.int32(0),
+            jnp.array(False),
+        )
+        _, (accept, offset) = jax.lax.scan(
+            accept_step,
+            carry0,
+            (valid, touched, dep, row, spill, n_open, capped_spend,
+             clamped, open_union),
+        )
+
+        # ---- commit every accepted plan in one scatter. Accepted
+        # plans write pairwise-disjoint rows (the acceptance
+        # conditions guarantee it), so summed/OR-ed commits equal the
+        # sequential one-at-a-time writes bit for bit: every f32 add
+        # below has at most one nonzero addend per row.
+        accf = accept.astype(jnp.int32)
+        off_free = offset - B
+        sh_fill = jax.vmap(jnp.roll)(o_fill, off_free)
+        sh_mask = jax.vmap(
+            lambda m, s: jnp.roll(m, s, axis=0)
+        )(o_mask, off_free)
+        sh_used = jax.vmap(
+            lambda u, s: jnp.roll(u, s, axis=0)
+        )(o_used, off_free)
+
+        take_acc = take * accf[:, None]
+        fill_all = jnp.concatenate(
+            [take_acc[:, :B], take_acc[:, B:] + sh_fill * accf[:, None]],
+            axis=1,
+        )
+        col = jnp.where(accept, cand, G)
+        assign = assign.at[:, col].add(fill_all.T, mode="drop")
+        reqs = group_req[gsafe]
+        bound_used = bound_used + jnp.einsum(
+            "wb,wr->br", take_acc[:, :B].astype(jnp.float32), reqs
+        )
+        free_used = (
+            free_used
+            + jnp.einsum(
+                "wf,wr->fr", take_acc[:, B:].astype(jnp.float32), reqs
+            )
+            + (sh_used * accf[:, None, None].astype(jnp.float32)).sum(axis=0)
+        )
+        t_acc = touched_f & accept[:, None]
+        free_mask = jnp.where(
+            t_acc.any(axis=0)[:, None],
+            (newmask_f & t_acc[:, :, None]).any(axis=0),
+            free_mask,
+        )
+        free_mask = free_mask | (sh_mask & accept[:, None, None]).any(axis=0)
+
+        node_count = node_count + (n_open * accf).sum()
+        rsv_used = rsv_used + (
+            spend * accf[:, None].astype(jnp.float32)
+        ).sum(axis=0)
+        unsched = unsched.at[col].add(unsched_add * accf, mode="drop")
+        done = done.at[col].set(True, mode="drop")
+        widths = widths.at[steps].set(accf.sum())
+        return (free_mask, free_used, bound_used, node_count, assign,
+                unsched, rsv_used, done, steps + 1, widths)
+
+    def cond(state):
+        done, steps = state[7], state[8]
+        return (~done.all()) & (steps < G)
+
+    state = jax.lax.while_loop(
+        cond,
+        round_body,
+        (
+            jnp.zeros((F, C), bool),
+            jnp.zeros((F, R), jnp.float32),
+            bound_used0,
+            jnp.int32(B),
+            jnp.zeros((N, G), jnp.int32),
+            jnp.zeros((G,), jnp.int32),
+            rsv_used0,
+            group_count <= 0,
+            jnp.int32(0),
+            jnp.zeros((G,), jnp.int32),
+        ),
+    )
+    (free_mask, _, _, node_count, assign, unsched, _, _, steps,
+     widths) = state
+    return assign, free_mask, node_count, unsched, steps, widths
+
+
+@functools.partial(jax.jit, static_argnames=("max_free", "mode", "wavefront"))
 def pack_probe_lanes_flat(
     compat: jnp.ndarray,        # [G, C] bool (shared)
     group_req: jnp.ndarray,     # [G, R] f32 (shared)
@@ -626,6 +1155,7 @@ def pack_probe_lanes_flat(
     cfg_price: jnp.ndarray,     # [C] f32 (shared)
     max_free: int,
     mode: str = "ffd",
+    wavefront: int = 0,
     cfg_rsv: jnp.ndarray | None = None,
     rsv_cap: jnp.ndarray | None = None,
     conflict: jnp.ndarray | None = None,
@@ -641,34 +1171,55 @@ def pack_probe_lanes_flat(
     evaluates the entire prefix ladder / candidate rotation instead of
     one sequential solve per probe; the flat uint32 output stacks one
     pack_split_flat-layout row per lane so the host pays a single
-    device fetch for the whole batch."""
+    device fetch for the whole batch.
 
-    def one(counts, live):
-        return pack_split(
-            compat, group_req, counts, cfg_alloc, cfg_pool, pool_overhead,
-            bound_compat, bound_alloc, bound_used0, bound_slot, live,
-            cfg_price, max_free=max_free, mode=mode, cfg_rsv=cfg_rsv,
-            rsv_cap=rsv_cap, conflict=conflict,
+    `wavefront > 1` vmaps the wavefront kernel instead: the batched
+    while_loop runs max-rounds-across-lanes iterations rather than G,
+    so every lane of the probe batch inherits the step reduction. The
+    per-lane stats ([G] widths + round count) land AFTER the
+    sequential-layout fields of each row, keeping offset decoders
+    unchanged."""
+    steps = widths = None
+    if wavefront > 1:
+        def one(counts, live):
+            return pack_split_wavefront(
+                compat, group_req, counts, cfg_alloc, cfg_pool,
+                pool_overhead, bound_compat, bound_alloc, bound_used0,
+                bound_slot, live, cfg_price, max_free=max_free, mode=mode,
+                width=wavefront, cfg_rsv=cfg_rsv, rsv_cap=rsv_cap,
+                conflict=conflict,
+            )
+
+        (assign, free_mask, node_count, unsched, steps,
+         widths) = jax.vmap(one)(lane_counts, lane_live)
+    else:
+        def one(counts, live):
+            return pack_split(
+                compat, group_req, counts, cfg_alloc, cfg_pool,
+                pool_overhead, bound_compat, bound_alloc, bound_used0,
+                bound_slot, live, cfg_price, max_free=max_free, mode=mode,
+                cfg_rsv=cfg_rsv, rsv_cap=rsv_cap, conflict=conflict,
+            )
+
+        assign, free_mask, node_count, unsched = jax.vmap(one)(
+            lane_counts, lane_live
         )
-
-    assign, free_mask, node_count, unsched = jax.vmap(one)(
-        lane_counts, lane_live
-    )
     L, f, cp = free_mask.shape
     words = cp // 32
     packed = (
         free_mask.reshape(L, f, words, 32).astype(jnp.uint32)
         << jnp.arange(32, dtype=jnp.uint32)[None, None, None, :]
     ).sum(axis=-1, dtype=jnp.uint32)
-    return jnp.concatenate(
-        [
-            assign.astype(jnp.uint32).reshape(L, -1),
-            packed.reshape(L, -1),
-            node_count.astype(jnp.uint32)[:, None],
-            unsched.astype(jnp.uint32).reshape(L, -1),
-        ],
-        axis=1,
-    )
+    parts = [
+        assign.astype(jnp.uint32).reshape(L, -1),
+        packed.reshape(L, -1),
+        node_count.astype(jnp.uint32)[:, None],
+        unsched.astype(jnp.uint32).reshape(L, -1),
+    ]
+    if wavefront > 1:
+        parts.append(widths.astype(jnp.uint32).reshape(L, -1))
+        parts.append(steps.astype(jnp.uint32)[:, None])
+    return jnp.concatenate(parts, axis=1)
 
 
 def probe_batch_width() -> int:
@@ -708,34 +1259,49 @@ def _lane_bucket(n: int) -> int:
     return _pad_axis(n, base=base)
 
 
-@functools.partial(jax.jit, static_argnames=("max_free", "mode"))
+@functools.partial(jax.jit, static_argnames=("max_free", "mode", "wavefront"))
 def pack_split_flat(*args, max_free: int, mode: str = "ffd",
-                    bound_quota=None, cfg_rsv=None, rsv_cap=None,
-                    group_cap=None, conflict=None):
+                    wavefront: int = 0, bound_quota=None, cfg_rsv=None,
+                    rsv_cap=None, group_cap=None, conflict=None):
     """`pack_split` with outputs fused into ONE compact uint32 vector
     (see pack_flat for the transport rationale). Bound rows ship no
     masks at all — the host rebuilds their one-hot rows from the
     bound_cfg vector it computed, so the payload shrinks by the whole
-    [B, C] block."""
-    assign, free_mask, node_count, unsched = pack_split(
-        *args, max_free=max_free, mode=mode, bound_quota=bound_quota,
-        cfg_rsv=cfg_rsv, rsv_cap=rsv_cap, group_cap=group_cap,
-        conflict=conflict,
-    )
+    [B, C] block.
+
+    `wavefront > 1` routes the wavefront kernel and APPENDS its
+    per-round width vector [G] and round count to the buffer — after
+    every sequential-layout field, so offset-based decoders that don't
+    know about the stats keep working unchanged."""
+    if wavefront > 1:
+        (assign, free_mask, node_count, unsched, steps,
+         widths) = pack_split_wavefront(
+            *args, max_free=max_free, mode=mode, width=wavefront,
+            bound_quota=bound_quota, cfg_rsv=cfg_rsv, rsv_cap=rsv_cap,
+            group_cap=group_cap, conflict=conflict,
+        )
+    else:
+        assign, free_mask, node_count, unsched = pack_split(
+            *args, max_free=max_free, mode=mode, bound_quota=bound_quota,
+            cfg_rsv=cfg_rsv, rsv_cap=rsv_cap, group_cap=group_cap,
+            conflict=conflict,
+        )
     f, cp = free_mask.shape
     words = cp // 32
     packed = (
         free_mask.reshape(f, words, 32).astype(jnp.uint32)
         << jnp.arange(32, dtype=jnp.uint32)[None, None, :]
     ).sum(axis=-1, dtype=jnp.uint32)
-    return jnp.concatenate(
-        [
-            assign.astype(jnp.uint32).ravel(),
-            packed.ravel(),
-            node_count.astype(jnp.uint32)[None],
-            unsched.astype(jnp.uint32).ravel(),
-        ]
-    )
+    parts = [
+        assign.astype(jnp.uint32).ravel(),
+        packed.ravel(),
+        node_count.astype(jnp.uint32)[None],
+        unsched.astype(jnp.uint32).ravel(),
+    ]
+    if wavefront > 1:
+        parts.append(widths.astype(jnp.uint32).ravel())
+        parts.append(steps.astype(jnp.uint32)[None])
+    return jnp.concatenate(parts)
 
 
 # problem-shape signature -> node-axis bucket that fit last time.
@@ -1160,6 +1726,15 @@ def _run_pack(
             group_cap_full = jax.device_put(group_cap_full, replicated)
         if conflict_full is not None:
             conflict_full = jax.device_put(conflict_full, replicated)
+    # wavefront routing: judged on the REAL group count (padding groups
+    # carry zero demand and pre-commit, so they never widen a round),
+    # off the GSPMD path while sharded solves stay sequential. The
+    # kwarg is only PASSED when active: jit keys an explicitly-passed
+    # static argument differently from the omitted default, so
+    # `wavefront=0` would shadow-recompile every already-warm
+    # sequential program (measured ~0.6s per shape bucket).
+    wf = wavefront_plan(G, shards)
+    wf_kw = {"wavefront": wf} if wf > 1 else {}
     _t_dispatch = _time.perf_counter()
     SOLVER_PHASE_DURATION.observe(
         _t_dispatch - _t_stage, {"phase": "transfer"}
@@ -1180,6 +1755,7 @@ def _run_pack(
         cfg_price_j,
         max_free=F,
         mode=mode,
+        **wf_kw,
         bound_quota=bound_quota_j,
         cfg_rsv=cfg_rsv,
         rsv_cap=rsv_cap,
@@ -1230,6 +1806,27 @@ def _run_pack(
         unsched = flat[o0 + F * W + 1 : o0 + F * W + 1 + Gp][:G].astype(
             np.int32
         )
+        # device-step accounting: the sequential fori_loop runs one
+        # step per PADDED group; the wavefront buffer carries its
+        # round count and per-round widths after the sequential layout
+        if wf > 1:
+            o2 = o0 + F * W + 1 + Gp
+            steps = int(flat[o2 + Gp])
+            wf_widths = flat[o2 : o2 + Gp][:steps].astype(np.int32)
+        else:
+            steps = Gp
+            wf_widths = None
+        from karpenter_tpu.metrics.store import (
+            SOLVER_DEVICE_STEPS,
+            SOLVER_WAVEFRONT_WIDTH,
+        )
+
+        SOLVER_DEVICE_STEPS.observe(
+            steps, {"path": "wavefront" if wf > 1 else "sequential"}
+        )
+        if wf_widths is not None:
+            for wv in wf_widths.tolist():
+                SOLVER_WAVEFRONT_WIDTH.observe(wv)
         # node_active / node_used are pure functions of the shipped
         # state: active = holds pods or is a live existing slot;
         # used = base (existing usage / fresh pool overhead) + the
@@ -1260,6 +1857,8 @@ def _run_pack(
             node_active=node_active,
             node_count=node_count,
             unschedulable=unsched,
+            device_steps=steps,
+            wavefront_widths=wf_widths,
         )
 
     return fetch

@@ -199,7 +199,15 @@ def _solve_packing(enc, **kwargs):
     SURVEY §5.8), the sharded and single-device kernels, and finally
     the host FFD oracle, under per-backend circuit breakers and the
     optional watchdog deadline. Every call returns a PackResult —
-    degraded, perhaps, but never absent."""
+    degraded, perhaps, but never absent.
+
+    Device rungs resolve the KARPENTER_WAVEFRONT knob at dispatch
+    (pack.wavefront_plan): solves with enough pod groups run the
+    wavefront kernel — many independent groups committed per device
+    step, bit-identical to the sequential loop — while small solves,
+    sharded solves, and the knob's off state keep the sequential
+    fori_loop. Everything stacked on this seam (the cost race, the
+    incremental repack, topology lowering) inherits the routing."""
     from karpenter_tpu.solver import resilience
 
     return resilience.shared().solve_packing(enc, **kwargs)

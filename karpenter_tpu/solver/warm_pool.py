@@ -48,11 +48,18 @@ log = logging.getLogger("karpenter.solver.warmpool")
 # default family mirrors the shapes the bench matrix and a steady-state
 # operator actually hit: small catalog probes, the mid-size batched
 # solve, the 50k-pod headline, and a bound-row-heavy incremental tick.
+# The last two entries extend the large-(G, F) diagonal the 50k cost
+# solve actually walks (selector-fragmented demand lands ~100-200
+# group signatures against a multi-thousand-node fresh axis; BENCH_r05
+# measured 10.8s of reserved_50k warmup, all XLA on exactly these
+# buckets).
 DEFAULT_SHAPES: tuple[tuple[int, int, int, int], ...] = (
     (16, 256, 0, 64),
     (64, 1024, 0, 512),
     (128, 4096, 0, 2048),
     (16, 1024, 1024, 64),
+    (128, 4096, 0, 4096),
+    (200, 4096, 0, 3200),
 )
 
 MODES = ("ffd", "cost")
@@ -208,6 +215,7 @@ def _compile_probe_bucket(
         pack_probe_lanes_flat,
         pack_split_flat,
         probe_batch_width,
+        wavefront_plan,
     )
 
     faults.fire("warm")
@@ -235,6 +243,19 @@ def _compile_probe_bucket(
                 S((Ep,), jnp.bool_),         # bound_live
                 S((Cp,), jnp.float32),       # cfg_price
             )
+            # solo probes dispatch the wavefront variant when the
+            # lane's compacted group count clears the routing floor —
+            # warm both, like _compile_bucket. Judged on the REAL
+            # count this level serves (min of the spec's group count
+            # and the level's padded axis), never the padding: a spec
+            # below WAVEFRONT_MIN_GROUPS pads to 16 but every real
+            # dispatch routes sequential, so warming its wavefront
+            # variant would be pure wasted startup time.
+            wf = wavefront_plan(min(G, Gp))
+            if wf > 1:
+                pack_split_flat.lower(
+                    *args, max_free=F, mode=mode, wavefront=wf
+                ).compile()
             pack_split_flat.lower(*args, max_free=F, mode=mode).compile()
         return
     Gp = _pad_axis(G)
@@ -254,6 +275,15 @@ def _compile_probe_bucket(
         S((Lp, Ep), jnp.bool_),      # lane_live
         S((Cp,), jnp.float32),       # cfg_price
     )
+    # like _compile_bucket: a real batch dispatch judges the width on
+    # its own union group count, so either variant can be asked of
+    # this bucket — warm both (wavefront only when the spec's G clears
+    # the routing floor)
+    wf = wavefront_plan(G)
+    if wf > 1:
+        pack_probe_lanes_flat.lower(
+            *args, max_free=F, mode=mode, wavefront=wf
+        ).compile()
     pack_probe_lanes_flat.lower(*args, max_free=F, mode=mode).compile()
 
 
@@ -300,6 +330,18 @@ def _compile_bucket(
         kw["conflict"] = S((Gp, Gp), jnp.bool_)
         if Ep:
             kw["bound_quota"] = S((Ep, Gp), jnp.int16)
+    # a real solve of this bucket dispatches EITHER the wavefront or
+    # the sequential jaxpr depending on its REAL (unpadded) group
+    # count (pack.wavefront_plan); the bucket spec only knows G, so
+    # warm both variants — solves below WAVEFRONT_MIN_GROUPS padded
+    # into this bucket still hit the sequential program
+    from karpenter_tpu.solver.pack import wavefront_plan
+
+    wf = wavefront_plan(G)
+    if wf > 1:
+        pack_split_flat.lower(
+            *args, max_free=F, mode=mode, wavefront=wf, **kw
+        ).compile()
     pack_split_flat.lower(*args, max_free=F, mode=mode, **kw).compile()
 
 

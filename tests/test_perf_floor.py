@@ -215,6 +215,116 @@ def test_batched_multi_node_consolidation_beats_sequential(n_nodes, monkeypatch)
     )
 
 
+def test_wavefront_cuts_device_steps_2x_on_mixed_200_groups(monkeypatch):
+    """ISSUE-4 acceptance: on a mixed synthetic of ~200 group
+    signatures spread over independent selector families (3 zones x 2
+    arches — the shape of real multi-AZ multi-arch demand), the
+    wavefront kernel must finish in at most HALF the sequential
+    kernel's device steps, while remaining bit-identical (the oracle
+    suite holds identity; this floor holds the speedup)."""
+    import numpy as np
+
+    from karpenter_tpu.solver.encode import encode, group_pods
+    from karpenter_tpu.solver.pack import solve_packing
+
+    pools = [(mk_nodepool("default"), instance_types(60))]
+    zones = ["test-zone-1", "test-zone-2", "test-zone-3"]
+    arches = ["amd64", "arm64"]
+    pods = []
+    # 34 size levels x 6 (zone, arch) families = 204 signatures; the
+    # encoder sorts groups by size, so families interleave level by
+    # level and each wavefront round can commit one group per family
+    for level in range(34):
+        cpu = round(4.0 - level * 0.1, 2)
+        mem = (1.0 + (level % 7) * 0.5) * 2**30
+        for zi, zone in enumerate(zones):
+            for ai, arch in enumerate(arches):
+                for k in range(3):
+                    pods.append(mk_pod(
+                        name=f"wf-{level}-{zi}-{ai}-{k}",
+                        cpu=cpu, memory=mem,
+                        node_selector={
+                            "topology.kubernetes.io/zone": zone,
+                            "kubernetes.io/arch": arch,
+                        },
+                    ))
+    enc = encode(group_pods(pods), pools)
+    assert enc.compat.shape[0] >= 200
+
+    monkeypatch.setenv("KARPENTER_WAVEFRONT", "force")
+    solve_packing(enc, mode="ffd")  # warm: stabilizes the node axis
+    wf = solve_packing(enc, mode="ffd")
+    monkeypatch.setenv("KARPENTER_WAVEFRONT", "0")
+    seq = solve_packing(enc, mode="ffd")
+
+    np.testing.assert_array_equal(wf.assign, seq.assign)
+    assert wf.device_steps > 0 and seq.device_steps > 0
+    assert wf.device_steps * 2 <= seq.device_steps, (
+        f"wavefront ran {wf.device_steps} steps vs sequential "
+        f"{seq.device_steps} — below the 2x floor"
+    )
+    # the width histogram data backs the step count: committed groups
+    # must sum to the real signature count
+    assert wf.wavefront_widths is not None
+    assert int(wf.wavefront_widths.sum()) == enc.compat.shape[0]
+
+
+def test_wavefront_default_does_not_regress_churn_tick(monkeypatch):
+    """ISSUE-4 satellite: the steady-state churn tick (small residual
+    repacks — bench.py steady_state_churn at operator scale) must not
+    get slower under the DEFAULT wavefront routing. Small ticks are
+    protected twice: auto mode keeps CPU sequential outright, and
+    WAVEFRONT_MIN_GROUPS keeps few-signature repacks sequential even
+    when forced. Interleaved best-of-N on both sides so load jitter
+    can't fail the floor."""
+    from karpenter_tpu.solver.incremental import IncrementalPipeline
+    from karpenter_tpu.solver.pack import WAVEFRONT_MIN_GROUPS, wavefront_plan
+
+    # a tick's residual demand spans fewer signatures than the floor:
+    # routing must stay sequential even with the knob forced
+    monkeypatch.setenv("KARPENTER_WAVEFRONT", "force")
+    assert wavefront_plan(WAVEFRONT_MIN_GROUPS - 1) == 0
+
+    pools = [(mk_nodepool("default"), instance_types(50))]
+    pods = diverse_pods(1500)
+
+    def make_pipe(flag):
+        monkeypatch.setenv("KARPENTER_WAVEFRONT", flag)
+        pipe = IncrementalPipeline(full_every=0, repack_objective="ffd")
+        pipe.solve_tick(pods, pools, objective="ffd")
+        ticked = pods
+        for t in range(3):  # warm the repack's shape buckets
+            k = max(1, len(ticked) // 100)
+            born = diverse_pods(k)
+            for i, p in enumerate(born):
+                p.metadata.name = f"warm-{flag}-{t}-{i}"
+            ticked = ticked[k:] + born
+            pipe.solve_tick(ticked, pools, objective="ffd")
+        return pipe, ticked
+
+    pipe_auto, pods_auto = make_pipe("auto")
+    pipe_off, pods_off = make_pipe("0")
+
+    def tick(pipe, base, flag, tag):
+        monkeypatch.setenv("KARPENTER_WAVEFRONT", flag)
+        k = max(1, len(base) // 100)
+        born = diverse_pods(k)
+        for i, p in enumerate(born):
+            p.metadata.name = f"timed-{tag}-{i}"
+        t0 = time.perf_counter()
+        pipe.solve_tick(base[k:] + born, pools, objective="ffd")
+        return time.perf_counter() - t0
+
+    auto_wall = off_wall = float("inf")
+    for n in range(5):
+        auto_wall = min(auto_wall, tick(pipe_auto, pods_auto, "auto", f"a{n}"))
+        off_wall = min(off_wall, tick(pipe_off, pods_off, "0", f"o{n}"))
+    assert auto_wall < off_wall * 1.25 + 0.005, (
+        f"churn tick regressed under default wavefront routing: "
+        f"{auto_wall * 1000:.1f}ms vs {off_wall * 1000:.1f}ms sequential"
+    )
+
+
 def test_resilience_wrapper_overhead_under_5_percent():
     """ISSUE-3 healthy-path guard: with no faults, no deadlines and a
     closed breaker, routing a solve through the resilience ladder

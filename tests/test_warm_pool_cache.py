@@ -1,0 +1,69 @@
+"""Regression guard for the CPU AOT-cache trap (bench.py:110-121).
+
+XLA:CPU AOT artifacts serialize pseudo-features (+prefer-no-gather /
++prefer-no-scatter) the loader's host-feature detection never reports,
+so every persistent-cache load fails validation and recompiles mid-run
+— measured 2x tail inflation on reserved_50k and the prime suspect for
+round 4's 3-10x topology regression. `enable_persistent_cache` must
+therefore stay DISABLED on the CPU backend unless explicitly forced;
+this test pins that contract so a refactor can't quietly re-enable it.
+"""
+
+import os
+
+import pytest
+
+import jax
+
+from karpenter_tpu.solver.warm_pool import enable_persistent_cache
+
+
+@pytest.mark.skipif(
+    jax.default_backend() != "cpu",
+    reason="the trap is CPU-specific; accelerator backends should cache",
+)
+def test_persistent_cache_stays_disabled_on_cpu(tmp_path, monkeypatch):
+    monkeypatch.setenv("KARPENTER_JAX_CACHE_DIR", str(tmp_path))
+    before = jax.config.jax_compilation_cache_dir
+    assert enable_persistent_cache() is None, (
+        "enable_persistent_cache() enabled the on-disk cache on the CPU "
+        "backend — the cpu_aot_loader validation failure makes every "
+        "cached load a mid-run recompile (BENCH r04 postmortem)"
+    )
+    assert jax.config.jax_compilation_cache_dir == before, (
+        "CPU backend must not point jax_compilation_cache_dir anywhere"
+    )
+    assert not any(os.scandir(tmp_path)), (
+        "CPU backend must not create cache directories"
+    )
+
+
+@pytest.mark.skipif(
+    jax.default_backend() != "cpu",
+    reason="force-override semantics only matter where the default skips",
+)
+def test_persistent_cache_force_override_still_works(tmp_path, monkeypatch):
+    """`force=True` is the deliberate escape hatch (tests, debugging);
+    it must tag the directory per backend+machine and then be fully
+    reversible."""
+    monkeypatch.setenv("KARPENTER_JAX_CACHE_DIR", str(tmp_path))
+    before = jax.config.jax_compilation_cache_dir
+    try:
+        path = enable_persistent_cache(force=True)
+        assert path is not None and path.startswith(str(tmp_path))
+        assert os.path.basename(path).startswith("cpu-")
+        assert os.path.isdir(path)
+    finally:
+        jax.config.update("jax_compilation_cache_dir", before)
+
+
+def test_bench_cache_setup_delegates_to_warm_pool():
+    """bench._setup_jax_cache must route through the shared gating in
+    warm_pool (one place owns the CPU trap logic), not re-implement
+    it."""
+    import inspect
+
+    import bench
+
+    src = inspect.getsource(bench._setup_jax_cache)
+    assert "enable_persistent_cache" in src

@@ -1,0 +1,551 @@
+"""Wavefront-vs-sequential oracle (ISSUE 4 acceptance).
+
+The wavefront kernel commits many independent pod groups per device
+step; its results must be *bit-identical* to the sequential
+`pack_split` loop — same assignment matrix, same free-node config
+masks, same node count, same unschedulable tallies — because every
+acceptance condition is a proof that the batched commit commutes with
+the serial one. Any divergence on randomized problems is a correctness
+bug, never a tolerance issue.
+
+Covered dimensions (satellite: fuzz oracle across both pack modes,
+reservations, group caps, hostname conflicts, and existing-node
+prefixes):
+
+1. kernel level — randomized encodes run through `pack_split` and
+   `pack_split_wavefront` at several widths (width 1 must degenerate
+   to the sequential solve exactly);
+2. kernel level with synthetic per-node group caps + pairwise conflict
+   rows (the lowered hostname-topology constraints) and with bound-row
+   prefixes (existing nodes at random fills);
+3. solver level — `solve()` with KARPENTER_WAVEFRONT=force vs =0 must
+   produce interchangeable Solutions, including the cost objective's
+   LP race and the topology-lowered Scheduler path.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from karpenter_tpu.cloudprovider.fake import (
+    GIB,
+    instance_types,
+    make_instance_type,
+)
+from karpenter_tpu.solver.encode import encode, group_pods
+from karpenter_tpu.solver.pack import (
+    WAVEFRONT_MIN_GROUPS,
+    _pad_axis,
+    pack_split,
+    pack_split_wavefront,
+    wavefront_plan,
+)
+from karpenter_tpu.testing import mk_nodepool, mk_pod
+
+ZONES = ["test-zone-1", "test-zone-2", "test-zone-3"]
+
+
+def _random_problem(seed, n_pods=300, n_types=20, reservations=False):
+    rng = np.random.default_rng(seed)
+    if reservations:
+        types = []
+        for i in range(n_types):
+            cpu = float(rng.choice([2, 4, 8, 16]))
+            rsv = (
+                [(f"rsv-{i}", "test-zone-1", int(rng.integers(1, 4)))]
+                if rng.random() < 0.3
+                else None
+            )
+            types.append(
+                make_instance_type(
+                    f"t-{i}", cpu=cpu, memory=cpu * 4 * GIB,
+                    price=cpu * float(rng.uniform(0.8, 1.2)),
+                    reservations=rsv,
+                )
+            )
+    else:
+        types = instance_types(n_types)
+    pool = mk_nodepool("default")
+    pods = []
+    for i in range(n_pods):
+        cpu = float(rng.choice([0.25, 0.5, 1.0, 2.0, 4.0]))
+        mem = float(rng.choice([0.5, 1.0, 2.0, 8.0])) * GIB
+        sel = {}
+        if rng.random() < 0.3:
+            sel["kubernetes.io/arch"] = "amd64"
+        if rng.random() < 0.3:
+            sel["topology.kubernetes.io/zone"] = str(rng.choice(ZONES))
+        pods.append(mk_pod(name=f"p-{i}", cpu=cpu, memory=mem,
+                           node_selector=sel))
+    return encode(group_pods(pods), [(pool, types)], [])
+
+
+def _staged(enc, existing_mask=None, existing_used=None, N=256):
+    """Pad an encode the way _run_pack does and build the shared
+    argument tuple both kernels take."""
+    G, C = enc.compat.shape
+    R = enc.group_req.shape[1]
+    E = existing_mask.shape[0] if existing_mask is not None else 0
+    Gp, Cp = _pad_axis(G), _pad_axis(C)
+    Cp = -(-Cp // 32) * 32
+    Ep = _pad_axis(E) if E else 0
+
+    compat = np.zeros((Gp, Cp), bool)
+    compat[:G, :C] = enc.compat
+    group_req = np.zeros((Gp, R), np.float32)
+    group_req[:G] = enc.group_req
+    group_count = np.zeros((Gp,), np.int32)
+    group_count[:G] = enc.group_count
+    cfg_alloc = np.zeros((Cp, R), np.float32)
+    cfg_alloc[:C] = enc.cfg_alloc
+    cfg_pool = np.full((Cp,), -1, np.int32)
+    cfg_pool[:C] = enc.cfg_pool
+    cfg_price = np.zeros((Cp,), np.float32)
+    cfg_price[:C] = enc.cfg_price
+
+    cfg_rsv = rsv_cap = None
+    cfg_rsv_h = np.full((Cp,), -1, np.int32)
+    K = 0
+    if enc.rsv_cap is not None and enc.rsv_cap.size:
+        K = int(enc.rsv_cap.size)
+        cfg_rsv_h[:C] = enc.cfg_rsv
+        cfg_rsv = jnp.asarray(cfg_rsv_h)
+        rsv_cap = jnp.asarray(enc.rsv_cap.astype(np.float32))
+
+    bound_cfg = np.full((Ep,), -1, np.int32)
+    bound_used = np.zeros((Ep, R), np.float32)
+    if E:
+        bound_cfg[:E] = np.where(
+            existing_mask.any(axis=1), existing_mask.argmax(axis=1), -1
+        )
+        bound_used[:E] = existing_used
+    bound_live = bound_cfg >= 0
+    safe_cfg = np.maximum(bound_cfg, 0)
+    bound_alloc = np.where(
+        bound_live[:, None], cfg_alloc[safe_cfg], 0.0
+    ).astype(np.float32)
+    bound_compat = (
+        compat[:, safe_cfg] & bound_live[None, :]
+        if Ep else np.zeros((Gp, 0), bool)
+    )
+    bound_slot = np.where(
+        bound_live & (cfg_rsv_h[safe_cfg] >= 0), cfg_rsv_h[safe_cfg], K
+    ).astype(np.int32)
+
+    args = (
+        jnp.asarray(compat), jnp.asarray(group_req),
+        jnp.asarray(group_count), jnp.asarray(cfg_alloc),
+        jnp.asarray(cfg_pool), jnp.asarray(enc.pool_overhead),
+        jnp.asarray(bound_compat), jnp.asarray(bound_alloc),
+        jnp.asarray(bound_used), jnp.asarray(bound_slot),
+        jnp.asarray(bound_live), jnp.asarray(cfg_price),
+    )
+    return args, dict(cfg_rsv=cfg_rsv, rsv_cap=rsv_cap), N - Ep, Gp
+
+
+def _assert_bit_identical(args, kw, max_free, mode, widths=(1, 8)):
+    seq = [
+        np.asarray(x)
+        for x in pack_split(*args, max_free=max_free, mode=mode, **kw)
+    ]
+    for width in widths:
+        wf = [
+            np.asarray(x)
+            for x in pack_split_wavefront(
+                *args, max_free=max_free, mode=mode, width=width, **kw
+            )
+        ]
+        np.testing.assert_array_equal(
+            seq[0], wf[0], err_msg=f"assign diverged at width {width}"
+        )
+        np.testing.assert_array_equal(
+            seq[1], wf[1], err_msg=f"free masks diverged at width {width}"
+        )
+        assert seq[2] == wf[2], f"node_count diverged at width {width}"
+        np.testing.assert_array_equal(
+            seq[3], wf[3], err_msg=f"unschedulable diverged at width {width}"
+        )
+        # the stats must be self-consistent: widths sum to the real
+        # (non-empty) groups, one round minimum per commit chain
+        steps = int(wf[4])
+        committed = int(wf[5][:steps].sum())
+        assert committed == int((np.asarray(args[2]) > 0).sum())
+        assert (wf[5][:steps] >= 1).all()
+        assert (wf[5][steps:] == 0).all()
+    return seq
+
+
+class TestWavefrontKernelOracle:
+    @pytest.mark.parametrize("mode", ["ffd", "cost"])
+    @pytest.mark.parametrize("seed", [0, 1])
+    def test_fresh_only(self, seed, mode):
+        enc = _random_problem(seed)
+        args, kw, max_free, _ = _staged(enc)
+        _assert_bit_identical(args, kw, max_free, mode)
+
+    @pytest.mark.parametrize("seed", [5, 6])
+    def test_with_reservations(self, seed):
+        enc = _random_problem(seed, reservations=True)
+        args, kw, max_free, _ = _staged(enc)
+        _assert_bit_identical(args, kw, max_free, "ffd", widths=(1, 8, 16))
+
+    @pytest.mark.parametrize("seed", [7])
+    def test_with_existing_rows(self, seed):
+        """Existing-node prefixes: random one-hot bound rows at random
+        fills precede the fresh axis."""
+        enc = _random_problem(seed)
+        C = enc.compat.shape[1]
+        R = enc.group_req.shape[1]
+        rng = np.random.default_rng(seed + 100)
+        E = 9
+        existing_mask = np.zeros((E, C), bool)
+        existing_used = np.zeros((E, R), np.float32)
+        launchable = np.flatnonzero(enc.cfg_pool >= 0)
+        for e in range(E):
+            c = int(rng.choice(launchable))
+            existing_mask[e, c] = True
+            existing_used[e] = enc.cfg_alloc[c] * float(rng.uniform(0, 0.5))
+        args, kw, max_free, _ = _staged(enc, existing_mask, existing_used)
+        _assert_bit_identical(args, kw, max_free, "ffd")
+
+    @pytest.mark.parametrize("seed", [11])
+    def test_with_group_caps_and_conflicts(self, seed):
+        """Synthetic hostname-topology lowering: per-node group caps
+        (maxSkew) and pairwise conflict rows (anti-affinity owners /
+        host ports) fed identically to both kernels."""
+        enc = _random_problem(seed, n_pods=200)
+        args, kw, max_free, Gp = _staged(enc)
+        rng = np.random.default_rng(seed)
+        G = enc.compat.shape[0]
+        gc = np.full((Gp,), np.iinfo(np.int32).max, np.int32)
+        gc[:G] = rng.integers(1, 5, size=G)
+        conflict = np.zeros((Gp, Gp), bool)
+        for _ in range(12):
+            a, b = rng.integers(0, G, size=2)
+            conflict[a, b] = conflict[b, a] = True
+        kw = dict(kw, group_cap=jnp.asarray(gc),
+                  conflict=jnp.asarray(conflict))
+        _assert_bit_identical(args, kw, max_free, "ffd")
+
+
+class TestWavefrontProbeLanes:
+    def test_probe_lane_rows_identical_and_stats_appended(self):
+        """The lane-batched probe kernel with `wavefront` set must
+        produce, per lane, exactly the sequential lane layout as a
+        prefix (LaneSolver's offset decode reads only that prefix) with
+        the round stats appended after it."""
+        from karpenter_tpu.solver.pack import pack_probe_lanes_flat
+
+        enc = _random_problem(17, n_pods=240)
+        C = enc.compat.shape[1]
+        R = enc.group_req.shape[1]
+        rng = np.random.default_rng(17)
+        E = 12
+        existing_mask = np.zeros((E, C), bool)
+        existing_used = np.zeros((E, R), np.float32)
+        launchable = np.flatnonzero(enc.cfg_pool >= 0)
+        for e in range(E):
+            c = int(rng.choice(launchable))
+            existing_mask[e, c] = True
+            existing_used[e] = enc.cfg_alloc[c] * float(rng.uniform(0, 0.4))
+        args, kw, max_free, Gp = _staged(enc, existing_mask, existing_used)
+        (compat, group_req, group_count, cfg_alloc, cfg_pool,
+         pool_overhead, bound_compat, bound_alloc, bound_used,
+         bound_slot, bound_live, cfg_price) = args
+        L = 4
+        Ep = bound_alloc.shape[0]
+        lane_counts = np.zeros((L, Gp), np.int32)
+        lane_live = np.zeros((L, Ep), bool)
+        base_counts = np.asarray(group_count)
+        base_live = np.asarray(bound_live)
+        for li in range(L):
+            keep = rng.random(Gp) < 0.6
+            lane_counts[li] = base_counts * keep
+            lane_live[li] = base_live & (rng.random(Ep) < 0.8)
+        lane_args = (
+            compat, group_req, jnp.asarray(lane_counts), cfg_alloc,
+            cfg_pool, pool_overhead, bound_compat, bound_alloc,
+            bound_used, bound_slot, jnp.asarray(lane_live), cfg_price,
+        )
+        seq = np.asarray(pack_probe_lanes_flat(
+            *lane_args, max_free=max_free, mode="ffd", **kw
+        ))
+        wf = np.asarray(pack_probe_lanes_flat(
+            *lane_args, max_free=max_free, mode="ffd", wavefront=8, **kw
+        ))
+        assert wf.shape[1] == seq.shape[1] + Gp + 1
+        np.testing.assert_array_equal(wf[:, : seq.shape[1]], seq)
+        steps = wf[:, -1].astype(np.int64)
+        widths = wf[:, seq.shape[1] : -1].astype(np.int64)
+        for li in range(L):
+            assert 0 < steps[li] <= Gp
+            assert widths[li, : steps[li]].sum() == (
+                lane_counts[li] > 0
+            ).sum()
+
+
+    def test_lane_solver_forced_wavefront_identical_and_observed(
+        self, monkeypatch
+    ):
+        """LaneSolver end to end with the knob forced: lane Solutions
+        match the sequential probe solve bit for bit, and the consulted
+        lane's device steps land in the histograms (the probe decode
+        reads the appended stats tail)."""
+        from karpenter_tpu.apis.v1.labels import (
+            CAPACITY_TYPE_LABEL,
+            HOSTNAME_LABEL,
+            INSTANCE_TYPE_LABEL,
+            NODEPOOL_LABEL,
+            TOPOLOGY_ZONE_LABEL,
+        )
+        from karpenter_tpu.metrics.store import SOLVER_DEVICE_STEPS
+        from karpenter_tpu.scheduling.requirements import Requirements
+        from karpenter_tpu.solver.consolidation_batch import (
+            LaneSolver,
+            ProbeLane,
+        )
+        from karpenter_tpu.solver.encode import ExistingNodeInput
+        from karpenter_tpu.solver.solver import solve
+
+        pool = mk_nodepool("default")
+        types = instance_types(20)
+        pools = [(pool, types)]
+        # a small retained fleet plus pending demand spanning >= 8
+        # signatures so forced routing actually takes the wavefront
+        nodes = []
+        node_pods = {}
+        for ni in range(4):
+            it = types[ni * 3]
+            off = it.offerings[0]
+            name = f"n-{ni}"
+            kept = [mk_pod(name=f"kept-{ni}", cpu=0.5)]
+            labels = {
+                NODEPOOL_LABEL: pool.metadata.name,
+                INSTANCE_TYPE_LABEL: it.name,
+                TOPOLOGY_ZONE_LABEL: off.zone,
+                CAPACITY_TYPE_LABEL: off.capacity_type,
+                HOSTNAME_LABEL: name,
+            }
+            avail = {
+                k: max(0.0, v - 0.5 * len(kept) * (k == "cpu"))
+                for k, v in it.allocatable.items()
+            }
+            nodes.append(ExistingNodeInput(
+                name=name,
+                requirements=Requirements.from_labels(labels),
+                taints=(),
+                available=avail,
+                pool_name=pool.metadata.name,
+                pod_count=len(kept),
+            ))
+            node_pods[name] = kept
+        moved = node_pods["n-0"] + [
+            mk_pod(
+                name=f"mv-{i}", cpu=0.25 + (i % 9) * 0.25,
+                node_selector={
+                    "topology.kubernetes.io/zone": ZONES[i % 3]
+                },
+            )
+            for i in range(18)
+        ]
+        lane = ProbeLane(exclude_names=("n-0",), pods=moved)
+
+        def run(flag):
+            monkeypatch.setenv("KARPENTER_WAVEFRONT", flag)
+            return LaneSolver(pools, nodes).solve_lazy([lane])[0]()
+
+        before = SOLVER_DEVICE_STEPS.count({"path": "wavefront"})
+        wf_sol = run("force")
+        assert SOLVER_DEVICE_STEPS.count({"path": "wavefront"}) > before, (
+            "consulted wavefront probe lane was not observed in the "
+            "device-steps histogram"
+        )
+        seq_sol = run("0")
+        assert self._solution_key(wf_sol) == self._solution_key(seq_sol)
+
+    @staticmethod
+    def _solution_key(sol):
+        return (
+            len(sol.unschedulable),
+            round(sol.total_price, 6),
+            sorted(
+                (n.pool.metadata.name, round(float(n.price), 6),
+                 sorted(p.metadata.name for p in n.pods))
+                for n in sol.new_nodes
+            ),
+            sorted(
+                (e.existing_index, sorted(p.metadata.name for p in e.pods))
+                for e in sol.existing
+            ),
+        )
+
+
+class TestWavefrontSolverOracle:
+    """`solve()` routed through _run_pack with the knob forced on vs
+    off: the decoded Solutions must be interchangeable."""
+
+    @staticmethod
+    def _solution_key(sol):
+        return (
+            len(sol.unschedulable),
+            round(sol.total_price, 6),
+            sorted(
+                (n.pool.metadata.name, round(float(n.price), 6),
+                 sorted(p.metadata.name for p in n.pods))
+                for n in sol.new_nodes
+            ),
+            sorted(
+                (e.existing_index, sorted(p.metadata.name for p in e.pods))
+                for e in sol.existing
+            ),
+        )
+
+    @pytest.mark.parametrize("objective", ["ffd", "cost"])
+    def test_solve_identical_forced_vs_off(self, objective, monkeypatch):
+        import karpenter_tpu.solver.solver as solver_mod
+        from karpenter_tpu.solver.solver import solve
+
+        rng = np.random.default_rng(23)
+        pools = [(mk_nodepool("default"), instance_types(40))]
+        pods = []
+        for i in range(400):
+            cpu = float(rng.choice([0.5, 1.0, 2.0]))
+            sel = {}
+            if i % 3 == 0:
+                sel["topology.kubernetes.io/zone"] = ZONES[i % 3]
+            if i % 4 == 0:
+                sel["kubernetes.io/arch"] = "amd64"
+            pods.append(mk_pod(name=f"s-{i}", cpu=cpu, memory=GIB,
+                               node_selector=sel))
+
+        def run(flag):
+            monkeypatch.setenv("KARPENTER_WAVEFRONT", flag)
+            # the cost race's steady-state caches must not leak one
+            # arm's recorded floor into the other arm's skip decision
+            solver_mod._ffd_floor.clear()
+            solver_mod._plan_cache.clear()
+            return solve(pods, pools, objective=objective)
+
+        assert self._solution_key(run("force")) == self._solution_key(
+            run("0")
+        )
+
+    def test_topology_scheduler_identical(self, monkeypatch):
+        """The lowered topology path (domain pins + group caps +
+        conflicts) through the real Scheduler."""
+        from karpenter_tpu.kube.objects import (
+            LabelSelector,
+            TopologySpreadConstraint,
+        )
+        from karpenter_tpu.provisioning.scheduler import Scheduler
+
+        pool = mk_nodepool("default")
+        types = instance_types(30)
+
+        def pods():
+            out = []
+            for i in range(180):
+                pod = mk_pod(name=f"t-{i}", cpu=1.0)
+                pod.metadata.labels["app"] = f"svc-{i % 12}"
+                pod.spec.topology_spread_constraints = [
+                    TopologySpreadConstraint(
+                        max_skew=1,
+                        topology_key="topology.kubernetes.io/zone",
+                        when_unsatisfiable="DoNotSchedule",
+                        label_selector=LabelSelector.of(
+                            {"app": f"svc-{i % 12}"}
+                        ),
+                    )
+                ]
+                out.append(pod)
+            return out
+
+        def run(flag):
+            monkeypatch.setenv("KARPENTER_WAVEFRONT", flag)
+            res = Scheduler(pools_with_types=[(pool, types)]).solve(pods())
+            return (
+                res.scheduled_count,
+                len(res.errors),
+                sorted(
+                    (p.pool.metadata.name, round(float(p.price), 6),
+                     sorted(x.metadata.name for x in p.pods))
+                    for p in res.new_node_plans
+                ),
+            )
+
+        assert run("force") == run("0")
+
+
+class TestWavefrontRouting:
+    def test_knob_resolution(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_WAVEFRONT", "0")
+        assert wavefront_plan(100) == 0
+        monkeypatch.setenv("KARPENTER_WAVEFRONT", "force")
+        assert wavefront_plan(100) > 1
+        # small solves stay sequential even when forced
+        assert wavefront_plan(WAVEFRONT_MIN_GROUPS - 1) == 0
+        # sharded solves stay off the wavefront program
+        assert wavefront_plan(100, shards=2) == 0
+        monkeypatch.setenv("KARPENTER_WAVEFRONT", "12")
+        assert wavefront_plan(100) == 12
+        monkeypatch.setenv("KARPENTER_WAVEFRONT", "force")
+        monkeypatch.setenv("KARPENTER_WAVEFRONT_WIDTH", "6")
+        assert wavefront_plan(100) == 6
+
+    def test_auto_matches_backend(self, monkeypatch):
+        import jax
+
+        monkeypatch.delenv("KARPENTER_WAVEFRONT", raising=False)
+        monkeypatch.delenv("KARPENTER_WAVEFRONT_WIDTH", raising=False)
+        expected = 0 if jax.default_backend() == "cpu" else 16
+        assert wavefront_plan(100) == expected
+
+    def test_codec_round_trips_step_stats(self):
+        """The remote-service codec carries the step accounting (and
+        tolerates its absence — older servers)."""
+        from karpenter_tpu.service import codec
+        from karpenter_tpu.solver.pack import PackResult
+
+        base = dict(
+            assign=np.zeros((4, 3), np.int32),
+            node_mask=np.zeros((4, 8), bool),
+            node_used=np.zeros((4, 2), np.float64),
+            node_active=np.zeros((4,), bool),
+            node_count=2,
+            unschedulable=np.zeros((3,), np.int32),
+        )
+        rt = codec.decode_result(codec.encode_result(PackResult(
+            **base, device_steps=7,
+            wavefront_widths=np.array([3, 2, 2], np.int32),
+        )))
+        assert rt.device_steps == 7
+        np.testing.assert_array_equal(rt.wavefront_widths, [3, 2, 2])
+        bare = codec.decode_result(codec.encode_result(PackResult(**base)))
+        assert bare.device_steps == 0 and bare.wavefront_widths is None
+
+    def test_metrics_exposed(self, monkeypatch):
+        """A wavefront solve lands in the device-steps and round-width
+        histograms, and both series render through /metrics."""
+        from karpenter_tpu.metrics.exposition import render
+        from karpenter_tpu.metrics.store import (
+            SOLVER_DEVICE_STEPS,
+            SOLVER_WAVEFRONT_WIDTH,
+        )
+        from karpenter_tpu.solver.pack import solve_packing
+
+        monkeypatch.setenv("KARPENTER_WAVEFRONT", "force")
+        before = SOLVER_DEVICE_STEPS.count({"path": "wavefront"})
+        width_before = SOLVER_WAVEFRONT_WIDTH.count()
+        enc = _random_problem(31, n_pods=150)
+        result = solve_packing(enc, mode="ffd")
+        assert result.device_steps > 0
+        assert SOLVER_DEVICE_STEPS.count({"path": "wavefront"}) == before + 1
+        assert SOLVER_WAVEFRONT_WIDTH.count() == (
+            width_before + result.device_steps
+        )
+        text = render()
+        assert "karpenter_solver_device_steps" in text
+        assert "karpenter_solver_wavefront_width" in text
