@@ -934,6 +934,11 @@ class DisruptionEngine:
                 {"method": method.__name__},
             )
             if command is not None:
+                # crash window: the disruption decision exists only in
+                # memory — a restart recomputes it from cluster state
+                from karpenter_tpu.solver import faults as _faults
+
+                _faults.fire("crash_disruption")
                 self.queue.start_command(command, now)
                 return command
         return None

@@ -169,7 +169,7 @@ class KubeClient:
                 out.append(obj)
             return out
 
-    def update(self, obj) -> object:
+    def update(self, obj, mutate=None) -> object:
         """Write an object back; bumps resource version.
 
         Optimistic concurrency (the API server's resourceVersion
@@ -179,12 +179,28 @@ class KubeClient:
         In-place mutations of the canonical object (the common
         single-process controller pattern here) are never stale.
         NodeClaim specs are immutable (nodeclaim.go:145 CEL rule).
+
+        `mutate` (optional) states the write as a FUNCTION of the
+        object — the conflict-safe form mirrored by RealKubeClient's
+        retry wrapper: applied before the write, and on a would-be
+        conflict re-applied onto the CANONICAL stored object instead
+        of failing (read-modify-write, never last-write-wins).
         """
         with self._lock:
+            if mutate is not None:
+                mutate(obj)
             bucket = self._bucket(obj.kind)
             existing = bucket.get(obj.key)
             if existing is None:
                 raise NotFoundError(f"{obj.kind} {obj.key}")
+            if (
+                mutate is not None
+                and existing is not obj
+                and obj.metadata.resource_version
+                < existing.metadata.resource_version
+            ):
+                mutate(existing)
+                obj = existing
             if existing is not obj and (
                 obj.metadata.resource_version < existing.metadata.resource_version
             ):

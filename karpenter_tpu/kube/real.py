@@ -22,6 +22,14 @@ run against a real cluster:
 - Self-originated watch events (resourceVersion <= mirror's) are
   deduped, so a controller never has its canonical object replaced by
   the echo of its own write.
+- Every transport request funnels through `_request` and the
+  kube/retry.py RetryPolicy: 429s honor Retry-After under full-jitter
+  backoff, 5xx retries within a per-call budget, and PUT 409s resolve
+  through targeted re-GET + read-modify-write re-apply (`update`
+  takes an optional mutation fn); 410 Gone on a watch triggers a
+  bounded relist. Fault sites (solver/faults.py kube_* kinds) hook
+  both transports so chaos specs replay deterministically over HTTP
+  or in memory.
 
 Transports:
 - `HTTPTransport`: stdlib urllib against an API server URL with a
@@ -50,7 +58,10 @@ from karpenter_tpu.kube.client import (
     WatchHandler,
 )
 from karpenter_tpu.kube.objects import LabelSelector
+from karpenter_tpu.kube.retry import RetryPolicy
 from karpenter_tpu.kube.serialize import FROM_CR, from_cr, to_cr
+from karpenter_tpu.metrics.store import KUBE_RELIST
+from karpenter_tpu.solver import faults as _faults
 
 # kind -> (api prefix, plural, namespaced)
 RESOURCES = {
@@ -88,10 +99,73 @@ def _path(kind: str, name: str = "", namespace: str = "") -> str:
     return "/".join(parts)
 
 
+def _refresh_in_place(dst, src) -> None:
+    """Copy `src`'s data onto `dst` preserving `dst`'s identity (the
+    informer-cache replace minus the identity break, shared by _apply
+    and the 409-recovery _graft so the two can't drift). Not every
+    kind is spec/status shaped (Lease carries holder/renew fields), so
+    copy whatever data attributes the fresh object has."""
+    dst.metadata = src.metadata
+    for attr in ("spec", "status", "status_conditions",
+                 "holder", "renew_time", "lease_duration"):
+        if hasattr(src, attr):
+            setattr(dst, attr, getattr(src, attr))
+
+
 class ApiError(Exception):
     def __init__(self, status: int, message: str = ""):
         self.status = status
         super().__init__(f"HTTP {status}: {message}")
+
+
+# -- kube fault sites (solver/faults.py kinds kube_* / operator_crash) --------
+#
+# Both transports route every request/watch drain through these hooks,
+# so a KARPENTER_FAULTS spec drives the SAME deterministic sequence
+# counters whether the stack runs over HTTP or in memory. The raised
+# fault is consumed here and mapped to the HTTP status a real API
+# server would answer — clients exercise their genuine status-code
+# paths, never a foreign exception type.
+
+_PLURALS = frozenset(plural for _, plural, _ in RESOURCES.values())
+
+
+def _fault_site(method: str, path: str) -> str:
+    if method != "GET":
+        return "kube_write"
+    last = path.rstrip("/").rsplit("/", 1)[-1]
+    return "kube_list" if last in _PLURALS else "kube_read"
+
+
+def _fire_request_fault(method: str, path: str):
+    """Fire the request's fault site. Returns None (no fault), a
+    ("respond", status, body) synthesized answer, ("stale",) to
+    re-serve the previous LIST, or ("partial",) to land the write but
+    lose the response."""
+    try:
+        _faults.fire(_fault_site(method, path))
+    except _faults.KubeConflictError as err:
+        return ("respond", 409, {"message": str(err), "reason": "Conflict"})
+    except _faults.KubeThrottleError as err:
+        return ("respond", 429, {
+            "message": str(err), "reason": "TooManyRequests",
+            "details": {"retryAfterSeconds": err.retry_after},
+        })
+    except _faults.StaleListError:
+        return ("stale",)
+    except _faults.WritePartialError:
+        return ("partial",)
+    return None
+
+
+def _fire_watch_fault(kind: str) -> None:
+    """Fire the kube_watch site; a drop surfaces as the 410 Gone a
+    real apiserver answers when the stream's resourceVersion fell off
+    its event horizon."""
+    try:
+        _faults.fire("kube_watch")
+    except _faults.WatchDropError as err:
+        raise ApiError(410, f"watch of {kind} dropped: {err}") from None
 
 
 class _KindWatch:
@@ -264,6 +338,7 @@ class HTTPTransport:
         self._streams: dict[str, _KindWatch] = {}
         self._gone_pending: set[str] = set()  # kinds owing a 410
         self._streams_lock = threading.Lock()
+        self._list_cache: dict[str, dict] = {}  # path -> last LIST body
 
     def _bearer(self) -> str:
         if self.token_file:
@@ -281,6 +356,27 @@ class HTTPTransport:
 
     def request(self, method: str, path: str, body: Optional[dict] = None,
                 params: Optional[dict] = None) -> tuple[int, dict]:
+        injected = _fire_request_fault(method, path)
+        if injected is not None:
+            if injected[0] == "respond":
+                return injected[1], injected[2]
+            if injected[0] == "stale" and path in self._list_cache:
+                return 200, json.loads(json.dumps(self._list_cache[path]))
+            # "partial": perform the request, then lose the response
+        status, detail = self._request_raw(method, path, body, params)
+        if injected is not None and injected[0] == "partial":
+            return 500, {"message": "injected write-partial: response lost"}
+        if (method == "GET" and status == 200 and "items" in detail
+                and _faults.get() is not None):
+            # remember the last good LIST so an injected stale read has
+            # a genuinely old snapshot to serve; only while a fault
+            # spec is live — the deep copy is O(cluster) per LIST and
+            # the healthy path must not pay it
+            self._list_cache[path] = json.loads(json.dumps(detail))
+        return status, detail
+
+    def _request_raw(self, method: str, path: str, body: Optional[dict],
+                     params: Optional[dict]) -> tuple[int, dict]:
         import ssl
         import urllib.error
         import urllib.parse
@@ -311,6 +407,16 @@ class HTTPTransport:
                 detail = json.loads(payload) if payload else {}
             except ValueError:
                 detail = {"message": payload.decode(errors="replace")}
+            retry_after = err.headers.get("Retry-After") if err.headers else None
+            if retry_after is not None:
+                # fold the header into the Status body where
+                # kube/retry.py reads it (apiservers ship both)
+                try:
+                    detail.setdefault("details", {}).setdefault(
+                        "retryAfterSeconds", float(retry_after)
+                    )
+                except (ValueError, AttributeError):
+                    pass
             return err.code, detail
 
     # LIST-diff fallback (snapshot_watch=True): the client re-lists
@@ -324,6 +430,16 @@ class HTTPTransport:
         use at `since_rv`). Raises ApiError(410) when the server
         declared the resourceVersion too old — the caller re-lists
         and the next call restarts the stream from the fresh rv."""
+        try:
+            _fire_watch_fault(kind)
+        except ApiError:
+            # injected drop: kill the live stream too, so the next
+            # call restarts one from the post-relist rv
+            with self._streams_lock:
+                stream = self._streams.pop(kind, None)
+            if stream is not None:
+                stream.stop()
+            raise
         with self._streams_lock:
             if kind in self._gone_pending:
                 # consume the deferred 410 exactly once; the NEXT call
@@ -403,11 +519,31 @@ class InMemoryApiServer:
         # rv horizon: events at or below this were compacted away; a
         # watch resuming from below it gets 410 Gone (etcd compaction)
         self._compacted_rv = 0
+        self._list_cache: dict[str, dict] = {}  # path -> last LIST body
 
     # -- request API (the Transport protocol) ---------------------------
 
     def request(self, method: str, path: str, body: Optional[dict] = None,
                 params: Optional[dict] = None) -> tuple[int, dict]:
+        injected = _fire_request_fault(method, path)
+        if injected is not None:
+            if injected[0] == "respond":
+                return injected[1], injected[2]
+            if injected[0] == "stale" and path in self._list_cache:
+                return 200, json.loads(json.dumps(self._list_cache[path]))
+        status, detail = self._handle(method, path, body)
+        if injected is not None and injected[0] == "partial":
+            # the write LANDED; the response is lost on the wire
+            return 500, {"message": "injected write-partial: response lost"}
+        if (method == "GET" and status == 200 and "items" in detail
+                and _faults.get() is not None):
+            # last-good-LIST snapshot for kube_stale_list; fault runs
+            # only (the copy is O(cluster) per LIST)
+            self._list_cache[path] = json.loads(json.dumps(detail))
+        return status, detail
+
+    def _handle(self, method: str, path: str,
+                body: Optional[dict]) -> tuple[int, dict]:
         kind, name, namespace, subresource = self._parse(path)
         if kind is None:
             return 404, {"message": f"unknown path {path}"}
@@ -439,6 +575,7 @@ class InMemoryApiServer:
         return 405, {"message": method}
 
     def watch_events(self, kind: str, since_rv: int) -> list[tuple[str, dict, int]]:
+        _fire_watch_fault(kind)
         with self._lock:
             if since_rv < self._compacted_rv:
                 raise ApiError(
@@ -539,9 +676,16 @@ class InMemoryApiServer:
             return 404, {"message": "not found"}
         sent_rv = int(cr.get("metadata", {}).get("resourceVersion", "0") or 0)
         have_rv = int(existing["metadata"].get("resourceVersion", "0"))
-        if sent_rv and sent_rv < have_rv:
+        if sent_rv and sent_rv != have_rv:
+            # full optimistic concurrency, as a real apiserver enforces
+            # it: ANY mismatch is a conflict, not just a stale-older
+            # write — last-write-wins must never silently clobber a
+            # concurrent actor (the conflict-retry wrapper in
+            # RealKubeClient re-GETs and re-applies)
             return 409, {
-                "message": f"resourceVersion conflict: {sent_rv} < {have_rv}"
+                "message": "resourceVersion conflict: "
+                           f"sent {sent_rv}, have {have_rv}",
+                "reason": "Conflict",
             }
         reason = self._admit(kind, cr, existing)
         if reason is not None:
@@ -653,7 +797,30 @@ class RealKubeClient:
         self._pod_node: dict[str, str] = {}
         self.async_delivery = True  # cache semantics are inherent here
         self._last_pump = 0.0
+        self._relist_at: dict[str, float] = {}  # kind -> last 410 relist
         self.sync()
+
+    # -- transport funnel --------------------------------------------------
+
+    def _request(self, verb: str, method: str, path: str,
+                 body: Optional[dict] = None, body_fn=None,
+                 on_conflict=None) -> tuple[int, dict]:
+        """EVERY transport request goes through here (statically
+        enforced by tests/test_kube_write_sites.py): the env-tuned
+        RetryPolicy (kube/retry.py) absorbs 429 storms and apiserver
+        5xx hiccups under per-call budgets, and 409s re-enter through
+        the caller's targeted re-GET + re-apply hook. `body_fn`
+        re-renders the payload per attempt so a conflict hook's
+        mutation lands in the retried write."""
+
+        def attempt() -> tuple[int, dict]:
+            return self.transport.request(
+                method, path, body_fn() if body_fn is not None else body
+            )
+
+        return RetryPolicy.current().execute(
+            verb, attempt, on_conflict=on_conflict
+        )
 
     # -- informer machinery ----------------------------------------------
 
@@ -677,7 +844,7 @@ class RealKubeClient:
         absence. A 404 for a core kind, or any other error, is a real
         connectivity/configuration problem and fails fast."""
         for kind in list(self.kinds):
-            status, body = self.transport.request("GET", _path(kind))
+            status, body = self._request("list", "GET", _path(kind))
             if status == 404 and kind in self.OPTIONAL_KINDS:
                 self.kinds.remove(kind)
                 self._mirror.pop(kind, None)
@@ -718,7 +885,9 @@ class RealKubeClient:
                 return
             self._last_pump = now
             for kind in self.kinds:
-                self._relist(kind)  # snapshot pump IS a relist per kind
+                # snapshot pump IS a relist per kind (already throttled
+                # by snapshot_poll_seconds; not a 410 reaction)
+                self._relist(kind, reason="snapshot")
             return
         for kind in self.kinds:
             try:
@@ -752,11 +921,30 @@ class RealKubeClient:
                     continue
                 self._apply(kind, self._from_item(kind, cr), rv, event)
 
-    def _relist(self, kind: str) -> None:
+    def _relist(self, kind: str, reason: str = "watch_gone") -> None:
         """Full LIST + mirror diff for one kind (the informer's
         reaction to 410 Gone), synthesizing DELETED for keys that
-        vanished while the watch was stale."""
-        status, body = self.transport.request("GET", _path(kind))
+        vanished while the watch was stale. 410-driven relists are
+        BOUNDED (KARPENTER_KUBE_RELIST_MIN_MS, default 500): a
+        flapping watch degrades freshness by one bounded interval
+        instead of hammering the apiserver with O(cluster) LISTs every
+        pump — the 410 stays pending server-side, so a skipped relist
+        is retried on the next pump."""
+        if reason == "watch_gone":
+            import os as _os
+            import time as _time
+
+            try:
+                min_s = float(_os.environ.get(
+                    "KARPENTER_KUBE_RELIST_MIN_MS", "500")) / 1000.0
+            except ValueError:
+                min_s = 0.5
+            now = _time.monotonic()
+            if now - self._relist_at.get(kind, float("-inf")) < min_s:
+                return
+            self._relist_at[kind] = now
+            KUBE_RELIST.inc({"kind": kind})
+        status, body = self._request("list", "GET", _path(kind))
         if status != 200:
             return  # transient; the next pump retries
         live_keys = set()
@@ -791,14 +979,8 @@ class RealKubeClient:
             if current is not None:
                 # refresh the CANONICAL instance in place so controller
                 # references stay valid (informer cache replace, minus
-                # the identity break). Not every kind is spec/status
-                # shaped (Lease carries holder/renew fields), so copy
-                # whatever data attributes the fresh object has.
-                current.metadata = obj.metadata
-                for attr in ("spec", "status", "status_conditions",
-                             "holder", "renew_time", "lease_duration"):
-                    if hasattr(obj, attr):
-                        setattr(current, attr, getattr(obj, attr))
+                # the identity break)
+                _refresh_in_place(current, obj)
                 obj = current
             else:
                 self._mirror[kind][obj.key] = obj
@@ -834,8 +1016,97 @@ class RealKubeClient:
 
     # -- writes ----------------------------------------------------------
 
-    def _push(self, method: str, obj, path: str) -> None:
-        status, body = self.transport.request(method, path, to_cr(obj))
+    def _graft(self, obj, fresh_cr: dict) -> None:
+        """Adopt the server's fresh state onto the canonical instance
+        in place (identity preserved — the same refresh the informer
+        _apply does, just ahead of the pump)."""
+        _refresh_in_place(obj, self._from_item(obj.kind, fresh_cr))
+
+    @staticmethod
+    def _sans_stamps(cr: dict) -> dict:
+        """A CR with the server-stamped metadata fields removed, for
+        did-my-write-land comparisons."""
+        out = json.loads(json.dumps(cr))
+        meta = out.get("metadata") or {}
+        meta.pop("resourceVersion", None)
+        meta.pop("generation", None)
+        return out
+
+    def _push(self, method: str, obj, path: str, mutate=None) -> None:
+        """Write `obj`; conflict-aware (controller-runtime's
+        RetryOnConflict shape). On a 409 the hook re-GETs the server
+        copy and decides:
+
+        - server rv == ours: spurious conflict (an injected fault or a
+          proxy flake) — the state never moved, re-send as-is;
+        - server content == ours modulo stamps AND a prior attempt of
+          THIS call lost its response (5xx in the history): OUR write
+          landed (write-partial) — adopt the server rv, done. The
+          history gate matters: without it, a concurrent writer
+          landing IDENTICAL content would be mistaken for our own
+          write and a CAS caller would silently lose an update;
+        - genuine divergence: re-apply the caller's `mutate` fn on the
+          refreshed object and retry (read-modify-write); without a
+          mutation fn the conflict is the CALLER's to resolve —
+          ConflictError, exactly as before, never last-write-wins.
+        """
+        get_path = _path(obj.kind, obj.metadata.name, obj.metadata.namespace)
+        resolved: dict = {}
+        vanished: dict = {}
+
+        def on_conflict(history=()) -> bool:
+            st, fresh = self._request("get", "GET", get_path)
+            if st == 404:
+                # nothing there: a POST's injected conflict (re-send);
+                # a PUT's target vanished — that is a NotFound, not a
+                # Conflict (a real apiserver would answer the PUT 404),
+                # so touch()'s gone-object-is-a-no-op contract holds
+                if method == "PUT":
+                    vanished["msg"] = fresh.get("message", obj.key)
+                return method == "POST"
+            if st != 200:
+                return False
+            fresh_rv = int(
+                fresh.get("metadata", {}).get("resourceVersion", "0") or 0
+            )
+            ours = to_cr(obj)
+            if method == "PUT" and fresh_rv == int(
+                ours.get("metadata", {}).get("resourceVersion", "0") or 0
+            ):
+                return True  # spurious: state unmoved, re-send as-is
+            if any(s >= 500 for s in history) and (
+                self._sans_stamps(fresh) == self._sans_stamps(ours)
+            ):
+                resolved["rv"] = fresh_rv  # our lost-response write landed
+                return False
+            if mutate is None or method == "POST":
+                # genuine conflict, the CALLER resolves (ConflictError).
+                # For PUTs, adopt the server truth onto the canonical
+                # object first, so the caller's retry cycle (re-read ->
+                # re-apply -> update) works from current state
+                # immediately instead of losing a race to the next
+                # informer pump — their intended write is already lost
+                # either way, that is what the 409 says.
+                if method == "PUT":
+                    self._graft(obj, fresh)
+                return False
+            # true read-modify-write: graft the SERVER's fresh state
+            # onto the canonical instance, then re-apply the caller's
+            # mutation on top — the remote actor's fields survive,
+            # ours land
+            self._graft(obj, fresh)
+            mutate(obj)
+            return True
+
+        status, body = self._request(
+            "create" if method == "POST" else "update", method, path,
+            body_fn=lambda: to_cr(obj), on_conflict=on_conflict,
+        )
+        if status == 409 and resolved:
+            obj.metadata.resource_version = resolved["rv"]
+            return
+        if status == 409 and vanished:
+            raise NotFoundError(vanished["msg"])
         if status == 409:
             raise ConflictError(body.get("message", "conflict"))
         if status == 404:
@@ -876,10 +1147,18 @@ class RealKubeClient:
         self._announce(obj.kind, ADDED, obj)
         return obj
 
-    def update(self, obj):
+    def update(self, obj, mutate=None):
+        """Write the object back. `mutate` (optional) is the caller's
+        intended mutation as a FUNCTION of the object — applied before
+        the first attempt and RE-applied after each conflict re-GET,
+        so a racy write converges to read-modify-write instead of
+        last-write-wins."""
+        if mutate is not None:
+            mutate(obj)
         self._push(
             "PUT", obj,
             _path(obj.kind, obj.metadata.name, obj.metadata.namespace),
+            mutate=mutate,
         )
         if obj.kind not in self._mirror:
             return obj  # write-only kind (Events): push, don't cache
@@ -910,12 +1189,14 @@ class RealKubeClient:
         to EvictionBlockedError for the caller's backoff queue; an
         already-gone pod is success."""
         path = _path("Pod", pod.metadata.name, pod.metadata.namespace)
-        status, body = self.transport.request("POST", path + "/eviction", {
+        # eviction is idempotent server-side: a racy/injected 409 is
+        # safely re-sent (the PDB-blocked 429 still passes through)
+        status, body = self._request("evict", "POST", path + "/eviction", {
             "apiVersion": "policy/v1",
             "kind": "Eviction",
             "metadata": {"name": pod.metadata.name,
                          "namespace": pod.metadata.namespace},
-        })
+        }, on_conflict=lambda *_: True)
         if status == 404:
             with self._lock:
                 self._mirror["Pod"].pop(pod.key, None)
@@ -936,7 +1217,7 @@ class RealKubeClient:
         # carries no deletionTimestamp, GET the pod to learn whether it
         # is terminating (grace period / finalizers) or already gone.
         if not (body and body.get("metadata", {}).get("deletionTimestamp")):
-            st, got = self.transport.request("GET", path)
+            st, got = self._request("get", "GET", path)
             body = got if st == 200 else {}
         # mirror bookkeeping identical to delete(): either the pod is
         # wedged terminating behind a finalizer or it is gone
@@ -967,13 +1248,23 @@ class RealKubeClient:
             obj = self.get(obj_or_kind.kind, obj_or_kind.key)
         if obj is None:
             return None
-        status, body = self.transport.request(
-            "DELETE",
+        # deletes carry no resourceVersion precondition here: a
+        # racy/injected 409 is safely re-sent (idempotent)
+        status, body = self._request(
+            "delete", "DELETE",
             _path(obj.kind, obj.metadata.name, obj.metadata.namespace),
+            on_conflict=lambda *_: True,
         )
         if status == 404:
+            # already gone server-side (another actor, or OUR earlier
+            # delete whose response was lost and the wrapper retried):
+            # in-process subscribers must still hear the deletion —
+            # the server's DELETED echo skips keys the mirror already
+            # dropped, so without this announce they never would
             with self._lock:
                 self._mirror[obj.kind].pop(obj.key, None)
+                self._index_pod(obj, removed=True)
+            self._announce(obj.kind, DELETED, obj)
             return None
         if status >= 400:
             raise ApiError(status, body.get("message", ""))
@@ -1016,11 +1307,14 @@ class RealKubeClient:
             self._announce(obj.kind, DELETED, obj)
 
     def bind_pod(self, pod, node_name: str) -> None:
-        status, body = self.transport.request(
-            "POST",
+        # bindings are idempotent toward the same target: a
+        # racy/injected 409 is safely re-sent
+        status, body = self._request(
+            "bind", "POST",
             _path("Pod", pod.metadata.name, pod.metadata.namespace)
             + "/binding",
             {"target": {"kind": "Node", "name": node_name}},
+            on_conflict=lambda *_: True,
         )
         if status >= 400:
             raise ApiError(status, body.get("message", ""))

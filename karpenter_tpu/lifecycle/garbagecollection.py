@@ -16,9 +16,11 @@ import logging
 import time
 from typing import Optional
 
+from karpenter_tpu.apis.v1.labels import NODEPOOL_LABEL
 from karpenter_tpu.apis.v1.nodeclaim import COND_REGISTERED
 from karpenter_tpu.cloudprovider.types import CloudProvider, NodeClaimNotFoundError
 from karpenter_tpu.kube.client import KubeClient
+from karpenter_tpu.metrics.store import OPERATOR_RECOVERY
 from karpenter_tpu.operator.options import Options
 
 log = logging.getLogger("karpenter.gc")
@@ -34,16 +36,22 @@ class GarbageCollectionController:
 
     def reconcile(self, now: Optional[float] = None) -> dict[str, int]:
         now = time.time() if now is None else now
-        stats = {"leaked_instances": 0, "orphaned_claims": 0}
+        stats = {"leaked_instances": 0, "orphaned_claims": 0,
+                 "orphaned_nodes": 0}
         claims = {c.status.provider_id: c for c in self.kube.node_claims()
                   if c.status.provider_id}
-        # leaked cloud instances with no claim
+        # leaked cloud instances with no claim — including the
+        # double-launch window: a crash between the provider create and
+        # the claim's status write leaves a running instance no claim
+        # records; the restarted operator re-launches, and this pass
+        # reaps the unrecorded twin
         for remote in self.cloud.list():
             pid = remote.status.provider_id
             if pid and pid not in claims:
                 try:
                     self.cloud.delete(remote)
                     stats["leaked_instances"] += 1
+                    OPERATOR_RECOVERY.inc({"action": "reaped_leak"})
                     log.info("gc: deleted leaked instance %s", pid)
                 except NodeClaimNotFoundError:
                     pass
@@ -58,6 +66,23 @@ class GarbageCollectionController:
                 self.kube.delete(claim, now=now)
                 stats["orphaned_claims"] += 1
                 log.info("gc: deleted orphaned claim %s", claim.metadata.name)
+        # karpenter-managed Node objects whose backing instance AND
+        # claim are both gone (the node a reaped leaked instance had
+        # already materialized): nothing else deletes these — the claim
+        # cascade never knew them. Instance liveness is checked AFTER
+        # the leak pass so a just-reaped twin's node goes too.
+        live_pids = {
+            i.status.provider_id for i in self.cloud.list()
+            if i.status.provider_id
+        }
+        for node in self.kube.nodes():
+            if NODEPOOL_LABEL not in node.metadata.labels:
+                continue  # bring-your-own nodes are never GC'd
+            pid = node.spec.provider_id
+            if pid and pid not in live_pids and pid not in claims:
+                self.kube.delete(node, now=now)
+                stats["orphaned_nodes"] += 1
+                log.info("gc: deleted orphaned node %s", node.metadata.name)
         return stats
 
 

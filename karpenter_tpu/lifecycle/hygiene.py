@@ -83,6 +83,15 @@ class ConsistencyController:
                 continue
             node = nodes_by_pid.get(claim.status.provider_id)
             if node is None:
+                # a Registered claim with no live node is the crash-
+                # recovery window (node deleted by another actor, or an
+                # operator died between two registration writes):
+                # surface it on the condition so readiness dashboards
+                # see the inconsistency while GC converges it
+                if claim.metadata.deletion_timestamp is None:
+                    claim.status_conditions.set_false(
+                        COND_CONSISTENT_STATE_FOUND, "NodeMissing", now=now
+                    )
                 continue
             consistent = True
             for key, expected in claim.status.capacity.items():

@@ -156,6 +156,24 @@ class NodeClaimLifecycle:
             else:
                 self._active.add(key)
 
+    def adopt_in_flight(self) -> int:
+        """Crash recovery (Operator._recover): re-enter every claim
+        still progressing — not yet Initialized, or mid-deletion —
+        into the active set, so a restarted operator advances them on
+        its own clocks instead of waiting for watch traffic the old
+        process already consumed. Idempotent; returns how many claims
+        are in flight."""
+        adopted = 0
+        for claim in self.kube.node_claims():
+            settled = (
+                claim.metadata.deletion_timestamp is None
+                and claim.status_conditions.is_true(COND_INITIALIZED)
+            )
+            if not settled:
+                self._active.add(claim.key)
+                adopted += 1
+        return adopted
+
     def _signature(self, claim: NodeClaim) -> tuple:
         return (
             claim.status.provider_id,
@@ -200,6 +218,12 @@ class NodeClaimLifecycle:
             claim.status_conditions.set_false(COND_LAUNCHED, "LaunchFailed", str(err), now=now)
             self.kube.update(claim)
             return
+        # crash window: the cloud instance EXISTS but the claim does
+        # not record it yet — a restarted operator re-launches (one
+        # live instance per claim) and GC reaps the unrecorded orphan
+        from karpenter_tpu.solver import faults as _faults
+
+        _faults.fire("crash_launch")
         self._launch_retry.pop(claim.key, None)
         claim.status.provider_id = launched.status.provider_id
         claim.status.image_id = launched.status.image_id

@@ -246,6 +246,24 @@ SOLVER_HEDGE = REGISTRY.counter(
 SOLVER_FAULTS_INJECTED = REGISTRY.counter(
     "karpenter_solver_faults_injected_total",
     "Faults fired by the deterministic injector, by site and kind")
+# control-plane fault tolerance (kube/retry.py, operator recovery):
+# the kube-API analogue of the solver breaker metrics above
+KUBE_RETRIES = REGISTRY.counter(
+    "karpenter_kube_retries_total",
+    "Kube API requests retried by the conflict/throttle-aware write "
+    "wrapper, by verb and response status (409/429/5xx)")
+KUBE_RELIST = REGISTRY.counter(
+    "karpenter_kube_relist_total",
+    "Informer relists after a watch fell off the server's event "
+    "horizon (410 Gone), by kind")
+OPERATOR_RECOVERY = REGISTRY.counter(
+    "karpenter_operator_recovery_total",
+    "Crash-recovery actions taken at operator boot, by action "
+    "(readopted_claim / requeued_pod / reaped_leak)")
+BINDING_RETRY = REGISTRY.counter(
+    "karpenter_binding_retry_total",
+    "Pod bindings re-enqueued after a retryable API failure "
+    "(409/429/5xx), by status")
 DISRUPTION_PROBE_STARVATION = REGISTRY.counter(
     "karpenter_disruption_probe_starvation_total",
     "Consolidation probes attempted vs still remaining when a method's "

@@ -47,9 +47,11 @@ from karpenter_tpu.metrics.controllers import (
     PodMetricsController,
     StatusConditionMetricsController,
 )
+from karpenter_tpu.metrics.store import BINDING_RETRY, OPERATOR_RECOVERY
 from karpenter_tpu.operator.options import Options
 from karpenter_tpu.provisioning.provisioner import Provisioner
 from karpenter_tpu.provisioning.static import StaticCapacityController
+from karpenter_tpu.solver import faults as _faults
 from karpenter_tpu.state.cluster import Cluster, attach_informers
 from karpenter_tpu.state.nodepoolhealth import HealthTracker
 
@@ -177,6 +179,10 @@ class Operator:
         # real cluster; this runtime owns the whole substrate, so it
         # binds pods to the nodes the solver placed them on)
         self._pending_bindings: list = []
+        # crash/restart convergence: the first tick rebuilds in-flight
+        # intent from the API alone (see _recover)
+        self._recovered = False
+        self._recovery: dict = {}
 
         # pod/node watch events drive the provisioning batcher
         # (provisioning/controller.go PodController/NodeController)
@@ -198,8 +204,11 @@ class Operator:
         # consistent (possibly one-tick-stale) mirror — the informer
         # cache model the reference's Synced() barrier exists for
         self.kube.deliver()
+        _faults.fire("crash_tick")
         if self.leader_election and not self.elector.try_acquire_or_renew(now):
             return  # standby replica: keep the mirror warm, do nothing
+        if not self._recovered:
+            self._recover(now)
         if self.overlay_controller is not None:
             # overlay snapshot before anything consumes instance types
             self.overlay_controller.reconcile(now=now)
@@ -261,6 +270,9 @@ class Operator:
         if self.provisioner.batcher.ready(now=now):
             with self.profiler.span("provisioning"):
                 results = self.provisioner.reconcile(now=now)
+            # crash window: NodeClaims written, binding plan not yet
+            # queued — restart must re-derive the plan from the API
+            _faults.fire("crash_provision")
             self._enqueue_bindings(results, now, BIND_RESULTS_TTL_SECONDS)
 
         with self.profiler.span("lifecycle"):
@@ -291,6 +303,11 @@ class Operator:
             self._last_disruption = now
             with self.profiler.span("disruption"):
                 command = self.disruption.reconcile(now=now)
+                if command is not None:
+                    # crash window: command started (candidates tainted,
+                    # replacements created) but its binding plan and the
+                    # queue's in-memory command state die with us
+                    _faults.fire("crash_disruption_started")
                 if command is not None and command.results is not None:
                     # the command's placements ARE the plan for the
                     # candidates' pods: route them through the binding
@@ -325,6 +342,78 @@ class Operator:
             self.node_metrics.reconcile_all(now=now)
             self.nodepool_metrics.reconcile_all(now=now)
             self.status_condition_metrics.reconcile_all(now=now)
+
+    def _recover(self, now: float) -> None:
+        """Crash/restart convergence: the first tick rebuilds in-flight
+        intent from the API alone. A predecessor's memory — its
+        `_pending_bindings` plans, lifecycle active set, launch
+        backoffs, disruption queue — is gone; everything it had already
+        WRITTEN (claims, taints, deletionTimestamps) survives on the
+        API server and is the only truth.
+
+        - claims still progressing (or deleting) re-enter the lifecycle
+          active set so they advance without waiting for fresh events;
+        - lost binding plans are re-derived by re-solving the pending
+          pods against the surviving in-flight capacity (the scheduler
+          routes them onto existing unregistered claims, so no capacity
+          is bought twice);
+        - a GC pass reaps launches that were decided but never
+          acknowledged (cloud instances no claim records — the
+          double-launch window) before any solve can bind onto them.
+        """
+        self._recovered = True
+        readopted = self.lifecycle.adopt_in_flight()
+        deleting = sum(
+            1 for c in self.kube.node_claims()
+            if c.metadata.deletion_timestamp is not None
+        )
+        requeued = 0
+        if readopted or deleting:
+            pending = self.provisioner.get_pending_pods()
+            requeued = len(pending)
+            if pending:
+                # nominated-but-unbound pods lost their plan with the
+                # old process: re-solve them (deadline-free — the
+                # in-flight claims they were headed to still count as
+                # capacity, so the fresh solve re-derives the bindings)
+                self.provisioner.batcher.trigger(now=now)
+            self.gc.reconcile(now=now)
+            self._last_gc = now
+        if readopted:
+            OPERATOR_RECOVERY.inc({"action": "readopted_claim"},
+                                  value=float(readopted))
+        if requeued:
+            OPERATOR_RECOVERY.inc({"action": "requeued_pod"},
+                                  value=float(requeued))
+        self._recovery = {
+            "readopted_claims": readopted,
+            "requeued_pods": requeued,
+            "deleting_claims": deleting,
+        }
+
+    def _bind_one(self, pod, node_name: str) -> bool:
+        """Bind one pod; on a RETRYABLE failure (409/429/5xx — an
+        apiserver conflict or throttle that outlived the transport's
+        own retry budget) the plan is held and re-tried next tick
+        under its remaining TTL instead of being dropped. Returns
+        False when the binding must be re-enqueued."""
+        from karpenter_tpu.kube.client import ConflictError
+        from karpenter_tpu.kube.real import ApiError
+
+        _faults.fire("crash_bind")
+        try:
+            self.kube.bind_pod(pod, node_name)
+            return True
+        except ConflictError:
+            status = 409
+        except ApiError as err:
+            if err.status not in (409, 429) and not 500 <= err.status < 600:
+                raise
+            status = err.status
+        BINDING_RETRY.inc({"status": str(status)})
+        log.warning("binding %s -> %s failed with retryable HTTP %s; "
+                    "re-enqueued", pod.key, node_name, status)
+        return False
 
     def _enqueue_bindings(self, results, now: float, ttl: float) -> None:
         results.bind_deadline = now + ttl
@@ -378,7 +467,8 @@ class Operator:
                             unbound = True
                         continue  # already home (or nothing to wait on)
                     if node_name and not claim_gone:
-                        self.kube.bind_pod(live, node_name)
+                        if not self._bind_one(live, node_name):
+                            unbound = True
                     elif claim_gone:
                         # binding target never materializes (ICE /
                         # liveness timeout deleted the claim): re-queue
@@ -416,7 +506,8 @@ class Operator:
                 for pod in pods:
                     live = self.kube.get_pod(pod.metadata.namespace, pod.metadata.name)
                     if live is not None and not live.spec.node_name:
-                        self.kube.bind_pod(live, target)
+                        if not self._bind_one(live, target):
+                            unbound = True
                     elif live is None or live.spec.node_name != target:
                         # awaiting rebirth from the drain, or still
                         # bound to the node being drained: HOLD the
@@ -448,6 +539,9 @@ class Operator:
         return {
             "ok": synced,
             "checks": {"informers_synced": synced, "leader": leader},
+            # crash-recovery status: what the first tick rebuilt from
+            # the API ({} until the first tick has run)
+            "recovery": dict(self._recovery),
         }
 
     def serve_observability(self, port: Optional[int] = None):

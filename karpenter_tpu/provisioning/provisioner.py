@@ -448,6 +448,11 @@ class Provisioner:
         if not self.cluster.synced():
             return SchedulerResults(new_node_plans=[], existing_assignments={})
         results = self.schedule()
+        # crash window: the solver decided but nothing is written yet —
+        # a restart must re-solve to the same decision from the API
+        from karpenter_tpu.solver import faults as _faults
+
+        _faults.fire("crash_claims")
         self.create_node_claims(results, now=now)
         self._record_events(results, now=now)
         self.batcher.reset()

@@ -14,6 +14,8 @@ Spec grammar (KARPENTER_FAULTS, comma-separated entries):
 
     entry  = kind [ "@" site ] [ ":" occ ] [ "=" duration ]
     kind   = device_lost | rpc_drop | compile_delay | exec_delay
+           | kube_conflict | kube_throttle | kube_watch_drop
+           | kube_stale_list | kube_write_partial | operator_crash
     occ    = "*" | N | N "+" | N "-" M        (1-based, per site)
 
 Examples:
@@ -21,9 +23,13 @@ Examples:
     rpc_drop@probe:*           every batched-probe dispatch drops
     compile_delay=5s           every kernel dispatch sleeps 5s first
     rpc_drop@rpc:2-4           RPCs 2..4 drop, then the service heals
+    kube_conflict@kube_write:2-4   writes 2..4 answer 409
+    kube_throttle=250ms        every kube write 429s, Retry-After 250ms
+    operator_crash@crash_bind:2    die just before the 2nd pod binding
 
 Default sites per kind: device_lost -> solve, rpc_drop -> rpc,
-compile_delay -> compile, exec_delay -> execute. Error kinds raise
+compile_delay -> compile, exec_delay -> execute, kube faults -> their
+natural verb site, operator_crash -> crash_tick. Error kinds raise
 their exception at the site; delay kinds sleep there (inflating the
 phase the watchdog budgets). Instrumented sites:
 
@@ -34,6 +40,30 @@ phase the watchdog budgets). Instrumented sites:
     warm        warm_pool per-bucket AOT compile
     rpc         service client, before sending the RPC
     rpc_server  service server, inside the Solve handler
+
+Kube sites (hooked into HTTPTransport.request/watch_events and
+InMemoryApiServer — the transport maps the raised fault to the HTTP
+status a real API server would answer; see kube/real.py):
+
+    kube_read   GET of a single object
+    kube_list   collection GET (LIST)
+    kube_write  POST/PUT/DELETE incl. the eviction/binding subresources
+    kube_watch  one watch_events() drain (drop -> 410 Gone -> relist)
+
+Operator crash points (Operator.step and the controllers it drives;
+`operator_crash` raises OperatorCrashError there — the restart-chaos
+harness treats it as process death and boots a fresh operator against
+the surviving API server):
+
+    crash_tick                 tick start, right after the informer pump
+    crash_claims               solver decided, before NodeClaims are written
+    crash_provision            claims written, before the binding plan is queued
+    crash_bind                 before the Nth pod binding of the tick
+    crash_launch               provider launch succeeded, before the claim
+                               records its provider id (the double-launch window)
+    crash_disruption           disruption command computed, before it starts
+    crash_disruption_started   command started (taints + replacements),
+                               before its binding plan is queued
 """
 
 from __future__ import annotations
@@ -49,16 +79,34 @@ log = logging.getLogger("karpenter.solver.faults")
 
 ENV_SPEC = "KARPENTER_FAULTS"
 
-SITES = ("solve", "compile", "execute", "probe", "warm", "rpc", "rpc_server")
+CRASH_SITES = (
+    "crash_tick", "crash_claims", "crash_provision", "crash_bind",
+    "crash_launch", "crash_disruption", "crash_disruption_started",
+)
+
+SITES = (
+    "solve", "compile", "execute", "probe", "warm", "rpc", "rpc_server",
+    "kube_read", "kube_list", "kube_write", "kube_watch",
+) + CRASH_SITES
 
 _DEFAULT_SITE = {
     "device_lost": "solve",
     "rpc_drop": "rpc",
     "compile_delay": "compile",
     "exec_delay": "execute",
+    "kube_conflict": "kube_write",
+    "kube_throttle": "kube_write",
+    "kube_watch_drop": "kube_watch",
+    "kube_stale_list": "kube_list",
+    "kube_write_partial": "kube_write",
+    "operator_crash": "crash_tick",
 }
 
-_ERROR_KINDS = ("device_lost", "rpc_drop")
+_ERROR_KINDS = (
+    "device_lost", "rpc_drop", "kube_conflict", "kube_throttle",
+    "kube_watch_drop", "kube_stale_list", "kube_write_partial",
+    "operator_crash",
+)
 
 
 class FaultError(RuntimeError):
@@ -71,6 +119,49 @@ class DeviceLostError(FaultError):
 
 class RpcDropError(FaultError):
     """Injected stand-in for an unreachable solver service."""
+
+
+class KubeFaultError(FaultError):
+    """Base class for kube-API faults: raised at the transport's fault
+    site and CONSUMED there — the transport answers the HTTP status the
+    fault models, so clients exercise their real status-code paths
+    instead of a foreign exception type."""
+
+
+class KubeConflictError(KubeFaultError):
+    """Injected 409: the write raced another actor."""
+
+
+class KubeThrottleError(KubeFaultError):
+    """Injected 429: API-server client-side throttling. `retry_after`
+    (the entry's =duration) rides in the Status body the way a real
+    apiserver ships details.retryAfterSeconds."""
+
+    def __init__(self, message: str, retry_after: float = 0.0):
+        super().__init__(message)
+        self.retry_after = retry_after
+
+
+class WatchDropError(KubeFaultError):
+    """Injected watch-stream drop: the transport surfaces 410 Gone so
+    the informer must relist."""
+
+
+class StaleListError(KubeFaultError):
+    """Injected stale LIST: the transport re-serves its previous LIST
+    response (an etcd follower lagging behind a quorum write)."""
+
+
+class WritePartialError(KubeFaultError):
+    """Injected write-partial: the write LANDS server-side but the
+    response is lost (connection cut after commit) — the client sees a
+    500 for a mutation that actually happened."""
+
+
+class OperatorCrashError(FaultError):
+    """Injected operator death at a crash point. Never caught inside
+    the operator: it must unwind the whole tick, exactly like SIGKILL
+    between two writes would."""
 
 
 @dataclass(frozen=True)
@@ -173,14 +264,26 @@ class FaultInjector:
                             rule.kind, site, seq, rule.delay)
                 self._sleep(rule.delay)
             elif error is None:
-                if rule.kind == "device_lost":
-                    error = DeviceLostError(
-                        f"injected device_lost@{site}:{seq}")
-                elif rule.kind == "rpc_drop":
-                    error = RpcDropError(f"injected rpc_drop@{site}:{seq}")
+                error = self._make_error(rule, site, seq)
         if error is not None:
             log.warning("fault injected: %s", error)
             raise error
+
+    @staticmethod
+    def _make_error(rule: FaultRule, site: str, seq: int) -> FaultError:
+        message = f"injected {rule.kind}@{site}:{seq}"
+        if rule.kind == "kube_throttle":
+            return KubeThrottleError(message, retry_after=rule.delay)
+        cls = {
+            "device_lost": DeviceLostError,
+            "rpc_drop": RpcDropError,
+            "kube_conflict": KubeConflictError,
+            "kube_watch_drop": WatchDropError,
+            "kube_stale_list": StaleListError,
+            "kube_write_partial": WritePartialError,
+            "operator_crash": OperatorCrashError,
+        }.get(rule.kind, FaultError)
+        return cls(message)
 
     def snapshot_log(self) -> list[tuple[str, int, str]]:
         """Copy of the fired-fault log: (site, per-site seq, kind) in

@@ -253,6 +253,14 @@ class Cluster:
                 return self._by_provider.get(pid)
             return self._unpaired_claims.get(name)
 
+    def unpaired_claim_names(self) -> list[str]:
+        """Names of claims tracked without a node yet (launched or
+        launching capacity still materializing) — the in-flight set a
+        crash-recovery pass re-adopts, and what restart-convergence
+        tests assert drains to empty."""
+        with self._lock:
+            return sorted(self._unpaired_claims)
+
     def deep_copy_nodes(self) -> list[StateNode]:
         """Snapshot for a scheduling run (cluster.go:249)."""
         with self._lock:

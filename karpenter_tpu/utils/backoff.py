@@ -22,6 +22,15 @@ def jitter(rng: Optional[_random.Random] = None) -> float:
     return 0.5 + 0.5 * (rng or _random).random()
 
 
+def full_jitter(window: float, rng: Optional[_random.Random] = None) -> float:
+    """Full-jitter wait in [0, window): the AWS-architecture-blog
+    variant for API-server retry storms, where spreading a throttled
+    cohort across the WHOLE window (including ~0) empties the server's
+    queue fastest. Use `jitter` instead when the wait must remain a
+    lower-bounded backoff (breaker cooldowns, reconnects)."""
+    return window * (rng or _random).random()
+
+
 def capped_exponential(
     attempts: int, base: float, cap: float, max_exp: int = 16
 ) -> float:

@@ -27,6 +27,12 @@ def pytest_configure(config):
         "(part of tier-1; select alone with -m chaos)",
     )
     config.addinivalue_line(
+        "markers",
+        "restart_chaos: kill-and-restart convergence scenarios against "
+        "a surviving API server (part of tier-1; select alone with "
+        "-m restart_chaos)",
+    )
+    config.addinivalue_line(
         "markers", "slow: excluded from the tier-1 verify run"
     )
 

@@ -174,6 +174,51 @@ class TestHttpWatchStream:
             b.close()
 
 
+class TestInjectedWatchDrop:
+    def test_kindwatch_drop_410_relist_recovers(self, served, monkeypatch):
+        """Satellite (ISSUE 5) over the LIVE socket: an injected
+        kube_watch_drop kills the _KindWatch stream and surfaces 410
+        Gone; the client relists, restarts the stream at the fresh rv,
+        and no event is missed or duplicated."""
+        from karpenter_tpu.solver import faults
+
+        monkeypatch.setenv("KARPENTER_KUBE_RELIST_MIN_MS", "0")
+        _, srv = served
+        a, b = _client(srv), _client(srv)
+        try:
+            a.create(mk_nodepool("before"))
+            assert _pump_until(
+                b, lambda: b.get_node_pool("before") is not None
+            )
+            events = []
+            b.watch("NodePool",
+                    lambda ev, obj: events.append((ev, obj.key)))
+            monkeypatch.setenv("KARPENTER_FAULTS",
+                               "kube_watch_drop@kube_watch:1-4")
+            faults.reset()
+            a.create(mk_nodepool("during"))
+            assert _pump_until(
+                b, lambda: b.get_node_pool("during") is not None,
+                seconds=8.0,
+            ), "relist after injected drop did not converge"
+            monkeypatch.delenv("KARPENTER_FAULTS")
+            faults.reset()
+            a.create(mk_nodepool("after"))
+            assert _pump_until(
+                b, lambda: b.get_node_pool("after") is not None,
+                seconds=8.0,
+            ), "stream did not resume after the drop storm"
+            for key in ("during", "after"):
+                assert [e for e in events if e == ("ADDED", key)] == [
+                    ("ADDED", key)
+                ], f"missed or duplicated event for {key}: {events}"
+        finally:
+            monkeypatch.delenv("KARPENTER_FAULTS", raising=False)
+            faults.reset()
+            a.close()
+            b.close()
+
+
 class TestHttpAuth:
     def test_bearer_token_and_refresh(self, served, tmp_path):
         api, srv = served

@@ -1,0 +1,332 @@
+"""kube/retry.py + the RealKubeClient conflict-aware write path:
+Retry-After honoring, full-jitter windows, per-call budgets, PDB-429
+exemption, read-modify-write conflict resolution, write-partial
+self-healing, and the 409/429/watch-drop storm decision-identity
+acceptance (ISSUE 5)."""
+
+import time
+
+import pytest
+
+from karpenter_tpu.kube.client import ConflictError
+from karpenter_tpu.kube.real import InMemoryApiServer, RealKubeClient
+from karpenter_tpu.kube.retry import RetryPolicy
+from karpenter_tpu.metrics.store import BINDING_RETRY, KUBE_RELIST, KUBE_RETRIES
+from karpenter_tpu.solver import faults
+from karpenter_tpu.testing import mk_nodepool, mk_pod
+
+
+@pytest.fixture()
+def clean_faults(monkeypatch):
+    monkeypatch.delenv("KARPENTER_FAULTS", raising=False)
+    monkeypatch.setenv("KARPENTER_KUBE_RETRY_BASE_MS", "1")
+    monkeypatch.setenv("KARPENTER_KUBE_RELIST_MIN_MS", "0")
+    faults.reset()
+    yield monkeypatch
+    faults.reset()
+
+
+class TestRetryPolicy:
+    def test_429_honors_retry_after(self):
+        responses = [
+            (429, {"details": {"retryAfterSeconds": 0.25}}),
+            (200, {}),
+        ]
+        waits = []
+        policy = RetryPolicy(base_seconds=0.001, cap_seconds=0.01)
+        status, _ = policy.execute(
+            "update", lambda: responses.pop(0), sleep=waits.append,
+        )
+        assert status == 200
+        # the server's Retry-After is a FLOOR under the jittered window
+        assert waits and waits[0] >= 0.25
+
+    def test_5xx_retries_with_backoff_then_succeeds(self):
+        responses = [(503, {}), (502, {}), (200, {"ok": True})]
+        waits = []
+        policy = RetryPolicy(base_seconds=0.004, cap_seconds=0.05)
+        status, body = policy.execute(
+            "create", lambda: responses.pop(0), sleep=waits.append,
+        )
+        assert status == 200 and body == {"ok": True}
+        assert len(waits) == 2
+        # full jitter: within [0, window); windows double
+        assert 0.0 <= waits[0] < 0.004 and 0.0 <= waits[1] < 0.008
+
+    def test_budget_degrades_instead_of_wedging(self):
+        """A hard-throttled apiserver: the call returns the last 429
+        within the budget instead of sleeping forever."""
+        clock = {"t": 0.0}
+
+        def sleep(s):
+            clock["t"] += s
+
+        policy = RetryPolicy(max_attempts=50, base_seconds=0.5,
+                             cap_seconds=10.0, budget_seconds=2.0)
+        calls = []
+
+        def attempt():
+            calls.append(1)
+            return 429, {"details": {"retryAfterSeconds": 1.0}}
+
+        status, _ = policy.execute(
+            "update", attempt, sleep=sleep, clock=lambda: clock["t"],
+        )
+        assert status == 429
+        assert clock["t"] <= 2.5  # budget, not 50 attempts' worth
+        assert len(calls) < 10
+
+    def test_pdb_429_is_never_retried(self):
+        body = {
+            "message": "disruption budget",
+            "details": {"causes": [{"reason": "DisruptionBudget"}]},
+        }
+        calls = []
+
+        def attempt():
+            calls.append(1)
+            return 429, body
+
+        status, out = RetryPolicy().execute("evict", attempt)
+        assert status == 429 and out is body
+        assert len(calls) == 1
+
+    def test_409_without_hook_is_terminal(self):
+        calls = []
+
+        def attempt():
+            calls.append(1)
+            return 409, {"message": "conflict"}
+
+        status, _ = RetryPolicy().execute("update", attempt)
+        assert status == 409 and len(calls) == 1
+
+    def test_retry_metric_labels(self):
+        before = KUBE_RETRIES.value({"verb": "update", "status": "503"})
+        responses = [(503, {}), (200, {})]
+        RetryPolicy(base_seconds=0.0001).execute(
+            "update", lambda: responses.pop(0), sleep=lambda s: None,
+        )
+        assert KUBE_RETRIES.value(
+            {"verb": "update", "status": "503"}
+        ) == before + 1
+
+
+class TestConflictReadModifyWrite:
+    def test_mutation_fn_lands_on_top_of_remote_write(self):
+        """The satellite-1 contract: with strict resourceVersion
+        enforcement, a racy writer passing a mutation fn converges to
+        read-modify-write — the remote actor's change SURVIVES and the
+        local mutation lands on top (never last-write-wins)."""
+        server = InMemoryApiServer()
+        a = RealKubeClient(server)
+        b = RealKubeClient(server)
+        a.create(mk_nodepool("gp"))
+        b.deliver()
+        theirs = b.get_node_pool("gp")
+        # A wins the race with a weight change B hasn't pumped
+        mine = a.get_node_pool("gp")
+        mine.spec.weight = 41
+        a.update(mine)
+        # B writes a DIFFERENT field as a mutation fn
+        b.update(theirs, mutate=lambda p: p.metadata.labels.update(
+            {"team": "infra"}
+        ))
+        a.deliver()
+        merged = a.get_node_pool("gp")
+        assert merged.spec.weight == 41, "remote write clobbered"
+        assert merged.metadata.labels.get("team") == "infra"
+        # and B's canonical object reflects the merged truth too
+        assert theirs.spec.weight == 41
+
+    def test_plain_stale_update_still_conflicts(self):
+        """Without a mutation fn a genuine conflict stays the
+        CALLER's to resolve — silent last-write-wins would be the
+        exact bug class satellite 1 outlaws."""
+        server = InMemoryApiServer()
+        a = RealKubeClient(server)
+        b = RealKubeClient(server)
+        a.create(mk_nodepool("gp"))
+        b.deliver()
+        theirs = b.get_node_pool("gp")
+        mine = a.get_node_pool("gp")
+        mine.spec.weight = 41
+        a.update(mine)
+        theirs.spec.weight = 42
+        with pytest.raises(ConflictError):
+            b.update(theirs)
+
+    def test_write_partial_update_self_heals(self, clean_faults):
+        """kube_write_partial: the PUT lands but its response is lost
+        (500). The retry re-sends, hits the strict-RV 409, re-GETs,
+        recognizes its own landed content, and adopts the rv — no
+        error, no duplicate effect."""
+        server = InMemoryApiServer()
+        kube = RealKubeClient(server)
+        pool = mk_nodepool("gp")
+        kube.create(pool)
+        clean_faults.setenv("KARPENTER_FAULTS",
+                            "kube_write_partial@kube_write:1")
+        faults.reset()
+        pool.spec.weight = 9
+        kube.update(pool)  # must not raise
+        clean_faults.delenv("KARPENTER_FAULTS")
+        status, cr = server.request(
+            "GET", "/apis/karpenter.sh/v1/nodepools/gp"
+        )
+        assert status == 200 and cr["spec"]["weight"] == 9
+        assert pool.metadata.resource_version == int(
+            cr["metadata"]["resourceVersion"]
+        )
+
+    def test_write_partial_create_self_heals(self, clean_faults):
+        server = InMemoryApiServer()
+        kube = RealKubeClient(server)
+        clean_faults.setenv("KARPENTER_FAULTS",
+                            "kube_write_partial@kube_write:1")
+        faults.reset()
+        kube.create(mk_nodepool("gp"))  # POST lands, response lost
+        clean_faults.delenv("KARPENTER_FAULTS")
+        status, _ = server.request(
+            "GET", "/apis/karpenter.sh/v1/nodepools/gp"
+        )
+        assert status == 200
+        assert kube.get_node_pool("gp") is not None
+
+    def test_injected_conflict_storm_on_writes_is_absorbed(
+        self, clean_faults
+    ):
+        """Spurious 409s (the state never moved) are re-sent as-is and
+        counted in karpenter_kube_retries_total."""
+        server = InMemoryApiServer()
+        kube = RealKubeClient(server)
+        pool = mk_nodepool("gp")
+        kube.create(pool)
+        before = KUBE_RETRIES.value({"verb": "update", "status": "409"})
+        clean_faults.setenv("KARPENTER_FAULTS",
+                            "kube_conflict@kube_write:1-2")
+        faults.reset()
+        pool.spec.weight = 5
+        kube.update(pool)
+        clean_faults.delenv("KARPENTER_FAULTS")
+        assert kube.get_node_pool("gp").spec.weight == 5
+        assert KUBE_RETRIES.value(
+            {"verb": "update", "status": "409"}
+        ) > before
+
+
+class _FlakyBindTransport:
+    """Passes everything through except the binding subresource, which
+    answers 503 `fail_n` times (beyond the transport retry budget the
+    operator's _bind_one must re-enqueue the plan)."""
+
+    def __init__(self, server, fail_n):
+        self.server = server
+        self.fail_n = fail_n
+
+    def request(self, method, path, body=None, params=None):
+        if path.endswith("/binding") and self.fail_n > 0:
+            self.fail_n -= 1
+            return 503, {"message": "etcd leader election"}
+        return self.server.request(method, path, body, params)
+
+    def watch_events(self, kind, since_rv):
+        return self.server.watch_events(kind, since_rv)
+
+
+class TestBindingRetry:
+    def test_retryable_bind_failure_reenqueues_under_ttl(
+        self, clean_faults
+    ):
+        """Satellite 2: a binding that keeps failing retryably past the
+        transport budget is held and re-tried next tick — the pod binds
+        once the apiserver recovers, karpenter_binding_retry_total
+        counts the deferral, and the plan is never dropped."""
+        from karpenter_tpu.cloudprovider.fake import GIB, make_instance_type
+        from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
+        from karpenter_tpu.operator.operator import Operator
+
+        clean_faults.setenv("KARPENTER_KUBE_RETRY_MAX", "2")
+        server = InMemoryApiServer()
+        # every bind 503s through ~2 ticks' worth of attempts, then heals
+        kube = RealKubeClient(_FlakyBindTransport(server, fail_n=4))
+        cloud = KwokCloudProvider(kube, types=[
+            make_instance_type("c4", cpu=4, memory=16 * GIB, price=1.0),
+        ])
+        op = Operator(kube=kube, cloud_provider=cloud)
+        user = RealKubeClient(server)
+        user.create(mk_nodepool("default"))
+        user.create(mk_pod(name="w", cpu=1.0))
+        before = BINDING_RETRY.total()
+        now = time.time()
+        for i in range(10):
+            op.step(now=now + 2.0 * i)
+        pod = kube.get_pod("default", "w")
+        assert pod is not None and pod.spec.node_name, (
+            "binding dropped instead of re-enqueued"
+        )
+        assert BINDING_RETRY.total() > before
+
+
+class TestStormDecisionIdentity:
+    """ISSUE-5 acceptance: under an injected 409/429/watch-drop storm a
+    full provisioning flow reaches the SAME scheduling decisions as the
+    fault-free run, with the retries visible in metrics."""
+
+    def _run(self):
+        from karpenter_tpu.cloudprovider.fake import GIB, make_instance_type
+        from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
+        from karpenter_tpu.operator.operator import Operator
+
+        server = InMemoryApiServer()
+        kube = RealKubeClient(server)
+        cloud = KwokCloudProvider(kube, types=[
+            make_instance_type("c4", cpu=4, memory=16 * GIB, price=1.0),
+            make_instance_type("c16", cpu=16, memory=64 * GIB, price=3.5),
+        ])
+        op = Operator(kube=kube, cloud_provider=cloud)
+        user = RealKubeClient(server)
+        user.create(mk_nodepool("default"))
+        for i in range(12):
+            user.create(mk_pod(name=f"w-{i}", cpu=0.9))
+        now = time.time()
+        for i in range(12):
+            op.step(now=now + 2.0 * i)
+        live = [p for p in kube.pods()
+                if p.metadata.deletion_timestamp is None]
+        assert all(p.spec.node_name for p in live), "stranded pods"
+        parts = sorted(
+            (
+                n.metadata.labels.get(
+                    "node.kubernetes.io/instance-type", ""),
+                tuple(sorted(
+                    p.metadata.name
+                    for p in kube.pods_on_node(n.metadata.name))),
+            )
+            for n in kube.nodes()
+        )
+        return parts
+
+    @pytest.mark.chaos
+    def test_decisions_identical_under_storm(self, clean_faults):
+        want = self._run()
+        # burst widths stay under the attempt budget (5): a spec that
+        # conflicts EVERY attempt of a write forever is unsurvivable by
+        # construction, like device_lost@solve:* without a ladder
+        clean_faults.setenv(
+            "KARPENTER_FAULTS",
+            "kube_conflict@kube_write:3-5,"
+            "kube_conflict@kube_write:9-10,"
+            "kube_throttle@kube_write:14-16=2ms,"
+            "kube_throttle@kube_list:2,"
+            "kube_watch_drop@kube_watch:5-12,"
+            "kube_stale_list@kube_list:4",
+        )
+        faults.reset()
+        retries0 = KUBE_RETRIES.total()
+        relists0 = KUBE_RELIST.total()
+        got = self._run()
+        clean_faults.delenv("KARPENTER_FAULTS")
+        assert got == want, "storm changed the scheduling decisions"
+        assert KUBE_RETRIES.total() > retries0
+        assert KUBE_RELIST.total() > relists0

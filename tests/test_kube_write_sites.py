@@ -1,0 +1,108 @@
+"""Static funnel check (ISSUE-5 satellite): every kube API call in
+`karpenter_tpu/` must go through RealKubeClient._request — the ONE
+seam where the RetryPolicy (conflict re-apply, Retry-After, budgets)
+and the fault sites live. A new controller calling
+`transport.request(...)` directly would silently bypass retries,
+metrics, AND chaos coverage; this tier-1 test makes that a failing
+build instead of a production incident.
+"""
+
+import ast
+import pathlib
+
+PKG = pathlib.Path(__file__).resolve().parent.parent / "karpenter_tpu"
+
+
+def _transport_request_calls(tree):
+    """ast.Call nodes of the shape `<anything>.transport.request(...)`."""
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if (
+            isinstance(func, ast.Attribute)
+            and func.attr == "request"
+            and isinstance(func.value, ast.Attribute)
+            and func.value.attr == "transport"
+        ):
+            out.append(node)
+    return out
+
+
+def test_no_transport_request_outside_kube_real():
+    """No module outside kube/real.py may talk to a Transport
+    directly."""
+    offenders = []
+    for path in sorted(PKG.rglob("*.py")):
+        if path.name == "real.py" and path.parent.name == "kube":
+            continue
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for call in _transport_request_calls(tree):
+            offenders.append(f"{path.relative_to(PKG.parent)}:{call.lineno}")
+    assert not offenders, (
+        "kube API calls bypassing the RealKubeClient._request funnel "
+        f"(retry + fault coverage): {offenders}"
+    )
+
+
+def test_real_client_funnels_through_request():
+    """Inside kube/real.py, `self.transport.request` may appear ONLY
+    in RealKubeClient._request (the funnel's own attempt closure). The
+    write methods (create/update/delete/evict/bind_pod/_push) and the
+    read paths (sync/_relist) must all route through it."""
+    source = (PKG / "kube" / "real.py").read_text()
+    tree = ast.parse(source, filename="kube/real.py")
+    client = next(
+        node for node in tree.body
+        if isinstance(node, ast.ClassDef) and node.name == "RealKubeClient"
+    )
+    offenders = []
+    for method in client.body:
+        if not isinstance(method, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        calls = _transport_request_calls(method)
+        if calls and method.name != "_request":
+            offenders.append(
+                f"RealKubeClient.{method.name} (lines "
+                f"{[c.lineno for c in calls]})"
+            )
+    assert not offenders, (
+        "direct transport calls bypassing the retry funnel: "
+        f"{offenders}"
+    )
+    funnel = next(
+        m for m in client.body
+        if isinstance(m, ast.FunctionDef) and m.name == "_request"
+    )
+    assert len(_transport_request_calls(funnel)) == 1
+
+
+def test_every_write_verb_is_exercised_by_the_funnel():
+    """The funnel's verb labels (karpenter_kube_retries_total{verb})
+    must cover every write surface the client exposes — a write method
+    passing no verb (or a new verb unnamed here) fails loudly."""
+    source = (PKG / "kube" / "real.py").read_text()
+    tree = ast.parse(source, filename="kube/real.py")
+    client = next(
+        node for node in tree.body
+        if isinstance(node, ast.ClassDef) and node.name == "RealKubeClient"
+    )
+    verbs = set()
+    for node in ast.walk(client):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "_request"
+            and node.args
+        ):
+            arg = node.args[0]
+            candidates = (
+                [arg.body, arg.orelse] if isinstance(arg, ast.IfExp)
+                else [arg]
+            )
+            for c in candidates:
+                if isinstance(c, ast.Constant):
+                    verbs.add(c.value)
+    assert {"create", "update", "delete", "evict", "bind",
+            "get", "list"} <= verbs, verbs

@@ -187,11 +187,13 @@ def test_batched_multi_node_consolidation_beats_sequential(n_nodes, monkeypatch)
 
     run("1")  # warm: probe-kernel shape compiles + axis memory
     run("0")  # warm: sequential path's compiles
-    # best-of-3 per side: both paths are deterministic, so min wall is
-    # the honest cost — single runs jitter with machine load
+    # best-of-5 per side, interleaved: both paths are deterministic, so
+    # min wall is the honest cost — single runs jitter with machine
+    # load, and at the small 120-node size the margin is thin enough
+    # that best-of-3 still lost to suite-load noise (round 5)
     batched, batched_wall = run("1")
     sequential, seq_wall = run("0")
-    for _ in range(2):
+    for _ in range(4):
         _, w = run("1")
         batched_wall = min(batched_wall, w)
         _, w = run("0")
@@ -349,18 +351,82 @@ def test_resilience_wrapper_overhead_under_5_percent():
     # blocks lets a load shift between the blocks (other tests' GC,
     # CI noisy neighbors) masquerade as wrapper overhead — alternating
     # iterations expose both sides to the same noise. The 2ms absolute
-    # grace absorbs scheduler-quantum jitter the min can't.
+    # grace absorbs scheduler-quantum jitter the min can't. GC off so
+    # a collection landing inside one side's solve can't masquerade as
+    # overhead (same rationale as the kube funnel guard below).
+    import gc as _gc
+
     direct = wrapped = float("inf")
-    for _ in range(10):
-        t0 = time.perf_counter()
-        solve_packing(enc, mode="ffd")
-        direct = min(direct, time.perf_counter() - t0)
-        t0 = time.perf_counter()
-        rs.solve_packing(enc, mode="ffd")
-        wrapped = min(wrapped, time.perf_counter() - t0)
+    _gc.disable()
+    try:
+        for _ in range(20):
+            t0 = time.perf_counter()
+            solve_packing(enc, mode="ffd")
+            direct = min(direct, time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            rs.solve_packing(enc, mode="ffd")
+            wrapped = min(wrapped, time.perf_counter() - t0)
+    finally:
+        _gc.enable()
     assert wrapped < direct * 1.05 + 0.002, (
         f"resilient solve {wrapped * 1000:.2f}ms vs direct "
         f"{direct * 1000:.2f}ms — wrapper overhead above 5%"
+    )
+
+
+def test_kube_write_path_overhead_under_5_percent():
+    """ISSUE-5 healthy-path guard: with no faults, no conflicts, and no
+    throttling, routing every write through the retry funnel
+    (RetryPolicy + fault-site hooks) must cost <5% over the same write
+    with the funnel bypassed. Interleaved best-of-N, same rationale as
+    the resilience-wrapper guard above."""
+    from karpenter_tpu.kube.real import InMemoryApiServer, RealKubeClient
+
+    assert not os.environ.get("KARPENTER_FAULTS")
+    server = InMemoryApiServer()
+    kube = RealKubeClient(server)
+    pool = mk_nodepool("perf")
+    kube.create(pool)
+
+    funneled = RealKubeClient._request
+
+    def bypass(self, verb, method, path, body=None, body_fn=None,
+               on_conflict=None):
+        return self.transport.request(
+            method, path, body_fn() if body_fn is not None else body
+        )
+
+    # CALL-granular interleaving with per-side MINIMA: the per-write
+    # cost is dominated by server-side admission (~200us) whose noise
+    # under a cpu-shared runner dwarfs the few-us funnel overhead under
+    # test. The funnel's cost is a CONSTANT per call, so the fastest
+    # call each side achieves under identical conditions differs by
+    # exactly that constant — minima are immune to the load spikes that
+    # made block sums flake. GC off so a collection landing in one
+    # side's call can't masquerade as overhead.
+    import gc as _gc
+
+    for _ in range(100):
+        kube.update(pool)  # warm caches (serializer, policy, snapshot)
+    wrapped = direct = float("inf")
+    _gc.disable()
+    try:
+        for _ in range(1200):
+            RealKubeClient._request = funneled
+            t0 = time.perf_counter()
+            kube.update(pool)
+            wrapped = min(wrapped, time.perf_counter() - t0)
+            RealKubeClient._request = bypass
+            t0 = time.perf_counter()
+            kube.update(pool)
+            direct = min(direct, time.perf_counter() - t0)
+    finally:
+        _gc.enable()
+        RealKubeClient._request = funneled
+    assert wrapped < direct * 1.05 + 0.00001, (
+        f"funneled write path {wrapped * 1e6:.1f}us vs direct "
+        f"{direct * 1e6:.1f}us per write — overhead above 5% "
+        "(+10us grace)"
     )
 
 

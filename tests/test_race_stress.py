@@ -13,6 +13,7 @@ once the churn stops.
 
 import pytest
 
+import random
 import threading
 import time
 
@@ -233,6 +234,7 @@ class TestRealClientWriteRace:
         def writer(wid):
             def run():
                 try:
+                    rng = random.Random(wid)
                     client = RealKubeClient(server)
                     for i in range(40):
                         for attempt in range(20):
@@ -245,8 +247,17 @@ class TestRealClientWriteRace:
                                     applied[0] += 1
                                 break
                             except ConflictError:
-                                pass  # re-read and retry; 409 path is
-                                # asserted deterministically below
+                                # re-read and retry WITH jittered
+                                # backoff — client-go's RetryOnConflict
+                                # mandates wait.Backoff for exactly
+                                # this: a zero-backoff CAS loop can
+                                # starve under contention no matter
+                                # how many attempts it budgets. The
+                                # 409 path itself is asserted
+                                # deterministically below.
+                                time.sleep(
+                                    rng.random() * 0.001 * (attempt + 1)
+                                )
                 except BaseException as err:  # noqa: BLE001
                     errors.append(err)
             return run

@@ -1,0 +1,297 @@
+"""Restart-chaos harness (ISSUE 5): kill the operator at injected
+crash points mid-provisioning / mid-binding / mid-disruption, restart
+it against the SURVIVING InMemoryApiServer (and the surviving cloud —
+launched instances do not die with the operator), and assert the
+cluster converges to the same state as an uninterrupted run:
+
+- same node set (instance-type multiset; names are process-local),
+- same bindings (per-node pod-name partition),
+- zero orphaned nodeclaims (every claim backed by a node + instance),
+- zero double launches (cloud instances == claim provider ids),
+
+with the fault schedule replaying byte-identically
+(`FaultInjector.snapshot_log`).
+
+The crash mechanism is `operator_crash@<site>:<occ>` raising
+OperatorCrashError out of `Operator.step` — the deterministic stand-in
+for SIGKILL between two API writes. The restarted operator gets a
+FRESH RealKubeClient (mirror rebuilt from LIST, exactly like informer
+start) and an empty memory: pending-binding plans, the lifecycle
+active set, and the disruption queue must all be re-derived from the
+API alone (Operator._recover).
+"""
+
+import time
+
+import pytest
+
+from karpenter_tpu.cloudprovider.fake import GIB, make_instance_type
+from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
+from karpenter_tpu.kube.real import InMemoryApiServer, RealKubeClient
+from karpenter_tpu.metrics.store import OPERATOR_RECOVERY
+from karpenter_tpu.operator.operator import Operator
+from karpenter_tpu.solver import faults
+from karpenter_tpu.testing import mk_nodepool, mk_pod
+
+
+@pytest.fixture()
+def clean_faults(monkeypatch):
+    monkeypatch.delenv("KARPENTER_FAULTS", raising=False)
+    monkeypatch.setenv("KARPENTER_KUBE_RETRY_BASE_MS", "1")
+    monkeypatch.setenv("KARPENTER_KUBE_RELIST_MIN_MS", "0")
+    faults.reset()
+    yield monkeypatch
+    faults.reset()
+
+
+def _singleton_types():
+    # one-pod-per-node catalog: a 1.5-cpu pod only fits a c2, so EVERY
+    # solve (the uninterrupted one and any post-crash partial re-solve)
+    # is forced to the same singleton partition — binding identity is
+    # assertable exactly, not just statistically
+    return [make_instance_type("c2", cpu=2, memory=8 * GIB, price=2.0)]
+
+
+def _consolidation_types():
+    return [
+        make_instance_type("c2", cpu=2, memory=8 * GIB, price=2.0),
+        make_instance_type("c8", cpu=8, memory=32 * GIB, price=5.0),
+    ]
+
+
+class Harness:
+    """One cluster run: a surviving API server + surviving cloud, and
+    an operator that may die (OperatorCrashError) and be rebooted with
+    fresh memory at any tick."""
+
+    def __init__(self, types):
+        self.server = InMemoryApiServer()
+        kube = RealKubeClient(self.server)
+        self.cloud = KwokCloudProvider(kube, types=types)
+        self.op = Operator(kube=kube, cloud_provider=self.cloud)
+        self.user = RealKubeClient(self.server)
+        self.now = time.time()
+        self.crashes = 0
+
+    def drive(self, ticks, dt=2.0):
+        for _ in range(ticks):
+            self.now += dt
+            try:
+                self.op.step(now=self.now)
+            except faults.OperatorCrashError:
+                self.crashes += 1
+                self._restart()
+
+    def _restart(self):
+        # the operator process died; the API server and the cloud did
+        # not. New client (fresh LIST-fed mirror), new operator (empty
+        # memory); the cloud's node-materialization writes ride the
+        # new client, as the kubelet rides the real apiserver.
+        kube = RealKubeClient(self.server)
+        self.cloud.kube = kube
+        self.op = Operator(kube=kube, cloud_provider=self.cloud)
+
+    # -- workload script (identical for every arm) ------------------------
+
+    def seed(self, pods, consolidate="Never"):
+        pool = mk_nodepool("default")
+        pool.spec.disruption.consolidate_after = consolidate
+        self.user.create(pool)
+        for name, cpu in pods:
+            self.user.create(mk_pod(name=name, cpu=cpu))
+
+    def delete_pods(self, names):
+        self.user.deliver()
+        for name in names:
+            pod = self.user.get_pod("default", name)
+            if pod is not None:
+                self.user.delete(pod)
+
+    def create_pods(self, pods):
+        for name, cpu in pods:
+            self.user.create(mk_pod(name=name, cpu=cpu))
+
+    # -- converged-state identity ----------------------------------------
+
+    def fingerprint(self):
+        """Name-agnostic converged state + the no-leak invariants."""
+        kube = self.op.kube
+        claims = kube.node_claims()
+        assert all(
+            c.metadata.deletion_timestamp is None for c in claims
+        ), "orphaned (wedged-deleting) nodeclaim"
+        claim_pids = sorted(
+            c.status.provider_id for c in claims if c.status.provider_id
+        )
+        assert len(claim_pids) == len(claims), "claim never launched"
+        inst_pids = sorted(
+            i.status.provider_id for i in self.cloud.list()
+        )
+        assert inst_pids == claim_pids, (
+            "leaked instance or double launch: "
+            f"cloud={inst_pids} claims={claim_pids}"
+        )
+        nodes = kube.nodes()
+        assert sorted(n.spec.provider_id for n in nodes) == claim_pids, (
+            "node set diverged from claim set"
+        )
+        live = [
+            p for p in kube.pods()
+            if p.metadata.deletion_timestamp is None
+        ]
+        assert all(p.spec.node_name for p in live), (
+            "stranded pod: "
+            f"{[p.metadata.name for p in live if not p.spec.node_name]}"
+        )
+        assert self.op.cluster.synced()
+        assert self.op.cluster.unpaired_claim_names() == [], (
+            "in-flight claim never materialized"
+        )
+        parts = sorted(
+            (
+                n.metadata.labels.get(
+                    "node.kubernetes.io/instance-type", ""
+                ),
+                tuple(sorted(
+                    p.metadata.name
+                    for p in kube.pods_on_node(n.metadata.name)
+                )),
+            )
+            for n in nodes
+        )
+        return parts
+
+
+def _provisioning_run(spec, monkeypatch):
+    """Six 1.5-cpu pods on a singleton catalog: converge to six c2
+    nodes, one pod each."""
+    if spec:
+        monkeypatch.setenv("KARPENTER_FAULTS", spec)
+    else:
+        monkeypatch.delenv("KARPENTER_FAULTS", raising=False)
+    faults.reset()
+    h = Harness(_singleton_types())
+    h.seed([(f"w-{i}", 1.5) for i in range(6)])
+    h.drive(14, dt=2.0)
+    # ride past the GC interval so a reaped double-launch (crash_launch)
+    # has been collected before the final fingerprint
+    h.now += 130
+    h.drive(8, dt=2.0)
+    inj = faults.get()
+    h.fault_log = inj.snapshot_log() if inj is not None else []
+    monkeypatch.delenv("KARPENTER_FAULTS", raising=False)
+    return h
+
+
+def _disruption_run(spec, monkeypatch):
+    """Fifteen 1.5-cpu pods -> three c8 nodes; thin to one pod per node
+    -> multi-node consolidation replaces 3 with 1; the drained pods die
+    (the real-client stack fabricates no successors) and the fleet
+    empties; recreate three pods -> one c8. Crashes anywhere along the
+    way must land on the same end state."""
+    if spec:
+        monkeypatch.setenv("KARPENTER_FAULTS", spec)
+    else:
+        monkeypatch.delenv("KARPENTER_FAULTS", raising=False)
+    faults.reset()
+    h = Harness(_consolidation_types())
+    h.seed([(f"w-{i}", 1.5) for i in range(15)], consolidate="0s")
+    h.drive(14, dt=2.0)
+    # keep the first-listed pod on each node, delete the rest
+    h.user.deliver()
+    keep: set = set()
+    doomed = []
+    for pod in sorted(h.user.pods(), key=lambda p: p.metadata.name):
+        if pod.spec.node_name and pod.spec.node_name not in keep:
+            keep.add(pod.spec.node_name)
+        else:
+            doomed.append(pod.metadata.name)
+    h.delete_pods(doomed)
+    h.drive(30, dt=15.0)
+    h.create_pods([(f"r-{i}", 1.5) for i in range(3)])
+    h.drive(12, dt=2.0)
+    h.now += 130
+    h.drive(8, dt=2.0)
+    inj = faults.get()
+    h.fault_log = inj.snapshot_log() if inj is not None else []
+    monkeypatch.delenv("KARPENTER_FAULTS", raising=False)
+    return h
+
+
+_REFERENCE: dict = {}
+
+
+def _reference(kind, monkeypatch):
+    if kind not in _REFERENCE:
+        run = {"prov": _provisioning_run, "disr": _disruption_run}[kind]
+        _REFERENCE[kind] = run("", monkeypatch).fingerprint()
+    return _REFERENCE[kind]
+
+
+PROVISIONING_CRASHES = [
+    "operator_crash@crash_tick:2",
+    "operator_crash@crash_claims:1",
+    "operator_crash@crash_provision:1",
+    "operator_crash@crash_bind:2",
+    "operator_crash@crash_launch:3",
+]
+
+DISRUPTION_CRASHES = [
+    "operator_crash@crash_disruption:1",
+    "operator_crash@crash_disruption_started:1",
+]
+
+
+@pytest.mark.restart_chaos
+@pytest.mark.parametrize("spec", PROVISIONING_CRASHES)
+def test_provisioning_crash_converges_to_uninterrupted_state(
+    spec, clean_faults
+):
+    want = _reference("prov", clean_faults)
+    assert len(want) == 6 and all(len(p[1]) == 1 for p in want)
+    h = _provisioning_run(spec, clean_faults)
+    assert h.crashes >= 1, f"{spec} never fired"
+    assert h.fingerprint() == want
+    # the restarted operator reported what it rebuilt from the API
+    assert "readopted_claims" in h.op.readyz()["recovery"]
+
+
+@pytest.mark.restart_chaos
+@pytest.mark.parametrize("spec", DISRUPTION_CRASHES)
+def test_disruption_crash_converges_to_uninterrupted_state(
+    spec, clean_faults
+):
+    want = _reference("disr", clean_faults)
+    h = _disruption_run(spec, clean_faults)
+    assert h.crashes >= 1, f"{spec} never fired"
+    assert h.fingerprint() == want
+
+
+@pytest.mark.restart_chaos
+def test_crash_launch_reaps_the_unrecorded_twin(clean_faults):
+    """The double-launch window in isolation: a crash between the
+    provider create and the claim's status write leaves a running
+    instance no claim records. The restarted operator re-launches
+    (one live instance per claim) and its recovery GC reaps the twin —
+    observable in karpenter_operator_recovery_total."""
+    reaped0 = OPERATOR_RECOVERY.value({"action": "reaped_leak"})
+    h = _provisioning_run("operator_crash@crash_launch:1", clean_faults)
+    assert h.crashes == 1
+    assert h.fingerprint() == _reference("prov", clean_faults)
+    assert OPERATOR_RECOVERY.value({"action": "reaped_leak"}) > reaped0
+
+
+@pytest.mark.restart_chaos
+def test_fault_schedule_replays_byte_identically(clean_faults):
+    """Same spec + same workload script => identical fired-fault log
+    AND identical converged state — a restart-chaos failure found in
+    CI replays exactly on a laptop."""
+    spec = "operator_crash@crash_bind:2,kube_conflict@kube_write:5-7"
+    h_a = _provisioning_run(spec, clean_faults)
+    h_b = _provisioning_run(spec, clean_faults)
+    assert h_a.fault_log, "spec never fired"
+    assert h_a.fault_log == h_b.fault_log, (
+        "fault sequences must replay identically"
+    )
+    assert h_a.crashes == h_b.crashes >= 1
+    assert h_a.fingerprint() == h_b.fingerprint()
