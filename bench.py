@@ -1052,6 +1052,190 @@ def scenario_hetero(n_pods: int = 10000, n_types: int = 200) -> dict:
     return _timed_cost_solve(pods, pools, bound_gap=True)
 
 
+def scenario_spot_mix(hours: float = 12.0, ticks_per_hour: int = 2,
+                      rate_per_hour: float = 0.05) -> dict:
+    """Spot capacity as a COST feature (ISSUE 6 / KubePACS): the same
+    workload run twice over a simulated horizon on the full controller
+    stack (Environment: provisioner, interruption controller,
+    orchestration queue, termination) —
+
+    (a) on-demand only (pool spot budget pinned to zero), calm;
+    (b) spot-preferred under a deterministic `rate_per_hour`
+        interruption regime (`spot_interruption@cloud_interrupt`,
+        seeded, replay-identical), with an 80% max-spot-fraction
+        budget and drain-after-replace interruption handling.
+
+    Reported: fleet $-hours for both arms, the measured price
+    reduction, interruption count, and availability — pod-minutes
+    unscheduled at tick boundaries, which drain-after-replace must
+    hold within the 1% target."""
+    from karpenter_tpu.apis.v1.labels import (
+        CAPACITY_TYPE_LABEL,
+        INSTANCE_TYPE_LABEL,
+        SPOT_MAX_FRACTION_ANNOTATION,
+        TOPOLOGY_ZONE_LABEL,
+    )
+    from karpenter_tpu.cloudprovider.fake import GIB, make_instance_type
+    from karpenter_tpu.solver import faults as _faults
+    from karpenter_tpu.testing import Environment, mk_nodepool, mk_pod
+
+    n_pods = int(os.environ.get("BENCH_SPOT_PODS", "60"))
+    catalog = lambda: [  # noqa: E731 - rebuilt per arm (prices mutate)
+        make_instance_type("c4", cpu=4, memory=16 * GIB, price=3.0),
+        make_instance_type("c8", cpu=8, memory=32 * GIB, price=5.5),
+    ]
+    tick_s = 3600.0 / ticks_per_hour
+    n_ticks = int(hours * ticks_per_hour)
+    # one cloud_interrupt check per live spot instance per tick, so the
+    # per-check rate that realizes rate_per_hour is rate/ticks_per_hour
+    per_check = rate_per_hour / ticks_per_hour
+
+    def fleet_price(env) -> float:
+        """Sum of the CURRENT offering price of every live node (the
+        spot curve moves hourly, so this is evaluated per tick)."""
+        types = {it.name: it for it in env.cloud.types}
+        total = 0.0
+        for node in env.kube.nodes():
+            it = types.get(node.metadata.labels.get(INSTANCE_TYPE_LABEL))
+            if it is None:
+                continue
+            ct = node.metadata.labels.get(CAPACITY_TYPE_LABEL)
+            zone = node.metadata.labels.get(TOPOLOGY_ZONE_LABEL)
+            match = [
+                o for o in it.offerings
+                if o.capacity_type == ct and o.zone == zone
+            ]
+            if match:
+                total += match[0].price
+        return total
+
+    def run_arm(spot: bool) -> dict:
+        # save the AMBIENT injector (an externally-set KARPENTER_FAULTS
+        # schedule mid-replay) — a reset on exit would zero its
+        # occurrence counters and wipe the replay log the top-level
+        # fault_schedule provenance reports
+        prev_state = _faults.snapshot_active()
+        prev_spec = os.environ.pop("KARPENTER_FAULTS", None)
+        prev_seed = os.environ.pop("KARPENTER_FAULT_SEED", None)
+        try:
+            if spot:
+                os.environ["KARPENTER_FAULTS"] = (
+                    f"spot_interruption@cloud_interrupt:*={per_check:g}"
+                )
+                os.environ["KARPENTER_FAULT_SEED"] = "6"
+            _faults.reset()
+            env = Environment(types=catalog())
+            pool = mk_nodepool("default")
+            if not spot:
+                pool.metadata.annotations[SPOT_MAX_FRACTION_ANNOTATION] = "0"
+            else:
+                pool.metadata.annotations[SPOT_MAX_FRACTION_ANNOTATION] = "0.8"
+            env.kube.create(pool)
+            pods = [mk_pod(name=f"p-{i}", cpu=3.0, memory=4 * GIB)
+                    for i in range(n_pods)]
+            t0 = time.perf_counter()
+            env.provision(*pods, now=0.0)
+            provision_wall = time.perf_counter() - t0
+            dollar_hours = 0.0
+            unscheduled_pod_minutes = 0.0
+            for i in range(1, n_ticks + 1):
+                now = i * tick_s
+                # advance the hourly spot curve on EVERY tick — the
+                # controller stack only repricies on provision, and a
+                # quiet stretch would otherwise bill fleet_price at
+                # prices stamped by the last wave
+                env.cloud.reprice(now)
+                env.reconcile_interruption(now=now)
+                dollar_hours += fleet_price(env) * tick_s / 3600.0
+                unscheduled_pod_minutes += sum(
+                    1 for p in env.kube.pods()
+                    if not p.is_terminal() and not p.spec.node_name
+                ) * tick_s / 60.0
+            wall = time.perf_counter() - t0
+            inj = _faults.get()
+            log = inj.snapshot_log() if inj is not None else []
+            nodes = env.kube.nodes()
+            arm = {
+                "fleet_dollar_hours": round(dollar_hours, 4),
+                "unscheduled_pod_minutes": round(unscheduled_pod_minutes, 1),
+                "interruptions": sum(
+                    1 for e in log if e[2] == "spot_interruption"
+                ),
+                "final_nodes": len(nodes),
+                "final_spot_nodes": sum(
+                    1 for n in nodes
+                    if n.metadata.labels.get(CAPACITY_TYPE_LABEL) == "spot"
+                ),
+                "wall_s": round(wall, 3),
+                "provision_wall_s": round(provision_wall, 3),
+            }
+            if spot:
+                arm["fault_schedule"] = _fault_schedule()
+            return arm
+        finally:
+            os.environ.pop("KARPENTER_FAULTS", None)
+            os.environ.pop("KARPENTER_FAULT_SEED", None)
+            if prev_spec is not None:
+                os.environ["KARPENTER_FAULTS"] = prev_spec
+            if prev_seed is not None:
+                os.environ["KARPENTER_FAULT_SEED"] = prev_seed
+            _faults.restore_active(prev_state)
+
+    od = run_arm(spot=False)
+    mix = run_arm(spot=True)
+    total_pod_minutes = n_pods * hours * 60.0
+    availability_target = 0.01
+    reduction = 0.0
+    if od["fleet_dollar_hours"] > 0:
+        reduction = 1.0 - mix["fleet_dollar_hours"] / od["fleet_dollar_hours"]
+    return {
+        "pods": n_pods,
+        "hours": hours,
+        "interruption_rate_per_hour": rate_per_hour,
+        "on_demand_only": od,
+        "spot_mix": mix,
+        "price_reduction_pct": round(reduction * 100.0, 2),
+        "unscheduled_pod_minutes_pct": round(
+            mix["unscheduled_pod_minutes"] / total_pod_minutes * 100.0, 3
+        ),
+        "availability_target_pct": availability_target * 100.0,
+        "availability_within_target": (
+            mix["unscheduled_pod_minutes"]
+            <= availability_target * total_pod_minutes
+        ),
+        # throughput over the PROVISIONING solve alone — wall_s spans
+        # the whole simulated half-day of reconcile ticks, and a
+        # headline computed over it would read as a ~0.5 pods/sec
+        # scheduler regression in any dashboard consuming the JSON
+        "pods_per_sec": round(
+            n_pods / max(mix["provision_wall_s"], 1e-9), 1
+        ),
+    }
+
+
+def _fault_schedule() -> Optional[dict]:
+    """Provenance of the ACTIVE fault schedule: spec + seed + a digest
+    of the replay log, so a BENCH_* run under chaos is reproducible
+    from the artifact alone (same spec + seed => byte-identical
+    schedule; the digest proves which one actually fired)."""
+    import hashlib
+
+    from karpenter_tpu.solver import faults as _faults
+
+    inj = _faults.get()
+    if inj is None:
+        return None
+    log = inj.snapshot_log()
+    blob = "\n".join(f"{s}:{q}:{k}" for s, q, k in log).encode()
+    return {
+        "spec": os.environ.get("KARPENTER_FAULTS", ""),
+        "seed": os.environ.get("KARPENTER_FAULT_SEED", "0"),
+        "fired": len(log),
+        "replay_log_sha256": hashlib.sha256(blob).hexdigest(),
+        "rejected_entries": list(inj.rejected),
+    }
+
+
 def _wait_for_tpu(max_wait_s: float, probe_timeout: float = 60.0) -> bool:
     """Poll until the TPU backend answers or the window closes. Used by
     the in-round watcher (BENCH_WAIT_TPU_S): three rounds produced zero
@@ -1151,6 +1335,7 @@ def main() -> int:
         "steady_state_churn": lambda: scenario_steady_state_churn(
             n_pods, n_types
         ),
+        "spot_mix": scenario_spot_mix,
     }
     if only:
         wanted = set(only.split(","))
@@ -1202,6 +1387,12 @@ def main() -> int:
         "vs_baseline": round(pods_per_sec / 100.0, 2),
         "detail": detail,
     }
+    # chaos provenance: a run under an externally-set KARPENTER_FAULTS
+    # records the schedule it actually replayed, so the artifact alone
+    # reproduces the run (spec + seed + fired-log digest)
+    schedule = _fault_schedule()
+    if schedule is not None:
+        out["fault_schedule"] = schedule
     if errors:
         out["error"] = "; ".join(errors)
     print(json.dumps(out))

@@ -36,6 +36,12 @@ DO_NOT_SYNC_TAINTS_LABEL = f"{GROUP}/do-not-sync-taints"
 CAPACITY_TYPE_LABEL = f"{GROUP}/capacity-type"
 RESERVATION_ID_LABEL = f"{GROUP}/reservation-id"
 
+# Per-NodePool spot availability targets (annotations so no schema
+# migration is needed; the env knobs KARPENTER_SPOT_MAX_FRACTION /
+# KARPENTER_SPOT_MIN_ON_DEMAND give the fleet-wide defaults).
+SPOT_MAX_FRACTION_ANNOTATION = f"{GROUP}/spot-max-fraction"
+SPOT_MIN_ON_DEMAND_ANNOTATION = f"{GROUP}/spot-min-on-demand"
+
 # Annotations
 DO_NOT_DISRUPT_ANNOTATION = f"{GROUP}/do-not-disrupt"
 NODEPOOL_HASH_ANNOTATION = f"{GROUP}/nodepool-hash"

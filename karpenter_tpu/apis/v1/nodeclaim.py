@@ -26,6 +26,9 @@ COND_VOLUMES_DETACHED = "VolumesDetached"
 COND_INSTANCE_TERMINATING = "InstanceTerminating"
 COND_CONSISTENT_STATE_FOUND = "ConsistentStateFound"
 COND_DISRUPTION_REASON = "DisruptionReason"
+# spot capacity holding a cloud interruption notice (set by the
+# interruption controller the tick the provider reports the notice)
+COND_INTERRUPTED = "Interrupted"
 COND_NODE_CLASS_READY = "NodeClassReady"
 
 LIFECYCLE_ROOT_CONDITIONS = [COND_LAUNCHED, COND_REGISTERED, COND_INITIALIZED]

@@ -27,6 +27,10 @@ CONSOLIDATION_WHEN_EMPTY_OR_UNDERUTILIZED = "WhenEmptyOrUnderutilized"
 REASON_UNDERUTILIZED = "Underutilized"
 REASON_EMPTY = "Empty"
 REASON_DRIFTED = "Drifted"
+# spot interruption notice (KubePACS-style forced reclaim): the cloud
+# takes the capacity whether or not the controller acts, so commands
+# with this reason bypass graceful pod-block rules and budgets
+REASON_INTERRUPTED = "Interrupted"
 
 COND_VALIDATION_SUCCEEDED = "ValidationSucceeded"
 COND_NODE_CLASS_READY = "NodeClassReady"

@@ -518,6 +518,19 @@ class OverlayCloudProvider(CloudProvider):
     def repair_policies(self):
         return self.inner.repair_policies()
 
+    # spot-tier hooks (optional on the SPI)
+    def reprice(self, now):
+        fn = getattr(self.inner, "reprice", None)
+        return 0 if fn is None else fn(now)
+
+    def poll_interruptions(self, now=None):
+        fn = getattr(self.inner, "poll_interruptions", None)
+        return [] if fn is None else fn(now)
+
+    @property
+    def interrupted(self):
+        return getattr(self.inner, "interrupted", set())
+
     def name(self):
         return self.inner.name()
 

@@ -10,6 +10,7 @@ used by the benchmark harness.
 from __future__ import annotations
 
 import itertools
+import logging
 import threading
 import zlib
 from typing import Callable, Optional
@@ -59,6 +60,49 @@ DEFAULT_ZONES = ("test-zone-1", "test-zone-2", "test-zone-3")
 def price_from_resources(resources: ResourceList) -> float:
     """Deterministic synthetic price (fake PriceFromResources)."""
     return resources.get(CPU, 0.0) * 0.025 + resources.get(MEMORY, 0.0) / GIB * 0.001
+
+
+# base spot discount vs on-demand (kwok's spot pricing ratio)
+SPOT_DISCOUNT = 0.4
+
+
+def spot_price_at(on_demand_price: float, zone: str, now: float) -> float:
+    """Deterministic time-varying spot price: the on-demand price at
+    the base SPOT_DISCOUNT, wobbled per (zone, hour) by up to ±12.5% —
+    a seeded stand-in for real spot market drift. Pure function of its
+    inputs, so two runs over the same simulated hours see identical
+    curves (replay-identical bench arms)."""
+    hour = int(now // 3600.0)
+    wobble = (
+        (zlib.crc32(f"{zone}:{hour}".encode()) % 1001) / 1000.0 - 0.5
+    ) * 0.25
+    return round(on_demand_price * SPOT_DISCOUNT * (1.0 + wobble), 6)
+
+
+def reprice_spot(types: list[InstanceType], now: float) -> int:
+    """Re-point every spot offering's price at the deterministic
+    curve for `now` (each zone's on-demand sibling is the reference
+    price). In-place: the encoder cache's catalog fingerprint covers
+    offering prices, so a reprice busts it exactly like an overlay
+    price change would. Returns the number of offerings updated."""
+    updated = 0
+    for it in types:
+        od_by_zone = {
+            o.zone: o.price
+            for o in it.offerings
+            if o.capacity_type == CAPACITY_TYPE_ON_DEMAND
+        }
+        for o in it.offerings:
+            if o.capacity_type != CAPACITY_TYPE_SPOT:
+                continue
+            base = od_by_zone.get(o.zone)
+            if base is None:
+                continue
+            price = spot_price_at(base, o.zone, now)
+            if price != o.price:
+                o.price = price
+                updated += 1
+    return updated
 
 
 def make_instance_type(
@@ -249,6 +293,8 @@ class FakeCloudProvider(CloudProvider):
         self.drifted: str = ""
         self._repair_policies: list[RepairPolicy] = []
         self._counter = itertools.count(1)
+        # provider ids of spot instances holding an interruption notice
+        self.interrupted: set[str] = set()
 
     # -- SPI ------------------------------------------------------------------
 
@@ -314,6 +360,53 @@ class FakeCloudProvider(CloudProvider):
             if node_claim.status.provider_id not in self.created:
                 raise NodeClaimNotFoundError(node_claim.status.provider_id)
             del self.created[node_claim.status.provider_id]
+            self.interrupted.discard(node_claim.status.provider_id)
+
+    def reprice(self, now: float) -> int:
+        """Advance spot offering prices to the deterministic curve for
+        `now` (see spot_price_at). Returns offerings changed — 0 within
+        one price hour, so the encoder cache's catalog fingerprint only
+        busts when the curve actually moved."""
+        with self._lock:
+            return reprice_spot(self.types, now)
+
+    def poll_interruptions(self, now: Optional[float] = None) -> list[str]:
+        """One `cloud_interrupt` fault check per live spot instance, in
+        sorted provider-id order (occurrence numbers map to instances
+        deterministically). A firing `spot_interruption` rule is
+        CONSUMED here: the instance gets an interruption notice —
+        exactly a cloud's rebalance/termination warning — surfaced
+        through `self.interrupted` for the interruption controller's
+        normal poll. Returns the newly noticed provider ids."""
+        from karpenter_tpu.metrics.store import SPOT_INTERRUPTIONS
+        from karpenter_tpu.solver import faults as _faults
+
+        newly: list[str] = []
+        with self._lock:
+            for pid in sorted(self.created):
+                if pid in self.interrupted:
+                    continue
+                claim = self.created[pid]
+                if (
+                    claim.metadata.labels.get(CAPACITY_TYPE_LABEL)
+                    != CAPACITY_TYPE_SPOT
+                ):
+                    continue
+                try:
+                    _faults.fire("cloud_interrupt")
+                except _faults.SpotInterruptionError:
+                    self.interrupted.add(pid)
+                    newly.append(pid)
+                    SPOT_INTERRUPTIONS.inc({"provider": "fake"})
+                except _faults.FaultError as err:
+                    # a mis-kinded spec (e.g. device_lost@cloud_interrupt)
+                    # is consumed, not propagated: a chaos knob must
+                    # never take the operator tick down
+                    logging.getLogger(__name__).warning(
+                        "ignoring non-interruption fault at "
+                        "cloud_interrupt: %r", err,
+                    )
+        return newly
 
     def get(self, provider_id: str) -> NodeClaim:
         with self._lock:

@@ -12,6 +12,7 @@ instance type, and Ready=True.
 from __future__ import annotations
 
 import itertools
+import logging
 import threading
 import time
 from dataclasses import dataclass
@@ -85,6 +86,10 @@ class KwokCloudProvider(CloudProvider):
         # chaos hook (parity with the fake provider's error injection,
         # fake/cloudprovider.go): the next create() raises this once
         self.next_create_error: Optional[Exception] = None
+        # provider ids of spot instances holding an interruption notice
+        # (the cloud's rebalance/termination warning; consumed by the
+        # interruption controller's poll)
+        self.interrupted: set[str] = set()
 
     def restore(self) -> int:
         """Rehydrate instance state from the store after a restart —
@@ -236,6 +241,54 @@ class KwokCloudProvider(CloudProvider):
                 raise NodeClaimNotFoundError(pid)
             inst.terminated = True
             del self._instances[pid]
+            self.interrupted.discard(pid)
+
+    def reprice(self, now: float) -> int:
+        """Advance spot offering prices to the deterministic hourly
+        curve (fake.spot_price_at). 0 changes within one price hour, so
+        the encoder cache's catalog fingerprint busts only when the
+        curve actually moved."""
+        from karpenter_tpu.cloudprovider.fake import reprice_spot
+
+        with self._lock:
+            return reprice_spot(self.types, now)
+
+    def poll_interruptions(self, now: Optional[float] = None) -> list[str]:
+        """One `cloud_interrupt` fault check per live spot instance, in
+        sorted provider-id order (occurrence numbers map to instances
+        deterministically). A firing `spot_interruption` rule is
+        CONSUMED here — the instance gets an interruption notice
+        surfaced through `self.interrupted`, exactly like a cloud's
+        rebalance/termination warning. Returns newly noticed ids."""
+        from karpenter_tpu.apis.v1.labels import CAPACITY_TYPE_SPOT
+        from karpenter_tpu.metrics.store import SPOT_INTERRUPTIONS
+        from karpenter_tpu.solver import faults as _faults
+
+        newly: list[str] = []
+        with self._lock:
+            for pid in sorted(self._instances):
+                if pid in self.interrupted:
+                    continue
+                inst = self._instances[pid]
+                if inst.terminated:
+                    continue
+                if inst.labels.get(CAPACITY_TYPE_LABEL) != CAPACITY_TYPE_SPOT:
+                    continue
+                try:
+                    _faults.fire("cloud_interrupt")
+                except _faults.SpotInterruptionError:
+                    self.interrupted.add(pid)
+                    newly.append(pid)
+                    SPOT_INTERRUPTIONS.inc({"provider": "kwok"})
+                except _faults.FaultError as err:
+                    # a mis-kinded spec (e.g. device_lost@cloud_interrupt)
+                    # is consumed, not propagated: a chaos knob must
+                    # never take the operator tick down
+                    logging.getLogger(__name__).warning(
+                        "ignoring non-interruption fault at "
+                        "cloud_interrupt: %r", err,
+                    )
+        return newly
 
     def get(self, provider_id: str) -> NodeClaim:
         with self._lock:

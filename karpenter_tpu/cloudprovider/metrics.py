@@ -58,6 +58,20 @@ class MetricsCloudProvider(CloudProvider):
     def repair_policies(self):
         return self.inner.repair_policies()
 
+    # spot-tier hooks (optional on the SPI): forwarded so controllers
+    # handed the decorated provider still see the notice/price surface
+    def reprice(self, now):
+        fn = getattr(self.inner, "reprice", None)
+        return 0 if fn is None else fn(now)
+
+    def poll_interruptions(self, now=None):
+        fn = getattr(self.inner, "poll_interruptions", None)
+        return [] if fn is None else self._call("PollInterruptions", fn, now)
+
+    @property
+    def interrupted(self):
+        return getattr(self.inner, "interrupted", set())
+
     def name(self):
         return self.inner.name()
 

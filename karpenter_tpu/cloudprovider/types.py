@@ -11,6 +11,7 @@ truncation), and the typed error taxonomy (types.go:477-586).
 from __future__ import annotations
 
 import math
+import os
 from dataclasses import dataclass, field
 from functools import cached_property
 from typing import Iterable, Optional, Sequence, TYPE_CHECKING
@@ -18,6 +19,7 @@ from typing import Iterable, Optional, Sequence, TYPE_CHECKING
 from karpenter_tpu.apis.v1.labels import (
     CAPACITY_TYPE_LABEL,
     CAPACITY_TYPE_RESERVED,
+    CAPACITY_TYPE_SPOT,
     RESERVATION_ID_LABEL,
     TOPOLOGY_ZONE_LABEL,
 )
@@ -61,6 +63,52 @@ class Offering:
 
     def is_reserved(self) -> bool:
         return self.capacity_type == CAPACITY_TYPE_RESERVED
+
+    def is_spot(self) -> bool:
+        return self.capacity_type == CAPACITY_TYPE_SPOT
+
+
+# -- interruption-adjusted pricing -------------------------------------------
+#
+# Spot capacity trades at a discount because it can be reclaimed; a
+# decision layer that compares raw prices keeps churning workloads onto
+# capacity about to be interrupted. KARPENTER_SPOT_PENALTY expresses
+# the expected interruption cost as a price multiplier: the solver's
+# encoded price matrices and consolidation's cheaper-than filter price
+# spot offerings at price x (1 + penalty), while the raw price stays
+# what the fleet actually pays (bench/validation economics).
+
+SPOT_PENALTY_ENV = "KARPENTER_SPOT_PENALTY"
+
+# parse memo keyed on the raw env value: effective_price sits in the
+# encode hot loop (once per spot launch config), and re-floating the
+# same string thousands of times per solve is pure waste
+_penalty_memo: tuple[str, float] = ("", 0.0)
+
+
+def interruption_penalty() -> float:
+    """The configured spot interruption penalty (>= 0; 0 = raw
+    prices). Read per call so chaos suites and the bench can flip it
+    without rebuilding catalogs; the encoder cache folds the value
+    into its catalog fingerprint."""
+    global _penalty_memo
+    raw = os.environ.get(SPOT_PENALTY_ENV, "")
+    if raw == _penalty_memo[0]:
+        return _penalty_memo[1]
+    try:
+        value = max(0.0, float(raw))
+    except ValueError:
+        value = 0.0
+    _penalty_memo = (raw, value)
+    return value
+
+
+def effective_price(offering: "Offering") -> float:
+    """The decision-layer price of an offering: raw for on-demand and
+    reserved capacity, interruption-penalized for spot."""
+    if offering.is_spot():
+        return offering.price * (1.0 + interruption_penalty())
+    return offering.price
 
 
 class Offerings(list):

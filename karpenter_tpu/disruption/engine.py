@@ -47,7 +47,7 @@ from karpenter_tpu.apis.v1.nodepool import (
     REASON_UNDERUTILIZED,
     NodePool,
 )
-from karpenter_tpu.cloudprovider.types import CloudProvider
+from karpenter_tpu.cloudprovider.types import CloudProvider, effective_price
 from karpenter_tpu.kube.client import KubeClient
 from karpenter_tpu.metrics.store import (
     DISRUPTION_EVALUATION_DURATION,
@@ -259,6 +259,13 @@ class DisruptionEngine:
             return None
         if claim.metadata.name in protected:
             return None  # an in-flight command's replacement
+        from karpenter_tpu.apis.v1.nodeclaim import COND_INTERRUPTED
+
+        if claim.status_conditions.is_true(COND_INTERRUPTED):
+            # holding a cloud interruption notice: the interruption
+            # controller owns this node's replacement — a concurrent
+            # consolidation command would race the drain
+            return None
         pool = self.kube.get_node_pool(node.nodepool_name())
         if pool is None or pool.is_static():
             return None
@@ -545,14 +552,22 @@ class DisruptionEngine:
                 c.reschedulable_pods for c in candidates
             ) else REASON_UNDERUTILIZED, candidates=candidates, results=results)
         plan = results.new_node_plans[0]
-        # replacement must be strictly cheaper: filter offerings by price
-        cheaper = [o for o in plan.offerings if o.price < current_price]
+        # replacement must be strictly cheaper: filter offerings by
+        # price — spot offerings judged at their interruption-penalized
+        # effective price (cloudprovider.types.effective_price), so
+        # consolidation stops churning workloads onto capacity the
+        # interruption regime is about to reclaim
+        cheaper = [o for o in plan.offerings if effective_price(o) < current_price]
         if not cheaper:
             return None
         all_spot = all(c.capacity_type == CAPACITY_TYPE_SPOT for c in candidates)
-        spot_replacement = any(
-            o.capacity_type == CAPACITY_TYPE_SPOT for o in cheaper
-        )
+        # the launch resolves to the cheapest surviving offering (raw
+        # price — what the provider actually picks), so THAT offering's
+        # capacity type is the replacement's: a ~free reserved offering
+        # beating the spot candidates must route through the normal
+        # path, not the spot-to-spot gate
+        replacement_ct = min(cheaper, key=lambda o: o.price).capacity_type
+        spot_replacement = replacement_ct == CAPACITY_TYPE_SPOT
         if all_spot and spot_replacement:
             # spot-to-spot (consolidation.go:233-311): gated; replacement
             # forced to spot; single-node additionally demands >=15
@@ -580,13 +595,16 @@ class DisruptionEngine:
                 if any(o in it.offerings for it in plan.instance_types)
             ]
         else:
-            # OD -> [OD, spot]: filtering assumed the spot variant
-            # launches, so pin the replacement to spot when both remain
-            # (consolidation.go:215-223)
+            # the cheaper-than filter assumed the cheapest variant
+            # launches, so when several capacity types remain and one
+            # of them is spot, pin the replacement to the capacity type
+            # the launch resolves to (consolidation.go:215-223 pins
+            # OD -> [OD, spot] to spot; a cheaper reserved offering
+            # pins to reserved the same way)
             captypes = {o.capacity_type for o in cheaper}
             if CAPACITY_TYPE_SPOT in captypes and len(captypes) > 1:
                 cheaper = [
-                    o for o in cheaper if o.capacity_type == CAPACITY_TYPE_SPOT
+                    o for o in cheaper if o.capacity_type == replacement_ct
                 ]
             plan.offerings = cheaper
             names = set()
@@ -669,21 +687,31 @@ class DisruptionEngine:
         for plan in results.new_node_plans:
             captypes = {o.capacity_type for o in plan.offerings}
             if CAPACITY_TYPE_SPOT in captypes:
+                # the launch resolves to the cheapest surviving offering
+                # (raw price — what the provider actually picks), so
+                # THAT capacity type is the replacement's: a ~free
+                # reserved offering beating the spot candidates routes
+                # through the normal path, exactly as in the
+                # single-node path above
+                replacement_ct = min(
+                    plan.offerings, key=lambda o: o.price
+                ).capacity_type
                 # spot-to-spot churn is gated (consolidation.go:233-311);
                 # the >=2-candidate set is exempt from the 15-type floor
                 # exactly as the reference's multi-node path is
                 if (
                     all_spot
+                    and replacement_ct == CAPACITY_TYPE_SPOT
                     and not self.options.feature_gates.spot_to_spot_consolidation
                 ):
                     return None
                 if len(captypes) > 1:
-                    # the price estimate assumes the cheapest (spot)
-                    # offering launches, so pin the plan to spot
+                    # the price estimate assumes the cheapest offering
+                    # launches, so pin the plan to its capacity type
                     # (consolidation.go:215-223)
                     plan.offerings = [
                         o for o in plan.offerings
-                        if o.capacity_type == CAPACITY_TYPE_SPOT
+                        if o.capacity_type == replacement_ct
                     ]
                     names = {
                         it.name for it in plan.instance_types
@@ -695,7 +723,13 @@ class DisruptionEngine:
                     if not plan.instance_types:
                         return None
             plan.price = min(o.price for o in plan.offerings)
-        new_price = sum(p.price for p in results.new_node_plans)
+        # decide on interruption-penalized prices (spot offerings carry
+        # their expected reclaim cost) while plan.price stays the raw
+        # launch price
+        new_price = sum(
+            min(effective_price(o) for o in p.offerings)
+            for p in results.new_node_plans
+        )
         if new_price >= current_price:
             return None
         # Price-prune each plan's fallback offerings the way
@@ -709,8 +743,12 @@ class DisruptionEngine:
         if plans:
             share = (current_price - new_price) / len(plans)
             for plan in plans:
-                cap = plan.price + share
-                plan.offerings = [o for o in plan.offerings if o.price < cap]
+                # cap in the same effective-price domain the decision
+                # used, so spot fallbacks keep their reclaim penalty
+                cap = min(effective_price(o) for o in plan.offerings) + share
+                plan.offerings = [
+                    o for o in plan.offerings if effective_price(o) < cap
+                ]
                 names = {
                     it.name for it in plan.instance_types
                     if any(o in it.offerings for o in plan.offerings)

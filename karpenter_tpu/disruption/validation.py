@@ -51,7 +51,11 @@ from karpenter_tpu.apis.v1.labels import (
     INSTANCE_TYPE_LABEL,
     TOPOLOGY_ZONE_LABEL,
 )
-from karpenter_tpu.apis.v1.nodepool import REASON_DRIFTED, REASON_UNDERUTILIZED
+from karpenter_tpu.apis.v1.nodepool import (
+    REASON_DRIFTED,
+    REASON_INTERRUPTED,
+    REASON_UNDERUTILIZED,
+)
 from karpenter_tpu.utils.pdb import PdbLimits
 
 if TYPE_CHECKING:  # pragma: no cover
@@ -81,6 +85,23 @@ class Validator:
                                now: Optional[float] = None) -> None:
         now = time.time() if now is None else now
         kube = self.engine.kube
+        if command.reason == REASON_INTERRUPTED:
+            # forced reclaim: the cloud takes the capacity whether the
+            # drain happens or not, so graceful pod-block rules
+            # (do-not-disrupt, PDBs, nominations) and disruption
+            # budgets never veto — a planned drain strictly dominates
+            # the forced one. Only existence is checked: a vanished
+            # claim means there is nothing left to drain.
+            for candidate in command.candidates:
+                claim = candidate.state_node.node_claim
+                if claim is None or kube.get_node_claim(
+                    claim.metadata.name
+                ) is None:
+                    raise ValidationError(
+                        f"interrupted candidate "
+                        f"{candidate.state_node.name} claim vanished"
+                    )
+            return
         pdb = PdbLimits(kube)
         # Execution-time revalidation applies the GRACEFUL pod-block
         # rules, and the reference runs it for CONSOLIDATION commands

@@ -246,6 +246,24 @@ SOLVER_HEDGE = REGISTRY.counter(
 SOLVER_FAULTS_INJECTED = REGISTRY.counter(
     "karpenter_solver_faults_injected_total",
     "Faults fired by the deterministic injector, by site and kind")
+FAULTS_REJECTED = REGISTRY.counter(
+    "karpenter_faults_rejected_total",
+    "Malformed KARPENTER_FAULTS entries dropped at parse — nonzero "
+    "means a chaos knob is typo'd and injecting nothing")
+# spot capacity tier (cloudprovider spot offerings, disruption/
+# interruption.py, scheduler spot budget)
+SPOT_INTERRUPTIONS = REGISTRY.counter(
+    "karpenter_spot_interruptions_total",
+    "Spot instances that received an interruption notice, by provider")
+INTERRUPTION_COMMANDS = REGISTRY.counter(
+    "karpenter_interruption_commands_total",
+    "Drain-after-replace commands started for interrupted nodes, by "
+    "nodepool")
+SPOT_BUDGET_PINNED = REGISTRY.counter(
+    "karpenter_spot_budget_pinned_total",
+    "Planned nodes pinned off spot (onto their cheapest non-spot "
+    "offering) by the per-pool spot budget (max-spot-fraction cap or "
+    "min-on-demand floor), by nodepool and cause")
 # control-plane fault tolerance (kube/retry.py, operator recovery):
 # the kube-API analogue of the solver breaker metrics above
 KUBE_RETRIES = REGISTRY.counter(

@@ -128,6 +128,16 @@ class Operator:
             self.kube, self.cluster, provider, self.provisioner,
             options=self.options, recorder=self.recorder,
         )
+        from karpenter_tpu.disruption.interruption import (
+            InterruptionController,
+        )
+
+        # spot interruption notices: poll the provider (through the
+        # decorators — they forward the hook), replace before draining
+        self.interruption = InterruptionController(
+            self.kube, self.cluster, provider, self.disruption,
+            recorder=self.recorder,
+        )
         self.gc = GarbageCollectionController(self.kube, provider)
         self.node_health = NodeHealthController(self.kube, provider, self.options)
         self.consistency = ConsistencyController(self.kube, self.recorder)
@@ -298,6 +308,19 @@ class Operator:
             self.pod_events.reconcile_dirty(now=now)
             self.conditions.reconcile_dirty(now=now)
             self.expiration.reconcile_dirty(now=now)
+
+        # interruption notices run EVERY tick (a notice is a countdown,
+        # not a policy choice — waiting a disruption poll period risks
+        # the forced reclaim beating the replacement); each started
+        # command's placements ride the binding queue like a disruption
+        # command's, so displaced pods land on the pre-provisioned
+        # claims instead of a fresh solve
+        with self.profiler.span("interruption"):
+            for command in self.interruption.reconcile(now=now):
+                if command.results is not None:
+                    self._enqueue_bindings(
+                        command.results, now, COMMAND_BIND_TTL_SECONDS
+                    )
 
         if now - self._last_disruption >= self.options.disruption_poll_seconds:
             self._last_disruption = now
@@ -542,6 +565,10 @@ class Operator:
             # crash-recovery status: what the first tick rebuilt from
             # the API ({} until the first tick has run)
             "recovery": dict(self._recovery),
+            # malformed KARPENTER_FAULTS entries dropped at parse time:
+            # a typo'd chaos knob must be visible here (and in
+            # karpenter_faults_rejected_total), never silent
+            "rejected_fault_specs": _faults.rejected_specs(),
         }
 
     def serve_observability(self, port: Optional[int] = None):

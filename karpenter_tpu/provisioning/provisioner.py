@@ -447,6 +447,13 @@ class Provisioner:
     def reconcile(self, now: Optional[float] = None) -> SchedulerResults:
         if not self.cluster.synced():
             return SchedulerResults(new_node_plans=[], existing_assignments={})
+        # advance the provider's time-varying spot price curve before
+        # the catalog is read: launch decisions see current spot market
+        # prices, and a moved curve busts the encoder cache through the
+        # catalog fingerprint exactly like an overlay price change
+        reprice = getattr(self.cloud_provider, "reprice", None)
+        if reprice is not None and now is not None:
+            reprice(now)
         results = self.schedule()
         # crash window: the solver decided but nothing is written yet —
         # a restart must re-solve to the same decision from the API

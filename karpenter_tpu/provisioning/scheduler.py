@@ -21,14 +21,18 @@ from __future__ import annotations
 
 import logging
 import math
+import os
 from dataclasses import dataclass, field
 from typing import Optional, Sequence
 
 from karpenter_tpu.apis.v1.labels import (
     CAPACITY_TYPE_LABEL,
+    CAPACITY_TYPE_SPOT,
     HOSTNAME_LABEL,
     NODEPOOL_LABEL,
     RESERVATION_ID_LABEL,
+    SPOT_MAX_FRACTION_ANNOTATION,
+    SPOT_MIN_ON_DEMAND_ANNOTATION,
     TOPOLOGY_ZONE_LABEL,
     WELL_KNOWN_LABELS,
 )
@@ -120,21 +124,77 @@ def _pool_requirements(pool: NodePool) -> Requirements:
     return pool_template_requirements(pool, with_labels=False)
 
 
-def _strip_reserved(it: InstanceType) -> InstanceType:
-    """Instance type without its reserved-capacity offerings."""
-    kept = [o for o in it.offerings if not o.is_reserved()]
+def _strip_offerings(it: InstanceType, drop) -> InstanceType:
+    """Instance type without the offerings `drop` matches (unchanged
+    instance returned when nothing matched)."""
+    kept = [o for o in it.offerings if not drop(o)]
     if len(kept) == len(it.offerings):
         return it
     from karpenter_tpu.cloudprovider.types import Offerings
 
-    out = InstanceType(
+    return InstanceType(
         name=it.name,
         requirements=it.requirements,
         offerings=Offerings(kept),
         capacity=it.capacity,
         overhead=it.overhead,
     )
-    return out
+
+
+def _strip_reserved(it: InstanceType) -> InstanceType:
+    """Instance type without its reserved-capacity offerings."""
+    return _strip_offerings(it, lambda o: o.is_reserved())
+
+
+def _strip_spot(it: InstanceType) -> InstanceType:
+    """Instance type without its spot offerings (a pool whose spot
+    budget is zero never encodes a spot column at all)."""
+    return _strip_offerings(it, lambda o: o.is_spot())
+
+
+# -- spot availability targets ------------------------------------------------
+#
+# Spot capacity is interruptible; a pool that lets EVERY node resolve
+# to spot trades its whole availability on the interruption regime.
+# Two per-pool knobs bound the exposure (KubePACS availability targets,
+# PAPERS.md): a max fraction of the pool's nodes that may be spot, and
+# an absolute floor of on-demand nodes. Fleet-wide env defaults; pool
+# annotations override.
+
+SPOT_MAX_FRACTION_ENV = "KARPENTER_SPOT_MAX_FRACTION"
+SPOT_MIN_ON_DEMAND_ENV = "KARPENTER_SPOT_MIN_ON_DEMAND"
+
+
+def pool_spot_budget(pool: NodePool) -> tuple[float, int]:
+    """(max spot fraction in [0, 1], min non-spot node floor >= 0)
+    for one pool — annotation over env default over (1.0, 0). The
+    floor counts every non-interruptible node (on-demand AND
+    reserved): it bounds exposure to the interruption regime, not the
+    billing model."""
+
+    def _knob(ann_key, env_key, default, cast, lo):
+        # a malformed annotation falls back to the FLEET default (the
+        # env knob), not straight to unbounded — a typo'd per-pool
+        # override must not widen the pool's exposure past what the
+        # operator configured fleet-wide
+        for source, raw in (
+            (ann_key, pool.metadata.annotations.get(ann_key)),
+            (env_key, os.environ.get(env_key, "")),
+        ):
+            if not raw:
+                continue
+            try:
+                return max(lo, cast(raw))
+            except (TypeError, ValueError):
+                log.warning("bad spot budget knob %s=%r; ignoring",
+                            source, raw)
+        return default
+
+    frac = _knob(SPOT_MAX_FRACTION_ANNOTATION, SPOT_MAX_FRACTION_ENV,
+                 1.0, float, 0.0)
+    floor = _knob(SPOT_MIN_ON_DEMAND_ANNOTATION, SPOT_MIN_ON_DEMAND_ENV,
+                  0, int, 0)
+    return (min(frac, 1.0), floor)
 
 
 class Scheduler:
@@ -181,6 +241,19 @@ class Scheduler:
                 (pool, [_strip_reserved(it) for it in types])
                 for pool, types in pools_with_types
             ]
+        # a zero spot budget is enforced INSIDE the encoded offering
+        # matrices: the pool's spot offerings never become config
+        # columns, so neither pack_split nor the per-pod path can pick
+        # one (fractional budgets pin plans post-solve instead — the
+        # node count a fraction applies to is unknown until decode)
+        pools_with_types = [
+            (
+                pool,
+                [_strip_spot(it) for it in types]
+                if pool_spot_budget(pool)[0] <= 0.0 else types,
+            )
+            for pool, types in pools_with_types
+        ]
         # weight order (provisioner.go:241-262)
         self.pools_with_types = sorted(
             pools_with_types, key=lambda pt: (-pt[0].spec.weight, pt[0].metadata.name)
@@ -830,7 +903,100 @@ class Scheduler:
             if not self._enforce_min_values(plan, results):
                 continue
             results.new_node_plans.append(plan)
+        self._enforce_spot_budget(results.new_node_plans)
         return results
+
+    def _enforce_spot_budget(self, plans: list[NodePlan]) -> None:
+        """Per-pool spot availability targets over the WHOLE round's
+        plans plus the live fleet: with a max-spot-fraction cap or a
+        min-on-demand floor configured, plans that would resolve to a
+        spot launch (their cheapest surviving offering is spot) are
+        pinned off spot — spot offerings dropped, so the claim's
+        capacity-type requirement and the provider's launch resolve to
+        the cheapest surviving non-spot offering (on-demand, or
+        reserved where one applies) — until the targets hold. Plans whose pods REQUIRE spot (no
+        on-demand offering survived the solve) can never be pinned;
+        they consume the budget first and any residual violation is
+        logged. Later-opened plans pin first (deterministic, and the
+        earlier plans carry the round's first-placed pods)."""
+        from karpenter_tpu.metrics.store import SPOT_BUDGET_PINNED
+
+        by_pool: dict[str, list[NodePlan]] = {}
+        for plan in plans:
+            by_pool.setdefault(plan.pool.metadata.name, []).append(plan)
+        for pool_name, pool_plans in by_pool.items():
+            frac, od_floor = pool_spot_budget(pool_plans[0].pool)
+            if frac >= 1.0 and od_floor <= 0:
+                continue
+            existing_spot = existing_other = 0
+            for node in self.state_nodes:
+                if node.nodepool_name() != pool_name or node.deleting():
+                    continue
+                ct = node.labels().get(CAPACITY_TYPE_LABEL, "")
+                if ct == CAPACITY_TYPE_SPOT:
+                    existing_spot += 1
+                elif ct:
+                    existing_other += 1
+
+            def _resolves_spot(plan: NodePlan) -> bool:
+                if not plan.offerings:
+                    return False
+                cheapest = min(plan.offerings, key=lambda o: o.price)
+                return cheapest.capacity_type == CAPACITY_TYPE_SPOT
+
+            spot_plans = [p for p in pool_plans if _resolves_spot(p)]
+            total = existing_spot + existing_other + len(pool_plans)
+            n_spot = existing_spot + len(spot_plans)
+            n_od = total - n_spot
+            need, cause = 0, ""
+            # epsilon before truncating: 0.7 * 10 is 6.999999999999999
+            # in binary floats, and a bare int() would pin one plan
+            # that is legitimately within budget
+            spot_cap = int(frac * total + 1e-9)
+            if frac < 1.0 and n_spot > spot_cap:
+                need, cause = n_spot - spot_cap, "max-spot-fraction"
+            if od_floor > 0 and n_od < min(od_floor, total):
+                if min(od_floor, total) - n_od > need:
+                    need, cause = (
+                        min(od_floor, total) - n_od, "min-on-demand-floor"
+                    )
+            if need <= 0:
+                continue
+            for plan in reversed(spot_plans):
+                if need <= 0:
+                    break
+                od = [o for o in plan.offerings if not o.is_spot()]
+                if not od:
+                    continue  # pods demand spot; budget can't touch it
+                plan.offerings = od
+                kept_types = [
+                    it for it in plan.instance_types
+                    if any(o in it.offerings for o in od)
+                ]
+                if kept_types:
+                    plan.instance_types = kept_types
+                plan.price = min(o.price for o in plan.offerings)
+                need -= 1
+                SPOT_BUDGET_PINNED.inc(
+                    {"nodepool": pool_name, "cause": cause}
+                )
+            if need > 0:
+                if spot_plans:
+                    log.warning(
+                        "spot budget for pool %s unsatisfiable: %d "
+                        "planned spot nodes have no on-demand offering "
+                        "(pods pin capacity-type=spot)", pool_name, need,
+                    )
+                else:
+                    # nothing in this round to pin: the EXISTING fleet
+                    # already exceeds the budget (e.g. the knob was
+                    # tightened); attrition/consolidation retires the
+                    # excess, provisioning cannot
+                    log.warning(
+                        "spot budget for pool %s: existing fleet is %d "
+                        "node(s) over budget; new plans already comply",
+                        pool_name, need,
+                    )
 
     def _enforce_min_values(self, plan: NodePlan, results: SchedulerResults) -> bool:
         """minValues flexibility floor per planned node

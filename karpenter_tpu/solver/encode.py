@@ -36,7 +36,11 @@ from karpenter_tpu.apis.v1.labels import (
     WELL_KNOWN_LABELS,
 )
 from karpenter_tpu.apis.v1.nodepool import NodePool
-from karpenter_tpu.cloudprovider.types import InstanceType, Offering
+from karpenter_tpu.cloudprovider.types import (
+    InstanceType,
+    Offering,
+    effective_price as _effective_price,
+)
 from karpenter_tpu.kube.objects import Pod, Taint
 from karpenter_tpu.scheduling.requirement import (
     DOES_NOT_EXIST,
@@ -402,7 +406,11 @@ def encode(
             else:
                 for ri, key in enumerate(keys):
                     cfg_alloc[ci, ri] = cfg.instance_type.allocatable.get(key, 0.0)
-                cfg_price[ci] = cfg.offering.price
+                # spot offerings are priced at price x (1 + interruption
+                # penalty): the packer's cost signal accounts for the
+                # expected reclaim, while the raw price stays what the
+                # fleet pays (cloudprovider.types.effective_price)
+                cfg_price[ci] = _effective_price(cfg.offering)
                 cfg_pool[ci] = pool_order[cfg.pool.metadata.name]
                 rid = cfg.offering.reservation_id
                 if rid:

@@ -12,11 +12,14 @@ spec produce byte-identical fault sequences (see `snapshot_log`).
 
 Spec grammar (KARPENTER_FAULTS, comma-separated entries):
 
-    entry  = kind [ "@" site ] [ ":" occ ] [ "=" duration ]
+    entry  = kind [ "@" site ] [ ":" occ ] [ "=" param ]
     kind   = device_lost | rpc_drop | compile_delay | exec_delay
            | kube_conflict | kube_throttle | kube_watch_drop
            | kube_stale_list | kube_write_partial | operator_crash
+           | spot_interruption
     occ    = "*" | N | N "+" | N "-" M        (1-based, per site)
+    param  = duration                         (delay / retry-after kinds)
+           | rate                             (spot_interruption: 0 < r <= 1)
 
 Examples:
     device_lost@solve:3        third device solve raises DeviceLostError
@@ -26,6 +29,16 @@ Examples:
     kube_conflict@kube_write:2-4   writes 2..4 answer 409
     kube_throttle=250ms        every kube write 429s, Retry-After 250ms
     operator_crash@crash_bind:2    die just before the 2nd pod binding
+    spot_interruption@cloud_interrupt:3      3rd interruption check reclaims
+    spot_interruption@cloud_interrupt:*=0.05 each check reclaims w.p. 5%,
+                                             decided by a seeded hash of the
+                                             check's sequence number — the
+                                             deterministic stand-in for a
+                                             5%/hr interruption regime when
+                                             the provider polls hourly.
+                                             KARPENTER_FAULT_SEED picks the
+                                             schedule; same seed + same spec
+                                             replay byte-identically.
 
 Default sites per kind: device_lost -> solve, rpc_drop -> rpc,
 compile_delay -> compile, exec_delay -> execute, kube faults -> their
@@ -40,6 +53,17 @@ phase the watchdog budgets). Instrumented sites:
     warm        warm_pool per-bucket AOT compile
     rpc         service client, before sending the RPC
     rpc_server  service server, inside the Solve handler
+
+Cloud sites (hooked into the kwok/fake providers):
+
+    cloud_interrupt  one interruption check of one live spot instance
+                     (providers iterate spot instances in sorted
+                     provider-id order, so occurrence numbers map to
+                     instances deterministically); a firing
+                     spot_interruption rule raises SpotInterruptionError,
+                     which the provider CONSUMES — the instance gets an
+                     interruption notice, exactly like a cloud's
+                     rebalance/termination warning
 
 Kube sites (hooked into HTTPTransport.request/watch_events and
 InMemoryApiServer — the transport maps the raised fault to the HTTP
@@ -72,12 +96,14 @@ import logging
 import os
 import threading
 import time
+import zlib
 from dataclasses import dataclass
 from typing import Optional, Sequence
 
 log = logging.getLogger("karpenter.solver.faults")
 
 ENV_SPEC = "KARPENTER_FAULTS"
+ENV_SEED = "KARPENTER_FAULT_SEED"
 
 CRASH_SITES = (
     "crash_tick", "crash_claims", "crash_provision", "crash_bind",
@@ -87,6 +113,7 @@ CRASH_SITES = (
 SITES = (
     "solve", "compile", "execute", "probe", "warm", "rpc", "rpc_server",
     "kube_read", "kube_list", "kube_write", "kube_watch",
+    "cloud_interrupt",
 ) + CRASH_SITES
 
 _DEFAULT_SITE = {
@@ -100,12 +127,13 @@ _DEFAULT_SITE = {
     "kube_stale_list": "kube_list",
     "kube_write_partial": "kube_write",
     "operator_crash": "crash_tick",
+    "spot_interruption": "cloud_interrupt",
 }
 
 _ERROR_KINDS = (
     "device_lost", "rpc_drop", "kube_conflict", "kube_throttle",
     "kube_watch_drop", "kube_stale_list", "kube_write_partial",
-    "operator_crash",
+    "operator_crash", "spot_interruption",
 )
 
 
@@ -164,6 +192,14 @@ class OperatorCrashError(FaultError):
     between two writes would."""
 
 
+class SpotInterruptionError(FaultError):
+    """Injected spot-capacity interruption notice. Raised at the
+    provider's `cloud_interrupt` check for one instance and CONSUMED
+    there — the provider marks the instance interrupted so the
+    interruption controller sees the notice through its normal poll,
+    exactly like a cloud's rebalance/termination warning."""
+
+
 @dataclass(frozen=True)
 class FaultRule:
     kind: str
@@ -171,6 +207,7 @@ class FaultRule:
     lo: int            # 1-based first occurrence; 0 == every occurrence
     hi: int            # last occurrence inclusive; -1 == open-ended
     delay: float = 0.0
+    rate: float = 1.0  # <1.0: fire w.p. rate, seeded-hash-decided per seq
 
     def matches(self, seq: int) -> bool:
         if self.lo == 0:
@@ -181,17 +218,34 @@ class FaultRule:
 
 
 def _parse_duration(text: str) -> float:
+    """Bare seconds, or a `ms`/`s`/`m`/`h` suffix. The `ms` check must
+    precede `m` and `s` (both are suffixes of it)."""
     text = text.strip().lower()
     if text.endswith("ms"):
         return float(text[:-2]) / 1000.0
+    if text.endswith("h"):
+        return float(text[:-1]) * 3600.0
+    if text.endswith("m"):
+        return float(text[:-1]) * 60.0
     if text.endswith("s"):
         return float(text[:-1])
     return float(text)
 
 
-def parse(spec: str) -> list[FaultRule]:
+def _hash01(seed: str, site: str, seq: int) -> float:
+    """Deterministic uniform-ish [0, 1) from (seed, site, seq) — the
+    replay clock for rate-based rules. Pure function of the per-site
+    sequence number, so two runs of the same workload under the same
+    seed reclaim the same occurrences."""
+    return (zlib.crc32(f"{seed}:{site}:{seq}".encode()) & 0xFFFFFFFF) / 2.0**32
+
+
+def parse(spec: str, rejected: Optional[list] = None) -> list[FaultRule]:
     """Parse a KARPENTER_FAULTS spec. Malformed entries are dropped
-    with a warning — chaos knobs must never take the operator down."""
+    with a warning — chaos knobs must never take the operator down —
+    but never silently: each drop increments
+    karpenter_faults_rejected_total and lands in `rejected` (surfaced
+    through readyz() so a typo'd chaos knob is visible)."""
     rules: list[FaultRule] = []
     for raw in (spec or "").split(","):
         raw = raw.strip()
@@ -219,12 +273,26 @@ def parse(spec: str) -> list[FaultRule]:
                 lo = hi = int(occ)
             if (occ and occ != "*" and lo < 1) or (hi >= 0 and hi < lo):
                 raise ValueError(f"bad occurrence range {occ!r}")
-            delay = _parse_duration(param) if param else 0.0
+            rate = 1.0
+            if kind == "spot_interruption":
+                # the =param is a probability per occurrence, not a
+                # duration (spec grammar: spot_interruption@...:occ=rate)
+                rate = float(param) if param else 1.0
+                if not 0.0 < rate <= 1.0:
+                    raise ValueError(f"bad interruption rate {param!r}")
+                delay = 0.0
+            else:
+                delay = _parse_duration(param) if param else 0.0
             if kind.endswith("_delay") and delay <= 0.0:
                 raise ValueError("delay kind needs a =duration")
-            rules.append(FaultRule(kind, site, lo, hi, delay))
+            rules.append(FaultRule(kind, site, lo, hi, delay, rate))
         except (ValueError, IndexError) as err:
             log.warning("ignoring malformed fault entry %r: %s", raw, err)
+            if rejected is not None:
+                rejected.append(raw)
+            from karpenter_tpu.metrics.store import FAULTS_REJECTED
+
+            FAULTS_REJECTED.inc()
     return rules
 
 
@@ -235,12 +303,24 @@ class FaultInjector:
     concurrent call sites interleave but each site's own sequence —
     and therefore which of its calls fault — is deterministic."""
 
-    def __init__(self, rules: Sequence[FaultRule], sleep=time.sleep):
+    def __init__(self, rules: Sequence[FaultRule], sleep=time.sleep,
+                 seed: str = "0", rejected: Optional[list] = None):
         self.rules = list(rules)
         self._sleep = sleep
+        self.seed = seed
+        # malformed spec entries dropped at parse time (readyz surfaces
+        # them so a typo'd chaos knob is visible, not silent)
+        self.rejected: list[str] = list(rejected or [])
         self._seq: dict[str, int] = {}
         self._lock = threading.Lock()
         self.log: list[tuple[str, int, str]] = []  # (site, seq, kind)
+
+    def _admits(self, rule: FaultRule, site: str, seq: int) -> bool:
+        if not rule.matches(seq):
+            return False
+        if rule.rate >= 1.0:
+            return True
+        return _hash01(self.seed, site, seq) < rule.rate
 
     def fire(self, site: str) -> None:
         """Advance `site`'s sequence counter and apply matching rules:
@@ -249,7 +329,7 @@ class FaultInjector:
             seq = self._seq.get(site, 0) + 1
             self._seq[site] = seq
             hits = [r for r in self.rules
-                    if r.site == site and r.matches(seq)]
+                    if r.site == site and self._admits(r, site, seq)]
             for rule in hits:
                 self.log.append((site, seq, rule.kind))
         if not hits:
@@ -282,6 +362,7 @@ class FaultInjector:
             "kube_stale_list": StaleListError,
             "kube_write_partial": WritePartialError,
             "operator_crash": OperatorCrashError,
+            "spot_interruption": SpotInterruptionError,
         }.get(rule.kind, FaultError)
         return cls(message)
 
@@ -300,9 +381,10 @@ _active_lock = threading.Lock()
 
 
 def get() -> Optional[FaultInjector]:
-    """The active injector per KARPENTER_FAULTS, or None. A changed
-    spec builds a fresh injector with zeroed counters, so tests that
-    re-point the env replay from occurrence 1."""
+    """The active injector per KARPENTER_FAULTS (+ the seed), or None.
+    A changed spec or seed builds a fresh injector with zeroed
+    counters, so tests that re-point the env replay from occurrence
+    1."""
     spec = os.environ.get(ENV_SPEC, "")
     global _active, _active_spec
     if not spec:
@@ -310,12 +392,26 @@ def get() -> Optional[FaultInjector]:
             with _active_lock:
                 _active, _active_spec = None, None
         return None
-    if spec != _active_spec:
+    seed = os.environ.get(ENV_SEED, "0")
+    key = f"{seed}|{spec}"
+    if key != _active_spec:
         with _active_lock:
-            if spec != _active_spec:
-                _active = FaultInjector(parse(spec))
-                _active_spec = spec
+            if key != _active_spec:
+                rejected: list[str] = []
+                _active = FaultInjector(
+                    parse(spec, rejected=rejected), seed=seed,
+                    rejected=rejected,
+                )
+                _active_spec = key
     return _active
+
+
+def rejected_specs() -> list[str]:
+    """Malformed entries the ACTIVE spec dropped at parse time — the
+    operator surfaces these through readyz() so a typo'd chaos knob is
+    observable without grepping logs."""
+    injector = get()
+    return list(injector.rejected) if injector is not None else []
 
 
 def reset() -> None:
@@ -323,6 +419,23 @@ def reset() -> None:
     global _active, _active_spec
     with _active_lock:
         _active, _active_spec = None, None
+
+
+def snapshot_active():
+    """Opaque (injector, key) state for scoped spec overrides: callers
+    that temporarily re-point KARPENTER_FAULTS (bench arms) save the
+    ambient injector here and `restore_active` it afterwards, so an
+    externally-set schedule keeps its occurrence counters and replay
+    log across the override instead of being reset to occurrence 1."""
+    with _active_lock:
+        return _active, _active_spec
+
+
+def restore_active(state) -> None:
+    """Reinstate a `snapshot_active` state (see there)."""
+    global _active, _active_spec
+    with _active_lock:
+        _active, _active_spec = state
 
 
 def fire(site: str) -> None:

@@ -85,7 +85,11 @@ def catalog_fingerprint(pools_with_types) -> tuple:
     # place by providers (ICE marking, overlays) and read as plain
     # attributes into FLAT tuples (this runs twice per steady tick —
     # nested per-offering tuples measurably showed up in profiles).
-    return tuple(
+    # The spot interruption penalty is part of the fingerprint: cached
+    # cfg_price arrays bake it in, so a flipped penalty must bust them.
+    from karpenter_tpu.cloudprovider.types import interruption_penalty
+
+    return (interruption_penalty(),) + tuple(
         (
             pool.metadata.name,
             pool.hash(),
@@ -186,12 +190,17 @@ class EncodedCache:
         pool = np.full((n_launch,), -1, np.int32)
         rids: list[tuple[int, str]] = []
         statics: list[tuple] = []
+        from karpenter_tpu.cloudprovider.types import effective_price
+
         for ci in range(n_launch):
             cfg = configs[ci]
             allocatable = cfg.instance_type.allocatable
             for ri, key in enumerate(keys):
                 alloc[ci, ri] = allocatable.get(key, 0.0)
-            price[ci] = cfg.offering.price
+            # spot offerings enter the packing objective at their
+            # interruption-penalized price (the penalty is part of the
+            # catalog fingerprint, so a changed knob busts this cache)
+            price[ci] = effective_price(cfg.offering)
             pool[ci] = pool_order[cfg.pool.metadata.name]
             rid = cfg.offering.reservation_id
             if rid:

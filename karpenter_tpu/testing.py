@@ -128,6 +128,14 @@ class Environment:
             self.kube, self.cluster, self.cloud, self.provisioner,
             options=self.options, recorder=self.recorder,
         )
+        from karpenter_tpu.disruption.interruption import (
+            InterruptionController,
+        )
+
+        self.interruption = InterruptionController(
+            self.kube, self.cluster, self.cloud, self.disruption,
+            recorder=self.recorder,
+        )
         from karpenter_tpu.provisioning.static import StaticCapacityController
 
         self.static = StaticCapacityController(
@@ -161,6 +169,24 @@ class Environment:
         if self.provisioner.get_pending_pods():
             self.provision(now=now)
         return command
+
+    def reconcile_interruption(self, now: Optional[float] = None):
+        """One spot-interruption cycle: poll the provider for notices,
+        start drain-after-replace commands, progress the queue and
+        termination, and rebind displaced/pending pods."""
+        self._advance(now)
+        commands = self.interruption.reconcile(now=now)
+        for command in commands:
+            if command.results is not None:
+                self.bind_results(command.results)
+        self.lifecycle.reconcile_all(now=now)
+        self.cloud.tick(now=now)
+        self.lifecycle.reconcile_all(now=now)
+        self.disruption.queue.reconcile(now=now)
+        self.reconcile_termination(now=now)
+        if self.provisioner.get_pending_pods():
+            self.provision(now=now)
+        return commands
 
     def all_pods_bound(self) -> bool:
         return all(

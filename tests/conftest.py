@@ -33,6 +33,12 @@ def pytest_configure(config):
         "-m restart_chaos)",
     )
     config.addinivalue_line(
+        "markers",
+        "interruption_chaos: seeded spot-interruption storm convergence "
+        "scenarios (part of tier-1; select alone with "
+        "-m interruption_chaos)",
+    )
+    config.addinivalue_line(
         "markers", "slow: excluded from the tier-1 verify run"
     )
 

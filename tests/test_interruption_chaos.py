@@ -1,0 +1,326 @@
+"""Interruption-storm chaos suite (ISSUE 6): a seeded spot
+interruption schedule (`spot_interruption@cloud_interrupt:…=rate`,
+solver/faults.py) fires mid-provisioning and mid-consolidation, and the
+fleet must converge to the SAME fingerprint as the storm-free run:
+
+- same node set (instance-type + capacity-type multiset; replaced node
+  names are process-local and excluded by construction),
+- same bindings (per-node pod-name partition — displaced pods rebirth
+  under their own names on the simulation substrate),
+- zero leaked claims (every claim backed by a node + instance),
+- zero double launches (cloud instances == claim provider ids),
+
+with the fault schedule replaying byte-identically across two runs of
+the same seed (`FaultInjector.snapshot_log`).
+
+The storm mechanism is the interruption controller's normal path: the
+kwok provider's `poll_interruptions()` runs one `cloud_interrupt`
+fault check per live spot instance per operator tick (sorted
+provider-id order, so occurrence numbers map to instances
+deterministically); a firing rule marks the instance interrupted, and
+`disruption/interruption.py` replaces-then-drains it through the
+orchestration queue.
+"""
+
+import time
+
+import pytest
+
+from karpenter_tpu.apis.v1.labels import CAPACITY_TYPE_LABEL
+from karpenter_tpu.apis.v1.nodeclaim import COND_INTERRUPTED
+from karpenter_tpu.cloudprovider.fake import GIB, make_instance_type
+from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
+from karpenter_tpu.kube.client import KubeClient
+from karpenter_tpu.metrics.store import SPOT_INTERRUPTIONS
+from karpenter_tpu.operator.operator import Operator
+from karpenter_tpu.operator.options import FeatureGates, Options
+from karpenter_tpu.solver import faults
+from karpenter_tpu.testing import Environment, mk_nodepool, mk_pod
+
+
+@pytest.fixture()
+def clean_faults(monkeypatch):
+    monkeypatch.delenv("KARPENTER_FAULTS", raising=False)
+    monkeypatch.delenv("KARPENTER_FAULT_SEED", raising=False)
+    faults.reset()
+    yield monkeypatch
+    faults.reset()
+
+
+def _singleton_types():
+    # one-pod-per-node catalog: a 1.5-cpu pod only fits a c2, so every
+    # solve — calm, mid-storm, and every replacement wave — is forced
+    # to the same singleton partition; binding identity is assertable
+    # exactly. Spot offerings (0.4x) are cheapest, so the fleet lands
+    # spot and the storm has something to reclaim.
+    return [make_instance_type("c2", cpu=2, memory=8 * GIB, price=2.0)]
+
+
+def _consolidation_types():
+    return [
+        make_instance_type("c2", cpu=2, memory=8 * GIB, price=2.0),
+        make_instance_type("c8", cpu=8, memory=32 * GIB, price=5.0),
+    ]
+
+
+class Harness:
+    """One cluster run on the simulation substrate (in-memory
+    KubeClient, so evicted controller-owned pods rebirth under their
+    own names — the stand-in for a real ReplicaSet) driving the full
+    Operator tick, interruption controller included."""
+
+    def __init__(self, types):
+        self.kube = KubeClient()
+        self.cloud = KwokCloudProvider(self.kube, types=types)
+        self.op = Operator(
+            kube=self.kube, cloud_provider=self.cloud,
+            options=Options(feature_gates=FeatureGates(
+                spot_to_spot_consolidation=True
+            )),
+        )
+        self.now = time.time()
+
+    def drive(self, ticks, dt=2.0):
+        for _ in range(ticks):
+            self.now += dt
+            self.op.step(now=self.now)
+
+    def seed(self, pods, consolidate="Never"):
+        pool = mk_nodepool("default")
+        pool.spec.disruption.consolidate_after = consolidate
+        self.kube.create(pool)
+        for name, cpu in pods:
+            self.kube.create(mk_pod(name=name, cpu=cpu))
+
+    def delete_pods(self, names):
+        for name in names:
+            pod = self.kube.get_pod("default", name)
+            if pod is not None:
+                self.kube.delete(pod)
+
+    def fingerprint(self):
+        """Name-agnostic converged state + the no-leak invariants."""
+        claims = self.kube.node_claims()
+        assert all(
+            c.metadata.deletion_timestamp is None for c in claims
+        ), "orphaned (wedged-deleting) nodeclaim"
+        claim_pids = sorted(
+            c.status.provider_id for c in claims if c.status.provider_id
+        )
+        assert len(claim_pids) == len(claims), "claim never launched"
+        inst_pids = sorted(i.status.provider_id for i in self.cloud.list())
+        assert inst_pids == claim_pids, (
+            "leaked instance or double launch: "
+            f"cloud={inst_pids} claims={claim_pids}"
+        )
+        nodes = self.kube.nodes()
+        assert sorted(n.spec.provider_id for n in nodes) == claim_pids, (
+            "node set diverged from claim set"
+        )
+        live = [
+            p for p in self.kube.pods()
+            if p.metadata.deletion_timestamp is None
+        ]
+        assert all(p.spec.node_name for p in live), (
+            "stranded pod: "
+            f"{[p.metadata.name for p in live if not p.spec.node_name]}"
+        )
+        assert self.op.cluster.synced()
+        assert self.op.cluster.unpaired_claim_names() == [], (
+            "in-flight claim never materialized"
+        )
+        return sorted(
+            (
+                n.metadata.labels.get(
+                    "node.kubernetes.io/instance-type", ""
+                ),
+                n.metadata.labels.get(CAPACITY_TYPE_LABEL, ""),
+                tuple(sorted(
+                    p.metadata.name
+                    for p in self.kube.pods_on_node(n.metadata.name)
+                )),
+            )
+            for n in nodes
+        )
+
+
+def _storm(monkeypatch, spec, seed="11"):
+    if spec:
+        monkeypatch.setenv("KARPENTER_FAULTS", spec)
+        monkeypatch.setenv("KARPENTER_FAULT_SEED", seed)
+    else:
+        monkeypatch.delenv("KARPENTER_FAULTS", raising=False)
+    faults.reset()
+
+
+def _provisioning_run(spec, monkeypatch, seed="11"):
+    """Six 1.5-cpu pods on a singleton catalog: converge to six spot
+    c2 nodes, one pod each — through however many replacement waves
+    the storm forces."""
+    _storm(monkeypatch, spec, seed)
+    h = Harness(_singleton_types())
+    h.seed([(f"w-{i}", 1.5) for i in range(6)])
+    h.drive(30, dt=2.0)
+    # quiet tail: the storm window is occurrence-bounded, so by now it
+    # is over — ride to quiescence (waves drain, displaced pods land)
+    h.drive(30, dt=15.0)
+    inj = faults.get()
+    h.fault_log = inj.snapshot_log() if inj is not None else []
+    monkeypatch.delenv("KARPENTER_FAULTS", raising=False)
+    return h
+
+
+def _consolidation_run(spec, monkeypatch, seed="11"):
+    """Fifteen 1.5-cpu pods -> three spot c8 nodes; thin to one pod
+    per node -> multi-node consolidation replaces 3 with 1, with the
+    storm reclaiming spot capacity mid-search. End state: one c8, three
+    pods."""
+    _storm(monkeypatch, spec, seed)
+    h = Harness(_consolidation_types())
+    h.seed([(f"w-{i}", 1.5) for i in range(15)], consolidate="0s")
+    h.drive(16, dt=2.0)
+    # thin by NAME (storm-independent: a placement-derived survivor
+    # set would differ between the calm and storm runs and the
+    # fingerprints would diverge for script reasons, not convergence
+    # reasons)
+    h.delete_pods([f"w-{i}" for i in range(3, 15)])
+    h.drive(30, dt=15.0)
+    inj = faults.get()
+    h.fault_log = inj.snapshot_log() if inj is not None else []
+    monkeypatch.delenv("KARPENTER_FAULTS", raising=False)
+    return h
+
+
+_REFERENCE: dict = {}
+
+
+def _reference(kind, monkeypatch):
+    if kind not in _REFERENCE:
+        run = {"prov": _provisioning_run, "cons": _consolidation_run}[kind]
+        _REFERENCE[kind] = run("", monkeypatch).fingerprint()
+    return _REFERENCE[kind]
+
+
+# The 5%/hr regime, occurrence-scaled: the provider runs one
+# cloud_interrupt check per live spot instance per tick, so an
+# occurrence-windowed rate bounds the storm in CHECKS (deterministic)
+# rather than wall time. The window covers provisioning plus several
+# replacement waves, then goes quiet so the fleet can converge.
+PROVISIONING_STORM = "spot_interruption@cloud_interrupt:1-120=0.2"
+CONSOLIDATION_STORM = "spot_interruption@cloud_interrupt:1-60=0.15"
+
+
+@pytest.mark.interruption_chaos
+def test_provisioning_storm_converges_to_calm_fingerprint(clean_faults):
+    want = _reference("prov", clean_faults)
+    assert len(want) == 6 and all(len(p[2]) == 1 for p in want)
+    assert all(p[1] == "spot" for p in want), "fleet should land spot"
+    h = _provisioning_run(PROVISIONING_STORM, clean_faults)
+    fired = [e for e in h.fault_log if e[2] == "spot_interruption"]
+    assert fired, "storm never fired"
+    assert h.fingerprint() == want
+    # every storm interruption was consumed: no claim still holds the
+    # Interrupted condition at convergence (replaced nodes are gone)
+    assert not any(
+        c.status_conditions.is_true(COND_INTERRUPTED)
+        for c in h.kube.node_claims()
+    )
+
+
+@pytest.mark.interruption_chaos
+def test_consolidation_storm_converges_to_calm_fingerprint(clean_faults):
+    want = _reference("cons", clean_faults)
+    assert sum(len(p[2]) for p in want) == 3
+    h = _consolidation_run(CONSOLIDATION_STORM, clean_faults)
+    fired = [e for e in h.fault_log if e[2] == "spot_interruption"]
+    assert fired, "storm never fired"
+    assert h.fingerprint() == want
+
+
+@pytest.mark.interruption_chaos
+def test_storm_replays_byte_identically(clean_faults):
+    """Same spec + same seed + same workload script => identical
+    fired-fault log AND identical converged state — a storm failure
+    found in CI replays exactly on a laptop."""
+    h_a = _provisioning_run(PROVISIONING_STORM, clean_faults, seed="23")
+    h_b = _provisioning_run(PROVISIONING_STORM, clean_faults, seed="23")
+    assert h_a.fault_log, "storm never fired"
+    assert h_a.fault_log == h_b.fault_log, (
+        "fault sequences must replay identically"
+    )
+    assert h_a.fingerprint() == h_b.fingerprint()
+
+
+@pytest.mark.interruption_chaos
+def test_interruption_metric_counts_notices(clean_faults):
+    before = SPOT_INTERRUPTIONS.value({"provider": "kwok"})
+    h = _provisioning_run(
+        "spot_interruption@cloud_interrupt:3", clean_faults
+    )
+    assert h.fingerprint() == _reference("prov", clean_faults)
+    assert SPOT_INTERRUPTIONS.value({"provider": "kwok"}) == before + 1
+
+
+class TestDrainAfterReplace:
+    """The ordering contract in isolation, on the Environment harness:
+    replacement capacity exists and initializes BEFORE the interrupted
+    node drains — never a capacity gap."""
+
+    def _env(self):
+        env = Environment(types=_singleton_types())
+        env.kube.create(mk_nodepool("default"))
+        return env
+
+    def test_notice_taints_and_replaces_before_drain(
+        self, clean_faults, monkeypatch
+    ):
+        env = self._env()
+        env.provision(mk_pod(name="w-0", cpu=1.5), now=0.0)
+        (claim,) = env.kube.node_claims()
+        assert claim.metadata.labels[CAPACITY_TYPE_LABEL] == "spot"
+        # interrupt the singleton on its first check
+        monkeypatch.setenv(
+            "KARPENTER_FAULTS", "spot_interruption@cloud_interrupt:1"
+        )
+        faults.reset()
+        commands = env.interruption.reconcile(now=10.0)
+        assert len(commands) == 1
+        # the notice is surfaced on the claim, the node is tainted,
+        # and the replacement claim already exists — while the
+        # interrupted claim is NOT yet deleting
+        live = env.kube.get_node_claim(claim.metadata.name)
+        assert live.status_conditions.is_true(COND_INTERRUPTED)
+        assert live.metadata.deletion_timestamp is None
+        names = {c.metadata.name for c in env.kube.node_claims()}
+        assert len(names) == 2, "replacement not pre-provisioned"
+        # the interrupted node refuses new pods from this moment
+        state = env.cluster.node_for_key(claim.metadata.name)
+        assert any(
+            t.key == "karpenter.sh/disrupted"
+            for t in state.node.spec.taints
+        )
+        # drive to completion: replacement initializes, drain runs,
+        # the pod lands on the replacement
+        for i in range(1, 8):
+            env.reconcile_interruption(now=10.0 + i * 30.0)
+        assert env.all_pods_bound()
+        (survivor,) = env.kube.node_claims()
+        assert survivor.metadata.name != claim.metadata.name
+
+    def test_interrupted_node_skipped_by_consolidation(
+        self, clean_faults, monkeypatch
+    ):
+        env = self._env()
+        env.provision(mk_pod(name="w-0", cpu=1.5), now=0.0)
+        (claim,) = env.kube.node_claims()
+        claim.status_conditions.set_true(
+            COND_INTERRUPTED, reason="SpotInterruption", now=0.0
+        )
+        env.kube.touch(claim)
+        state = env.cluster.node_for_key(claim.metadata.name)
+        from karpenter_tpu.apis.v1.nodepool import REASON_UNDERUTILIZED
+        from karpenter_tpu.utils.pdb import PdbLimits
+
+        assert env.disruption._build_candidate(
+            state, REASON_UNDERUTILIZED, PdbLimits(env.kube), 100.0
+        ) is None

@@ -430,6 +430,58 @@ def test_kube_write_path_overhead_under_5_percent():
     )
 
 
+def test_on_demand_only_spot_machinery_overhead_under_5_percent():
+    """ISSUE-6 guard: a fleet with NO spot offerings must not pay for
+    the spot tier. The hot-path machinery is the effective-price
+    indirection in encode (called once per launch config; on-demand
+    offerings short-circuit before the env read) plus the spot-budget
+    sweep (a no-op with no knobs set). Encoding an on-demand-only
+    problem with the indirection live must cost <5% over the same
+    encode with it stubbed to the raw price. Interleaved best-of-N,
+    GC off — same rationale as the resilience-wrapper guard above."""
+    from bench import build_problem
+    from karpenter_tpu.provisioning.scheduler import _strip_spot
+    from karpenter_tpu.solver import encode as encode_mod
+    from karpenter_tpu.solver.encode import encode, group_pods
+
+    assert not os.environ.get("KARPENTER_SPOT_PENALTY")
+    pods, pool_types = build_problem(2000, 40, seed=9)
+    pool_types = [
+        (pool, [_strip_spot(it) for it in types])
+        for pool, types in pool_types
+    ]
+    assert not any(
+        o.is_spot() for _, types in pool_types for it in types
+        for o in it.offerings
+    )
+    groups = group_pods(pods)
+    encode(groups, pool_types)  # warm requirement/compat caches
+
+    hooked = encode_mod._effective_price
+    import gc as _gc
+
+    with_hook = without = float("inf")
+    _gc.disable()
+    try:
+        for _ in range(10):
+            encode_mod._effective_price = hooked
+            t0 = time.perf_counter()
+            encode(groups, pool_types)
+            with_hook = min(with_hook, time.perf_counter() - t0)
+            encode_mod._effective_price = lambda o: o.price
+            t0 = time.perf_counter()
+            encode(groups, pool_types)
+            without = min(without, time.perf_counter() - t0)
+    finally:
+        _gc.enable()
+        encode_mod._effective_price = hooked
+    assert with_hook < without * 1.05 + 0.002, (
+        f"on-demand-only encode {with_hook * 1000:.2f}ms vs "
+        f"{without * 1000:.2f}ms with the spot pricing hook stubbed — "
+        "spot machinery overhead above 5%"
+    )
+
+
 @pytest.mark.parametrize(
     "n_nodes",
     [

@@ -1,0 +1,458 @@
+"""Spot capacity tier unit coverage (ISSUE 6 satellites): fault-spec
+grammar (`m`/`h` durations, `spot_interruption` rates, rejected-entry
+visibility), interruption-penalized effective pricing, the
+deterministic spot price curve, per-pool spot budgets, and
+CAPACITY_TYPE_LABEL propagation end to end for all three capacity
+types."""
+
+import pytest
+
+from karpenter_tpu.apis.v1.labels import (
+    CAPACITY_TYPE_LABEL,
+    CAPACITY_TYPE_ON_DEMAND,
+    CAPACITY_TYPE_RESERVED,
+    CAPACITY_TYPE_SPOT,
+    RESERVATION_ID_LABEL,
+    SPOT_MAX_FRACTION_ANNOTATION,
+    SPOT_MIN_ON_DEMAND_ANNOTATION,
+)
+from karpenter_tpu.cloudprovider import types as ctypes
+from karpenter_tpu.cloudprovider.fake import (
+    GIB,
+    make_instance_type,
+    reprice_spot,
+    spot_price_at,
+)
+from karpenter_tpu.metrics.store import FAULTS_REJECTED, SPOT_BUDGET_PINNED
+from karpenter_tpu.solver import faults
+from karpenter_tpu.testing import Environment, mk_nodepool, mk_pod
+
+
+@pytest.fixture()
+def clean_faults(monkeypatch):
+    monkeypatch.delenv("KARPENTER_FAULTS", raising=False)
+    monkeypatch.delenv("KARPENTER_FAULT_SEED", raising=False)
+    faults.reset()
+    yield monkeypatch
+    faults.reset()
+
+
+class TestDurationSuffixes:
+    """`_parse_duration` satellite: `1m` used to parse as float("1m")
+    -> ValueError, silently swallowed by the entry-drop path."""
+
+    @pytest.mark.parametrize("text,want", [
+        ("2", 2.0),            # bare seconds
+        ("250ms", 0.25),
+        ("5s", 5.0),
+        ("1m", 60.0),
+        ("1.5m", 90.0),
+        ("1h", 3600.0),
+        ("0.5h", 1800.0),
+    ])
+    def test_all_suffixes(self, text, want):
+        assert faults._parse_duration(text) == want
+
+    def test_minute_hour_delays_survive_parse(self):
+        rules = faults.parse("compile_delay=1m,exec_delay=2h")
+        assert [r.delay for r in rules] == [60.0, 7200.0]
+
+
+class TestSpotInterruptionSpec:
+    def test_defaults_to_cloud_interrupt_site(self):
+        (rule,) = faults.parse("spot_interruption:3")
+        assert (rule.site, rule.lo, rule.hi, rule.rate) == (
+            "cloud_interrupt", 3, 3, 1.0
+        )
+
+    def test_rate_param_is_probability_not_duration(self):
+        (rule,) = faults.parse("spot_interruption@cloud_interrupt:*=0.05")
+        assert rule.rate == 0.05 and rule.delay == 0.0
+
+    @pytest.mark.parametrize("bad", [
+        "spot_interruption:*=0",
+        "spot_interruption:*=1.5",
+        "spot_interruption:*=-0.1",
+        "spot_interruption:*=abc",
+    ])
+    def test_bad_rates_rejected(self, bad):
+        rejected: list = []
+        assert faults.parse(bad, rejected=rejected) == []
+        assert rejected == [bad]
+
+    def test_rate_admission_is_seed_deterministic(self):
+        def fire_mask(seed):
+            inj = faults.FaultInjector(
+                faults.parse("spot_interruption:*=0.3"), seed=seed
+            )
+            mask = []
+            for _ in range(200):
+                try:
+                    inj.fire("cloud_interrupt")
+                    mask.append(False)
+                except faults.SpotInterruptionError:
+                    mask.append(True)
+            return mask
+
+        a, b = fire_mask("17"), fire_mask("17")
+        assert a == b, "same seed must replay identically"
+        fired = sum(a)
+        # ~0.3 +/- generous slack: the hash is uniform-ish, and the
+        # bound only guards against degenerate all/none behavior
+        assert 20 <= fired <= 120
+        assert fire_mask("18") != a, "different seed, different schedule"
+
+
+class TestRejectedSpecVisibility:
+    def test_counter_increments_per_dropped_entry(self):
+        before = FAULTS_REJECTED.value()
+        faults.parse("garbage@solve,device_lost@nowhere,device_lost@solve:2")
+        assert FAULTS_REJECTED.value() == before + 2
+
+    def test_env_injector_records_rejects(self, clean_faults):
+        clean_faults.setenv(
+            "KARPENTER_FAULTS", "typo_kind@solve:1,device_lost@solve:99"
+        )
+        faults.reset()
+        assert faults.rejected_specs() == ["typo_kind@solve:1"]
+
+    def test_operator_readyz_surfaces_rejects(self, clean_faults):
+        from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
+        from karpenter_tpu.kube.client import KubeClient
+        from karpenter_tpu.operator.operator import Operator
+
+        clean_faults.setenv("KARPENTER_FAULTS", "not_a_kind@solve")
+        faults.reset()
+        kube = KubeClient()
+        op = Operator(kube=kube, cloud_provider=KwokCloudProvider(kube))
+        assert op.readyz()["rejected_fault_specs"] == ["not_a_kind@solve"]
+
+
+class TestEffectivePrice:
+    def _offerings(self):
+        it = make_instance_type("c4", cpu=4, memory=16 * GIB, price=3.0)
+        spot = next(o for o in it.offerings if o.is_spot())
+        od = next(o for o in it.offerings if not o.is_spot())
+        return spot, od
+
+    def test_no_penalty_means_raw_prices(self, monkeypatch):
+        monkeypatch.delenv("KARPENTER_SPOT_PENALTY", raising=False)
+        spot, od = self._offerings()
+        assert ctypes.effective_price(spot) == spot.price
+        assert ctypes.effective_price(od) == od.price
+
+    def test_penalty_applies_to_spot_only(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_SPOT_PENALTY", "0.5")
+        spot, od = self._offerings()
+        assert ctypes.effective_price(spot) == pytest.approx(
+            spot.price * 1.5
+        )
+        assert ctypes.effective_price(od) == od.price
+
+    @pytest.mark.parametrize("raw", ["", "nonsense", "-2"])
+    def test_bad_or_negative_penalty_clamps_to_zero(self, monkeypatch, raw):
+        monkeypatch.setenv("KARPENTER_SPOT_PENALTY", raw)
+        assert ctypes.interruption_penalty() == 0.0
+
+    def test_penalty_busts_encoder_cache_fingerprint(self, monkeypatch):
+        from karpenter_tpu.solver.incremental import catalog_fingerprint
+
+        pool = mk_nodepool("default")
+        pools = [(pool, [make_instance_type("c4", cpu=4)])]
+        monkeypatch.delenv("KARPENTER_SPOT_PENALTY", raising=False)
+        fp0 = catalog_fingerprint(pools)
+        monkeypatch.setenv("KARPENTER_SPOT_PENALTY", "0.25")
+        assert catalog_fingerprint(pools) != fp0
+
+
+class TestSpotPriceCurve:
+    def test_pure_function_of_inputs(self):
+        assert spot_price_at(10.0, "z-1", 7200.0) == spot_price_at(
+            10.0, "z-1", 7200.0
+        )
+
+    def test_bounded_wobble_around_discount(self):
+        for hour in range(48):
+            p = spot_price_at(10.0, "z-1", hour * 3600.0)
+            assert 10.0 * 0.4 * 0.875 <= p <= 10.0 * 0.4 * 1.125
+
+    def test_curve_moves_across_hours(self):
+        prices = {
+            spot_price_at(10.0, "z-1", h * 3600.0) for h in range(24)
+        }
+        assert len(prices) > 1
+
+    def test_reprice_is_idempotent_within_the_hour(self):
+        types = [make_instance_type("c4", cpu=4, price=3.0)]
+        changed = reprice_spot(types, now=5 * 3600.0)
+        assert changed > 0
+        assert reprice_spot(types, now=5 * 3600.0 + 120.0) == 0
+        spot = [o for it in types for o in it.offerings if o.is_spot()]
+        od = {o.zone: o.price for it in types for o in it.offerings
+              if not o.is_spot()}
+        for o in spot:
+            assert o.price == spot_price_at(od[o.zone], o.zone, 5 * 3600.0)
+
+
+def _budget_env(annotations=None):
+    env = Environment(types=[
+        make_instance_type("c4", cpu=4, memory=16 * GIB, price=3.0)
+    ])
+    pool = mk_nodepool("default")
+    for key, value in (annotations or {}).items():
+        pool.metadata.annotations[key] = value
+    env.kube.create(pool)
+    return env
+
+
+def _capacity_counts(env):
+    counts: dict = {}
+    for node in env.kube.nodes():
+        ct = node.metadata.labels.get(CAPACITY_TYPE_LABEL, "")
+        counts[ct] = counts.get(ct, 0) + 1
+    return counts
+
+
+class TestSpotBudget:
+    def test_default_budget_is_unbounded(self):
+        from karpenter_tpu.provisioning.scheduler import pool_spot_budget
+
+        assert pool_spot_budget(mk_nodepool("p")) == (1.0, 0)
+
+    def test_annotation_overrides_env(self, monkeypatch):
+        from karpenter_tpu.provisioning.scheduler import pool_spot_budget
+
+        monkeypatch.setenv("KARPENTER_SPOT_MAX_FRACTION", "0.9")
+        pool = mk_nodepool("p")
+        pool.metadata.annotations[SPOT_MAX_FRACTION_ANNOTATION] = "0.25"
+        pool.metadata.annotations[SPOT_MIN_ON_DEMAND_ANNOTATION] = "2"
+        assert pool_spot_budget(pool) == (0.25, 2)
+
+    def test_bad_knob_falls_back_to_default(self):
+        from karpenter_tpu.provisioning.scheduler import pool_spot_budget
+
+        pool = mk_nodepool("p")
+        pool.metadata.annotations[SPOT_MAX_FRACTION_ANNOTATION] = "lots"
+        assert pool_spot_budget(pool) == (1.0, 0)
+
+    def test_bad_annotation_falls_back_to_env_not_unbounded(self, monkeypatch):
+        """A typo'd per-pool annotation must fall back to the FLEET
+        default (the env knob), not widen the pool's exposure to the
+        unbounded hardcoded default."""
+        from karpenter_tpu.provisioning.scheduler import pool_spot_budget
+
+        monkeypatch.setenv("KARPENTER_SPOT_MAX_FRACTION", "0.5")
+        pool = mk_nodepool("p")
+        pool.metadata.annotations[SPOT_MAX_FRACTION_ANNOTATION] = "0.5x"
+        assert pool_spot_budget(pool) == (0.5, 0)
+
+    def test_zero_budget_launches_on_demand_only(self):
+        env = _budget_env({SPOT_MAX_FRACTION_ANNOTATION: "0"})
+        env.provision(*[mk_pod(cpu=3.0) for _ in range(4)], now=0.0)
+        assert _capacity_counts(env) == {CAPACITY_TYPE_ON_DEMAND: 4}
+
+    def test_max_fraction_pins_excess_to_on_demand(self):
+        before = SPOT_BUDGET_PINNED.value(
+            {"nodepool": "default", "cause": "max-spot-fraction"}
+        )
+        env = _budget_env({SPOT_MAX_FRACTION_ANNOTATION: "0.5"})
+        env.provision(*[mk_pod(cpu=3.0) for _ in range(4)], now=0.0)
+        counts = _capacity_counts(env)
+        assert counts[CAPACITY_TYPE_SPOT] == 2
+        assert counts[CAPACITY_TYPE_ON_DEMAND] == 2
+        assert SPOT_BUDGET_PINNED.value(
+            {"nodepool": "default", "cause": "max-spot-fraction"}
+        ) == before + 2
+
+    def test_min_on_demand_floor(self):
+        env = _budget_env({SPOT_MIN_ON_DEMAND_ANNOTATION: "1"})
+        env.provision(*[mk_pod(cpu=3.0) for _ in range(3)], now=0.0)
+        counts = _capacity_counts(env)
+        assert counts.get(CAPACITY_TYPE_ON_DEMAND, 0) >= 1
+        assert counts.get(CAPACITY_TYPE_SPOT, 0) == 2
+
+    def test_existing_fleet_counts_toward_the_budget(self):
+        env = _budget_env({SPOT_MAX_FRACTION_ANNOTATION: "0.5"})
+        env.provision(*[mk_pod(cpu=3.0) for _ in range(2)], now=0.0)
+        assert _capacity_counts(env) == {
+            CAPACITY_TYPE_SPOT: 1, CAPACITY_TYPE_ON_DEMAND: 1
+        }
+        # two more pods: the budget must see the LIVE 1-spot/1-od fleet
+        env.provision(*[mk_pod(cpu=3.0) for _ in range(2)], now=10.0)
+        counts = _capacity_counts(env)
+        assert counts[CAPACITY_TYPE_SPOT] == 2
+        assert counts[CAPACITY_TYPE_ON_DEMAND] == 2
+
+    def test_spot_requiring_pods_cannot_be_pinned(self):
+        env = _budget_env({SPOT_MAX_FRACTION_ANNOTATION: "0"})
+        env.provision(
+            mk_pod(cpu=3.0, node_selector={
+                CAPACITY_TYPE_LABEL: CAPACITY_TYPE_SPOT
+            }),
+            now=0.0,
+        )
+        # zero budget strips spot columns entirely, so a pod that PINS
+        # spot goes unschedulable rather than silently violating the
+        # budget (unsatisfiable demand is the pool owner's conflict)
+        assert not env.all_pods_bound()
+
+
+class TestCapacityTypePropagation:
+    """Satellite: scheduler requirement -> offering selection ->
+    launched NodeClaim labels -> consolidation same-type guard, for
+    all three capacity types."""
+
+    def _env(self):
+        return Environment(types=[
+            make_instance_type(
+                "c4", cpu=4, memory=16 * GIB, price=3.0,
+                reservations=[("rsv-1", "test-zone-1", 2)],
+            ),
+        ])
+
+    @pytest.mark.parametrize("ct", [
+        CAPACITY_TYPE_ON_DEMAND, CAPACITY_TYPE_SPOT, CAPACITY_TYPE_RESERVED,
+    ])
+    def test_selector_to_claim_labels(self, ct):
+        env = self._env()
+        env.kube.create(mk_nodepool("default"))
+        env.provision(
+            mk_pod(cpu=3.0, node_selector={CAPACITY_TYPE_LABEL: ct}),
+            now=0.0,
+        )
+        (claim,) = env.kube.node_claims()
+        assert claim.metadata.labels[CAPACITY_TYPE_LABEL] == ct
+        (node,) = env.kube.nodes()
+        assert node.metadata.labels[CAPACITY_TYPE_LABEL] == ct
+        if ct == CAPACITY_TYPE_RESERVED:
+            assert claim.metadata.labels[RESERVATION_ID_LABEL] == "rsv-1"
+        else:
+            assert RESERVATION_ID_LABEL not in claim.metadata.labels
+        assert env.all_pods_bound()
+
+    @pytest.mark.parametrize("ct", [
+        CAPACITY_TYPE_ON_DEMAND, CAPACITY_TYPE_SPOT, CAPACITY_TYPE_RESERVED,
+    ])
+    def test_candidate_capacity_type_propagates(self, ct):
+        import time
+
+        from karpenter_tpu.apis.v1.nodepool import REASON_UNDERUTILIZED
+
+        t0 = time.time()
+        env = self._env()
+        pool = mk_nodepool("default")
+        pool.spec.disruption.consolidate_after = "0s"
+        env.kube.create(pool)
+        env.provision(
+            mk_pod(cpu=1.0, node_selector={CAPACITY_TYPE_LABEL: ct}),
+            now=t0,
+        )
+        env.pod_events.reconcile_all(now=t0 + 100.0)
+        env.conditions.reconcile_all(now=t0 + 100.0)
+        candidates = env.disruption.get_candidates(
+            REASON_UNDERUTILIZED, now=t0 + 200.0
+        )
+        assert [c.capacity_type for c in candidates] == [ct]
+
+    @pytest.mark.parametrize("gate", [False, True])
+    def test_spot_to_spot_guard_reads_candidate_capacity_type(self, gate):
+        """A lone spot node consolidates onto cheaper spot ONLY when
+        the SpotToSpotConsolidation gate is on (and >=15 cheaper spot
+        types exist — consolidation.go:233-311); the guard reads the
+        candidate's propagated capacity type. Gate on is the positive
+        control proving the scenario is otherwise consolidatable, so
+        the gate-off survival is the guard, not a vacuous pass."""
+        import time
+
+        from karpenter_tpu.operator.options import FeatureGates, Options
+
+        types = [make_instance_type("c4", cpu=4, memory=16 * GIB,
+                                    price=3.0)] + [
+            # >= SPOT_TO_SPOT_MIN_TYPES cheaper shapes the freed pod fits
+            make_instance_type(f"s{i:02d}", cpu=2, memory=8 * GIB,
+                               price=2.0 + i * 0.001)
+            for i in range(15)
+        ]
+        env = Environment(
+            types=types,
+            options=Options(feature_gates=FeatureGates(
+                spot_to_spot_consolidation=gate
+            )),
+        )
+        t0 = time.time()
+        pool = mk_nodepool("default")
+        pool.spec.disruption.consolidate_after = "0s"
+        env.kube.create(pool)
+        # land one small pod on the big spot node by requiring c4
+        env.provision(
+            mk_pod(cpu=1.0, node_selector={
+                "node.kubernetes.io/instance-type": "c4",
+                CAPACITY_TYPE_LABEL: CAPACITY_TYPE_SPOT,
+            }),
+            now=t0,
+        )
+        (claim,) = env.kube.node_claims()
+        pod = env.kube.pods()[0]
+        pod.spec.node_selector = {}  # free the pod; cheaper s* now fit
+        env.kube.touch(pod)
+        for i in range(1, 8):
+            env.reconcile_disruption(now=t0 + i * 30.0)
+        claims = env.kube.node_claims()
+        if gate:
+            # consolidated onto a cheaper spot type
+            assert [c.metadata.name for c in claims] != [claim.metadata.name]
+            assert all(
+                c.metadata.labels[CAPACITY_TYPE_LABEL] == CAPACITY_TYPE_SPOT
+                for c in claims
+            )
+        else:
+            # gate off: spot->spot churn blocked, the node survives
+            assert [c.metadata.name for c in claims] == [claim.metadata.name]
+
+    def test_global_repack_routes_by_resolved_capacity_type(self):
+        """Multi-node repack twin of the single-node fix: a replacement
+        plan whose surviving offerings include BOTH a ~free reserved
+        offering (cheapest raw price — what the launch resolves to) and
+        a cheaper-than-current spot offering must pin to RESERVED, not
+        get misrouted to spot just because a spot offering survived."""
+        import time
+
+        from karpenter_tpu.apis.v1.nodepool import REASON_UNDERUTILIZED
+
+        types = [
+            make_instance_type("c4", cpu=4, memory=16 * GIB, price=3.0),
+            make_instance_type(
+                "big8", cpu=8, memory=32 * GIB, price=7.0,
+                reservations=[("rsv-big", "test-zone-1", 2)],
+            ),
+        ]
+        env = Environment(types=types)
+        t0 = time.time()
+        pool = mk_nodepool("default")
+        pool.spec.disruption.consolidate_after = "0s"
+        env.kube.create(pool)
+        # 2.5 cpu each: two pods cannot share a c4, so the fleet lands
+        # two on-demand c4 nodes; both fit one big8
+        env.provision(
+            *[mk_pod(name=f"r{i}", cpu=2.5, node_selector={
+                "node.kubernetes.io/instance-type": "c4",
+                CAPACITY_TYPE_LABEL: CAPACITY_TYPE_ON_DEMAND,
+            }) for i in range(2)],
+            now=t0,
+        )
+        assert len(env.kube.node_claims()) == 2
+        for pod in env.kube.pods():
+            pod.spec.node_selector = {}  # free the pods; big8 now fits
+            env.kube.touch(pod)
+        env.pod_events.reconcile_all(now=t0 + 100.0)
+        env.conditions.reconcile_all(now=t0 + 100.0)
+        command = env.disruption.global_repack_consolidation(
+            now=t0 + 200.0
+        )
+        assert command is not None and command.results is not None
+        offering_cts = {
+            o.capacity_type
+            for plan in command.results.new_node_plans
+            for o in plan.offerings
+        }
+        assert offering_cts == {CAPACITY_TYPE_RESERVED}
