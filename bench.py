@@ -1022,7 +1022,7 @@ def scenario_steady_state_churn(
 
     inc_p50 = sorted(inc_walls)[len(inc_walls) // 2]
     full_p50 = sorted(full_walls)[len(full_walls) // 2]
-    return {
+    out = {
         "pods": len(pods),
         "ticks": ticks,
         "churn_per_tick": churn,
@@ -1036,6 +1036,74 @@ def scenario_steady_state_churn(
         "unschedulable": inc.unschedulable if inc else 0,
         "nodes": inc.nodes if inc else 0,
         "fleet_price_per_hr": round(inc.fleet_price, 2) if inc else 0.0,
+    }
+    live_pods = int(os.environ.get("BENCH_LIVE_PODS",
+                                   str(min(n_pods, 5000))))
+    if live_pods >= 8:
+        out["live_operator"] = _live_operator_arm(
+            live_pods, ticks=5, churn=churn
+        )
+    return out
+
+
+def _live_operator_arm(n_pods: int, ticks: int, churn: float) -> dict:
+    """ISSUE-7 live-operator arm: the same steady-state-churn question
+    asked of the REAL control loop — a full Operator over the in-memory
+    kube, with `Provisioner.schedule()` routed through the incremental
+    live tick (provisioning/incremental_tick.py) — instead of the
+    library pipeline above. Each tick deletes/rebirths `churn` of the
+    bound pods and measures the operator step that runs the churn
+    solve, three ways: incremental (audits off), incremental with the
+    shadow full-solve oracle audit forced EVERY tick (the audit
+    overhead), and the incremental path disabled (the O(fleet) full
+    reconcile). Oracle divergences must be zero: every audited tick's
+    incremental decision matched the full Scheduler's byte-for-byte.
+
+    Scale: BENCH_LIVE_PODS (default min(BENCH_PODS, 5000); 0 disables
+    the arm). The fixture is `karpenter_tpu.testing.build_churn_operator`
+    — the same full-fleet workload `tests/test_perf_floor.py` guards,
+    so the bench and the perf floor measure one workload."""
+    from karpenter_tpu.metrics.store import INCREMENTAL_DIVERGENCE
+    from karpenter_tpu.testing import build_churn_operator, churn_tick_walls
+
+    churn_k = max(1, int(n_pods * churn))
+
+    def run_arm(env_overrides: dict) -> tuple[float, dict]:
+        saved = {k: os.environ.get(k) for k in env_overrides}
+        os.environ.update(env_overrides)
+        try:
+            env, op, now = build_churn_operator(n_pods)
+            p50, _ = churn_tick_walls(env, op, now, ticks, churn_k)
+            return p50, op.provisioner.incremental.status()
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    div0 = INCREMENTAL_DIVERGENCE.total()
+    inc_p50, inc_status = run_arm({
+        "KARPENTER_INCREMENTAL": "1", "KARPENTER_INCR_AUDIT_EVERY": "0",
+    })
+    audited_p50, audit_status = run_arm({
+        "KARPENTER_INCREMENTAL": "1", "KARPENTER_INCR_AUDIT_EVERY": "1",
+    })
+    full_p50, _ = run_arm({"KARPENTER_INCREMENTAL": "0"})
+    divergences = int(INCREMENTAL_DIVERGENCE.total() - div0)
+    return {
+        "pods": n_pods,
+        "ticks": ticks,
+        "churn_per_tick": churn,
+        "incremental_tick_p50_s": round(inc_p50, 4),
+        "full_reconcile_p50_s": round(full_p50, 4),
+        "speedup": round(full_p50 / inc_p50, 2) if inc_p50 > 0 else 0.0,
+        "audited_tick_p50_s": round(audited_p50, 4),
+        "audit_overhead_s": round(max(0.0, audited_p50 - inc_p50), 4),
+        "incremental_ticks": inc_status["ticks"],
+        "audited_ticks": audit_status["ticks"],
+        "last_audit": audit_status["last_audit"],
+        "oracle_divergences": divergences,
     }
 
 

@@ -14,11 +14,34 @@ no watch events; controllers that mutate objects in place call
 `KubeClient.touch` so every tracker sees the change (the reference has
 no such path — every write goes through the API server — which is
 exactly the property touch() restores).
+
+Two extensions serve retained-state consumers (the provisioner's
+incremental live tick):
+
+- `watch(kind, key=fn)` maps each event to DERIVED keys (e.g. a Pod
+  event dirties the NODE the pod is bound to), so a consumer keyed by
+  one kind can be fed from events of another.
+- `relisted(kind)` latches 410-driven relists: a watch_gone re-LIST
+  means the watch stream fell off the server's event horizon, so the
+  diff-based relist events CANNOT be trusted to name every change the
+  stale window hid (the mirror's rv guard suppresses echoes, and a
+  change-then-change-back is invisible to a diff). A retained-state
+  consumer must treat such a relist as "everything dirty" and rebuild —
+  correctness over incrementality, exactly once per relist. Snapshot
+  transports re-LIST every pump BY DESIGN — their diff events are the
+  primary event stream, not a recovery path — so they never advance
+  the generation (marking everything dirty every pump would erase
+  incrementality entirely).
 """
 
 from __future__ import annotations
 
+from typing import Callable, Iterable, Optional
+
 from karpenter_tpu.kube.client import KubeClient
+
+# key-mapping hook: (event, obj) -> derived dirty keys
+KeyFn = Callable[[str, object], Iterable[str]]
 
 
 class DirtyTracker:
@@ -26,16 +49,23 @@ class DirtyTracker:
         self.kube = kube
         self._sets: dict[str, set[str]] = {}
         self._watched: set[str] = set()
+        # last relist generation observed per kind (clients that never
+        # relist — the in-memory substrate — simply never advance it)
+        self._relist_gen: dict[str, int] = {}
 
-    def watch(self, *kinds: str) -> "DirtyTracker":
+    def watch(self, *kinds: str, key: Optional[KeyFn] = None) -> "DirtyTracker":
         for kind in kinds:
             if kind in self._watched:
                 continue
             self._watched.add(kind)
             self._sets.setdefault(kind, set())
 
-            def handler(event: str, obj, _k: str = kind) -> None:
-                self._sets[_k].add(obj.key)
+            def handler(event: str, obj, _k: str = kind,
+                        _key: Optional[KeyFn] = key) -> None:
+                if _key is None:
+                    self._sets[_k].add(obj.key)
+                else:
+                    self._sets[_k].update(_key(event, obj))
 
             self.kube.watch(kind, handler)
         return self
@@ -50,6 +80,25 @@ class DirtyTracker:
 
     def peek(self, kind: str) -> set[str]:
         return set(self._sets.get(kind, set()))
+
+    def relisted(self, *kinds: str) -> bool:
+        """True once per 410-driven relist of any of `kinds` since the
+        last call — the signal that the event stream lost continuity
+        and a retained-state consumer must mark EVERYTHING dirty.
+        Reads the client's per-kind relist generation (RealKubeClient
+        increments it only on watch_gone re-LISTs; snapshot pumps
+        re-LIST every cycle by design and never advance it); clients
+        without one never relist."""
+        gen_of = getattr(self.kube, "relist_generation", None)
+        if gen_of is None:
+            return False
+        hit = False
+        for kind in kinds:
+            gen = gen_of(kind)
+            if gen != self._relist_gen.get(kind, 0):
+                self._relist_gen[kind] = gen
+                hit = True
+        return hit
 
     def clear(self) -> None:
         """Drop all pending dirt without reporting it (used after a

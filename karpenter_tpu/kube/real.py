@@ -798,6 +798,11 @@ class RealKubeClient:
         self.async_delivery = True  # cache semantics are inherent here
         self._last_pump = 0.0
         self._relist_at: dict[str, float] = {}  # kind -> last 410 relist
+        # monotone per-kind relist counter: DirtyTracker.relisted reads
+        # it so retained-state consumers can mark everything dirty once
+        # per lost-continuity window (the relist's diff events alone
+        # cannot prove nothing else changed while the watch was stale)
+        self._relist_gen: dict[str, int] = {}
         self.sync()
 
     # -- transport funnel --------------------------------------------------
@@ -947,6 +952,12 @@ class RealKubeClient:
         status, body = self._request("list", "GET", _path(kind))
         if status != 200:
             return  # transient; the next pump retries
+        if reason == "watch_gone":
+            # only 410 relists lose event-stream continuity (snapshot
+            # pumps re-LIST every cycle by design); retained-state
+            # consumers key "mark everything dirty" off this
+            with self._lock:
+                self._relist_gen[kind] = self._relist_gen.get(kind, 0) + 1
         live_keys = set()
         for item in body.get("items", []):
             rv = int(item["metadata"].get("resourceVersion", "0") or 0)
@@ -1167,6 +1178,12 @@ class RealKubeClient:
             self._index_pod(obj)
         self._announce(obj.kind, MODIFIED, obj)
         return obj
+
+    def relist_generation(self, kind: str) -> int:
+        """Monotone count of 410-driven relists for one kind — the
+        lost-continuity signal DirtyTracker.relisted latches."""
+        with self._lock:
+            return self._relist_gen.get(kind, 0)
 
     def touch(self, obj) -> None:
         """In-place mutations must land on the server: touch IS update

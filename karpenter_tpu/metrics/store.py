@@ -202,6 +202,34 @@ SOLVER_ENCODE_CACHE = REGISTRY.counter(
 SOLVER_INCREMENTAL_TICKS = REGISTRY.counter(
     "karpenter_solver_incremental_ticks_total",
     "Warm-start pipeline ticks, by mode (incremental/full) and reason")
+# incremental live tick (provisioning/incremental_tick.py): the
+# provisioner's retained-state reconcile path and its self-audit
+INCREMENTAL_TICK = REGISTRY.counter(
+    "karpenter_incremental_tick_total",
+    "Provisioner live reconcile ticks, by path (incremental: served "
+    "from retained state; full_backstop: routed to the full Scheduler "
+    "with the ineligibility reason; quarantined: retained state "
+    "distrusted, full-solve decision served)")
+INCREMENTAL_DIVERGENCE = REGISTRY.counter(
+    "karpenter_incremental_oracle_divergence_total",
+    "Incremental-vs-full decision divergences caught by the shadow "
+    "oracle audit — every one quarantines the retained state; a "
+    "nonzero rate means the dirty-set plumbing is missing changes")
+INCREMENTAL_AUDITS = REGISTRY.counter(
+    "karpenter_incremental_audit_total",
+    "Shadow full-solve oracle audits of the incremental live tick, by "
+    "verdict (ok/divergence) and trigger (cadence/fault/recovery/"
+    "probation)")
+INCREMENTAL_FINGERPRINT_AGE = REGISTRY.gauge(
+    "karpenter_incremental_fingerprint_age_ticks",
+    "Incremental ticks served since the retained fleet state was last "
+    "rebuilt from scratch — the staleness horizon the oracle audit "
+    "bounds")
+DISRUPTION_SCAN_SKIPPED = REGISTRY.counter(
+    "karpenter_disruption_scan_skipped_total",
+    "Disruption reconcile rounds skipped because nothing went dirty "
+    "since the last empty-handed scan (the watch-driven O(changes) "
+    "gate; a periodic forced scan bounds staleness)")
 SOLVER_DEVICE_STEPS = REGISTRY.histogram(
     "karpenter_solver_device_steps",
     "Outer-loop device steps per packing solve, by path "

@@ -202,6 +202,24 @@ class Operator:
 
         self.kube.watch("Pod", on_pod_event)
 
+        # Incremental disruption gate: the engine's candidate scan +
+        # probe ladder is O(fleet) even when it decides nothing. When
+        # the previous round came back empty-handed and NOTHING the
+        # scan reads has changed since — no Node/NodeClaim/Pod/
+        # NodePool/PDB watch traffic, same catalog fingerprint, and no
+        # cron-scheduled budget that could open a window silently — the
+        # same scan returns the same nothing, so skip it. A periodic
+        # forced scan (KARPENTER_INCR_DISRUPTION_FORCE_SECONDS) bounds
+        # staleness against anything the gate mis-models.
+        from karpenter_tpu.kube.dirty import DirtyTracker
+
+        self._disruption_dirty = DirtyTracker(self.kube).watch(
+            "Node", "NodeClaim", "Pod", "NodePool", "PodDisruptionBudget"
+        )
+        self._disruption_idle = False    # last round found nothing
+        self._disruption_catalog_fp = None
+        self._last_forced_disruption = 0.0
+
     # -- one tick --------------------------------------------------------------
 
     def step(self, now: Optional[float] = None) -> None:
@@ -323,27 +341,37 @@ class Operator:
                     )
 
         if now - self._last_disruption >= self.options.disruption_poll_seconds:
+            # a skipped scan consumes its poll slot too — otherwise the
+            # skip-gate's own checks (node_pools, catalog fingerprint)
+            # re-run every step in exactly the idle clusters the gate
+            # exists to make cheap
             self._last_disruption = now
-            with self.profiler.span("disruption"):
-                command = self.disruption.reconcile(now=now)
-                if command is not None:
-                    # crash window: command started (candidates tainted,
-                    # replacements created) but its binding plan and the
-                    # queue's in-memory command state die with us
-                    _faults.fire("crash_disruption_started")
-                if command is not None and command.results is not None:
-                    # the command's placements ARE the plan for the
-                    # candidates' pods: route them through the binding
-                    # queue so evicted pods land on the planned
-                    # capacity instead of re-solving from scratch (the
-                    # reference nominates pods onto the planned nodes
-                    # and the provisioner skips nominated pods —
-                    # without this, a fresh solve can buy a NEW node
-                    # for the displaced pods and consolidation
-                    # oscillates: found by the round-5 seed-11 soak)
-                    self._enqueue_bindings(
-                        command.results, now, COMMAND_BIND_TTL_SECONDS
+            if not self._skip_disruption_scan(now):
+                with self.profiler.span("disruption"):
+                    command = self.disruption.reconcile(now=now)
+                    self._disruption_idle = (
+                        command is None and not self.disruption.queue.active
                     )
+                    if command is not None:
+                        # crash window: command started (candidates
+                        # tainted, replacements created) but its binding
+                        # plan and the queue's in-memory command state
+                        # die with us
+                        _faults.fire("crash_disruption_started")
+                    if command is not None and command.results is not None:
+                        # the command's placements ARE the plan for the
+                        # candidates' pods: route them through the
+                        # binding queue so evicted pods land on the
+                        # planned capacity instead of re-solving from
+                        # scratch (the reference nominates pods onto the
+                        # planned nodes and the provisioner skips
+                        # nominated pods — without this, a fresh solve
+                        # can buy a NEW node for the displaced pods and
+                        # consolidation oscillates: found by the round-5
+                        # seed-11 soak)
+                        self._enqueue_bindings(
+                            command.results, now, COMMAND_BIND_TTL_SECONDS
+                        )
         self.disruption.queue.reconcile(now=now)
 
         with self.profiler.span("termination"):
@@ -366,6 +394,62 @@ class Operator:
             self.nodepool_metrics.reconcile_all(now=now)
             self.status_condition_metrics.reconcile_all(now=now)
 
+    def _skip_disruption_scan(self, now: float) -> bool:
+        """True when this poll's disruption scan provably repeats the
+        last empty-handed one (see the gate's construction in
+        __post_init__). Conservative: any dirt, any catalog movement,
+        any cron-scheduled budget, an active command queue, or an
+        expired force interval runs the scan."""
+        from karpenter_tpu.provisioning.incremental_tick import (
+            _env_float,
+            incremental_enabled,
+        )
+
+        if not incremental_enabled() or not self._disruption_idle:
+            self._disruption_dirty.clear()
+            return False
+        force_s = _env_float("KARPENTER_INCR_DISRUPTION_FORCE_SECONDS", 60.0)
+        if now - self._last_forced_disruption >= force_s:
+            self._last_forced_disruption = now
+            self._disruption_dirty.clear()
+            return False
+        if self.disruption.queue.active:
+            return False
+        dirty = False
+        for kind in ("Node", "NodeClaim", "Pod", "NodePool",
+                     "PodDisruptionBudget"):
+            # drain ALL kinds so one dirty kind doesn't leave the
+            # others' stale keys to mis-trigger a later poll
+            if self._disruption_dirty.drain(kind):
+                dirty = True
+        if self._disruption_dirty.relisted(
+            "Node", "NodeClaim", "Pod", "NodePool", "PodDisruptionBudget"
+        ):
+            dirty = True
+        if dirty:
+            return False
+        # a cron-scheduled budget can open a disruption window with no
+        # watch traffic at all; never skip while one exists
+        for pool in self.kube.node_pools():
+            for budget in pool.spec.disruption.budgets:
+                if budget.schedule is not None or budget.duration is not None:
+                    return False
+        # catalog movement (spot reprice, overlay, ICE) changes
+        # consolidation economics without kube events
+        try:
+            from karpenter_tpu.solver.incremental import catalog_fingerprint
+
+            fp = catalog_fingerprint(self.provisioner.ready_pools_with_types())
+        except Exception:
+            return False
+        if fp != self._disruption_catalog_fp:
+            self._disruption_catalog_fp = fp
+            return False
+        from karpenter_tpu.metrics.store import DISRUPTION_SCAN_SKIPPED
+
+        DISRUPTION_SCAN_SKIPPED.inc()
+        return True
+
     def _recover(self, now: float) -> None:
         """Crash/restart convergence: the first tick rebuilds in-flight
         intent from the API alone. A predecessor's memory — its
@@ -385,6 +469,14 @@ class Operator:
           double-launch window) before any solve can bind onto them.
         """
         self._recovered = True
+        # a crash between ticks must not resurrect a pre-crash
+        # retained-state cache: rebuild the incremental tick's inputs
+        # from the API mirror and force an oracle audit on its first
+        # incremental serve (cheap insurance — this process is fresh,
+        # but recovery may also run after leadership churn where the
+        # informer stream, and thus the dirty sets, had gaps)
+        self.provisioner.incremental.on_recover()
+        OPERATOR_RECOVERY.inc({"action": "incremental_rebuild"})
         readopted = self.lifecycle.adopt_in_flight()
         deleting = sum(
             1 for c in self.kube.node_claims()
@@ -565,6 +657,9 @@ class Operator:
             # crash-recovery status: what the first tick rebuilt from
             # the API ({} until the first tick has run)
             "recovery": dict(self._recovery),
+            # incremental live tick: last oracle-audit verdict,
+            # retained-state fingerprint + age, quarantine state
+            "incremental": self.provisioner.incremental.status(),
             # malformed KARPENTER_FAULTS entries dropped at parse time:
             # a typo'd chaos knob must be visible here (and in
             # karpenter_faults_rejected_total), never silent

@@ -1,0 +1,723 @@
+"""Incremental live tick: the provisioner's retained-state reconcile.
+
+PR 1's `IncrementalPipeline` proved the warm-start math (4.3x on
+50k-pod/1% churn) but lived as a library/bench surface; the live
+reconcile loop still paid O(fleet) per tick — a deep-copied cluster
+snapshot, a fresh `ExistingNodeInput` per node, a topology rebuild
+over every bound pod, and an encode whose pseudo-config axis spanned
+the whole fleet. This module promotes the incremental structure to THE
+operator tick:
+
+- **Retained state**: one `ExistingNodeInput` per live/in-flight node,
+  built by the SAME `NodeInputBuilder` the full Scheduler uses, kept
+  across rounds and refreshed only for keys the kube watch stream
+  marked dirty (`DirtyTracker` with mapped keys: a Pod event dirties
+  the node it is bound to; a NodeClaim event dirties both its claim
+  key and its node). A 410-driven relist marks EVERYTHING dirty — the
+  diff events of a relist cannot prove nothing else changed while the
+  watch was stale, so lost continuity always costs one full rebuild,
+  never a silent stale row.
+
+- **Backstops**: strict eligibility gates route anything the batched
+  fast path cannot express (topology, host ports, volumes, DRA,
+  minValues pools, spot budgets, reservations) to the unchanged full
+  Scheduler; a churn threshold (`KARPENTER_INCR_CHURN_MAX`) does the
+  same when the dirty fraction says incrementality has nothing left to
+  save.
+
+- **Oracle audit**: on a sampled cadence (`KARPENTER_INCR_AUDIT_EVERY`)
+  — and ALWAYS after fault-injector activity, crash recovery, or while
+  on post-quarantine probation — the tick also runs the full Scheduler
+  as a shadow and fingerprints both decision sets. Divergence
+  quarantines the retained state (cleared, encoder cache busted,
+  divergence recorded for replay) and serves the full-solve decision;
+  the next tick rebuilds from scratch and must pass a probation audit
+  before the cache is trusted again. The `incremental_poison`
+  degradation rung (solver/resilience.py) records every quarantined
+  serve, so a poisoned cache degrades to a full solve — never to a
+  wrong fleet.
+
+- **Chaos**: `cache_poison@incremental` (solver/faults.py) corrupts
+  one retained capacity row deterministically; `operator_crash` fires
+  at `crash_incr_solve` (dirty sets drained, solve not yet run) and
+  `crash_incr_commit` (solved, plans not yet written) so the
+  restart-chaos suite can kill the operator inside the incremental
+  tick and assert the rebuilt cache converges.
+
+Decision identity is the design invariant: on eligible ticks the
+encode inputs (same builder, same ordering — live nodes in cluster
+order, in-flight fewest-pods-first — same catalog sort, same residual
+prune that provably preserves first-feasible order) match the full
+Scheduler's, so the audit asserts equality, not a tolerance band.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from dataclasses import replace
+from typing import Callable, Optional, Sequence
+
+from karpenter_tpu.kube.dirty import DirtyTracker
+from karpenter_tpu.kube.objects import Pod
+from karpenter_tpu.metrics.store import (
+    INCREMENTAL_AUDITS,
+    INCREMENTAL_DIVERGENCE,
+    INCREMENTAL_FINGERPRINT_AGE,
+    INCREMENTAL_TICK,
+    SCHEDULER_QUEUE_DEPTH,
+    SCHEDULER_SCHEDULING_DURATION,
+    SCHEDULER_UNSCHEDULABLE_PODS,
+)
+from karpenter_tpu.provisioning.scheduler import (
+    SOLVE_TIMEOUT_SECONDS,
+    NodeInputBuilder,
+    SchedulerResults,
+    _pool_requirements,
+    _state_node_key,
+    finalize_plan,
+    pool_spot_budget,
+)
+from karpenter_tpu.scheduling.hostports import pod_host_ports
+from karpenter_tpu.solver import faults
+from karpenter_tpu.solver.encode import encode, group_pods
+from karpenter_tpu.solver.incremental import (
+    _env_float,
+    catalog_fingerprint,
+)
+from karpenter_tpu.solver.solver import solve_encoded
+from karpenter_tpu.utils import resources as resutil
+
+log = logging.getLogger("karpenter.incremental")
+
+ENV_ENABLE = "KARPENTER_INCREMENTAL"
+ENV_AUDIT_EVERY = "KARPENTER_INCR_AUDIT_EVERY"
+ENV_CHURN_MAX = "KARPENTER_INCR_CHURN_MAX"
+
+# Scheduler error string for unschedulable fast-path pods — must match
+# the full path byte-for-byte (the audit compares error sets)
+NO_CAPACITY_ERROR = "no compatible instance types or nodes"
+
+MAX_DIVERGENCE_RECORDS = 16
+RETRY_ROUNDS = 16  # k-way-evicted re-solve bound, mirrors Scheduler._solve
+
+
+def incremental_enabled() -> bool:
+    """KARPENTER_INCREMENTAL gate, default ON (the live tick is the
+    default path; the env knob is the operator's kill switch)."""
+    return os.environ.get(ENV_ENABLE, "1").lower() not in (
+        "0", "false", "off"
+    )
+
+
+def _pod_node_keys(event: str, pod) -> list[str]:
+    """A Pod event dirties the node the pod is (or was) bound to —
+    its usage row changed. Unbound pods touch no retained row."""
+    return [pod.spec.node_name] if pod.spec.node_name else []
+
+
+def _claim_keys(event: str, claim) -> list[str]:
+    """A NodeClaim event dirties its claim key (the in-flight state
+    key) AND its node's key once one materialized — registration moves
+    the state key from claim name to node name, and both entries must
+    refresh across that transition."""
+    keys = [claim.metadata.name]
+    if claim.status.node_name:
+        keys.append(claim.status.node_name)
+    return keys
+
+
+def decision_fingerprint(results: SchedulerResults) -> tuple:
+    """Name-insensitive identity of one scheduling decision: what the
+    oracle audit diffs between the incremental and full paths. New
+    plans are identified by (pool, resolved launch target, price, pod
+    set); existing assignments by (state key, pod set); failures by
+    (pod key, reason)."""
+    new = []
+    for plan in results.new_node_plans:
+        it, off = plan.primary()
+        new.append((
+            plan.pool.metadata.name if plan.pool is not None else "",
+            it.name if it is not None else "",
+            (off.zone, off.capacity_type) if off is not None else ("", ""),
+            round(float(plan.price), 6),
+            tuple(sorted(p.key for p in plan.pods)),
+        ))
+    existing = sorted(
+        (key, tuple(sorted(p.key for p in pods)))
+        for key, pods in results.existing_assignments.items()
+    )
+    return (
+        tuple(sorted(new)),
+        tuple(existing),
+        tuple(sorted(results.errors.items())),
+    )
+
+
+class IncrementalTickScheduler:
+    """The provisioner's retained-state solve seam (see module doc).
+
+    `tick(pods, pools_with_types)` returns SchedulerResults when the
+    incremental path served (or the quarantine path served the
+    full-solve decision), or None when the caller must route through
+    the full Scheduler (ineligible tick / churn blow-out)."""
+
+    def __init__(
+        self,
+        kube,
+        cluster,
+        compat_cache,
+        make_scheduler: Callable,
+        options=None,
+        clock=None,
+    ):
+        self.kube = kube
+        self.cluster = cluster
+        self.cache = compat_cache
+        # factory(pools_with_types, metrics_controller) -> Scheduler —
+        # the provisioner's own full-path construction, reused verbatim
+        # for the shadow oracle so the audit compares against exactly
+        # what the fallback path would have decided
+        self._make_scheduler = make_scheduler
+        self.options = options
+        self.clock = clock if clock is not None else time.monotonic
+        self.churn_max = _env_float(ENV_CHURN_MAX, 0.25)
+        self.audit_every = int(_env_float(ENV_AUDIT_EVERY, 16))
+        self._tracker = DirtyTracker(kube)
+        self._tracker.watch("Node")
+        self._tracker.watch("NodeClaim", key=_claim_keys)
+        self._tracker.watch("Pod", key=_pod_node_keys)
+        # any DaemonSet change invalidates every node's daemon reserve
+        # and the per-pool overhead: one sentinel key = rebuild all
+        self._tracker.watch("DaemonSet", key=lambda e, o: ["*"])
+        # retained state
+        self._inputs: dict = {}            # state key -> ExistingNodeInput
+        self._order: list[str] = []        # Scheduler's existing-node order
+        self._builder: Optional[NodeInputBuilder] = None
+        self._builder_fp: Optional[tuple] = None
+        self._daemon_overhead: dict = {}
+        self._catalog_has_reserved = False
+        # audit / quarantine state
+        self._ticks = 0
+        self._since_audit = 0
+        self._age = 0                      # ticks since last full rebuild
+        self._quarantined = False
+        self._warm_pending = False   # cold bail taken; next tick warms
+        self._force_audit: Optional[str] = None   # pending trigger
+        self._last_fault_len = 0
+        self._last_audit: dict = {}
+        self.divergences: list[dict] = []
+        self._counts = {"incremental": 0, "full_backstop": 0,
+                        "quarantined": 0}
+
+    # -- external triggers ----------------------------------------------------
+
+    def on_recover(self) -> None:
+        """Crash-recovery hook (Operator._recover): a predecessor's
+        retained state died with it, and whatever THIS process has
+        accumulated before recovery ran cannot be vouched for either.
+        Rebuild from scratch and audit the first incremental tick."""
+        self._invalidate(trigger="recovery")
+
+    def _invalidate(self, trigger: str) -> None:
+        self._inputs.clear()
+        self._order = []
+        if self._builder is not None:
+            self._builder = None
+            self._builder_fp = None
+        self._tracker.clear()
+        self._force_audit = trigger
+        self._age = 0
+
+    # -- tick -----------------------------------------------------------------
+
+    def tick(
+        self, pods: Sequence[Pod], pools_with_types,
+    ) -> Optional[SchedulerResults]:
+        if not incremental_enabled():
+            return None
+        t0 = self.clock()
+        self._ticks += 1
+        # fault-injector activity since the last tick distrusts the
+        # retained state enough to force an audit: injected kube
+        # faults (conflicts, stale lists, watch drops) are exactly the
+        # conditions under which dirty-set plumbing can miss a change
+        inj = faults.get()
+        fault_len = len(inj.snapshot_log()) if inj is not None else 0
+        if fault_len != self._last_fault_len:
+            self._last_fault_len = fault_len
+            if self._force_audit is None:
+                self._force_audit = "fault"
+
+        reason = self._ineligible(pods, pools_with_types)
+        if reason is not None:
+            INCREMENTAL_TICK.inc({"path": "full_backstop", "reason": reason})
+            self._counts["full_backstop"] += 1
+            return None
+
+        pools = self._sorted_pools(pools_with_types)
+        cold = not self._inputs
+        if (
+            cold
+            and not self._warm_pending
+            # a quarantined (probation) or forced-audit tick must
+            # rebuild AND audit now — deferring a tick would leave an
+            # unaudited window after recovery/divergence
+            and not self._quarantined
+            and self._force_audit is None
+            and any(not sn.deleting() for sn in self.cluster.nodes())
+        ):
+            # Cold cache against a live fleet: building every retained
+            # input AND paying the full Scheduler's own per-node build
+            # in one tick would double the first tick's cost — bail to
+            # the full path untouched (<5% cold overhead is a
+            # perf-floor guarantee) and warm on the NEXT tick, whose
+            # sync is the one-time O(fleet) rebuild.
+            self._warm_pending = True
+            INCREMENTAL_TICK.inc({"path": "full_backstop",
+                                  "reason": "cold"})
+            self._counts["full_backstop"] += 1
+            return None
+        self._warm_pending = False
+        churn = self._sync(pools)
+        # the poison site fires AFTER sync so a corrupted row is not
+        # immediately rebuilt away — the audit must catch it instead
+        self._consume_poison()
+        # crash window: dirty sets drained (their marks are GONE from
+        # the tracker), solve not yet run — a restart must rebuild the
+        # cache from the API, not resurrect the drained delta
+        faults.fire("crash_incr_solve")
+        if pods and not cold and churn > self.churn_max and (
+            not self._quarantined
+        ):
+            INCREMENTAL_TICK.inc({"path": "full_backstop",
+                                  "reason": "churn"})
+            self._counts["full_backstop"] += 1
+            return None
+
+        from karpenter_tpu.solver import resilience
+
+        resilience.pop_degraded()  # scope the report to THIS solve
+        results, fallback = self._solve(pods, pools)
+        degraded = resilience.pop_degraded()
+        if results is not None and degraded:
+            log.warning(
+                "incremental solve served degraded via rung(s) %s",
+                sorted(set(degraded)),
+            )
+            results.degraded_rungs = sorted(set(degraded))
+        if results is None:
+            # the solve left pods only the relaxation ladder can help:
+            # hand the whole tick to the full path
+            INCREMENTAL_TICK.inc({"path": "full_backstop",
+                                  "reason": fallback})
+            self._counts["full_backstop"] += 1
+            return None
+
+        self._since_audit += 1
+        audit_trigger = self._audit_trigger(pods)
+        if audit_trigger is not None:
+            ok, shadow = self._audit(pods, pools_with_types, results,
+                                     audit_trigger)
+            if not ok:
+                # serve the full-solve decision; retained state is
+                # already quarantined by _audit. The tick degraded
+                # through the ladder's incremental_poison rung — make
+                # that visible the same way backend degradations are.
+                shadow.degraded_rungs = sorted(
+                    set(shadow.degraded_rungs) | {"incremental_poison"}
+                )
+                faults.fire("crash_incr_commit")
+                self._publish_solver_metrics(shadow, t0)
+                INCREMENTAL_TICK.inc({"path": "quarantined",
+                                      "reason": audit_trigger})
+                self._counts["quarantined"] += 1
+                return shadow
+            if self._quarantined:
+                log.info("incremental cache leaves quarantine: "
+                         "probation audit passed")
+                self._quarantined = False
+
+        self._age += 1
+        INCREMENTAL_FINGERPRINT_AGE.set(float(self._age))
+        # crash window: solved, plans not yet handed back for
+        # NodeClaim writes
+        faults.fire("crash_incr_commit")
+        self._publish_solver_metrics(results, t0)
+        INCREMENTAL_TICK.inc({
+            "path": "incremental",
+            "reason": "audited" if audit_trigger is not None else "steady",
+        })
+        self._counts["incremental"] += 1
+        return results
+
+    def _publish_solver_metrics(self, results: SchedulerResults,
+                                t0: float) -> None:
+        """Scheduler-subsystem series parity: dashboards watching
+        controller="provisioner" must keep reading the live solve no
+        matter which path served it."""
+        labels = {"controller": "provisioner"}
+        SCHEDULER_SCHEDULING_DURATION.observe(self.clock() - t0, labels)
+        SCHEDULER_QUEUE_DEPTH.set(0.0, labels)
+        SCHEDULER_UNSCHEDULABLE_PODS.set(float(len(results.errors)), labels)
+
+    # -- eligibility ----------------------------------------------------------
+
+    def _ineligible(self, pods, pools_with_types) -> Optional[str]:
+        """First reason this tick cannot ride the retained-state fast
+        path, or None. Every gate here names machinery only the full
+        Scheduler implements — the audit's equality claim holds only
+        inside this envelope."""
+        from karpenter_tpu.utils.pod import has_dra_requirements
+
+        for pod in pods:
+            spec = pod.spec
+            if spec.volumes or spec.injected_requirements:
+                return "volumes"
+            if pod_host_ports(pod):
+                return "host_ports"
+            if spec.topology_spread_constraints:
+                return "topology"
+            aff = spec.affinity
+            if aff is not None and (aff.pod_affinity or aff.pod_anti_affinity):
+                return "topology"
+            if has_dra_requirements(pod):
+                return "dra"
+        if self.cluster.pods_with_anti_affinity():
+            # live pods with required anti-affinity repel matching new
+            # pods — only the Topology tracker models that
+            return "anti_affinity"
+        has_reserved = False
+        for pool, types in pools_with_types:
+            if _pool_requirements(pool).has_min_values():
+                return "min_values"
+            if pool_spot_budget(pool) != (1.0, 0):
+                return "spot_budget"
+            if not has_reserved:
+                has_reserved = any(
+                    o.is_reserved() for it in types for o in it.offerings
+                )
+        if has_reserved:
+            # reservation budgets need the live reserved_in_use ledger
+            return "reserved"
+        return None
+
+    @staticmethod
+    def _sorted_pools(pools_with_types):
+        # weight order, exactly as Scheduler.__init__ sorts
+        return sorted(
+            pools_with_types,
+            key=lambda pt: (-pt[0].spec.weight, pt[0].metadata.name),
+        )
+
+    # -- retained-state sync --------------------------------------------------
+
+    def _sync(self, pools) -> float:
+        """Refresh the retained inputs from cluster state, O(dirty).
+        Returns the churn fraction (rebuilt rows / fleet)."""
+        rebuild_all = self._tracker.relisted(
+            "Node", "NodeClaim", "Pod", "DaemonSet"
+        )
+        if self._tracker.drain("DaemonSet"):
+            rebuild_all = True
+        dirty = (
+            self._tracker.drain("Node")
+            | self._tracker.drain("NodeClaim")
+            | self._tracker.drain("Pod")
+        )
+        fp = catalog_fingerprint(pools)
+        if rebuild_all or fp != self._builder_fp or self._builder is None:
+            # catalog moved (price flip, pool edit, type rebuild): the
+            # builder pins the types it resolves min-admissible
+            # allocatable from, and the per-pool daemon overhead hangs
+            # off the pool templates — rebuild both. Retained NODE
+            # inputs survive: they derive from node labels/usage, not
+            # prices. rebuild_all (DaemonSet churn or a relist) must
+            # ALSO rebuild the builder: it pins the daemonset list it
+            # computes per-node reserves and per-pool overhead from,
+            # and the catalog fingerprint cannot see daemonsets move.
+            daemonsets = self.cluster.daemonsets()
+            self._builder = NodeInputBuilder(
+                pools, daemonsets,
+                self.options.ignore_dra_requests
+                if self.options is not None else True,
+            )
+            self._builder_fp = fp
+            self._daemon_overhead = self._builder.daemon_overhead()
+        if rebuild_all:
+            self._inputs.clear()
+            self._age = 0
+
+        rebuilt = 0
+        live: list[str] = []
+        inflight: list[tuple[tuple, str]] = []
+        seen: set[str] = set()
+        for sn in self.cluster.nodes():
+            if sn.deleting():
+                continue
+            key = _state_node_key(sn)
+            if not key:
+                continue
+            seen.add(key)
+            # in-flight/unlaunched entries are few and transition-heavy
+            # (claim -> node identity, registration filling status):
+            # rebuild them every tick instead of chasing edge cases.
+            # Their rebuilds do NOT count toward churn — a scale-up
+            # burst with many in-flight claims is exactly when the
+            # incremental path saves the most, and counting the
+            # always-rebuilt volatile rows would wedge it on the
+            # churn backstop for the whole materialization window.
+            volatile = sn.node is None or not sn.registered()
+            if key not in self._inputs or key in dirty or volatile:
+                self._builder.invalidate(key)
+                self._inputs[key] = self._builder.existing_input(sn)
+                if not volatile:
+                    rebuilt += 1
+            if sn.initialized():
+                live.append(key)
+            else:
+                inflight.append(((len(sn.pod_keys), sn.name), key))
+        for key in [k for k in self._inputs if k not in seen]:
+            del self._inputs[key]
+            self._builder.invalidate(key)
+        inflight.sort()
+        self._order = live + [key for _, key in inflight]
+        return rebuilt / max(1, len(self._inputs))
+
+    def _consume_poison(self) -> None:
+        try:
+            faults.fire("incremental")
+        except faults.CachePoisonError as err:
+            if not self._inputs:
+                log.warning("cache_poison fired on an empty retained "
+                            "state; nothing to corrupt (%s)", err)
+                return
+            victim = min(self._inputs)
+            inp = self._inputs[victim]
+            # phantom capacity: the corrupted row looks roomy, so the
+            # incremental solve places pods the full solve would buy a
+            # node for — a real stale-cache failure mode, deterministic
+            self._inputs[victim] = replace(
+                inp,
+                available=resutil.merge(
+                    inp.available, {"cpu": 1024.0, "memory": 2.0**42}
+                ),
+            )
+            log.warning("fault injected: %s (corrupted retained row %s)",
+                        err, victim)
+            if self._force_audit is None:
+                self._force_audit = "fault"
+
+    # -- solve ----------------------------------------------------------------
+
+    def _solve(
+        self, pods: Sequence[Pod], pools,
+    ) -> tuple[Optional[SchedulerResults], str]:
+        """The batched fast path against the retained inputs. Returns
+        (results, "") or (None, reason) when only the full path's
+        relaxation ladder can finish the tick."""
+        results = SchedulerResults(new_node_plans=[],
+                                   existing_assignments={})
+        if not pods:
+            return results, ""
+        work = dict(self._inputs)   # per-tick view; commits copy-on-write
+        open_plans: list = []
+        place = list(pods)
+        still_failed: list[Pod] = []
+        # same wall budget the full Scheduler's _solve enforces; a
+        # blown budget hands the WHOLE tick to the full path, which
+        # owns the TIMEOUT_ERROR semantics (stamping partial timeouts
+        # here would make the audit's fingerprint comparison racy)
+        deadline = self.clock() + SOLVE_TIMEOUT_SECONDS
+        for _ in range(1 + RETRY_ROUNDS):
+            if not place:
+                break
+            if self.clock() > deadline:
+                return None, "timeout"
+            groups = group_pods(place)
+            chosen = self._pruned_keys(groups, work)
+            enc = encode(
+                groups, pools,
+                [work[k] for k in chosen],
+                self._daemon_overhead,
+                compat_cache=self.cache,
+            )
+            sol = solve_encoded(enc)
+            for a in sol.existing:
+                key = chosen[a.existing_index]
+                results.existing_assignments.setdefault(key, []).extend(
+                    a.pods
+                )
+                inp = work[key]
+                usage = resutil.requests_for_pods(a.pods)
+                work[key] = replace(
+                    inp,
+                    available=resutil.positive(
+                        resutil.subtract(inp.available, usage)
+                    ),
+                    pod_count=inp.pod_count + len(a.pods),
+                )
+                # the committed row is provisional until the pods bind;
+                # rebuild it from cluster truth next tick
+                self._tracker.mark("Node", key)
+            open_plans.extend(sol.new_nodes)
+            evicted_keys = {p.key for p in sol.evicted}
+            still_failed.extend(
+                p for p in sol.unschedulable if p.key not in evicted_keys
+            )
+            # k-way-evicted pods are schedulable alone: retry them
+            # against the committed state (mirrors Scheduler._solve)
+            place = list(sol.evicted)
+        still_failed.extend(place)  # retry bound hit
+
+        for pod in still_failed:
+            aff = pod.spec.affinity
+            if aff is not None and aff.node_affinity is not None:
+                # the relaxation ladder could still place this pod
+                # (drop preferred terms / trailing OR-terms) — that
+                # machinery lives only in the full Scheduler
+                return None, "relaxation"
+            results.errors[pod.key] = NO_CAPACITY_ERROR
+
+        for plan in open_plans:
+            finalize_plan(plan)
+            results.new_node_plans.append(plan)
+        return results, ""
+
+    def _pruned_keys(self, groups, work: dict) -> list[str]:
+        """Residual prune (exact, from IncrementalPipeline): a node
+        below the componentwise MINIMUM request over keys EVERY group
+        demands can hold none of them, and nodes only fill during a
+        solve — dropping it preserves first-feasible order while
+        shrinking the bound axis to nodes with real headroom. Survivors
+        keep `self._order` — the Scheduler's existing-node axis order
+        (live nodes in cluster order, in-flight fewest-pods-first) —
+        so placements stay byte-identical with the full path's."""
+        min_req: dict[str, float] = {}
+        req_counts: dict[str, int] = {}
+        for g in groups:
+            for k, v in g.resources.items():
+                if v <= 0:
+                    continue
+                req_counts[k] = req_counts.get(k, 0) + 1
+                have = min_req.get(k)
+                min_req[k] = v if have is None else min(have, v)
+        min_req = {
+            k: v for k, v in min_req.items()
+            if req_counts[k] == len(groups)
+        }
+        out = []
+        for key in self._order:
+            inp = work.get(key)
+            if inp is None:
+                continue
+            if any(
+                inp.available.get(k, 0.0) < v for k, v in min_req.items()
+            ):
+                continue
+            out.append(key)
+        return out
+
+    # -- oracle audit ---------------------------------------------------------
+
+    def _audit_trigger(self, pods) -> Optional[str]:
+        if not pods:
+            return None   # empty decisions compare trivially equal
+        if self._quarantined:
+            return "probation"
+        if self._force_audit is not None:
+            trigger = self._force_audit
+            self._force_audit = None
+            return trigger
+        if self.audit_every > 0 and self._since_audit >= self.audit_every:
+            return "cadence"
+        return None
+
+    def _audit(
+        self, pods, pools_with_types, results: SchedulerResults,
+        trigger: str,
+    ) -> tuple[bool, SchedulerResults]:
+        """Shadow full solve + decision fingerprint diff. On
+        divergence: quarantine the retained state, record the episode
+        for replay, and hand back the shadow decision."""
+        self._since_audit = 0
+        shadow = self._make_scheduler(
+            pools_with_types, "incremental_audit"
+        ).solve(list(pods))
+        want = decision_fingerprint(shadow)
+        got = decision_fingerprint(results)
+        ok = want == got
+        self._last_audit = {
+            "verdict": "ok" if ok else "divergence",
+            "trigger": trigger,
+            "tick": self._ticks,
+        }
+        INCREMENTAL_AUDITS.inc(
+            {"verdict": self._last_audit["verdict"], "trigger": trigger}
+        )
+        if ok:
+            return True, shadow
+        INCREMENTAL_DIVERGENCE.inc()
+        inj = faults.get()
+        record = {
+            "tick": self._ticks,
+            "trigger": trigger,
+            "incremental": got,
+            "full": want,
+            # the fired-fault log up to the divergence: replaying the
+            # same spec + seed + workload reproduces this episode
+            # byte-identically (FaultInjector.snapshot_log)
+            "fault_log": inj.snapshot_log() if inj is not None else [],
+        }
+        self.divergences.append(record)
+        del self.divergences[:-MAX_DIVERGENCE_RECORDS]
+        log.error(
+            "incremental oracle audit diverged (trigger=%s); "
+            "quarantining retained state and serving the full-solve "
+            "decision", trigger,
+        )
+        from karpenter_tpu.solver import resilience
+
+        resilience.note_incremental_poison()
+        self._quarantined = True
+        self._invalidate(trigger="quarantine")
+        # probation (the _quarantined gate) owns the follow-up audits;
+        # leaving the force flag set would fire one extra shadow solve
+        # AFTER probation clears, with a trigger label outside the
+        # metric's documented set
+        self._force_audit = None
+        self.cache.invalidate()
+        return False, shadow
+
+    # -- observability --------------------------------------------------------
+
+    def state_fingerprint(self) -> str:
+        """Stable hash of the retained inputs — readyz surfaces it so
+        two replicas (or a pre/post-restart pair) can be compared."""
+        import hashlib
+
+        rows = sorted(
+            (
+                key,
+                inp.pool_name,
+                inp.pod_count,
+                tuple(sorted(
+                    (k, round(v, 6)) for k, v in inp.available.items()
+                )),
+            )
+            for key, inp in self._inputs.items()
+        )
+        return hashlib.sha256(repr(rows).encode()).hexdigest()
+
+    def status(self) -> dict:
+        return {
+            "enabled": incremental_enabled(),
+            "quarantined": self._quarantined,
+            "retained_nodes": len(self._inputs),
+            "fingerprint": self.state_fingerprint(),
+            "fingerprint_age_ticks": self._age,
+            "last_audit": dict(self._last_audit),
+            "divergences": len(self.divergences),
+            "ticks": dict(self._counts),
+        }

@@ -134,6 +134,22 @@ class Provisioner:
 
         self.encode_cache = EncodedCache()
         self._catalog_dirty = DirtyTracker(kube).watch("NodePool")
+        # Incremental live tick (the default reconcile path): retained
+        # per-node solver inputs synced O(dirty) from the watch stream,
+        # with a shadow full-solve oracle audit and quarantine-on-
+        # divergence. Ineligible ticks (topology, volumes, minValues,
+        # spot budgets, reservations, churn blow-outs) fall through to
+        # the unchanged full Scheduler below. KARPENTER_INCREMENTAL=0
+        # disables it entirely.
+        from karpenter_tpu.provisioning.incremental_tick import (
+            IncrementalTickScheduler,
+        )
+
+        self.incremental = IncrementalTickScheduler(
+            kube, cluster, self.encode_cache,
+            make_scheduler=self._make_scheduler,
+            options=options, clock=self.clock,
+        )
 
     # -- pod intake (provisioner.go:172-195, utils/node) ----------------------
 
@@ -206,14 +222,13 @@ class Provisioner:
                 pools.append((pool, types))
         return pools
 
-    def schedule(self, extra_pods: Sequence[Pod] = ()) -> SchedulerResults:
-        pods = list(extra_pods) or (
-            self.get_pending_pods() + self.reschedulable_pods_from_deleting_nodes()
-        )
-        if self._catalog_dirty.drain("NodePool"):
-            self.encode_cache.invalidate()
-        pools = self.ready_pools_with_types()
-        scheduler = Scheduler(
+    def _make_scheduler(self, pools, metrics_controller: str = "provisioner"
+                        ) -> Scheduler:
+        """The full-path Scheduler construction — one seam shared by
+        the live reconcile fallback and the incremental tick's shadow
+        oracle audit, so the audit compares against exactly what the
+        fallback would have decided."""
+        return Scheduler(
             pools_with_types=pools,
             state_nodes=self.cluster.deep_copy_nodes(),
             daemonsets=self.cluster.daemonsets(),
@@ -233,8 +248,25 @@ class Provisioner:
             ),
             clock=self.clock,
             compat_cache=self.encode_cache,
+            metrics_controller=metrics_controller,
         )
-        results = scheduler.solve(pods)
+
+    def schedule(self, extra_pods: Sequence[Pod] = ()) -> SchedulerResults:
+        pods = list(extra_pods) or (
+            self.get_pending_pods() + self.reschedulable_pods_from_deleting_nodes()
+        )
+        if self._catalog_dirty.drain("NodePool"):
+            self.encode_cache.invalidate()
+        pools = self.ready_pools_with_types()
+        # the incremental live tick is the default path; it returns
+        # None for ticks outside its envelope (explicit extra_pods are
+        # a caller-scripted solve, not the live reconcile)
+        if not extra_pods:
+            results = self.incremental.tick(pods, pools)
+            if results is not None:
+                self.cluster.mark_pod_scheduling_decisions(pods)
+                return results
+        results = self._make_scheduler(pools).solve(pods)
         self.cluster.mark_pod_scheduling_decisions(pods)
         return results
 

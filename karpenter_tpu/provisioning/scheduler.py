@@ -197,6 +197,232 @@ def pool_spot_budget(pool: NodePool) -> tuple[float, int]:
     return (min(frac, 1.0), floor)
 
 
+class NodeInputBuilder:
+    """Shared builder of solver inputs from cluster state: existing/
+    in-flight node inputs and daemonset overhead/reservations.
+
+    Extracted from Scheduler so the provisioner's incremental live
+    tick (provisioning/incremental_tick.py) derives its RETAINED
+    per-node inputs through the exact same code path the full
+    Scheduler uses per round — the two paths cannot drift, which is
+    what makes the incremental-vs-full oracle audit a meaningful
+    equality check instead of a tolerance band."""
+
+    def __init__(
+        self,
+        pools_with_types: Sequence[tuple[NodePool, Sequence[InstanceType]]],
+        daemonsets: Sequence = (),
+        ignore_dra_requests: bool = True,
+    ):
+        self.pools_with_types = list(pools_with_types)
+        self.daemonsets = list(daemonsets)
+        self.ignore_dra_requests = ignore_dra_requests
+        # per-node daemon reservation, memoized: invariant within a
+        # scheduling round, but existing_input re-runs per committed
+        # pod on the slow path. The live tick invalidates per key when
+        # a node's watch events mark it dirty.
+        self._daemon_reserve_cache: dict[str, dict[str, float]] = {}
+
+    def invalidate(self, key: str) -> None:
+        """Drop one node's memoized daemon reservation (the node's
+        taints/labels/daemon pods changed)."""
+        self._daemon_reserve_cache.pop(key, None)
+
+    def existing_input(self, node: StateNode) -> ExistingNodeInput:
+        reqs = Requirements.from_labels(node.labels())
+        if node.node_claim is not None and not node.registered():
+            for spec in node.node_claim.spec.requirements:
+                reqs.add(Requirement(spec.key, spec.operator, spec.values,
+                                     spec.min_values))
+        available = resutil.positive(node.available())
+        claim = node.node_claim
+        if (
+            node.node is None
+            and claim is not None
+            and not claim.status.allocatable
+        ):
+            # no REAL allocatable yet = the provider hasn't launched
+            # (creation stamps only the plan's expected capacity); a
+            # launched-but-full node has allocatable set and correctly
+            # reports empty `available` above.
+            # A claim created but not yet LAUNCHED has no
+            # status.capacity: model it from its admissible instance
+            # types like the reference's in-flight NodeClaim scheduling
+            # nodes (scheduler.go builds them from instanceTypeOptions)
+            # — otherwise pods freed by a disruption command can't land
+            # on the command's own replacement and the provisioner buys
+            # duplicate capacity (suite_test.go:454). The MINIMUM
+            # allocatable across admissible types is conservative:
+            # whatever type the launch resolves can hold what we place.
+            # (Gated on the claim being truly unlaunched — a launched,
+            # full node legitimately has empty `available`.)
+            available = resutil.positive(
+                resutil.subtract(
+                    self._min_admissible_allocatable(node, reqs), node.used()
+                )
+            )
+        reserve = self.daemon_reserve(node)
+        if reserve:
+            available = resutil.positive(
+                resutil.subtract(available, reserve)
+            )
+        return ExistingNodeInput(
+            name=_state_node_key(node),
+            requirements=reqs,
+            taints=tuple(node.taints()),
+            available=available,
+            pool_name=node.nodepool_name(),
+            pod_count=len(node.pod_keys),
+        )
+
+    def _min_admissible_allocatable(
+        self, node: StateNode, reqs: Requirements
+    ) -> ResourceList:
+        """Component-wise minimum allocatable over the pool's instance
+        types compatible with `reqs` (the caller's labels+claim
+        requirements) — the floor of what the launch can
+        materialize."""
+        floor: ResourceList = {}
+        for pool, types in self.pools_with_types:
+            if pool.metadata.name != node.nodepool_name():
+                continue
+            for it in types:
+                if it.requirements.intersects(reqs) is not None:
+                    continue
+                alloc = it.allocatable
+                if not floor:
+                    floor = dict(alloc)
+                else:
+                    floor = {
+                        k: min(v, alloc.get(k, 0.0))
+                        for k, v in floor.items()
+                    }
+        return floor
+
+    def _daemon_expected(
+        self, node_reqs: Requirements, taints: list
+    ) -> dict[str, float]:
+        """Total requests of daemonsets whose pods can land on a node
+        with these taints/labels (isDaemonPodCompatibleWithNode,
+        scheduler.go:708-717) — the one filter shared by new-node
+        overhead budgeting and existing-node reservation."""
+        from karpenter_tpu.utils.pod import has_dra_requirements
+
+        expected: dict[str, float] = {}
+        for ds in self.daemonsets:
+            pod = Pod(spec=ds.spec.template.spec)
+            pod.metadata.labels = dict(ds.spec.template.metadata.labels)
+            # a DRA daemon pod can never be scheduled by us, so its
+            # requests must not inflate any budget
+            # (shouldSkipDaemonPod, scheduler.go:702-705)
+            if self.ignore_dra_requests and has_dra_requirements(pod):
+                continue
+            if tolerates_pod(taints, pod) is not None:
+                continue
+            if not self._daemon_compatible(node_reqs, pod):
+                continue
+            expected = resutil.merge(expected, resutil.pod_requests(pod))
+        return expected
+
+    def _daemon_compatible(self, node_reqs: Requirements, pod: Pod) -> bool:
+        """Daemon-pod schedulability against a node/template: required
+        node-affinity terms are ORed — ANY matching term admits the
+        pod (the kube-scheduler semantic the reference's per-term check
+        follows) — and hostname affinity is dropped first: a daemonset
+        pinned to an EXISTING node's hostname says nothing about new
+        capacity (suite_test.go "remove daemonset node hostname
+        affinity when considering daemonset schedulability")."""
+        base = Requirements.from_labels(dict(pod.spec.node_selector))
+        if pod.spec.injected_requirements:
+            base.add(*pod.spec.injected_requirements)
+        aff = pod.spec.affinity
+        terms = ()
+        if aff is not None and aff.node_affinity is not None:
+            terms = aff.node_affinity.required or ()
+        if not terms:
+            return node_reqs.is_compatible(
+                base, allow_undefined=WELL_KNOWN_LABELS
+            )
+        for term in terms:
+            reqs = Requirements(r.copy() for r in base)
+            reqs.add(*(
+                r
+                for r in Requirements.from_node_selector_requirements(
+                    term.match_expressions
+                ).values()
+                if r.key != HOSTNAME_LABEL
+            ))
+            if node_reqs.is_compatible(
+                reqs, allow_undefined=WELL_KNOWN_LABELS
+            ):
+                return True
+        return False
+
+    def daemon_reserve(self, node: StateNode) -> dict[str, float]:
+        """Capacity still owed to daemonsets on this node: the
+        requests of every daemonset whose pods CAN land here, minus
+        daemon pods already bound, floored at zero (unexpected daemon
+        pods must not push the reservation negative) —
+        existingnode.go:41-52, scheduler.go isDaemonPodCompatibleWithNode.
+        """
+        if not self.daemonsets or not node.managed():
+            return {}
+        cache_key = _state_node_key(node)
+        cached = self._daemon_reserve_cache.get(cache_key)
+        if cached is not None:
+            return cached
+        expected = self._daemon_expected(
+            Requirements.from_labels(node.labels()), list(node.taints())
+        )
+        # net of daemon pods already bound to the node — cluster state
+        # tracks these (terminal pods excluded) so the reservation is
+        # not re-derived from the raw pod list
+        reserve = (
+            resutil.positive(resutil.subtract(expected, node.daemon_usage))
+            if expected
+            else {}
+        )
+        self._daemon_reserve_cache[cache_key] = reserve
+        return reserve
+
+    def daemon_overhead(self) -> dict[str, dict[str, float]]:
+        """Per-pool daemonset resource overhead (scheduler.go:772-803):
+        sum requests of daemon pods whose scheduling terms admit the
+        pool template. Uses the same full-compatibility filter
+        (undefined-key rules included) as the existing-node
+        reservation, via _daemon_expected."""
+        from karpenter_tpu.solver.encode import pool_template_requirements
+
+        out: dict[str, dict[str, float]] = {}
+        for pool, types in self.pools_with_types:
+            total = self._daemon_expected(
+                pool_template_requirements(pool, with_pool_pin=True),
+                list(pool.spec.template.spec.taints),
+            )
+            if total:
+                out[pool.metadata.name] = total
+        return out
+
+
+def finalize_plan(plan: NodePlan) -> None:
+    """Price-order and truncate instance types, honoring the pool's
+    minValues floors (results.TruncateInstanceTypes,
+    provisioner.go:374; types.go:322-334). Module-level: the full
+    Scheduler and the incremental live tick finalize through the same
+    function."""
+    pool_reqs = _pool_requirements(plan.pool)
+    try:
+        plan.instance_types = truncate(
+            plan.instance_types, pool_reqs, MAX_INSTANCE_TYPES
+        )
+    except ValueError:
+        # truncation cannot keep the minValues floor —
+        # _enforce_min_values decides reject (Strict) vs relax
+        plan.instance_types = truncate(
+            plan.instance_types, Requirements(), MAX_INSTANCE_TYPES
+        )
+
+
 class Scheduler:
     def __init__(
         self,
@@ -284,10 +510,11 @@ class Scheduler:
         self.daemonsets = list(daemonsets)
         self.cluster_pods = list(cluster_pods)
 
-        # per-node daemon reservation, memoized: invariant within a
-        # scheduling round, but _existing_input re-runs per committed
-        # pod on the slow path
-        self._daemon_reserve_cache: dict[str, dict[str, float]] = {}
+        # existing-node input + daemon machinery shared with the
+        # incremental live tick (see NodeInputBuilder)
+        self.input_builder = NodeInputBuilder(
+            self.pools_with_types, self.daemonsets, self.ignore_dra_requests
+        )
 
         # existing first, then in-flight fewest-pods-first (scheduler.go:552)
         live = [n for n in state_nodes if not n.deleting() and n.initialized()]
@@ -327,7 +554,7 @@ class Scheduler:
                             o.reservation_capacity,
                         )
 
-        self.daemon_overhead = self._daemon_overhead()
+        self.daemon_overhead = self.input_builder.daemon_overhead()
         self.topology = self._build_topology()
 
         # per-node host-port reservations from live pods
@@ -358,74 +585,7 @@ class Scheduler:
     # -- construction helpers -------------------------------------------------
 
     def _existing_input(self, node: StateNode) -> ExistingNodeInput:
-        reqs = Requirements.from_labels(node.labels())
-        if node.node_claim is not None and not node.registered():
-            for spec in node.node_claim.spec.requirements:
-                reqs.add(Requirement(spec.key, spec.operator, spec.values, spec.min_values))
-        available = resutil.positive(node.available())
-        claim = node.node_claim
-        if (
-            node.node is None
-            and claim is not None
-            and not claim.status.allocatable
-        ):
-            # no REAL allocatable yet = the provider hasn't launched
-            # (creation stamps only the plan's expected capacity); a
-            # launched-but-full node has allocatable set and correctly
-            # reports empty `available` above.
-            # A claim created but not yet LAUNCHED has no
-            # status.capacity: model it from its admissible instance
-            # types like the reference's in-flight NodeClaim scheduling
-            # nodes (scheduler.go builds them from instanceTypeOptions)
-            # — otherwise pods freed by a disruption command can't land
-            # on the command's own replacement and the provisioner buys
-            # duplicate capacity (suite_test.go:454). The MINIMUM
-            # allocatable across admissible types is conservative:
-            # whatever type the launch resolves can hold what we place.
-            # (Gated on the claim being truly unlaunched — a launched,
-            # full node legitimately has empty `available`.)
-            available = resutil.positive(
-                resutil.subtract(
-                    self._min_admissible_allocatable(node, reqs), node.used()
-                )
-            )
-        reserve = self._daemon_reserve(node)
-        if reserve:
-            available = resutil.positive(
-                resutil.subtract(available, reserve)
-            )
-        return ExistingNodeInput(
-            name=_state_node_key(node),
-            requirements=reqs,
-            taints=tuple(node.taints()),
-            available=available,
-            pool_name=node.nodepool_name(),
-            pod_count=len(node.pod_keys),
-        )
-
-    def _min_admissible_allocatable(
-        self, node: StateNode, reqs: Requirements
-    ) -> ResourceList:
-        """Component-wise minimum allocatable over the pool's instance
-        types compatible with `reqs` (the caller's labels+claim
-        requirements) — the floor of what the launch can
-        materialize."""
-        floor: ResourceList = {}
-        for pool, types in self.pools_with_types:
-            if pool.metadata.name != node.nodepool_name():
-                continue
-            for it in types:
-                if it.requirements.intersects(reqs) is not None:
-                    continue
-                alloc = it.allocatable
-                if not floor:
-                    floor = dict(alloc)
-                else:
-                    floor = {
-                        k: min(v, alloc.get(k, 0.0))
-                        for k, v in floor.items()
-                    }
-        return floor
+        return self.input_builder.existing_input(node)
 
     def _accept_solution(
         self, solution: Solution, open_plans: list, results: SchedulerResults,
@@ -444,110 +604,6 @@ class Scheduler:
             ).extend(a.pods)
             for p in a.pods:
                 self._commit_existing(a.existing_index, p)
-
-    def _daemon_expected(
-        self, node_reqs: Requirements, taints: list
-    ) -> dict[str, float]:
-        """Total requests of daemonsets whose pods can land on a node
-        with these taints/labels (isDaemonPodCompatibleWithNode,
-        scheduler.go:708-717) — the one filter shared by new-node
-        overhead budgeting and existing-node reservation."""
-        from karpenter_tpu.utils.pod import has_dra_requirements
-
-        expected: dict[str, float] = {}
-        for ds in self.daemonsets:
-            pod = Pod(spec=ds.spec.template.spec)
-            pod.metadata.labels = dict(ds.spec.template.metadata.labels)
-            # a DRA daemon pod can never be scheduled by us, so its
-            # requests must not inflate any budget
-            # (shouldSkipDaemonPod, scheduler.go:702-705)
-            if self.ignore_dra_requests and has_dra_requirements(pod):
-                continue
-            if tolerates_pod(taints, pod) is not None:
-                continue
-            if not self._daemon_compatible(node_reqs, pod):
-                continue
-            expected = resutil.merge(expected, resutil.pod_requests(pod))
-        return expected
-
-    def _daemon_compatible(self, node_reqs: Requirements, pod: Pod) -> bool:
-        """Daemon-pod schedulability against a node/template: required
-        node-affinity terms are ORed — ANY matching term admits the
-        pod (the kube-scheduler semantic the reference's per-term check
-        follows) — and hostname affinity is dropped first: a daemonset
-        pinned to an EXISTING node's hostname says nothing about new
-        capacity (suite_test.go "remove daemonset node hostname
-        affinity when considering daemonset schedulability")."""
-        base = Requirements.from_labels(dict(pod.spec.node_selector))
-        if pod.spec.injected_requirements:
-            base.add(*pod.spec.injected_requirements)
-        aff = pod.spec.affinity
-        terms = ()
-        if aff is not None and aff.node_affinity is not None:
-            terms = aff.node_affinity.required or ()
-        if not terms:
-            return node_reqs.is_compatible(
-                base, allow_undefined=WELL_KNOWN_LABELS
-            )
-        for term in terms:
-            reqs = Requirements(r.copy() for r in base)
-            reqs.add(*(
-                r
-                for r in Requirements.from_node_selector_requirements(
-                    term.match_expressions
-                ).values()
-                if r.key != HOSTNAME_LABEL
-            ))
-            if node_reqs.is_compatible(
-                reqs, allow_undefined=WELL_KNOWN_LABELS
-            ):
-                return True
-        return False
-
-    def _daemon_reserve(self, node: StateNode) -> dict[str, float]:
-        """Capacity still owed to daemonsets on this node: the
-        requests of every daemonset whose pods CAN land here, minus
-        daemon pods already bound, floored at zero (unexpected daemon
-        pods must not push the reservation negative) —
-        existingnode.go:41-52, scheduler.go isDaemonPodCompatibleWithNode.
-        """
-        if not self.daemonsets or not node.managed():
-            return {}
-        cache_key = _state_node_key(node)
-        cached = self._daemon_reserve_cache.get(cache_key)
-        if cached is not None:
-            return cached
-        expected = self._daemon_expected(
-            Requirements.from_labels(node.labels()), list(node.taints())
-        )
-        # net of daemon pods already bound to the node — cluster state
-        # tracks these (terminal pods excluded) so the reservation is
-        # not re-derived from the raw pod list
-        reserve = (
-            resutil.positive(resutil.subtract(expected, node.daemon_usage))
-            if expected
-            else {}
-        )
-        self._daemon_reserve_cache[cache_key] = reserve
-        return reserve
-
-    def _daemon_overhead(self) -> dict[str, dict[str, float]]:
-        """Per-pool daemonset resource overhead (scheduler.go:772-803):
-        sum requests of daemon pods whose scheduling terms admit the
-        pool template. Uses the same full-compatibility filter
-        (undefined-key rules included) as the existing-node
-        reservation, via _daemon_expected."""
-        from karpenter_tpu.solver.encode import pool_template_requirements
-
-        out: dict[str, dict[str, float]] = {}
-        for pool, types in self.pools_with_types:
-            total = self._daemon_expected(
-                pool_template_requirements(pool, with_pool_pin=True),
-                list(pool.spec.template.spec.taints),
-            )
-            if total:
-                out[pool.metadata.name] = total
-        return out
 
     def _build_topology(self) -> Topology:
         # Domain discovery honors the POOL's own requirements
@@ -1421,17 +1477,4 @@ class Scheduler:
     # -- finalize -------------------------------------------------------------
 
     def _finalize_plan(self, plan: NodePlan) -> None:
-        """Price-order and truncate instance types, honoring the pool's
-        minValues floors (results.TruncateInstanceTypes,
-        provisioner.go:374; types.go:322-334)."""
-        pool_reqs = _pool_requirements(plan.pool)
-        try:
-            plan.instance_types = truncate(
-                plan.instance_types, pool_reqs, MAX_INSTANCE_TYPES
-            )
-        except ValueError:
-            # truncation cannot keep the minValues floor —
-            # _enforce_min_values decides reject (Strict) vs relax
-            plan.instance_types = truncate(
-                plan.instance_types, Requirements(), MAX_INSTANCE_TYPES
-            )
+        finalize_plan(plan)

@@ -16,7 +16,7 @@ Spec grammar (KARPENTER_FAULTS, comma-separated entries):
     kind   = device_lost | rpc_drop | compile_delay | exec_delay
            | kube_conflict | kube_throttle | kube_watch_drop
            | kube_stale_list | kube_write_partial | operator_crash
-           | spot_interruption
+           | spot_interruption | cache_poison
     occ    = "*" | N | N "+" | N "-" M        (1-based, per site)
     param  = duration                         (delay / retry-after kinds)
            | rate                             (spot_interruption: 0 < r <= 1)
@@ -29,6 +29,10 @@ Examples:
     kube_conflict@kube_write:2-4   writes 2..4 answer 409
     kube_throttle=250ms        every kube write 429s, Retry-After 250ms
     operator_crash@crash_bind:2    die just before the 2nd pod binding
+    cache_poison@incremental:2     corrupt a retained capacity row at the
+                                   2nd incremental live tick — the oracle
+                                   audit must catch it and degrade to the
+                                   full-solve decision
     spot_interruption@cloud_interrupt:3      3rd interruption check reclaims
     spot_interruption@cloud_interrupt:*=0.05 each check reclaims w.p. 5%,
                                              decided by a seeded hash of the
@@ -55,6 +59,15 @@ phase the watchdog budgets). Instrumented sites:
     rpc_server  service server, inside the Solve handler
 
 Cloud sites (hooked into the kwok/fake providers):
+
+    incremental      one incremental live tick of the provisioner's
+                     retained-state scheduler (provisioning/
+                     incremental_tick.py); a firing cache_poison rule
+                     raises CachePoisonError, which the tick CONSUMES —
+                     one retained capacity row is corrupted
+                     deterministically (the first fleet key in sorted
+                     order gains phantom capacity), so the oracle audit
+                     has a real stale-cache divergence to catch
 
     cloud_interrupt  one interruption check of one live spot instance
                      (providers iterate spot instances in sorted
@@ -88,6 +101,10 @@ the surviving API server):
     crash_disruption           disruption command computed, before it starts
     crash_disruption_started   command started (taints + replacements),
                                before its binding plan is queued
+    crash_incr_solve           incremental tick drained its dirty sets,
+                               before the residual solve runs
+    crash_incr_commit          incremental tick solved, before its plans
+                               are handed back for NodeClaim writes
 """
 
 from __future__ import annotations
@@ -108,12 +125,13 @@ ENV_SEED = "KARPENTER_FAULT_SEED"
 CRASH_SITES = (
     "crash_tick", "crash_claims", "crash_provision", "crash_bind",
     "crash_launch", "crash_disruption", "crash_disruption_started",
+    "crash_incr_solve", "crash_incr_commit",
 )
 
 SITES = (
     "solve", "compile", "execute", "probe", "warm", "rpc", "rpc_server",
     "kube_read", "kube_list", "kube_write", "kube_watch",
-    "cloud_interrupt",
+    "cloud_interrupt", "incremental",
 ) + CRASH_SITES
 
 _DEFAULT_SITE = {
@@ -128,12 +146,13 @@ _DEFAULT_SITE = {
     "kube_write_partial": "kube_write",
     "operator_crash": "crash_tick",
     "spot_interruption": "cloud_interrupt",
+    "cache_poison": "incremental",
 }
 
 _ERROR_KINDS = (
     "device_lost", "rpc_drop", "kube_conflict", "kube_throttle",
     "kube_watch_drop", "kube_stale_list", "kube_write_partial",
-    "operator_crash", "spot_interruption",
+    "operator_crash", "spot_interruption", "cache_poison",
 )
 
 
@@ -190,6 +209,13 @@ class OperatorCrashError(FaultError):
     """Injected operator death at a crash point. Never caught inside
     the operator: it must unwind the whole tick, exactly like SIGKILL
     between two writes would."""
+
+
+class CachePoisonError(FaultError):
+    """Injected retained-state corruption. Raised at the incremental
+    live tick's `incremental` site and CONSUMED there — the tick
+    corrupts one retained capacity row deterministically, modeling the
+    stale-cache failure the oracle audit exists to catch."""
 
 
 class SpotInterruptionError(FaultError):
@@ -363,6 +389,7 @@ class FaultInjector:
             "kube_write_partial": WritePartialError,
             "operator_crash": OperatorCrashError,
             "spot_interruption": SpotInterruptionError,
+            "cache_poison": CachePoisonError,
         }.get(rule.kind, FaultError)
         return cls(message)
 

@@ -435,23 +435,70 @@ class IncrementalPipeline:
             self._where[p.key] = node
         return node
 
-    def adopt(self, pods: Sequence[Pod], solution, pools_with_types) -> None:
+    def adopt(self, pods: Sequence[Pod], solution, pools_with_types,
+              existing: Optional[Sequence[ResidualNode]] = None) -> None:
         """Replace the retained fleet with a full Solution's (the drift
         backstop's adoption path; also usable by an external backstop
-        that computed the full solve itself)."""
-        assert not solution.existing, (
-            "IncrementalPipeline models fresh fleets only (no "
-            "caller-provided existing nodes)"
-        )
+        that computed the full solve itself).
+
+        A solution computed against an EXISTING fleet (live nodes +
+        in-flight claims) is adopted by passing `existing`: the
+        ResidualNode list aligned index-for-index with the
+        ExistingNodeInput order the solve was encoded with. Each
+        existing assignment folds its pods (and their usage) into the
+        matching residual node, and the retained fleet becomes
+        existing + new — the extension past the original fresh-fleets
+        guard that lets the pipeline model the live operator's fleet."""
+        if solution.existing and existing is None:
+            raise ValueError(
+                "solution assigns pods to existing nodes; pass the "
+                "ResidualNode list aligned with the solve's "
+                "ExistingNodeInput order"
+            )
         self._fleet = []
         self._where = {}
         self._pods = {p.key: p for p in pods}
+        if existing is not None:
+            for node in existing:
+                self._fleet.append(node)
+                for key, pod in node.pods.items():
+                    self._where[key] = node
+                    self._pods.setdefault(key, pod)
+            for a in solution.existing:
+                node = existing[a.existing_index]
+                for p in a.pods:
+                    node.pods[p.key] = p
+                    self._where[p.key] = node
+                node.used = resutil.merge(
+                    node.used, resutil.requests_for_pods(a.pods)
+                )
         for plan in solution.new_nodes:
             node = self._node_from_plan(plan)
             if node is not None:
                 self._fleet.append(node)
         self._unplaced = {p.key for p in solution.unschedulable}
         self._catalog_fp = catalog_fingerprint(pools_with_types)
+
+    def state_fingerprint(self) -> str:
+        """Stable identity of the retained fleet: what a self-audit
+        (or a restart-convergence test) compares before trusting the
+        cache. Name-insensitive for NEW nodes (inc-N names are
+        process-local) but exact on the capacity ledger."""
+        import hashlib
+
+        if self._fleet is None:
+            return ""
+        rows = sorted(
+            (
+                node.pool.metadata.name,
+                node.instance_type.name if node.instance_type else "",
+                round(node.price, 6),
+                tuple(sorted(node.pods)),
+                tuple(sorted((k, round(v, 6)) for k, v in node.used.items())),
+            )
+            for node in self._fleet
+        )
+        return hashlib.sha256(repr(rows).encode()).hexdigest()
 
     # -- solving --------------------------------------------------------------
 

@@ -457,6 +457,18 @@ def _ladder_count(rung: str, outcome: str) -> None:
     SOLVER_LADDER.inc({"rung": rung, "outcome": outcome})
 
 
+def note_incremental_poison() -> None:
+    """The degradation ladder's `incremental_poison` rung: the
+    provisioner's incremental live tick caught (or was told about) a
+    poisoned retained-state cache and degraded the tick to the full
+    Scheduler's decision. Not a backend rung — no breaker, nothing to
+    retry — but it IS a degradation the fleet operator must see in the
+    same ladder telemetry as device/remote failures: a tick served
+    correct-but-slower, and a growing count means the retained state
+    keeps going stale."""
+    _ladder_count("incremental_poison", "quarantined")
+
+
 class ResilientSolver:
     """The solve seam's resilience wrapper; one per process (shared())
     so breaker state survives across ticks and callers."""

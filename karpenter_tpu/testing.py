@@ -266,3 +266,73 @@ class Environment:
             n for n in self.kube.nodes()
             if n.metadata.labels.get("karpenter.sh/initialized") == "true"
         ]
+
+
+# -- live-churn harness (ISSUE 7) ------------------------------------------
+#
+# One fixture shared by tests/test_perf_floor.py and bench.py's
+# steady_state_churn live_operator arm, so the perf guard and the bench
+# measure the SAME workload: a settled Operator over a FULL fleet of
+# 4x 0.9-cpu pods per 4-cpu node (allocatable 3.9 after kube-reserved,
+# so a fifth pod can never fit) where churn pods can only land in the
+# slots their deleted predecessors freed.
+
+def build_churn_operator(n_pods: int):
+    """Provision `n_pods` steady pods, settle a real Operator over the
+    fleet, and return (env, operator, synthetic_now) ready for
+    `churn_tick_walls`."""
+    import time
+
+    from karpenter_tpu.cloudprovider.fake import GIB, make_instance_type
+    from karpenter_tpu.operator.operator import Operator
+    from karpenter_tpu.operator.options import Options
+
+    types = [make_instance_type("c4", cpu=4, memory=16 * GIB, price=1.0)]
+    env = Environment(types=types)
+    pool = mk_nodepool("churn")
+    pool.spec.disruption.consolidate_after = "Never"
+    env.kube.create(pool)
+    env.provision(
+        *[mk_pod(name=f"s-{i}", cpu=0.9, memory=2 * GIB)
+          for i in range(n_pods)]
+    )
+    op = Operator(kube=env.kube, cloud_provider=env.cloud,
+                  options=Options())
+    now = time.time()
+    for i in range(3):   # settle: recovery, cache warmup, residual dirt
+        op.step(now=now + i * 2.0)
+    return env, op, now + 10.0
+
+
+def churn_tick_walls(env, op, now: float, ticks: int, churn_pods: int):
+    """Per-tick wall of the operator step that runs the churn solve:
+    each tick deletes `churn_pods` bound pods, creates as many
+    same-shape ones, and measures the step where the batcher fires.
+    Returns (p50_wall_seconds, now)."""
+    import time
+
+    from karpenter_tpu.cloudprovider.fake import GIB
+
+    walls = []
+    counter = 0
+    for t in range(ticks):
+        bound = sorted(
+            (p for p in env.kube.pods() if p.spec.node_name),
+            key=lambda p: p.metadata.name,
+        )
+        for pod in bound[:churn_pods]:
+            env.kube.delete(pod)
+        for _ in range(churn_pods):
+            counter += 1
+            env.kube.create(mk_pod(name=f"churn-{t}-{counter}", cpu=0.9,
+                                   memory=2 * GIB))
+        # the batcher keys off wall-clock event arrival while the
+        # harness ticks synthetic time already offset past the idle
+        # window, so the FIRST step after churn runs the solve
+        now += 2.0
+        t0 = time.perf_counter()
+        op.step(now=now)
+        walls.append(time.perf_counter() - t0)
+        now += 2.0
+        op.step(now=now)   # bind/settle
+    return sorted(walls)[len(walls) // 2], now

@@ -1,0 +1,446 @@
+"""Incremental live tick (ISSUE 7): the provisioner's retained-state
+reconcile path, its self-auditing oracle, and the quarantine/degrade
+machinery.
+
+The decision-identity contract: with KARPENTER_INCREMENTAL on (the
+default), every eligible live tick must land the SAME fleet the full
+Scheduler path would have — enforced continuously by the shadow oracle
+audit, and here by driving identical workloads down both paths. A
+`cache_poison@incremental` injection (deterministic, replay-logged)
+corrupts a retained capacity row; the audit must catch it, quarantine
+the cache, and serve the full-solve decision, so the converged fleet
+never changes vs the calm run.
+"""
+
+import time
+
+import pytest
+
+from karpenter_tpu.cloudprovider.fake import GIB, make_instance_type
+from karpenter_tpu.metrics.store import (
+    INCREMENTAL_AUDITS,
+    INCREMENTAL_DIVERGENCE,
+    INCREMENTAL_TICK,
+)
+from karpenter_tpu.solver import faults
+from karpenter_tpu.testing import Environment, mk_nodepool, mk_pod
+
+
+@pytest.fixture()
+def clean_faults(monkeypatch):
+    monkeypatch.delenv("KARPENTER_FAULTS", raising=False)
+    monkeypatch.delenv("KARPENTER_INCREMENTAL", raising=False)
+    faults.reset()
+    yield monkeypatch
+    faults.reset()
+
+
+def _types():
+    return [make_instance_type("c4", cpu=4, memory=16 * GIB, price=1.0)]
+
+
+def _fleet_fingerprint(env):
+    """Name-agnostic converged state: instance-type -> bound pod-name
+    partition."""
+    return sorted(
+        (
+            n.metadata.labels.get("node.kubernetes.io/instance-type", ""),
+            tuple(sorted(
+                p.metadata.name
+                for p in env.kube.pods_on_node(n.metadata.name)
+            )),
+        )
+        for n in env.kube.nodes()
+    )
+
+
+def _counter_totals():
+    return {
+        "incremental": sum(
+            v for k, v in INCREMENTAL_TICK.samples()
+            if dict(k).get("path") == "incremental"
+        ),
+        "full_backstop": sum(
+            v for k, v in INCREMENTAL_TICK.samples()
+            if dict(k).get("path") == "full_backstop"
+        ),
+        "quarantined": sum(
+            v for k, v in INCREMENTAL_TICK.samples()
+            if dict(k).get("path") == "quarantined"
+        ),
+    }
+
+
+class TestDefaultRouting:
+    def test_incremental_is_the_default_live_tick(self, clean_faults):
+        before = _counter_totals()
+        env = Environment(types=_types())
+        env.kube.create(mk_nodepool("p"))
+        env.provision(*[mk_pod(name=f"a-{i}", cpu=1.0) for i in range(4)])
+        after = _counter_totals()
+        assert after["incremental"] > before["incremental"], (
+            "the live reconcile must route through the incremental tick "
+            "by default"
+        )
+        assert env.provisioner.incremental.status()["enabled"]
+        fp = _fleet_fingerprint(env)
+        assert sum(len(p[1]) for p in fp) == 4
+
+    def test_env_kill_switch_routes_full_path(self, clean_faults):
+        clean_faults.setenv("KARPENTER_INCREMENTAL", "0")
+        before = _counter_totals()
+        env = Environment(types=_types())
+        env.kube.create(mk_nodepool("p"))
+        env.provision(*[mk_pod(name=f"b-{i}", cpu=1.0) for i in range(4)])
+        after = _counter_totals()
+        assert after == before, "disabled tick must not touch the counters"
+        assert not env.provisioner.incremental.status()["enabled"]
+        assert sum(len(p[1]) for p in _fleet_fingerprint(env)) == 4
+
+    def test_incremental_and_full_paths_decide_identically(
+        self, clean_faults
+    ):
+        """The headline identity: same workload, both paths, same
+        name-agnostic fleet."""
+
+        def run():
+            env = Environment(types=_types())
+            env.kube.create(mk_nodepool("p"))
+            env.provision(*[
+                mk_pod(name=f"w-{i}", cpu=1.0 + (i % 3) * 0.5)
+                for i in range(9)
+            ])
+            # a second wave lands on the warm retained state
+            env.provision(*[
+                mk_pod(name=f"x-{i}", cpu=0.5) for i in range(4)
+            ])
+            return _fleet_fingerprint(env)
+
+        clean_faults.setenv("KARPENTER_INCREMENTAL", "1")
+        with_inc = run()
+        clean_faults.setenv("KARPENTER_INCREMENTAL", "0")
+        without = run()
+        assert with_inc == without
+
+    def test_ineligible_tick_falls_back_with_reason(self, clean_faults):
+        """A topology-constrained pod routes the whole tick to the
+        full Scheduler (recorded as a full_backstop)."""
+        from karpenter_tpu.kube.objects import (
+            LabelSelector,
+            TopologySpreadConstraint,
+        )
+
+        before = _counter_totals()
+        env = Environment(types=_types())
+        env.kube.create(mk_nodepool("p"))
+        pod = mk_pod(name="spread-0", cpu=1.0, labels={"app": "x"})
+        pod.spec.topology_spread_constraints = [
+            TopologySpreadConstraint(
+                max_skew=1,
+                topology_key="topology.kubernetes.io/zone",
+                when_unsatisfiable="ScheduleAnyway",
+                label_selector=LabelSelector.of({"app": "x"}),
+            )
+        ]
+        env.provision(pod)
+        after = _counter_totals()
+        assert after["full_backstop"] > before["full_backstop"]
+
+
+class TestOracleAuditAndPoison:
+    def _converge(self, spec, monkeypatch):
+        """Two provisioning waves; the second lands while the cache is
+        warm, so a poisoned retained row has a real decision to
+        corrupt: the c4 nodes are nearly full (3.5/4 cpu), and the new
+        1-cpu pods fit only on NEW capacity — unless a phantom-capacity
+        row lies about headroom."""
+        if spec:
+            monkeypatch.setenv("KARPENTER_FAULTS", spec)
+        else:
+            monkeypatch.delenv("KARPENTER_FAULTS", raising=False)
+        faults.reset()
+        env = Environment(types=_types())
+        env.kube.create(mk_nodepool("p"))
+        env.provision(*[mk_pod(name=f"f-{i}", cpu=3.5) for i in range(3)])
+        env.provision()   # warm the retained state (post-cold tick)
+        env.provision(*[mk_pod(name=f"n-{i}", cpu=1.0) for i in range(2)])
+        inj = faults.get()
+        log = inj.snapshot_log() if inj is not None else []
+        monkeypatch.delenv("KARPENTER_FAULTS", raising=False)
+        return env, log
+
+    def test_cache_poison_never_changes_the_fleet(self, clean_faults):
+        calm_env, _ = self._converge("", clean_faults)
+        want = _fleet_fingerprint(calm_env)
+        div0 = INCREMENTAL_DIVERGENCE.total()
+        env, log = self._converge(
+            "cache_poison@incremental:*", clean_faults
+        )
+        assert any(kind == "cache_poison" for _, _, kind in log), (
+            "the poison spec never fired"
+        )
+        assert _fleet_fingerprint(env) == want, (
+            "a poisoned retained row must degrade to the full-solve "
+            "decision, never change the fleet"
+        )
+        # the oracle audit actually caught the corruption (the phantom
+        # row attracted a placement the full solve rejects)
+        assert INCREMENTAL_DIVERGENCE.total() > div0
+        status = env.provisioner.incremental.status()
+        assert status["quarantined"] or status["divergences"] > 0
+
+    def test_poison_replay_is_byte_identical(self, clean_faults):
+        spec = "cache_poison@incremental:*"
+        _, log_a = self._converge(spec, clean_faults)
+        env_b, log_b = self._converge(spec, clean_faults)
+        assert log_a, "spec never fired"
+        assert log_a == log_b, "fault schedules must replay identically"
+        # and the divergence record carries the replay artifact
+        divs = env_b.provisioner.incremental.divergences
+        if divs:
+            assert divs[-1]["fault_log"], "divergence must record the log"
+
+    def test_quarantine_recovers_after_probation_audit(self, clean_faults):
+        """One poisoned tick quarantines; once the fault stops firing,
+        the next incremental tick re-audits (probation) and the cache
+        is trusted again."""
+        env, _ = self._converge(
+            "cache_poison@incremental:2", clean_faults
+        )
+        ok0 = INCREMENTAL_AUDITS.value(
+            {"verdict": "ok", "trigger": "probation"}
+        )
+        env.provision(mk_pod(name="post-q", cpu=1.0))
+        status = env.provisioner.incremental.status()
+        assert not status["quarantined"], (
+            f"probation audit should clear quarantine: {status}"
+        )
+        assert INCREMENTAL_AUDITS.value(
+            {"verdict": "ok", "trigger": "probation"}
+        ) > ok0 or status["divergences"] == 0
+
+    def test_divergence_recorded_for_replay(self, clean_faults):
+        env, _ = self._converge(
+            "cache_poison@incremental:*", clean_faults
+        )
+        divs = env.provisioner.incremental.divergences
+        assert divs, "poison storm must produce a recorded divergence"
+        rec = divs[-1]
+        assert rec["incremental"] != rec["full"]
+        assert any(kind == "cache_poison" for _, _, kind in rec["fault_log"])
+
+    def test_quarantined_serve_reports_the_ladder_rung(self, clean_faults):
+        from karpenter_tpu.metrics.store import SOLVER_LADDER
+
+        before = SOLVER_LADDER.value(
+            {"rung": "incremental_poison", "outcome": "quarantined"}
+        )
+        self._converge("cache_poison@incremental:*", clean_faults)
+        assert SOLVER_LADDER.value(
+            {"rung": "incremental_poison", "outcome": "quarantined"}
+        ) > before
+
+
+class TestReadyz:
+    def test_readyz_surfaces_incremental_status(self, clean_faults):
+        from karpenter_tpu.kube.client import KubeClient
+        from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
+        from karpenter_tpu.operator.operator import Operator
+
+        kube = KubeClient()
+        op = Operator(
+            kube=kube, cloud_provider=KwokCloudProvider(kube, types=_types())
+        )
+        kube.create(mk_nodepool("p"))
+        kube.create(mk_pod(name="r-0", cpu=1.0))
+        now = time.time()
+        for i in range(4):
+            op.step(now=now + i * 2.0)
+        ready = op.readyz()
+        inc = ready["incremental"]
+        assert inc["enabled"] is True
+        assert "fingerprint" in inc and "fingerprint_age_ticks" in inc
+        assert "last_audit" in inc and "quarantined" in inc
+        assert inc["ticks"]["incremental"] >= 1
+
+    def test_recovery_forces_rebuild_and_audit(self, clean_faults):
+        """Operator._recover invalidates the retained state: the
+        recovery hook is how a crash between ticks cannot resurrect a
+        pre-crash cache."""
+        env = Environment(types=_types())
+        env.kube.create(mk_nodepool("p"))
+        env.provision(mk_pod(name="rc-0", cpu=1.0))
+        tick = env.provisioner.incremental
+        assert tick._ticks > 0
+        tick.on_recover()
+        assert tick.status()["retained_nodes"] == 0
+        assert tick._force_audit == "recovery"
+        # the next live tick re-syncs and re-audits without divergence
+        env.provision(mk_pod(name="rc-1", cpu=1.0))
+        assert tick.status()["divergences"] == 0
+
+
+class TestDirtyTrackerExtensions:
+    def test_mapped_keys(self):
+        from karpenter_tpu.kube.client import KubeClient
+        from karpenter_tpu.kube.dirty import DirtyTracker
+
+        kube = KubeClient()
+        tracker = DirtyTracker(kube).watch(
+            "Pod", key=lambda e, p: (
+                [p.spec.node_name] if p.spec.node_name else []
+            ),
+        )
+        tracker.drain("Pod")
+        pod = mk_pod(name="m-0", cpu=1.0)
+        kube.create(pod)
+        assert tracker.drain("Pod") == set()  # unbound: no node dirtied
+        node_pod = mk_pod(name="m-1", cpu=1.0)
+        kube.create(node_pod)
+        live = kube.get_pod("default", "m-1")
+        live.spec.node_name = "node-a"
+        kube.touch(live)
+        assert "node-a" in tracker.drain("Pod")
+
+    def test_relisted_latch(self):
+        from karpenter_tpu.kube.dirty import DirtyTracker
+        from karpenter_tpu.kube.real import InMemoryApiServer, RealKubeClient
+
+        server = InMemoryApiServer()
+        kube = RealKubeClient(server)
+        tracker = DirtyTracker(kube).watch("Pod")
+        assert tracker.relisted("Pod") is False
+        kube._relist("Pod", reason="watch_gone")
+        assert tracker.relisted("Pod") is True
+        assert tracker.relisted("Pod") is False  # latched once
+        # in-memory client has no relist machinery at all
+        from karpenter_tpu.kube.client import KubeClient
+
+        t2 = DirtyTracker(KubeClient()).watch("Pod")
+        assert t2.relisted("Pod") is False
+
+
+class TestDisruptionSkipGate:
+    def test_idle_scan_skipped_once_per_poll_slot(self, clean_faults):
+        """An empty-handed disruption scan is skipped while nothing it
+        reads changes — and a skipped scan consumes its poll slot, so
+        the gate's own checks don't re-run every operator step. Watch
+        traffic re-arms the real scan."""
+        from karpenter_tpu.metrics.store import DISRUPTION_SCAN_SKIPPED
+        from karpenter_tpu.testing import build_churn_operator
+
+        clean_faults.setenv(
+            "KARPENTER_INCR_DISRUPTION_FORCE_SECONDS", "100000"
+        )
+        env, op, now = build_churn_operator(8)
+        poll = op.options.disruption_poll_seconds
+        op.step(now=now)              # empty-handed scan (or forced)
+        op.step(now=now + poll + 1)   # first skippable slot
+        base = DISRUPTION_SCAN_SKIPPED.total()
+        op.step(now=now + 2 * poll + 2)
+        assert DISRUPTION_SCAN_SKIPPED.total() == base + 1
+        # same slot: the gate must not even be consulted again
+        op.step(now=now + 2 * poll + 3)
+        assert DISRUPTION_SCAN_SKIPPED.total() == base + 1
+        # watch traffic (a new pod) re-arms the scan: next slot runs it
+        env.kube.create(mk_pod(name="dirt-0", cpu=0.9))
+        op.step(now=now + 3 * poll + 4)
+        assert DISRUPTION_SCAN_SKIPPED.total() == base + 1
+
+
+class TestDaemonSetChurn:
+    def test_daemonset_created_after_warm_cache_rebuilds_builder(
+        self, clean_faults
+    ):
+        """A DaemonSet created AFTER the retained state warmed must
+        rebuild the NodeInputBuilder — it pins the daemonset list its
+        per-node reserves and per-pool overhead derive from, and the
+        catalog fingerprint cannot see daemonsets move. A stale builder
+        serves phantom daemon capacity: the incremental tick packs 3x
+        1.3-cpu pods per fresh node where the full path (1.0 cpu daemon
+        reserve) fits only 2."""
+        from karpenter_tpu.kube.objects import (
+            Container,
+            DaemonSet,
+            DaemonSetSpec,
+            ObjectMeta,
+            PodSpec,
+            PodTemplateSpec,
+        )
+
+        def run(enabled):
+            clean_faults.setenv("KARPENTER_INCREMENTAL", enabled)
+            env = Environment(types=_types())
+            env.kube.create(mk_nodepool("p"))
+            env.provision(*[mk_pod(name=f"d-{i}", cpu=1.0)
+                            for i in range(4)])
+            env.provision()   # warm the retained state
+            env.kube.create(DaemonSet(
+                metadata=ObjectMeta(name="logging"),
+                spec=DaemonSetSpec(template=PodTemplateSpec(
+                    spec=PodSpec(
+                        containers=[Container(requests={"cpu": 1.0})]
+                    )
+                )),
+            ))
+            env.provision(*[mk_pod(name=f"e-{i}", cpu=1.3)
+                            for i in range(6)])
+            return _fleet_fingerprint(env), env
+
+        with_inc, env = run("1")
+        without, _ = run("0")
+        assert with_inc == without, (
+            "daemonset created after warm-up must not leave the "
+            "incremental tick deciding against a stale daemon reserve"
+        )
+        assert env.provisioner.incremental.status()["divergences"] == 0
+
+
+class TestWatchDropStaleDirty:
+    def test_watch_drop_relist_marks_everything_dirty(self, clean_faults):
+        """A 410-driven relist loses event-stream continuity: the
+        retained state must be rebuilt wholesale (relisted() latch),
+        and the converged fleet must match the calm run's."""
+        from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
+        from karpenter_tpu.kube.real import InMemoryApiServer, RealKubeClient
+        from karpenter_tpu.operator.operator import Operator
+
+        def run(spec):
+            if spec:
+                clean_faults.setenv("KARPENTER_FAULTS", spec)
+                clean_faults.setenv("KARPENTER_KUBE_RELIST_MIN_MS", "0")
+            else:
+                clean_faults.delenv("KARPENTER_FAULTS", raising=False)
+            faults.reset()
+            server = InMemoryApiServer()
+            kube = RealKubeClient(server)
+            cloud = KwokCloudProvider(kube, types=_types())
+            op = Operator(kube=kube, cloud_provider=cloud)
+            user = RealKubeClient(server)
+            user.create(mk_nodepool("p"))
+            for i in range(5):
+                user.create(mk_pod(name=f"wd-{i}", cpu=1.0))
+            now = time.time()
+            for i in range(12):
+                op.step(now=now + i * 2.0)
+            clean_faults.delenv("KARPENTER_FAULTS", raising=False)
+            return sorted(
+                (
+                    n.metadata.labels.get(
+                        "node.kubernetes.io/instance-type", ""
+                    ),
+                    tuple(sorted(
+                        p.metadata.name
+                        for p in op.kube.pods_on_node(n.metadata.name)
+                    )),
+                )
+                for n in op.kube.nodes()
+            ), op
+
+        want, _ = run("")
+        got, op = run("kube_watch_drop@kube_watch:3-5")
+        assert got == want, (
+            "stale-dirty-set injection (watch drop -> relist) must not "
+            "change the converged fleet"
+        )
+        assert op.readyz()["incremental"]["divergences"] == 0

@@ -234,6 +234,13 @@ PROVISIONING_CRASHES = [
     "operator_crash@crash_provision:1",
     "operator_crash@crash_bind:2",
     "operator_crash@crash_launch:3",
+    # inside the incremental live tick (ISSUE 7): after the dirty sets
+    # drained but before the residual solve, and after the solve but
+    # before the plans become NodeClaim writes — the restarted operator
+    # must rebuild the retained cache from the API (not resurrect the
+    # drained delta) and still converge
+    "operator_crash@crash_incr_solve:1",
+    "operator_crash@crash_incr_commit:1",
 ]
 
 DISRUPTION_CRASHES = [
@@ -265,6 +272,27 @@ def test_disruption_crash_converges_to_uninterrupted_state(
     h = _disruption_run(spec, clean_faults)
     assert h.crashes >= 1, f"{spec} never fired"
     assert h.fingerprint() == want
+
+
+@pytest.mark.restart_chaos
+def test_incremental_crash_rebuilds_the_retained_cache(clean_faults):
+    """A crash INSIDE the incremental tick must not resurrect the
+    pre-crash retained state: the restarted operator rebuilds from the
+    API (recovery invalidates + forces an oracle audit), converges to
+    the uninterrupted fleet, and reports zero divergences — the
+    rebuilt cache agreed with the full solve."""
+    want = _reference("prov", clean_faults)
+    h = _provisioning_run(
+        "operator_crash@crash_incr_commit:1", clean_faults
+    )
+    assert h.crashes >= 1
+    assert h.fingerprint() == want
+    inc = h.op.readyz()["incremental"]
+    assert inc["divergences"] == 0
+    assert inc["ticks"]["incremental"] >= 1, (
+        "the restarted operator must resume the incremental path, "
+        f"not wedge on the full backstop: {inc}"
+    )
 
 
 @pytest.mark.restart_chaos

@@ -1,0 +1,147 @@
+"""Static solve-seam check (ISSUE-7 satellite, pattern of
+test_kube_write_sites): every controller-layer solve must route
+through the audited pipeline seam — `provisioning/scheduler.py`
+(the full Scheduler) or `provisioning/incremental_tick.py` (the
+retained-state live tick with its oracle audit). A controller calling
+`solver.solve` / `solve_encoded` / `_solve_packing` directly would
+silently bypass the incremental tick's audit + backstop coverage, the
+scheduler's metrics, AND the resilience ladder's degradation report;
+this tier-1 test makes that a failing build instead of an unaudited
+fleet decision.
+"""
+
+import ast
+import pathlib
+
+PKG = pathlib.Path(__file__).resolve().parent.parent / "karpenter_tpu"
+
+# controller layers: everything that DECIDES fleet shape from cluster
+# state (the solver package itself, the service codecs, and the bench
+# are solver-internal surfaces, not controllers)
+CONTROLLER_DIRS = (
+    "provisioning", "disruption", "operator", "lifecycle", "state",
+    "metrics", "events",
+)
+
+# the audited seam: the only controller-layer modules allowed to reach
+# the raw solve entry points
+SEAM = {
+    ("provisioning", "scheduler.py"),
+    ("provisioning", "incremental_tick.py"),
+}
+
+SOLVE_ENTRY_NAMES = {
+    "solve", "solve_encoded", "_solve_packing", "_solve_packing_async",
+}
+
+
+def _controller_files():
+    for dirname in CONTROLLER_DIRS:
+        for path in sorted((PKG / dirname).rglob("*.py")):
+            yield dirname, path
+
+
+def _solver_solve_imports(tree):
+    """Names imported from karpenter_tpu.solver.solver that are solve
+    entry points (importing types like NodePlan stays legal)."""
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module and (
+            node.module.endswith("solver.solver")
+        ):
+            for alias in node.names:
+                if alias.name in SOLVE_ENTRY_NAMES:
+                    out.append((node.lineno, alias.name))
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name.endswith("solver.solver"):
+                    out.append((node.lineno, alias.name))
+    return out
+
+
+def _solve_attribute_calls(tree):
+    """Calls of the shape `<anything>.solve_encoded(...)` or
+    `<anything>._solve_packing[_async](...)` — reaching the kernel
+    seam through a module attribute instead of an import."""
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if isinstance(func, ast.Attribute) and func.attr in (
+            "solve_encoded", "_solve_packing", "_solve_packing_async"
+        ):
+            out.append((node.lineno, func.attr))
+    return out
+
+
+def test_no_controller_bypasses_the_solve_seam():
+    offenders = []
+    for dirname, path in _controller_files():
+        if (dirname, path.name) in SEAM:
+            continue
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for lineno, name in _solver_solve_imports(tree):
+            offenders.append(
+                f"{path.relative_to(PKG.parent)}:{lineno} imports {name}"
+            )
+        for lineno, name in _solve_attribute_calls(tree):
+            offenders.append(
+                f"{path.relative_to(PKG.parent)}:{lineno} calls {name}"
+            )
+    assert not offenders, (
+        "controller-layer solves bypassing the audited Scheduler/"
+        f"incremental-tick seam: {offenders}"
+    )
+
+
+def test_provisioner_routes_through_the_incremental_seam():
+    """The live reconcile's structure is pinned: Provisioner.schedule
+    must consult the incremental tick first and fall back through
+    _make_scheduler — not construct a Scheduler ad hoc elsewhere."""
+    source = (PKG / "provisioning" / "provisioner.py").read_text()
+    tree = ast.parse(source, filename="provisioning/provisioner.py")
+    prov = next(
+        node for node in tree.body
+        if isinstance(node, ast.ClassDef) and node.name == "Provisioner"
+    )
+    scheduler_ctors = []
+    tick_calls = []
+    for node in ast.walk(prov):
+        if isinstance(node, ast.Call):
+            if isinstance(node.func, ast.Name) and node.func.id == "Scheduler":
+                scheduler_ctors.append(node.lineno)
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "tick"
+                and isinstance(node.func.value, ast.Attribute)
+                and node.func.value.attr == "incremental"
+            ):
+                tick_calls.append(node.lineno)
+    methods = {
+        m.name: m for m in prov.body if isinstance(m, ast.FunctionDef)
+    }
+    assert tick_calls, "Provisioner.schedule must route through " \
+                       "self.incremental.tick"
+    ctor_owners = set()
+    for lineno in scheduler_ctors:
+        for name, m in methods.items():
+            if m.lineno <= lineno <= max(
+                getattr(m, "end_lineno", m.lineno), m.lineno
+            ):
+                ctor_owners.add(name)
+    assert ctor_owners <= {"_make_scheduler"}, (
+        "full-path Scheduler construction must live in _make_scheduler "
+        f"(the seam the oracle audit shares), found in: {ctor_owners}"
+    )
+
+
+def test_disruption_engine_routes_through_scheduler_only():
+    """The engine simulates through Scheduler (and the batched probe
+    solver, which wraps it) — never through raw solver entry points."""
+    for fname in ("engine.py", "validation.py", "interruption.py"):
+        tree = ast.parse(
+            (PKG / "disruption" / fname).read_text(), filename=fname
+        )
+        assert not _solver_solve_imports(tree), fname
+        assert not _solve_attribute_calls(tree), fname
