@@ -534,6 +534,44 @@ class DisruptionEngine:
             p.key for plan in results.new_node_plans for p in plan.pods
         } | {p.key for ps in results.existing_assignments.values() for p in ps}
         all_ok = all(p.key in scheduled_keys for p in pods)
+        if all_ok and pods and pending:
+            # priority-aware disruption (ISSUE 8): a command must not
+            # retire capacity while a PENDING pod of strictly higher
+            # priority than the pods it would displace is left
+            # capacity-unschedulable by the very same simulation —
+            # whether by catalog capacity (the solve's own error) or by
+            # NodePool limits (enforced at claim creation; simulated
+            # here the way the provisioner's admission loop does). The
+            # cluster would be churning low-priority workload for
+            # price while outranking demand starves. Uniform-priority
+            # clusters (everything 0) are unaffected: 0 > 0 never
+            # holds, and a pending pod at the candidates' own priority
+            # was unschedulable with the candidates present too.
+            from karpenter_tpu.provisioning.priority import (
+                NO_CAPACITY_ERROR,
+            )
+
+            floor = min(p.spec.priority for p in pods)
+            pending_by_key = {p.key: p for p in pending}
+            starved_keys = {
+                key for key, error in results.errors.items()
+                if error == NO_CAPACITY_ERROR
+            }
+            for plan in self.provisioner._plans_over_limits(
+                results.new_node_plans
+            ):
+                starved_keys.update(p.key for p in plan.pods)
+            for key in sorted(starved_keys):
+                starved = pending_by_key.get(key)
+                if starved is not None and starved.spec.priority > floor:
+                    log.info(
+                        "disruption simulation vetoed: pending pod %s "
+                        "(priority %d) would stay unschedulable while "
+                        "pods of priority %d are displaced",
+                        key, starved.spec.priority, floor,
+                    )
+                    all_ok = False
+                    break
         return results, all_ok
 
     # -- consolidation decision (consolidation.go:137-311) ---------------------

@@ -39,6 +39,7 @@ from karpenter_tpu.kube.objects import (
     PersistentVolumeClaim,
     Pod,
     PodDisruptionBudget,
+    PriorityClass,
     StorageClass,
 )
 
@@ -420,6 +421,12 @@ class KubeClient:
 
     def csi_nodes(self) -> list[CSINode]:
         return self.list("CSINode")
+
+    def priority_classes(self) -> list[PriorityClass]:
+        return self.list("PriorityClass")
+
+    def get_priority_class(self, name: str) -> Optional[PriorityClass]:
+        return self.get("PriorityClass", name)
 
     def bind_pod(self, pod: Pod, node_name: str) -> None:
         """The scheduler binding: sets spec.node_name."""

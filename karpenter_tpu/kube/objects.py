@@ -338,6 +338,31 @@ class Node:
         return cond is not None and cond.status == "True"
 
 
+# ---------------------------------------------------------------- priority
+
+
+@dataclass
+class PriorityClass:
+    """scheduling.k8s.io/v1 PriorityClass, trimmed to the fields the
+    admission-time priority resolution consumes
+    (scheduling/priority.py): a named integer priority, the
+    cluster-wide default flag, and the preemption policy gate the
+    provisioner's preemption controller honors."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    value: int = 0
+    global_default: bool = False
+    # PreemptLowerPriority | Never — pods of a Never class still sort
+    # above lower priorities but never nominate victims
+    preemption_policy: str = "PreemptLowerPriority"
+
+    kind = "PriorityClass"
+
+    @property
+    def key(self) -> str:
+        return self.metadata.name
+
+
 # ---------------------------------------------------------------- workloads
 
 

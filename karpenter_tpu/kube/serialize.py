@@ -14,8 +14,8 @@ Covered kinds (the TO_CR/FROM_CR registries below are the source of
 truth): NodePool, NodeClaim, NodeOverlay (the CRDs); Pod and Node
 (requests, affinity, topology spread, tolerations, volumes, taints,
 conditions, ownerReferences); DaemonSet, PodDisruptionBudget,
-PersistentVolumeClaim (read-side controller inputs); Lease (leader
-election); and Event (write-side recorder output).
+PersistentVolumeClaim, PriorityClass (read-side controller inputs);
+Lease (leader election); and Event (write-side recorder output).
 """
 
 from __future__ import annotations
@@ -973,6 +973,33 @@ def lease_from_cr(cr: dict):
     )
 
 
+def priorityclass_to_cr(pc) -> dict:
+    """scheduling.k8s.io/v1 PriorityClass wire form (value /
+    globalDefault / preemptionPolicy are the fields admission-time
+    priority resolution reads)."""
+    return {
+        "apiVersion": "scheduling.k8s.io/v1",
+        "kind": "PriorityClass",
+        "metadata": meta_to_cr(pc.metadata),
+        "value": pc.value,
+        "globalDefault": pc.global_default,
+        "preemptionPolicy": pc.preemption_policy,
+    }
+
+
+def priorityclass_from_cr(cr: dict):
+    from karpenter_tpu.kube.objects import PriorityClass
+
+    meta = meta_from_cr(cr)
+    meta.namespace = ""  # cluster-scoped
+    return PriorityClass(
+        metadata=meta,
+        value=int(cr.get("value", 0)),
+        global_default=bool(cr.get("globalDefault", False)),
+        preemption_policy=cr.get("preemptionPolicy", "PreemptLowerPriority"),
+    )
+
+
 # ---------------------------------------------------------------- registry
 
 def event_to_cr(ev) -> dict:
@@ -1028,6 +1055,7 @@ TO_CR = {
     "DaemonSet": daemonset_to_cr,
     "PodDisruptionBudget": pdb_to_cr,
     "PersistentVolumeClaim": pvc_to_cr,
+    "PriorityClass": priorityclass_to_cr,
     "Lease": lease_to_cr,
 }
 
@@ -1041,6 +1069,7 @@ FROM_CR = {
     "DaemonSet": daemonset_from_cr,
     "PodDisruptionBudget": pdb_from_cr,
     "PersistentVolumeClaim": pvc_from_cr,
+    "PriorityClass": priorityclass_from_cr,
     "Lease": lease_from_cr,
 }
 

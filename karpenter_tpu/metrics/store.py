@@ -310,6 +310,27 @@ BINDING_RETRY = REGISTRY.counter(
     "karpenter_binding_retry_total",
     "Pod bindings re-enqueued after a retryable API failure "
     "(409/429/5xx), by status")
+# priority-aware overload protection (provisioning/priority.py,
+# provisioning/preemption.py, state/nodepoolhealth.py)
+PRIORITY_SHED = REGISTRY.counter(
+    "karpenter_priority_shed_total",
+    "Pods shed by priority admission under overload — the lowest-"
+    "priority tail of the admission order when demand exceeds pool "
+    "limits or catalog capacity; shed pods retry next round")
+PREEMPTION_EVICTIONS = REGISTRY.counter(
+    "karpenter_preemption_evictions_total",
+    "Victim pods evicted by the preemption controller so a pending "
+    "higher-priority pod can land, by nodepool")
+PREEMPTION_NOMINATIONS = REGISTRY.counter(
+    "karpenter_preemption_nominations_total",
+    "Pending higher-priority pods that nominated a victim node "
+    "(status.nominatedNodeName stamped, victims evicted, binding "
+    "queued)")
+NODEPOOL_REGISTRATION_HEALTHY = REGISTRY.gauge(
+    "karpenter_nodepool_registration_healthy",
+    "Per-nodepool launch/registration health from the ring-buffer "
+    "tracker (1 healthy / 0 degraded — the NodeRegistrationHealthy "
+    "condition's signal, surfaced for operators)")
 DISRUPTION_PROBE_STARVATION = REGISTRY.counter(
     "karpenter_disruption_probe_starvation_total",
     "Consolidation probes attempted vs still remaining when a method's "

@@ -115,6 +115,17 @@ class Operator:
             self.kube, self.cluster, provider, options=self.options,
             recorder=self.recorder,
         )
+        from karpenter_tpu.provisioning.preemption import (
+            PreemptionController,
+        )
+
+        # priority preemption: pending higher-priority pods the solve
+        # could not place nominate lower-priority victims (PDB-aware,
+        # never equal/higher); landings ride the binding queue
+        self.preemption = PreemptionController(
+            self.kube, self.cluster, self.provisioner,
+            recorder=self.recorder,
+        )
         self.lifecycle = NodeClaimLifecycle(self.kube, provider, health=self.health)
         self.termination = TerminationController(
             self.kube, self.cluster, recorder=self.recorder
@@ -302,6 +313,15 @@ class Operator:
             # queued — restart must re-derive the plan from the API
             _faults.fire("crash_provision")
             self._enqueue_bindings(results, now, BIND_RESULTS_TTL_SECONDS)
+            # preemption acts on the round's capacity failures: a
+            # pending higher-priority pod that fit nothing nominates
+            # lower-priority victims; its landing plan rides the same
+            # binding queue (nominate-then-evict — the pod-level
+            # drain-after-replace ordering)
+            for binding in self.preemption.reconcile(results, now=now):
+                self._enqueue_bindings(
+                    binding, now, BIND_RESULTS_TTL_SECONDS
+                )
 
         with self.profiler.span("lifecycle"):
             if full:
@@ -660,6 +680,12 @@ class Operator:
             # incremental live tick: last oracle-audit verdict,
             # retained-state fingerprint + age, quarantine state
             "incremental": self.provisioner.incremental.status(),
+            # per-pool launch/registration health (state/nodepoolhealth
+            # ring buffers): a pool failing most recent registrations
+            # is visible here and in
+            # karpenter_nodepool_registration_healthy, not just in the
+            # NodeRegistrationHealthy condition
+            "nodepool_health": self.health.snapshot(),
             # malformed KARPENTER_FAULTS entries dropped at parse time:
             # a typo'd chaos knob must be visible here (and in
             # karpenter_faults_rejected_total), never silent

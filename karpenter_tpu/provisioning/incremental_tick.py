@@ -71,6 +71,7 @@ from karpenter_tpu.metrics.store import (
     SCHEDULER_UNSCHEDULABLE_PODS,
 )
 from karpenter_tpu.provisioning.scheduler import (
+    NO_CAPACITY_ERROR,
     SOLVE_TIMEOUT_SECONDS,
     NodeInputBuilder,
     SchedulerResults,
@@ -94,10 +95,6 @@ log = logging.getLogger("karpenter.incremental")
 ENV_ENABLE = "KARPENTER_INCREMENTAL"
 ENV_AUDIT_EVERY = "KARPENTER_INCR_AUDIT_EVERY"
 ENV_CHURN_MAX = "KARPENTER_INCR_CHURN_MAX"
-
-# Scheduler error string for unschedulable fast-path pods — must match
-# the full path byte-for-byte (the audit compares error sets)
-NO_CAPACITY_ERROR = "no compatible instance types or nodes"
 
 MAX_DIVERGENCE_RECORDS = 16
 RETRY_ROUNDS = 16  # k-way-evicted re-solve bound, mirrors Scheduler._solve
@@ -373,6 +370,15 @@ class IncrementalTickScheduler:
 
         for pod in pods:
             spec = pod.spec
+            if spec.priority or spec.priority_class_name:
+                # priority-bearing ticks route to the full path: the
+                # admission contract (Provisioner._enforce_priority_
+                # admission) wraps the full Scheduler's results, and
+                # the retained-state solve has no shed/cutoff
+                # machinery. Conservative first cut — widening the
+                # envelope to uniform-nonzero-priority ticks is a
+                # follow-up once the oracle audit covers it.
+                return "priority"
             if spec.volumes or spec.injected_requirements:
                 return "volumes"
             if pod_host_ports(pod):

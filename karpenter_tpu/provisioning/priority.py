@@ -1,0 +1,118 @@
+"""Priority-ordered admission: overload degrades by policy, not luck.
+
+Under overload (demand > NodePool limits or catalog capacity) the
+solver's unscheduled set used to be an accident of encode order — FFD
+fills whatever fits, so WHICH pods starve depended on shapes, not
+importance. "Priority Matters" (PAPERS.md) frames the right contract:
+with PriorityClass semantics resolved, the unscheduled set must be
+exactly the lowest-priority tail of the admission order, ties broken
+by the solver's own deterministic pod order (group_pods' priority-major
+FFD sort — the pod order the encode already commits to).
+
+The contract is enforced by `Provisioner._enforce_priority_admission`
+(a host-side wrapper around the unchanged solve): when a solve leaves
+CAPACITY-class failures among pods that are placeable in principle,
+the admission cutoff moves to the highest-priority such failure and
+everything at or past the cutoff is shed with `PRIORITY_SHED_ERROR`
+while the admitted prefix re-solves clean. Pods that could never
+schedule (no compatible launchable config, or too big for any machine)
+are OUTSIDE the contract: they keep their own errors and never drag
+the tail down with them.
+
+Engages only when the round's pods span MORE THAN ONE priority —
+uniform-priority rounds (every pod 0, the default) are byte-identical
+to the pre-priority behavior.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional, Sequence
+
+import numpy as np
+
+from karpenter_tpu.kube.objects import Pod
+from karpenter_tpu.utils import resources as resutil
+
+log = logging.getLogger("karpenter.priority")
+
+# capacity-class failure strings: truncation the admission contract
+# covers. Everything else (DRA, timeouts, topology infeasibility,
+# minValues policy rejects) is a permanent/transient error in its own
+# right — shedding the tail below such a pod would turn one wedged pod
+# into a cluster-wide outage. NO_CAPACITY_ERROR is canonical in
+# scheduler.py (its producer); LIMITS_ERROR is canonical HERE and
+# produced by Provisioner.create_node_claims — both matched by exact
+# string equality, so producers and consumers import, never respell.
+from karpenter_tpu.provisioning.scheduler import NO_CAPACITY_ERROR  # noqa: E402,F401
+
+LIMITS_ERROR = "nodepool limits exceeded"
+
+PRIORITY_SHED_ERROR = (
+    "insufficient capacity; shed by priority admission (lower-priority "
+    "tail, will retry next round)"
+)
+
+# capacity-class errors preemption may act on for a pending pod
+CAPACITY_ERRORS = (NO_CAPACITY_ERROR, LIMITS_ERROR, PRIORITY_SHED_ERROR)
+
+
+def mixed_priorities(pods: Sequence[Pod]) -> bool:
+    """True when the pod set spans more than one resolved priority —
+    the only case in which there IS a priority order to honor."""
+    seen: Optional[int] = None
+    for pod in pods:
+        p = pod.spec.priority
+        if seen is None:
+            seen = p
+        elif p != seen:
+            return True
+    return False
+
+
+def admission_order(pods: Sequence[Pod]) -> list[Pod]:
+    """The admission order the contract is defined over: groups sorted
+    priority-major by group_pods (ties broken by the existing
+    deterministic FFD order), flattened group-major with pods in
+    arrival order within a group — exactly the pod order the encode's
+    decode tables commit to."""
+    from karpenter_tpu.solver.encode import group_pods
+
+    return [p for g in group_pods(pods) for p in g.pods]
+
+
+def placeable_keys(
+    pods: Sequence[Pod],
+    pools_with_types,
+    daemon_overhead: Optional[dict[str, dict[str, float]]] = None,
+) -> set[str]:
+    """Keys of pods that are placeable in principle: compatible with at
+    least one launchable config (requirements AND taints) whose
+    allocatable holds the pod's requests plus the pool's daemon
+    overhead. Only these participate in the tail contract — a pod no
+    catalog machine could ever hold is not 'capacity-truncated', it is
+    unschedulable, and must not shed the tail below it."""
+    from karpenter_tpu.solver.encode import (
+        _full_compat,
+        group_pods,
+        launch_configs,
+    )
+
+    groups = group_pods(pods)
+    configs = launch_configs(pools_with_types)
+    if not configs or not groups:
+        return set()
+    compat = _full_compat(groups, configs)
+    overhead = daemon_overhead or {}
+    out: set[str] = set()
+    for gi, group in enumerate(groups):
+        for ci in np.flatnonzero(compat[gi]):
+            cfg = configs[ci]
+            need = resutil.merge(
+                group.resources,
+                overhead.get(cfg.pool.metadata.name, {}),
+            )
+            if resutil.fits(need, cfg.instance_type.allocatable):
+                out.update(p.key for p in group.pods)
+                break
+    return out

@@ -52,6 +52,13 @@ _claim_counter = itertools.count(1)
 # (nodeclaimtemplate.go:34-37,119). Seconds; None = no default.
 DEFAULT_TERMINATION_GRACE_PERIOD: Optional[float] = None
 
+# demand_surge burst pods (solver/faults.py `provision_intake` site):
+# the label chaos suites use to find and retire a storm's pods, and the
+# priorities the seeded low/high mix resolves to
+SURGE_LABEL = "karpenter.sh/demand-surge"
+SURGE_HIGH_PRIORITY = 100
+SURGE_LOW_PRIORITY = -100
+
 
 def _specs_from_requirement(req: Requirement, relaxed: bool) -> list[RequirementSpec]:
     """Serialize one algebraic Requirement back into claim spec
@@ -255,19 +262,208 @@ class Provisioner:
         pods = list(extra_pods) or (
             self.get_pending_pods() + self.reschedulable_pods_from_deleting_nodes()
         )
+        if not extra_pods:
+            # live intake only: a scripted solve must never absorb a
+            # chaos burst meant for the reconcile loop
+            pods = self._consume_demand_surge(pods)
+        # admission-plugin analogue: resolve PriorityClass values onto
+        # spec.priority before anything groups the pods
+        from karpenter_tpu.scheduling.priority import resolve_pod_priorities
+
+        resolve_pod_priorities(pods, self.kube)
         if self._catalog_dirty.drain("NodePool"):
             self.encode_cache.invalidate()
         pools = self.ready_pools_with_types()
         # the incremental live tick is the default path; it returns
         # None for ticks outside its envelope (explicit extra_pods are
-        # a caller-scripted solve, not the live reconcile)
+        # a caller-scripted solve, not the live reconcile; priority-
+        # bearing ticks route to the full path via its eligibility
+        # gates, so admission below only ever sees full-path results)
         if not extra_pods:
             results = self.incremental.tick(pods, pools)
             if results is not None:
                 self.cluster.mark_pod_scheduling_decisions(pods)
                 return results
         results = self._make_scheduler(pools).solve(pods)
+        results = self._enforce_priority_admission(pods, pools, results)
         self.cluster.mark_pod_scheduling_decisions(pods)
+        return results
+
+    # -- priority admission (ISSUE 8) -----------------------------------------
+
+    def _consume_demand_surge(self, pods: list[Pod]) -> list[Pod]:
+        """The `provision_intake` fault site: a firing `demand_surge`
+        rule is consumed here as a deterministic burst of pending pods
+        — created in the store (a workload controller scaled out
+        mid-tick) and joined to this round's solve."""
+        from karpenter_tpu.solver import faults as _faults
+
+        try:
+            _faults.fire("provision_intake")
+        except _faults.DemandSurgeError as err:
+            burst = self._synthesize_surge(err)
+            log.warning(
+                "fault injected: %s (%d surge pods join this round)",
+                err, len(burst),
+            )
+            pods = pods + burst
+        except _faults.FaultError as err:
+            # a mis-kinded chaos spec aimed at this site must not take
+            # the reconcile loop down — consume and warn, exactly as
+            # the providers do at cloud_interrupt
+            log.warning(
+                "ignoring non-surge fault at provision_intake: %s", err
+            )
+        return pods
+
+    def _synthesize_surge(self, err) -> list[Pod]:
+        """Deterministic burst pods for one DemandSurgeError: names
+        `surge-<seq>-<i>`, priority low (-100) or high (100) decided by
+        the seeded hash — a pure function of (seed, seq), so the same
+        schedule injects byte-identical demand across runs. Bare pods
+        (no owner): an evicted or shed surge pod never rebirths, so the
+        storm is occurrence-bounded by construction."""
+        from karpenter_tpu.kube.objects import Container, PodSpec
+        from karpenter_tpu.solver.faults import _hash01
+
+        out: list[Pod] = []
+        for i in range(err.count):
+            name = f"surge-{err.seq}-{i}"
+            existing = self.kube.get_pod("default", name)
+            if existing is not None:
+                out.append(existing)
+                continue
+            high = _hash01(err.seed, f"surge-{err.seq}", i + 1) < 0.5
+            pod = Pod(
+                metadata=ObjectMeta(
+                    name=name,
+                    labels={SURGE_LABEL: str(err.seq)},
+                ),
+                spec=PodSpec(
+                    containers=[Container(
+                        requests={"cpu": 0.5, "memory": float(2**30)}
+                    )],
+                    priority=SURGE_HIGH_PRIORITY if high
+                    else SURGE_LOW_PRIORITY,
+                ),
+            )
+            self.kube.create(pod)
+            out.append(pod)
+        return out
+
+    def _plans_over_limits(self, plans: Sequence[NodePlan]) -> list[NodePlan]:
+        """Plans `create_node_claims` would reject for NodePool limits,
+        simulated WITHOUT mutation against the same usage snapshot and
+        in the same order the real create walks — so the admission loop
+        can fold limit truncation into the priority cutoff before any
+        claim exists."""
+        usage_by_pool = self.cluster.nodepool_resources()
+        over: list[NodePlan] = []
+        for plan in plans:
+            pool = plan.pool
+            if not pool.spec.limits:
+                continue
+            usage = usage_by_pool.get(pool.metadata.name, {})
+            fitting = [
+                it for it in plan.instance_types
+                if all(
+                    usage.get(key, 0.0) + it.capacity.get(key, 0.0) <= limit
+                    for key, limit in pool.spec.limits.items()
+                )
+            ]
+            if not fitting:
+                over.append(plan)
+                continue
+            # create also rejects when the surviving types leave the
+            # plan's OFFERING set empty (a spot-budget pin can strip
+            # every offering of the limit-fitting types) — a plan this
+            # sim passes but create would kill breaks the tail contract
+            if plan.offerings and not any(
+                o in it.offerings for it in fitting for o in plan.offerings
+            ):
+                over.append(plan)
+                continue
+            usage_by_pool[pool.metadata.name] = resutil.merge(
+                usage, fitting[0].capacity
+            )
+        return over
+
+    def _enforce_priority_admission(
+        self, pods: Sequence[Pod], pools, results: SchedulerResults,
+    ) -> SchedulerResults:
+        """The overload degradation contract (provisioning/priority.py):
+        when capacity (catalog or pool limits) truncates the solve, the
+        unscheduled set must be exactly the lowest-priority tail of the
+        admission order. Iterates cutoff-and-re-solve until the
+        admitted prefix is clean; the cutoff strictly decreases, so the
+        loop terminates. No-op on uniform-priority rounds."""
+        from karpenter_tpu.metrics.store import PRIORITY_SHED
+        from karpenter_tpu.provisioning import priority as padm
+
+        pods = list(pods)
+        if not padm.mixed_priorities(pods):
+            return results
+        # order/placeable are built lazily on the FIRST capacity
+        # failure: the healthy mixed-priority round pays only the
+        # mixed scan above and the limit simulation below
+        order: Optional[list] = None
+        pos: dict = {}
+        placeable: set = set()
+        cut = 0
+        for _ in range(16):
+            raw_failed = [
+                key for key, error in results.errors.items()
+                if error == padm.NO_CAPACITY_ERROR
+            ]
+            for plan in self._plans_over_limits(results.new_node_plans):
+                raw_failed.extend(p.key for p in plan.pods)
+            if order is None:
+                if not raw_failed:
+                    return results
+                from karpenter_tpu.provisioning.scheduler import (
+                    NodeInputBuilder,
+                )
+
+                order = padm.admission_order(pods)
+                pos = {p.key: i for i, p in enumerate(order)}
+                cut = len(order)
+                placeable = padm.placeable_keys(
+                    pods, pools,
+                    NodeInputBuilder(
+                        pools, self.cluster.daemonsets()
+                    ).daemon_overhead(),
+                )
+            failed = [
+                k for k in raw_failed
+                if k in placeable and pos.get(k, cut) < cut
+            ]
+            if not failed:
+                break
+            cut = min(pos[k] for k in failed)
+            # re-solve the admitted prefix; unplaceable pods rejoin so
+            # their permanent errors keep reporting
+            keep = order[:cut] + [
+                p for p in order[cut:] if p.key not in placeable
+            ]
+            results = self._make_scheduler(pools).solve(keep)
+        else:
+            log.warning(
+                "priority admission did not converge in 16 rounds; "
+                "serving the last solve's results"
+            )
+        if order is None or cut >= len(order):
+            return results
+        shed = [p for p in order[cut:] if p.key in placeable]
+        for pod in shed:
+            results.errors[pod.key] = padm.PRIORITY_SHED_ERROR
+        if shed:
+            PRIORITY_SHED.inc(value=float(len(shed)))
+            log.warning(
+                "priority admission: demand exceeds capacity; shed %d "
+                "pod(s) at or below priority %d (cutoff honors the "
+                "deterministic admission order)",
+                len(shed), order[cut].spec.priority,
+            )
         return results
 
     # -- create (provisioner.go:407-459) --------------------------------------
@@ -283,8 +479,12 @@ class Provisioner:
         for plan in results.new_node_plans:
             claim = self._claim_from_plan(plan, usage_by_pool)
             if claim is None:
+                from karpenter_tpu.provisioning.priority import (
+                    LIMITS_ERROR,
+                )
+
                 for pod in plan.pods:
-                    results.errors[pod.key] = "nodepool limits exceeded"
+                    results.errors[pod.key] = LIMITS_ERROR
                 continue
             if claim.status.capacity:
                 pool_name = plan.pool.metadata.name

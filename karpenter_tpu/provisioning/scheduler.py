@@ -81,6 +81,12 @@ SOLVE_TIMEOUT_SECONDS = 60.0
 
 TIMEOUT_ERROR = "scheduling timed out; will retry next round"
 
+# the batched fast path's capacity failure — the CANONICAL string:
+# priority admission, the disruption priority veto, preemption, and
+# the incremental tick's audit all match on it exactly, so every
+# producer and consumer must import THIS constant
+NO_CAPACITY_ERROR = "no compatible instance types or nodes"
+
 # DRA pods are rejected permanently (no relaxation retry) while the
 # ignore-dra-requests flag is on — scheduler.go:489-491, 448-452
 DRA_ERROR = (
@@ -716,6 +722,16 @@ class Scheduler:
         # (provisioner.go:365-368); work completed before the deadline
         # is kept, pods not yet placed report TIMEOUT_ERROR
         self._deadline = self.clock() + self.solve_timeout
+        if self.kube is not None:
+            # PriorityClass resolution at every solve entry (the
+            # volume-topology pattern): provisioning and disruption
+            # simulations group pods by the same resolved priorities
+            # no matter which caller stamped the pods last
+            from karpenter_tpu.scheduling.priority import (
+                resolve_pod_priorities,
+            )
+
+            resolve_pod_priorities(list(pods), self.kube)
         dra_rejected: list[Pod] = []
         if self.ignore_dra_requests:
             # DRA gate (scheduler.go:489-491): device allocation can't
@@ -840,7 +856,7 @@ class Scheduler:
                             )
                             retried = True
                 if not retried:
-                    results.errors[pod.key] = "no compatible instance types or nodes"
+                    results.errors[pod.key] = NO_CAPACITY_ERROR
             for plan in open_plans:
                 for pod in plan.pods:
                     topology_full.register(

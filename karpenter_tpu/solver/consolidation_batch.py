@@ -782,6 +782,7 @@ class BatchProbeSolver:
         ladder) would have engaged."""
         from karpenter_tpu.provisioning.scheduler import (
             DRA_ERROR,
+            NO_CAPACITY_ERROR,
             SchedulerResults,
         )
 
@@ -801,7 +802,7 @@ class BatchProbeSolver:
             name = sched.existing_inputs[a.existing_index].name
             results.existing_assignments.setdefault(name, []).extend(a.pods)
         for pod in sol.unschedulable:
-            results.errors[pod.key] = "no compatible instance types or nodes"
+            results.errors[pod.key] = NO_CAPACITY_ERROR
         for key in dra:
             results.errors[key] = DRA_ERROR
         for plan in kept:

@@ -58,12 +58,16 @@ BASE_RESOURCES = (resutil.CPU, resutil.MEMORY, resutil.PODS, resutil.EPHEMERAL_S
 
 @dataclass
 class PodGroup:
-    """Pods sharing requirements/tolerations/resources."""
+    """Pods sharing requirements/tolerations/resources/priority."""
 
     requirements: Requirements
     tolerations: tuple
     resources: dict[str, float]
     pods: list[Pod] = field(default_factory=list)
+    # resolved PriorityClass value shared by the group's pods: groups
+    # order priority-major, so the encode's group axis IS the
+    # degradation order priority admission truncates against
+    priority: int = 0
 
     @property
     def count(self) -> int:
@@ -71,8 +75,13 @@ class PodGroup:
 
 
 def group_pods(pods: Sequence[Pod], required_only: bool = False) -> list[PodGroup]:
-    """Group pods by scheduling signature, sorted CPU+memory descending
-    (the reference queue's FFD order, scheduling/queue.go:31-60).
+    """Group pods by scheduling signature, sorted priority-descending,
+    then CPU+memory descending within a priority band (the reference
+    queue's FFD order, scheduling/queue.go:31-60). Pods of different
+    priorities never share a group — a group's unplaced tail must be
+    attributable to ONE priority for the admission contract to hold —
+    and with uniform priority (every pod 0, the common case) the order
+    is byte-identical to the pre-priority sort.
 
     Requirements/resource parsing is memoized on a cheap raw-spec key so
     a 50k-pod batch with a few hundred distinct shapes pays the parse
@@ -101,6 +110,7 @@ def group_pods(pods: Sequence[Pod], required_only: bool = False) -> list[PodGrou
             ) if spec.init_containers else None,
             frozenset(spec.overhead.items()) if spec.overhead else None,
             frozenset(spec.resources.items()) if spec.resources else None,
+            spec.priority,
         )
         hit = parsed.get(raw)
         if hit is None:
@@ -111,18 +121,21 @@ def group_pods(pods: Sequence[Pod], required_only: bool = False) -> list[PodGrou
                 reqs.signature(),
                 tols,
                 tuple(sorted(resources.items())),
+                spec.priority,
             )
-            hit = (signature, reqs, tols, resources)
+            hit = (signature, reqs, tols, resources, spec.priority)
             parsed[raw] = hit
-        signature, reqs, tols, resources = hit
+        signature, reqs, tols, resources, priority = hit
         group = groups.get(signature)
         if group is None:
-            group = PodGroup(requirements=reqs, tolerations=tols, resources=resources)
+            group = PodGroup(requirements=reqs, tolerations=tols,
+                             resources=resources, priority=priority)
             groups[signature] = group
         group.pods.append(pod)
     return sorted(
         groups.values(),
         key=lambda g: (
+            -g.priority,
             -(g.resources.get(resutil.CPU, 0.0)),
             -(g.resources.get(resutil.MEMORY, 0.0)),
             g.requirements.signature(),
@@ -194,6 +207,13 @@ class Encoded:
     pool_min_values: np.ndarray = None    # [P+1] bool pools with minValues
                                           # floors (host decode metadata;
                                           # not shipped to the service)
+    group_priority: np.ndarray = None     # [G] int32 resolved PriorityClass
+                                          # value per group (groups order
+                                          # priority-major — the degradation
+                                          # order priority admission
+                                          # truncates against). Host decode
+                                          # metadata; not shipped to the
+                                          # service.
     # After column dedupe, every member (price, ConfigInfo) each column
     # represents — identical (pool, allocatable, compat column) configs
     # collapse to one device column and re-expand at decode. Aligned
@@ -353,8 +373,10 @@ def encode(
 
     group_req = np.zeros((G, R), np.float32)
     group_count = np.zeros((G,), np.int32)
+    group_priority = np.zeros((G,), np.int32)
     for gi, group in enumerate(groups):
         group_count[gi] = group.count
+        group_priority[gi] = group.priority
         for ri, key in enumerate(keys):
             group_req[gi, ri] = group.resources.get(key, 0.0)
 
@@ -573,6 +595,7 @@ def encode(
         n_existing=len(existing),
         group_req=group_req,
         group_count=group_count,
+        group_priority=group_priority,
         compat=compat,
         cfg_alloc=cfg_alloc,
         cfg_price=cfg_price,

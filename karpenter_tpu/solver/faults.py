@@ -16,10 +16,11 @@ Spec grammar (KARPENTER_FAULTS, comma-separated entries):
     kind   = device_lost | rpc_drop | compile_delay | exec_delay
            | kube_conflict | kube_throttle | kube_watch_drop
            | kube_stale_list | kube_write_partial | operator_crash
-           | spot_interruption | cache_poison
+           | spot_interruption | cache_poison | demand_surge
     occ    = "*" | N | N "+" | N "-" M        (1-based, per site)
     param  = duration                         (delay / retry-after kinds)
            | rate                             (spot_interruption: 0 < r <= 1)
+           | count                            (demand_surge: pods per burst)
 
 Examples:
     device_lost@solve:3        third device solve raises DeviceLostError
@@ -43,6 +44,13 @@ Examples:
                                              KARPENTER_FAULT_SEED picks the
                                              schedule; same seed + same spec
                                              replay byte-identically.
+    demand_surge@provision_intake:2=500      the 2nd live provisioning intake
+                                             absorbs a seeded burst of 500
+                                             pending pods (mixed low/high
+                                             PriorityClass values, shapes
+                                             hashed from seed+occurrence) —
+                                             the overload storm priority
+                                             admission must degrade through
 
 Default sites per kind: device_lost -> solve, rpc_drop -> rpc,
 compile_delay -> compile, exec_delay -> execute, kube faults -> their
@@ -68,6 +76,16 @@ Cloud sites (hooked into the kwok/fake providers):
                      deterministically (the first fleet key in sorted
                      order gains phantom capacity), so the oracle audit
                      has a real stale-cache divergence to catch
+
+    provision_intake one live provisioning intake of the provisioner
+                     (Provisioner.schedule, the non-scripted path); a
+                     firing demand_surge rule raises DemandSurgeError,
+                     which the provisioner CONSUMES — a deterministic
+                     burst of pending pods (names/shapes/priorities
+                     hashed from the fault seed and the site sequence
+                     number) is created in the kube store and joins the
+                     round's solve, modeling a workload controller
+                     scaling out mid-tick
 
     cloud_interrupt  one interruption check of one live spot instance
                      (providers iterate spot instances in sorted
@@ -131,7 +149,7 @@ CRASH_SITES = (
 SITES = (
     "solve", "compile", "execute", "probe", "warm", "rpc", "rpc_server",
     "kube_read", "kube_list", "kube_write", "kube_watch",
-    "cloud_interrupt", "incremental",
+    "cloud_interrupt", "incremental", "provision_intake",
 ) + CRASH_SITES
 
 _DEFAULT_SITE = {
@@ -147,12 +165,14 @@ _DEFAULT_SITE = {
     "operator_crash": "crash_tick",
     "spot_interruption": "cloud_interrupt",
     "cache_poison": "incremental",
+    "demand_surge": "provision_intake",
 }
 
 _ERROR_KINDS = (
     "device_lost", "rpc_drop", "kube_conflict", "kube_throttle",
     "kube_watch_drop", "kube_stale_list", "kube_write_partial",
     "operator_crash", "spot_interruption", "cache_poison",
+    "demand_surge",
 )
 
 
@@ -218,6 +238,23 @@ class CachePoisonError(FaultError):
     stale-cache failure the oracle audit exists to catch."""
 
 
+class DemandSurgeError(FaultError):
+    """Injected demand surge: a workload controller scaled out between
+    two ticks. Raised at the provisioner's `provision_intake` site and
+    CONSUMED there — a seeded burst of `count` pending pods (mixed
+    low/high PriorityClass values, deterministic names
+    `surge-<seq>-<i>`) is created and joins the round's solve. `seq`
+    and `seed` make the burst a pure function of the schedule, so two
+    runs of the same spec inject byte-identical demand."""
+
+    def __init__(self, message: str, count: int = 0, seq: int = 0,
+                 seed: str = "0"):
+        super().__init__(message)
+        self.count = count
+        self.seq = seq
+        self.seed = seed
+
+
 class SpotInterruptionError(FaultError):
     """Injected spot-capacity interruption notice. Raised at the
     provider's `cloud_interrupt` check for one instance and CONSUMED
@@ -234,6 +271,7 @@ class FaultRule:
     hi: int            # last occurrence inclusive; -1 == open-ended
     delay: float = 0.0
     rate: float = 1.0  # <1.0: fire w.p. rate, seeded-hash-decided per seq
+    count: int = 0     # demand_surge: pods per injected burst
 
     def matches(self, seq: int) -> bool:
         if self.lo == 0:
@@ -300,6 +338,7 @@ def parse(spec: str, rejected: Optional[list] = None) -> list[FaultRule]:
             if (occ and occ != "*" and lo < 1) or (hi >= 0 and hi < lo):
                 raise ValueError(f"bad occurrence range {occ!r}")
             rate = 1.0
+            count = 0
             if kind == "spot_interruption":
                 # the =param is a probability per occurrence, not a
                 # duration (spec grammar: spot_interruption@...:occ=rate)
@@ -307,11 +346,17 @@ def parse(spec: str, rejected: Optional[list] = None) -> list[FaultRule]:
                 if not 0.0 < rate <= 1.0:
                     raise ValueError(f"bad interruption rate {param!r}")
                 delay = 0.0
+            elif kind == "demand_surge":
+                # the =param is the burst size in pods
+                count = int(param) if param else 16
+                if count < 1:
+                    raise ValueError(f"bad surge count {param!r}")
+                delay = 0.0
             else:
                 delay = _parse_duration(param) if param else 0.0
             if kind.endswith("_delay") and delay <= 0.0:
                 raise ValueError("delay kind needs a =duration")
-            rules.append(FaultRule(kind, site, lo, hi, delay, rate))
+            rules.append(FaultRule(kind, site, lo, hi, delay, rate, count))
         except (ValueError, IndexError) as err:
             log.warning("ignoring malformed fault entry %r: %s", raw, err)
             if rejected is not None:
@@ -375,11 +420,13 @@ class FaultInjector:
             log.warning("fault injected: %s", error)
             raise error
 
-    @staticmethod
-    def _make_error(rule: FaultRule, site: str, seq: int) -> FaultError:
+    def _make_error(self, rule: FaultRule, site: str, seq: int) -> FaultError:
         message = f"injected {rule.kind}@{site}:{seq}"
         if rule.kind == "kube_throttle":
             return KubeThrottleError(message, retry_after=rule.delay)
+        if rule.kind == "demand_surge":
+            return DemandSurgeError(message, count=rule.count, seq=seq,
+                                    seed=self.seed)
         cls = {
             "device_lost": DeviceLostError,
             "rpc_drop": RpcDropError,

@@ -432,7 +432,7 @@ def _race_fingerprint(enc: Encoded) -> bytes:
         h.update(np.ascontiguousarray(buf).tobytes())
     for opt in (
         enc.cfg_rsv, enc.rsv_cap, enc.group_cap, enc.conflict,
-        enc.existing_quota, enc.loose_groups,
+        enc.existing_quota, enc.loose_groups, enc.group_priority,
     ):
         h.update(
             b"\x00" if opt is None

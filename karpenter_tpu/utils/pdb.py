@@ -37,6 +37,13 @@ class PdbLimits:
             and pdb.spec.selector.matches(pod.metadata.labels)
         ]
 
+    def matching(self, pod: Pod) -> list[PodDisruptionBudget]:
+        """The PDBs selecting this pod — public for callers that plan
+        multi-victim evictions (preemption) and must budget a WHOLE
+        victim set against each selecting PDB, not just the first
+        victim (can_evict is point-in-time per pod)."""
+        return self._matching(pod)
+
     def disruptions_allowed(self, pdb: PodDisruptionBudget) -> int:
         """Compute allowed disruptions from live pod state (the real
         controller-manager maintains status; we derive it)."""

@@ -39,6 +39,11 @@ def pytest_configure(config):
         "-m interruption_chaos)",
     )
     config.addinivalue_line(
+        "markers",
+        "surge_chaos: seeded demand-surge overload storm convergence "
+        "scenarios (part of tier-1; select alone with -m surge_chaos)",
+    )
+    config.addinivalue_line(
         "markers", "slow: excluded from the tier-1 verify run"
     )
 

@@ -1,0 +1,99 @@
+"""Fault-docs drift guard (ISSUE 8 satellite, pattern of
+test_solve_entry_sites / test_kube_write_sites): every fault SITE and
+KIND registered in `solver/faults.py` must have a row (site) or a
+mention in a row (kind) of README's fault classification table. A new
+fault landed without documentation is a failing build, not a silent
+chaos knob nobody can discover.
+
+Sites/kinds are extracted from the module's AST (the `SITES` and
+`CRASH_SITES` tuples and the `_DEFAULT_SITE` dict literal), so the
+guard tracks the source of truth without importing conventions.
+"""
+
+import ast
+import pathlib
+import re
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+FAULTS = REPO / "karpenter_tpu" / "solver" / "faults.py"
+README = REPO / "README.md"
+
+
+def _module_constants():
+    """(sites, kinds) from solver/faults.py's own literals."""
+    tree = ast.parse(FAULTS.read_text(), filename=str(FAULTS))
+    consts: dict[str, ast.AST] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            for target in node.targets:
+                if isinstance(target, ast.Name):
+                    consts[target.id] = node.value
+
+    def _tuple_strings(value) -> list[str]:
+        out = []
+        for elt in ast.walk(value):
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                out.append(elt.value)
+        return out
+
+    assert "SITES" in consts, "faults.SITES moved; update this guard"
+    assert "_DEFAULT_SITE" in consts, (
+        "faults._DEFAULT_SITE moved; update this guard"
+    )
+    # SITES is `(...literals...) + CRASH_SITES`; walking the BinOp's
+    # left side plus the CRASH_SITES tuple covers both halves
+    sites = set(_tuple_strings(consts["SITES"]))
+    if "CRASH_SITES" in consts:
+        sites |= set(_tuple_strings(consts["CRASH_SITES"]))
+    default_site = consts["_DEFAULT_SITE"]
+    assert isinstance(default_site, ast.Dict)
+    kinds = {
+        key.value for key in default_site.keys
+        if isinstance(key, ast.Constant) and isinstance(key.value, str)
+    }
+    return sites, kinds
+
+
+def _table_rows():
+    """README table rows (lines shaped `| ... | ... |`)."""
+    return [
+        line for line in README.read_text().splitlines()
+        if line.strip().startswith("|")
+    ]
+
+
+def test_every_fault_site_has_a_readme_table_row():
+    sites, _ = _module_constants()
+    rows = _table_rows()
+    missing = []
+    for site in sorted(sites):
+        pattern = re.compile(r"^\|\s*`" + re.escape(site) + r"`\s*\|")
+        if not any(pattern.match(row.strip()) for row in rows):
+            missing.append(site)
+    assert not missing, (
+        "fault sites registered in solver/faults.py without a row in "
+        f"README's fault classification table: {missing}"
+    )
+
+
+def test_every_fault_kind_appears_in_the_readme_table():
+    _, kinds = _module_constants()
+    rows = "\n".join(_table_rows())
+    missing = [
+        kind for kind in sorted(kinds)
+        if f"`{kind}`" not in rows
+    ]
+    assert not missing, (
+        "fault kinds registered in solver/faults.py without a mention "
+        f"in README's fault classification table: {missing}"
+    )
+
+
+def test_guard_reads_the_real_registry():
+    """Self-check: the AST extraction sees the known core entries, so
+    a refactor that silently empties it cannot green-wash the guard."""
+    sites, kinds = _module_constants()
+    assert {"solve", "kube_write", "provision_intake",
+            "crash_incr_commit"} <= sites
+    assert {"device_lost", "demand_surge", "spot_interruption",
+            "cache_poison"} <= kinds

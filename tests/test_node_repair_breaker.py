@@ -1,0 +1,125 @@
+"""Node-repair circuit breaker boundary (ISSUE 8 satellite).
+
+`lifecycle/garbagecollection.NodeHealthController` abstains when MORE
+than 20% of the cluster is unhealthy (node/health/controller.go's
+circuit breaker). The boundary semantics are exact and worth pinning:
+
+- unhealthy fraction EXACTLY at the threshold (20%) -> breaker stays
+  closed, repairs proceed;
+- one node past it -> breaker opens, and an open breaker leaves every
+  node untouched (no claim deletions, not even for the unhealthy
+  ones);
+- the single-node cluster escape hatch (`len(nodes) > 1`) repairs a
+  100%-unhealthy singleton.
+"""
+
+import time
+
+from karpenter_tpu.cloudprovider.fake import GIB, make_instance_type
+from karpenter_tpu.cloudprovider.types import RepairPolicy
+from karpenter_tpu.lifecycle.garbagecollection import (
+    UNHEALTHY_CLUSTER_THRESHOLD,
+    NodeHealthController,
+)
+from karpenter_tpu.kube.objects import NodeCondition
+from karpenter_tpu.operator.options import FeatureGates, Options
+from karpenter_tpu.testing import Environment, mk_nodepool, mk_pod
+
+POLICY = RepairPolicy(
+    condition_type="BadDevice", condition_status="True",
+    toleration_duration=60.0,
+)
+
+
+def _cluster(n_nodes: int):
+    """n one-pod nodes with repair enabled."""
+    env = Environment(
+        types=[make_instance_type("c4", cpu=4, memory=16 * GIB)]
+    )
+    env.kube.create(mk_nodepool("default"))
+    env.provision(
+        *[mk_pod(name=f"p-{i}", cpu=2.0) for i in range(n_nodes)],
+        now=0.0,
+    )
+    assert len(env.kube.nodes()) == n_nodes
+    env.cloud._repair_policies = [POLICY]
+    controller = NodeHealthController(
+        env.kube, env.cloud,
+        Options(feature_gates=FeatureGates(node_repair=True)),
+    )
+    return env, controller
+
+
+def _mark_unhealthy(env, count: int, since: float = 0.0):
+    nodes = sorted(env.kube.nodes(), key=lambda n: n.metadata.name)
+    for node in nodes[:count]:
+        node.status.conditions.append(NodeCondition(
+            type="BadDevice", status="True",
+            last_transition_time=since,
+        ))
+        env.kube.touch(node)
+
+
+class TestRepairBreakerBoundary:
+    def test_exactly_at_threshold_repairs(self):
+        """1/5 unhealthy = 20% exactly: NOT strictly greater than the
+        threshold, so the breaker stays closed and the node repairs."""
+        env, controller = _cluster(5)
+        _mark_unhealthy(env, 1)
+        assert 1 / 5 == UNHEALTHY_CLUSTER_THRESHOLD
+        repaired = controller.reconcile(now=100.0)
+        assert len(repaired) == 1
+        deleting = [
+            c for c in env.kube.node_claims()
+            if c.metadata.deletion_timestamp is not None
+        ]
+        assert len(deleting) == 1
+
+    def test_one_past_threshold_opens_breaker(self):
+        """2/5 unhealthy = 40% > 20%: the breaker opens and NOTHING is
+        touched — no claim gains a deletion timestamp, unhealthy nodes
+        included."""
+        env, controller = _cluster(5)
+        _mark_unhealthy(env, 2)
+        before = {
+            c.metadata.name: c.metadata.deletion_timestamp
+            for c in env.kube.node_claims()
+        }
+        repaired = controller.reconcile(now=100.0)
+        assert repaired == []
+        after = {
+            c.metadata.name: c.metadata.deletion_timestamp
+            for c in env.kube.node_claims()
+        }
+        assert after == before, "open breaker must leave nodes untouched"
+
+    def test_breaker_open_is_not_sticky(self):
+        """The breaker is a per-reconcile verdict: once the unhealthy
+        fraction drops back to the threshold, repairs resume."""
+        env, controller = _cluster(5)
+        _mark_unhealthy(env, 2)
+        assert controller.reconcile(now=100.0) == []
+        # one node recovers: its condition flips away from the policy
+        nodes = sorted(env.kube.nodes(), key=lambda n: n.metadata.name)
+        nodes[0].status.conditions = [
+            c for c in nodes[0].status.conditions
+            if c.type != "BadDevice"
+        ]
+        env.kube.touch(nodes[0])
+        assert len(controller.reconcile(now=101.0)) == 1
+
+    def test_singleton_cluster_repairs_despite_full_unhealthy(self):
+        """len(nodes) > 1 gates the breaker: a 100%-unhealthy
+        single-node cluster still repairs (abstaining forever would
+        wedge it)."""
+        env, controller = _cluster(1)
+        _mark_unhealthy(env, 1)
+        assert len(controller.reconcile(now=100.0)) == 1
+
+    def test_toleration_duration_gates_eligibility(self):
+        """A condition younger than the policy's toleration never
+        counts as unhealthy — neither for repair nor for the breaker
+        denominator."""
+        env, controller = _cluster(5)
+        _mark_unhealthy(env, 1, since=90.0)  # 10s old vs 60s toleration
+        assert controller.reconcile(now=100.0) == []

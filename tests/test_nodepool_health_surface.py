@@ -1,0 +1,64 @@
+"""NodePool registration health surfaced to operators (ISSUE 8
+satellite): the state/nodepoolhealth ring buffers were state-only —
+visible to the NodeRegistrationHealthy condition writer and nobody
+else. Now every record publishes
+`karpenter_nodepool_registration_healthy{nodepool}` and
+`Operator.readyz()["nodepool_health"]` snapshots the degraded set.
+"""
+
+from karpenter_tpu.cloudprovider.fake import GIB, make_instance_type
+from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
+from karpenter_tpu.kube.client import KubeClient
+from karpenter_tpu.metrics.store import NODEPOOL_REGISTRATION_HEALTHY
+from karpenter_tpu.operator.operator import Operator
+from karpenter_tpu.state.nodepoolhealth import HealthTracker
+from karpenter_tpu.testing import mk_nodepool
+
+
+class TestHealthGauge:
+    def test_record_publishes_gauge(self):
+        tracker = HealthTracker()
+        tracker.record("pool-g1", True)
+        assert NODEPOOL_REGISTRATION_HEALTHY.value(
+            {"nodepool": "pool-g1"}
+        ) == 1.0
+        for _ in range(6):
+            tracker.record("pool-g1", False)
+        assert NODEPOOL_REGISTRATION_HEALTHY.value(
+            {"nodepool": "pool-g1"}
+        ) == 0.0
+        tracker.reset("pool-g1")
+        # series dropped, not frozen at the stale verdict
+        assert ({"nodepool": "pool-g1"} not in [
+            dict(k) for k, _ in NODEPOOL_REGISTRATION_HEALTHY.samples()
+        ])
+
+    def test_snapshot_reports_degraded_pools(self):
+        tracker = HealthTracker()
+        tracker.record("good", True)
+        for _ in range(5):
+            tracker.record("bad", False)
+        snap = tracker.snapshot()
+        assert snap["tracked_pools"] == 2
+        assert list(snap["degraded"]) == ["bad"]
+        assert snap["degraded"]["bad"]["recent_failures"] == 5
+        assert snap["degraded"]["bad"]["window"] == 5
+
+
+class TestReadyzSurface:
+    def test_readyz_carries_nodepool_health(self):
+        kube = KubeClient()
+        cloud = KwokCloudProvider(
+            kube, types=[make_instance_type("c4", cpu=4, memory=16 * GIB)]
+        )
+        op = Operator(kube, cloud)
+        kube.create(mk_nodepool("flaky"))
+        for _ in range(5):
+            op.health.record("flaky", False)
+        ready = op.readyz()
+        health = ready["nodepool_health"]
+        assert health["tracked_pools"] == 1
+        assert "flaky" in health["degraded"]
+        assert NODEPOOL_REGISTRATION_HEALTHY.value(
+            {"nodepool": "flaky"}
+        ) == 0.0

@@ -1,0 +1,301 @@
+"""Priority-aware overload protection (ISSUE 8): PriorityClass
+resolution, the priority-ordered admission contract and its fuzz
+oracle, the preemption controller, and the priority-aware disruption
+veto.
+
+The admission oracle is the tentpole's acceptance check: under demand
+> capacity (fuzzed pool limits and catalogs), the unscheduled set must
+equal the LOWEST-PRIORITY TAIL of the admission order — sorted pods by
+(-priority, deterministic FFD order), the unscheduled pods are exactly
+a suffix — across seeds.
+"""
+
+import random
+
+import pytest
+
+from karpenter_tpu.cloudprovider.fake import GIB, make_instance_type
+from karpenter_tpu.kube.objects import ObjectMeta, PriorityClass
+from karpenter_tpu.provisioning.priority import (
+    PRIORITY_SHED_ERROR,
+    admission_order,
+    mixed_priorities,
+    placeable_keys,
+)
+from karpenter_tpu.scheduling.priority import (
+    SYSTEM_CLASSES,
+    resolve_pod_priorities,
+    resolve_priority,
+)
+from karpenter_tpu.testing import Environment, mk_nodepool, mk_pod
+
+
+def _env(types=None, limits=None, consolidate="Never"):
+    env = Environment(
+        types=types or [make_instance_type("c4", cpu=4, memory=16 * GIB)]
+    )
+    pool = mk_nodepool("default", limits=limits or {})
+    pool.spec.disruption.consolidate_after = consolidate
+    env.kube.create(pool)
+    return env, pool
+
+
+class TestPriorityResolution:
+    def test_class_name_resolves_value(self):
+        env, _ = _env()
+        env.kube.create(PriorityClass(
+            metadata=ObjectMeta(name="critical", namespace=""), value=5000
+        ))
+        pod = mk_pod(name="p")
+        pod.spec.priority_class_name = "critical"
+        env.kube.create(pod)
+        resolve_pod_priorities([pod], env.kube)
+        assert pod.spec.priority == 5000
+
+    def test_explicit_priority_wins_over_class(self):
+        env, _ = _env()
+        env.kube.create(PriorityClass(
+            metadata=ObjectMeta(name="critical", namespace=""), value=5000
+        ))
+        pod = mk_pod(name="p")
+        pod.spec.priority = 7
+        pod.spec.priority_class_name = "critical"
+        resolve_pod_priorities([pod], env.kube)
+        assert pod.spec.priority == 7
+
+    def test_global_default_applies_without_class_name(self):
+        env, _ = _env()
+        env.kube.create(PriorityClass(
+            metadata=ObjectMeta(name="dft", namespace=""), value=42,
+            global_default=True,
+        ))
+        pod = mk_pod(name="p")
+        resolve_pod_priorities([pod], env.kube)
+        assert pod.spec.priority == 42
+
+    def test_dangling_class_name_resolves_to_zero(self):
+        env, _ = _env()
+        pod = mk_pod(name="p")
+        pod.spec.priority_class_name = "nonexistent"
+        resolve_pod_priorities([pod], env.kube)
+        assert pod.spec.priority == 0
+
+    def test_system_classes_known_without_objects(self):
+        pod = mk_pod(name="p")
+        pod.spec.priority_class_name = "system-cluster-critical"
+        assert resolve_priority(pod, {}) == SYSTEM_CLASSES[
+            "system-cluster-critical"
+        ]
+
+    def test_mixed_priorities_detector(self):
+        a, b = mk_pod(name="a"), mk_pod(name="b")
+        assert not mixed_priorities([a, b])
+        b.spec.priority = 1
+        assert mixed_priorities([a, b])
+
+    def test_round_trips_through_cr(self):
+        from karpenter_tpu.kube.serialize import from_cr, to_cr
+
+        pc = PriorityClass(
+            metadata=ObjectMeta(name="gold", namespace=""), value=900,
+            global_default=True, preemption_policy="Never",
+        )
+        back = from_cr(to_cr(pc))
+        assert back.value == 900
+        assert back.global_default is True
+        assert back.preemption_policy == "Never"
+
+
+class TestAdmissionOrder:
+    def test_priority_major_then_ffd(self):
+        big_low = mk_pod(name="big-low", cpu=3.0)
+        small_high = mk_pod(name="small-high", cpu=0.5)
+        small_high.spec.priority = 10
+        order = admission_order([big_low, small_high])
+        assert [p.metadata.name for p in order] == [
+            "small-high", "big-low"
+        ]
+
+    def test_uniform_priority_keeps_ffd_order(self):
+        big = mk_pod(name="big", cpu=3.0)
+        small = mk_pod(name="small", cpu=0.5)
+        order = admission_order([small, big])
+        assert [p.metadata.name for p in order] == ["big", "small"]
+
+
+class TestAdmissionContract:
+    def test_high_priority_survives_pool_limit_overload(self):
+        env, _ = _env(limits={"cpu": 8.0})  # two c4 nodes max
+        pods = []
+        for i in range(4):
+            p = mk_pod(name=f"hi-{i}", cpu=1.5)
+            p.spec.priority = 1000
+            pods.append(p)
+        for i in range(6):
+            pods.append(mk_pod(name=f"lo-{i}", cpu=1.5))
+        results = env.provision(*pods, now=0.0)
+        shed = {k for k, e in results.errors.items()
+                if e == PRIORITY_SHED_ERROR}
+        assert shed == {f"default/lo-{i}" for i in range(6)}
+        bound = {p.metadata.name for p in env.kube.pods()
+                 if p.spec.node_name}
+        assert bound == {f"hi-{i}" for i in range(4)}
+
+    def test_uniform_priority_is_untouched(self):
+        """Every-pod-priority-0 rounds keep the pre-priority behavior:
+        no shed errors, plain limit rejection."""
+        env, _ = _env(limits={"cpu": 4.0})
+        pods = [mk_pod(name=f"p-{i}", cpu=1.5) for i in range(5)]
+        results = env.provision(*pods, now=0.0)
+        assert not any(
+            e == PRIORITY_SHED_ERROR for e in results.errors.values()
+        )
+
+    def test_unplaceable_pod_never_drags_the_tail(self):
+        """A high-priority pod no machine can hold keeps its own error;
+        lower-priority placeable pods still schedule."""
+        env, _ = _env()
+        giant = mk_pod(name="giant", cpu=64.0)
+        giant.spec.priority = 10_000
+        low = mk_pod(name="low", cpu=1.0)
+        results = env.provision(giant, low, now=0.0)
+        assert results.errors.get("default/giant") not in (
+            None, PRIORITY_SHED_ERROR
+        )
+        assert env.kube.get_pod("default", "low").spec.node_name
+
+    def test_placeable_keys_respects_fit(self):
+        pool = mk_nodepool("default")
+        types = [make_instance_type("c4", cpu=4, memory=16 * GIB)]
+        fits = mk_pod(name="fits", cpu=1.0)
+        giant = mk_pod(name="giant", cpu=64.0)
+        keys = placeable_keys([fits, giant], [(pool, types)])
+        assert keys == {"default/fits"}
+
+
+@pytest.mark.parametrize("seed", [3, 11, 29, 57])
+class TestAdmissionOracle:
+    """Fuzzed pool limits × catalogs × priorities: the unscheduled set
+    is exactly the lowest-priority tail of the admission order."""
+
+    def test_unscheduled_set_is_the_lowest_priority_tail(self, seed):
+        rng = random.Random(seed)
+        n_types = rng.choice([1, 2])
+        types = [
+            make_instance_type(
+                f"c{4 * (i + 1)}", cpu=4.0 * (i + 1),
+                memory=16 * (i + 1) * GIB, price=1.0 + i,
+            )
+            for i in range(n_types)
+        ]
+        # limit forces overload: room for roughly half the demand
+        limit_cpu = rng.choice([4.0, 8.0, 12.0])
+        env, _ = _env(types=types, limits={"cpu": limit_cpu})
+        pods = []
+        for i in range(rng.randint(8, 16)):
+            p = mk_pod(
+                name=f"p-{i}",
+                cpu=rng.choice([0.5, 1.0, 1.5]),
+                memory=2 * GIB,
+            )
+            p.spec.priority = rng.choice([0, 10, 100, 1000])
+            pods.append(p)
+        results = env.provision(*pods, now=0.0)
+
+        order = admission_order(pods)
+        keys = [p.key for p in order]
+        unscheduled = {
+            p.key for p in pods
+            if not env.kube.get_pod(*p.key.split("/", 1)).spec.node_name
+        }
+        # every unscheduled pod must carry an error
+        assert unscheduled == set(results.errors), (
+            results.errors, unscheduled,
+        )
+        # the unscheduled set is a SUFFIX of the admission order
+        if unscheduled:
+            cut = min(keys.index(k) for k in unscheduled)
+            assert set(keys[cut:]) == unscheduled, (
+                f"seed {seed}: unscheduled not a tail "
+                f"(cut {cut}): {sorted(unscheduled)} vs "
+                f"{keys[cut:]}"
+            )
+            # and therefore: no pod outranks a scheduled one while
+            # itself starving
+            max_unsched = max(
+                p.spec.priority for p in pods if p.key in unscheduled
+            )
+            min_sched = min(
+                (p.spec.priority for p in pods
+                 if p.key not in unscheduled),
+                default=max_unsched,
+            )
+            assert max_unsched <= min_sched
+
+
+class TestDisruptionPriorityVeto:
+    def test_sim_vetoes_when_higher_priority_pending_starves(self):
+        """A consolidation-style simulation must fail when a pending
+        pod of strictly higher priority than the displaced pods stays
+        capacity-unschedulable."""
+        env, pool = _env(limits={"cpu": 4.0})
+        low = mk_pod(name="low", cpu=1.0)
+        env.provision(low, now=0.0)
+        # a higher-priority pod arrives; the pool limit blocks growth
+        high = mk_pod(name="high", cpu=3.9)
+        high.spec.priority = 1000
+        env.kube.create(high)
+        state = env.cluster.nodes()[0]
+        from karpenter_tpu.disruption.engine import Candidate
+
+        candidate = Candidate(
+            state_node=state, node_pool=pool,
+            reschedulable_pods=[
+                env.kube.get_pod("default", "low")
+            ],
+            instance_type_name="c4", capacity_type="on-demand",
+            zone="zone-a", price=1.0, disruption_cost=1.0,
+        )
+        _, ok = env.disruption.simulate_scheduling([candidate])
+        assert not ok
+
+    def test_sim_unaffected_at_uniform_priority(self):
+        env, pool = _env(limits={"cpu": 4.0})
+        low = mk_pod(name="low", cpu=1.0)
+        env.provision(low, now=0.0)
+        pending = mk_pod(name="pending", cpu=3.9)  # priority 0, like low
+        env.kube.create(pending)
+        state = env.cluster.nodes()[0]
+        from karpenter_tpu.disruption.engine import Candidate
+
+        candidate = Candidate(
+            state_node=state, node_pool=pool,
+            reschedulable_pods=[env.kube.get_pod("default", "low")],
+            instance_type_name="c4", capacity_type="on-demand",
+            zone="zone-a", price=1.0, disruption_cost=1.0,
+        )
+        results, ok = env.disruption.simulate_scheduling([candidate])
+        # the displaced pod itself still schedules; the equal-priority
+        # pending pod's starvation does not veto
+        assert ok
+
+
+class TestIncrementalPriorityGate:
+    def test_priority_bearing_tick_routes_to_full_path(self):
+        env, _ = _env()
+        pod = mk_pod(name="p", cpu=1.0)
+        pod.spec.priority = 10
+        env.kube.create(pod)
+        reason = env.provisioner.incremental._ineligible(
+            [pod], env.provisioner.ready_pools_with_types()
+        )
+        assert reason == "priority"
+
+    def test_class_name_alone_gates_too(self):
+        env, _ = _env()
+        pod = mk_pod(name="p", cpu=1.0)
+        pod.spec.priority_class_name = "gold"
+        reason = env.provisioner.incremental._ineligible(
+            [pod], env.provisioner.ready_pools_with_types()
+        )
+        assert reason == "priority"

@@ -1,0 +1,199 @@
+"""Preemption-aware provisioning (ISSUE 8): a pending higher-priority
+pod that fits no launchable or existing capacity nominates
+lower-priority victims — PDB-respecting, never equal/higher priority,
+nominate-then-evict ordering, landings through the binding queue.
+"""
+
+import time
+
+from karpenter_tpu.apis.v1.labels import DO_NOT_DISRUPT_ANNOTATION
+from karpenter_tpu.cloudprovider.fake import GIB, make_instance_type
+from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
+from karpenter_tpu.kube.client import KubeClient
+from karpenter_tpu.kube.objects import (
+    LabelSelector,
+    ObjectMeta,
+    PodDisruptionBudget,
+    PodDisruptionBudgetSpec,
+    PriorityClass,
+)
+from karpenter_tpu.operator.operator import Operator
+from karpenter_tpu.testing import mk_nodepool, mk_pod
+
+
+class Harness:
+    """Operator over a one-node-capped pool: preemption is the only
+    way in once the node fills."""
+
+    def __init__(self, cpu_limit=4.0):
+        self.kube = KubeClient()
+        self.cloud = KwokCloudProvider(
+            self.kube,
+            types=[make_instance_type("c4", cpu=4, memory=16 * GIB)],
+        )
+        self.op = Operator(self.kube, self.cloud)
+        pool = mk_nodepool("cap", limits={"cpu": cpu_limit})
+        pool.spec.disruption.consolidate_after = "Never"
+        self.kube.create(pool)
+        self.now = time.time()
+
+    def drive(self, ticks=10, dt=2.0):
+        for _ in range(ticks):
+            self.now += dt
+            self.op.step(now=self.now)
+
+    def fill_low(self, n=2, cpu=1.5, labels=None):
+        for i in range(n):
+            self.kube.create(mk_pod(
+                name=f"lo-{i}", cpu=cpu, labels=labels or {}
+            ))
+        self.drive(8)
+        assert all(
+            p.spec.node_name for p in self.kube.pods()
+        ), "low-priority workload must bind before the preemption test"
+
+    def add_high(self, name="hi-0", cpu=1.5, priority=1000, owner=None):
+        pod = mk_pod(name=name, cpu=cpu, owner=owner)
+        pod.spec.priority = priority
+        self.kube.create(pod)
+        return pod
+
+    def pod(self, name):
+        return self.kube.get_pod("default", name)
+
+
+class TestPreemption:
+    def test_higher_priority_preempts_and_lands(self):
+        h = Harness()
+        h.fill_low()
+        h.add_high()
+        h.drive(14)
+        hi = h.pod("hi-0")
+        assert hi is not None and hi.spec.node_name, (
+            "high-priority pod must land on preempted capacity"
+        )
+        # one victim rebirthed pending (workload-owner semantics) and
+        # stays shed while the overload persists
+        lows = [h.pod(f"lo-{i}") for i in range(2)]
+        unbound = [p for p in lows if p is not None and not p.spec.node_name]
+        assert len(unbound) == 1
+        from karpenter_tpu.metrics.store import PREEMPTION_NOMINATIONS
+
+        assert PREEMPTION_NOMINATIONS.total() >= 1
+
+    def test_never_preempts_equal_or_higher_priority(self):
+        h = Harness()
+        for i in range(2):
+            pod = mk_pod(name=f"lo-{i}", cpu=1.5)
+            pod.spec.priority = 1000  # same as the would-be preemptor
+            h.kube.create(pod)
+        h.drive(8)
+        h.add_high(priority=1000)
+        h.drive(12)
+        hi = h.pod("hi-0")
+        assert hi is not None and not hi.spec.node_name
+        assert all(
+            h.pod(f"lo-{i}").spec.node_name for i in range(2)
+        ), "equal-priority pods must never be preempted"
+
+    def test_pdb_blocks_preemption(self):
+        h = Harness()
+        h.kube.create(PodDisruptionBudget(
+            metadata=ObjectMeta(name="protect"),
+            spec=PodDisruptionBudgetSpec(
+                selector=LabelSelector.of({"app": "guarded"}),
+                max_unavailable=0,
+            ),
+        ))
+        h.fill_low(labels={"app": "guarded"})
+        h.add_high()
+        h.drive(12)
+        hi = h.pod("hi-0")
+        assert hi is not None and not hi.spec.node_name
+        assert all(
+            h.pod(f"lo-{i}").spec.node_name for i in range(2)
+        ), "PDB-guarded pods must never be preempted"
+
+    def test_do_not_disrupt_blocks_preemption(self):
+        h = Harness()
+        for i in range(2):
+            pod = mk_pod(name=f"lo-{i}", cpu=1.5)
+            pod.metadata.annotations[DO_NOT_DISRUPT_ANNOTATION] = "true"
+            h.kube.create(pod)
+        h.drive(8)
+        h.add_high()
+        h.drive(12)
+        assert not h.pod("hi-0").spec.node_name
+        assert all(h.pod(f"lo-{i}").spec.node_name for i in range(2))
+
+    def test_preemption_policy_never_queues_without_evicting(self):
+        h = Harness()
+        h.kube.create(PriorityClass(
+            metadata=ObjectMeta(name="polite", namespace=""),
+            value=1000, preemption_policy="Never",
+        ))
+        h.fill_low()
+        pod = mk_pod(name="hi-0", owner=None, cpu=1.5)
+        pod.spec.priority_class_name = "polite"
+        h.kube.create(pod)
+        h.drive(12)
+        assert not h.pod("hi-0").spec.node_name
+        assert all(h.pod(f"lo-{i}").spec.node_name for i in range(2))
+
+    def test_nominate_before_evict(self):
+        """The pod-level drain-after-replace: the preemptor's
+        nominatedNodeName is stamped and its binding plan queued in the
+        same reconcile that evicts the victims — the landing is secured
+        before anything is killed."""
+        h = Harness()
+        h.fill_low()
+        hi = h.add_high()
+        # run exactly one provisioning round's worth of ticks and
+        # observe the nomination the moment the victim disappears
+        seen_nomination_with_victim_gone = False
+        for _ in range(14):
+            h.now += 2.0
+            h.op.step(now=h.now)
+            live = h.pod("hi-0")
+            lows = [h.pod(f"lo-{i}") for i in range(2)]
+            victim_gone = any(
+                p is None or p.is_terminating() or not p.spec.node_name
+                for p in lows
+            )
+            if victim_gone and live is not None:
+                assert live.status.nominated_node_name or live.spec.node_name, (
+                    "victim evicted before the preemptor had a "
+                    "nominated landing"
+                )
+                seen_nomination_with_victim_gone = True
+        assert seen_nomination_with_victim_gone
+
+    def test_min_victim_set(self):
+        """Evicting one 1.5-cpu victim frees enough for a 1.0-cpu
+        preemptor; the second victim survives."""
+        h = Harness()
+        h.fill_low()
+        h.add_high(cpu=1.0)
+        h.drive(14)
+        assert h.pod("hi-0").spec.node_name
+        lows = [h.pod(f"lo-{i}") for i in range(2)]
+        bound = [p for p in lows if p is not None and p.spec.node_name]
+        assert len(bound) == 1, "only the minimal victim set is evicted"
+
+    def test_victims_are_lowest_priority_first(self):
+        h = Harness()
+        mid = mk_pod(name="mid", cpu=1.5)
+        mid.spec.priority = 500
+        low = mk_pod(name="low", cpu=1.5)
+        low.spec.priority = 10
+        h.kube.create(mid)
+        h.kube.create(low)
+        h.drive(8)
+        h.add_high(cpu=1.0, priority=1000)
+        h.drive(14)
+        assert h.pod("hi-0").spec.node_name
+        assert h.pod("mid").spec.node_name, (
+            "the higher-priority victim candidate must survive when "
+            "evicting the lower one suffices"
+        )
+        assert not h.pod("low").spec.node_name

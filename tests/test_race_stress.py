@@ -9,6 +9,14 @@ between the kube lock, the cluster lock and the delivery lock (the
 round-2 review found one lock-order inversion in synced(); this is
 the regression net for that class) — and (b) the system converges
 once the churn stops.
+
+Synchronization is event/iteration-based, never wall-clock (the
+test_solver_service deflake pattern from PR 3): churn threads run a
+FIXED number of iterations and signal completion; the operator loop
+runs until every churner is done. A loaded CI box changes how long
+that takes, not what work races — the old fixed-duration windows let
+a slow box end the stress with writes still in flight and then flake
+the convergence assertions.
 """
 
 import pytest
@@ -16,6 +24,10 @@ import pytest
 import random
 import threading
 import time
+
+# fixed interleaving budget per churn thread — the work races the
+# same way regardless of machine speed
+CHURN_ITERATIONS = 250
 
 from karpenter_tpu.cloudprovider.fake import GIB, make_instance_type
 from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
@@ -58,7 +70,7 @@ def _converge_until_bound(op, kube, sim_now, step_seconds=11.0, rounds=40):
     assert all(p.spec.node_name for p in live), "pods unbound after churn"
 
 
-def _run_stress(async_delivery: bool, seconds: float = 2.5) -> None:
+def _run_stress(async_delivery: bool) -> None:
     kube = KubeClient(async_delivery=async_delivery)
     cloud = KwokCloudProvider(
         kube, types=[make_instance_type("c8", cpu=8, memory=32 * GIB)]
@@ -67,35 +79,48 @@ def _run_stress(async_delivery: bool, seconds: float = 2.5) -> None:
     kube.create(mk_nodepool("general"))
     errors: list[BaseException] = []
     stop = threading.Event()
+    done = [threading.Event(), threading.Event()]
 
     def operator_loop():
+        # runs until every churner finished its fixed budget (or a
+        # sibling errored): the racing window is defined by WORK done,
+        # not by how many wall-seconds a loaded box granted it
         now = time.time()
-        while not stop.is_set():
+        while not stop.is_set() and not all(d.is_set() for d in done):
             now += 2.0
             op.step(now=now)
 
-    def churn(prefix):
-        i = 0
-        while not stop.is_set():
-            i += 1
-            pod = mk_pod(name=f"{prefix}-{i}", cpu=0.5)
-            kube.create(pod)
-            if i % 3 == 0:
-                kube.delete(pod)
-            if i % 7 == 0:
-                # reads race the writes: snapshot + synced barrier
-                op.cluster.deep_copy_nodes()
-                op.cluster.synced()
-            time.sleep(0.001)
+    def churn(prefix, finished):
+        try:
+            for i in range(1, CHURN_ITERATIONS + 1):
+                if stop.is_set():
+                    return
+                pod = mk_pod(name=f"{prefix}-{i}", cpu=0.5)
+                kube.create(pod)
+                if i % 3 == 0:
+                    kube.delete(pod)
+                if i % 7 == 0:
+                    # reads race the writes: snapshot + synced barrier
+                    op.cluster.deep_copy_nodes()
+                    op.cluster.synced()
+        finally:
+            finished.set()
 
     threads = [
         threading.Thread(target=_guard(errors, stop, operator_loop), daemon=True),
-        threading.Thread(target=_guard(errors, stop, lambda: churn("a")), daemon=True),
-        threading.Thread(target=_guard(errors, stop, lambda: churn("b")), daemon=True),
+        threading.Thread(
+            target=_guard(errors, stop, lambda: churn("a", done[0])),
+            daemon=True,
+        ),
+        threading.Thread(
+            target=_guard(errors, stop, lambda: churn("b", done[1])),
+            daemon=True,
+        ),
     ]
     for t in threads:
         t.start()
-    time.sleep(seconds)
+    for d in done:
+        assert d.wait(timeout=60), "churn thread wedged: possible deadlock"
     stop.set()
     _join_all(threads, errors)
 
@@ -142,22 +167,25 @@ class TestDisruptionChurnRace:
         kube.create(pool)
         errors: list[BaseException] = []
         stop = threading.Event()
+        done = threading.Event()
         sim_now = [time.time()]
 
         def operator_loop():
-            while not stop.is_set():
+            while not stop.is_set() and not done.is_set():
                 sim_now[0] += 11.0  # every step crosses the 10s poll
                 op.step(now=sim_now[0])
 
         def churn():
-            i = 0
-            while not stop.is_set():
-                i += 1
-                pod = mk_pod(name=f"c-{i}", cpu=0.5)
-                kube.create(pod)
-                if i % 2 == 0:
-                    kube.delete(pod)
-                time.sleep(0.002)
+            try:
+                for i in range(1, CHURN_ITERATIONS + 1):
+                    if stop.is_set():
+                        return
+                    pod = mk_pod(name=f"c-{i}", cpu=0.5)
+                    kube.create(pod)
+                    if i % 2 == 0:
+                        kube.delete(pod)
+            finally:
+                done.set()
 
         threads = [
             threading.Thread(target=_guard(errors, stop, operator_loop), daemon=True),
@@ -165,7 +193,7 @@ class TestDisruptionChurnRace:
         ]
         for t in threads:
             t.start()
-        time.sleep(2.5)
+        assert done.wait(timeout=60), "churn thread wedged: possible deadlock"
         stop.set()
         _join_all(threads, errors)
         _converge_until_bound(op, kube, sim_now)

@@ -1,0 +1,306 @@
+"""Demand-surge chaos suite (ISSUE 8): a seeded `demand_surge` burst
+(`demand_surge@provision_intake:occ=count`, solver/faults.py) floods
+the provisioner mid-provisioning and mid-consolidation with mixed
+low/high-priority pods against a pool whose limits are already spoken
+for. Priority admission must degrade by policy:
+
+- zero high-priority (workload) pods are ever displaced or left
+  unscheduled while capacity exists — asserted EVERY tick of the storm
+  window, not just at convergence;
+- once the storm's pods are retired, the fleet converges to the calm
+  run's exact fingerprint (same node multiset, same bindings, zero
+  leaks/double launches);
+- the fault log replays byte-identically across runs of the same
+  seed.
+
+The storm mechanism is the provisioner's own intake: `fire(
+"provision_intake")` runs once per live schedule() round, and a firing
+rule is consumed as a deterministic burst of store-backed pending pods
+(names `surge-<seq>-<i>`, priorities ±100 decided by the seeded hash).
+"""
+
+import time
+
+import pytest
+
+from karpenter_tpu.cloudprovider.fake import GIB, make_instance_type
+from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
+from karpenter_tpu.kube.client import KubeClient
+from karpenter_tpu.operator.operator import Operator
+from karpenter_tpu.provisioning.provisioner import (
+    SURGE_HIGH_PRIORITY,
+    SURGE_LABEL,
+    SURGE_LOW_PRIORITY,
+)
+from karpenter_tpu.solver import faults
+from karpenter_tpu.testing import mk_nodepool, mk_pod
+
+WORKLOAD_PRIORITY = 1000
+
+
+@pytest.fixture()
+def clean_faults(monkeypatch):
+    monkeypatch.delenv("KARPENTER_FAULTS", raising=False)
+    monkeypatch.delenv("KARPENTER_FAULT_SEED", raising=False)
+    faults.reset()
+    yield monkeypatch
+    faults.reset()
+
+
+def _storm(monkeypatch, spec, seed="11"):
+    if spec:
+        monkeypatch.setenv("KARPENTER_FAULTS", spec)
+        monkeypatch.setenv("KARPENTER_FAULT_SEED", seed)
+    else:
+        monkeypatch.delenv("KARPENTER_FAULTS", raising=False)
+    faults.reset()
+
+
+class Harness:
+    """Operator over a limit-capped pool: capacity for exactly the
+    workload, so every surge pod is overload by construction."""
+
+    def __init__(self, cpu_limit):
+        self.kube = KubeClient()
+        self.cloud = KwokCloudProvider(
+            self.kube,
+            types=[make_instance_type("c4", cpu=4, memory=16 * GIB)],
+        )
+        self.op = Operator(self.kube, self.cloud)
+        self.now = time.time()
+        self.workload_displacements = 0
+        pool = mk_nodepool("default", limits={"cpu": cpu_limit})
+        pool.spec.disruption.consolidate_after = "0s"
+        self.kube.create(pool)
+
+    def seed_workload(self, n, cpu=1.75):
+        # 2 × 1.75 = 3.5 of the c4's 3.9 allocatable: full nodes with
+        # headroom strictly below the surge shape (0.5 cpu), so a
+        # surge pod can neither fit existing capacity nor (pool limit)
+        # open new — overload by construction
+        for i in range(n):
+            pod = mk_pod(name=f"w-{i}", cpu=cpu)
+            pod.spec.priority = WORKLOAD_PRIORITY
+            self.kube.create(pod)
+
+    def drive(self, ticks, dt=2.0, watch_workload=False):
+        for _ in range(ticks):
+            self.now += dt
+            self.op.step(now=self.now)
+            if watch_workload:
+                # zero high-priority displacement, checked mid-storm:
+                # a workload pod that was bound must stay bound
+                for pod in self.kube.pods():
+                    if (
+                        pod.spec.priority == WORKLOAD_PRIORITY
+                        and not pod.spec.node_name
+                        and pod.metadata.annotations.get("was-bound")
+                    ):
+                        self.workload_displacements += 1
+                    if pod.spec.priority == WORKLOAD_PRIORITY and pod.spec.node_name:
+                        pod.metadata.annotations["was-bound"] = "true"
+
+    def retire_surge(self):
+        for pod in list(self.kube.pods()):
+            if SURGE_LABEL in pod.metadata.labels:
+                self.kube.delete(pod)
+
+    def surge_pods(self):
+        return [
+            p for p in self.kube.pods()
+            if SURGE_LABEL in p.metadata.labels
+        ]
+
+    def fingerprint(self):
+        """Name-agnostic converged state + no-leak invariants (the
+        interruption-chaos contract, reused)."""
+        claims = self.kube.node_claims()
+        assert all(
+            c.metadata.deletion_timestamp is None for c in claims
+        ), "wedged-deleting nodeclaim"
+        claim_pids = sorted(
+            c.status.provider_id for c in claims if c.status.provider_id
+        )
+        assert len(claim_pids) == len(claims), "claim never launched"
+        inst_pids = sorted(i.status.provider_id for i in self.cloud.list())
+        assert inst_pids == claim_pids, (
+            f"leak/double-launch: cloud={inst_pids} claims={claim_pids}"
+        )
+        nodes = self.kube.nodes()
+        assert sorted(n.spec.provider_id for n in nodes) == claim_pids
+        live = [
+            p for p in self.kube.pods()
+            if p.metadata.deletion_timestamp is None
+        ]
+        assert all(p.spec.node_name for p in live), (
+            f"stranded: {[p.metadata.name for p in live if not p.spec.node_name]}"
+        )
+        return sorted(
+            (
+                n.metadata.labels.get("node.kubernetes.io/instance-type", ""),
+                tuple(sorted(
+                    p.metadata.name
+                    for p in self.kube.pods_on_node(n.metadata.name)
+                )),
+            )
+            for n in nodes
+        )
+
+
+def _provisioning_run(spec, monkeypatch, seed="11"):
+    """Eight 1.5-cpu priority-1000 pods against a cpu-16 limit (exactly
+    four c4 nodes — the workload consumes the whole budget): the storm
+    fires DURING initial provisioning, and every surge pod must shed
+    below the workload."""
+    _storm(monkeypatch, spec, seed)
+    h = Harness(cpu_limit=16.0)
+    h.seed_workload(8)
+    h.drive(20, dt=2.0, watch_workload=True)
+    # storm window over (occurrence-bounded): retire the surge demand
+    # and ride to quiescence
+    h.retire_surge()
+    h.drive(20, dt=15.0, watch_workload=True)
+    inj = faults.get()
+    h.fault_log = inj.snapshot_log() if inj is not None else []
+    monkeypatch.delenv("KARPENTER_FAULTS", raising=False)
+    return h
+
+
+def _consolidation_run(spec, monkeypatch, seed="11"):
+    """Workload provisions, thins by name to two pods, and the storm
+    fires while consolidation shrinks the fleet — shed surge demand
+    (strictly lower priority than the displaced pods) must not veto
+    the shrink, and the end state matches the calm run's."""
+    _storm(monkeypatch, spec, seed)
+    h = Harness(cpu_limit=16.0)
+    h.seed_workload(8)
+    h.drive(16, dt=2.0)
+    # survivors w-0 and w-7 land on DIFFERENT nodes (pods bind two per
+    # node in order), so the shrink is a real multi-node consolidation
+    # with an eviction — whose rebirth re-arms the intake the storm
+    # window covers — not a pure emptiness collect
+    for i in range(1, 7):
+        pod = h.kube.get_pod("default", f"w-{i}")
+        if pod is not None:
+            h.kube.delete(pod)
+    # no displacement watch here: the shrink itself legitimately
+    # displaces one survivor onto the merged node (a planned drain —
+    # the calm run displaces it identically); the convergence
+    # fingerprint is the contract for this scenario
+    h.drive(20, dt=15.0)
+    h.retire_surge()
+    h.drive(16, dt=15.0)
+    inj = faults.get()
+    h.fault_log = inj.snapshot_log() if inj is not None else []
+    monkeypatch.delenv("KARPENTER_FAULTS", raising=False)
+    return h
+
+
+_REFERENCE: dict = {}
+
+
+def _reference(kind, monkeypatch):
+    if kind not in _REFERENCE:
+        run = {"prov": _provisioning_run, "cons": _consolidation_run}[kind]
+        _REFERENCE[kind] = run("", monkeypatch).fingerprint()
+    return _REFERENCE[kind]
+
+
+# bursts on live intakes: the provisioning storm floods the FIRST
+# rounds (the workload and the burst contend in the same solves); the
+# consolidation storm starts at the 2nd intake — the rebirth-driven
+# rounds while the shrink is in flight (a settled fleet runs no intake
+# at all, so occurrence 1 is the only pre-settlement round)
+PROVISIONING_STORM = "demand_surge@provision_intake:1-3=12"
+CONSOLIDATION_STORM = "demand_surge@provision_intake:2-4=12"
+
+
+@pytest.mark.surge_chaos
+def test_provisioning_surge_converges_to_calm_fingerprint(clean_faults):
+    want = _reference("prov", clean_faults)
+    assert sum(len(p[1]) for p in want) == 8
+    h = _provisioning_run(PROVISIONING_STORM, clean_faults)
+    fired = [e for e in h.fault_log if e[2] == "demand_surge"]
+    assert fired, "storm never fired"
+    assert h.workload_displacements == 0, (
+        "a bound high-priority pod came unbound during the storm"
+    )
+    assert h.fingerprint() == want
+
+
+@pytest.mark.surge_chaos
+def test_surge_storm_sheds_only_below_the_workload(clean_faults):
+    """While the storm is live: every workload pod is bound (capacity
+    exists for them — zero high-priority pods unscheduled), every
+    surge pod is pending (the pool budget was already spoken for), and
+    the low-priority half of the burst sheds before the high half in
+    the admission order."""
+    _storm(clean_faults, PROVISIONING_STORM)
+    h = Harness(cpu_limit=16.0)
+    h.seed_workload(8)
+    h.drive(20, dt=2.0)
+    surge = h.surge_pods()
+    assert surge, "storm never materialized pods"
+    assert all(not p.spec.node_name for p in surge), (
+        "surge pods must shed while the workload owns the capacity"
+    )
+    assert {p.spec.priority for p in surge} == {
+        SURGE_LOW_PRIORITY, SURGE_HIGH_PRIORITY
+    }, "the seeded burst must mix low and high priorities"
+    for i in range(8):
+        assert h.kube.get_pod("default", f"w-{i}").spec.node_name, (
+            "workload pod unscheduled while capacity exists"
+        )
+
+
+@pytest.mark.surge_chaos
+def test_consolidation_surge_converges_to_calm_fingerprint(clean_faults):
+    want = _reference("cons", clean_faults)
+    assert sum(len(p[1]) for p in want) == 2
+    h = _consolidation_run(CONSOLIDATION_STORM, clean_faults)
+    fired = [e for e in h.fault_log if e[2] == "demand_surge"]
+    assert fired, "storm never fired"
+    assert h.fingerprint() == want
+
+
+@pytest.mark.surge_chaos
+def test_surge_replays_byte_identically(clean_faults):
+    h_a = _provisioning_run(PROVISIONING_STORM, clean_faults, seed="23")
+    h_b = _provisioning_run(PROVISIONING_STORM, clean_faults, seed="23")
+    assert h_a.fault_log, "storm never fired"
+    assert h_a.fault_log == h_b.fault_log
+    assert h_a.fingerprint() == h_b.fingerprint()
+    # the synthesized bursts themselves are a pure function of
+    # (seed, occurrence): same names, same priorities — asserted via
+    # the surviving store state before retirement in a fresh run
+    _storm(clean_faults, PROVISIONING_STORM, seed="23")
+    h_c = Harness(cpu_limit=16.0)
+    h_c.seed_workload(8)
+    h_c.drive(20, dt=2.0)
+    _storm(clean_faults, PROVISIONING_STORM, seed="23")
+    h_d = Harness(cpu_limit=16.0)
+    h_d.seed_workload(8)
+    h_d.drive(20, dt=2.0)
+    sig = lambda h: sorted(  # noqa: E731
+        (p.metadata.name, p.spec.priority) for p in h.surge_pods()
+    )
+    assert sig(h_c) == sig(h_d)
+    assert sig(h_c), "no surge pods materialized"
+
+
+class TestSurgeFaultParsing:
+    def test_demand_surge_defaults(self, clean_faults):
+        rules = faults.parse("demand_surge")
+        assert len(rules) == 1
+        assert rules[0].site == "provision_intake"
+        assert rules[0].count == 16
+
+    def test_demand_surge_count_param(self, clean_faults):
+        (rule,) = faults.parse("demand_surge@provision_intake:2=500")
+        assert rule.count == 500
+        assert rule.lo == rule.hi == 2
+
+    def test_bad_count_rejected(self, clean_faults):
+        rejected = []
+        assert faults.parse("demand_surge=0", rejected=rejected) == []
+        assert rejected == ["demand_surge=0"]
