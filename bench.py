@@ -1553,8 +1553,15 @@ def main() -> int:
         errors.append(backend_error)
     backend = jax.default_backend()
     detail = {"backend": backend, "backend_provenance": provenance}
+    from karpenter_tpu import tracing
+
     for name, fn in runners.items():
         res_before = _resilience_counts()
+        # scope the flight-recorder ring to this arm: operator-driven
+        # scenarios (steady_state_churn live arm, overload_surge,
+        # spot_mix) leave tick traces behind; their per-span p50/p99
+        # breakdown lands in the arm's JSON below
+        tracing.clear()
         try:
             detail[name] = fn()
             # per-scenario backend stamp: a partial TPU run (tunnel died
@@ -1571,6 +1578,16 @@ def main() -> int:
         res_delta = _resilience_delta(res_before, _resilience_counts())
         if res_delta:
             detail[name]["resilience"] = res_delta
+        arm_traces = tracing.traces()
+        if arm_traces:
+            # the ring bounds the sample: a long arm keeps only its
+            # LAST ring_size ticks, so say how many the stats cover —
+            # a silent cap would read as whole-arm coverage
+            detail[name]["trace_summary"] = {
+                "spans": tracing.span_stats(arm_traces),
+                "traces_sampled": len(arm_traces),
+                "ring_capacity": tracing.ring_size(),
+            }
         if backend == "tpu":
             # persist incrementally THE MOMENT any TPU scenario lands —
             # evidence must survive a crash/timeout later in the run

@@ -992,6 +992,8 @@ class DisruptionEngine:
     # -- controller loop (controller.go:121-176) -------------------------------
 
     def reconcile(self, now: Optional[float] = None) -> Optional[Command]:
+        from karpenter_tpu import tracing
+
         now = time.time() if now is None else now
         if not self.cluster.synced():
             return None
@@ -1004,7 +1006,9 @@ class DisruptionEngine:
             self.single_node_consolidation,
         ):
             t0 = time.perf_counter()
-            command = method(now)
+            with tracing.span(f"disruption.{method.__name__}") as sp:
+                command = method(now)
+                sp.annotate(decided=command is not None)
             DISRUPTION_EVALUATION_DURATION.observe(
                 time.perf_counter() - t0,
                 {"method": method.__name__},
@@ -1185,6 +1189,16 @@ class OrchestrationQueue:
                 ), now=now)
 
     def start_command(self, command: Command, now: Optional[float] = None) -> None:
+        from karpenter_tpu import tracing
+
+        with tracing.span(
+            "disruption.start", reason=command.reason,
+            candidates=len(command.candidates),
+        ):
+            self._start_command(command, now)
+
+    def _start_command(self, command: Command,
+                       now: Optional[float] = None) -> None:
         now = time.time() if now is None else now
         command.started_at = now
         self._record(command, now)
@@ -1227,7 +1241,13 @@ class OrchestrationQueue:
             self._nominate_replacements(command, now=now)
             state = self._replacements_state(command)
             if state == "ready":
-                verdict = self._validate(command, now)
+                from karpenter_tpu import tracing
+
+                with tracing.span(
+                    "disruption.validation", reason=command.reason,
+                ) as vsp:
+                    verdict = self._validate(command, now)
+                    vsp.annotate(verdict=verdict)
                 if verdict == "retry":
                     # transient failure (e.g. catalog fetch blip): keep
                     # the command active; the COMMAND_TIMEOUT deadline
@@ -1245,14 +1265,21 @@ class OrchestrationQueue:
                 if verdict == "invalid":
                     self._rollback(command, now=now)
                     continue
-                for candidate in command.candidates:
-                    claim = candidate.state_node.node_claim
-                    if claim is not None and claim.metadata.deletion_timestamp is None:
-                        self.kube.delete(claim, now=now)
-                        NODECLAIMS_DISRUPTED.inc({
-                            "reason": command.reason,
-                            "nodepool": candidate.node_pool.metadata.name,
-                        })
+                with tracing.span(
+                    "disruption.commit", reason=command.reason,
+                    candidates=len(command.candidates),
+                ):
+                    for candidate in command.candidates:
+                        claim = candidate.state_node.node_claim
+                        if claim is not None and (
+                            claim.metadata.deletion_timestamp is None
+                        ):
+                            self.kube.delete(claim, now=now)
+                            NODECLAIMS_DISRUPTED.inc({
+                                "reason": command.reason,
+                                "nodepool":
+                                    candidate.node_pool.metadata.name,
+                            })
             elif state == "failed" or now - command.started_at > COMMAND_TIMEOUT_SECONDS:
                 log.warning("disruption command %s rolled back (%s)", command.reason,
                             state)

@@ -35,6 +35,11 @@ class RecordedEvent:
     event: Event
     timestamp: float
     count: int = 1
+    # flight-recorder provenance: the tick trace open when the event
+    # was first published ("" outside any trace). Lives here — NOT on
+    # the frozen Event — so dedupe identity ignores it: the same event
+    # republished from a later tick still dedupes.
+    trace_id: str = ""
 
 
 class EventRecorder:
@@ -84,7 +89,12 @@ class EventRecorder:
         window.append(now)
         self._reason_counts[event.reason] = window
         self._last_seen[event] = now
-        self.events.append(RecordedEvent(event=event, timestamp=now))
+        from karpenter_tpu import tracing
+
+        self.events.append(RecordedEvent(
+            event=event, timestamp=now,
+            trace_id=tracing.current_trace_id(),
+        ))
         self._post(event, now)
         return True
 
@@ -93,8 +103,12 @@ class EventRecorder:
     def _post(self, event: Event, now: float) -> None:
         if self.kube is None:
             return
+        from karpenter_tpu import tracing
         from karpenter_tpu.kube.objects import KubeEvent, ObjectMeta
 
+        # corev1 Events carry the provenance annotation too: kubectl
+        # describe on a disrupted node leads straight to the tick trace
+        trace_id = tracing.current_trace_id()
         obj = KubeEvent(
             metadata=ObjectMeta(
                 # the real recorder's unique-name convention:
@@ -105,6 +119,10 @@ class EventRecorder:
                 # disambiguates same-microsecond publishes in sims.
                 name=f"{event.name}.{int(now * 1e6):x}{next(_seq):04x}",
                 namespace=event.namespace or "default",
+                annotations=(
+                    {tracing.PROVENANCE_ANNOTATION: trace_id}
+                    if trace_id else {}
+                ),
             ),
             involved_kind=event.kind,
             involved_name=event.name,

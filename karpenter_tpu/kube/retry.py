@@ -152,6 +152,12 @@ class RetryPolicy:
             ))
         return _cached[1]
 
+    # verbs whose requests land a flight-recorder span (with retry
+    # counts) on the open tick trace; reads stay span-free — a LIST
+    # per tick per kind would swamp the ring with healthy noise
+    WRITE_VERBS = frozenset(
+        {"create", "update", "delete", "evict", "bind", "patch"})
+
     def execute(
         self,
         verb: str,
@@ -166,19 +172,44 @@ class RetryPolicy:
         `on_conflict` receives the statuses seen so far in this call
         (the current 409 included) — a 409 right after a 5xx is how a
         lost-response write that actually landed announces itself, and
-        the hook must be able to tell that apart from a genuine race."""
+        the hook must be able to tell that apart from a genuine race.
+
+        Write verbs record a span on the open tick trace carrying the
+        final status and the retry count — the per-write provenance
+        the aggregate karpenter_kube_retries_total cannot give."""
+        if verb in self.WRITE_VERBS:
+            from karpenter_tpu import tracing
+
+            with tracing.span(f"kube.{verb}") as sp:
+                status, body, retries = self._execute(
+                    verb, attempt, on_conflict, sleep, clock)
+                sp.annotate(status=status, retries=retries)
+            return status, body
+        status, body, _ = self._execute(
+            verb, attempt, on_conflict, sleep, clock)
+        return status, body
+
+    def _execute(
+        self,
+        verb: str,
+        attempt: Attempt,
+        on_conflict: Optional[Callable[..., bool]] = None,
+        sleep=time.sleep,
+        clock=time.monotonic,
+    ) -> tuple[int, dict, int]:
         deadline = clock() + self.budget_seconds
         history: list[int] = []
+        retries = 0
         status, body = attempt()
         for tries in range(1, self.max_attempts):
             history.append(status)
             if status == 409:
                 if on_conflict is None or not on_conflict(tuple(history)):
-                    return status, body
+                    return status, body, retries
                 KUBE_RETRIES.inc({"verb": verb, "status": "409"})
             elif status == 429:
                 if is_pdb_eviction_block(body):
-                    return status, body
+                    return status, body, retries
                 KUBE_RETRIES.inc({"verb": verb, "status": "429"})
                 wait = max(
                     retry_after_seconds(body),
@@ -196,14 +227,15 @@ class RetryPolicy:
                     break
                 sleep(wait)
             else:
-                return status, body
+                return status, body, retries
             if clock() > deadline:
                 break
+            retries += 1
             status, body = attempt()
         if status in (409, 429) or status >= 500:
             log.warning("kube %s still failing after retries: HTTP %s %s",
                         verb, status, (body or {}).get("message", ""))
-        return status, body
+        return status, body, retries
 
 
 _cached: Optional[tuple[tuple, RetryPolicy]] = None

@@ -331,6 +331,20 @@ NODEPOOL_REGISTRATION_HEALTHY = REGISTRY.gauge(
     "Per-nodepool launch/registration health from the ring-buffer "
     "tracker (1 healthy / 0 degraded — the NodeRegistrationHealthy "
     "condition's signal, surfaced for operators)")
+# operator tick liveness (ISSUE 9): the wedge-detection signals —
+# healthz() reports unhealthy when the last tick's age exceeds
+# KARPENTER_TICK_STALL_MULTIPLE x the tick interval
+OPERATOR_LAST_TICK = REGISTRY.gauge(
+    "karpenter_operator_last_tick_timestamp_seconds",
+    "Wall-clock timestamp of the last completed operator tick — a "
+    "stalled series means the reconcile loop is wedged (healthz "
+    "reports unhealthy past the configured staleness multiple)")
+OPERATOR_TICK_DURATION = REGISTRY.histogram(
+    "karpenter_operator_tick_duration_seconds",
+    "Operator tick wall clock (Operator.step), end to end across "
+    "every controller",
+    buckets=(0.001, 0.005, 0.025, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30,
+             60, 300))
 DISRUPTION_PROBE_STARVATION = REGISTRY.counter(
     "karpenter_disruption_probe_starvation_total",
     "Consolidation probes attempted vs still remaining when a method's "

@@ -1,4 +1,5 @@
-"""Observability over HTTP: /metrics, /healthz, /readyz, /debug/profile.
+"""Observability over HTTP: /metrics, /healthz, /readyz,
+/debug/profile, /debug/traces.
 
 Counterpart of the ports the reference mounts on its manager
 (pkg/operator/operator.go:183-222: metrics server, healthz/readyz
@@ -7,6 +8,12 @@ server carries all routes — the split metrics/health ports of the
 reference collapse onto one listener per process here, with the port
 taken from Options.metrics_port (0 picks an ephemeral port, exposed as
 `.port` for tests).
+
+/debug/traces serves the flight recorder's tick-trace ring
+(karpenter_tpu/tracing): plain JSON by default, Chrome-trace/Perfetto
+with ?format=perfetto (load into ui.perfetto.dev), one trace's
+segments with ?trace_id=<id> — the id a NodeClaim's
+karpenter.sh/provenance annotation carries.
 """
 
 from __future__ import annotations
@@ -73,6 +80,13 @@ class ObservabilityServer:
 
     # -- routing -----------------------------------------------------------
 
+    @staticmethod
+    def _query(handler: BaseHTTPRequestHandler) -> dict:
+        from urllib.parse import parse_qsl
+
+        _, _, query = handler.path.partition("?")
+        return dict(parse_qsl(query))
+
     def _route(self, handler: BaseHTTPRequestHandler) -> None:
         path = handler.path.split("?", 1)[0]
         if path == "/metrics":
@@ -100,6 +114,25 @@ class ObservabilityServer:
             handler.wfile.write(body)
         elif path == "/debug/profile" and self._profile_report is not None:
             body = json.dumps(self._profile_report()).encode()
+            handler.send_response(200)
+            handler.send_header("Content-Type", "application/json")
+            handler.send_header("Content-Length", str(len(body)))
+            handler.end_headers()
+            handler.wfile.write(body)
+        elif path == "/debug/traces":
+            from karpenter_tpu import tracing
+
+            params = self._query(handler)
+            trace_id = params.get("trace_id", "")
+            if params.get("format") in ("perfetto", "chrome"):
+                selected = (
+                    tracing.find(trace_id) if trace_id
+                    else tracing.traces()
+                )
+                body = json.dumps(tracing.to_chrome(selected)).encode()
+            else:
+                # one source of truth for the response shape
+                body = tracing.render_json(trace_id).encode()
             handler.send_response(200)
             handler.send_header("Content-Type", "application/json")
             handler.send_header("Content-Length", str(len(body)))

@@ -47,7 +47,13 @@ from karpenter_tpu.metrics.controllers import (
     PodMetricsController,
     StatusConditionMetricsController,
 )
-from karpenter_tpu.metrics.store import BINDING_RETRY, OPERATOR_RECOVERY
+from karpenter_tpu import tracing
+from karpenter_tpu.metrics.store import (
+    BINDING_RETRY,
+    OPERATOR_LAST_TICK,
+    OPERATOR_RECOVERY,
+    OPERATOR_TICK_DURATION,
+)
 from karpenter_tpu.operator.options import Options
 from karpenter_tpu.provisioning.provisioner import Provisioner
 from karpenter_tpu.provisioning.static import StaticCapacityController
@@ -230,14 +236,41 @@ class Operator:
         self._disruption_idle = False    # last round found nothing
         self._disruption_catalog_fp = None
         self._last_forced_disruption = 0.0
+        # tick liveness (wedge detection): wall clock of the last
+        # COMPLETED tick, compared by healthz() against the tick
+        # interval x KARPENTER_TICK_STALL_MULTIPLE. The interval is
+        # only known once run() owns the loop; embedders driving
+        # step() on their own clock get no staleness check (None).
+        self._last_tick_wall: Optional[float] = None
+        self._tick_interval: Optional[float] = None
+        # the flight recorder's last tick trace id for THIS operator
+        # (the process ring can interleave several operators in tests)
+        self._last_trace_id = ""
 
     # -- one tick --------------------------------------------------------------
 
     def step(self, now: Optional[float] = None) -> None:
         """Advance every controller once, dependency-ordered: status
         controllers -> provisioning -> lifecycle -> disruption (on its
-        poll period) -> orchestration -> termination -> hygiene."""
+        poll period) -> orchestration -> termination -> hygiene.
+
+        Every tick runs under a flight-recorder root span ("tick"):
+        the per-phase children land in the trace ring served from
+        /debug/traces, and the completed tick stamps the liveness
+        gauge + duration histogram. A crashed tick (injected
+        operator_crash, real exception) records its partial trace but
+        never the liveness stamp — a wedged loop must look wedged."""
         now = time.time() if now is None else now
+        wall0 = time.perf_counter()
+        with tracing.trace("tick") as root:
+            self._last_trace_id = getattr(root, "trace_id", "")
+            self._step(now)
+        wall = time.perf_counter() - wall0
+        OPERATOR_TICK_DURATION.observe(wall)
+        self._last_tick_wall = time.time()
+        OPERATOR_LAST_TICK.set(self._last_tick_wall)
+
+    def _step(self, now: float) -> None:
         # informer pump: under async delivery, queued watch events land
         # at tick start, so every controller in the tick reads one
         # consistent (possibly one-tick-stale) mirror — the informer
@@ -307,7 +340,8 @@ class Operator:
                 self.provisioner.batcher.trigger(now=now)
 
         if self.provisioner.batcher.ready(now=now):
-            with self.profiler.span("provisioning"):
+            with self.profiler.span("provisioning"), \
+                    tracing.span("provision"):
                 results = self.provisioner.reconcile(now=now)
             # crash window: NodeClaims written, binding plan not yet
             # queued — restart must re-derive the plan from the API
@@ -318,12 +352,15 @@ class Operator:
             # lower-priority victims; its landing plan rides the same
             # binding queue (nominate-then-evict — the pod-level
             # drain-after-replace ordering)
-            for binding in self.preemption.reconcile(results, now=now):
+            with tracing.span("preemption") as sp:
+                bindings = self.preemption.reconcile(results, now=now)
+                sp.annotate(nominations=len(bindings))
+            for binding in bindings:
                 self._enqueue_bindings(
                     binding, now, BIND_RESULTS_TTL_SECONDS
                 )
 
-        with self.profiler.span("lifecycle"):
+        with self.profiler.span("lifecycle"), tracing.span("lifecycle"):
             if full:
                 self.lifecycle.reconcile_all(now=now)
             else:
@@ -353,7 +390,8 @@ class Operator:
         # command's placements ride the binding queue like a disruption
         # command's, so displaced pods land on the pre-provisioned
         # claims instead of a fresh solve
-        with self.profiler.span("interruption"):
+        with self.profiler.span("interruption"), \
+                tracing.span("interruption"):
             for command in self.interruption.reconcile(now=now):
                 if command.results is not None:
                     self._enqueue_bindings(
@@ -367,7 +405,8 @@ class Operator:
             # exists to make cheap
             self._last_disruption = now
             if not self._skip_disruption_scan(now):
-                with self.profiler.span("disruption"):
+                with self.profiler.span("disruption"), \
+                        tracing.span("disruption"):
                     command = self.disruption.reconcile(now=now)
                     self._disruption_idle = (
                         command is None and not self.disruption.queue.active
@@ -394,7 +433,7 @@ class Operator:
                         )
         self.disruption.queue.reconcile(now=now)
 
-        with self.profiler.span("termination"):
+        with self.profiler.span("termination"), tracing.span("termination"):
             if full:
                 self.termination.reconcile_all(now=now)
             else:
@@ -560,6 +599,13 @@ class Operator:
         live nodes). Results are dropped once fully bound or once every
         pod found a different home."""
         now = time.time() if now is None else now
+        if not self._pending_bindings:
+            return
+        with tracing.span("bind", plans=len(self._pending_bindings)) as sp:
+            self._bind_pending_traced(now, sp)
+
+    def _bind_pending_traced(self, now: float, sp) -> None:
+        bound = 0
         remaining = []
         for results in self._pending_bindings:
             if now > getattr(results, "bind_deadline", float("inf")):
@@ -602,7 +648,9 @@ class Operator:
                             unbound = True
                         continue  # already home (or nothing to wait on)
                     if node_name and not claim_gone:
-                        if not self._bind_one(live, node_name):
+                        if self._bind_one(live, node_name):
+                            bound += 1
+                        else:
                             unbound = True
                     elif claim_gone:
                         # binding target never materializes (ICE /
@@ -641,7 +689,9 @@ class Operator:
                 for pod in pods:
                     live = self.kube.get_pod(pod.metadata.namespace, pod.metadata.name)
                     if live is not None and not live.spec.node_name:
-                        if not self._bind_one(live, target):
+                        if self._bind_one(live, target):
+                            bound += 1
+                        else:
                             unbound = True
                     elif live is None or live.spec.node_name != target:
                         # awaiting rebirth from the drain, or still
@@ -652,16 +702,37 @@ class Operator:
             if unbound:
                 remaining.append(results)
         self._pending_bindings = remaining
+        sp.annotate(bound=bound, held=len(remaining))
 
     def healthz(self) -> dict:
-        """Liveness: the process and its store are responsive
-        (operator.go:205-222 mounts healthz/readyz probes)."""
+        """Liveness: the process and its store are responsive, and the
+        tick loop is actually ticking (operator.go:205-222 mounts
+        healthz/readyz probes). Wedge detection: once a tick has
+        completed, the last tick's age must stay under
+        KARPENTER_TICK_STALL_MULTIPLE (default 10) x the tick interval
+        — a reconcile loop stuck inside one tick (hung solve, wedged
+        write) goes unhealthy instead of serving green forever."""
         try:
             self.kube.node_pools()
             store_ok = True
         except Exception:
             store_ok = False
-        return {"ok": store_ok, "checks": {"store": store_ok}}
+        tick_fresh = True
+        if self._last_tick_wall is not None and self._tick_interval:
+            import os as _os
+
+            try:
+                multiple = float(
+                    _os.environ.get("KARPENTER_TICK_STALL_MULTIPLE", "10")
+                )
+            except ValueError:
+                multiple = 10.0
+            age = time.time() - self._last_tick_wall
+            tick_fresh = age <= multiple * max(self._tick_interval, 1e-3)
+        return {
+            "ok": store_ok and tick_fresh,
+            "checks": {"store": store_ok, "tick_fresh": tick_fresh},
+        }
 
     def readyz(self) -> dict:
         """Readiness: the mirror has caught up with the store (the
@@ -690,6 +761,16 @@ class Operator:
             # a typo'd chaos knob must be visible here (and in
             # karpenter_faults_rejected_total), never silent
             "rejected_fault_specs": _faults.rejected_specs(),
+            # flight recorder: digest of THIS operator's last tick
+            # trace (full tree at /debug/traces?trace_id=...). The id
+            # can match several ring segments — an in-process solver
+            # service adopts it for its remote hop — so pick the tick
+            # segment explicitly
+            "last_tick_trace": tracing.summarize(next(
+                (t for t in tracing.find(self._last_trace_id)
+                 if t["name"] == "tick"),
+                None,
+            )),
         }
 
     def serve_observability(self, port: Optional[int] = None):
@@ -736,6 +817,7 @@ class Operator:
         tick (signal handlers)."""
         if serve:
             self.serve_observability()
+        self._tick_interval = tick_seconds
         try:
             deadline = None if stop_after is None else time.time() + stop_after
             first_tick = True

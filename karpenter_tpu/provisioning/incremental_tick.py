@@ -81,6 +81,7 @@ from karpenter_tpu.provisioning.scheduler import (
     pool_spot_budget,
 )
 from karpenter_tpu.scheduling.hostports import pod_host_ports
+from karpenter_tpu import tracing
 from karpenter_tpu.solver import faults
 from karpenter_tpu.solver.encode import encode, group_pods
 from karpenter_tpu.solver.incremental import (
@@ -233,6 +234,7 @@ class IncrementalTickScheduler:
         self, pods: Sequence[Pod], pools_with_types,
     ) -> Optional[SchedulerResults]:
         if not incremental_enabled():
+            tracing.annotate(path="full", reason="disabled")
             return None
         t0 = self.clock()
         self._ticks += 1
@@ -249,6 +251,7 @@ class IncrementalTickScheduler:
 
         reason = self._ineligible(pods, pools_with_types)
         if reason is not None:
+            tracing.annotate(path="full_backstop", reason=reason)
             INCREMENTAL_TICK.inc({"path": "full_backstop", "reason": reason})
             self._counts["full_backstop"] += 1
             return None
@@ -272,6 +275,7 @@ class IncrementalTickScheduler:
             # perf-floor guarantee) and warm on the NEXT tick, whose
             # sync is the one-time O(fleet) rebuild.
             self._warm_pending = True
+            tracing.annotate(path="full_backstop", reason="cold")
             INCREMENTAL_TICK.inc({"path": "full_backstop",
                                   "reason": "cold"})
             self._counts["full_backstop"] += 1
@@ -288,6 +292,7 @@ class IncrementalTickScheduler:
         if pods and not cold and churn > self.churn_max and (
             not self._quarantined
         ):
+            tracing.annotate(path="full_backstop", reason="churn")
             INCREMENTAL_TICK.inc({"path": "full_backstop",
                                   "reason": "churn"})
             self._counts["full_backstop"] += 1
@@ -307,6 +312,7 @@ class IncrementalTickScheduler:
         if results is None:
             # the solve left pods only the relaxation ladder can help:
             # hand the whole tick to the full path
+            tracing.annotate(path="full_backstop", reason=fallback)
             INCREMENTAL_TICK.inc({"path": "full_backstop",
                                   "reason": fallback})
             self._counts["full_backstop"] += 1
@@ -327,6 +333,8 @@ class IncrementalTickScheduler:
                 )
                 faults.fire("crash_incr_commit")
                 self._publish_solver_metrics(shadow, t0)
+                tracing.annotate(path="quarantined",
+                                 reason=audit_trigger)
                 INCREMENTAL_TICK.inc({"path": "quarantined",
                                       "reason": audit_trigger})
                 self._counts["quarantined"] += 1
@@ -342,6 +350,10 @@ class IncrementalTickScheduler:
         # NodeClaim writes
         faults.fire("crash_incr_commit")
         self._publish_solver_metrics(results, t0)
+        tracing.annotate(
+            path="incremental",
+            reason="audited" if audit_trigger is not None else "steady",
+        )
         INCREMENTAL_TICK.inc({
             "path": "incremental",
             "reason": "audited" if audit_trigger is not None else "steady",

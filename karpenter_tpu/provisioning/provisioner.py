@@ -259,18 +259,25 @@ class Provisioner:
         )
 
     def schedule(self, extra_pods: Sequence[Pod] = ()) -> SchedulerResults:
-        pods = list(extra_pods) or (
-            self.get_pending_pods() + self.reschedulable_pods_from_deleting_nodes()
-        )
-        if not extra_pods:
-            # live intake only: a scripted solve must never absorb a
-            # chaos burst meant for the reconcile loop
-            pods = self._consume_demand_surge(pods)
-        # admission-plugin analogue: resolve PriorityClass values onto
-        # spec.priority before anything groups the pods
-        from karpenter_tpu.scheduling.priority import resolve_pod_priorities
+        from karpenter_tpu import tracing
 
-        resolve_pod_priorities(pods, self.kube)
+        with tracing.span("intake") as sp:
+            pods = list(extra_pods) or (
+                self.get_pending_pods()
+                + self.reschedulable_pods_from_deleting_nodes()
+            )
+            if not extra_pods:
+                # live intake only: a scripted solve must never absorb a
+                # chaos burst meant for the reconcile loop
+                pods = self._consume_demand_surge(pods)
+            # admission-plugin analogue: resolve PriorityClass values
+            # onto spec.priority before anything groups the pods
+            from karpenter_tpu.scheduling.priority import (
+                resolve_pod_priorities,
+            )
+
+            resolve_pod_priorities(pods, self.kube)
+            sp.annotate(pods=len(pods))
         if self._catalog_dirty.drain("NodePool"):
             self.encode_cache.invalidate()
         pools = self.ready_pools_with_types()
@@ -278,14 +285,18 @@ class Provisioner:
         # None for ticks outside its envelope (explicit extra_pods are
         # a caller-scripted solve, not the live reconcile; priority-
         # bearing ticks route to the full path via its eligibility
-        # gates, so admission below only ever sees full-path results)
+        # gates, so admission below only ever sees full-path results).
+        # The route span carries the decision + reason — the
+        # incremental tick annotates it from its gates.
         if not extra_pods:
-            results = self.incremental.tick(pods, pools)
-            if results is not None:
-                self.cluster.mark_pod_scheduling_decisions(pods)
-                return results
+            with tracing.span("route"):
+                results = self.incremental.tick(pods, pools)
+                if results is not None:
+                    self.cluster.mark_pod_scheduling_decisions(pods)
+                    return results
         results = self._make_scheduler(pools).solve(pods)
-        results = self._enforce_priority_admission(pods, pools, results)
+        with tracing.span("admission"):
+            results = self._enforce_priority_admission(pods, pools, results)
         self.cluster.mark_pod_scheduling_decisions(pods)
         return results
 
@@ -457,6 +468,10 @@ class Provisioner:
         for pod in shed:
             results.errors[pod.key] = padm.PRIORITY_SHED_ERROR
         if shed:
+            from karpenter_tpu import tracing
+
+            tracing.annotate(shed=len(shed),
+                             cutoff_priority=order[cut].spec.priority)
             PRIORITY_SHED.inc(value=float(len(shed)))
             log.warning(
                 "priority admission: demand exceeds capacity; shed %d "
@@ -470,6 +485,24 @@ class Provisioner:
 
     def create_node_claims(self, results: SchedulerResults,
                            now: Optional[float] = None) -> list[NodeClaim]:
+        from karpenter_tpu import tracing
+
+        with tracing.span("create") as sp:
+            created = self._create_node_claims(results, now)
+            sp.annotate(claims=len(created),
+                        limit_rejected=len(results.new_node_plans)
+                        - len(created))
+        return created
+
+    def _create_node_claims(self, results: SchedulerResults,
+                            now: Optional[float] = None) -> list[NodeClaim]:
+        from karpenter_tpu import tracing
+
+        # decision provenance: the launched claim carries the trace id
+        # of the tick that produced it, so any node on the fleet
+        # resolves back to the exact span tree (and fault window) via
+        # /debug/traces?trace_id=<annotation>
+        provenance = tracing.current_trace_id()
         created = []
         # one usage snapshot per round (an O(nodes) scan under the
         # cluster lock — not per plan), advanced in-loop with each
@@ -497,6 +530,10 @@ class Provisioner:
                 # on, so a simulated-future round must not create
                 # claims that look 15 minutes old already
                 claim.metadata.creation_timestamp = now
+            if provenance:
+                claim.metadata.annotations[
+                    tracing.PROVENANCE_ANNOTATION
+                ] = provenance
             self.kube.create(claim)
             plan.claim_name = claim.metadata.name
             # sync-write into state so back-to-back solves see it

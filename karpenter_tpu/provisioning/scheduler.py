@@ -685,11 +685,17 @@ class Scheduler:
         self._last_progress_publish = self._solve_start
         SCHEDULER_UNFINISHED_WORK.set(0.0, labels)
         results: Optional[SchedulerResults] = None
+        from karpenter_tpu import tracing
         from karpenter_tpu.solver import resilience
 
         resilience.pop_degraded()  # scope the report to THIS solve
         try:
-            results = self._solve(pods)
+            with tracing.span(
+                "scheduler.solve",
+                controller=self.metrics_controller, pods=len(pods),
+            ) as tsp:
+                results = self._solve(pods)
+                tsp.annotate(errors=len(results.errors))
             return results
         finally:
             degraded = resilience.pop_degraded()

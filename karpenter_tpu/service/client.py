@@ -85,11 +85,20 @@ class RemoteSolver:
         if skip:
             return local()
         try:
+            from karpenter_tpu import tracing
             from karpenter_tpu.solver import faults
 
-            faults.fire("rpc")
-            request = codec.encode_request(enc, mode, max_nodes, shards, plan)
-            response = self._solve(request, timeout=self.timeout)
+            # attrs stay deterministic under replay (the structure
+            # contract): endpoint + mode only, no payload sizes — the
+            # compressed request embeds the per-run trace id
+            with tracing.span("solve.rpc", endpoint=self.endpoint,
+                              mode=mode):
+                faults.fire("rpc")
+                request = codec.encode_request(
+                    enc, mode, max_nodes, shards, plan,
+                    trace_id=tracing.current_trace_id(),
+                )
+                response = self._solve(request, timeout=self.timeout)
             with self._breaker_lock:
                 self._failures = 0
                 self._open_cycles = 0

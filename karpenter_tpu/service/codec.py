@@ -41,7 +41,8 @@ class _StubConfig:
 
 
 def encode_request(
-    enc: Encoded, mode: str, max_nodes: int, shards: int, plan=None
+    enc: Encoded, mode: str, max_nodes: int, shards: int, plan=None,
+    trace_id: str = "",
 ) -> bytes:
     header = {
         "mode": mode,
@@ -51,6 +52,11 @@ def encode_request(
         "existing_index": [c.existing_index for c in enc.configs],
         "has_plan": plan is not None,
     }
+    if trace_id:
+        # optional on the wire (old peers never read it): the caller's
+        # flight-recorder trace id, adopted by the server so its ring
+        # segment resolves to the same tick
+        header["trace_id"] = trace_id
     arrays = {name: getattr(enc, name) for name in _ARRAY_FIELDS}
     for name in _OPTIONAL_ARRAY_FIELDS:
         value = getattr(enc, name)
@@ -69,7 +75,9 @@ def encode_request(
 
 
 def decode_request(payload: bytes):
-    """-> (Encoded-compatible object, mode, max_nodes, shards, plan)."""
+    """-> (Encoded-compatible object, mode, max_nodes, shards, plan,
+    trace_id). `trace_id` is "" for requests from peers that predate
+    the flight recorder (the header field is optional both ways)."""
     data = np.load(io.BytesIO(payload), allow_pickle=False)
     header = json.loads(bytes(data["__header__"]).decode())
     kwargs = {name: data[name] for name in _ARRAY_FIELDS}
@@ -92,7 +100,8 @@ def decode_request(payload: bytes):
             lower_bound=0.0,
             objective_estimate=0.0,
         )
-    return enc, header["mode"], header["max_nodes"], header["shards"], plan
+    return (enc, header["mode"], header["max_nodes"], header["shards"],
+            plan, header.get("trace_id", ""))
 
 
 def encode_result(result: PackResult) -> bytes:

@@ -47,6 +47,7 @@ class SolverServer:
         self.request_started = threading.Event()
 
         def solve_handler(request: bytes, context) -> bytes:
+            from karpenter_tpu import tracing
             from karpenter_tpu.solver import faults
             from karpenter_tpu.solver.pack import solve_packing
 
@@ -54,13 +55,20 @@ class SolverServer:
                 self.requests_started += 1
             self.request_started.set()
             faults.fire("rpc_server")
-            enc, mode, max_nodes, _, plan = codec.decode_request(request)
-            with self._solve_lock:
-                result = solve_packing(
-                    enc, max_nodes=max_nodes, mode=mode, plan=plan,
-                    shards=self._default_shards,
-                )
-                self.requests_served += 1
+            (enc, mode, max_nodes, _, plan,
+             trace_id) = codec.decode_request(request)
+            # the caller's flight-recorder trace id survives the RPC
+            # hop: this host's span segment records under the SAME id,
+            # so /debug/traces?trace_id= on either side resolves the
+            # solve (old peers send no id -> a fresh local trace)
+            with tracing.adopt(trace_id, "solve.remote") as root:
+                root.annotate(mode=mode, shards=self._default_shards)
+                with self._solve_lock:
+                    result = solve_packing(
+                        enc, max_nodes=max_nodes, mode=mode, plan=plan,
+                        shards=self._default_shards,
+                    )
+                    self.requests_served += 1
             return codec.encode_result(result)
 
         handler = grpc.method_handlers_generic_handler(

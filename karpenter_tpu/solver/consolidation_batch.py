@@ -319,9 +319,17 @@ class LaneSolver:
             hit = chunk_cache.get(ci)
             if hit is not None:
                 return hit
+            from karpenter_tpu import tracing
+
+            with tracing.span("disruption.probe_batch", chunk=ci):
+                return _dispatch_traced(ci)
+
+        def _dispatch_traced(ci: int) -> tuple:
+            from karpenter_tpu import tracing
             from karpenter_tpu.solver import faults, resilience
 
             chunk = list(range(ci * width, min((ci + 1) * width, L)))
+            tracing.annotate(lanes=len(chunk))
             # counted once per chunk — cap-regrow retries re-dispatch
             # (counted as batch + capped_retry) but don't re-ship lanes
             SOLVER_PROBE_BATCH.inc(

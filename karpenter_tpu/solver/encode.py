@@ -336,6 +336,37 @@ def encode(
     existing_quota: Optional[np.ndarray] = None,
     compat_cache=None,
 ) -> Encoded:
+    """Build the dense problem (see _encode_impl for the semantics) —
+    under a flight-recorder span: encode is the solver's first phase
+    and every caller (scheduler fast path, topology batch, incremental
+    repack, probe staging) inherits the instrumentation here."""
+    from karpenter_tpu import tracing
+
+    with tracing.span("solve.encode") as sp:
+        enc = _encode_impl(
+            groups, pools_with_types, existing, daemon_overhead,
+            reserved_in_use=reserved_in_use, group_cap=group_cap,
+            conflict=conflict, existing_quota=existing_quota,
+            compat_cache=compat_cache,
+        )
+        sp.annotate(
+            groups=len(enc.groups), configs=len(enc.configs),
+            existing=enc.n_existing,
+        )
+    return enc
+
+
+def _encode_impl(
+    groups: Sequence[PodGroup],
+    pools_with_types: Sequence[tuple[NodePool, Sequence[InstanceType]]],
+    existing: Sequence[ExistingNodeInput] = (),
+    daemon_overhead: Optional[dict[str, dict[str, float]]] = None,
+    reserved_in_use: Optional[dict[str, int]] = None,
+    group_cap: Optional[np.ndarray] = None,
+    conflict: Optional[np.ndarray] = None,
+    existing_quota: Optional[np.ndarray] = None,
+    compat_cache=None,
+) -> Encoded:
     """Build the dense problem. `daemon_overhead` maps pool name ->
     resource list of daemonset pods that will land on new nodes
     (reference scheduler.go:772-803). `reserved_in_use` maps

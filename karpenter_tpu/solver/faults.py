@@ -384,7 +384,12 @@ class FaultInjector:
         self.rejected: list[str] = list(rejected or [])
         self._seq: dict[str, int] = {}
         self._lock = threading.Lock()
-        self.log: list[tuple[str, int, str]] = []  # (site, seq, kind)
+        # (site, seq, kind, trace_id): the trace id of the tick the
+        # fault fired in — the provenance column joining a replay log
+        # entry to its /debug/traces span tree. snapshot_log() strips
+        # it (trace ids are per-run; the replay-identity artifact must
+        # stay byte-identical across runs of the same schedule).
+        self.log: list[tuple[str, int, str, str]] = []
 
     def _admits(self, rule: FaultRule, site: str, seq: int) -> bool:
         if not rule.matches(seq):
@@ -396,19 +401,25 @@ class FaultInjector:
     def fire(self, site: str) -> None:
         """Advance `site`'s sequence counter and apply matching rules:
         delays sleep in the caller, then the first error kind raises."""
+        from karpenter_tpu import tracing
+
+        trace_id = tracing.current_trace_id()
         with self._lock:
             seq = self._seq.get(site, 0) + 1
             self._seq[site] = seq
             hits = [r for r in self.rules
                     if r.site == site and self._admits(r, site, seq)]
             for rule in hits:
-                self.log.append((site, seq, rule.kind))
+                self.log.append((site, seq, rule.kind, trace_id))
         if not hits:
             return
         from karpenter_tpu.metrics.store import SOLVER_FAULTS_INJECTED
 
         error: Optional[FaultError] = None
         for rule in hits:
+            # fault attribution on the span tree: the innermost open
+            # span of the tick carries every fault fired under it
+            tracing.add_event("fault", kind=rule.kind, site=site, seq=seq)
             SOLVER_FAULTS_INJECTED.inc({"site": site, "kind": rule.kind})
             if rule.kind.endswith("_delay"):
                 log.warning("fault injected: %s@%s:%d sleeping %.3fs",
@@ -442,7 +453,17 @@ class FaultInjector:
 
     def snapshot_log(self) -> list[tuple[str, int, str]]:
         """Copy of the fired-fault log: (site, per-site seq, kind) in
-        firing order — the replay-identity artifact chaos tests diff."""
+        firing order — the replay-identity artifact chaos tests diff.
+        The per-run trace-id column is deliberately stripped here (two
+        replays of one schedule must compare byte-identical); use
+        snapshot_log_traced() for the provenance view."""
+        with self._lock:
+            return [(site, seq, kind) for site, seq, kind, _ in self.log]
+
+    def snapshot_log_traced(self) -> list[tuple[str, int, str, str]]:
+        """The provenance view of the replay log: (site, seq, kind,
+        trace_id) — each fired fault joined to the tick trace it fired
+        in ("" outside any trace), resolvable via /debug/traces."""
         with self._lock:
             return list(self.log)
 

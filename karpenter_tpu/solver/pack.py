@@ -1739,6 +1739,10 @@ def _run_pack(
     SOLVER_PHASE_DURATION.observe(
         _t_dispatch - _t_stage, {"phase": "transfer"}
     )
+    from karpenter_tpu import tracing
+
+    tracing.record("solve.transfer", _t_stage, _t_dispatch,
+                   groups=G, configs=C, shards=shards)
     faults.fire("compile")
     flat_dev = pack_split_flat(
         compat_j,
@@ -1762,9 +1766,15 @@ def _run_pack(
         group_cap=group_cap_full,
         conflict=conflict_full,
     )
+    _t_compiled = _time.perf_counter()
     SOLVER_PHASE_DURATION.observe(
-        _time.perf_counter() - _t_dispatch, {"phase": "compile"}
+        _t_compiled - _t_dispatch, {"phase": "compile"}
     )
+    from karpenter_tpu.solver import warm_pool as _warm_pool
+
+    tracing.record("solve.compile", _t_dispatch, _t_compiled,
+                   wavefront=int(wf),
+                   warm_hit=_warm_pool.warmed(Gp, Cp, Ep, F, mode))
     # compile finished: release the watchdog's compile budget (the
     # execute budget keeps running until fetch)
     from karpenter_tpu.solver import resilience
@@ -1783,9 +1793,11 @@ def _run_pack(
         faults.fire("execute")
         _t_exec = _time.perf_counter()
         flat = np.asarray(flat_dev)  # the one device->host fetch
+        _t_fetched = _time.perf_counter()
         SOLVER_PHASE_DURATION.observe(
-            _time.perf_counter() - _t_exec, {"phase": "execute"}
+            _t_fetched - _t_exec, {"phase": "execute"}
         )
+        tracing.record("solve.execute", _t_exec, _t_fetched)
         o0 = N * Gp
         o1 = o0 + F * W
         assign = flat[:o0].reshape(N, Gp)[:, :G].astype(np.int32)

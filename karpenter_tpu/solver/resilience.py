@@ -432,21 +432,26 @@ class _GuardedPending:
         self._result = None
 
     def result(self):
+        from karpenter_tpu import tracing
+
         if self._result is not None:
             return self._result
         br = self._solver.breaker(self._rung)
-        try:
-            out = self._pending.result()
-        except Exception as err:
-            reason = classify(err)
-            br.record_failure(reason)
-            _ladder_count(self._rung, reason)
-            log.warning("solver rung %s failed at fetch (%s: %s); "
-                        "degrading", self._rung, reason, err)
-            out = self._tail()
-        else:
-            br.record_success()
-            _ladder_count(self._rung, "ok")
+        with tracing.span("solver.rung", rung=self._rung) as rsp:
+            try:
+                out = self._pending.result()
+            except Exception as err:
+                reason = classify(err)
+                br.record_failure(reason)
+                _ladder_count(self._rung, reason)
+                rsp.annotate(outcome=reason)
+                log.warning("solver rung %s failed at fetch (%s: %s); "
+                            "degrading", self._rung, reason, err)
+                out = self._tail()
+            else:
+                br.record_success()
+                _ladder_count(self._rung, "ok")
+                rsp.annotate(outcome="ok")
         self._result = out
         return out
 
@@ -466,6 +471,10 @@ def note_incremental_poison() -> None:
     same ladder telemetry as device/remote failures: a tick served
     correct-but-slower, and a growing count means the retained state
     keeps going stale."""
+    from karpenter_tpu import tracing
+
+    tracing.add_event("rung_degraded", rung="incremental_poison",
+                      reason="quarantined")
     _ladder_count("incremental_poison", "quarantined")
 
 
@@ -695,6 +704,8 @@ class ResilientSolver:
             timer.daemon = True
             timer.start()
 
+        from karpenter_tpu import tracing
+
         try:
             for name in names:
                 if name == "host":
@@ -702,6 +713,8 @@ class ResilientSolver:
                 br = self.breaker(name)
                 if not br.allow():
                     _ladder_count(name, "skipped_open")
+                    tracing.add_event("rung_skipped", rung=name,
+                                      reason="breaker_open")
                     continue
                 budget = None
                 if t_end is not None:
@@ -712,18 +725,25 @@ class ResilientSolver:
                         # the breaker as-is and degrade straight down
                         SOLVER_DEADLINE_EXCEEDED.inc({"phase": "total"})
                         _ladder_count(name, "skipped_deadline")
+                        tracing.add_event("rung_skipped", rung=name,
+                                          reason="deadline")
                         break
-                try:
-                    fn = self._rung_fn(
-                        name, enc, max_nodes, mode, plan, shards)
-                    result = self._attempt(name, fn, budget, compile_budget)
-                except Exception as err:
-                    reason = classify(err)
-                    br.record_failure(reason)
-                    _ladder_count(name, reason)
-                    log.warning("solver rung %s failed (%s: %s); degrading",
-                                name, reason, err)
-                    continue
+                with tracing.span("solver.rung", rung=name) as rsp:
+                    try:
+                        fn = self._rung_fn(
+                            name, enc, max_nodes, mode, plan, shards)
+                        result = self._attempt(
+                            name, fn, budget, compile_budget)
+                    except Exception as err:
+                        reason = classify(err)
+                        br.record_failure(reason)
+                        _ladder_count(name, reason)
+                        rsp.annotate(outcome=reason)
+                        log.warning(
+                            "solver rung %s failed (%s: %s); degrading",
+                            name, reason, err)
+                        continue
+                    rsp.annotate(outcome="ok")
                 br.record_success()
                 _ladder_count(name, "ok")
                 _note_rung(served, name, degraded=(name != primary))
@@ -741,10 +761,13 @@ class ResilientSolver:
                     if hedge["result"] is not None:
                         SOLVER_HEDGE.inc({"outcome": "win"})
                         _ladder_count("host", "ok")
+                        tracing.add_event("hedge_win", rung="host")
                         _note_rung(served, "host",
                                    degraded=(primary != "host"))
                         return hedge["result"]
-            result = host_pack_result(enc, max_nodes, mode)
+            with tracing.span("solver.rung", rung="host") as rsp:
+                result = host_pack_result(enc, max_nodes, mode)
+                rsp.annotate(outcome="ok")
             _ladder_count("host", "ok")
             _note_rung(served, "host", degraded=(primary != "host"))
             return result

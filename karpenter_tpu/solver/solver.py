@@ -785,11 +785,13 @@ def _build_solution_arrays(
         unschedulable.extend(tail)
         if extra_unsched[gi]:
             evicted.extend(tail[len(tail) - int(extra_unsched[gi]) :])
+    from karpenter_tpu import tracing
     from karpenter_tpu.metrics.store import SOLVER_PHASE_DURATION
 
-    SOLVER_PHASE_DURATION.observe(
-        _time.perf_counter() - _t_decode, {"phase": "decode"}
-    )
+    _t_done = _time.perf_counter()
+    SOLVER_PHASE_DURATION.observe(_t_done - _t_decode, {"phase": "decode"})
+    tracing.record("solve.decode", _t_decode, _t_done,
+                   nodes=len(new_nodes), unschedulable=len(unschedulable))
     return Solution(
         new_nodes=new_nodes,
         existing=sorted(existing.values(), key=lambda e: e.existing_index),

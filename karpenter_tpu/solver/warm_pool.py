@@ -343,6 +343,22 @@ def _compile_bucket(
             *args, max_free=F, mode=mode, wavefront=wf, **kw
         ).compile()
     pack_split_flat.lower(*args, max_free=F, mode=mode, **kw).compile()
+    # padded-signature registry: lets the flight recorder attribute a
+    # solve's compile span to a warm-pool hit (pack.py annotates
+    # warm_hit when its padded shape matches a pre-compiled bucket)
+    compiled_buckets.add((Gp, Cp, Ep, F, mode))
+
+
+# padded (Gp, Cp, Ep, F, mode) signatures AOT-compiled by this process
+# (see _compile_bucket); read via `warmed` from pack's dispatch path
+compiled_buckets: set[tuple] = set()
+
+
+def warmed(Gp: int, Cp: int, Ep: int, F: int, mode: str) -> bool:
+    """True when a warm-pool bucket compile covered this exact padded
+    shape — the deterministic warm-hit signal (the compile span's
+    duration shows it; this attributes it)."""
+    return (Gp, Cp, Ep, F, mode) in compiled_buckets
 
 
 def rewarm_canary() -> bool:

@@ -1,0 +1,90 @@
+"""Metrics docs-drift guard (ISSUE 9 satellite, the test_fault_docs
+pattern): every metric name registered in code must have a row in
+README's metrics reference table. A new series landed without
+documentation is a failing build, not a dashboard surprise.
+
+Registrations are extracted from the AST of every module under
+karpenter_tpu/ (calls shaped `REGISTRY.counter|gauge|histogram("name",
+...)`), so the guard tracks the source of truth without importing the
+whole tree.
+"""
+
+import ast
+import pathlib
+import re
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+PKG = REPO / "karpenter_tpu"
+README = REPO / "README.md"
+
+_METHODS = {"counter", "gauge", "histogram"}
+
+
+def registered_metrics() -> dict[str, str]:
+    """{metric name: relative module path} for every REGISTRY
+    registration in the package."""
+    out: dict[str, str] = {}
+    for path in sorted(PKG.rglob("*.py")):
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for node in ast.walk(tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _METHODS
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "REGISTRY"
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+            ):
+                continue
+            out[node.args[0].value] = str(path.relative_to(REPO))
+    return out
+
+
+def _table_rows() -> list[str]:
+    return [
+        line for line in README.read_text().splitlines()
+        if line.strip().startswith("|")
+    ]
+
+
+def test_every_registered_metric_has_a_readme_table_row():
+    rows = _table_rows()
+    missing = []
+    for name, module in sorted(registered_metrics().items()):
+        pattern = re.compile(r"^\|\s*`" + re.escape(name) + r"`\s*\|")
+        if not any(pattern.match(row.strip()) for row in rows):
+            missing.append(f"{name} ({module})")
+    assert not missing, (
+        "metrics registered in code without a row in README's metrics "
+        f"reference table: {missing}"
+    )
+
+
+def test_readme_table_names_no_phantom_metrics():
+    """The reverse direction: a README row claiming a karpenter_*
+    metric that no code registers is stale documentation."""
+    known = set(registered_metrics())
+    phantom = []
+    for row in _table_rows():
+        m = re.match(r"^\|\s*`(karpenter_[a-z0-9_]+)`\s*\|", row.strip())
+        if m and m.group(1) not in known:
+            phantom.append(m.group(1))
+    assert not phantom, (
+        f"README metrics table rows with no code registration: {phantom}"
+    )
+
+
+def test_guard_reads_the_real_registrations():
+    """Self-check: a refactor that moves the registry must not
+    green-wash the guard by emptying the extraction."""
+    names = set(registered_metrics())
+    assert {
+        "karpenter_nodeclaims_created_total",
+        "karpenter_operator_last_tick_timestamp_seconds",
+        "karpenter_operator_tick_duration_seconds",
+        "karpenter_operator_step_duration_seconds",
+        "karpenter_solver_phase_duration_seconds",
+    } <= names
+    assert len(names) >= 55

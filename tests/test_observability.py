@@ -57,6 +57,39 @@ class TestExposition:
         text = render(reg)
         assert 't_esc{k="a\\"b\\\\c\\nd"} 1' in text
 
+    def test_label_escaping_edge_cases(self):
+        """Exposition escaping torture set: bare backslash at end of
+        value, consecutive quotes, newline-only value, backslash-n
+        literal (must NOT collapse with an escaped newline), and
+        escaping inside HELP text."""
+        reg = Registry()
+        g = reg.gauge("t_edge", 'help with "quotes" and \\slash\n2nd')
+        g.set(1, {"k": "trailing\\"})
+        g.set(2, {"k": '""'})
+        g.set(3, {"k": "\n"})
+        g.set(4, {"k": "\\n"})
+        text = render(reg)
+        assert 't_edge{k="trailing\\\\"} 1' in text
+        assert 't_edge{k="\\"\\""} 2' in text
+        assert 't_edge{k="\\n"} 3' in text
+        # a literal backslash+n escapes the BACKSLASH, not the n: the
+        # rendered bytes differ from the real-newline series above
+        assert 't_edge{k="\\\\n"} 4' in text
+        assert ('# HELP t_edge help with \\"quotes\\" and '
+                '\\\\slash\\n2nd') in text
+
+    def test_histogram_exact_bucket_boundary(self):
+        """A value exactly on a bucket edge counts in that bucket
+        (le semantics), and the +Inf bucket equals _count."""
+        reg = Registry()
+        h = reg.histogram("t_edge_seconds", "x", buckets=[0.1, 1.0])
+        h.observe(0.1)
+        h.observe(1.0)
+        text = render(reg)
+        assert 't_edge_seconds_bucket{le="0.1"} 1' in text
+        assert 't_edge_seconds_bucket{le="1"} 2' in text
+        assert 't_edge_seconds_bucket{le="+Inf"} 2' in text
+
 
 class TestObservabilityServer:
     def _operator(self):
@@ -107,14 +140,107 @@ class TestObservabilityServer:
         op = self._operator()
         server = op.serve_observability(port=0)
         try:
-            try:
-                _get(server.port, "/nope")
-                status = 200
-            except urllib.error.HTTPError as err:
-                status = err.code
-            assert status == 404
+            for path in ("/nope", "/debug", "/debug/nope", "/metrics/x"):
+                try:
+                    _get(server.port, path)
+                    status = 200
+                except urllib.error.HTTPError as err:
+                    status = err.code
+                assert status == 404, path
         finally:
             op.stop_observability()
+
+    def test_content_types(self):
+        op = self._operator()
+        server = op.serve_observability(port=0)
+        try:
+            op.step()
+            expectations = {
+                "/metrics": "text/plain; version=0.0.4; charset=utf-8",
+                "/healthz": "application/json",
+                "/readyz": "application/json",
+                "/debug/profile": "application/json",
+                "/debug/traces": "application/json",
+            }
+            for path, want in expectations.items():
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{server.port}{path}", timeout=5
+                ) as resp:
+                    assert resp.headers["Content-Type"] == want, path
+        finally:
+            op.stop_observability()
+
+    def test_debug_traces_json_and_perfetto(self):
+        from karpenter_tpu import tracing
+
+        tracing.clear()
+        op = self._operator()
+        server = op.serve_observability(port=0)
+        try:
+            op.kube.create(mk_nodepool("default"))
+            op.kube.create(mk_pod(cpu=1.0))
+            import time as _time
+
+            now = _time.time()
+            op.provisioner.batcher.trigger(now=now)
+            for i in range(3):
+                op.step(now=now + 2 + i)
+            status, body = _get(server.port, "/debug/traces")
+            assert status == 200
+            ring = json.loads(body)["traces"]
+            assert ring and ring[-1]["name"] == "tick"
+            tid = op.kube.node_claims()[0].metadata.annotations[
+                tracing.PROVENANCE_ANNOTATION
+            ]
+            # provenance filter: one trace's segments by id
+            status, body = _get(
+                server.port, f"/debug/traces?trace_id={tid}"
+            )
+            selected = json.loads(body)["traces"]
+            assert selected and all(
+                t["trace_id"] == tid for t in selected
+            )
+            names = {s["name"] for t in selected for s in t["spans"]}
+            assert {"tick", "provision", "create"} <= names
+            # Perfetto/Chrome-trace format
+            status, body = _get(
+                server.port, "/debug/traces?format=perfetto"
+            )
+            events = json.loads(body)["traceEvents"]
+            assert events
+            assert all(e["ph"] == "X" for e in events)
+            assert any(e["name"] == "tick" for e in events)
+        finally:
+            op.stop_observability()
+            tracing.clear()
+
+    def test_healthz_wedge_detection(self, monkeypatch):
+        """Tick liveness: a loop that stops ticking goes unhealthy once
+        the last tick's age exceeds the configured multiple of the
+        tick interval; the staleness metrics exist alongside."""
+        from karpenter_tpu.metrics.store import (
+            OPERATOR_LAST_TICK,
+            OPERATOR_TICK_DURATION,
+        )
+
+        op = self._operator()
+        count0 = OPERATOR_TICK_DURATION.count()
+        op.step()
+        assert OPERATOR_TICK_DURATION.count() == count0 + 1
+        assert OPERATOR_LAST_TICK.value() > 0
+        assert op.healthz()["checks"]["tick_fresh"] is True
+        # embedders without a run() loop get no staleness check
+        op._last_tick_wall -= 3600
+        assert op.healthz()["ok"] is True
+        # under run()'s interval, the same age trips the check
+        op._tick_interval = 1.0
+        monkeypatch.setenv("KARPENTER_TICK_STALL_MULTIPLE", "10")
+        probe = op.healthz()
+        assert probe["ok"] is False
+        assert probe["checks"]["tick_fresh"] is False
+        # a generous multiple keeps it healthy (knob is live per probe)
+        monkeypatch.setenv("KARPENTER_TICK_STALL_MULTIPLE", "1e6")
+        assert op.healthz()["ok"] is True
 
 
 import urllib.error  # noqa: E402  (used in except clauses above)

@@ -71,8 +71,10 @@ class TestCodec:
     def test_request_roundtrip(self):
         _, _, enc = _enc()
         payload = codec.encode_request(enc, "ffd", 0, 0, None)
-        enc2, mode, max_nodes, shards, plan = codec.decode_request(payload)
+        (enc2, mode, max_nodes, shards, plan,
+         trace_id) = codec.decode_request(payload)
         assert mode == "ffd" and max_nodes == 0 and plan is None
+        assert trace_id == ""  # no open trace: the field stays absent
         assert np.array_equal(enc2.compat, enc.compat)
         assert np.array_equal(enc2.cfg_price, enc.cfg_price)
         assert [c.existing_index for c in enc2.configs] == [

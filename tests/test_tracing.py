@@ -1,0 +1,397 @@
+"""Flight recorder (ISSUE 9): span mechanics, decision provenance, the
+cross-process trace id, and the chaos contract — span STRUCTURE (not
+timings) must be byte-identical under byte-identical fault replay.
+
+The acceptance path: a launched NodeClaim's karpenter.sh/provenance
+annotation resolves, via the trace ring (/debug/traces), to a full
+span tree covering intake -> solve -> create -> bind for its tick,
+with the solver-service hop and injected faults attributed to spans.
+"""
+
+import json
+import time
+
+import pytest
+
+from karpenter_tpu import tracing
+from karpenter_tpu.cloudprovider.fake import GIB, make_instance_type
+from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
+from karpenter_tpu.kube.client import KubeClient
+from karpenter_tpu.operator.operator import Operator
+from karpenter_tpu.operator.options import Options
+from karpenter_tpu.solver import faults
+from karpenter_tpu.testing import Environment, mk_nodepool, mk_pod
+
+
+@pytest.fixture(autouse=True)
+def _clean_ring(monkeypatch):
+    monkeypatch.delenv("KARPENTER_FAULTS", raising=False)
+    monkeypatch.delenv("KARPENTER_TRACE", raising=False)
+    monkeypatch.delenv("KARPENTER_TRACE_RING", raising=False)
+    faults.reset()
+    tracing.clear()
+    yield
+    tracing.clear()
+    faults.reset()
+
+
+def _types():
+    return [make_instance_type("c4", cpu=4, memory=16 * GIB, price=1.0)]
+
+
+def _ticked_operator(n_pods=3, ticks=4, base=1_700_000_000.0,
+                     options=None):
+    kube = KubeClient()
+    cloud = KwokCloudProvider(kube)
+    op = Operator(kube=kube, cloud_provider=cloud,
+                  options=options or Options())
+    kube.create(mk_nodepool("default"))
+    for i in range(n_pods):
+        kube.create(mk_pod(name=f"tp-{i}", cpu=1.0))
+    op.provisioner.batcher.trigger(now=base)
+    for i in range(ticks):
+        op.step(now=base + 2 + i)
+    return op
+
+
+class TestSpanMechanics:
+    def test_no_trace_is_a_noop(self):
+        with tracing.span("orphan") as sp:
+            sp.annotate(x=1)
+            sp.add_event("e")
+        assert tracing.traces() == []
+        assert tracing.current_trace_id() == ""
+
+    def test_nesting_parent_ids_and_attrs(self):
+        clock = iter(range(100))
+        with tracing.trace("root", clock=lambda: next(clock)):
+            with tracing.span("a", k="v"):
+                with tracing.span("b"):
+                    tracing.annotate(deep=True)
+        (t,) = tracing.traces()
+        by_name = {s["name"]: s for s in t["spans"]}
+        assert by_name["a"]["parent_id"] == by_name["root"]["span_id"]
+        assert by_name["b"]["parent_id"] == by_name["a"]["span_id"]
+        assert by_name["a"]["attrs"] == {"k": "v"}
+        assert by_name["b"]["attrs"] == {"deep": True}
+        # injectable clock: monotone integer ticks land as span times
+        assert by_name["b"]["t0_s"] > by_name["a"]["t0_s"]
+
+    def test_nested_trace_degrades_to_span(self):
+        with tracing.trace("outer"):
+            with tracing.trace("inner"):
+                pass
+        (t,) = tracing.traces()
+        assert t["name"] == "outer"
+        assert [s["name"] for s in t["spans"]] == ["outer", "inner"]
+
+    def test_kill_switch(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_TRACE", "0")
+        with tracing.trace("t"):
+            with tracing.span("s"):
+                pass
+        assert tracing.traces() == []
+
+    def test_ring_is_bounded(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_TRACE_RING", "3")
+        for i in range(5):
+            with tracing.trace(f"t{i}"):
+                pass
+        names = [t["name"] for t in tracing.traces()]
+        assert names == ["t2", "t3", "t4"]
+
+    def test_record_from_existing_timestamps(self):
+        with tracing.trace("t") as root:
+            t0 = time.perf_counter()
+            t1 = t0 + 0.5
+            tracing.record("phase", t0, t1, k=1)
+        (t,) = tracing.traces()
+        phase = next(s for s in t["spans"] if s["name"] == "phase")
+        assert phase["attrs"] == {"k": 1}
+        assert 0.49 < phase["t1_s"] - phase["t0_s"] < 0.51
+        assert phase["parent_id"] == 0
+        assert root.trace_id == t["trace_id"]
+
+    def test_adopt_records_separate_segment_under_same_id(self):
+        with tracing.trace("tick") as root:
+            tid = root.trace_id
+            with tracing.adopt(tid, "solve.remote"):
+                with tracing.span("inner"):
+                    pass
+        segs = tracing.find(tid)
+        assert len(segs) == 2
+        assert {s["name"] for s in segs} == {"tick", "solve.remote"}
+        remote = next(s for s in segs if s["name"] == "solve.remote")
+        assert [s["name"] for s in remote["spans"]] == [
+            "solve.remote", "inner"
+        ]
+
+    def test_adopt_inside_open_span_restores_parenting(self):
+        """Review regression: an adopt() nested inside an OPEN span on
+        the same thread must restore the original stack object — a
+        copy strands the enclosing span's entry and every later span
+        mis-parents under the already-closed one."""
+        with tracing.trace("tick") as root:
+            with tracing.span("rpc"):
+                with tracing.adopt(root.trace_id, "solve.remote"):
+                    pass
+            with tracing.span("after"):
+                pass
+        tick = next(t for t in tracing.find(root.trace_id)
+                    if t["name"] == "tick")
+        by_name = {s["name"]: s for s in tick["spans"]}
+        # "after" is a sibling of "rpc" (parents to the root), not a
+        # child of the closed rpc span
+        assert by_name["after"]["parent_id"] == by_name["tick"]["span_id"]
+
+    def test_structure_strips_nonstructural_attrs(self):
+        """warm_hit is coupled to the background warm pool's compile
+        progress; two byte-identical replays may disagree on it, so
+        structure() must not include it."""
+        with tracing.trace("a"):
+            with tracing.span("s", warm_hit=True, outcome="ok"):
+                pass
+        with tracing.trace("b"):
+            with tracing.span("s", warm_hit=False, outcome="ok"):
+                pass
+        a, b = tracing.traces()
+        assert tracing.structure(a)[0][3] == tracing.structure(b)[0][3]
+
+    def test_span_stats_and_chrome_export(self):
+        clock = iter([0.0, 1.0, 3.0, 4.0])
+        with tracing.trace("t", clock=lambda: next(clock)):
+            with tracing.span("work"):
+                pass
+        stats = tracing.span_stats(tracing.traces())
+        assert stats["work"]["count"] == 1
+        assert stats["work"]["p50_s"] == 2.0
+        chrome = tracing.to_chrome(tracing.traces())
+        events = chrome["traceEvents"]
+        assert {e["name"] for e in events} == {"t", "work"}
+        work = next(e for e in events if e["name"] == "work")
+        assert work["ph"] == "X" and work["dur"] == pytest.approx(2e6)
+
+
+class TestDecisionProvenance:
+    def test_nodeclaim_annotation_resolves_to_full_span_tree(self):
+        """The acceptance criterion's local half: annotation ->
+        /debug/traces -> intake/solve/create/bind spans of its tick."""
+        op = _ticked_operator()
+        claims = op.kube.node_claims()
+        assert claims
+        tid = claims[0].metadata.annotations[tracing.PROVENANCE_ANNOTATION]
+        assert tid
+        segs = tracing.find(tid)
+        assert len(segs) == 1
+        names = {s["name"] for s in segs[0]["spans"]}
+        for expected in ("tick", "provision", "intake", "route",
+                         "scheduler.solve", "solve.encode", "solver.rung",
+                         "solve.decode", "create"):
+            assert expected in names, (expected, sorted(names))
+        # the bind lands on a later tick; its trace exists in the ring
+        bind_spans = [
+            s for t in tracing.traces() for s in t["spans"]
+            if s["name"] == "bind" and s["attrs"].get("bound", 0) > 0
+        ]
+        assert bind_spans, "no tick bound the provisioned pods"
+        # route carries the routing decision + reason
+        route = next(s for s in segs[0]["spans"] if s["name"] == "route")
+        assert route["attrs"]["path"] in ("full_backstop", "incremental")
+        assert route["attrs"]["reason"]
+
+    def test_readyz_surfaces_last_tick_trace(self):
+        op = _ticked_operator(ticks=2)
+        digest = op.readyz()["last_tick_trace"]
+        assert digest is not None
+        assert digest["name"] == "tick"
+        assert digest["span_count"] >= 1
+        assert tracing.find(digest["trace_id"])
+
+    def test_recorder_events_carry_trace_id(self):
+        op = _ticked_operator()
+        nominated = op.recorder.for_reason("Nominated")
+        assert nominated
+        assert any(r.trace_id for r in nominated)
+        rec = next(r for r in nominated if r.trace_id)
+        assert tracing.find(rec.trace_id)
+        # the posted corev1 Event carries the annotation too
+        posted = [
+            e for e in op.kube.list("Event")
+            if e.metadata.annotations.get(tracing.PROVENANCE_ANNOTATION)
+        ]
+        assert posted
+
+    def test_fault_log_gains_trace_column_replay_log_unchanged(
+        self, monkeypatch
+    ):
+        monkeypatch.setenv("KARPENTER_FAULTS", "device_lost@solve:1")
+        op = _ticked_operator()
+        inj = faults.get()
+        log = inj.snapshot_log()
+        assert log == [("solve", 1, "device_lost")]  # 3-tuples: replay
+        traced = inj.snapshot_log_traced()
+        assert len(traced) == 1
+        site, seq, kind, tid = traced[0]
+        assert (site, seq, kind) == ("solve", 1, "device_lost")
+        assert tid and tracing.find(tid)
+        # the fault is attributed to a span of that tick's trace
+        events = [
+            e
+            for t in tracing.find(tid)
+            for s in t["spans"]
+            for e in s["events"]
+            if e["name"] == "fault"
+        ]
+        assert {"name": "fault", "kind": "device_lost", "site": "solve",
+                "seq": 1} in events
+        # and the ladder degraded: device rung failed, host served
+        rungs = [
+            (s["attrs"].get("rung"), s["attrs"].get("outcome"))
+            for t in tracing.find(tid)
+            for s in t["spans"]
+            if s["name"] == "solver.rung"
+        ]
+        assert ("device", "device_lost") in rungs
+        assert ("host", "ok") in rungs
+
+
+class TestServiceHop:
+    def test_trace_id_survives_the_rpc_and_server_adopts_it(
+        self, monkeypatch
+    ):
+        """The cross-process half of the acceptance criterion: the
+        solver-service hop is attributed to spans on BOTH sides of the
+        wire under one trace id."""
+        grpc = pytest.importorskip("grpc")
+        from karpenter_tpu.service.server import SolverServer
+        from karpenter_tpu.solver import resilience
+        from karpenter_tpu.solver import solver as solver_mod
+
+        server = SolverServer(port=0).start()
+        try:
+            monkeypatch.setenv(
+                "KARPENTER_SOLVER_ENDPOINT", f"127.0.0.1:{server.port}"
+            )
+            resilience.reset()
+            op = _ticked_operator()
+            assert server.requests_served >= 1
+            tid = op.kube.node_claims()[0].metadata.annotations[
+                tracing.PROVENANCE_ANNOTATION
+            ]
+            segs = tracing.find(tid)
+            names = {t["name"] for t in segs}
+            assert "tick" in names
+            assert "solve.remote" in names, (
+                "server-side segment missing: the codec did not carry "
+                f"the trace id ({[t['name'] for t in segs]})"
+            )
+            tick = next(t for t in segs if t["name"] == "tick")
+            rpc = [s for s in tick["spans"] if s["name"] == "solve.rpc"]
+            assert rpc and rpc[0]["attrs"]["endpoint"].endswith(
+                str(server.port)
+            )
+        finally:
+            server.stop(grace=0.2)
+            monkeypatch.delenv("KARPENTER_SOLVER_ENDPOINT", raising=False)
+            resilience.reset()
+            with solver_mod._remote_lock:
+                if solver_mod._remote_solver is not None:
+                    solver_mod._remote_solver.close()
+                    solver_mod._remote_solver = None
+
+    def test_old_peer_request_without_trace_id_decodes(self):
+        from karpenter_tpu.service import codec
+        from karpenter_tpu.solver.encode import encode, group_pods
+
+        env = Environment(types=_types())
+        env.kube.create(mk_nodepool("p"))
+        pods = [mk_pod(cpu=1.0)]
+        pools = env.provisioner.ready_pools_with_types()
+        enc = encode(group_pods(pods), pools)
+        # wire compatibility: a payload with no trace_id header field
+        # (an old peer) decodes with trace_id == ""
+        payload = codec.encode_request(enc, "ffd", 0, 0, None)
+        *_, trace_id = codec.decode_request(payload)
+        assert trace_id == ""
+        payload = codec.encode_request(enc, "ffd", 0, 0, None,
+                                       trace_id="abc123")
+        *_, trace_id = codec.decode_request(payload)
+        assert trace_id == "abc123"
+
+
+@pytest.mark.chaos
+class TestChaosStructureIdentity:
+    def _run(self, spec, monkeypatch, ticks=5):
+        """One operator run under `spec`; returns the span structures
+        of every tick trace, in tick order."""
+        monkeypatch.setenv("KARPENTER_FAULTS", spec)
+        monkeypatch.setenv("KARPENTER_FAULT_SEED", "11")
+        faults.reset()
+        tracing.clear()
+        _ticked_operator(n_pods=4, ticks=ticks)
+        structures = [
+            tracing.structure(t) for t in tracing.traces()
+            if t["name"] == "tick"
+        ]
+        inj = faults.get()
+        log = inj.snapshot_log() if inj is not None else []
+        return structures, log
+
+    def test_identical_replay_has_identical_span_structure(
+        self, monkeypatch
+    ):
+        """The decision-identity contract extended to the observability
+        layer: two runs of one fault schedule replay byte-identical
+        fault logs AND byte-identical span trees — ids and timings
+        differ, structure (names, nesting, attrs, fault events) must
+        not."""
+        spec = "device_lost@solve:2,kube_conflict@kube_write:1"
+        s1, log1 = self._run(spec, monkeypatch)
+        s2, log2 = self._run(spec, monkeypatch)
+        assert log1 == log2, "fault replay itself diverged"
+        assert len(s1) == len(s2)
+        for i, (a, b) in enumerate(zip(s1, s2)):
+            assert a == b, f"tick {i} span structure diverged"
+        # the runs actually traced something substantial
+        assert any("provision" in json.dumps(s) for s in s1)
+
+    def test_faulted_run_differs_from_clean_run(self, monkeypatch):
+        """Positive control: the structure comparison is sensitive —
+        a run WITH an injected fault must not compare equal to the
+        clean run (the fault event + degraded rung are in the tree)."""
+        clean, _ = self._run("", monkeypatch)
+        faulted, _ = self._run("device_lost@solve:2", monkeypatch)
+        assert clean != faulted
+
+
+class TestTraceReportTool:
+    def test_renders_ring_and_bench_payloads(self):
+        import sys
+
+        sys.path.insert(0, "tools")
+        try:
+            import trace_report
+        finally:
+            sys.path.pop(0)
+        with tracing.trace("tick"):
+            with tracing.span("work"):
+                pass
+        ring_payload = {"traces": tracing.traces()}
+        out = trace_report.report(ring_payload)
+        assert "work" in out and "p99_s" in out
+        bench_payload = {
+            "detail": {
+                "arm_a": {
+                    "trace_summary": {
+                        "spans": tracing.span_stats(tracing.traces()),
+                        "traces_sampled": 1,
+                        "ring_capacity": tracing.ring_size(),
+                    }
+                },
+                "arm_b": {"pods_per_sec": 1.0},
+            }
+        }
+        out = trace_report.report(bench_payload)
+        assert "arm_a" in out and "work" in out
+        assert "1 trace(s) sampled" in out
+        assert trace_report.report({"detail": {}}).startswith("(no traces")

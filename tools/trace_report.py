@@ -1,0 +1,90 @@
+#!/usr/bin/env python3
+"""Render a saved flight-recorder trace ring as a per-span latency
+table.
+
+Input: the JSON an operator serves at /debug/traces (`{"traces":
+[...]}`), a bare list of trace dicts, or a bench JSON whose arms carry
+`trace_summary` blocks — from a file argument or stdin. Output: one
+aligned table per source — span name, count, total, p50, p99, max —
+the same digest bench artifacts embed per arm (tracing.span_stats).
+
+    curl -s localhost:8080/debug/traces | python tools/trace_report.py
+    python tools/trace_report.py ring.json
+    python tools/trace_report.py BENCH_r06.json   # per-arm summaries
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from karpenter_tpu.tracing import span_stats  # noqa: E402
+
+
+def _fmt_table(stats: dict[str, dict]) -> str:
+    if not stats:
+        return "(no spans)"
+    headers = ("span", "count", "total_s", "p50_s", "p99_s", "max_s")
+    rows = [
+        (name, str(s["count"]), f"{s['total_s']:.6f}",
+         f"{s['p50_s']:.6f}", f"{s['p99_s']:.6f}", f"{s['max_s']:.6f}")
+        for name, s in stats.items()
+    ]
+    widths = [
+        max(len(h), *(len(r[i]) for r in rows))
+        for i, h in enumerate(headers)
+    ]
+    lines = [
+        "  ".join(h.ljust(w) for h, w in zip(headers, widths)),
+        "  ".join("-" * w for w in widths),
+    ]
+    for row in rows:
+        lines.append("  ".join(c.ljust(w) for c, w in zip(row, widths)))
+    return "\n".join(lines)
+
+
+def report(payload) -> str:
+    """Dispatch on the payload shape (see module docstring)."""
+    if isinstance(payload, list):
+        return _fmt_table(span_stats(payload))
+    if "traces" in payload:
+        traces = payload["traces"]
+        out = [_fmt_table(span_stats(traces))]
+        ids = sorted({t["trace_id"] for t in traces})
+        out.append(f"\n{len(traces)} trace(s), {len(ids)} id(s)")
+        return "\n".join(out)
+    # bench JSON: arms carrying trace_summary blocks
+    detail = payload.get("detail", payload)
+    sections = []
+    for arm, body in detail.items():
+        if isinstance(body, dict) and "trace_summary" in body:
+            summary = body["trace_summary"]
+            # wrapped shape {spans, traces_sampled, ring_capacity};
+            # bare per-span dicts accepted for older artifacts
+            stats = summary.get("spans", summary)
+            header = f"== {arm} =="
+            if "traces_sampled" in summary:
+                header += (
+                    f" ({summary['traces_sampled']} trace(s) sampled,"
+                    f" ring capacity {summary['ring_capacity']})"
+                )
+            sections.append(f"{header}\n{_fmt_table(stats)}")
+    if not sections:
+        return "(no traces or trace_summary blocks found)"
+    return "\n\n".join(sections)
+
+
+def main(argv: list[str]) -> int:
+    if len(argv) > 1:
+        with open(argv[1]) as fh:
+            payload = json.load(fh)
+    else:
+        payload = json.load(sys.stdin)
+    print(report(payload))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
