@@ -213,6 +213,330 @@ def build_problem(n_pods: int, n_types: int, seed: int = 42,
     return pods, [(pool, types)]
 
 
+def _peak_rss_mb() -> float:
+    """Host peak RSS (VmHWM) in MB — the high-watermark since process
+    start or the last _reset_peak_rss()."""
+    try:
+        with open("/proc/self/status") as fh:
+            for line in fh:
+                if line.startswith("VmHWM:"):
+                    return round(int(line.split()[1]) / 1024.0, 1)
+    except OSError:
+        pass
+    try:
+        import resource
+
+        peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        # ru_maxrss is KB on Linux/BSD but BYTES on macOS
+        divisor = 1024.0 * 1024.0 if sys.platform == "darwin" else 1024.0
+        return round(peak / divisor, 1)
+    except Exception:
+        return 0.0
+
+
+def _reset_peak_rss() -> bool:
+    """Reset the kernel's peak-RSS watermark (Linux: writing 5 to
+    /proc/self/clear_refs) so _peak_rss_mb() scopes to the region that
+    follows. Returns False where unsupported — callers then flag the
+    reported peak as process-lifetime, not per-arm."""
+    try:
+        with open("/proc/self/clear_refs", "w") as fh:
+            fh.write("5")
+        return True
+    except OSError:
+        return False
+
+
+def build_scaled_demand(total_pods: int, n_types: int = 100,
+                        n_signatures: int = 400, seed: int = 13):
+    """Million-pod demand as SCALED GROUP COUNTS: one representative
+    pod per scheduling signature (diverse shapes x arch/zone
+    selectors), encoded once, with `Encoded.group_count` rescaled to a
+    Pareto-weighted distribution summing to `total_pods`.
+
+    The kernel is pod-count-invariant in memory — grouped encoding is
+    the architecture's point: a million-pod solve differs from the
+    representative solve only in the demand counts, so materializing a
+    million Pod objects host-side would measure CPython's allocator,
+    not the solver. The solve, the node axis it opens, and the
+    reported pods/sec are exactly the million-pod problem's; only the
+    per-pod decode (which walks real Pod objects) is out of scope, and
+    the JSON flags `demand_scaled` accordingly. Returns (enc, pools).
+    """
+    import numpy as np
+
+    from karpenter_tpu.apis.v1.labels import TOPOLOGY_ZONE_LABEL
+    from karpenter_tpu.apis.v1.nodepool import NodePool
+    from karpenter_tpu.cloudprovider.fake import GIB, instance_types
+    from karpenter_tpu.kube.objects import Container, ObjectMeta, Pod, PodSpec
+    from karpenter_tpu.solver.encode import encode, group_pods
+
+    rng = np.random.default_rng(seed)
+    types = instance_types(n_types)
+    pool = NodePool(metadata=ObjectMeta(name="default"))
+    zones = ["test-zone-1", "test-zone-2", "test-zone-3"]
+    cpu_levels = [0.1, 0.25, 0.5, 0.75, 1.0, 1.5, 2.0, 3.0, 4.0, 6.0, 8.0]
+    mem_levels = [0.25, 0.5, 1.0, 2.0, 4.0, 8.0, 16.0]
+    reps = []
+    for i in range(n_signatures):
+        selector = {}
+        if rng.random() < 0.25:
+            selector["kubernetes.io/arch"] = str(
+                rng.choice(["amd64", "arm64"])
+            )
+        if rng.random() < 0.3:
+            selector[TOPOLOGY_ZONE_LABEL] = str(rng.choice(zones))
+        reps.append(Pod(
+            metadata=ObjectMeta(name=f"sig-{i}"),
+            spec=PodSpec(
+                containers=[Container(requests={
+                    "cpu": float(rng.choice(cpu_levels)),
+                    "memory": float(rng.choice(mem_levels)) * GIB,
+                })],
+                node_selector=selector,
+            ),
+        ))
+    groups = group_pods(reps)
+    enc = encode(groups, [(pool, types)])
+    G = enc.compat.shape[0]
+    # Pareto weights: a heavy head (the big deployments) over a long
+    # tail of small services — the shape real million-pod fleets have
+    if total_pods < G:
+        raise ValueError(
+            f"total_pods={total_pods} below the {G} encoded signatures "
+            "— every group carries at least one pod; lower "
+            "n_signatures for tiny smoke runs"
+        )
+    weights = rng.pareto(1.5, G) + 1.0
+    counts = np.maximum(
+        1, np.floor(weights / weights.sum() * total_pods)
+    ).astype(np.int64)
+    # rebalance to the exact total WITHOUT driving any group below 1:
+    # the min-1 floor can overshoot small totals, and dumping the whole
+    # correction on the largest group went negative there
+    excess = int(counts.sum() - total_pods)
+    if excess > 0:
+        for i in np.argsort(-counts):
+            cut = min(excess, int(counts[i]) - 1)
+            counts[i] -= cut
+            excess -= cut
+            if excess == 0:
+                break
+    else:
+        counts[np.argmax(counts)] += -excess
+    assert counts.sum() == total_pods
+    assert counts.min() >= 1 and counts.max() < 2**31
+    enc.group_count = counts.astype(np.int32)
+    return enc, [(pool, types)]
+
+
+def _run_million_worker() -> dict:
+    """The million_pod arm's body — assumes the process already has
+    the device mesh it needs (scenario_million_pod spawns a subprocess
+    with virtual CPU devices when the parent is a single-device CPU
+    bench; a real multi-chip host runs this inline).
+
+    Measures, at BENCH_MILLION_PODS total demand:
+    - p50/p99 tick latency + pods/sec of the production-routed solve
+      (sharded over the mesh, wavefront per backend auto-routing,
+      streaming encode) over BENCH_MILLION_REPEATS steady solves;
+    - the streaming staging's peak-block vs full-materialization
+      bytes, plus scoped host peak RSS for the streamed arm AND a
+      full-materialization baseline solve (KARPENTER_STREAM_ENCODE=0)
+      whose placements must be identical;
+    - an unsharded reference solve (BENCH_MILLION_COMPARE=0 skips).
+    """
+    import jax
+    import numpy as np
+
+    from karpenter_tpu.solver import stream
+    from karpenter_tpu.solver.pack import solve_packing
+
+    total = int(os.environ.get("BENCH_MILLION_PODS", "1000000"))
+    n_types = int(os.environ.get("BENCH_MILLION_TYPES", "100"))
+    n_sig = int(os.environ.get("BENCH_MILLION_SIGNATURES", "400"))
+    repeats = max(1, int(os.environ.get("BENCH_MILLION_REPEATS", "3")))
+    shards = min(
+        int(os.environ.get("BENCH_MILLION_SHARDS", "8")),
+        len(jax.devices()),
+    )
+    compare = os.environ.get(
+        "BENCH_MILLION_COMPARE", "1"
+    ).lower() not in ("0", "false", "off")
+
+    t0 = time.perf_counter()
+    enc, _pools = build_scaled_demand(total, n_types, n_sig)
+    encode_wall = time.perf_counter() - t0
+    G, C = enc.compat.shape
+
+    prev = {
+        k: os.environ.get(k)
+        for k in ("KARPENTER_WAVEFRONT", "KARPENTER_STREAM_ENCODE")
+    }
+    os.environ["KARPENTER_WAVEFRONT"] = "auto"   # production routing
+    os.environ["KARPENTER_STREAM_ENCODE"] = "auto"
+    kw = {"shards": shards} if shards > 1 else {}
+    try:
+        # warm TWICE: first solve compiles the estimated node axis and
+        # remembers a tighter one, the second compiles THAT axis
+        t0 = time.perf_counter()
+        solve_packing(enc, mode="ffd", **kw)
+        solve_packing(enc, mode="ffd", **kw)
+        warm_wall = time.perf_counter() - t0
+
+        stream.reset_stats()
+        rss_scoped = _reset_peak_rss()
+        steps_before = _steps_snapshot()
+        samples = []
+        result = None
+        gc.collect()
+        gc.freeze()
+        try:
+            for _ in range(repeats):
+                t0 = time.perf_counter()
+                result = solve_packing(enc, mode="ffd", **kw)
+                samples.append(time.perf_counter() - t0)
+        finally:
+            gc.unfreeze()
+        peak_rss = _peak_rss_mb()
+        steps = _steps_delta(steps_before, _steps_snapshot())
+        sstats = stream.last_stats()
+
+        ordered = sorted(samples)
+
+        def pct(p):
+            x = p * (len(ordered) - 1)
+            lo = int(x)
+            hi = min(lo + 1, len(ordered) - 1)
+            return round(
+                ordered[lo] + (ordered[hi] - ordered[lo]) * (x - lo), 3
+            )
+
+        p50 = pct(0.50)
+        scheduled = int(result.assign.astype(np.int64).sum())
+        unsched = int(result.unschedulable.astype(np.int64).sum())
+        out = {
+            "pods": total,
+            "demand_scaled": True,
+            "signatures": G,
+            "configs": C,
+            "shards": shards,
+            "scheduled": scheduled,
+            "unschedulable": unsched,
+            "nodes": int(result.node_count),
+            "p50_s": p50,
+            "p99_s": pct(0.99),
+            "samples": len(ordered),
+            "pods_per_sec": round(scheduled / p50, 1) if p50 > 0 else 0.0,
+            "encode_wall_s": round(encode_wall, 3),
+            "warmup_s": round(warm_wall, 3),
+            "peak_rss_mb": peak_rss,
+            "peak_rss_scope": "arm" if rss_scoped else "process",
+        }
+        if steps:
+            out["device_steps"] = steps
+        if sstats:
+            out["stream_peak_staging_bytes"] = sstats["peak_block_bytes"]
+            out["full_staging_bytes"] = sstats["full_bytes"]
+            # the streaming-encode memory contract, asserted: the
+            # largest host transient of the streamed staging is a
+            # fraction of what one full-materialization copy of the
+            # padded matrices allocates (the classic path makes 2-3
+            # such copies per matrix)
+            out["staging_bounded"] = (
+                sstats["peak_block_bytes"] < sstats["full_bytes"]
+            )
+
+        if shards > 1:
+            # full-materialization baseline: same mesh, same program —
+            # only the staging differs, so placements must be identical
+            os.environ["KARPENTER_STREAM_ENCODE"] = "0"
+            _reset_peak_rss()
+            t0 = time.perf_counter()
+            full = solve_packing(enc, mode="ffd", **kw)
+            full_wall = time.perf_counter() - t0
+            out["full_staging_peak_rss_mb"] = _peak_rss_mb()
+            out["full_staging_wall_s"] = round(full_wall, 3)
+            n = result.node_count
+            out["stream_identical_to_full"] = bool(
+                full.node_count == n
+                and np.array_equal(full.assign[:n], result.assign[:n])
+            )
+            out["rss_below_full_baseline"] = bool(
+                peak_rss <= out["full_staging_peak_rss_mb"]
+            )
+            os.environ["KARPENTER_STREAM_ENCODE"] = "auto"
+
+        if compare and shards > 1:
+            # unsharded reference: what one device does with the same
+            # million pods (its own warm first — separate program)
+            solve_packing(enc, mode="ffd")
+            t0 = time.perf_counter()
+            solve_packing(enc, mode="ffd")
+            unsharded_wall = time.perf_counter() - t0
+            out["unsharded_wall_s"] = round(unsharded_wall, 3)
+            out["sharded_speedup"] = (
+                round(unsharded_wall / p50, 2) if p50 > 0 else 0.0
+            )
+        return out
+    finally:
+        for k, v in prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def scenario_million_pod() -> dict:
+    """Million-pod sharded scale-out (ISSUE 11): the 1M-pod demand
+    solved over the device mesh with the sharded wavefront routing and
+    streaming encode — the end-to-end proof of the millions-of-users
+    north star at the solver layer.
+
+    A single-device CPU bench host cannot shard in-process (virtual
+    CPU devices must be pinned before JAX initializes, and pinning
+    them process-wide costs every OTHER scenario ~35% single-device
+    wall — measured), so the arm runs in a SUBPROCESS with its own
+    XLA device flags; a host that already sees enough devices (a real
+    TPU mesh) runs it inline."""
+    import subprocess
+
+    import jax
+
+    want = int(os.environ.get("BENCH_MILLION_SHARDS", "8"))
+    # inline whenever the host has ANY mesh to offer (the worker clamps
+    # shards to the visible devices — a 4-chip host runs a 4-wide mesh)
+    # or a non-CPU backend: spawning a CPU subprocess from a TPU host
+    # would stamp virtual-CPU walls with the parent's tpu backend
+    if (
+        want <= 1
+        or len(jax.devices()) > 1
+        or jax.default_backend() != "cpu"
+    ):
+        return _run_million_worker()
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "--xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={want}"
+        ).strip()
+    timeout_s = float(os.environ.get("BENCH_MILLION_TIMEOUT_S", "1800"))
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--million-worker"],
+        env=env, capture_output=True, timeout=timeout_s,
+    )
+    if proc.returncode != 0:
+        tail = (proc.stderr or b"").decode(errors="replace")[-400:]
+        raise RuntimeError(f"million_pod worker failed: {tail}")
+    # the worker prints exactly one JSON line last; anything before it
+    # is library noise (XLA warnings)
+    line = (proc.stdout or b"").decode().strip().splitlines()[-1]
+    out = json.loads(line)
+    out["isolated_subprocess"] = True
+    return out
+
+
 def _steps_snapshot() -> dict:
     """(sum, count) of the device-step histogram per kernel path."""
     from karpenter_tpu.metrics.store import SOLVER_DEVICE_STEPS
@@ -1538,6 +1862,7 @@ def main() -> int:
         ),
         "spot_mix": scenario_spot_mix,
         "overload_surge": scenario_overload_surge,
+        "million_pod": scenario_million_pod,
     }
     if only:
         wanted = set(only.split(","))
@@ -1562,6 +1887,12 @@ def main() -> int:
         # spot_mix) leave tick traces behind; their per-span p50/p99
         # breakdown lands in the arm's JSON below
         tracing.clear()
+        # per-arm host peak RSS (ISSUE 11 satellite): the watermark is
+        # reset before each arm where the kernel supports it, so every
+        # scenario's JSON carries its own peak — the provenance the
+        # streaming-encode memory claim is tracked against round to
+        # round
+        rss_scoped = _reset_peak_rss()
         try:
             detail[name] = fn()
             # per-scenario backend stamp: a partial TPU run (tunnel died
@@ -1572,6 +1903,14 @@ def main() -> int:
             detail[name] = {"error": f"{type(e).__name__}: {e}",
                             "backend": backend}
             errors.append(f"{name}: {type(e).__name__}: {e}")
+        if "peak_rss_mb" not in detail[name]:
+            # scenarios measuring their own scoped peak (million_pod's
+            # subprocess) keep it; everyone else gets the arm-scoped
+            # watermark read here
+            detail[name]["peak_rss_mb"] = _peak_rss_mb()
+            detail[name]["peak_rss_scope"] = (
+                "arm" if rss_scoped else "process"
+            )
         # resilience activity delta (ladder rungs, breaker transitions,
         # deadline misses, hedge wins, injected faults): chaos arms set
         # KARPENTER_FAULTS and read the degradation story from here
@@ -1619,4 +1958,15 @@ def main() -> int:
 
 
 if __name__ == "__main__":
+    if "--million-worker" in sys.argv:
+        # the isolated million_pod arm (see scenario_million_pod): the
+        # spawning bench set JAX_PLATFORMS/XLA_FLAGS in our env; the
+        # config must still be pinned before the first backend touch
+        # (the site hook overwrites jax_platforms at startup)
+        if os.environ.get("JAX_PLATFORMS") == "cpu":
+            from karpenter_tpu.utils.platform import force_cpu_mesh
+
+            force_cpu_mesh()
+        print(json.dumps(_run_million_worker()))
+        sys.exit(0)
     sys.exit(main())

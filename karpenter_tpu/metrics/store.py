@@ -244,6 +244,16 @@ SOLVER_WAVEFRONT_WIDTH = REGISTRY.histogram(
 SOLVER_WARM_COMPILES = REGISTRY.counter(
     "karpenter_solver_warm_compiles_total",
     "Kernel shape buckets AOT-compiled by the warm pool, by outcome")
+SOLVER_SHARDS = REGISTRY.gauge(
+    "karpenter_solver_shards",
+    "Shard count the last device solve actually ran with (1 = "
+    "unsharded) — makes the silent KARPENTER_SOLVER_SHARDS "
+    "fallback-to-unsharded observable instead of log-only")
+SOLVER_STREAM_BLOCKS = REGISTRY.counter(
+    "karpenter_solver_stream_blocks_total",
+    "Per-shard column blocks shipped by the streaming staging path "
+    "(solver/stream.py) — zero on a sharded fleet means every solve "
+    "is still paying full-materialization host peaks")
 SOLVER_PROBE_BATCH = REGISTRY.counter(
     "karpenter_solver_probe_batch_total",
     "Batched consolidation probe activity: device dispatches (batch), "

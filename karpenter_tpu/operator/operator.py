@@ -761,6 +761,13 @@ class Operator:
             # a typo'd chaos knob must be visible here (and in
             # karpenter_faults_rejected_total), never silent
             "rejected_fault_specs": _faults.rejected_specs(),
+            # solver mesh resolution (ISSUE 11 satellite): the
+            # configured shard count vs what the last device solve
+            # actually ran with — a fleet-wide KARPENTER_SOLVER_SHARDS
+            # silently falling back to unsharded on a device-poor host
+            # is visible here (and in karpenter_solver_shards), not
+            # just in a log line
+            "solver": self._solver_status(),
             # flight recorder: digest of THIS operator's last tick
             # trace (full tree at /debug/traces?trace_id=...). The id
             # can match several ring segments — an in-process solver
@@ -771,6 +778,25 @@ class Operator:
                  if t["name"] == "tick"),
                 None,
             )),
+        }
+
+    @staticmethod
+    def _solver_status() -> dict:
+        """readyz()["solver"]: configured vs observed shard counts.
+        `shards_effective`/`devices_visible` are 0 until a device
+        solve has dispatched — deliberately read from the solve path's
+        own record rather than probing jax here, so a wedged backend
+        can never hang the readiness probe."""
+        from karpenter_tpu.solver.pack import (
+            default_shards,
+            last_resolved_shards,
+        )
+
+        observed = last_resolved_shards()
+        return {
+            "shards_configured": default_shards(),
+            "shards_effective": observed["effective"],
+            "devices_visible": observed["devices"],
         }
 
     def serve_observability(self, port: Optional[int] = None):

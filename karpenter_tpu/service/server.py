@@ -26,18 +26,37 @@ SERVICE_NAME = "karpenter.tpu.Solver"
 SOLVE_METHOD = f"/{SERVICE_NAME}/Solve"
 
 
+def resolve_service_shards(shards) -> int:
+    """Resolve the service's mesh width. `"auto"` (or any negative
+    int) spans EVERY device the host sees — the multi-host pjit mode
+    ISSUE 11 lands: one logical solve partitioned over the service's
+    whole device set. `0` inherits solve_packing's own default
+    (KARPENTER_SOLVER_SHARDS / unsharded); a positive int is taken
+    literally. With "auto" on a single-device host the resolution is 0
+    (nothing to span — the solve runs unsharded rather than paying
+    mesh setup for one device)."""
+    if shards == "auto" or (isinstance(shards, int) and shards < 0):
+        from karpenter_tpu.solver.pack import visible_devices
+
+        visible = visible_devices(1)
+        return visible if visible > 1 else 0
+    return int(shards)
+
+
 class SolverServer:
-    def __init__(self, port: int = 0, shards: int = 0, max_workers: int = 4,
+    def __init__(self, port: int = 0, shards=0, max_workers: int = 4,
                  bind: str = "127.0.0.1"):
         """`shards`: device-mesh width the service solves with — its own
         ICI parallelism, authoritative over anything a client sends (a
         control plane has no idea how many chips this host has).
-        `port=0` picks a free port, exposed as `self.port` after
-        start(). `bind`: loopback by default (tests/sidecar); a
-        standalone TPU host serves on all interfaces via serve()."""
+        `"auto"` / a negative int spans every visible device (see
+        resolve_service_shards). `port=0` picks a free port, exposed
+        as `self.port` after start(). `bind`: loopback by default
+        (tests/sidecar); a standalone TPU host serves on all
+        interfaces via serve()."""
         import grpc
 
-        self._default_shards = shards
+        self._default_shards = resolve_service_shards(shards)
         self._solve_lock = threading.Lock()
         self.requests_served = 0
         self.requests_started = 0
@@ -96,9 +115,11 @@ class SolverServer:
         self._server.stop(grace)
 
 
-def serve(port: int = 50151, shards: int = 0,
+def serve(port: int = 50151, shards="auto",
           bind: str = "[::]") -> None:  # pragma: no cover
     """Blocking entry point for a standalone solver host: listens on
-    all interfaces so the control plane can reach it over DCN."""
+    all interfaces so the control plane can reach it over DCN. Default
+    mesh width is "auto" — one logical solve pjit-spans every chip the
+    host owns (pass an explicit int to pin a narrower mesh)."""
     server = SolverServer(port=port, shards=shards, bind=bind).start()
     server._server.wait_for_termination()

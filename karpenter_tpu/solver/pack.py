@@ -47,6 +47,43 @@ from karpenter_tpu.solver.encode import Encoded
 
 BIG = jnp.float32(3.4e38)
 INT_BIG = jnp.int32(2**31 - 1)
+# per-node capacity ceiling: fits int32 exactly (2_000_000_000) and
+# behaves as "unbounded" against any real demand. Every capacity the
+# kernels compute is clipped here BEFORE the int cast — casting the
+# f32 BIG sentinel to int32 is implementation-defined in XLA and the
+# int32 range audit (tests/test_scale_dtypes.py) pins the clamp.
+CAP_MAX = 2.0e9
+
+
+def _prefix_take(k: jnp.ndarray, remaining: jnp.ndarray) -> jnp.ndarray:
+    """The per-group prefix fill, safe against int32 overflow:
+    take_i = clip(remaining - sum_{j<i} k_j, 0, k_i) without ever
+    materializing the raw cumulative sum. Per-node capacities are
+    clipped at CAP_MAX (~2e9), so a plain int32 cumsum wraps as soon
+    as two unbounded rows stack — at million-pod node axes the wrapped
+    prefix would fabricate placements. Instead: clamp each capacity at
+    `remaining` (a row's surplus beyond the group's demand can never
+    be consumed, so takes are unchanged) and saturate the running sum
+    at `remaining` via a uint32 associative scan — min(a+b, r) over
+    non-negatives is associative, and a+b <= 2r always fits uint32.
+    Exact integer arithmetic: bit-identical to the naive prefix
+    wherever the int32 math didn't overflow."""
+    # clamp against the NON-NEGATIVE remaining: the replaced
+    # clip(remaining - prefix, 0, k) returned zeros for a negative
+    # demand, and min(k, raw_remaining) would wrap negative through
+    # the uint32 cast into huge takes
+    rem = jnp.maximum(remaining, 0)
+    r = rem.astype(jnp.uint32)
+    kc = jnp.minimum(k, rem).astype(jnp.uint32)
+
+    def sat_add(a, b):
+        return jnp.minimum(a + b, r)
+
+    inclusive = jax.lax.associative_scan(sat_add, kc)
+    prefix = jnp.concatenate(
+        [jnp.zeros((1,), jnp.uint32), inclusive[:-1]]
+    )
+    return jnp.minimum((r - prefix).astype(jnp.int32), kc.astype(jnp.int32))
 
 
 @functools.lru_cache(maxsize=None)
@@ -73,6 +110,40 @@ def default_shards() -> int:
         return int(os.environ.get("KARPENTER_SOLVER_SHARDS", "0") or 0)
     except ValueError:
         return 0
+
+
+# last observed shard resolution (ISSUE 11 satellite): the silent
+# default_shards() fallback-to-unsharded used to be log-only; now the
+# resolved count lands in the karpenter_solver_shards gauge, on the
+# solve.execute span, and in readyz()["solver"] via this record.
+_shards_observed = {"effective": 0, "devices": 0}
+
+
+def last_resolved_shards() -> dict:
+    """{"effective": shards the last solve ran with (1 = unsharded),
+    "devices": devices visible at that resolution} — 0s before any
+    solve has dispatched."""
+    return dict(_shards_observed)
+
+
+def visible_devices(default: int = 1) -> int:
+    """len(jax.devices()) with a guarded fallback — backend init can
+    raise on hosts whose accelerator runtime is absent. The one probe
+    every shard-resolution site shares (solve fallback, warm pool,
+    service auto-mesh, observability)."""
+    try:
+        return len(jax.devices())
+    except Exception:
+        return default
+
+
+def _observe_shards(effective: int) -> None:
+    from karpenter_tpu.metrics.store import SOLVER_SHARDS
+
+    eff = effective if effective > 1 else 1
+    _shards_observed["effective"] = eff
+    _shards_observed["devices"] = visible_devices(0)
+    SOLVER_SHARDS.set(eff)
 
 
 @dataclass
@@ -148,7 +219,7 @@ def pack(
         head = cfg_alloc - used_j[None, :]
         k = jnp.floor((head + 1e-4) / safe_req[None, :])
         k = jnp.where(req[None, :] > 0, k, BIG)
-        return jnp.clip(jnp.min(k, axis=-1), 0.0, BIG).astype(jnp.int32)
+        return jnp.clip(jnp.min(k, axis=-1), 0.0, CAP_MAX).astype(jnp.int32)
 
     def body(g, state):
         """One group per iteration: (1) prefix-sum fill across every
@@ -174,7 +245,7 @@ def pack(
             (cfg_alloc[None, :, :] - node_used[:, None, :] + 1e-4) / safe_req[None, None, :]
         )
         kmat = jnp.where(req[None, None, :] > 0, kmat, BIG).min(axis=-1)
-        kmat = jnp.clip(kmat, 0.0, 2.0e9).astype(jnp.int32)
+        kmat = jnp.clip(kmat, 0.0, CAP_MAX).astype(jnp.int32)
         ok = node_mask & row[None, :] & (kmat >= 1)
         # a reservation-pinned node (mask holds a capped column) only
         # admits groups compatible with THAT column, and its fill is
@@ -206,8 +277,7 @@ def pack(
             # assignment state
             blocked = (assign * conflict[g][None, :]).sum(axis=1) > 0
             k = jnp.where(blocked, 0, k)
-        prefix = jnp.cumsum(k) - k
-        take = jnp.clip(remaining - prefix, 0, k)
+        take = _prefix_take(k, remaining)
         touched = take > 0
         node_mask = jnp.where(touched[:, None], ok & (kmat >= take[:, None]), node_mask)
         node_used = node_used + take[:, None].astype(jnp.float32) * req[None, :]
@@ -265,9 +335,9 @@ def pack(
                 m_star = jnp.clip(group_cap[g], 1, m_star)
             slot_star = cfg_slot[c_star]
             cap_left = jnp.minimum(
-                rsv_cap_ext[slot_star] - rsv_used[slot_star], 2.0e9
+                rsv_cap_ext[slot_star] - rsv_used[slot_star], CAP_MAX
             )
-            q = jnp.minimum((remaining + m_star - 1) // m_star, N - node_count)
+            q = jnp.minimum((remaining - 1) // m_star + 1, N - node_count)
             q = jnp.minimum(q, jnp.maximum(cap_left, 0).astype(jnp.int32))
             q = jnp.maximum(q, 1)  # open_cond guarantees one is legal
             rem_last = jnp.clip(remaining - (q - 1) * m_star, 1, m_star)
@@ -443,7 +513,7 @@ def pack_split(
         head = cfg_alloc - used_j[None, :]
         k = jnp.floor((head + 1e-4) / safe_req[None, :])
         k = jnp.where(req[None, :] > 0, k, BIG)
-        return jnp.clip(jnp.min(k, axis=-1), 0.0, BIG).astype(jnp.int32)
+        return jnp.clip(jnp.min(k, axis=-1), 0.0, CAP_MAX).astype(jnp.int32)
 
     def body(g, state):
         (free_mask, free_used, node_count, assign, unsched,
@@ -463,7 +533,7 @@ def pack_split(
             (bound_alloc - bound_used + 1e-4) / safe_req[None, :]
         )
         kb = jnp.where(req[None, :] > 0, kb, BIG).min(axis=-1)
-        kb = jnp.clip(kb, 0.0, 2.0e9).astype(jnp.int32)
+        kb = jnp.clip(kb, 0.0, CAP_MAX).astype(jnp.int32)
         ok_b = bound_compat[g] & bound_live & (kb >= 1)
         kb = kb * ok_b
         if bound_quota is not None:
@@ -481,7 +551,7 @@ def pack_split(
             / safe_req[None, None, :]
         )
         kmat = jnp.where(req[None, None, :] > 0, kmat, BIG).min(axis=-1)
-        kmat = jnp.clip(kmat, 0.0, 2.0e9).astype(jnp.int32)
+        kmat = jnp.clip(kmat, 0.0, CAP_MAX).astype(jnp.int32)
         okf = free_mask & row[None, :] & (kmat >= 1)
         pinned = free_mask & capped[None, :]
         is_pinned = pinned.any(axis=1)
@@ -501,8 +571,7 @@ def pack_split(
         # ---- unified prefix fill (bound rows precede fresh in index
         # order, preserving existing -> in-flight/planned -> new)
         k = jnp.concatenate([kb, kf])
-        prefix = jnp.cumsum(k) - k
-        take = jnp.clip(remaining - prefix, 0, k)
+        take = _prefix_take(k, remaining)
         take_b = take[:B]
         take_f = take[B:]
         touched_f = take_f > 0
@@ -549,9 +618,9 @@ def pack_split(
                 m_star = jnp.clip(group_cap[g], 1, m_star)
             slot_star = cfg_slot[c_star]
             cap_left = jnp.minimum(
-                rsv_cap_ext[slot_star] - rsv_used[slot_star], 2.0e9
+                rsv_cap_ext[slot_star] - rsv_used[slot_star], CAP_MAX
             )
-            q = jnp.minimum((remaining + m_star - 1) // m_star,
+            q = jnp.minimum((remaining - 1) // m_star + 1,
                             B + F - node_count)
             q = jnp.minimum(q, jnp.maximum(cap_left, 0).astype(jnp.int32))
             q = jnp.maximum(q, 1)
@@ -689,10 +758,19 @@ WAVEFRONT_MIN_GROUPS = 8
 
 def wavefront_plan(n_groups: int, shards: int = 0) -> int:
     """Static wavefront width for a solve over `n_groups` real groups;
-    0 routes the sequential kernel (knob off, solve too small, or the
-    config axis is sharded — the wavefront program is kept off the
-    GSPMD path until it earns its own sharding story)."""
-    if shards > 1 or n_groups < WAVEFRONT_MIN_GROUPS:
+    0 routes the sequential kernel (knob off, or the solve is too
+    small to amortize the fan-out).
+
+    Sharded solves take the wavefront too: every per-lane decision is
+    an index-tie-broken arg-reduction over the config axis, the round
+    commits touch only replicated state (node axis, reservation
+    budgets, the done mask), and the acceptance scan runs on
+    replicated scalars — so partitioning the config axis over the mesh
+    changes where reductions run, never what they produce. Bit
+    identity to the unsharded sequential solve is oracle-enforced
+    (tests/test_wavefront_oracle.py sharded axis,
+    tests/test_sharded_solver.py)."""
+    if n_groups < WAVEFRONT_MIN_GROUPS:
         return 0
     return wavefront_width()
 
@@ -805,7 +883,7 @@ def pack_split_wavefront(
             (bound_alloc - bound_used + 1e-4) / safe_req[None, :]
         )
         kb = jnp.where(req[None, :] > 0, kb, BIG).min(axis=-1)
-        kb = jnp.clip(kb, 0.0, 2.0e9).astype(jnp.int32)
+        kb = jnp.clip(kb, 0.0, CAP_MAX).astype(jnp.int32)
         ok_b = bound_compat[g] & bound_live & (kb >= 1)
         kb = kb * ok_b
         if bound_quota is not None:
@@ -823,7 +901,7 @@ def pack_split_wavefront(
             / safe_req[None, None, :]
         )
         kmat = jnp.where(req[None, None, :] > 0, kmat, BIG).min(axis=-1)
-        kmat = jnp.clip(kmat, 0.0, 2.0e9).astype(jnp.int32)
+        kmat = jnp.clip(kmat, 0.0, CAP_MAX).astype(jnp.int32)
         okf = free_mask & row[None, :] & (kmat >= 1)
         pinned = free_mask & capped[None, :]
         is_pinned = pinned.any(axis=1)
@@ -841,8 +919,7 @@ def pack_split_wavefront(
             kf = jnp.where(blocked[B:], 0, kf)
 
         k = jnp.concatenate([kb, kf])
-        prefix = jnp.cumsum(k) - k
-        take = jnp.clip(remaining - prefix, 0, k)
+        take = _prefix_take(k, remaining)
         take_f = take[B:]
         touched_f = take_f > 0
         newmask_f = okf & (kmat >= take_f[:, None])
@@ -873,7 +950,7 @@ def pack_split_wavefront(
             head = cfg_alloc - overhead[None, :]
             kfc = jnp.floor((head + 1e-4) / safe_req[None, :])
             kfc = jnp.where(req[None, :] > 0, kfc, BIG)
-            kfc = jnp.clip(jnp.min(kfc, axis=-1), 0.0, BIG).astype(jnp.int32)
+            kfc = jnp.clip(jnp.min(kfc, axis=-1), 0.0, CAP_MAX).astype(jnp.int32)
             kf_open = kfc * mask
             if mode == "cost":
                 ppp = jnp.where(
@@ -893,7 +970,7 @@ def pack_split_wavefront(
                 m_star = jnp.clip(group_cap[g], 1, m_star)
             slot_star = cfg_slot[c_star]
             cap_left = jnp.minimum(
-                rsv_cap_ext[slot_star] - rsv_now[slot_star], 2.0e9
+                rsv_cap_ext[slot_star] - rsv_now[slot_star], CAP_MAX
             )
             axis_left = N - (node_count + n_open)
             # min() terms commute, so splitting the sequential
@@ -901,7 +978,7 @@ def pack_split_wavefront(
             # AXIS was ever the binding constraint — a clamped plan
             # cannot survive an index shift and is re-planned instead
             q_noaxis = jnp.minimum(
-                (rem + m_star - 1) // m_star,
+                (rem - 1) // m_star + 1,
                 jnp.maximum(cap_left, 0).astype(jnp.int32),
             )
             q = jnp.maximum(jnp.minimum(q_noaxis, axis_left), 1)
@@ -1399,10 +1476,7 @@ def solve_packing_async(
             # fewer visible devices — fall back to the unsharded solve.
             # An explicit shards argument still raises (the caller
             # asked for that exact mesh).
-            try:
-                visible = len(jax.devices())
-            except Exception:
-                visible = 1
+            visible = visible_devices(1)
             if shards > visible:
                 import logging
 
@@ -1411,6 +1485,7 @@ def solve_packing_async(
                     "devices; running unsharded", shards, visible,
                 )
                 shards = 0
+    _observe_shards(shards)
     G, C = enc.compat.shape
     E = enc.n_existing
     n_planned = len(plan.planned_cols) if plan is not None else 0
@@ -1589,6 +1664,18 @@ def _run_pack(
     faults.fire("solve")
     _t_stage = _time.perf_counter()
 
+    # int32 width guard (tests/test_scale_dtypes.py): the kernel state,
+    # the flat uint32 transport, and the host decode all carry pod
+    # counts in 32 bits. A demand whose TOTAL exceeds int32 cannot be
+    # represented anywhere downstream — reject it here, before any
+    # array is staged, with an error naming the limit.
+    total_demand = int(np.asarray(enc.group_count, np.int64).sum())
+    if total_demand >= 2**31:
+        raise ValueError(
+            f"total pod demand {total_demand} exceeds the solver's "
+            "int32 range (2^31-1); split the solve"
+        )
+
     G, C = enc.compat.shape
     R = enc.group_req.shape[1]
     E = existing_mask.shape[0]
@@ -1605,20 +1692,35 @@ def _run_pack(
     assert N >= Ep, (N, Ep)
     F = N - Ep  # fresh axis
 
-    compat = np.zeros((Gp, Cp), bool)
-    compat[:G, :C] = enc.compat
+    from karpenter_tpu.solver import stream as stream_mod
+
+    # streaming staging (ISSUE 11): sharded solves ship the padded
+    # config-axis matrices as per-shard column blocks, so the full
+    # [Gp, Cp] compat block (and the [Cp, ·] cost vectors) never
+    # materialize host-side at once — see solver/stream.py for the
+    # memory contract. Value-identical to the classic path.
+    stream_on = shards > 1 and stream_mod.enabled()
+
     group_req = np.zeros((Gp, R), np.float32)
     group_req[:G] = enc.group_req
     group_count = np.zeros((Gp,), np.int32)
     group_count[:G] = enc.group_count
-    cfg_alloc = np.zeros((Cp, R), np.float32)
-    cfg_alloc[:C] = enc.cfg_alloc
+    # padded pool vector: kept host-side on EVERY path — fetch()
+    # resolves fresh nodes' daemon overhead through it
     cfg_pool = np.full((Cp,), -1, np.int32)
     cfg_pool[:C] = enc.cfg_pool
-    cfg_price = np.zeros((Cp,), np.float32)
-    cfg_price[:C] = enc.cfg_price
+    if not stream_on:
+        compat = np.zeros((Gp, Cp), bool)
+        compat[:G, :C] = enc.compat
+        cfg_alloc = np.zeros((Cp, R), np.float32)
+        cfg_alloc[:C] = enc.cfg_alloc
+        cfg_price = np.zeros((Cp,), np.float32)
+        cfg_price[:C] = enc.cfg_price
 
-    # ---- bound block: one-hot rows flattened to per-row vectors
+    # ---- bound block: one-hot rows flattened to per-row vectors.
+    # Built from the UNPADDED encode arrays (bound columns always index
+    # real configs), so the streaming path never needs the padded
+    # matrices it refuses to materialize.
     bound_cfg = np.full((Ep,), -1, np.int32)
     bound_used_h = np.zeros((Ep, R), np.float32)
     if E:
@@ -1631,11 +1733,11 @@ def _run_pack(
     bound_live_h = bound_cfg >= 0
     safe_cfg = np.maximum(bound_cfg, 0)
     bound_alloc_h = np.where(
-        bound_live_h[:, None], cfg_alloc[safe_cfg], 0.0
+        bound_live_h[:, None], enc.cfg_alloc[safe_cfg], 0.0
     ).astype(np.float32)
     bound_compat_h = np.zeros((Gp, Ep), bool)
     if Ep:
-        bound_compat_h[:, :] = compat[:, safe_cfg] & bound_live_h[None, :]
+        bound_compat_h[:G, :] = enc.compat[:, safe_cfg] & bound_live_h[None, :]
 
     bound_quota_h = None
     if quota is not None:
@@ -1671,19 +1773,19 @@ def _run_pack(
         K = int(enc.rsv_cap.size)
         rsvp = np.full((Cp,), -1, np.int32)
         rsvp[:C] = enc.cfg_rsv
-        cfg_rsv = jnp.asarray(rsvp)
-        rsv_cap = jnp.asarray(enc.rsv_cap.astype(np.float32))
         cfg_rsv_h = rsvp
+        if not stream_on:
+            # the streaming branch stages its own per-shard blocks —
+            # converting here too would upload a device array the
+            # stager immediately discards
+            cfg_rsv = jnp.asarray(rsvp)
+            rsv_cap = jnp.asarray(enc.rsv_cap.astype(np.float32))
     else:
         cfg_rsv_h = np.full((Cp,), -1, np.int32)
     bound_slot_h = np.where(
         bound_live_h & (cfg_rsv_h[safe_cfg] >= 0), cfg_rsv_h[safe_cfg], K
     ).astype(np.int32)
 
-    compat_j = jnp.asarray(compat)
-    cfg_alloc_j = jnp.asarray(cfg_alloc)
-    cfg_pool_j = jnp.asarray(cfg_pool)
-    cfg_price_j = jnp.asarray(cfg_price)
     bound = {
         "bound_compat": jnp.asarray(bound_compat_h),
         "bound_alloc": jnp.asarray(bound_alloc_h),
@@ -1699,27 +1801,82 @@ def _run_pack(
         "group_count": jnp.asarray(group_count),
         "pool_overhead": jnp.asarray(enc.pool_overhead),
     }
+    if not stream_on:
+        compat_j = jnp.asarray(compat)
+        cfg_alloc_j = jnp.asarray(cfg_alloc)
+        cfg_pool_j = jnp.asarray(cfg_pool)
+        cfg_price_j = jnp.asarray(cfg_price)
     if shards > 1:
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         mesh = _mesh(shards)
         shard_cfg = NamedSharding(mesh, P("cfg"))
-        shard_nc = NamedSharding(mesh, P(None, "cfg"))
-        shard_cr = NamedSharding(mesh, P("cfg", None))
         replicated = NamedSharding(mesh, P())
         # committed input shardings drive GSPMD: the jitted kernel
         # compiles with the config axis split over ICI and everything
         # else (including the bound block, whose per-row work has no
         # config axis) replicated
-        compat_j = jax.device_put(compat_j, shard_nc)
-        cfg_alloc_j = jax.device_put(cfg_alloc_j, shard_cr)
-        cfg_pool_j = jax.device_put(cfg_pool_j, shard_cfg)
-        cfg_price_j = jax.device_put(cfg_price_j, shard_cfg)
-        bound = {k: jax.device_put(v, replicated) for k, v in bound.items()}
-        rest = {k: jax.device_put(v, replicated) for k, v in rest.items()}
-        if cfg_rsv is not None:
+        if stream_on:
+            # per-shard column blocks, built + shipped one at a time
+            # (solver/stream.py): the padded matrices never exist
+            # host-side at once
+            staging = stream_mod._Staging()
+            compat_j = stream_mod.stage(
+                mesh, P(None, "cfg"), (Gp, Cp), np.bool_,
+                stream_mod.col_fill_2d(enc.compat, Gp, G, C, np.bool_),
+                staging,
+            )
+            cfg_alloc_j = stream_mod.stage(
+                mesh, P("cfg", None), (Cp, R), np.float32,
+                stream_mod.row_fill_2d(enc.cfg_alloc, R, C, np.float32),
+                staging,
+            )
+            cfg_pool_j = stream_mod.stage(
+                mesh, P("cfg"), (Cp,), np.int32,
+                stream_mod.vec_fill(enc.cfg_pool, C, np.int32, pad_value=-1),
+                staging,
+            )
+            cfg_price_j = stream_mod.stage(
+                mesh, P("cfg"), (Cp,), np.float32,
+                stream_mod.vec_fill(enc.cfg_price, C, np.float32),
+                staging,
+            )
+            rsv_src = (
+                enc.cfg_rsv if K else np.full((C,), -1, np.int32)
+            )
+            cfg_rsv = stream_mod.stage(
+                mesh, P("cfg"), (Cp,), np.int32,
+                stream_mod.vec_fill(rsv_src, C, np.int32, pad_value=-1),
+                staging,
+            )
+            rsv_cap = jax.device_put(
+                jnp.asarray(enc.rsv_cap.astype(np.float32))
+                if K else jnp.zeros((0,), jnp.float32),
+                replicated,
+            )
+            staging.commit()
+        else:
+            shard_nc = NamedSharding(mesh, P(None, "cfg"))
+            shard_cr = NamedSharding(mesh, P("cfg", None))
+            compat_j = jax.device_put(compat_j, shard_nc)
+            cfg_alloc_j = jax.device_put(cfg_alloc_j, shard_cr)
+            cfg_pool_j = jax.device_put(cfg_pool_j, shard_cfg)
+            cfg_price_j = jax.device_put(cfg_price_j, shard_cfg)
+            if cfg_rsv is None:
+                # reservation-free sharded solves must still pass
+                # cfg_rsv as a TRACED input: left to the in-jit
+                # default, `capped` is a compile-time all-false
+                # constant, XLA folds the wavefront kernel's
+                # reservation reductions into degenerate reduce regions
+                # (ROOT constant(false)), and the SPMD partitioner
+                # rejects them as unsupported reduction computations.
+                # A [C] int32 upload is noise next to compat.
+                cfg_rsv = jnp.asarray(cfg_rsv_h)
+                rsv_cap = jnp.zeros((0,), jnp.float32)
             cfg_rsv = jax.device_put(cfg_rsv, shard_cfg)
             rsv_cap = jax.device_put(rsv_cap, replicated)
+        bound = {k: jax.device_put(v, replicated) for k, v in bound.items()}
+        rest = {k: jax.device_put(v, replicated) for k, v in rest.items()}
         if bound_quota_j is not None:
             bound_quota_j = jax.device_put(bound_quota_j, replicated)
         if group_cap_full is not None:
@@ -1727,8 +1884,9 @@ def _run_pack(
         if conflict_full is not None:
             conflict_full = jax.device_put(conflict_full, replicated)
     # wavefront routing: judged on the REAL group count (padding groups
-    # carry zero demand and pre-commit, so they never widen a round),
-    # off the GSPMD path while sharded solves stay sequential. The
+    # carry zero demand and pre-commit, so they never widen a round);
+    # sharded solves route it too — GSPMD partitions the round's config
+    # reductions and the commits stay replicated. The
     # kwarg is only PASSED when active: jit keys an explicitly-passed
     # static argument differently from the omitted default, so
     # `wavefront=0` would shadow-recompile every already-warm
@@ -1774,7 +1932,7 @@ def _run_pack(
 
     tracing.record("solve.compile", _t_dispatch, _t_compiled,
                    wavefront=int(wf),
-                   warm_hit=_warm_pool.warmed(Gp, Cp, Ep, F, mode))
+                   warm_hit=_warm_pool.warmed(Gp, Cp, Ep, F, mode, shards))
     # compile finished: release the watchdog's compile budget (the
     # execute budget keeps running until fetch)
     from karpenter_tpu.solver import resilience
@@ -1797,7 +1955,8 @@ def _run_pack(
         SOLVER_PHASE_DURATION.observe(
             _t_fetched - _t_exec, {"phase": "execute"}
         )
-        tracing.record("solve.execute", _t_exec, _t_fetched)
+        tracing.record("solve.execute", _t_exec, _t_fetched,
+                       shards=shards if shards > 1 else 1)
         o0 = N * Gp
         o1 = o0 + F * W
         assign = flat[:o0].reshape(N, Gp)[:, :G].astype(np.int32)

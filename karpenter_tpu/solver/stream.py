@@ -1,0 +1,184 @@
+"""Streaming device staging for sharded solves (ISSUE 11 tentpole b).
+
+`pack._run_pack`'s classic staging materializes every padded
+config-axis matrix host-side (the [Gp, Cp] compat block plus the
+[Cp, R] / [Cp] cost vectors), copies each into a jax array, and lets
+`device_put` split it over the mesh — up to three full-size host
+allocations per matrix before the kernel sees a byte. At million-pod
+shapes the padded group x config matrix is the largest host-side
+solver array, and that full-materialization peak is what caps the
+problem size a control-plane host can stage.
+
+This module ships the same arrays as PER-SHARD COLUMN BLOCKS instead:
+for each mesh device, build only that shard's padded slice (padding
+and slicing fused into one fill callback), place it directly on its
+device, free the host block, move on. The assembled array
+(`jax.make_array_from_single_device_arrays`) is indistinguishable to
+the compiled kernel from the `device_put` result — same sharding, same
+values — so solves are bit-identical to the classic staging
+(oracle-enforced: tests/test_wavefront_oracle.py,
+tests/test_stream_encode.py). Host transient peak per matrix drops
+from ~2-3x the full padded size to one 1/shards-width block.
+
+Knob: KARPENTER_STREAM_ENCODE — "auto" (default: stream whenever the
+solve is sharded), "0"/"off" (always classic), "1"/"on"/"force"
+(stream sharded solves; an unsharded solve has no mesh to stream onto
+and always stages classically). Stats of the most recent streamed
+staging are kept per-process (`last_stats`) so the million_pod bench
+can report/assert the peak-block-vs-full-materialization bytes next
+to its measured RSS.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Callable
+
+import numpy as np
+
+_lock = threading.Lock()
+_last: dict = {}
+
+
+def enabled() -> bool:
+    """Resolve KARPENTER_STREAM_ENCODE for a sharded solve (the only
+    caller context — unsharded staging never consults this)."""
+    raw = os.environ.get("KARPENTER_STREAM_ENCODE", "auto").strip().lower()
+    if raw in ("0", "off", "false", "no"):
+        return False
+    # auto / 1 / on / force / unrecognized spellings: stream. The
+    # classic path stays reachable via the explicit off switch only —
+    # streaming is value-identical, so there is no backend to be
+    # conservative about.
+    return True
+
+
+def reset_stats() -> None:
+    with _lock:
+        _last.clear()
+
+
+def last_stats() -> dict:
+    """Staging stats of the most recent streamed solve on any thread:
+    {"arrays", "blocks", "peak_block_bytes", "full_bytes"} —
+    full_bytes is what ONE full-materialization copy of every streamed
+    matrix would have allocated host-side (the classic path makes 2-3
+    such copies per matrix; peak_block_bytes is the streamed path's
+    largest single host transient)."""
+    with _lock:
+        return dict(_last)
+
+
+class _Staging:
+    """Accumulates per-solve stats across the stage() calls of one
+    staging pass; commit() publishes them as last_stats()."""
+
+    def __init__(self):
+        self.arrays = 0
+        self.blocks = 0
+        self.peak_block_bytes = 0
+        self.full_bytes = 0
+
+    def commit(self) -> None:
+        from karpenter_tpu.metrics.store import SOLVER_STREAM_BLOCKS
+
+        SOLVER_STREAM_BLOCKS.inc(value=self.blocks)
+        with _lock:
+            _last.clear()
+            _last.update(
+                arrays=self.arrays,
+                blocks=self.blocks,
+                peak_block_bytes=self.peak_block_bytes,
+                full_bytes=self.full_bytes,
+            )
+
+
+def stage(
+    mesh,
+    spec,
+    shape: tuple,
+    dtype,
+    fill: Callable[[tuple], np.ndarray],
+    staging: _Staging | None = None,
+):
+    """Assemble a global sharded array from per-device blocks built one
+    at a time. `fill(index)` receives the device's index tuple (slices
+    into the global shape) and returns that block as a host array —
+    already padded, already the right dtype; it is shipped to the
+    device and released before the next block is built, so the host
+    transient is one block, never the full matrix."""
+    import jax
+    from jax.sharding import NamedSharding, SingleDeviceSharding
+
+    sharding = NamedSharding(mesh, spec)
+    imap = sharding.addressable_devices_indices_map(shape)
+    arrays = []
+    for dev, idx in imap.items():
+        block = np.ascontiguousarray(fill(idx))
+        if staging is not None:
+            staging.blocks += 1
+            staging.peak_block_bytes = max(
+                staging.peak_block_bytes, block.nbytes
+            )
+        arrays.append(jax.device_put(block, SingleDeviceSharding(dev)))
+        del block
+    if staging is not None:
+        staging.arrays += 1
+        staging.full_bytes += int(
+            np.prod(shape) * np.dtype(dtype).itemsize
+        )
+    return jax.make_array_from_single_device_arrays(shape, sharding, arrays)
+
+
+def col_fill_2d(src: np.ndarray, rows: int, real_rows: int, real_cols: int,
+                dtype):
+    """Fill callback for a [rows, Cp] matrix sharded over its COLUMN
+    axis: pads rows beyond `real_rows` and columns beyond `real_cols`
+    with zeros, copying only the live window of `src` ([real_rows,
+    real_cols])."""
+
+    def fill(idx):
+        _, cs = idx
+        lo = cs.start or 0
+        hi = cs.stop if cs.stop is not None else src.shape[1]
+        blk = np.zeros((rows, hi - lo), dtype)
+        if lo < real_cols:
+            take = min(hi, real_cols) - lo
+            blk[:real_rows, :take] = src[:, lo : lo + take]
+        return blk
+
+    return fill
+
+
+def row_fill_2d(src: np.ndarray, cols: int, real_rows: int, dtype):
+    """Fill callback for a [Cp, cols] matrix sharded over its ROW
+    (config) axis."""
+
+    def fill(idx):
+        rs, _ = idx
+        lo = rs.start or 0
+        hi = rs.stop
+        blk = np.zeros((hi - lo, cols), dtype)
+        if lo < real_rows:
+            take = min(hi, real_rows) - lo
+            blk[:take] = src[lo : lo + take]
+        return blk
+
+    return fill
+
+
+def vec_fill(src: np.ndarray, real_len: int, dtype, pad_value=0):
+    """Fill callback for a [Cp] vector sharded over the config axis."""
+
+    def fill(idx):
+        (cs,) = idx
+        lo = cs.start or 0
+        hi = cs.stop
+        blk = np.full((hi - lo,), pad_value, dtype)
+        if lo < real_len:
+            take = min(hi, real_len) - lo
+            blk[:take] = src[lo : lo + take]
+        return blk
+
+    return fill

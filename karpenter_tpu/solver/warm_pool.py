@@ -287,44 +287,111 @@ def _compile_probe_bucket(
     pack_probe_lanes_flat.lower(*args, max_free=F, mode=mode).compile()
 
 
+def warm_shards() -> int:
+    """KARPENTER_WARM_SHARDS: mesh width the warm pool ALSO compiles
+    each bucket for (the multi-host solver service's pjit shapes —
+    ISSUE 11). "auto" spans every visible device; 0/unset skips the
+    sharded variants (no startup cost for single-device fleets); a
+    count above the visible devices is clamped to them (same graceful
+    degradation as the solve path's default_shards fallback).
+
+    Deliberately NOT shared with service.server.resolve_service_shards
+    despite the similar spelling: an explicit service width is
+    authoritative and lets _mesh raise on an impossible ask, while
+    warm-up is best-effort by definition and clamps instead."""
+    raw = os.environ.get("KARPENTER_WARM_SHARDS", "").strip().lower()
+    if not raw or raw in ("0", "off", "false", "no"):
+        return 0
+    from karpenter_tpu.solver.pack import visible_devices
+
+    visible = visible_devices(0)
+    if visible == 0:
+        return 0
+    if raw == "auto":
+        return visible if visible > 1 else 0
+    try:
+        want = int(raw)
+    except ValueError:
+        log.warning("ignoring malformed KARPENTER_WARM_SHARDS=%r", raw)
+        return 0
+    want = min(want, visible)
+    return want if want > 1 else 0
+
+
 def _compile_bucket(
     G: int, C: int, E: int, N: int, mode: str,
-    R: int = 4, P: int = 1, topo: bool = False,
+    R: int = 4, P: int = 1, topo: bool = False, shards: int = 0,
 ) -> None:
     """AOT-compile pack_split_flat for one padded shape bucket using
     ShapeDtypeStructs (no real arrays, no execution). The padding must
     mirror _run_pack exactly or the warmed program never matches a real
-    solve."""
+    solve. With `shards > 1` the structs carry the sharded solve's
+    committed input shardings (config axis split over the mesh,
+    everything else replicated), so the compiled program is the exact
+    GSPMD-partitioned one a sharded dispatch needs."""
+    import math
+
     import jax.numpy as jnp
-    from jax import ShapeDtypeStruct as S
+    from jax import ShapeDtypeStruct as _S
 
     from karpenter_tpu.solver import faults
-    from karpenter_tpu.solver.pack import _bucket, _pad_axis, pack_split_flat
+    from karpenter_tpu.solver.pack import (
+        _bucket,
+        _mesh,
+        _pad_axis,
+        pack_split_flat,
+    )
 
     faults.fire("warm")
     Gp = _pad_axis(G)
-    Cp = -(-_pad_axis(C) // 32) * 32
+    step = math.lcm(32, shards) if shards > 1 else 32
+    Cp = -(-_pad_axis(C) // step) * step
     Ep = _pad_axis(E) if E else 0
+    if shards > 1:
+        from jax.sharding import NamedSharding, PartitionSpec as _P
+
+        mesh = _mesh(shards)
+        _spec = {
+            "cfg": NamedSharding(mesh, _P("cfg")),
+            "nc": NamedSharding(mesh, _P(None, "cfg")),
+            "cr": NamedSharding(mesh, _P("cfg", None)),
+            "rep": NamedSharding(mesh, _P()),
+        }
+
+        def S(shape, dtype, part="rep"):
+            return _S(shape, dtype, sharding=_spec[part])
+    else:
+        def S(shape, dtype, part=None):
+            return _S(shape, dtype)
     # N names the FRESH node axis: solve_packing_async buckets the
     # fresh axis independently of the (already padded) bound block, so
     # only _bucket values ever reach the kernel as max_free — deriving
     # F any other way would compile programs no real solve can reuse
     F = _bucket(max(N, 1))
     args = (
-        S((Gp, Cp), jnp.bool_),      # compat
-        S((Gp, R), jnp.float32),     # group_req
-        S((Gp,), jnp.int32),         # group_count
-        S((Cp, R), jnp.float32),     # cfg_alloc
-        S((Cp,), jnp.int32),         # cfg_pool
-        S((P + 1, R), jnp.float32),  # pool_overhead
-        S((Gp, Ep), jnp.bool_),      # bound_compat
-        S((Ep, R), jnp.float32),     # bound_alloc
-        S((Ep, R), jnp.float32),     # bound_used0
-        S((Ep,), jnp.int32),         # bound_slot
-        S((Ep,), jnp.bool_),         # bound_live
-        S((Cp,), jnp.float32),       # cfg_price
+        S((Gp, Cp), jnp.bool_, "nc"),       # compat
+        S((Gp, R), jnp.float32),            # group_req
+        S((Gp,), jnp.int32),                # group_count
+        S((Cp, R), jnp.float32, "cr"),      # cfg_alloc
+        S((Cp,), jnp.int32, "cfg"),         # cfg_pool
+        S((P + 1, R), jnp.float32),         # pool_overhead
+        S((Gp, Ep), jnp.bool_),             # bound_compat
+        S((Ep, R), jnp.float32),            # bound_alloc
+        S((Ep, R), jnp.float32),            # bound_used0
+        S((Ep,), jnp.int32),                # bound_slot
+        S((Ep,), jnp.bool_),                # bound_live
+        S((Cp,), jnp.float32, "cfg"),       # cfg_price
     )
     kw = {}
+    if shards > 1:
+        # sharded dispatches always pass cfg_rsv/rsv_cap as traced
+        # inputs (pack._run_pack: an in-jit constant would fold the
+        # reservation reductions into regions the SPMD partitioner
+        # rejects); warm the reservation-free K=0 shape — per-fleet
+        # reservation counts change the rsv_cap shape and can't be
+        # enumerated here
+        kw["cfg_rsv"] = S((Cp,), jnp.int32, "cfg")
+        kw["rsv_cap"] = S((0,), jnp.float32)
     if topo:
         kw["group_cap"] = S((Gp,), jnp.int32)
         kw["conflict"] = S((Gp, Gp), jnp.bool_)
@@ -337,7 +404,7 @@ def _compile_bucket(
     # into this bucket still hit the sequential program
     from karpenter_tpu.solver.pack import wavefront_plan
 
-    wf = wavefront_plan(G)
+    wf = wavefront_plan(G, shards)
     if wf > 1:
         pack_split_flat.lower(
             *args, max_free=F, mode=mode, wavefront=wf, **kw
@@ -346,19 +413,22 @@ def _compile_bucket(
     # padded-signature registry: lets the flight recorder attribute a
     # solve's compile span to a warm-pool hit (pack.py annotates
     # warm_hit when its padded shape matches a pre-compiled bucket)
-    compiled_buckets.add((Gp, Cp, Ep, F, mode))
+    compiled_buckets.add((Gp, Cp, Ep, F, mode, shards))
 
 
-# padded (Gp, Cp, Ep, F, mode) signatures AOT-compiled by this process
-# (see _compile_bucket); read via `warmed` from pack's dispatch path
+# padded (Gp, Cp, Ep, F, mode, shards) signatures AOT-compiled by this
+# process (see _compile_bucket); read via `warmed` from pack's
+# dispatch path
 compiled_buckets: set[tuple] = set()
 
 
-def warmed(Gp: int, Cp: int, Ep: int, F: int, mode: str) -> bool:
+def warmed(Gp: int, Cp: int, Ep: int, F: int, mode: str,
+           shards: int = 0) -> bool:
     """True when a warm-pool bucket compile covered this exact padded
     shape — the deterministic warm-hit signal (the compile span's
-    duration shows it; this attributes it)."""
-    return (Gp, Cp, Ep, F, mode) in compiled_buckets
+    duration shows it; this attributes it). Sharded solves match only
+    sharded-warmed buckets: the GSPMD program is a different compile."""
+    return (Gp, Cp, Ep, F, mode, shards) in compiled_buckets
 
 
 def rewarm_canary() -> bool:
@@ -428,28 +498,34 @@ def warm(
                 "probe warm compile (L=%d,G=%d,C=%d,E=%d,N=%d,R=%d,P=%d) "
                 "failed: %s", L, G, C, E, N, R, P, err,
             )
+    # KARPENTER_WARM_SHARDS adds the GSPMD-partitioned variant of each
+    # bucket (the multi-host solver service's pjit shapes): same
+    # matrix, compiled with the config axis split over the mesh
+    ws = warm_shards()
+    shard_variants = (0, ws) if ws > 1 else (0,)
     for shape in shapes:
         G, C, E, N = shape[:4]
         R = shape[4] if len(shape) > 4 else 4
         P = shape[5] if len(shape) > 5 else 1
         for mode in modes:
             for with_topo in ((False, True) if topo else (False,)):
-                if stop is not None and stop.is_set():
-                    counts["skipped"] += 1
-                    continue
-                try:
-                    _compile_bucket(G, C, E, N, mode, R=R, P=P,
-                                    topo=with_topo)
-                    counts["ok"] += 1
-                    SOLVER_WARM_COMPILES.inc({"outcome": "ok"})
-                except Exception as err:
-                    counts["error"] += 1
-                    SOLVER_WARM_COMPILES.inc({"outcome": "error"})
-                    log.warning(
-                        "warm compile (G=%d,C=%d,E=%d,N=%d,R=%d,P=%d,"
-                        "mode=%s,topo=%s) failed: %s",
-                        G, C, E, N, R, P, mode, with_topo, err,
-                    )
+                for shards in shard_variants:
+                    if stop is not None and stop.is_set():
+                        counts["skipped"] += 1
+                        continue
+                    try:
+                        _compile_bucket(G, C, E, N, mode, R=R, P=P,
+                                        topo=with_topo, shards=shards)
+                        counts["ok"] += 1
+                        SOLVER_WARM_COMPILES.inc({"outcome": "ok"})
+                    except Exception as err:
+                        counts["error"] += 1
+                        SOLVER_WARM_COMPILES.inc({"outcome": "error"})
+                        log.warning(
+                            "warm compile (G=%d,C=%d,E=%d,N=%d,R=%d,P=%d,"
+                            "mode=%s,topo=%s,shards=%d) failed: %s",
+                            G, C, E, N, R, P, mode, with_topo, shards, err,
+                        )
     return counts
 
 

@@ -228,6 +228,44 @@ class TestLadder:
         out = resilience.shared().solve_packing(enc, mode="ffd")
         assert _same_pack(out, host_pack_result(enc))
 
+    def test_sharded_rung_serves_wavefront_and_streaming(self, monkeypatch):
+        """ISSUE 11: the ladder's sharded rung now routes the
+        wavefront kernel over the streamed per-shard staging. The
+        served result must equal the direct unsharded solve, and the
+        sharded rung — not device — must take the ok."""
+        from karpenter_tpu.solver import stream
+
+        enc = _enc(seed=17)
+        monkeypatch.setenv("KARPENTER_WAVEFRONT", "force")
+        monkeypatch.setenv("KARPENTER_STREAM_ENCODE", "auto")
+        direct = solve_packing(enc, mode="ffd")
+        monkeypatch.setenv("KARPENTER_SOLVER_SHARDS", "8")
+        before = SOLVER_LADDER.value({"rung": "sharded", "outcome": "ok"})
+        stream.reset_stats()
+        out = resilience.shared().solve_packing(enc, mode="ffd")
+        assert _same_pack(out, direct)
+        assert SOLVER_LADDER.value(
+            {"rung": "sharded", "outcome": "ok"}) == before + 1
+        # the rung's staging actually streamed (blocks were shipped)
+        assert stream.last_stats().get("blocks", 0) > 0
+
+    def test_sharded_rung_failure_degrades_to_single_device(
+        self, monkeypatch
+    ):
+        """One injected device loss on the sharded rung: the ladder
+        falls to the single-device rung, whose answer is identical."""
+        enc = _enc(seed=19)
+        direct = solve_packing(enc, mode="ffd")
+        monkeypatch.setenv("KARPENTER_SOLVER_SHARDS", "8")
+        monkeypatch.setenv("KARPENTER_FAULTS", "device_lost@solve:1")
+        faults.reset()
+        resilience.reset()
+        before_dev = SOLVER_LADDER.value({"rung": "device", "outcome": "ok"})
+        out = resilience.shared().solve_packing(enc, mode="ffd")
+        assert _same_pack(out, direct)
+        assert SOLVER_LADDER.value(
+            {"rung": "device", "outcome": "ok"}) == before_dev + 1
+
     def test_breaker_opens_and_skips_then_recloses(self, monkeypatch):
         enc = _enc(seed=13)
         # cooldown far beyond any suite-load stall: the skip assertion

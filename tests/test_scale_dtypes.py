@@ -1,0 +1,142 @@
+"""Int32 width audit at million-pod shapes (ISSUE 11 satellite).
+
+The packing kernels keep all counts in int32 (assign, group_count,
+unschedulable, the flat uint32 transport). Three spots could overflow
+once node axes and demands reach million-pod scale, and each now has a
+guarded construction pinned here:
+
+1. the per-group prefix fill — a plain int32 cumsum of per-node
+   capacities (each clipped at CAP_MAX ~ 2e9) wraps as soon as two
+   unbounded rows stack; `_prefix_take` clamps capacities at the
+   group's remaining demand and saturates the running sum via a uint32
+   associative scan (exact, and bit-identical to the naive prefix
+   wherever int32 didn't overflow);
+2. capacity casts — capacities are clipped to CAP_MAX (int32-exact)
+   BEFORE the f32 -> int32 cast; casting the f32 BIG sentinel is
+   implementation-defined in XLA;
+3. the bulk-open ceil division — (remaining + m_star - 1) overflows
+   when both near 2^31; the kernels use (remaining - 1) // m_star + 1,
+   exact for the remaining >= 1 the loop guarantees.
+
+Host-side, _run_pack rejects demands whose total exceeds int32 before
+any array is staged.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from karpenter_tpu.solver.pack import CAP_MAX, _prefix_take, pack_split
+
+
+def naive_take(k, remaining):
+    """The definitionally-correct int64 prefix fill."""
+    k64 = np.asarray(k, np.int64)
+    prefix = np.cumsum(k64) - k64
+    return np.clip(remaining - prefix, 0, k64).astype(np.int64)
+
+
+class TestPrefixTake:
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_matches_naive_on_ordinary_capacities(self, seed):
+        rng = np.random.default_rng(seed)
+        k = rng.integers(0, 500, size=200).astype(np.int32)
+        for remaining in (0, 1, 37, 1_000, 1_000_000):
+            got = np.asarray(_prefix_take(jnp.asarray(k), jnp.int32(remaining)))
+            np.testing.assert_array_equal(got, naive_take(k, remaining))
+
+    def test_unbounded_rows_would_wrap_int32(self):
+        """Four CAP_MAX rows: the raw int32 cumsum wraps at row 2 (sum
+        4e9 > 2^31) — the construction this module exists to prevent —
+        while the saturating scan still yields the exact fill."""
+        k = np.full(4, int(CAP_MAX), np.int32)
+        wrapped = np.cumsum(k, dtype=np.int32)  # the kernels' old width
+        assert (wrapped < 0).any(), "precondition: naive cumsum wraps"
+        got = np.asarray(_prefix_take(jnp.asarray(k), jnp.int32(5)))
+        np.testing.assert_array_equal(got, [5, 0, 0, 0])
+
+    def test_million_pod_boundary_shapes(self):
+        """Node axes and demands at the million_pod bench's scale:
+        35k nodes x capacities that sum far past int32."""
+        rng = np.random.default_rng(7)
+        k = rng.integers(0, 200_000, size=35_000).astype(np.int32)
+        k[::97] = int(CAP_MAX)  # sprinkle unbounded rows
+        for remaining in (1_000_000, 2**31 - 1):
+            got = np.asarray(
+                _prefix_take(jnp.asarray(k), jnp.int32(remaining))
+            )
+            np.testing.assert_array_equal(got, naive_take(k, remaining))
+
+    def test_negative_remaining_takes_nothing(self):
+        """The replaced clip(remaining - prefix, 0, k) floored negative
+        demand at zero takes; the saturating scan must too (an
+        unclamped min(k, remaining) wrapped -5 through the uint32 cast
+        into ~4.29e9-sized takes)."""
+        k = np.array([3, 10, 2], np.int32)
+        got = np.asarray(_prefix_take(jnp.asarray(k), jnp.int32(-5)))
+        np.testing.assert_array_equal(got, [0, 0, 0])
+
+    def test_saturation_never_inflates_total(self):
+        rng = np.random.default_rng(11)
+        for _ in range(20):
+            n = int(rng.integers(1, 64))
+            k = rng.integers(0, int(CAP_MAX), size=n).astype(np.int32)
+            remaining = int(rng.integers(0, 2**31 - 1))
+            got = np.asarray(
+                _prefix_take(jnp.asarray(k), jnp.int32(remaining))
+            )
+            assert got.astype(np.int64).sum() <= remaining
+            np.testing.assert_array_equal(got, naive_take(k, remaining))
+
+
+class TestKernelOverflowRegression:
+    def _zero_req_problem(self, B=4, remaining=7):
+        """A group requesting NOTHING (every resource dimension zero)
+        against B bound rows: each row's capacity is CAP_MAX, so the
+        pre-audit int32 cumsum wrapped at row 2 and the vectorized
+        take fabricated ~3e8 placements on row 2."""
+        G, C, R, F = 1, 32, 2, 16
+        compat = np.ones((G, C), bool)
+        group_req = np.zeros((G, R), np.float32)
+        group_count = np.array([remaining], np.int32)
+        cfg_alloc = np.full((C, R), 8.0, np.float32)
+        cfg_pool = np.full((C,), -1, np.int32)  # no fresh opens
+        pool_overhead = np.zeros((1, R), np.float32)
+        bound_compat = np.ones((G, B), bool)
+        bound_alloc = np.full((B, R), 8.0, np.float32)
+        bound_used0 = np.zeros((B, R), np.float32)
+        bound_slot = np.zeros((B,), np.int32)
+        bound_live = np.ones((B,), bool)
+        cfg_price = np.ones((C,), np.float32)
+        return (
+            jnp.asarray(compat), jnp.asarray(group_req),
+            jnp.asarray(group_count), jnp.asarray(cfg_alloc),
+            jnp.asarray(cfg_pool), jnp.asarray(pool_overhead),
+            jnp.asarray(bound_compat), jnp.asarray(bound_alloc),
+            jnp.asarray(bound_used0), jnp.asarray(bound_slot),
+            jnp.asarray(bound_live), jnp.asarray(cfg_price),
+        ), F
+
+    def test_zero_request_group_fills_first_row_only(self):
+        args, F = self._zero_req_problem()
+        assign, _, node_count, unsched = [
+            np.asarray(x)
+            for x in pack_split(*args, max_free=F, mode="ffd")
+        ]
+        # first-fit: all 7 pods on bound row 0, none fabricated
+        assert assign[0, 0] == 7
+        assert assign[1:, 0].sum() == 0
+        assert int(unsched.sum()) == 0
+
+    def test_run_pack_rejects_demand_past_int32(self):
+        from bench import build_problem
+        from karpenter_tpu.solver.encode import encode, group_pods
+        from karpenter_tpu.solver.pack import solve_packing
+
+        pods, pools = build_problem(64, 8, seed=1)
+        enc = encode(group_pods(pods), pools)
+        enc.group_count = enc.group_count.astype(np.int64)
+        enc.group_count[0] = 2**31
+        with pytest.raises(ValueError, match="int32"):
+            solve_packing(enc, mode="ffd")

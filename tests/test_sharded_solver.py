@@ -45,6 +45,23 @@ class TestShardedPack:
         for r in results[1:]:
             assert same_solution(r, results[0])
 
+    def test_odd_shard_counts_agree(self):
+        """Uneven column splits (ISSUE 11 satellite): the config axis
+        pads to lcm(32, shards), so odd meshes exercise per-shard
+        blocks of different effective width."""
+        _, _, enc = _problem(700, 40, seed=19)
+        base = solve_packing(enc, mode="ffd")
+        for s in (3, 5, 7):
+            assert same_solution(solve_packing(enc, mode="ffd", shards=s), base)
+
+    def test_odd_shards_cost_mode_agree(self):
+        _, _, enc = _problem(600, 40, seed=29)
+        base = solve_packing(enc, mode="cost")
+        for s in (3, 5):
+            assert same_solution(
+                solve_packing(enc, mode="cost", shards=s), base
+            )
+
     def test_solve_facade_shards(self):
         pods, pools, _ = _problem(600, 32, seed=9)
         base = solve(pods, pools)

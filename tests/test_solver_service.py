@@ -108,6 +108,50 @@ class TestService:
         )
         assert same_solution(remote, local)
 
+    def test_auto_mesh_spans_device_set_and_matches_unsharded(self):
+        """ISSUE 11 tentpole (c): a service booted with shards="auto"
+        pjit-spans its whole device set (the 8 virtual devices here —
+        the multi-host layout), solves remotely over the mesh with the
+        wavefront kernel, and stays bit-identical to the local
+        unsharded solve."""
+        import os
+
+        from karpenter_tpu.service.server import resolve_service_shards
+
+        assert resolve_service_shards("auto") == 8
+        srv = SolverServer(port=0, shards="auto").start()
+        try:
+            assert srv._default_shards == 8
+            _, _, enc = _enc(600, 32, seed=17)
+            prev = os.environ.get("KARPENTER_WAVEFRONT")
+            os.environ["KARPENTER_WAVEFRONT"] = "force"
+            try:
+                local = solve_packing(enc, mode="ffd")
+                remote = RemoteSolver(
+                    f"127.0.0.1:{srv.port}"
+                ).solve_packing(enc, mode="ffd")
+            finally:
+                if prev is None:
+                    os.environ.pop("KARPENTER_WAVEFRONT", None)
+                else:
+                    os.environ["KARPENTER_WAVEFRONT"] = prev
+            assert srv.requests_served == 1
+            assert same_solution(remote, local)
+            # the mesh solve reports wavefront step accounting over
+            # the wire (the codec's optional fields)
+            assert remote.device_steps > 0
+            assert remote.wavefront_widths is not None
+        finally:
+            srv.stop()
+
+    def test_resolve_service_shards_contract(self, monkeypatch):
+        from karpenter_tpu.service.server import resolve_service_shards
+
+        assert resolve_service_shards(0) == 0          # inherit
+        assert resolve_service_shards(4) == 4          # literal
+        assert resolve_service_shards(-1) == 8         # auto via sentinel
+        assert resolve_service_shards("auto") == 8
+
     def test_env_routes_full_solve_through_service(self, server, monkeypatch):
         import karpenter_tpu.solver.solver as solver_mod
 

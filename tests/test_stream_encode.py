@@ -1,0 +1,172 @@
+"""Streaming per-shard column-block staging (ISSUE 11 tentpole b).
+
+solver/stream.py ships the padded config-axis matrices to the mesh as
+per-shard column blocks so the full padded matrix never exists
+host-side at once. The contract tested here:
+
+1. value identity — a staged array equals the device_put of the full
+   padded matrix, per shard count (including odd widths), and a
+   streamed solve equals the classic-staged solve bit for bit;
+2. memory accounting — the recorded peak single-block transient is
+   bounded by full_bytes / shards (+ padding), and full_bytes matches
+   the padded matrix sizes the classic path would have allocated;
+3. knob resolution — KARPENTER_STREAM_ENCODE off/auto/force.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from karpenter_tpu.solver import stream
+from karpenter_tpu.solver.pack import _mesh, solve_packing
+
+
+def _mesh8():
+    return _mesh(8)
+
+
+class TestStageValues:
+    @pytest.mark.parametrize("shards", [2, 3, 5, 8])
+    def test_staged_matrix_equals_device_put(self, shards):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        import math
+
+        rng = np.random.default_rng(shards)
+        G, C = 37, 41
+        # mirror _run_pack's padding contract: the config axis splits
+        # evenly over the mesh AND packs into 32-bit mask words
+        step = math.lcm(32, shards)
+        Gp, Cp = 48, -(-64 // step) * step
+        src = rng.random((G, C)) < 0.5
+        mesh = _mesh(shards)
+        staging = stream._Staging()
+        got = stream.stage(
+            mesh, P(None, "cfg"), (Gp, Cp), np.bool_,
+            stream.col_fill_2d(src, Gp, G, C, np.bool_), staging,
+        )
+        full = np.zeros((Gp, Cp), bool)
+        full[:G, :C] = src
+        want = jax.device_put(
+            jnp.asarray(full), NamedSharding(mesh, P(None, "cfg"))
+        )
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+        assert staging.blocks == shards
+        assert staging.full_bytes == Gp * Cp
+        # each block is 1/shards of the columns (ceil-split)
+        assert staging.peak_block_bytes <= Gp * (-(-Cp // shards))
+
+    def test_vector_and_row_fills(self):
+        from jax.sharding import PartitionSpec as P
+
+        mesh = _mesh8()
+        C, Cp, R = 10, 32, 3
+        vec = np.arange(C, dtype=np.int32)
+        got = stream.stage(
+            mesh, P("cfg"), (Cp,), np.int32,
+            stream.vec_fill(vec, C, np.int32, pad_value=-1),
+        )
+        want = np.full((Cp,), -1, np.int32)
+        want[:C] = vec
+        np.testing.assert_array_equal(np.asarray(got), want)
+
+        mat = np.arange(C * R, dtype=np.float32).reshape(C, R)
+        got = stream.stage(
+            mesh, P("cfg", None), (Cp, R), np.float32,
+            stream.row_fill_2d(mat, R, C, np.float32),
+        )
+        want = np.zeros((Cp, R), np.float32)
+        want[:C] = mat
+        np.testing.assert_array_equal(np.asarray(got), want)
+
+
+class TestStreamedSolveIdentity:
+    @pytest.mark.parametrize("shards", [3, 8])
+    def test_streamed_equals_classic_with_existing_nodes(
+        self, shards, monkeypatch
+    ):
+        """The production consolidation shape: existing nodes occupy
+        pseudo-config columns (the bound block is now built from the
+        unpadded encode arrays on every path)."""
+        from bench import build_problem
+        from conftest import same_solution
+        from karpenter_tpu.apis.v1.labels import (
+            CAPACITY_TYPE_LABEL,
+            INSTANCE_TYPE_LABEL,
+            NODEPOOL_LABEL,
+            TOPOLOGY_ZONE_LABEL,
+        )
+        from karpenter_tpu.scheduling.requirements import Requirements
+        from karpenter_tpu.solver.encode import (
+            ExistingNodeInput,
+            encode,
+            group_pods,
+        )
+
+        pods, pools = build_problem(800, 48, seed=13, reservations=True)
+        types = pools[0][1]
+        existing = []
+        for i, it in enumerate(types[:5]):
+            labels = {
+                NODEPOOL_LABEL: pools[0][0].metadata.name,
+                INSTANCE_TYPE_LABEL: it.name,
+                TOPOLOGY_ZONE_LABEL: "test-zone-1",
+                CAPACITY_TYPE_LABEL: "on-demand",
+            }
+            existing.append(ExistingNodeInput(
+                name=f"live-{i}",
+                requirements=Requirements.from_labels(labels),
+                taints=(),
+                available=dict(it.allocatable),
+                pool_name=pools[0][0].metadata.name,
+            ))
+        enc = encode(group_pods(pods), pools, existing)
+        monkeypatch.setenv("KARPENTER_STREAM_ENCODE", "0")
+        classic = solve_packing(enc, mode="ffd", shards=shards)
+        monkeypatch.setenv("KARPENTER_STREAM_ENCODE", "1")
+        streamed = solve_packing(enc, mode="ffd", shards=shards)
+        assert same_solution(streamed, classic)
+
+    def test_stats_recorded_and_bounded(self, monkeypatch):
+        from bench import build_problem
+        from karpenter_tpu.solver.encode import encode, group_pods
+
+        pods, pools = build_problem(600, 40, seed=3)
+        enc = encode(group_pods(pods), pools)
+        monkeypatch.setenv("KARPENTER_STREAM_ENCODE", "1")
+        stream.reset_stats()
+        solve_packing(enc, mode="ffd", shards=8)
+        stats = stream.last_stats()
+        assert stats["arrays"] == 5  # compat, alloc, pool, price, rsv
+        assert stats["blocks"] == 5 * 8
+        assert 0 < stats["peak_block_bytes"] < stats["full_bytes"]
+        # the whole point: one transient block is a fraction of the
+        # full materialization (ceil-split padding allows slack on the
+        # smallest matrices, never a full-size block)
+        assert stats["peak_block_bytes"] * 4 <= stats["full_bytes"]
+
+    def test_stream_counter_increments(self, monkeypatch):
+        from bench import build_problem
+        from karpenter_tpu.metrics.store import SOLVER_STREAM_BLOCKS
+        from karpenter_tpu.solver.encode import encode, group_pods
+
+        pods, pools = build_problem(300, 24, seed=5)
+        enc = encode(group_pods(pods), pools)
+        monkeypatch.setenv("KARPENTER_STREAM_ENCODE", "1")
+        before = SOLVER_STREAM_BLOCKS.total()
+        solve_packing(enc, mode="ffd", shards=2)
+        assert SOLVER_STREAM_BLOCKS.total() == before + 10  # 5 arrays x 2
+
+
+class TestKnob:
+    def test_resolution(self, monkeypatch):
+        for off in ("0", "off", "false", "no"):
+            monkeypatch.setenv("KARPENTER_STREAM_ENCODE", off)
+            assert stream.enabled() is False
+        for on in ("auto", "1", "on", "force", ""):
+            monkeypatch.setenv("KARPENTER_STREAM_ENCODE", on)
+            assert stream.enabled() is True
+        monkeypatch.delenv("KARPENTER_STREAM_ENCODE")
+        assert stream.enabled() is True

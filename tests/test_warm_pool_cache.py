@@ -57,6 +57,50 @@ def test_persistent_cache_force_override_still_works(tmp_path, monkeypatch):
         jax.config.update("jax_compilation_cache_dir", before)
 
 
+def test_sharded_warm_bucket_compiles_and_registers(monkeypatch):
+    """ISSUE 11: KARPENTER_WARM_SHARDS adds the GSPMD-partitioned
+    variant of each bucket — the multi-host service's pjit shapes. The
+    sharded AOT compile must succeed on the 8-device mesh and register
+    under its own (padded, sharded) signature, distinct from the
+    unsharded program."""
+    from karpenter_tpu.solver import warm_pool
+    from karpenter_tpu.solver.pack import _bucket, _pad_axis
+
+    monkeypatch.setenv("KARPENTER_WARM_SHARDS", "auto")
+    assert warm_pool.warm_shards() == 8
+    monkeypatch.setenv("KARPENTER_WARM_SHARDS", "64")  # clamps to visible
+    assert warm_pool.warm_shards() == 8
+    monkeypatch.setenv("KARPENTER_WARM_SHARDS", "0")
+    assert warm_pool.warm_shards() == 0
+
+    before = set(warm_pool.compiled_buckets)
+    warm_pool._compile_bucket(16, 64, 0, 32, "ffd", shards=8)
+    Gp = _pad_axis(16)
+    Cp = -(-_pad_axis(64) // 32) * 32  # lcm(32, 8) == 32
+    F = _bucket(32)
+    assert warm_pool.warmed(Gp, Cp, 0, F, "ffd", 8)
+    # the sharded compile registers exactly its own signature — it
+    # never masquerades as the unsharded program (the registry is
+    # process-global, so assert on the DELTA, not absence)
+    assert warm_pool.compiled_buckets - before <= {
+        (Gp, Cp, 0, F, "ffd", 8)
+    }
+
+
+def test_warm_compiles_sharded_variants_when_enabled(monkeypatch):
+    """warm() with KARPENTER_WARM_SHARDS set compiles each bucket
+    twice (unsharded + sharded) — counted, never raising."""
+    from karpenter_tpu.solver import warm_pool
+
+    monkeypatch.setenv("KARPENTER_WARM_SHARDS", "8")
+    counts = warm_pool.warm(
+        shapes=[(16, 64, 0, 32)], modes=("ffd",), topo=False,
+        probe_shapes=[],
+    )
+    assert counts["error"] == 0
+    assert counts["ok"] == 2  # one unsharded + one sharded compile
+
+
 def test_bench_cache_setup_delegates_to_warm_pool():
     """bench._setup_jax_cache must route through the shared gating in
     warm_pool (one place owns the CPU trap logic), not re-implement

@@ -479,6 +479,118 @@ class TestWavefrontSolverOracle:
         assert run("force") == run("0")
 
 
+class TestShardedWavefrontOracle:
+    """ISSUE 11 tentpole (a): the wavefront kernel with the config
+    axis partitioned over the device mesh must stay bit-identical to
+    the UNSHARDED SEQUENTIAL solve — the strongest identity in the
+    suite, crossing both the batched-commit proof and the GSPMD
+    partitioning at once. Shard counts include odd widths (3, 5) so
+    uneven column splits are exercised, both pack modes run, and
+    existing-node prefixes cover the bound-block staging."""
+
+    @staticmethod
+    def _identical(a, b):
+        n = a.node_count
+        if n != b.node_count:
+            return False
+        return (
+            np.array_equal(a.assign[:n], b.assign[:n])
+            and np.array_equal(a.node_mask[:n], b.node_mask[:n])
+            and np.array_equal(a.unschedulable, b.unschedulable)
+        )
+
+    def _assert_sharded_wavefront_matches(
+        self, enc, mode, monkeypatch, shard_counts=(2, 3, 5, 8),
+        existing=None,
+    ):
+        from karpenter_tpu.solver.pack import solve_packing
+
+        kw = {}
+        monkeypatch.setenv("KARPENTER_WAVEFRONT", "0")
+        base = solve_packing(enc, mode=mode, **kw)
+        monkeypatch.setenv("KARPENTER_WAVEFRONT", "force")
+        for shards in shard_counts:
+            got = solve_packing(enc, mode=mode, shards=shards, **kw)
+            assert got.device_steps > 0
+            assert got.wavefront_widths is not None, (
+                f"shards={shards} did not route the wavefront kernel"
+            )
+            assert self._identical(got, base), (
+                f"sharded wavefront diverged from the unsharded "
+                f"sequential solve at shards={shards}, mode={mode}"
+            )
+
+    @pytest.mark.parametrize("mode", ["ffd", "cost"])
+    def test_fresh_only_both_modes(self, mode, monkeypatch):
+        enc = _random_problem(41, n_pods=400)
+        self._assert_sharded_wavefront_matches(enc, mode, monkeypatch)
+
+    def test_with_reservations(self, monkeypatch):
+        enc = _random_problem(43, n_pods=350, reservations=True)
+        self._assert_sharded_wavefront_matches(enc, "ffd", monkeypatch)
+
+    def test_with_existing_prefix(self, monkeypatch):
+        """Existing nodes occupy pseudo-config columns; the sharded
+        staging replicates the bound block while splitting the config
+        axis — the fill order over bound-then-fresh must survive."""
+        from karpenter_tpu.apis.v1.labels import (
+            CAPACITY_TYPE_LABEL,
+            INSTANCE_TYPE_LABEL,
+            NODEPOOL_LABEL,
+            TOPOLOGY_ZONE_LABEL,
+        )
+        from karpenter_tpu.cloudprovider.fake import instance_types
+        from karpenter_tpu.scheduling.requirements import Requirements
+        from karpenter_tpu.solver.encode import ExistingNodeInput
+
+        rng = np.random.default_rng(47)
+        pool = mk_nodepool("default")
+        types = instance_types(24)
+        pods = []
+        for i in range(300):
+            cpu = float(rng.choice([0.25, 0.5, 1.0, 2.0]))
+            sel = {}
+            if rng.random() < 0.4:
+                sel["topology.kubernetes.io/zone"] = str(rng.choice(ZONES))
+            pods.append(mk_pod(name=f"e-{i}", cpu=cpu, memory=GIB,
+                               node_selector=sel))
+        existing = []
+        for i, it in enumerate(types[:7]):
+            off = it.offerings[0]
+            labels = {
+                NODEPOOL_LABEL: pool.metadata.name,
+                INSTANCE_TYPE_LABEL: it.name,
+                TOPOLOGY_ZONE_LABEL: off.zone,
+                CAPACITY_TYPE_LABEL: off.capacity_type,
+            }
+            existing.append(ExistingNodeInput(
+                name=f"live-{i}",
+                requirements=Requirements.from_labels(labels),
+                taints=(),
+                available=dict(it.allocatable),
+                pool_name=pool.metadata.name,
+            ))
+        enc = encode(group_pods(pods), [(pool, types)], existing)
+        self._assert_sharded_wavefront_matches(
+            enc, "ffd", monkeypatch, shard_counts=(3, 8)
+        )
+
+    def test_streaming_staging_identical(self, monkeypatch):
+        """The streamed per-shard column-block staging must produce
+        the same solve as the full-materialization staging (ISSUE 11
+        tentpole (b) — the blocks differ only in how they reach the
+        mesh, never in value)."""
+        from karpenter_tpu.solver.pack import solve_packing
+
+        enc = _random_problem(53, n_pods=350, reservations=True)
+        monkeypatch.setenv("KARPENTER_WAVEFRONT", "force")
+        monkeypatch.setenv("KARPENTER_STREAM_ENCODE", "0")
+        full = solve_packing(enc, mode="ffd", shards=8)
+        monkeypatch.setenv("KARPENTER_STREAM_ENCODE", "1")
+        streamed = solve_packing(enc, mode="ffd", shards=8)
+        assert self._identical(streamed, full)
+
+
 class TestWavefrontRouting:
     def test_knob_resolution(self, monkeypatch):
         monkeypatch.setenv("KARPENTER_WAVEFRONT", "0")
@@ -487,8 +599,9 @@ class TestWavefrontRouting:
         assert wavefront_plan(100) > 1
         # small solves stay sequential even when forced
         assert wavefront_plan(WAVEFRONT_MIN_GROUPS - 1) == 0
-        # sharded solves stay off the wavefront program
-        assert wavefront_plan(100, shards=2) == 0
+        # sharded solves take the wavefront too (ISSUE 11: the config
+        # axis partitions over the mesh; rounds commit identically)
+        assert wavefront_plan(100, shards=2) == wavefront_plan(100)
         monkeypatch.setenv("KARPENTER_WAVEFRONT", "12")
         assert wavefront_plan(100) == 12
         monkeypatch.setenv("KARPENTER_WAVEFRONT", "force")

@@ -1,0 +1,222 @@
+#!/usr/bin/env python
+"""Diff two bench artifacts and gate on regressions (ISSUE 11).
+
+    python tools/bench_compare.py BASELINE.json CURRENT.json \
+        [--threshold 0.25] [--scenarios reserved_50k,steady_state_churn]
+
+Compares, per scenario present in BOTH artifacts' detail:
+- wall-clock keys (lower is better): wall_s, p50_s, p99_s, and every
+  *_wall_s / *_p50_s variant a scenario reports;
+- pods_per_sec (higher is better).
+
+Exit codes: 0 = no regression past the threshold, 1 = at least one
+regression, 2 = an artifact could not be parsed. A regression is a
+relative change past --threshold in the bad direction; improvements
+are reported but never gate. Scenarios present in only one artifact
+are listed and skipped (a new arm is not a regression; a VANISHED
+scenario is reported loudly but doesn't gate — arms can be disabled
+per round via BENCH_SCENARIOS).
+
+Accepted artifact shapes:
+- the bench's own JSON line ({"metric", "value", "detail": {...}});
+- the driver wrapper ({"parsed": {...}} or a "tail" string whose last
+  parsable JSON object line is the bench output).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+# lower-is-better wall keys compared when present in both runs
+WALL_KEYS = (
+    "wall_s", "p50_s", "p99_s",
+    "incremental_p50_s", "full_resolve_p50_s",
+    "batched_probe_wall_s", "reference_wall_s", "global_repack_wall_s",
+    "provision_wall_s", "p50_tick_s", "p99_tick_s",
+    "full_staging_wall_s", "unsharded_wall_s",
+)
+# higher-is-better throughput key
+RATE_KEY = "pods_per_sec"
+
+
+def load_detail(path: str) -> dict:
+    """Scenario detail dict from any accepted artifact shape, or a
+    raised ValueError naming what was wrong."""
+    with open(path) as fh:
+        data = json.load(fh)
+    if isinstance(data, dict) and isinstance(data.get("detail"), dict):
+        return data["detail"]
+    if isinstance(data, dict) and isinstance(data.get("parsed"), dict):
+        parsed = data["parsed"]
+        if isinstance(parsed.get("detail"), dict):
+            return parsed["detail"]
+    if isinstance(data, dict) and isinstance(data.get("tail"), str):
+        tail = data["tail"]
+        for line in reversed(tail.splitlines()):
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                candidate = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(candidate.get("detail"), dict):
+                return candidate["detail"]
+        # salvage mode: the driver truncates `tail` to its last N
+        # chars, so the bench JSON line is often cut at the FRONT while
+        # its later scenario objects are intact (every recorded round
+        # since r03 looks like this). Extract each complete
+        # `"name": {...}` object individually.
+        salvaged = _salvage_scenarios(tail)
+        if salvaged:
+            return salvaged
+        raise ValueError(
+            f"{path}: driver wrapper carries no parsed bench JSON "
+            "(tail truncated past salvage and 'parsed' missing)"
+        )
+    raise ValueError(f"{path}: no scenario detail found")
+
+
+def _salvage_scenarios(tail: str) -> dict:
+    """Complete `"name": {...}` objects recoverable from a truncated
+    JSON fragment: balanced-brace extraction per candidate, keeping
+    dicts that parse and carry at least one numeric field. Nested
+    braces inside a scenario (device_steps, trace_summary) are handled
+    by the depth walk; a scenario cut by the truncation simply fails
+    json.loads and is skipped."""
+    import re
+
+    out: dict = {}
+    for match in re.finditer(r'"([a-z][a-z0-9_]*)":\s*\{', tail):
+        name = match.group(1)
+        start = match.end() - 1
+        depth = 0
+        for i in range(start, len(tail)):
+            if tail[i] == "{":
+                depth += 1
+            elif tail[i] == "}":
+                depth -= 1
+                if depth == 0:
+                    try:
+                        obj = json.loads(tail[start : i + 1])
+                    except json.JSONDecodeError:
+                        break
+                    if isinstance(obj, dict) and any(
+                        isinstance(v, (int, float)) for v in obj.values()
+                    ):
+                        out[name] = obj
+                    break
+        else:
+            continue
+    # wrapper noise that is not a scenario
+    for key in ("backend_provenance", "detail", "parsed", "device_steps",
+                "trace_summary", "fault_schedule", "resilience"):
+        out.pop(key, None)
+    return out
+
+
+def compare(
+    base: dict, cur: dict, threshold: float, scenarios=None
+) -> tuple[list[str], list[str]]:
+    """-> (report lines, regression lines). A regression is a wall
+    increase or pods/sec decrease past `threshold` relative change."""
+    lines: list[str] = []
+    regressions: list[str] = []
+    meta = {"backend", "backend_provenance"}
+    base = {k: v for k, v in base.items() if k not in meta}
+    cur = {k: v for k, v in cur.items() if k not in meta}
+    names = sorted(set(base) & set(cur))
+    if scenarios:
+        names = [n for n in names if n in scenarios]
+        missing = [n for n in scenarios if n not in names]
+        for name in missing:
+            lines.append(f"  {name}: requested but absent from one side")
+    for name in sorted(set(base) ^ set(cur)):
+        side = "baseline" if name in base else "current"
+        lines.append(f"  {name}: only in {side} (skipped)")
+    for name in names:
+        b, c = base[name], cur[name]
+        if not isinstance(b, dict) or not isinstance(c, dict):
+            continue
+        if "error" in b or "error" in c:
+            lines.append(f"  {name}: errored arm (skipped)")
+            continue
+        for key in WALL_KEYS:
+            bv, cv = b.get(key), c.get(key)
+            if not isinstance(bv, (int, float)) or not isinstance(
+                cv, (int, float)
+            ) or bv <= 0:
+                continue
+            rel = cv / bv - 1.0
+            tag = f"{name}.{key}: {bv:.3f}s -> {cv:.3f}s ({rel:+.1%})"
+            if rel > threshold:
+                regressions.append(tag)
+            else:
+                lines.append("  " + tag)
+        bv, cv = b.get(RATE_KEY), c.get(RATE_KEY)
+        if isinstance(bv, (int, float)) and isinstance(
+            cv, (int, float)
+        ) and bv > 0:
+            rel = cv / bv - 1.0
+            tag = (
+                f"{name}.{RATE_KEY}: {bv:,.0f} -> {cv:,.0f} ({rel:+.1%})"
+            )
+            if rel < -threshold:
+                regressions.append(tag)
+            else:
+                lines.append("  " + tag)
+    return lines, regressions
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="gate bench results against a baseline artifact"
+    )
+    parser.add_argument("baseline")
+    parser.add_argument("current")
+    parser.add_argument(
+        "--threshold", type=float, default=0.25,
+        help="relative regression gate (default 0.25 — CPU bench "
+        "walls jitter; tighten on dedicated hardware)",
+    )
+    parser.add_argument(
+        "--scenarios", default="",
+        help="comma list restricting the gate (default: every "
+        "scenario present in both artifacts)",
+    )
+    parser.add_argument(
+        "--quiet", action="store_true",
+        help="print regressions only",
+    )
+    args = parser.parse_args(argv)
+    try:
+        base = load_detail(args.baseline)
+        cur = load_detail(args.current)
+    except (OSError, ValueError, json.JSONDecodeError) as err:
+        print(f"bench_compare: {err}", file=sys.stderr)
+        return 2
+    wanted = (
+        {s.strip() for s in args.scenarios.split(",") if s.strip()}
+        or None
+    )
+    lines, regressions = compare(base, cur, args.threshold, wanted)
+    if not args.quiet and lines:
+        print("compared (within threshold):")
+        for line in lines:
+            print(line)
+    if regressions:
+        print(
+            f"REGRESSIONS past {args.threshold:.0%} "
+            f"({args.baseline} -> {args.current}):"
+        )
+        for line in regressions:
+            print("  " + line)
+        return 1
+    print(f"no regressions past {args.threshold:.0%}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
