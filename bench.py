@@ -746,16 +746,64 @@ def _timed_cost_solve(pods, pools, bound_gap: bool = False, repeats: int = 1):
         out["p90_s"] = pct(0.90)
         out["p99_s"] = pct(0.99)
         out["samples"] = len(ordered)
-    if bound_gap and sol.lp is not None:
-        # quantify optimality from the bounds the cost solve already
-        # computed: lp_lower_bound is PROVEN-VALID (the better of the
-        # linear resource bound and the Farley bound certified by
-        # exact knapsack upper bounds); lp_estimate is the master-LP
-        # value. gap_vs_lp ~ how much any packer could still recover.
-        out["lp_lower_bound"] = round(sol.lp["lower_bound"], 2)
-        out["lp_estimate"] = round(sol.lp["estimate"], 2)
-        if sol.lp["estimate"] > 0:
-            out["gap_vs_lp"] = round(cost_price / sol.lp["estimate"] - 1, 4)
+    # optimality bookkeeping, in EVERY cost arm (ISSUE 12): the bounds
+    # ride along on Solution.lp, so recording them costs nothing.
+    # lp_lower_bound is PROVEN-VALID (the better of the linear resource
+    # bound and the Farley bound certified by exact knapsack upper
+    # bounds); lp_estimate is the master-LP value; lp_device_* come
+    # from the device dual ascent (solver/lp_device.py). null values
+    # mean the bound machinery was unavailable (scipy missing, LP
+    # degraded) — the solve itself still ran.
+    lp = sol.lp or {}
+    out["lp_lower_bound"] = (
+        round(lp["lower_bound"], 2) if "lower_bound" in lp else None
+    )
+    out["lp_estimate"] = (
+        round(lp["estimate"], 2) if "estimate" in lp else None
+    )
+    out["lp_device_bound"] = (
+        round(lp["device_bound"], 2) if "device_bound" in lp else None
+    )
+    out["lp_device_wall_s"] = lp.get("device_wall_s")
+    out["lp_trim_saved"] = lp.get("trim_saved")
+    out["gap_vs_lp"] = (
+        round(cost_price / lp["estimate"] - 1, 4)
+        if lp.get("estimate") else None
+    )
+    if bound_gap and lp.get("estimate"):
+        # the UNGUIDED baseline measured in the SAME run (same
+        # catalog, same demand, same machine): the dual-guidance
+        # acceptance — gap halved, p50 within 5% — is judged against
+        # these keys, not a previous round's artifact. The guidance
+        # knob is part of the race fingerprint, so the two arms cannot
+        # serve each other's cached floors or plans.
+        prev = os.environ.get("KARPENTER_LP_GUIDE")
+        os.environ["KARPENTER_LP_GUIDE"] = "0"
+        try:
+            solve(pods, pools, objective="cost")  # warm the unguided arm
+            unguided_samples = []
+            sol_u = None
+            for _ in range(max(2, min(6, repeats // 4)) if repeats > 1 else 1):
+                t0 = time.perf_counter()
+                sol_u = solve(pods, pools, objective="cost")
+                unguided_samples.append(time.perf_counter() - t0)
+        finally:
+            if prev is None:
+                os.environ.pop("KARPENTER_LP_GUIDE", None)
+            else:
+                os.environ["KARPENTER_LP_GUIDE"] = prev
+        u_price = float(sol_u.total_price)
+        u_est = (sol_u.lp or {}).get("estimate")
+        out["unguided_fleet_price_per_hr"] = round(u_price, 2)
+        out["unguided_p50_s"] = round(
+            sorted(unguided_samples)[len(unguided_samples) // 2], 3
+        )
+        if u_est:
+            out["gap_vs_lp_unguided"] = round(u_price / u_est - 1, 4)
+            if out["gap_vs_lp"] is not None and out["gap_vs_lp_unguided"] > 0:
+                out["guided_gap_ratio"] = round(
+                    max(out["gap_vs_lp"], 0.0) / out["gap_vs_lp_unguided"], 3
+                )
     return out
 
 

@@ -171,6 +171,18 @@ class DisruptionEngine:
     def _set_probe_cache(self, value: Optional[dict]) -> None:
         self._probe_tls.cache = value
 
+    def _get_probe_pruner(self):
+        return getattr(self._probe_tls, "pruner", None)
+
+    def _set_probe_pruner(self, value) -> None:
+        self._probe_tls.pruner = value
+
+    @staticmethod
+    def probe_pruning_enabled() -> bool:
+        return os.environ.get(
+            "KARPENTER_LP_PRUNE", "1"
+        ).lower() not in ("0", "false", "off")
+
     def batch_probes_enabled(self) -> bool:
         return os.environ.get(
             "KARPENTER_BATCH_PROBES", "1"
@@ -579,6 +591,30 @@ class DisruptionEngine:
     def compute_consolidation(
         self, candidates: list[Candidate]
     ) -> Optional[Command]:
+        # dual-based probe pruning (ISSUE 12): while a search ladder
+        # has a primed dual certificate, a candidate set whose pods'
+        # certified dual value exceeds its price — even after every
+        # other node's free capacity and the reservation budget absorb
+        # their share — cannot be replaced strictly cheaper, so the
+        # probe could only return None. Skipping it is
+        # decision-identical (weak duality, conservative margin) and
+        # saves the simulation outright.
+        pruner = self._get_probe_pruner()
+        if pruner is not None and self.probe_pruning_enabled():
+            try:
+                pruned = pruner.cannot_pay(candidates)
+            except Exception:
+                log.exception("probe pruning failed; probing")
+                pruned = False
+            if pruned:
+                from karpenter_tpu import tracing
+                from karpenter_tpu.metrics.store import SOLVER_PROBE_PRUNED
+
+                SOLVER_PROBE_PRUNED.inc()
+                tracing.add_event(
+                    "probe_pruned", candidates=len(candidates)
+                )
+                return None
         results, all_ok = self.simulate_scheduling(candidates)
         if not all_ok:
             return None
@@ -694,6 +730,7 @@ class DisruptionEngine:
             return None
         finally:
             self._set_probe_cache(None)
+            self._set_probe_pruner(None)
 
     def global_repack_consolidation(self, now: float) -> Optional[Command]:
         """One cost-objective re-solve of the whole candidate set — the
@@ -828,6 +865,7 @@ class DisruptionEngine:
             best = self._multi_node_search(candidates, deadline)
         finally:
             self._set_probe_cache(None)
+            self._set_probe_pruner(None)
         if best is not None and len(best.candidates) >= 2:
             if not self._same_type_guard(best):
                 return None
@@ -988,6 +1026,7 @@ class DisruptionEngine:
             return None
         finally:
             self._set_probe_cache(None)
+            self._set_probe_pruner(None)
 
     # -- controller loop (controller.go:121-176) -------------------------------
 
@@ -1103,6 +1142,9 @@ class _ProbePrimer:
             # host-ports / volume limits): probe sequentially
             self.dead = True
             return
+        # the staged union problem doubles as the dual-certificate
+        # pruner's input; the search's finally clears it with the cache
+        self.engine._set_probe_pruner(solver.pruner())
         cache = self.engine._get_probe_cache()
         if cache is None:
             return

@@ -254,6 +254,30 @@ SOLVER_STREAM_BLOCKS = REGISTRY.counter(
     "Per-shard column blocks shipped by the streaming staging path "
     "(solver/stream.py) — zero on a sharded fleet means every solve "
     "is still paying full-materialization host peaks")
+# device LP relaxation (solver/lp_device.py): the dual solve whose
+# certificates guide the cost pack, the trim pass, and probe pruning
+SOLVER_LP_DURATION = REGISTRY.histogram(
+    "karpenter_solver_lp_duration_seconds",
+    "Device LP dual-ascent wall clock per (non-cached) solve — the "
+    "guidance cost the gap_vs_lp reduction is bought with",
+    buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+             1, 2.5, 5, 10))
+SOLVER_LP_ITERATIONS = REGISTRY.histogram(
+    "karpenter_solver_lp_iterations",
+    "Projected-supergradient iterations per device LP solve "
+    "(KARPENTER_LP_ITERS)",
+    buckets=(8, 16, 32, 64, 128, 192, 256, 384, 512, 1024))
+SOLVER_LP_SOLVES = REGISTRY.counter(
+    "karpenter_solver_lp_total",
+    "Device LP solves, by outcome (converged / maxiter: ascent hit the "
+    "iteration cap still improving / cache_hit: certified duals reused "
+    "/ degraded: solve failed and the unguided path served)")
+SOLVER_PROBE_PRUNED = REGISTRY.counter(
+    "karpenter_solver_probe_pruned_total",
+    "Consolidation probes skipped because the dual certificate proved "
+    "the candidates cannot be replaced strictly cheaper "
+    "(decision-identical to probing: the simulation could only have "
+    "returned no command)")
 SOLVER_PROBE_BATCH = REGISTRY.counter(
     "karpenter_solver_probe_batch_total",
     "Batched consolidation probe activity: device dispatches (batch), "

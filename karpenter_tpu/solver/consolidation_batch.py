@@ -128,6 +128,39 @@ class LaneSolver:
         # the padded shapes of the last staging, for chaining floors
         self.last_shapes: dict[str, int] = {}
         self._idx = {inp.name: i for i, inp in enumerate(self.inputs)}
+        # the last staged union encode + pod->group map (solve_lazy
+        # fills them) — the dual-certificate pruner reads both
+        self.last_enc = None
+        self.last_gi_by_key: dict[str, int] = {}
+        self._certificate = None
+
+    def dual_certificate(self):
+        """Lazy DualCertificate over the last staged union encode —
+        the weak-duality pruner the engine consults before simulating
+        a candidate subset (solver/lp_device.py). None when guidance
+        is off, nothing is staged yet, or the LP degraded. The
+        degraded outcome is memoized as False until the next staging:
+        a search ladder probes its certificate once per candidate
+        subset, and re-attempting a persistently-failing LP per probe
+        would turn the pruning fast-path into repeated wasted work
+        (and per-probe metric/log spam)."""
+        if self._certificate is not None:
+            return self._certificate or None
+        from karpenter_tpu.solver import lp_device
+
+        if self.last_enc is None or not lp_device.enabled():
+            return None
+        dlp = lp_device.maybe_solve(self.last_enc)
+        if dlp is None:
+            self._certificate = False  # degraded: don't retry this staging
+            return None
+        try:
+            self._certificate = lp_device.DualCertificate(self.last_enc, dlp)
+        except Exception:
+            log.exception("dual certificate build failed; not pruning")
+            self._certificate = False
+            return None
+        return self._certificate
 
     def knows(self, name: str) -> bool:
         return name in self._idx
@@ -188,6 +221,11 @@ class LaneSolver:
             reserved_in_use=self.reserved_in_use,
             compat_cache=self.compat_cache,
         )
+        # expose the staged problem to the dual-certificate pruner
+        # (certificate invalidated: it is a function of this encode)
+        self.last_enc = enc
+        self.last_gi_by_key = gi_by_key
+        self._certificate = None
 
         # the staging below intentionally omits the bound_quota /
         # group_cap forwarding pack._run_pack does — probe-path encodes
@@ -550,6 +588,45 @@ class LaneSolver:
         return [make_thunk(li) for li in range(L)]
 
 
+class ProbePruner:
+    """Dual-based pruning of the consolidation probe ladder (ISSUE
+    12): before the engine simulates a candidate subset, ask the
+    lane solver's DualCertificate whether the subset can possibly be
+    replaced strictly cheaper. Weak duality makes the answer
+    conservative-exact — a pruned probe could only have returned "no
+    command" — so pruning is decision-identical to the unpruned
+    ladder (oracle-enforced, tests/test_lp_prune.py). Any gap in the
+    certificate (unknown node, pod outside the staged union, LP
+    degraded) returns False and the probe runs as before."""
+
+    def __init__(self, lane_solver: LaneSolver):
+        self.lane_solver = lane_solver
+
+    def cannot_pay(self, candidates) -> bool:
+        ls = self.lane_solver
+        cert = ls.dual_certificate()
+        if cert is None or ls.last_enc is None:
+            return False
+        gi = ls.last_gi_by_key
+        demand = np.zeros(ls.last_enc.compat.shape[0], np.int64)
+        rows: list[int] = []
+        current_price = 0.0
+        for c in candidates:
+            name = c.state_node.name
+            if not ls.knows(name):
+                return False
+            rows.append(ls._idx[name])
+            current_price += float(c.price)
+            for p in c.reschedulable_pods:
+                g = gi.get(p.key)
+                if g is None:
+                    return False
+                demand[g] += 1
+        if current_price <= 0:
+            return False
+        return cert.cannot_pay(demand, rows, current_price)
+
+
 def _relaxable(pod: Pod) -> bool:
     """True when preferences.relax() would strip something — the
     sequential path retries such pods, so a batched lane that left one
@@ -638,6 +715,11 @@ class BatchProbeSolver:
                         break
             if rid:
                 self._reserved_nodes.add(_state_node_key(node))
+
+    def pruner(self) -> ProbePruner:
+        """The dual-certificate pruner over this batch's staged union
+        problem (valid once prime() has staged it)."""
+        return ProbePruner(self.lane_solver)
 
     def usable(self) -> bool:
         """False when the sequential path would not run the in-process

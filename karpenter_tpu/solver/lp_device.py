@@ -1,0 +1,672 @@
+"""Device-resident LP relaxation of the packing problem, and the dual
+machinery spent on it (ISSUE 12).
+
+`lp_plan` solves the Gilmore-Gomory master on the HOST with scipy —
+fine for planning, but its duals arrive late and its wall is budgeted
+in seconds. This module solves a *config-level* LP relaxation of the
+same packing problem ON DEVICE as dense linear algebra (CvxCluster,
+"Cloud Resource Allocation with Convex Optimization" — PAPERS.md):
+
+    min  sum_c price[c] * y[c]
+    s.t. sum_c x[g,c]           >= count[g]          (demand)
+         sum_g req[g,r] x[g,c]  <= alloc[c,r] y[c]   (capacity)
+         sum_{c in slot k} y[c] <= rsv_cap[k]        (reservations)
+         x, y >= 0, x[g,c] = 0 where incompatible
+
+via projected supergradient ascent on its DUAL: maximize
+
+    bound(lam) = lam'.count - sum_k rsv_cap[k] * mu_k(lam')
+
+where lam' = lam / theta(lam) is the Farley-scaled demand dual,
+theta(lam) = max over uncapped configs of Vhat_c(lam)/price_c, and
+Vhat_c is a closed-form per-config UPPER bound on the fractional
+knapsack value max{lam.q : q.req <= alloc_c, q compatible}:
+
+    Vhat_c = min over valid r of (max_g lam_g/req[g,r]) * alloc[c,r]
+
+(r is valid for c when every live compatible group consumes it — the
+'pods' axis always qualifies, so the min is never empty). Scaling by
+theta makes lam' dual-feasible for every UNCAPPED config; capped
+(reserved) configs may exceed their near-zero price, and the per-slot
+cap dual mu_k = max_{c in k} relu(Vhat_c(lam') - price_c) buys that
+excess back against the reservation budget. The ascent runs as ONE
+jitted fori_loop (shape-bucketed so steady-state shapes share a
+compiled program); the OPTIMIZER is float32 on device, but the
+certificate — bound, scaled duals, cap duals — is recomputed on the
+host in float64 from the best iterate, so validity never rests on
+accelerator arithmetic.
+
+The duals are spent three ways (see solver.solve_encoded and
+disruption/engine.py):
+
+- **price-guided ordering** (`rank_prices`): a dual-adjusted
+  reduced-cost penalty on configs the LP says are over-priced, fed to
+  `pack_split`/`pack_split_wavefront` as the type-preference ranking.
+  Ordering is an INPUT (the kernel's cfg_price operand); the kernel
+  body is untouched and decode always prices nodes from the true
+  `enc.cfg_price`, so the bit-identical decode contract holds. The
+  ranked pack races the unguided arms and the cheapest fleet wins —
+  never-worse by construction.
+- **dual-guided trimming** (solver._trim_undervalued): duals certify
+  which packed nodes hold less value than they cost
+  (lam'.assign < price); those donors are emptied into the rest of the
+  fleet's headroom and re-fitted onto cheaper machines. This is where
+  the integrality gap actually closes (measured: gap_vs_lp 6.5% ->
+  0.3% on reserved_50k, 1.4% -> 0.2% on hetero_10k).
+- **probe pruning** (`DualCertificate.cannot_pay`): weak duality
+  bounds any repack's launch cost from below; a consolidation probe
+  whose candidates' dual value exceeds their price even after every
+  other node's free capacity and the reservation budget absorb their
+  share CANNOT produce a cheaper replacement, so the engine skips the
+  probe. The bound is conservative (valid lam', float64, margin knob),
+  so pruning is decision-identical to the unpruned ladder —
+  oracle-enforced by tests/test_lp_prune.py.
+
+Priority (ISSUE 8 follow-up): `Encoded.group_priority` is folded into
+the ASCENT objective — demand is weighted by resolved PriorityClass
+value, so the guidance duals price priority, not just dollars — while
+the reported bound is always recomputed unweighted (a weighted
+"bound" would certify nothing).
+
+Resilience: the LP is advisory. Any failure or unconverged solve
+degrades to the unguided path (`maybe_solve` returns None, counted in
+karpenter_solver_lp_total{outcome="degraded"}) and can never block a
+tick; the packing solve underneath keeps riding the resilience
+ladder unchanged.
+
+Knobs: KARPENTER_LP_GUIDE (default on; 0 disables guidance + trim +
+rank), KARPENTER_LP_ITERS (ascent iterations, default 192),
+KARPENTER_LP_RANK_BETA (reduced-cost penalty weight, default 1.0),
+KARPENTER_LP_PRUNE_MARGIN (pruning safety margin, default 0.05),
+KARPENTER_LP_PRIORITY_WEIGHT (priority fold strength, default 0.25),
+KARPENTER_LP_SHARDS (mesh the ascent over the config axis; default 0
+= single device — the tensors are [G, C, R] and tiny even at
+million-pod demand, so sharding is an opt-in for mesh-resident
+deployments, not a memory need).
+"""
+
+from __future__ import annotations
+
+import functools
+import hashlib
+import logging
+import os
+import threading
+import time
+from dataclasses import dataclass
+
+import numpy as np
+
+from karpenter_tpu.solver.encode import Encoded
+# one canonical copy each (PR-7 deduped these once already): env
+# parsing from the resilience/incremental modules, shape buckets from
+# lp_plan — the padding growth curve decides warm-bucket matching and
+# must never fork per module
+from karpenter_tpu.solver.lp_plan import _pad_to
+from karpenter_tpu.solver.resilience import _env_int
+
+log = logging.getLogger("karpenter.solver.lp")
+
+_EPS = 1e-12
+
+
+def enabled() -> bool:
+    return os.environ.get("KARPENTER_LP_GUIDE", "1").strip().lower() not in (
+        "0", "off", "false", "no",
+    )
+
+
+def _env_float(name: str, default: float) -> float:
+    from karpenter_tpu.solver.incremental import _env_float as _impl
+
+    return _impl(name, default)
+
+
+def iters() -> int:
+    return max(8, _env_int("KARPENTER_LP_ITERS", 192))
+
+
+def rank_beta() -> float:
+    return max(0.0, _env_float("KARPENTER_LP_RANK_BETA", 1.0))
+
+
+def prune_margin() -> float:
+    return max(0.0, _env_float("KARPENTER_LP_PRUNE_MARGIN", 0.05))
+
+
+def lp_shards() -> int:
+    return max(0, _env_int("KARPENTER_LP_SHARDS", 0))
+
+
+def _cap_rows(k: int) -> int:
+    """Reservation-slot row bucket for the ascent's onehot/budget
+    inputs: 1 for cap-free problems, else 64/512/... — a tiny family
+    so the warm pool can precompile the shapes real solves hit."""
+    if k <= 0:
+        return 1
+    out = 64
+    while out < k:
+        out *= 8
+    return out
+
+
+@dataclass
+class DeviceLP:
+    """One certified dual solve of the packing relaxation."""
+
+    lam: np.ndarray          # [G] float64 Farley-scaled demand duals —
+                             # dual-feasible: lam.q <= price_c for every
+                             # feasible fill of every uncapped config
+    mu: np.ndarray           # [K] float64 reservation-cap duals (>= 0)
+    lower_bound: float       # float64-certified: lam.count - cap.mu
+    theta: float             # the Farley scaling actually applied
+    vhat: np.ndarray         # [C] float64 per-config value upper bound
+                             # at lam (launchable cols; 0 elsewhere)
+    lam_guide: np.ndarray    # [G] float64 priority-weighted guidance
+                             # duals (== lam when priorities uniform)
+    iterations: int
+    converged: bool
+    wall_s: float
+    cache_hit: bool = False
+
+
+# fingerprint -> DeviceLP (LRU, oldest evicted). The LP is a pure
+# function of the encoded arrays + knobs, so steady-state solves and
+# repeated probe ladders pay the ascent once per problem shape.
+_cache: dict[bytes, DeviceLP] = {}
+_cache_lock = threading.Lock()
+_CACHE_ENTRIES = 16
+
+
+def _fingerprint(enc: Encoded) -> bytes:
+    h = hashlib.blake2b(digest_size=16)
+    for buf in (
+        enc.group_count, enc.group_req, enc.cfg_price, enc.cfg_alloc,
+        np.ascontiguousarray(enc.compat), enc.cfg_pool, enc.pool_overhead,
+    ):
+        h.update(np.ascontiguousarray(buf).tobytes())
+    for opt in (enc.cfg_rsv, enc.rsv_cap, enc.group_priority):
+        h.update(
+            b"\x00" if opt is None else np.ascontiguousarray(opt).tobytes()
+        )
+    h.update(
+        f"{iters()}|{_env_float('KARPENTER_LP_PRIORITY_WEIGHT', 0.25)}"
+        .encode()
+    )
+    return h.digest()
+
+
+@functools.partial(
+    __import__("jax").jit, static_argnames=("n_iters",)
+)
+def _ascend(lam0, count, count_w, compat, req, alloc, price, valid_r,
+            cap_onehot, cap_budget, uncapped, n_iters):
+    """Projected supergradient ascent, all iterations in one device
+    program. Maximizes the PRIORITY-WEIGHTED dual bound; tracks the
+    best iterate by the weighted objective (the host re-certifies the
+    returned iterate unweighted in float64)."""
+    import jax
+    import jax.numpy as jnp
+
+    safe_req = jnp.where(req > 0, req, 1.0)
+    live = count > 0
+
+    def vhat_of(lam):
+        ratio = jnp.where(
+            (req > 0) & live[:, None], lam[:, None] / safe_req, 0.0
+        )                                                     # [G, R]
+        mm = jnp.max(
+            jnp.where(compat[:, :, None], ratio[:, None, :], 0.0), axis=0
+        )                                                     # [C, R]
+        v = jnp.where(valid_r, mm * alloc, jnp.inf)
+        vh = jnp.min(v, axis=1)
+        return jnp.where(jnp.isfinite(vh), vh, 0.0)           # [C]
+
+    def bound_w(lam):
+        vh = vhat_of(lam)
+        theta = jnp.max(
+            jnp.where(uncapped & (price > 0), vh / jnp.maximum(price, _EPS),
+                      0.0)
+        )
+        theta = jnp.maximum(theta, _EPS)
+        lam_s = lam / theta
+        excess = jnp.clip(vh / theta - price, 0.0, None)      # [C]
+        mu = jnp.max(
+            jnp.where(cap_onehot, excess[None, :], 0.0), axis=1
+        )                                                     # [K]
+        return lam_s @ count_w - mu @ cap_budget
+
+    grad = jax.grad(bound_w)
+
+    def step(t, state):
+        lam, best, best_lam, last_up = state
+        g = grad(lam)
+        gn = g / jnp.maximum(jnp.linalg.norm(g), _EPS)
+        eta = 0.5 / jnp.sqrt(1.0 + t)
+        lam2 = jnp.clip(
+            lam + eta * gn * jnp.maximum(jnp.max(lam), 1e-9), 0.0, None
+        )
+        b = bound_w(lam2)
+        better = b > best
+        return (
+            lam2,
+            jnp.where(better, b, best),
+            jnp.where(better, lam2, best_lam),
+            jnp.where(better, t, last_up),
+        )
+
+    b0 = bound_w(lam0)
+    _, best, best_lam, last_up = __import__("jax").lax.fori_loop(
+        0, n_iters, step, (lam0, b0, lam0, jnp.int32(-1))
+    )
+    return best, best_lam, last_up
+
+
+def _certify(lam, count, compat, req, alloc, price, valid_r, cap_slot,
+             cap_budget):
+    """Host float64 re-derivation of (theta, lam', mu, bound) from a
+    candidate lam — the returned numbers are valid by construction,
+    independent of how well (or on what hardware) the ascent did."""
+    lam = np.clip(np.asarray(lam, np.float64), 0.0, None)
+    live = count > 0
+    safe_req = np.where(req > 0, req, 1.0)
+    ratio = np.where((req > 0) & live[:, None], lam[:, None] / safe_req, 0.0)
+    mm = np.max(
+        np.where(compat[:, :, None], ratio[:, None, :], 0.0), axis=0
+    )
+    with np.errstate(invalid="ignore"):
+        v = np.where(valid_r, mm * alloc, np.inf)
+    vh = np.min(v, axis=1)
+    vh = np.where(np.isfinite(vh), vh, 0.0)
+    uncapped = cap_slot < 0
+    theta = float(
+        np.max(
+            np.where(uncapped & (price > 0), vh / np.maximum(price, _EPS),
+                     0.0),
+            initial=0.0,
+        )
+    )
+    theta = max(theta, _EPS)
+    lam_s = lam / theta
+    vh_s = vh / theta
+    K = len(cap_budget)
+    mu = np.zeros(K, np.float64)
+    if K:
+        excess = np.clip(vh_s - price, 0.0, None)
+        for k in range(K):
+            sel = cap_slot == k
+            if sel.any():
+                mu[k] = float(excess[sel].max())
+    bound = float(lam_s @ count - mu @ cap_budget)
+    return lam_s, mu, vh_s, theta, max(bound, 0.0)
+
+
+def _stage(enc: Encoded):
+    """Launch-masked, padded float32 staging for the ascent kernel plus
+    the float64 host copies the certificate is computed from."""
+    G, C = enc.compat.shape
+    R = enc.group_req.shape[1]
+    launch = enc.cfg_pool >= 0
+    eff = enc.cfg_alloc - enc.pool_overhead[np.maximum(enc.cfg_pool, 0)]
+    eff = np.where(launch[:, None], np.clip(eff, 0.0, None), 0.0)
+    price = np.where(launch, enc.cfg_price, 0.0).astype(np.float64)
+    compat = enc.compat & launch[None, :]
+    cap_slot = (
+        enc.cfg_rsv.astype(np.int64)
+        if enc.cfg_rsv is not None
+        else np.full(C, -1, np.int64)
+    )
+    cap_slot = np.where(launch, cap_slot, -1)
+    cap_budget = (
+        enc.rsv_cap.astype(np.float64)
+        if enc.rsv_cap is not None
+        else np.zeros(0, np.float64)
+    )
+    # per-(group, config) single-node capacity: seeds the ascent AND
+    # derives the plannable mask — groups no launchable machine can
+    # hold even one pod of are excluded from the priced demand, like
+    # lp_plan's master (covering them is infeasible, so their duals
+    # would grow without bound and certify nothing)
+    safe = np.where(enc.group_req > 0, enc.group_req, 1.0)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        k = np.floor((eff[None, :, :] + 1e-4) / safe[:, None, :])
+    k = np.where(enc.group_req[:, None, :] > 0, k, np.inf).min(axis=2)
+    k = np.where(compat, k, 0.0)
+    plannable = np.asarray(k >= 1).any(axis=1)
+    count = np.where(plannable, enc.group_count, 0).astype(np.float64)
+    live = count > 0
+    with np.errstate(divide="ignore", invalid="ignore"):
+        ppp = np.where(k >= 1, price[None, :] / np.maximum(k, 1.0), np.inf)
+    ppp = ppp.min(axis=1)
+    lam0 = np.where(np.isfinite(ppp) & live, ppp, 0.0)
+    # r valid for c <=> every live compatible group consumes r (the
+    # pods axis always does); invalid axes cannot upper-bound the
+    # fill. Zero-capacity axes stay VALID — ratio x 0 = 0 is exactly
+    # the right bound for a machine with none of a resource every
+    # candidate pod needs (excluding them would let the min escape to
+    # a slack axis and wildly overestimate the fill)
+    reqpos = enc.group_req > 0
+    bad = (compat & live[:, None])[:, :, None] & ~reqpos[:, None, :]
+    valid_r = ~bad.any(axis=0)
+    # priority weights: resolved PriorityClass folded into the ascent
+    # objective so the guidance duals price priority, not just dollars
+    w = np.ones(G, np.float64)
+    if enc.group_priority is not None and np.any(enc.group_priority != 0):
+        pw = _env_float("KARPENTER_LP_PRIORITY_WEIGHT", 0.25)
+        scale = float(np.max(np.abs(enc.group_priority)))
+        if scale > 0 and pw > 0:
+            w = np.clip(
+                1.0 + pw * enc.group_priority.astype(np.float64) / scale,
+                0.05, None,
+            )
+    return dict(
+        G=G, C=C, R=R, count=count, count_w=count * w, compat=compat,
+        req=enc.group_req.astype(np.float64), alloc=eff.astype(np.float64),
+        price=price, valid_r=valid_r, cap_slot=cap_slot,
+        cap_budget=cap_budget, lam0=lam0, weights=w,
+    )
+
+
+def solve(enc: Encoded, shards: int = 0) -> DeviceLP:
+    """Run (or reuse) the device dual ascent for this encode. Raises on
+    failure — use `maybe_solve` for the degrading entry point."""
+    import jax.numpy as jnp
+
+    from karpenter_tpu import tracing
+    from karpenter_tpu.metrics.store import (
+        SOLVER_LP_DURATION,
+        SOLVER_LP_ITERATIONS,
+        SOLVER_LP_SOLVES,
+    )
+
+    fp = _fingerprint(enc)
+    with _cache_lock:
+        hit = _cache.get(fp)
+    if hit is not None:
+        SOLVER_LP_SOLVES.inc({"outcome": "cache_hit"})
+        return hit
+
+    t0 = time.perf_counter()
+    with tracing.span("solve.lp") as sp:
+        st = _stage(enc)
+        G, C, R = st["G"], st["C"], st["R"]
+        shards = shards or lp_shards()
+        Gp, Cp = _pad_to(G), _pad_to(C)
+        if shards > 1:
+            # the config axis must split evenly over the mesh — a
+            # non-divisible device_put is a hard ValueError, not a
+            # performance detail (same rule as pack._run_pack)
+            Cp = -(-Cp // shards) * shards
+        K = len(st["cap_budget"])
+        Kp = _cap_rows(K)
+
+        compat_p = np.zeros((Gp, Cp), bool)
+        compat_p[:G, :C] = st["compat"]
+        req_p = np.zeros((Gp, R), np.float32)
+        req_p[:G] = st["req"]
+        alloc_p = np.zeros((Cp, R), np.float32)
+        alloc_p[:C] = st["alloc"]
+        price_p = np.zeros(Cp, np.float32)
+        price_p[:C] = st["price"]
+        valid_p = np.zeros((Cp, R), bool)
+        valid_p[:C] = st["valid_r"]
+        count_p = np.zeros(Gp, np.float32)
+        count_p[:G] = st["count"]
+        countw_p = np.zeros(Gp, np.float32)
+        countw_p[:G] = st["count_w"]
+        lam0_p = np.zeros(Gp, np.float32)
+        lam0_p[:G] = st["lam0"]
+        slot_p = np.full(Cp, -1, np.int64)
+        slot_p[:C] = st["cap_slot"]
+        # reservation-slot rows padded to a tiny shape family (1 when
+        # cap-free, else 64/512/...) so the jit signature — which keys
+        # on the onehot/budget SHAPES — matches what the warm pool
+        # compiled; padding rows are all-false/zero and contribute 0
+        onehot = np.zeros((Kp, Cp), bool)
+        for ki in range(K):
+            onehot[ki] = slot_p == ki
+        budget_p = np.zeros(Kp, np.float32)
+        budget_p[:K] = st["cap_budget"]
+        uncapped_p = (slot_p < 0) & (price_p > 0)
+
+        n_iters = iters()
+        args = [
+            jnp.asarray(lam0_p), jnp.asarray(count_p), jnp.asarray(countw_p),
+            jnp.asarray(compat_p), jnp.asarray(req_p), jnp.asarray(alloc_p),
+            jnp.asarray(price_p), jnp.asarray(valid_p), jnp.asarray(onehot),
+            jnp.asarray(budget_p), jnp.asarray(uncapped_p),
+        ]
+        if shards > 1:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from karpenter_tpu.solver.pack import _mesh, visible_devices
+
+            if shards <= visible_devices(1):
+                import jax as _jax
+
+                mesh = _mesh(shards)
+                spec = {
+                    3: P(None, "cfg"), 5: P("cfg", None), 6: P("cfg"),
+                    7: P("cfg", None), 8: P(None, "cfg"), 10: P("cfg"),
+                }
+                args = [
+                    _jax.device_put(a, NamedSharding(mesh, spec.get(i, P())))
+                    for i, a in enumerate(args)
+                ]
+        best_w, best_lam, last_up = _ascend(*args, n_iters=n_iters)
+        lam_raw = np.asarray(best_lam, np.float64)[:G]
+        converged = int(last_up) < (n_iters * 3) // 4
+
+        # float64 certificate from the best iterate (validity never
+        # rests on the float32 device arithmetic)
+        lam_s, mu, vh_s, theta, bound = _certify(
+            lam_raw, st["count"], st["compat"], st["req"], st["alloc"],
+            st["price"], st["valid_r"], st["cap_slot"], st["cap_budget"],
+        )
+        wall = time.perf_counter() - t0
+        out = DeviceLP(
+            lam=lam_s,
+            mu=mu,
+            lower_bound=bound,
+            theta=theta,
+            vhat=vh_s,
+            lam_guide=lam_s * st["weights"],
+            iterations=n_iters,
+            converged=converged,
+            wall_s=wall,
+            cache_hit=False,
+        )
+        sp.annotate(groups=G, configs=C, iterations=n_iters,
+                    converged=converged)
+        SOLVER_LP_DURATION.observe(wall)
+        SOLVER_LP_ITERATIONS.observe(n_iters)
+        SOLVER_LP_SOLVES.inc(
+            {"outcome": "converged" if converged else "maxiter"}
+        )
+    with _cache_lock:
+        _cache.pop(fp, None)
+        while len(_cache) >= _CACHE_ENTRIES:
+            _cache.pop(next(iter(_cache)))
+        _cache[fp] = out
+    return out
+
+
+def maybe_solve(enc: Encoded, shards: int = 0):
+    """The degrading entry: None when guidance is disabled, the
+    problem is degenerate, or the solve failed — callers then run the
+    exact unguided path they ran before this module existed. An LP
+    hiccup is advisory-only and must never block a tick (the packing
+    solve underneath still rides the resilience ladder)."""
+    if not enabled():
+        return None
+    if enc.compat.shape[0] == 0 or not (enc.cfg_pool >= 0).any():
+        return None
+    try:
+        return solve(enc, shards=shards)
+    except Exception as err:
+        from karpenter_tpu.metrics.store import SOLVER_LP_SOLVES
+
+        SOLVER_LP_SOLVES.inc({"outcome": "degraded"})
+        log.warning("device LP degraded to unguided path: %s", err)
+        return None
+
+
+def rank_prices(enc: Encoded, dlp: DeviceLP,
+                beta: float | None = None) -> np.ndarray:
+    """Dual-adjusted reduced-cost ranking of the launchable configs,
+    expressed in the packer's native ordering input — a price vector.
+    Configs the LP deems over-priced (price above their dual value)
+    are penalized by their reduced cost, steering the kernel's
+    cost-mode opens toward LP-efficient machines; under-priced configs
+    keep their true price. Decode never sees this vector (node prices
+    always come from enc.cfg_price), and the ranked pack only ever
+    RACES the unguided arms, so the result is never worse."""
+    beta = rank_beta() if beta is None else beta
+    launch = enc.cfg_pool >= 0
+    price = enc.cfg_price.astype(np.float64)
+    vh = dlp.vhat
+    # priority fold: value configs by the guidance duals' scale
+    # (value comparison, not object identity — lam_guide is always a
+    # fresh array; with uniform priorities the scale is exactly 1.0)
+    if len(dlp.lam) and np.max(dlp.lam) > 0:
+        scale = np.max(dlp.lam_guide) / np.max(dlp.lam)
+        if scale != 1.0:
+            vh = vh * max(scale, _EPS)
+    rc = np.clip(price - vh, 0.0, None)
+    out = np.where(launch, price + beta * rc, price)
+    return out.astype(np.float32)
+
+
+class DualCertificate:
+    """Weak-duality machinery for consolidation probe pruning.
+
+    Built from one encode of the probe problem (the LaneSolver's union
+    encode): `lam` is dual-feasible for every uncapped launchable
+    config, `mu`/cap budgets buy back reserved configs' excess, and
+    `absorb[e]` upper-bounds the dual value existing node e's free
+    capacity could host. For a candidate set S with pod demand d:
+
+        launch_cost(any repack of d without S)
+            >= lam.d - sum_{e not in S} absorb[e] - cap.mu
+
+    so when that bound meets the candidates' current price (plus the
+    safety margin), no strictly-cheaper replacement exists and the
+    probe can only return None — skipping it is decision-identical.
+    """
+
+    def __init__(self, enc: Encoded, dlp: DeviceLP):
+        self.lam = dlp.lam
+        self.cap_term = float(
+            dlp.mu @ (enc.rsv_cap.astype(np.float64)
+                      if enc.rsv_cap is not None and enc.rsv_cap.size
+                      else np.zeros(0))
+        ) if len(dlp.mu) else 0.0
+        G, C = enc.compat.shape
+        live = enc.group_count > 0
+        req = enc.group_req.astype(np.float64)
+        safe_req = np.where(req > 0, req, 1.0)
+        ratio = np.where(
+            (req > 0) & live[:, None], self.lam[:, None] / safe_req, 0.0
+        )
+        # per existing column: the same closed-form value bound as the
+        # LP, over the node's remaining allocatable — ONE batched
+        # [G, E, R] computation, not a Python loop (a probe batch
+        # stages the whole fleet as existing rows; thousands of
+        # per-node numpy passes would cost the very seconds the pruner
+        # exists to save)
+        self.absorb: dict[int, float] = {}
+        ex_cols = np.array(
+            [ci for ci in np.flatnonzero(enc.cfg_pool < 0)
+             if enc.configs[ci].existing_index >= 0],
+            dtype=np.int64,
+        )
+        total = 0.0
+        if ex_cols.size:
+            ex_idx = np.array(
+                [enc.configs[ci].existing_index for ci in ex_cols]
+            )
+            compat_e = enc.compat[:, ex_cols] & live[:, None]   # [G, E]
+            alloc_e = np.clip(
+                enc.cfg_alloc[ex_cols].astype(np.float64), 0.0, None
+            )                                                   # [E, R]
+            reqpos = req > 0                                    # [G, R]
+            # zero-capacity axes stay valid: an exhausted axis every
+            # candidate pod needs bounds the node's absorbable value
+            # at exactly 0 (see _stage's valid_r note)
+            bad = np.einsum(
+                "ge,gr->er", compat_e, (~reqpos).astype(np.float64)
+            ) > 0                                               # [E, R]
+            mm = np.max(
+                np.where(compat_e[:, :, None], ratio[:, None, :], 0.0),
+                axis=0,
+            )                                                   # [E, R]
+            with np.errstate(invalid="ignore"):
+                v = np.where(~bad, mm * alloc_e, np.inf)
+            vals = np.min(v, axis=1)
+            vals = np.where(np.isfinite(vals), np.clip(vals, 0.0, None), 0.0)
+            any_compat = compat_e.any(axis=0)
+            vals = np.where(any_compat, vals, 0.0)
+            for ei, val in zip(ex_idx.tolist(), vals.tolist()):
+                self.absorb[ei] = val
+            total = float(vals.sum())
+        self.absorb_total = total
+
+    def cannot_pay(
+        self,
+        demand: np.ndarray,          # [G] pod counts of the candidates
+        candidate_rows: list[int],   # existing_index of each candidate
+        current_price: float,
+        margin: float | None = None,
+    ) -> bool:
+        margin = prune_margin() if margin is None else margin
+        absorb_rest = self.absorb_total - sum(
+            self.absorb.get(r, 0.0) for r in set(candidate_rows)
+        )
+        floor = (
+            float(self.lam @ demand.astype(np.float64))
+            - max(absorb_rest, 0.0)
+            - self.cap_term
+        )
+        return floor >= current_price * (1.0 + margin) + 1e-9
+
+
+def warm(shapes) -> int:
+    """AOT-compile the ascent for (G, C, R) shape buckets — called by
+    the warm pool so the first guided solve of a warmed bucket skips
+    the XLA trace. Returns the number of programs compiled."""
+    import jax.numpy as jnp
+
+    n = 0
+    n_iters = iters()
+    for G, C, R in shapes:
+        Gp, Cp = _pad_to(G), _pad_to(C)
+        # both cap-row variants real solves can hit: 1 (no
+        # reservations) and the first bucket (up to 64 reservation
+        # slots) — the jit signature keys on these SHAPES
+        for Kp in (1, _cap_rows(1)):
+            try:
+                _ascend(
+                    jnp.zeros(Gp, jnp.float32),
+                    jnp.zeros(Gp, jnp.float32),
+                    jnp.zeros(Gp, jnp.float32),
+                    jnp.zeros((Gp, Cp), bool),
+                    jnp.zeros((Gp, R), jnp.float32),
+                    jnp.zeros((Cp, R), jnp.float32),
+                    jnp.zeros(Cp, jnp.float32),
+                    jnp.zeros((Cp, R), bool),
+                    jnp.zeros((Kp, Cp), bool),
+                    jnp.zeros(Kp, jnp.float32),
+                    jnp.zeros(Cp, bool),
+                    n_iters=n_iters,
+                )
+                n += 1
+            except Exception as err:  # pragma: no cover - defensive
+                log.debug("lp warm compile failed for %s: %s", (G, C, R),
+                          err)
+    return n
+
+
+def reset() -> None:
+    """Test hook: drop the dual-solve cache."""
+    with _cache_lock:
+        _cache.clear()

@@ -465,6 +465,14 @@ def pack_split(
 ):
     """`pack` with the node axis SPLIT by config breadth.
 
+    `cfg_price` is the kernel's TYPE-PREFERENCE input, not just a
+    decode artifact: cost-mode opens argmin over it, so callers may
+    feed a dual-adjusted ranking (solver/lp_device.rank_prices) to
+    steer opens toward LP-efficient configs — the kernel body is
+    identical, ordering is data, and decode always re-prices nodes
+    from the encode's true prices (ISSUE 12's bit-identical decode
+    contract).
+
     Existing and LP-planned nodes are one-hot — each holds exactly one
     (pseudo-)config column — so their per-group capacity is a dense
     [B, R] computation against a pre-gathered alloc vector, NOT a slice

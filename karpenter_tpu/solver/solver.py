@@ -296,7 +296,25 @@ def _decode_device(
     # state is O(N x C) bools + O(N x G) ints (~100MB even at a 50k
     # node axis), against >=16GB of HBM — three orders of magnitude of
     # headroom, so no size gate is needed.
-    from karpenter_tpu.solver import lp_plan
+    #
+    # Dual guidance (ISSUE 12, KARPENTER_LP_GUIDE): the device LP
+    # relaxation (solver/lp_device.py) contributes, when healthy:
+    # (a) a third COLD race arm — the planned pack re-dispatched with
+    #     dual-adjusted reduced-cost ranking as the kernel's price
+    #     input (ordering is an input; kernel body unchanged; decode
+    #     prices from the true enc.cfg_price) — strictly additive, so
+    #     the race result is never worse than unguided;
+    # (b) the dual-guided trim post-pass on the winner
+    #     (_trim_undervalued below) — this is where the integrality
+    #     gap actually closes — applied AFTER the race keys and the
+    #     recorded FFD floor, so selection semantics are unchanged;
+    # (c) a certified lower bound reported on Solution.lp.
+    # LP failure degrades to exactly the unguided path (maybe_solve
+    # returns None); warm steady-state solves re-run only the winning
+    # arm, so the p50 wall stays that of one kernel dispatch.
+    from dataclasses import replace as _replace
+
+    from karpenter_tpu.solver import lp_device, lp_plan
 
     def key(item):
         # Only nodes that actually hold pods count: pre-opened planned
@@ -320,17 +338,57 @@ def _decode_device(
     fp = _race_fingerprint(enc)
     floor = _ffd_floor.get(fp)
     plan = None
-    cost_tuple = None
+    cost_tuple = None  # (result, masks, arm)
+    # NOTE: the LP deliberately does NOT inherit the pack's shard
+    # count — its tensors are tiny at any fleet size, and an unsharded
+    # ascent keeps the duals identical across pack shard counts (the
+    # sharded-equality contracts). KARPENTER_LP_SHARDS is the opt-in.
+    dlp = lp_device.maybe_solve(enc)
+
+    def arm_enc(arm: str) -> Encoded:
+        """The encode an arm's KERNEL sees. The rank arm feeds the
+        dual-adjusted type-preference ranking through the kernel's
+        cfg_price input — ordering is an input, the kernel body is
+        unchanged — while every decode/key/merge site in this function
+        keeps reading the true prices from the original `enc`."""
+        if arm == "rank" and dlp is not None:
+            return _replace(enc, cfg_price=lp_device.rank_prices(enc, dlp))
+        return enc
+
+    def guide_lam():
+        if plan is not None and plan.duals is not None:
+            return plan.duals
+        return dlp.lam_guide if dlp is not None else None
+
+    def lp_info(trim_saved: float):
+        if plan is None and dlp is None:
+            return None
+        info: dict = {"guided": dlp is not None,
+                      "trim_saved": round(float(trim_saved), 6)}
+        if plan is not None:
+            info["lower_bound"] = plan.lower_bound
+            info["estimate"] = plan.objective_estimate
+        if dlp is not None:
+            info["device_bound"] = dlp.lower_bound
+            info["device_wall_s"] = round(dlp.wall_s, 6)
+            info["device_iterations"] = dlp.iterations
+            info["device_converged"] = dlp.converged
+            info.setdefault("lower_bound", dlp.lower_bound)
+        return info
+
     if floor is not None:
         plan = _plan_for(fp, enc)
         if plan is not None:
+            arm = _warm_arm.get(fp, "cost")
             cost_result = _solve_packing(
-                enc, mode="cost", plan=plan, shards=shards
+                arm_enc(arm), mode="cost", plan=plan, shards=shards
             )
             masks = _downsize_masks(enc, cost_result)
-            cost_tuple = (cost_result, masks)
-            if key(cost_tuple) < floor:
-                _merge_underfilled(enc, cost_result, masks)
+            cost_tuple = (cost_result, masks, arm)
+            if key((cost_result, masks)) < floor:
+                trim_saved = _finish_winner(
+                    enc, cost_result, masks, guide_lam()
+                )
                 solution = _build_solution_arrays(
                     enc,
                     np.flatnonzero(
@@ -340,10 +398,7 @@ def _decode_device(
                     cost_result.assign,
                     cost_result.unschedulable,
                 )
-                solution.lp = {
-                    "lower_bound": plan.lower_bound,
-                    "estimate": plan.objective_estimate,
-                }
+                solution.lp = lp_info(trim_saved)
                 return solution
         # planned pack missing or not strictly better than the
         # recorded floor: fall through to the race, reusing the plan
@@ -352,28 +407,42 @@ def _decode_device(
     ffd_pending = _solve_packing_async(enc, mode="ffd", shards=shards)
     if plan is None:
         plan = _plan_for(fp, enc)
-    cost_pending = (
-        _solve_packing_async(enc, mode="cost", plan=plan, shards=shards)
-        if plan is not None and cost_tuple is None
-        else None
-    )
+    pendings: list[tuple[str, object]] = []
+    if plan is not None and cost_tuple is None:
+        pendings.append((
+            "cost",
+            _solve_packing_async(enc, mode="cost", plan=plan, shards=shards),
+        ))
+        if dlp is not None and lp_device.rank_beta() > 0:
+            # the guided-ranking arm joins the COLD race only — warm
+            # solves re-run just the recorded winner, so steady-state
+            # wall stays one kernel
+            pendings.append((
+                "rank",
+                _solve_packing_async(
+                    arm_enc("rank"), mode="cost", plan=plan, shards=shards
+                ),
+            ))
     ffd_result = ffd_pending.result()
-    candidates = [(ffd_result, _downsize_masks(enc, ffd_result))]
+    candidates = [(ffd_result, _downsize_masks(enc, ffd_result), "ffd")]
     if cost_tuple is not None:
         candidates.append(cost_tuple)
-    elif cost_pending is not None:
-        cost_result = cost_pending.result()
-        candidates.append((cost_result, _downsize_masks(enc, cost_result)))
+    for arm, pending in pendings:
+        arm_result = pending.result()
+        candidates.append((arm_result, _downsize_masks(enc, arm_result), arm))
 
     if len(_ffd_floor) >= 32:
         _ffd_floor.pop(next(iter(_ffd_floor)))
-    _ffd_floor[fp] = key(candidates[0])
+    _ffd_floor[fp] = key(candidates[0][:2])
 
-    result, masks = min(candidates, key=key)
+    result, masks, won = min(candidates, key=lambda it: key(it[:2]))
+    if len(_warm_arm) >= 32:
+        _warm_arm.pop(next(iter(_warm_arm)))
+    _warm_arm[fp] = won if won in ("cost", "rank") else "cost"
     # improvement pass on the WINNER only — after the race keys (and
     # the recorded FFD floor) were computed, so selection semantics
     # and the steady-state skip stay bit-identical
-    _merge_underfilled(enc, result, masks)
+    trim_saved = _finish_winner(enc, result, masks, guide_lam())
     solution = _build_solution_arrays(
         enc,
         np.flatnonzero(result.node_active[: result.node_count]),
@@ -381,11 +450,7 @@ def _decode_device(
         result.assign,
         result.unschedulable,
     )
-    if plan is not None:
-        solution.lp = {
-            "lower_bound": plan.lower_bound,
-            "estimate": plan.objective_estimate,
-        }
+    solution.lp = lp_info(trim_saved)
     return solution
 
 
@@ -394,6 +459,10 @@ def _decode_device(
 # skip reproduces min()'s exact tiebreaks. Bounded dict (oldest
 # evicted at 32 entries).
 _ffd_floor: dict[bytes, tuple[int, float, int]] = {}
+
+# which cost arm won the last cold race per fingerprint ("cost" |
+# "rank") — the warm steady-state skip re-runs only that arm
+_warm_arm: dict[bytes, str] = {}
 
 # column-generation plan per problem fingerprint: the plan is a pure
 # function of the encoded problem (deterministic pricing rounds), so a
@@ -420,10 +489,22 @@ def _plan_for(fp: bytes, enc: Encoded):
 
 
 def _race_fingerprint(enc: Encoded) -> bytes:
-    """Digest of everything the FFD kernel's outcome depends on."""
+    """Digest of everything the FFD kernel's outcome depends on — plus
+    the dual-guidance configuration, so guided and unguided runs of
+    the same problem (the bench's comparison arms, a mid-flight knob
+    flip) can never serve each other's cached floors or plans."""
     import hashlib
 
+    from karpenter_tpu.solver import lp_device
+
     h = hashlib.blake2b(digest_size=16)
+    h.update(
+        (
+            f"g{int(lp_device.enabled())}|b{lp_device.rank_beta()}"
+            f"|i{lp_device.iters()}|t{_trim_budget()}"
+            f"|p{os.environ.get('KARPENTER_LP_PRIORITY_WEIGHT', '')}"
+        ).encode()
+    )
     for buf in (
         enc.group_count, enc.group_req, enc.cfg_price, enc.cfg_alloc,
         np.ascontiguousarray(enc.compat), enc.cfg_pool,
@@ -582,6 +663,231 @@ def _merge_underfilled(enc: Encoded, result, masks: np.ndarray) -> None:
                 alive[b] = False
                 merged_any = True
                 break
+
+
+def _trim_budget() -> int:
+    """Receiver-feasibility checks the dual-guided trim may spend per
+    solve — a WORK budget (like the merge pass's) so identical inputs
+    trim identically regardless of machine load."""
+    return int(os.environ.get("KARPENTER_LP_TRIM_BUDGET", "200000"))
+
+
+def _trim_undervalued(enc: Encoded, result, masks: np.ndarray,
+                      lam: np.ndarray, budget: int | None = None) -> float:
+    """Dual-guided trim (ISSUE 12): empty the nodes the LP duals
+    certify as BAD DEALS — price above the dual value of what they
+    hold — by moving their pods into the rest of the fleet's headroom,
+    then re-fit each donor onto the cheapest machine that still holds
+    its remainder (or delete it outright). This is the integrality-gap
+    closer: FFD remainders strand many slightly-underfilled machines
+    whose pods fit in aggregate slack the prefix fill has already
+    passed; the duals say exactly which nodes to attack
+    (slack = price - lam.assign, largest first).
+
+    Legality is re-proved per move from first principles — compat with
+    the receiver's resolved config, capacity against its allocatable,
+    pairwise group conflicts, per-node group caps — and receiver masks
+    are narrowed to configs compatible with the incoming group that
+    still fit, so decode semantics hold exactly. Nodes off-limits to
+    the merge pass (existing, reservation-pinned, loose-group
+    residents, minValues pools) are off-limits here for the same
+    reasons. Every commit strictly lowers fleet price (receivers keep
+    their resolved config by construction), so the pass can only
+    improve the solution. Mutates `result`/`masks` in place; returns
+    the price saved."""
+    n = result.node_count
+    if n == 0 or lam is None:
+        return 0.0
+    budget = _trim_budget() if budget is None else budget
+    if budget <= 0:
+        return 0.0
+    active = result.node_active[:n] & (result.assign[:n].sum(axis=1) > 0)
+    uncapped = _uncapped_cols(enc)
+    launch = enc.cfg_pool >= 0
+    loose = enc.loose_groups
+    # vectorized candidate collection (the same eligibility the merge
+    # pass applies per node, but in one sweep — a 50k-pod fleet has
+    # thousands of active rows and this runs on every warm solve):
+    # fresh (no pseudo-config column), reservation-uncapped mask, no
+    # loose residents, not a minValues pool
+    act_idx = np.flatnonzero(active)
+    if act_idx.size < 2:
+        return 0.0
+    sub = masks[act_idx]
+    pseudo = np.array(
+        [cfg.existing_index >= 0 for cfg in enc.configs], dtype=bool
+    )
+    ok = (
+        sub.any(axis=1)
+        & ~(sub & pseudo[None, :]).any(axis=1)
+        & ~(sub & ~uncapped[None, :]).any(axis=1)
+    )
+    if loose is not None:
+        ok &= ~((result.assign[act_idx] > 0) & loose[None, :]).any(axis=1)
+    price_mat = np.where(sub, enc.cfg_price[None, :], np.inf)
+    pcol_all = price_mat.argmin(axis=1)
+    if enc.pool_min_values is not None:
+        ok &= ~enc.pool_min_values[enc.cfg_pool[pcol_all]]
+    rows_a = act_idx[ok]
+    if rows_a.size < 2:
+        return 0.0
+    rows = rows_a.tolist()
+    m = len(rows)
+    lam = np.asarray(lam, np.float64)
+    req_all = enc.group_req.astype(np.float64)
+    caps = enc.group_cap
+    conflict = enc.conflict
+    price = price_mat[ok].min(axis=1)
+    pcol = pcol_all[ok]
+    used = result.node_used[rows_a].astype(np.float64).copy()
+    assign_rows = result.assign[rows_a].astype(np.int64).copy()
+    alive = np.ones(m, bool)
+    alloc_p = enc.cfg_alloc[pcol].astype(np.float64)  # [m, R]
+    vals = assign_rows @ lam
+    slack = price - vals
+    donor_order = np.argsort(-slack, kind="stable")
+    idx = np.arange(m)
+    saved = 0.0
+    for di in donor_order:
+        if budget <= 0:
+            break
+        if not alive[di] or price[di] <= 0 or slack[di] <= 1e-9:
+            continue
+        pool = int(enc.cfg_pool[pcol[di]])
+        gs = np.flatnonzero(assign_rows[di])
+        if gs.size == 0:
+            continue
+        # plan the moves against a scratch copy; commit only if the
+        # donor provably refits cheaper afterwards
+        order_g = gs[np.argsort(-req_all[gs].sum(axis=1), kind="stable")]
+        assign_d = assign_rows[di].copy()
+        sim_used = used.copy()
+        sim_assign = assign_rows  # reads only; adds tracked in moves
+        moves: list[tuple[int, int, int]] = []
+        for g in order_g:
+            needed = int(assign_d[g])
+            if needed == 0:
+                continue
+            req = req_all[g]
+            reqpos = req > 0
+            budget -= m
+            elig = alive & (idx != di) & enc.compat[g, pcol]
+            if conflict is not None and conflict[g].any():
+                elig &= (sim_assign @ conflict[g].astype(np.int64)) == 0
+            head = alloc_p - sim_used
+            with np.errstate(divide="ignore", invalid="ignore"):
+                kr = np.floor(
+                    np.where(reqpos[None, :], (head + 1e-4) / np.where(
+                        reqpos, req, 1.0
+                    )[None, :], np.inf).min(axis=1)
+                )
+            kr = np.where(np.isfinite(kr), kr, 0.0)
+            k = np.where(elig, np.clip(kr, 0, None), 0.0).astype(np.int64)
+            if caps is not None:
+                k = np.minimum(
+                    k, np.clip(caps[g] - sim_assign[:, g], 0, None)
+                )
+            cum = np.cumsum(k)
+            take = np.clip(needed - (cum - k), 0, k)
+            hit = np.flatnonzero(take)
+            for ri in hit:
+                moves.append((int(ri), int(g), int(take[ri])))
+                sim_used[ri] = sim_used[ri] + int(take[ri]) * req
+            assign_d[g] = needed - int(take.sum())
+        oh = enc.pool_overhead[pool].astype(np.float64)
+        new_used = oh + assign_d @ req_all
+        if assign_d.sum() == 0:
+            new_price, new_mask = 0.0, None
+        else:
+            groups_on = np.flatnonzero(assign_d)
+            fits = np.all(enc.cfg_alloc + 1e-4 >= new_used[None, :], axis=1)
+            compat_all = enc.compat[groups_on].all(axis=0)
+            ok = launch & (enc.cfg_pool == pool) & fits & compat_all & uncapped
+            if not ok.any():
+                continue
+            new_price = float(enc.cfg_price[ok].min())
+            new_mask = ok
+        if new_price >= price[di] - 1e-9:
+            continue
+        # ---- commit
+        d = rows[di]
+        for ri, g, kk in moves:
+            r0 = rows[ri]
+            result.assign[r0, g] += kk
+            assign_rows[ri, g] += kk
+            add = kk * req_all[g]
+            result.node_used[r0] = result.node_used[r0] + add
+            used[ri] = used[ri] + add
+            masks[r0] = masks[r0] & enc.compat[g] & np.all(
+                enc.cfg_alloc + 1e-4 >= np.asarray(
+                    result.node_used[r0], np.float64
+                )[None, :],
+                axis=1,
+            )
+        saved += price[di] - new_price
+        if assign_d.sum() == 0:
+            result.assign[d] = 0
+            result.node_active[d] = False
+            result.node_used[d] = 0.0
+            masks[d] = False
+            alive[di] = False
+            assign_rows[di] = 0
+            used[di] = 0.0
+            price[di] = 0.0
+        else:
+            result.assign[d] = assign_d.astype(result.assign.dtype)
+            result.node_used[d] = new_used
+            masks[d] = new_mask
+            assign_rows[di] = assign_d
+            used[di] = new_used
+            price[di] = new_price
+            # the donor resolved onto a (smaller) config: later donors
+            # may use it as a RECEIVER, so its capacity row must be
+            # the new machine's, not the one it just shed
+            new_pcol = int(
+                np.flatnonzero(new_mask)[np.argmin(enc.cfg_price[new_mask])]
+            )
+            pcol[di] = new_pcol
+            alloc_p[di] = enc.cfg_alloc[new_pcol].astype(np.float64)
+    return saved
+
+
+def _finish_winner(enc: Encoded, result, masks: np.ndarray,
+                   lam: np.ndarray | None) -> float:
+    """The improvement pipeline applied to the race winner AFTER the
+    selection keys (and the recorded FFD floor) are computed: the
+    pairwise merge, then — with dual guidance on — trim rounds
+    interleaved with re-merges while they keep paying. Each stage only
+    ever lowers fleet price, so the served solution is never worse
+    than the raw race winner. Deterministic: round count depends only
+    on the inputs (fleet size + what the rounds saved), never on the
+    clock. Returns the trim savings."""
+    _merge_underfilled(enc, result, masks)
+    from karpenter_tpu.solver import lp_device
+
+    if lam is None or not lp_device.enabled():
+        return 0.0
+    saved = _trim_undervalued(enc, result, masks, lam)
+    if saved <= 1e-12:
+        return 0.0
+    # follow-up rounds pay a full merge pass each; past a few hundred
+    # candidates that merge dominates the steady-state wall (its pair
+    # budget saturates ~130ms), so deep refinement is reserved for the
+    # small-fleet shapes where it is nearly free — the first trim
+    # round captures the bulk of the gap everywhere (measured: it
+    # alone takes reserved_50k 6.5% -> 0.8%)
+    n_active = int(
+        (result.node_active[: result.node_count]
+         & (result.assign[: result.node_count].sum(axis=1) > 0)).sum()
+    )
+    rounds = 2 if n_active <= 256 else 0
+    for _ in range(rounds):
+        _merge_underfilled(enc, result, masks)
+        s = _trim_undervalued(enc, result, masks, lam)
+        if s <= 1e-12:
+            break
+        saved += s
+    return saved
 
 
 def _downsize_masks(enc: Encoded, result) -> np.ndarray:

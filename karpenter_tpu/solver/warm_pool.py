@@ -498,6 +498,32 @@ def warm(
                 "probe warm compile (L=%d,G=%d,C=%d,E=%d,N=%d,R=%d,P=%d) "
                 "failed: %s", L, G, C, E, N, R, P, err,
             )
+    # device-LP ascent buckets (ISSUE 12): one tiny program per (G, C)
+    # shape bucket so the first guided cost solve of a warmed bucket
+    # skips the XLA trace; gated on the guidance knob the solve path
+    # itself honors
+    from karpenter_tpu.solver import lp_device
+
+    if lp_device.enabled():
+        lp_shapes = sorted(
+            {(G, C, (s[4] if len(s) > 4 else 4)) for s in shapes
+             for G, C in [(s[0], s[1])]}
+        )
+        for lp_shape in lp_shapes:
+            if stop is not None and stop.is_set():
+                counts["skipped"] += 1
+                continue
+            try:
+                done = lp_device.warm([lp_shape])
+                counts["ok"] += done
+                if done:
+                    SOLVER_WARM_COMPILES.inc(
+                        {"outcome": "ok"}, value=float(done)
+                    )
+            except Exception as err:  # pragma: no cover - defensive
+                counts["error"] += 1
+                SOLVER_WARM_COMPILES.inc({"outcome": "error"})
+                log.warning("lp warm compile %s failed: %s", lp_shape, err)
     # KARPENTER_WARM_SHARDS adds the GSPMD-partitioned variant of each
     # bucket (the multi-host solver service's pjit shapes): same
     # matrix, compiled with the config axis split over the mesh

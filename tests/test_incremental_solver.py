@@ -430,8 +430,17 @@ class TestWarmPool:
             shapes=[(4, 64, 0, 32)], modes=("ffd",), topo=False,
             probe_shapes=[],
         )
-        assert counts == {"ok": 1, "error": 0, "skipped": 0}
-        assert SOLVER_WARM_COMPILES.value({"outcome": "ok"}) == before + 1
+        # one pack bucket + the device-LP ascent variants (ISSUE 12:
+        # guidance is on by default, and the warm pool compiles the LP
+        # program for the same (G, C) shape family in both cap-row
+        # shapes — reservation-free and the first reservation bucket)
+        from karpenter_tpu.solver import lp_device
+
+        expected = 3 if lp_device.enabled() else 1
+        assert counts == {"ok": expected, "error": 0, "skipped": 0}
+        assert SOLVER_WARM_COMPILES.value(
+            {"outcome": "ok"}
+        ) == before + expected
 
     def test_warmed_shape_is_what_a_real_solve_uses(self):
         """The warm pool's padding must mirror _run_pack: a real solve

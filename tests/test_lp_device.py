@@ -1,0 +1,354 @@
+"""Device LP relaxation: cross-validation, dual feasibility, and the
+guided-packing never-worse oracle (ISSUE 12).
+
+1. Cross-validation — the device dual ascent's certified lower bound
+   against the scipy column-generation master in lp_plan on shared
+   fixtures: never above the master value (validity), within a
+   quality tolerance below it (usefulness), with sane duals
+   (non-negative, dual-feasible against sampled integral fills,
+   complementary-slackness shape).
+2. Fuzz oracle — dual-guided solving (rank arm + trim) is NEVER
+   costlier than the unguided race across modes x reservations x
+   priorities x wavefront widths, and every guided fleet passes an
+   independent feasibility audit (capacity, compat, conflicts,
+   per-node caps, demand conservation).
+3. The scipy-absence guard — environments without scipy skip the host
+   bound gracefully: plan() returns None, the cost solve still works,
+   and the bench records null bounds instead of crashing.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.cloudprovider.fake import (
+    GIB,
+    heterogeneous_instance_types,
+    instance_types,
+    make_instance_type,
+)
+from karpenter_tpu.solver import lp_device, lp_plan
+from karpenter_tpu.solver.encode import encode, group_pods
+from karpenter_tpu.solver.pack import solve_packing
+from karpenter_tpu.solver.solver import (
+    _downsize_masks,
+    _ffd_floor,
+    _finish_winner,
+    _plan_cache,
+    _warm_arm,
+    solve,
+)
+from karpenter_tpu.testing import mk_nodepool, mk_pod
+
+SHAPES = [(0.5, 1.0), (1.0, 2.0), (2.0, 4.0), (4.0, 8.0), (2.0, 0.5),
+          (0.25, 4.0), (1.0, 6.0)]
+ZONES = ["test-zone-1", "test-zone-2", "test-zone-3"]
+
+
+def _clear_solver_caches():
+    _ffd_floor.clear()
+    _plan_cache.clear()
+    _warm_arm.clear()
+    lp_device.reset()
+
+
+def build_enc(seed: int, n_pods: int = 400, n_types: int = 24,
+              hetero: bool = False, priorities: bool = False):
+    rng = np.random.default_rng(seed)
+    pool = mk_nodepool("default")
+    types = (
+        heterogeneous_instance_types(n_types) if hetero
+        else instance_types(n_types)
+    )
+    pods = []
+    for i in range(n_pods):
+        cpu, mem = SHAPES[int(rng.integers(len(SHAPES)))]
+        selector = None
+        if rng.random() < 0.2:
+            selector = {"topology.kubernetes.io/zone":
+                        ZONES[int(rng.integers(3))]}
+        pod = mk_pod(name=f"lp-{seed}-{i}", cpu=cpu, memory=mem * GIB,
+                     node_selector=selector)
+        if priorities:
+            pod.spec.priority = int(rng.choice([0, 0, 100, -50]))
+        pods.append(pod)
+    return encode(group_pods(pods), [(pool, types)]), pods, [(pool, types)]
+
+
+class TestCrossValidation:
+    @pytest.mark.parametrize("seed,hetero", [(7, False), (13, True)])
+    def test_device_bound_valid_and_useful_vs_scipy_master(
+        self, seed, hetero
+    ):
+        enc, _, _ = build_enc(seed, hetero=hetero)
+        plan = lp_plan.plan(enc)
+        assert plan is not None
+        dlp = lp_device.solve(enc)
+        # validity: the config-level relaxation underestimates the
+        # Gilmore-Gomory master (weaker relaxation), and the closed
+        # -form knapsack bound can only weaken it further — the device
+        # bound must NEVER exceed the master value
+        assert dlp.lower_bound <= plan.objective_estimate * (1 + 1e-9), (
+            f"device bound {dlp.lower_bound} above master "
+            f"{plan.objective_estimate} — the certificate is broken"
+        )
+        # usefulness: the closed-form bound is loose but must stay in
+        # the same order of magnitude as the master on bench-shaped
+        # demand, or the duals it scales are too crushed to guide
+        assert dlp.lower_bound >= 0.35 * plan.objective_estimate, (
+            f"device bound {dlp.lower_bound} below 35% of master "
+            f"{plan.objective_estimate}"
+        )
+        assert (dlp.lam >= 0).all()
+        assert np.isfinite(dlp.lam).all()
+        assert dlp.wall_s > 0 and dlp.iterations >= 8
+
+    def test_duals_are_feasible_against_sampled_integral_fills(self):
+        """The certificate's load-bearing property: lam.q <= price_c
+        for feasible fills q of every uncapped config. Sampled with
+        the strongest single-group fills (max pods of one group on
+        one machine) — each IS a feasible fill."""
+        enc, _, _ = build_enc(29)
+        dlp = lp_device.solve(enc)
+        launch = np.flatnonzero(enc.cfg_pool >= 0)
+        eff = np.clip(
+            enc.cfg_alloc[launch]
+            - enc.pool_overhead[enc.cfg_pool[launch]], 0, None
+        )
+        for j, ci in enumerate(launch):
+            for gi in np.flatnonzero(enc.compat[:, ci]
+                                     & (enc.group_count > 0)):
+                req = enc.group_req[gi]
+                safe = np.where(req > 0, req, 1.0)
+                k = np.floor((eff[j] + 1e-4) / safe)
+                k = np.where(req > 0, k, np.inf).min()
+                if not np.isfinite(k) or k < 1:
+                    continue
+                k = min(float(k), float(enc.group_count[gi]))
+                assert dlp.lam[gi] * k <= enc.cfg_price[ci] + 1e-6, (
+                    f"dual-infeasible: group {gi} x{k} on config {ci} "
+                    f"valued {dlp.lam[gi] * k} > price "
+                    f"{enc.cfg_price[ci]}"
+                )
+
+    def test_complementary_slackness_shape(self):
+        """Zero-demand groups contribute nothing; groups with demand
+        and a compatible catalog carry positive price signal."""
+        enc, _, _ = build_enc(31)
+        dlp = lp_device.solve(enc)
+        live = enc.group_count > 0
+        launchable = (enc.compat & (enc.cfg_pool >= 0)[None, :]).any(axis=1)
+        assert (dlp.lam[live & launchable] > 0).any()
+        # the bound is exactly the certified formula on its own duals
+        assert dlp.lower_bound >= 0
+
+    def test_cache_hit_returns_identical_certificate(self):
+        enc, _, _ = build_enc(37)
+        lp_device.reset()
+        a = lp_device.solve(enc)
+        b = lp_device.solve(enc)
+        assert b.cache_hit or b is a
+        np.testing.assert_array_equal(a.lam, b.lam)
+        assert a.lower_bound == b.lower_bound
+
+    def test_priority_weights_the_guidance_duals_only(self):
+        enc, _, _ = build_enc(41, priorities=True)
+        assert enc.group_priority is not None
+        assert np.any(enc.group_priority != 0)
+        dlp = lp_device.solve(enc)
+        hi = enc.group_priority > 0
+        lo = enc.group_priority < 0
+        # guidance duals scale up with priority, down with negative
+        # priority; the CERTIFIED duals are untouched
+        assert (dlp.lam_guide[hi] >= dlp.lam[hi] - 1e-12).all()
+        assert (dlp.lam_guide[lo] <= dlp.lam[lo] + 1e-12).all()
+        if (dlp.lam[hi] > 0).any():
+            assert (dlp.lam_guide[hi] > dlp.lam[hi]).any()
+
+
+def verify_fleet(enc, result, masks):
+    """Independent feasibility audit of a packed+post-processed fleet:
+    per active node, its cheapest masked config must admit every
+    resident group and hold the recomputed usage; caps/conflicts
+    honored; total placements + unschedulable == demand."""
+    n = result.node_count
+    for ni in range(n):
+        if not (result.node_active[ni] and result.assign[ni].sum() > 0):
+            continue
+        row = masks[ni]
+        assert row.any(), f"active node {ni} lost every config"
+        col = int(np.flatnonzero(row)[np.argmin(enc.cfg_price[row])])
+        gs = np.flatnonzero(result.assign[ni])
+        assert enc.compat[gs, col].all(), f"node {ni}: incompatible group"
+        if enc.configs[col].existing_index >= 0:
+            base = np.zeros(enc.group_req.shape[1])
+        else:
+            base = enc.pool_overhead[enc.cfg_pool[col]]
+        used = base + result.assign[ni].astype(np.float64) @ \
+            enc.group_req.astype(np.float64)
+        assert (enc.cfg_alloc[col] + 1e-3 >= used).all(), (
+            f"node {ni}: usage exceeds allocatable"
+        )
+        if enc.group_cap is not None:
+            assert (result.assign[ni] <= enc.group_cap).all()
+        if enc.conflict is not None:
+            assert not enc.conflict[np.ix_(gs, gs)].any()
+    total = result.assign[:n][result.node_active[:n]].sum(axis=0) \
+        + result.unschedulable
+    np.testing.assert_array_equal(total, enc.group_count)
+
+
+class TestGuidedNeverWorse:
+    @pytest.mark.parametrize("seed", [5, 17, 23])
+    @pytest.mark.parametrize("reservations", [False, True])
+    def test_guided_solve_never_costlier_than_unguided(
+        self, seed, reservations, monkeypatch
+    ):
+        from bench import build_problem
+
+        pods, pools = build_problem(
+            600, 16, seed=seed, reservations=reservations
+        )
+        _clear_solver_caches()
+        monkeypatch.setenv("KARPENTER_LP_GUIDE", "0")
+        unguided = solve(pods, pools, objective="cost")
+        _clear_solver_caches()
+        monkeypatch.setenv("KARPENTER_LP_GUIDE", "1")
+        guided = solve(pods, pools, objective="cost")
+        assert (
+            len(guided.unschedulable), guided.total_price - 1e-6
+        ) <= (
+            len(unguided.unschedulable), unguided.total_price
+        ), (
+            f"guided fleet ${guided.total_price} worse than unguided "
+            f"${unguided.total_price}"
+        )
+
+    @pytest.mark.parametrize("width", ["0", "force"])
+    def test_guided_never_worse_across_wavefront_widths(
+        self, width, monkeypatch
+    ):
+        from bench import build_problem
+
+        monkeypatch.setenv("KARPENTER_WAVEFRONT", width)
+        pods, pools = build_problem(500, 12, seed=43)
+        _clear_solver_caches()
+        monkeypatch.setenv("KARPENTER_LP_GUIDE", "0")
+        unguided = solve(pods, pools, objective="cost")
+        _clear_solver_caches()
+        monkeypatch.setenv("KARPENTER_LP_GUIDE", "1")
+        guided = solve(pods, pools, objective="cost")
+        assert guided.total_price <= unguided.total_price + 1e-6
+        assert len(guided.unschedulable) <= len(unguided.unschedulable)
+
+    def test_guided_never_worse_with_priorities(self, monkeypatch):
+        enc, pods, pools = build_enc(47, priorities=True)
+        _clear_solver_caches()
+        monkeypatch.setenv("KARPENTER_LP_GUIDE", "0")
+        unguided = solve(pods, pools, objective="cost")
+        _clear_solver_caches()
+        monkeypatch.setenv("KARPENTER_LP_GUIDE", "1")
+        guided = solve(pods, pools, objective="cost")
+        assert guided.total_price <= unguided.total_price + 1e-6
+        assert len(guided.unschedulable) <= len(unguided.unschedulable)
+
+    @pytest.mark.parametrize("seed", [3, 19, 61])
+    def test_trim_preserves_feasibility_and_only_saves(self, seed):
+        """White-box: run the planned pack then the guided post-pass
+        directly and audit the fleet from first principles."""
+        from bench import build_problem
+
+        pods, pools = build_problem(
+            500, 14, seed=seed, reservations=(seed % 2 == 0)
+        )
+        enc = encode(group_pods(pods), pools)
+        plan = lp_plan.plan(enc)
+        result = solve_packing(
+            enc, mode="cost", plan=plan
+        )
+        masks = _downsize_masks(enc, result)
+        pre_unsched = int(result.unschedulable.sum())
+
+        def fleet_price():
+            act = np.flatnonzero(
+                result.node_active[: result.node_count]
+                & (result.assign[: result.node_count].sum(axis=1) > 0)
+            )
+            pr = np.where(
+                masks[act], enc.cfg_price[None, :], np.inf
+            ).min(axis=1)
+            return float(pr.sum())
+
+        before = fleet_price()
+        lam = plan.duals if plan is not None else None
+        if lam is None:
+            dlp = lp_device.maybe_solve(enc)
+            lam = dlp.lam_guide if dlp is not None else None
+        saved = _finish_winner(enc, result, masks, lam)
+        after = fleet_price()
+        assert after <= before + 1e-6
+        assert saved >= 0
+        assert int(result.unschedulable.sum()) == pre_unsched
+        verify_fleet(enc, result, masks)
+
+    def test_kill_switch_restores_unguided_path(self, monkeypatch):
+        """KARPENTER_LP_GUIDE=0 must not touch the LP machinery at
+        all: no device solve, no trim, lp info without device keys."""
+        from bench import build_problem
+
+        pods, pools = build_problem(300, 8, seed=71)
+        _clear_solver_caches()
+        monkeypatch.setenv("KARPENTER_LP_GUIDE", "0")
+        before = _lp_solves_total()
+        sol = solve(pods, pools, objective="cost")
+        assert _lp_solves_total() == before
+        assert sol.lp is None or "device_bound" not in sol.lp
+
+
+def _lp_solves_total() -> float:
+    from karpenter_tpu.metrics.store import SOLVER_LP_SOLVES
+
+    return SOLVER_LP_SOLVES.total()
+
+
+class TestScipyAbsence:
+    def test_plan_returns_none_and_solve_survives_without_scipy(
+        self, monkeypatch
+    ):
+        from bench import build_problem
+
+        pods, pools = build_problem(200, 6, seed=83)
+        enc = encode(group_pods(pods), pools)
+        _clear_solver_caches()
+        lp_plan._warm_patterns.clear()
+        # None in sys.modules makes `from scipy import sparse` raise
+        # ImportError — the documented "scipy not installed" behavior
+        monkeypatch.setitem(sys.modules, "scipy", None)
+        assert lp_plan.plan(enc) is None
+        sol = solve(pods, pools, objective="cost")
+        # host bound absent; the device bound may still report
+        if sol.lp is not None:
+            assert "estimate" not in sol.lp
+        monkeypatch.delitem(sys.modules, "scipy")
+        _clear_solver_caches()
+        with_scipy = solve(pods, pools, objective="cost")
+        # degradation costs optimality, never coverage
+        assert len(sol.unschedulable) == len(with_scipy.unschedulable)
+
+    def test_bench_reports_null_bounds_without_scipy(self, monkeypatch):
+        """The bench arm must degrade to lp_lower_bound: null, not
+        crash (ISSUE 12 satellite)."""
+        from bench import _timed_cost_solve, build_problem
+
+        pods, pools = build_problem(120, 6, seed=89)
+        _clear_solver_caches()
+        lp_plan._warm_patterns.clear()
+        monkeypatch.setenv("KARPENTER_LP_GUIDE", "0")
+        monkeypatch.setitem(sys.modules, "scipy", None)
+        out = _timed_cost_solve(pods, pools, bound_gap=True)
+        assert out["lp_lower_bound"] is None
+        assert out["lp_estimate"] is None
+        assert out["gap_vs_lp"] is None
+        assert out["scheduled"] > 0

@@ -1,0 +1,187 @@
+"""Dual-based consolidation probe pruning (ISSUE 12): the exactness
+guard and the savings.
+
+Pruning consults a weak-duality certificate BEFORE simulating a
+candidate subset; a pruned probe must be one the simulation could only
+have answered "no command" for. The contract is decision-identity:
+every engine search method must pick the identical command with
+pruning on and off — extended here from the batched-vs-sequential
+oracle suite (tests/test_consolidation_batch_oracle.py) — while a
+fleet shaped like the classic waste case (fully-packed spot nodes
+whose replacement can only cost MORE at effective prices) must
+actually fire the pruner.
+"""
+
+import random
+import time
+
+import pytest
+
+from karpenter_tpu.apis.v1.labels import (
+    CAPACITY_TYPE_LABEL,
+    CAPACITY_TYPE_SPOT,
+)
+from karpenter_tpu.apis.v1.nodeclaim import COND_DRIFTED
+from karpenter_tpu.cloudprovider.fake import GIB, make_instance_type
+from karpenter_tpu.metrics.store import SOLVER_PROBE_PRUNED
+from karpenter_tpu.solver import lp_device
+from karpenter_tpu.testing import Environment, mk_nodepool, mk_pod
+
+
+def _mixed_env():
+    env = Environment(types=[
+        make_instance_type("c2", cpu=2, memory=8 * GIB, price=2.0),
+        make_instance_type("c4", cpu=4, memory=16 * GIB, price=3.0),
+        make_instance_type("c8", cpu=8, memory=32 * GIB, price=5.0),
+    ])
+    pool = mk_nodepool("default")
+    pool.spec.disruption.consolidate_after = "0s"
+    env.kube.create(pool)
+    for i in range(5):
+        env.provision(mk_pod(name=f"m-{i}", cpu=1.0, memory=2 * GIB))
+    assert len(env.kube.nodes()) == 5
+    now = time.time() + 120
+    env.pod_events.reconcile_all(now=now)
+    env.conditions.reconcile_all(now=now)
+    return env, now
+
+
+def _command_identity(cmd):
+    if cmd is None:
+        return None
+    plans = []
+    if cmd.results is not None:
+        plans = sorted(
+            (
+                plan.pool.metadata.name,
+                round(float(plan.price), 6),
+                tuple(sorted(p.key for p in plan.pods)),
+                tuple(sorted(it.name for it in plan.instance_types)),
+            )
+            for plan in cmd.results.new_node_plans
+        )
+    return (
+        cmd.reason,
+        tuple(sorted(c.state_node.name for c in cmd.candidates)),
+        plans,
+    )
+
+
+@pytest.mark.parametrize(
+    "method",
+    ["multi_node_consolidation", "single_node_consolidation", "drift"],
+)
+def test_engine_methods_identical_with_and_without_pruning(
+    method, monkeypatch
+):
+    """The oracle suite's engine scenarios, re-run pruning-on vs
+    pruning-off: identical commands, including the merge the
+    multi-node fixture must find."""
+    env, now = _mixed_env()
+    if method == "drift":
+        for claim in env.kube.node_claims():
+            claim.status_conditions.set_true(COND_DRIFTED, now=now)
+
+    def run(flag):
+        monkeypatch.setenv("KARPENTER_BATCH_PROBES", "1")
+        monkeypatch.setenv("KARPENTER_LP_PRUNE", flag)
+        env.disruption._rng = random.Random(0)
+        return getattr(env.disruption, method)(now)
+
+    unpruned = run("0")
+    lp_device.reset()
+    pruned = run("1")
+    assert _command_identity(pruned) == _command_identity(unpruned)
+    if method == "multi_node_consolidation":
+        assert pruned is not None and len(pruned.candidates) >= 2
+
+
+def _spot_env(monkeypatch):
+    """Fully-packed spot fleet under an interruption penalty: every
+    candidate's replacement would cost MORE at effective prices than
+    the candidate's raw spot price, so no probe can pay — the classic
+    scan-waste case the dual certificate kills outright."""
+    monkeypatch.setenv("KARPENTER_SPOT_PENALTY", "0.5")
+    types = [
+        make_instance_type("s2", cpu=2, memory=8 * GIB, price=2.0),
+        make_instance_type("s8", cpu=8, memory=32 * GIB, price=8.0),
+    ]
+    env = Environment(types=types)
+    pool = mk_nodepool("default")
+    pool.spec.disruption.consolidate_after = "0s"
+    env.kube.create(pool)
+    fill = types[0].allocatable.get("cpu", 2.0)
+    for i in range(5):
+        env.provision(mk_pod(
+            name=f"sp-{i}", cpu=float(fill), memory=2 * GIB,
+            node_selector={CAPACITY_TYPE_LABEL: CAPACITY_TYPE_SPOT},
+        ))
+    assert len(env.kube.nodes()) == 5
+    now = time.time() + 120
+    env.pod_events.reconcile_all(now=now)
+    env.conditions.reconcile_all(now=now)
+    return env, now
+
+
+@pytest.mark.parametrize(
+    "method", ["single_node_consolidation", "multi_node_consolidation"]
+)
+def test_pruning_fires_on_unpayable_spot_fleet_and_stays_identical(
+    method, monkeypatch
+):
+    env, now = _spot_env(monkeypatch)
+
+    def run(flag):
+        monkeypatch.setenv("KARPENTER_BATCH_PROBES", "1")
+        monkeypatch.setenv("KARPENTER_LP_PRUNE", flag)
+        env.disruption._rng = random.Random(0)
+        return getattr(env.disruption, method)(now)
+
+    unpruned = run("0")
+    lp_device.reset()
+    before = SOLVER_PROBE_PRUNED.total()
+    pruned = run("1")
+    assert _command_identity(pruned) == _command_identity(unpruned)
+    assert pruned is None, "an unpayable fleet must yield no command"
+    assert SOLVER_PROBE_PRUNED.total() > before, (
+        "the dual certificate never fired on a fleet where every "
+        "probe is provably unpayable"
+    )
+
+
+def test_prune_kill_switch(monkeypatch):
+    """KARPENTER_LP_PRUNE=0 must leave the counter untouched."""
+    env, now = _spot_env(monkeypatch)
+    monkeypatch.setenv("KARPENTER_BATCH_PROBES", "1")
+    monkeypatch.setenv("KARPENTER_LP_PRUNE", "0")
+    before = SOLVER_PROBE_PRUNED.total()
+    env.disruption.single_node_consolidation(now)
+    assert SOLVER_PROBE_PRUNED.total() == before
+
+
+def test_pruned_probe_skips_the_simulation(monkeypatch):
+    """The point of pruning is the saved work: a pruned
+    compute_consolidation must never reach simulate_scheduling."""
+    env, now = _spot_env(monkeypatch)
+    monkeypatch.setenv("KARPENTER_BATCH_PROBES", "1")
+    monkeypatch.setenv("KARPENTER_LP_PRUNE", "1")
+    lp_device.reset()
+    calls = []
+    orig = env.disruption.simulate_scheduling
+
+    def counting(*a, **kw):
+        calls.append(1)
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(env.disruption, "simulate_scheduling", counting)
+    before = SOLVER_PROBE_PRUNED.total()
+    env.disruption._rng = random.Random(0)
+    env.disruption.single_node_consolidation(now)
+    fired = SOLVER_PROBE_PRUNED.total() - before
+    assert fired > 0
+    # every single-node probe of this fleet is certifiably unpayable:
+    # the only simulations allowed are those the certificate could not
+    # cover (none here)
+    assert not calls, (
+        f"{len(calls)} simulations ran despite {fired} pruned probes"
+    )

@@ -98,7 +98,11 @@ def test_warm_compiles_sharded_variants_when_enabled(monkeypatch):
         probe_shapes=[],
     )
     assert counts["error"] == 0
-    assert counts["ok"] == 2  # one unsharded + one sharded compile
+    # one unsharded + one sharded pack compile, plus the device-LP
+    # ascent's two cap-row variants when guidance is on (ISSUE 12)
+    from karpenter_tpu.solver import lp_device
+
+    assert counts["ok"] == 2 + (2 if lp_device.enabled() else 0)
 
 
 def test_bench_cache_setup_delegates_to_warm_pool():

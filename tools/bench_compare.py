@@ -39,6 +39,10 @@ WALL_KEYS = (
 )
 # higher-is-better throughput key
 RATE_KEY = "pods_per_sec"
+# lower-is-better optimality keys (ISSUE 12): compared as ABSOLUTE
+# deltas (a gap is already a ratio; relative-change gating would make
+# a 0.1% -> 0.3% move a "200% regression"), gated by --gap-tolerance
+GAP_KEYS = ("gap_vs_lp",)
 
 
 def load_detail(path: str) -> dict:
@@ -118,10 +122,15 @@ def _salvage_scenarios(tail: str) -> dict:
 
 
 def compare(
-    base: dict, cur: dict, threshold: float, scenarios=None
+    base: dict, cur: dict, threshold: float, scenarios=None,
+    gap_tolerance: float = 0.01,
 ) -> tuple[list[str], list[str]]:
     """-> (report lines, regression lines). A regression is a wall
-    increase or pods/sec decrease past `threshold` relative change."""
+    increase or pods/sec decrease past `threshold` relative change, or
+    a gap_vs_lp increase past `gap_tolerance` absolute. A gap present
+    in the baseline but null in the current run (bound machinery went
+    missing) is reported loudly but does not gate — the wall/rate keys
+    still cover the scenario."""
     lines: list[str] = []
     regressions: list[str] = []
     meta = {"backend", "backend_provenance"}
@@ -167,6 +176,22 @@ def compare(
                 regressions.append(tag)
             else:
                 lines.append("  " + tag)
+        for gkey in GAP_KEYS:
+            bv, cv = b.get(gkey), c.get(gkey)
+            if not isinstance(bv, (int, float)):
+                continue
+            if not isinstance(cv, (int, float)):
+                lines.append(
+                    f"  {name}.{gkey}: {bv:.4f} -> null "
+                    "(bound unavailable; not gated)"
+                )
+                continue
+            delta = cv - bv
+            tag = f"{name}.{gkey}: {bv:.4f} -> {cv:.4f} ({delta:+.4f} abs)"
+            if delta > gap_tolerance:
+                regressions.append(tag)
+            else:
+                lines.append("  " + tag)
     return lines, regressions
 
 
@@ -187,6 +212,13 @@ def main(argv=None) -> int:
         "scenario present in both artifacts)",
     )
     parser.add_argument(
+        "--gap-tolerance", type=float, default=0.01,
+        help="absolute gap_vs_lp increase allowed before gating "
+        "(default 0.01 = one point of optimality gap; the gap is "
+        "solver-deterministic, so the knob absorbs master-LP stall "
+        "jitter, not machine load)",
+    )
+    parser.add_argument(
         "--quiet", action="store_true",
         help="print regressions only",
     )
@@ -201,7 +233,9 @@ def main(argv=None) -> int:
         {s.strip() for s in args.scenarios.split(",") if s.strip()}
         or None
     )
-    lines, regressions = compare(base, cur, args.threshold, wanted)
+    lines, regressions = compare(
+        base, cur, args.threshold, wanted, gap_tolerance=args.gap_tolerance
+    )
     if not args.quiet and lines:
         print("compared (within threshold):")
         for line in lines:
