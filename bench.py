@@ -447,6 +447,28 @@ def _run_million_worker() -> dict:
                 sstats["peak_block_bytes"] < sstats["full_bytes"]
             )
 
+        # device telemetry (ISSUE 13): the arm that pushed the solver
+        # to 1M pods finally asserts DEVICE headroom, not just host
+        # RSS. Null-safe: a CPU mesh reports no allocator stats, the
+        # block records the null, and the assertion is vacuous; when
+        # real stats exist (a TPU mesh) the peak allocation must leave
+        # at least 5% of every device's memory free — a solve riding
+        # the allocator ceiling OOMs on the next catalog growth.
+        from karpenter_tpu.solver import telemetry
+
+        telemetry.drain(timeout=30.0)
+        out["device_telemetry"] = telemetry.snapshot()
+        head = telemetry.headroom()
+        out["device_memory_headroom"] = head
+        if head is not None:
+            out["device_headroom_ok"] = (
+                head["min_headroom_fraction"] >= 0.05
+            )
+            assert out["device_headroom_ok"], (
+                f"device memory headroom {head['min_headroom_fraction']:.1%}"
+                " below the 5% bound at 1M pods"
+            )
+
         if shards > 1:
             # full-materialization baseline: same mesh, same program —
             # only the staging differs, so placements must be identical
@@ -1927,6 +1949,9 @@ def main() -> int:
     backend = jax.default_backend()
     detail = {"backend": backend, "backend_provenance": provenance}
     from karpenter_tpu import tracing
+    from karpenter_tpu.metrics import sentinel as _sentinel
+    from karpenter_tpu.metrics import slo as _slo
+    from karpenter_tpu.solver import telemetry as _telemetry
 
     for name, fn in runners.items():
         res_before = _resilience_counts()
@@ -1935,6 +1960,12 @@ def main() -> int:
         # spot_mix) leave tick traces behind; their per-span p50/p99
         # breakdown lands in the arm's JSON below
         tracing.clear()
+        # scope the telemetry plane the same way: sentinel anomaly
+        # deltas, the last SLO digest, and the compiled-bucket roll-up
+        # are per-arm provenance
+        sentinel_before = _sentinel.anomaly_total()
+        compiled_before = _telemetry.compiled_keys()
+        _slo.reset_last_digest()
         # per-arm host peak RSS (ISSUE 11 satellite): the watermark is
         # reset before each arm where the kernel supports it, so every
         # scenario's JSON carries its own peak — the provenance the
@@ -1965,6 +1996,25 @@ def main() -> int:
         res_delta = _resilience_delta(res_before, _resilience_counts())
         if res_delta:
             detail[name]["resilience"] = res_delta
+        # telemetry plane blocks (ISSUE 13), ALWAYS well-formed:
+        # device_telemetry carries nulls where the host has no signal
+        # (CPU memory_stats, never-compiled buckets); slo_summary is
+        # null for arms that never ticked a live operator;
+        # sentinel_summary scopes the anomaly count to this arm
+        _telemetry.drain(timeout=15.0)
+        if "device_telemetry" not in detail[name]:
+            detail[name]["device_telemetry"] = _telemetry.snapshot(
+                compiled_before=compiled_before
+            )
+        if "slo_summary" not in detail[name]:
+            detail[name]["slo_summary"] = _slo.last_digest()
+        if "sentinel_summary" not in detail[name]:
+            detail[name]["sentinel_summary"] = {
+                "signals": _sentinel.summary(),
+                "arm_anomalies": (
+                    _sentinel.anomaly_total() - sentinel_before
+                ),
+            }
         arm_traces = tracing.traces()
         if arm_traces:
             # the ring bounds the sample: a long arm keeps only its

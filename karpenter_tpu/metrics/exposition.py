@@ -36,6 +36,10 @@ def _fmt_labels(pairs, extra: str = "") -> str:
 def _fmt_value(value: float) -> str:
     if value == float("inf"):
         return "+Inf"
+    if value == float("-inf"):
+        return "-Inf"
+    if value != value:  # NaN: the text format spells it literally —
+        return "NaN"    # one poisoned series must not kill the scrape
     if value == int(value) and abs(value) < 2**53:
         return str(int(value))
     return repr(float(value))

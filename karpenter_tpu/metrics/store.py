@@ -384,6 +384,65 @@ DISRUPTION_PROBE_STARVATION = REGISTRY.counter(
     "Consolidation probes attempted vs still remaining when a method's "
     "wall-clock budget expired, by method — a growing 'remaining' "
     "series means the disruption budget is starving the scan")
+# device telemetry plane (solver/telemetry.py): XLA's own cost model
+# surfaced as live series — compiled-program memory/cost analyses per
+# shape bucket, live per-device allocator stats, staging attribution
+DEVICE_COMPILED_MEMORY = REGISTRY.gauge(
+    "karpenter_device_compiled_memory_bytes",
+    "XLA memory_analysis of a compiled solver program, by kernel, "
+    "padded shape bucket, shard count, and component (argument/output/"
+    "temp/generated_code) — the device footprint a dispatch of that "
+    "bucket commits to before a byte executes")
+DEVICE_COMPILED_COST = REGISTRY.gauge(
+    "karpenter_device_compiled_cost",
+    "XLA cost_analysis of a compiled/lowered solver program, by "
+    "kernel, padded shape bucket, shard count, and stat (flops / "
+    "bytes_accessed) — what one dispatch of the bucket asks of the "
+    "device")
+DEVICE_MEMORY = REGISTRY.gauge(
+    "karpenter_device_memory_bytes",
+    "Live per-device allocator stats from memory_stats(), by device "
+    "and stat (bytes_in_use/peak_bytes_in_use/bytes_limit/"
+    "largest_alloc_size); backends without allocator stats (XLA:CPU) "
+    "publish no series")
+DEVICE_STAGING = REGISTRY.gauge(
+    "karpenter_device_staging_bytes",
+    "Host->device staging bytes of the most recent streamed solve, by "
+    "stat (peak_block: largest single host transient; full: what one "
+    "full-materialization copy would have allocated) — unified with "
+    "stream.py's per-solve stats")
+# SLO engine (metrics/slo.py): declarative SLIs over tick signals,
+# multi-window burn-rate alerting
+SLO_BURN_RATE = REGISTRY.gauge(
+    "karpenter_slo_burn_rate",
+    "Error-budget burn rate per SLO and window (short/long): "
+    "bad_fraction / (1 - objective) over the window's ticks — 1.0 "
+    "consumes the budget exactly at the sustainable rate")
+SLO_OK = REGISTRY.gauge(
+    "karpenter_slo_ok",
+    "1 while the SLO's multiwindow verdict is ok, 0 while it is "
+    "warn/page (both windows burning past the threshold)")
+SLO_BUDGET_REMAINING = REGISTRY.gauge(
+    "karpenter_slo_error_budget_remaining",
+    "1 - long-window burn rate per SLO, floored at 0 — the fraction "
+    "of the error budget left at the current long-window burn")
+SLO_ALERTS = REGISTRY.counter(
+    "karpenter_slo_alerts_total",
+    "SLO alert-state transitions into warn/page, by slo and severity "
+    "— transition-counted, so byte-identical replays count identically")
+# regression sentinel (metrics/sentinel.py): EWMA+MAD baselines over
+# per-phase solver durations and the tick wall
+SENTINEL_ANOMALIES = REGISTRY.counter(
+    "karpenter_sentinel_anomaly_total",
+    "Samples the regression sentinel flagged as anomalous against the "
+    "signal's own EWMA+MAD baseline (after warmup), by signal — a "
+    "burst on one solve phase means the last change made that phase "
+    "slower before any human reran bench")
+SENTINEL_BASELINE = REGISTRY.gauge(
+    "karpenter_sentinel_baseline",
+    "The sentinel's rolling baseline per signal and stat (ewma / mad, "
+    "in the signal's own units) — what the anomaly threshold is "
+    "currently judged against")
 
 
 class Store:

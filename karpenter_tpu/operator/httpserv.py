@@ -1,5 +1,5 @@
 """Observability over HTTP: /metrics, /healthz, /readyz,
-/debug/profile, /debug/traces.
+/debug/profile, /debug/traces, /debug/slo.
 
 Counterpart of the ports the reference mounts on its manager
 (pkg/operator/operator.go:183-222: metrics server, healthz/readyz
@@ -39,10 +39,12 @@ class ObservabilityServer:
         port: int = 8080,
         host: str = "127.0.0.1",
         profile_report: Optional[Callable[[], dict]] = None,
+        slo_report: Optional[Callable[[], dict]] = None,
     ):
         self._healthz = healthz
         self._readyz = readyz
         self._profile_report = profile_report
+        self._slo_report = slo_report
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -115,6 +117,22 @@ class ObservabilityServer:
         elif path == "/debug/profile" and self._profile_report is not None:
             body = json.dumps(self._profile_report()).encode()
             handler.send_response(200)
+            handler.send_header("Content-Type", "application/json")
+            handler.send_header("Content-Length", str(len(body)))
+            handler.end_headers()
+            handler.wfile.write(body)
+        elif path == "/debug/slo" and self._slo_report is not None:
+            # the SLO engine's full report (metrics/slo.py): per-SLI
+            # burn windows, verdicts, alert counts, objectives. A
+            # report crash must not take the server down — same
+            # contract as the probes.
+            try:
+                body = json.dumps(self._slo_report()).encode()
+                status = 200
+            except Exception as err:
+                body = json.dumps({"error": str(err)}).encode()
+                status = 500
+            handler.send_response(status)
             handler.send_header("Content-Type", "application/json")
             handler.send_header("Content-Length", str(len(body)))
             handler.end_headers()

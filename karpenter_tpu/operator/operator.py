@@ -246,6 +246,21 @@ class Operator:
         # the flight recorder's last tick trace id for THIS operator
         # (the process ring can interleave several operators in tests)
         self._last_trace_id = ""
+        # SLO burn-rate engine (ISSUE 13): declarative SLIs over this
+        # operator's tick signals, evaluated per completed tick under
+        # the engine's injectable clock (replace self.slo before the
+        # first step to pin determinism in chaos replays). Counter
+        # deltas are tracked per operator — the metrics are
+        # process-global and tests run several operators
+        from karpenter_tpu.metrics.slo import SLOEngine
+        from karpenter_tpu.metrics.store import (
+            INCREMENTAL_DIVERGENCE,
+            PRIORITY_SHED,
+        )
+
+        self.slo = SLOEngine()
+        self._slo_divergences0 = INCREMENTAL_DIVERGENCE.total()
+        self._slo_shed0 = PRIORITY_SHED.total()
 
     # -- one tick --------------------------------------------------------------
 
@@ -262,13 +277,67 @@ class Operator:
         never the liveness stamp — a wedged loop must look wedged."""
         now = time.time() if now is None else now
         wall0 = time.perf_counter()
+        slo_wall0 = self.slo.clock()
+        # anything noted BEFORE this tick opened (a solve run outside
+        # any operator — bench, tools — in the same process) is not
+        # this tick's signal: discard it so the optimality SLI only
+        # ever scores the tick's own solves
+        from karpenter_tpu.metrics import slo as _slo_mod
+
+        _slo_mod.take_noted()
         with tracing.trace("tick") as root:
             self._last_trace_id = getattr(root, "trace_id", "")
             self._step(now)
         wall = time.perf_counter() - wall0
         OPERATOR_TICK_DURATION.observe(wall)
+        # telemetry plane (ISSUE 13): the sentinel baselines the tick
+        # wall, the SLO engine evaluates the tick's signals — both only
+        # for COMPLETED ticks (a crashed tick must neither replenish an
+        # error budget nor poison a baseline), like the liveness stamp
+        from karpenter_tpu.metrics import sentinel as _sentinel
+
+        _sentinel.observe("tick_wall", wall)
+        self._observe_slo(self.slo.clock() - slo_wall0)
         self._last_tick_wall = time.time()
         OPERATOR_LAST_TICK.set(self._last_tick_wall)
+
+    def _observe_slo(self, wall_s: float) -> None:
+        """One SLO evaluation per completed tick. Signals come from
+        the metrics the tick already maintained (counter deltas scoped
+        to this operator) plus whatever the solver noted mid-tick
+        (slo.note — gap_vs_lp); the engine itself is a pure function
+        of this dict, which is what the chaos determinism contract
+        asserts on."""
+        from karpenter_tpu.metrics import slo as _slo
+        from karpenter_tpu.metrics.store import (
+            INCREMENTAL_DIVERGENCE,
+            PRIORITY_SHED,
+            SCHEDULER_UNSCHEDULABLE_PODS,
+        )
+
+        divergences = INCREMENTAL_DIVERGENCE.total()
+        shed = PRIORITY_SHED.total()
+        signals = {
+            "tick_wall_s": wall_s,
+            # the LIVE provisioning series only: disruption
+            # simulations publish controller="disruption" counts whose
+            # "unschedulable" verdict just means a probe kept its node
+            # — scoring those would page schedulability on a healthy
+            # fleet (both live paths — full Scheduler and incremental
+            # tick — publish under controller="provisioner"). Read via
+            # series() so an ABSENT series is None, not 0.0: a crashed
+            # solve deliberately deletes its series, and scoring that
+            # tick "good" would keep karpenter_slo_ok green through a
+            # total solver outage — absent data is a data-free tick
+            "unschedulable_pods": SCHEDULER_UNSCHEDULABLE_PODS.series()
+            .get((("controller", "provisioner"),)),
+            "oracle_divergences": divergences - self._slo_divergences0,
+            "priority_shed": shed - self._slo_shed0,
+        }
+        self._slo_divergences0 = divergences
+        self._slo_shed0 = shed
+        signals.update(_slo.take_noted())
+        self.slo.observe_tick(signals)
 
     def _step(self, now: float) -> None:
         # informer pump: under async delivery, queued watch events land
@@ -778,6 +847,10 @@ class Operator:
                  if t["name"] == "tick"),
                 None,
             )),
+            # SLO engine digest (ISSUE 13): the multiwindow burn-rate
+            # verdict per SLI, deterministic under the injectable clock
+            # (full report at /debug/slo)
+            "slo": self.slo.digest(),
         }
 
     @staticmethod
@@ -823,6 +896,7 @@ class Operator:
             profile_report=(
                 self.profiler.report if self.options.enable_profiling else None
             ),
+            slo_report=self.slo.report,
         )
         self._observability.start()
         return self._observability

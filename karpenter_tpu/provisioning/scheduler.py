@@ -593,6 +593,21 @@ class Scheduler:
     def _existing_input(self, node: StateNode) -> ExistingNodeInput:
         return self.input_builder.existing_input(node)
 
+    def _note_gap(self, solution: Solution) -> None:
+        """Feed the SLO engine's optimality SLI (metrics/slo.py) from
+        the PROVISIONING fleet solve only: disruption simulations'
+        candidate-subset solves carry gaps vs their own restricted LP
+        estimates (routinely large on tiny sub-problems) that say
+        nothing about fleet optimality, so they must not note."""
+        if self.metrics_controller != "provisioner":
+            return
+        lp = solution.lp
+        est = lp.get("estimate") if lp else None
+        if est:
+            from karpenter_tpu.metrics import slo
+
+            slo.note("gap_vs_lp", solution.total_price / est - 1.0)
+
     def _accept_solution(
         self, solution: Solution, open_plans: list, results: SchedulerResults,
         round_in_use: dict[str, int],
@@ -808,6 +823,7 @@ class Scheduler:
         open_plans: list[NodePlan] = []
         if simple:
             solution = self._batched_solve(simple, reserved_in_use=round_in_use)
+            self._note_gap(solution)
             self._accept_solution(solution, open_plans, results, round_in_use)
 
             # k-way-evicted pods are schedulable alone: re-solve them

@@ -614,11 +614,12 @@ def _encode_impl(
         cfg_pool = np.ascontiguousarray(cfg_pool[keep])
         cfg_rsv = np.ascontiguousarray(cfg_rsv[keep])
 
+    from karpenter_tpu.metrics import sentinel
     from karpenter_tpu.metrics.store import SOLVER_PHASE_DURATION
 
-    SOLVER_PHASE_DURATION.observe(
-        _time.perf_counter() - _t_encode, {"phase": "encode"}
-    )
+    _encode_wall = _time.perf_counter() - _t_encode
+    SOLVER_PHASE_DURATION.observe(_encode_wall, {"phase": "encode"})
+    sentinel.observe_phase("encode", _encode_wall)
     return Encoded(
         resource_keys=keys,
         groups=list(groups),

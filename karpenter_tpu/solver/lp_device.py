@@ -454,6 +454,19 @@ def solve(enc: Encoded, shards: int = 0) -> DeviceLP:
                     for i, a in enumerate(args)
                 ]
         best_w, best_lam, last_up = _ascend(*args, n_iters=n_iters)
+        # device telemetry (ISSUE 13): the jit dispatch holds no
+        # Compiled handle, so a cold ascent bucket is analysed out of
+        # band — one background lowering per (Gp, Cp, Kp) signature
+        from karpenter_tpu.solver import telemetry
+
+        telemetry.request_lp_capture(Gp, Cp, R, Kp, n_iters)
+        entry = telemetry.compiled_entry(
+            "lp", (Gp, Cp, R, Kp, "iters%d" % n_iters)
+        )
+        if entry is not None and entry.get("cost"):
+            sp.annotate(**{
+                "tm_" + k: v for k, v in entry["cost"].items()
+            })
         lam_raw = np.asarray(best_lam, np.float64)[:G]
         converged = int(last_up) < (n_iters * 3) // 4
 
@@ -645,6 +658,9 @@ def warm(shapes) -> int:
         # slots) — the jit signature keys on these SHAPES
         for Kp in (1, _cap_rows(1)):
             try:
+                from karpenter_tpu.solver import telemetry
+
+                telemetry.request_lp_capture(Gp, Cp, R, Kp, n_iters)
                 _ascend(
                     jnp.zeros(Gp, jnp.float32),
                     jnp.zeros(Gp, jnp.float32),

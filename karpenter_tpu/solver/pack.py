@@ -1906,7 +1906,10 @@ def _run_pack(
         _t_dispatch - _t_stage, {"phase": "transfer"}
     )
     from karpenter_tpu import tracing
+    from karpenter_tpu.metrics import sentinel as _sentinel
+    from karpenter_tpu.solver import telemetry as _telemetry
 
+    _sentinel.observe_phase("transfer", _t_dispatch - _t_stage)
     tracing.record("solve.transfer", _t_stage, _t_dispatch,
                    groups=G, configs=C, shards=shards)
     faults.fire("compile")
@@ -1938,9 +1941,41 @@ def _run_pack(
     )
     from karpenter_tpu.solver import warm_pool as _warm_pool
 
+    _sentinel.observe_phase("compile", _t_compiled - _t_dispatch)
+    _warm_hit = _warm_pool.warmed(Gp, Cp, Ep, F, mode, shards)
+    _tm_attrs: dict = {}
+    if _telemetry.enabled():
+        # the EXACT kwarg variant this dispatch lowered — distinct
+        # combinations are distinct XLA programs and must never share
+        # a telemetry entry
+        _rsv_k = K if K else (0 if shards > 1 else None)
+        _variant = _telemetry.variant_tag(
+            int(wf), _rsv_k,
+            group_cap=group_cap_full is not None,
+            conflict=conflict_full is not None,
+            quota=bound_quota_j is not None,
+        )
+        if not _warm_hit:
+            # cold lowering of this padded signature: queue it for a
+            # drain-time analysis (one shape-only lower per bucket) —
+            # never on the tick's own clock
+            _telemetry.request_pack_capture(
+                Gp, Cp, Ep, F, R, enc.pool_overhead.shape[0] - 1,
+                mode, int(wf), shards,
+                rsv_k=_rsv_k,
+                group_cap=group_cap_full is not None,
+                conflict=conflict_full is not None,
+                quota=bound_quota_j is not None,
+            )
+        _entry = _telemetry.compiled_entry(
+            "pack", (Gp, Cp, Ep, F, mode, _variant), shards=shards,
+        )
+        if _entry is not None:
+            for values in (_entry.get("memory"), _entry.get("cost")):
+                for k, v in (values or {}).items():
+                    _tm_attrs["tm_" + k] = v
     tracing.record("solve.compile", _t_dispatch, _t_compiled,
-                   wavefront=int(wf),
-                   warm_hit=_warm_pool.warmed(Gp, Cp, Ep, F, mode, shards))
+                   wavefront=int(wf), warm_hit=_warm_hit, **_tm_attrs)
     # compile finished: release the watchdog's compile budget (the
     # execute budget keeps running until fetch)
     from karpenter_tpu.solver import resilience
@@ -1963,8 +1998,26 @@ def _run_pack(
         SOLVER_PHASE_DURATION.observe(
             _t_fetched - _t_exec, {"phase": "execute"}
         )
+        _sentinel.observe_phase("execute", _t_fetched - _t_exec)
+        _tm_exec: dict = {}
+        if _telemetry.enabled():
+            # live allocator stats straight after the device round-trip
+            # — the moment the solve's buffers are all resident. Only
+            # backends that report stats publish anything (CPU: no-op).
+            for _dev in _telemetry.publish_device_memory():
+                _stats = _dev["stats"] or {}
+                if "bytes_in_use" in _stats:
+                    _tm_exec["tm_in_use_bytes"] = max(
+                        _tm_exec.get("tm_in_use_bytes", 0),
+                        _stats["bytes_in_use"],
+                    )
+                if "peak_bytes_in_use" in _stats:
+                    _tm_exec["tm_peak_bytes"] = max(
+                        _tm_exec.get("tm_peak_bytes", 0),
+                        _stats["peak_bytes_in_use"],
+                    )
         tracing.record("solve.execute", _t_exec, _t_fetched,
-                       shards=shards if shards > 1 else 1)
+                       shards=shards if shards > 1 else 1, **_tm_exec)
         o0 = N * Gp
         o1 = o0 + F * W
         assign = flat[:o0].reshape(N, Gp)[:, :G].astype(np.int32)

@@ -1092,10 +1092,12 @@ def _build_solution_arrays(
         if extra_unsched[gi]:
             evicted.extend(tail[len(tail) - int(extra_unsched[gi]) :])
     from karpenter_tpu import tracing
+    from karpenter_tpu.metrics import sentinel
     from karpenter_tpu.metrics.store import SOLVER_PHASE_DURATION
 
     _t_done = _time.perf_counter()
     SOLVER_PHASE_DURATION.observe(_t_done - _t_decode, {"phase": "decode"})
+    sentinel.observe_phase("decode", _t_done - _t_decode)
     tracing.record("solve.decode", _t_decode, _t_done,
                    nodes=len(new_nodes), unschedulable=len(unschedulable))
     return Solution(

@@ -84,14 +84,21 @@ class _Staging:
         from karpenter_tpu.metrics.store import SOLVER_STREAM_BLOCKS
 
         SOLVER_STREAM_BLOCKS.inc(value=self.blocks)
+        stats = dict(
+            arrays=self.arrays,
+            blocks=self.blocks,
+            peak_block_bytes=self.peak_block_bytes,
+            full_bytes=self.full_bytes,
+        )
         with _lock:
             _last.clear()
-            _last.update(
-                arrays=self.arrays,
-                blocks=self.blocks,
-                peak_block_bytes=self.peak_block_bytes,
-                full_bytes=self.full_bytes,
-            )
+            _last.update(stats)
+        # unified staging attribution (ISSUE 13): the same per-solve
+        # stats land on the device-telemetry gauges and in the per-arm
+        # device_telemetry block next to the compiled-program peaks
+        from karpenter_tpu.solver import telemetry
+
+        telemetry.note_staging(stats)
 
 
 def stage(

@@ -1,0 +1,545 @@
+"""Device cost/memory accounting for the solver (ISSUE 13 tentpole).
+
+The 1M-pod sharded solves run blind to XLA's own cost model: nothing
+in the tree ever reads `compiled.memory_analysis()` /
+`cost_analysis()`, so the only memory evidence bench rounds carry is
+host RSS. This module closes that gap with three accounting surfaces,
+all null-safe on CPU-only hosts (no `memory_stats()`), scipy-absent
+hosts, and sharded subprocess arms:
+
+1. **Compiled-program accounting** — at every warm-pool AOT compile
+   the `Compiled` object is already in hand, so its
+   `memory_analysis()` (argument/output/temp/generated-code bytes) and
+   `cost_analysis()` (flops, bytes accessed) are recorded per
+   (kernel, shape-bucket, shards, variant) for free. Cold `_run_pack`/
+   LP-ascent lowerings go through the jit dispatch (no `Compiled`
+   handle exists), so a cold dispatch only ENQUEUES its padded
+   signature; `drain()` — called per bench arm, by tests, and by any
+   tool that wants the numbers — materializes the queue with one
+   shape-only `lower()` per never-seen bucket, reading the cost
+   analysis off the StableHLO without paying a second XLA compile
+   (`KARPENTER_DEVICE_TELEMETRY=force` additionally compiles the
+   analysis copy to get memory_analysis for cold buckets too).
+   Deliberately NOT a background thread: XLA lowering is Python-heavy
+   and holds the GIL, so a worker racing the reconcile loop would
+   steal exactly the tick wall the SLO engine is measuring (observed
+   as a live-tick perf-guard regression). Warm-pool-covered fleets
+   get full coverage at startup for free; drain() is the explicit,
+   caller-paid path for the rest.
+2. **Live device memory** — per-device `memory_stats()` gauges
+   (bytes_in_use / peak / limit where the backend reports them; a CPU
+   backend returns None and the gauges simply stay unset).
+3. **Host↔device staging attribution** — `stream.py`'s per-solve
+   staging stats land in `karpenter_device_staging_bytes` and in
+   `snapshot()` next to the compiled peaks, so one block answers "how
+   close is this solve to the device" end to end.
+
+Everything lands three ways: gauges (`karpenter_device_*`), `tm_*`
+attrs on the existing `solve.compile`/`solve.execute` spans (stripped
+from `tracing.structure()` — they track background compile progress,
+so byte-identical replays may legitimately disagree), and the
+`snapshot()` block bench stamps per arm as `device_telemetry`.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from collections import deque
+from typing import Optional
+
+log = logging.getLogger("karpenter.solver.telemetry")
+
+ENV = "KARPENTER_DEVICE_TELEMETRY"
+
+# memory_analysis() components exported per compiled bucket
+_MEM_COMPONENTS = (
+    ("argument", "argument_size_in_bytes"),
+    ("output", "output_size_in_bytes"),
+    ("temp", "temp_size_in_bytes"),
+    ("generated_code", "generated_code_size_in_bytes"),
+)
+# memory_stats() keys exported per live device (when the backend
+# reports them at all — XLA:CPU returns None)
+_DEVICE_STATS = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit",
+                 "largest_alloc_size")
+
+
+def mode() -> str:
+    """off | auto | force. auto (default) records compiled analyses
+    wherever a Compiled object already exists (warm pool) and lowers —
+    but never compiles — an analysis copy for cold buckets; force also
+    compiles the cold copy so memory_analysis exists for every bucket."""
+    raw = os.environ.get(ENV, "auto").strip().lower()
+    if raw in ("0", "off", "false", "no"):
+        return "off"
+    if raw in ("force", "2"):
+        return "force"
+    return "auto"
+
+
+def enabled() -> bool:
+    return mode() != "off"
+
+
+# -- compiled-program registry ------------------------------------------------
+
+_lock = threading.Lock()
+# (kernel, bucket, shards) -> {"memory": {...}|None, "cost": {...}|None,
+#                              "source": "warm_pool"|"cold_lowering"}
+_compiled: dict[tuple, dict] = {}
+_staging: dict = {}
+
+
+def variant_tag(wavefront: int, rsv_k: Optional[int] = None,
+                group_cap: bool = False, conflict: bool = False,
+                quota: bool = False) -> str:
+    """The kernel-variant component of a pack bucket key. Distinct
+    kwarg combinations lower to DIFFERENT XLA programs (reservation
+    inputs, topology caps/conflicts, per-node quotas), so each needs
+    its own registry entry — a shared key would annotate a solve's
+    spans with a program it never dispatched."""
+    parts = ["wf%d" % wavefront,
+             "rsv%s" % ("n" if rsv_k is None else int(rsv_k))]
+    if group_cap:
+        parts.append("gc")
+    if conflict:
+        parts.append("cf")
+    if quota:
+        parts.append("qt")
+    return "-".join(parts)
+
+
+def _bucket_key(kernel: str, bucket: tuple, shards: int) -> tuple:
+    return (kernel, tuple(int(x) if isinstance(x, (int, bool)) else str(x)
+                          for x in bucket), int(shards))
+
+
+def _memory_dict(compiled) -> Optional[dict]:
+    """CompiledMemoryStats -> plain dict; None when the runtime can't
+    produce one (old jaxlib, unsupported backend)."""
+    try:
+        stats = compiled.memory_analysis()
+    except Exception:
+        return None
+    if stats is None:
+        return None
+    out = {}
+    for name, attr in _MEM_COMPONENTS:
+        value = getattr(stats, attr, None)
+        if value is not None:
+            out[name] = int(value)
+    return out or None
+
+
+def _cost_dict(analysed) -> Optional[dict]:
+    """cost_analysis() of a Lowered or Compiled -> {"flops",
+    "bytes_accessed"}; the API returns a dict (Lowered) or a list of
+    per-computation dicts (Compiled), and either may be missing on
+    exotic backends."""
+    try:
+        cost = analysed.cost_analysis()
+    except Exception:
+        return None
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else None
+    if not isinstance(cost, dict):
+        return None
+    out = {}
+    if "flops" in cost:
+        out["flops"] = float(cost["flops"])
+    if "bytes accessed" in cost:
+        out["bytes_accessed"] = float(cost["bytes accessed"])
+    return out or None
+
+
+def record_compiled(kernel: str, bucket: tuple, compiled,
+                    shards: int = 0, source: str = "warm_pool") -> None:
+    """Account one compiled program. `bucket` is the padded shape
+    signature ((Gp, Cp, Ep, F, mode, variant...) for pack kernels);
+    safe to call with anything — failures are swallowed (telemetry
+    must never take a compile path down)."""
+    if not enabled():
+        return
+    try:
+        entry = {
+            "memory": _memory_dict(compiled),
+            "cost": _cost_dict(compiled),
+            "source": source,
+        }
+        _publish_compiled(kernel, bucket, shards, entry)
+    except Exception:  # pragma: no cover - defensive
+        log.debug("compiled telemetry failed for %s %s", kernel, bucket,
+                  exc_info=True)
+
+
+def record_lowered(kernel: str, bucket: tuple, lowered,
+                   shards: int = 0, source: str = "cold_lowering") -> None:
+    """Cost-only accounting off a Lowered (no XLA compile paid)."""
+    if not enabled():
+        return
+    try:
+        entry = {"memory": None, "cost": _cost_dict(lowered),
+                 "source": source}
+        _publish_compiled(kernel, bucket, shards, entry)
+    except Exception:  # pragma: no cover - defensive
+        log.debug("lowered telemetry failed for %s %s", kernel, bucket,
+                  exc_info=True)
+
+
+def _publish_compiled(kernel: str, bucket: tuple, shards: int,
+                      entry: dict) -> None:
+    from karpenter_tpu.metrics.store import (
+        DEVICE_COMPILED_COST,
+        DEVICE_COMPILED_MEMORY,
+    )
+
+    key = _bucket_key(kernel, bucket, shards)
+    with _lock:
+        prior = _compiled.get(key)
+        if prior is not None:
+            # a warm-pool record (has memory_analysis) must not be
+            # downgraded by a later cost-only capture of the same bucket
+            if entry["memory"] is None and prior.get("memory") is not None:
+                entry = {**entry, "memory": prior["memory"],
+                         "source": prior["source"]}
+        _compiled[key] = entry
+    labels = {"kernel": kernel, "bucket": "x".join(str(x) for x in key[1]),
+              "shards": str(shards)}
+    if entry["memory"]:
+        for component, value in entry["memory"].items():
+            DEVICE_COMPILED_MEMORY.set(
+                float(value), {**labels, "component": component}
+            )
+    if entry["cost"]:
+        for stat, value in entry["cost"].items():
+            DEVICE_COMPILED_COST.set(float(value), {**labels, "stat": stat})
+
+
+def compiled_entry(kernel: str, bucket: tuple, shards: int = 0
+                   ) -> Optional[dict]:
+    """The recorded analysis for one bucket (None until captured) —
+    the solve path annotates its compile span from this."""
+    with _lock:
+        entry = _compiled.get(_bucket_key(kernel, bucket, shards))
+        return dict(entry) if entry is not None else None
+
+
+# -- cold-bucket capture queue ------------------------------------------------
+#
+# The jit dispatch path holds no Compiled handle, so cold buckets are
+# analysed out of band: the solve site enqueues its padded signature
+# (dedup'd, bounded), and drain() lowers the same shapes once (force:
+# also compiles) in the CALLER's thread — see the module docstring for
+# why this is not a background worker. Eviction under pressure removes
+# the dropped request's dedup key too, so a bucket squeezed out
+# between drains re-enqueues on its next dispatch instead of being
+# silently blacklisted forever.
+
+_QUEUE_MAX = 64
+_queue: deque = deque()
+_requested: set = set()
+
+
+def request_pack_capture(Gp: int, Cp: int, Ep: int, F: int, R: int,
+                         P: int, mode_: str, wavefront: int,
+                         shards: int, rsv_k: Optional[int],
+                         group_cap: bool = False, conflict: bool = False,
+                         quota: bool = False) -> None:
+    """Enqueue a cold pack bucket for drain-time analysis (dedup'd).
+    Called from `_run_pack` after a dispatch whose padded signature no
+    warm-pool compile covered — the flags name the EXACT kwarg variant
+    the real solve dispatched."""
+    if not enabled():
+        return
+    key = ("pack", Gp, Cp, Ep, F, mode_, wavefront, shards,
+           rsv_k, group_cap, conflict, quota)
+    _enqueue(key, ("pack", dict(Gp=Gp, Cp=Cp, Ep=Ep, F=F, R=R, P=P,
+                                mode=mode_, wavefront=wavefront,
+                                shards=shards, rsv_k=rsv_k,
+                                group_cap=group_cap, conflict=conflict,
+                                quota=quota)))
+
+
+def request_lp_capture(Gp: int, Cp: int, R: int, Kp: int,
+                       n_iters: int) -> None:
+    """Enqueue a cold LP-ascent bucket for background analysis."""
+    if not enabled():
+        return
+    key = ("lp", Gp, Cp, R, Kp, n_iters)
+    _enqueue(key, ("lp", dict(Gp=Gp, Cp=Cp, R=R, Kp=Kp,
+                              n_iters=n_iters)))
+
+
+def _enqueue(key: tuple, item: tuple) -> None:
+    with _lock:
+        if key in _requested:
+            return
+        _requested.add(key)
+        while len(_queue) >= _QUEUE_MAX:
+            # drop the oldest request AND its dedup key: the bucket
+            # re-enqueues on its next dispatch rather than vanishing
+            old_key, _ = _queue.popleft()
+            _requested.discard(old_key)
+        _queue.append((key, item))
+
+
+def drain(timeout: float = 10.0) -> bool:
+    """Materialize the queued cold-bucket captures in THIS thread,
+    bounded by `timeout` seconds (bench calls this before stamping
+    `device_telemetry` blocks; the steady tick path never does). True
+    when the queue emptied within the budget."""
+    import time as _time
+
+    deadline = _time.monotonic() + timeout
+    while _time.monotonic() < deadline:
+        with _lock:
+            try:
+                key, item = _queue.popleft()
+            except IndexError:
+                return True
+        try:
+            _capture(item)
+        except Exception:  # pragma: no cover - defensive
+            # un-blacklist the bucket: a transient failure (device
+            # busy, fault injector live) must leave it re-requestable
+            # on its next dispatch, same contract as queue eviction
+            with _lock:
+                _requested.discard(key)
+            log.debug("telemetry capture failed for %s", item[0],
+                      exc_info=True)
+    with _lock:
+        return not _queue
+
+
+def _capture(item: tuple) -> None:
+    kind, spec = item
+    if kind == "pack":
+        _capture_pack(spec)
+    elif kind == "lp":
+        _capture_lp(spec)
+
+
+def _capture_pack(spec: dict) -> None:
+    from karpenter_tpu.solver.pack import pack_split_flat
+    from karpenter_tpu.solver.warm_pool import bucket_args
+
+    args, kw = bucket_args(
+        spec["Gp"], spec["Cp"], spec["Ep"], spec["R"], spec["P"],
+        shards=spec["shards"], rsv_k=spec["rsv_k"],
+        group_cap=spec["group_cap"], conflict=spec["conflict"],
+        quota=spec["quota"],
+    )
+    statics = {"max_free": spec["F"], "mode": spec["mode"]}
+    if spec["wavefront"] > 1:
+        statics["wavefront"] = spec["wavefront"]
+    lowered = pack_split_flat.lower(*args, **statics, **kw)
+    bucket = (spec["Gp"], spec["Cp"], spec["Ep"], spec["F"],
+              spec["mode"],
+              variant_tag(spec["wavefront"], spec["rsv_k"],
+                          spec["group_cap"], spec["conflict"],
+                          spec["quota"]))
+    if mode() == "force":
+        record_compiled("pack", bucket, lowered.compile(),
+                        shards=spec["shards"], source="cold_lowering")
+    else:
+        record_lowered("pack", bucket, lowered, shards=spec["shards"])
+
+
+def _capture_lp(spec: dict) -> None:
+    import jax.numpy as jnp
+    from jax import ShapeDtypeStruct as S
+
+    from karpenter_tpu.solver.lp_device import _ascend
+
+    Gp, Cp, R, Kp = spec["Gp"], spec["Cp"], spec["R"], spec["Kp"]
+    lowered = _ascend.lower(
+        S((Gp,), jnp.float32), S((Gp,), jnp.float32),
+        S((Gp,), jnp.float32), S((Gp, Cp), jnp.bool_),
+        S((Gp, R), jnp.float32), S((Cp, R), jnp.float32),
+        S((Cp,), jnp.float32), S((Cp, R), jnp.bool_),
+        S((Kp, Cp), jnp.bool_), S((Kp,), jnp.float32),
+        S((Cp,), jnp.bool_),
+        n_iters=spec["n_iters"],
+    )
+    bucket = (Gp, Cp, R, Kp, "iters%d" % spec["n_iters"])
+    if mode() == "force":
+        record_compiled("lp", bucket, lowered.compile(),
+                        source="cold_lowering")
+    else:
+        record_lowered("lp", bucket, lowered)
+
+
+# -- live device memory -------------------------------------------------------
+
+def device_memory_snapshot() -> list[dict]:
+    """Per-device live memory: [{"device", "platform", "stats":
+    {...}|None}]. Null-safe by construction — XLA:CPU (and any backend
+    without an allocator report) returns stats=None, and a jax import
+    failure returns []."""
+    try:
+        import jax
+
+        devices = jax.local_devices()
+    except Exception:
+        return []
+    out = []
+    for dev in devices:
+        stats = None
+        try:
+            raw = dev.memory_stats()
+        except Exception:
+            raw = None
+        if raw:
+            stats = {k: int(raw[k]) for k in _DEVICE_STATS if k in raw}
+            stats = stats or None
+        out.append({
+            "device": f"{dev.platform}:{dev.id}",
+            "platform": str(dev.platform),
+            "stats": stats,
+        })
+    return out
+
+
+def publish_device_memory() -> list[dict]:
+    """Refresh the `karpenter_device_memory_bytes` gauges from live
+    `memory_stats()` and return the snapshot. Devices without stats
+    leave no series behind."""
+    snap = device_memory_snapshot()
+    if not enabled():
+        return snap
+    from karpenter_tpu.metrics.store import DEVICE_MEMORY
+
+    for dev in snap:
+        if not dev["stats"]:
+            continue
+        for stat, value in dev["stats"].items():
+            DEVICE_MEMORY.set(float(value),
+                              {"device": dev["device"], "stat": stat})
+    return snap
+
+
+# -- staging attribution ------------------------------------------------------
+
+def note_staging(stats: dict) -> None:
+    """Record the most recent streamed staging pass (called by
+    stream._Staging.commit) into the staging gauges + snapshot()."""
+    if not stats:
+        return
+    with _lock:
+        _staging.clear()
+        _staging.update(stats)
+    if not enabled():
+        return
+    from karpenter_tpu.metrics.store import DEVICE_STAGING
+
+    for stat, key in (("peak_block", "peak_block_bytes"),
+                      ("full", "full_bytes")):
+        if key in stats:
+            DEVICE_STAGING.set(float(stats[key]), {"stat": stat})
+
+
+# -- the bench block ----------------------------------------------------------
+
+def compiled_keys() -> set:
+    """The registry's current bucket keys (bench captures this before
+    an arm so snapshot() can scope its compiled roll-up to the arm)."""
+    with _lock:
+        return set(_compiled)
+
+
+def snapshot(compiled_before: Optional[set] = None) -> dict:
+    """The per-arm `device_telemetry` block: always well-formed, with
+    nulls where the host genuinely has no signal (CPU memory_stats,
+    never-compiled buckets). Scalar roll-ups (`compiled_peak_temp_mb`,
+    `device_peak_in_use_mb`) ride at the top level so
+    tools/bench_compare.py can gate them without walking the detail —
+    each carries a scope: with `compiled_before` (the keys recorded
+    BEFORE the arm, see compiled_keys()) the compiled peak covers only
+    buckets this arm added ("arm"); without it, it covers the process
+    lifetime. The live-device peak is ALWAYS process-scoped — XLA's
+    peak_bytes_in_use watermark has no reset — and bench_compare
+    refuses to gate process-scoped peaks (they accumulate every
+    earlier arm, so a delta would fire on arm ordering, not memory)."""
+    with _lock:
+        items = list(_compiled.items())
+        staging = dict(_staging) if _staging else None
+    compiled = {}
+    temp_peaks = []
+    for k, v in items:
+        name = "%s[%s]sh%d" % (k[0], "x".join(str(x) for x in k[1]), k[2])
+        compiled[name] = {
+            "memory": dict(v["memory"]) if v["memory"] else None,
+            "cost": dict(v["cost"]) if v["cost"] else None,
+            "source": v["source"],
+        }
+        if (
+            v["memory"] and "temp" in v["memory"]
+            and (compiled_before is None or k not in compiled_before)
+        ):
+            temp_peaks.append(v["memory"]["temp"])
+    devices = device_memory_snapshot()
+    in_use_peaks = [
+        d["stats"]["peak_bytes_in_use"] for d in devices
+        if d["stats"] and "peak_bytes_in_use" in d["stats"]
+    ]
+    return {
+        "mode": mode(),
+        "compiled": compiled or None,
+        "devices": devices or None,
+        "staging": staging,
+        "compiled_peak_temp_mb": (
+            round(max(temp_peaks) / 2**20, 2) if temp_peaks else None
+        ),
+        "compiled_scope": (
+            "arm" if compiled_before is not None else "process"
+        ),
+        "device_peak_in_use_mb": (
+            round(max(in_use_peaks) / 2**20, 2) if in_use_peaks else None
+        ),
+        "device_scope": "process",
+    }
+
+
+def headroom() -> Optional[dict]:
+    """Device-memory headroom where REAL stats exist: min over devices
+    of 1 - bytes_IN_USE/limit — the LIVE footprint at the call site,
+    deliberately not peak_bytes_in_use: the peak is a process-lifetime
+    watermark with no reset, so on a host that ran other work first
+    (bench arms before million_pod on a TPU mesh) it measures history,
+    not this solve — an assertion on it would abort on arm ordering.
+    Callers sample right after the work whose footprint they mean to
+    bound, while its buffers are still resident. The peak still rides
+    along as provenance. None on hosts whose backend reports no
+    allocator stats (CPU) — the caller's assertion is then vacuous by
+    design (the million_pod arm records the null and moves on)."""
+    fractions = []
+    peaks = []
+    for dev in device_memory_snapshot():
+        stats = dev["stats"] or {}
+        limit = stats.get("bytes_limit")
+        in_use = stats.get("bytes_in_use")
+        if limit and in_use is not None:
+            fractions.append(1.0 - in_use / limit)
+            if "peak_bytes_in_use" in stats:
+                peaks.append(1.0 - stats["peak_bytes_in_use"] / limit)
+    if not fractions:
+        return None
+    return {
+        "min_headroom_fraction": round(min(fractions), 4),
+        "min_peak_headroom_fraction": (
+            round(min(peaks), 4) if peaks else None
+        ),
+        "devices_reporting": len(fractions),
+    }
+
+
+def reset() -> None:
+    """Test hook: drop the registries (gauges keep their last values —
+    the registry has no per-series delete sweep and tests read deltas)."""
+    with _lock:
+        _compiled.clear()
+        _staging.clear()
+        _requested.clear()
+    _queue.clear()

@@ -207,7 +207,7 @@ def _compile_probe_bucket(
     import jax.numpy as jnp
     from jax import ShapeDtypeStruct as S
 
-    from karpenter_tpu.solver import faults
+    from karpenter_tpu.solver import faults, telemetry
     from karpenter_tpu.solver.pack import (
         _bucket,
         _lane_bucket,
@@ -253,10 +253,20 @@ def _compile_probe_bucket(
             # variant would be pure wasted startup time.
             wf = wavefront_plan(min(G, Gp))
             if wf > 1:
+                telemetry.record_compiled(
+                    "probe_solo",
+                    (Gp, Cp, Ep, F, mode, telemetry.variant_tag(wf)),
+                    pack_split_flat.lower(
+                        *args, max_free=F, mode=mode, wavefront=wf
+                    ).compile(),
+                )
+            telemetry.record_compiled(
+                "probe_solo",
+                (Gp, Cp, Ep, F, mode, telemetry.variant_tag(0)),
                 pack_split_flat.lower(
-                    *args, max_free=F, mode=mode, wavefront=wf
-                ).compile()
-            pack_split_flat.lower(*args, max_free=F, mode=mode).compile()
+                    *args, max_free=F, mode=mode
+                ).compile(),
+            )
         return
     Gp = _pad_axis(G)
     Lp = _lane_bucket(L)
@@ -281,10 +291,20 @@ def _compile_probe_bucket(
     # the routing floor)
     wf = wavefront_plan(G)
     if wf > 1:
+        telemetry.record_compiled(
+            "probe_lanes",
+            (Lp, Gp, Cp, Ep, F, mode, telemetry.variant_tag(wf)),
+            pack_probe_lanes_flat.lower(
+                *args, max_free=F, mode=mode, wavefront=wf
+            ).compile(),
+        )
+    telemetry.record_compiled(
+        "probe_lanes",
+        (Lp, Gp, Cp, Ep, F, mode, telemetry.variant_tag(0)),
         pack_probe_lanes_flat.lower(
-            *args, max_free=F, mode=mode, wavefront=wf
-        ).compile()
-    pack_probe_lanes_flat.lower(*args, max_free=F, mode=mode).compile()
+            *args, max_free=F, mode=mode
+        ).compile(),
+    )
 
 
 def warm_shards() -> int:
@@ -318,37 +338,29 @@ def warm_shards() -> int:
     return want if want > 1 else 0
 
 
-def _compile_bucket(
-    G: int, C: int, E: int, N: int, mode: str,
-    R: int = 4, P: int = 1, topo: bool = False, shards: int = 0,
-) -> None:
-    """AOT-compile pack_split_flat for one padded shape bucket using
-    ShapeDtypeStructs (no real arrays, no execution). The padding must
-    mirror _run_pack exactly or the warmed program never matches a real
-    solve. With `shards > 1` the structs carry the sharded solve's
-    committed input shardings (config axis split over the mesh,
-    everything else replicated), so the compiled program is the exact
-    GSPMD-partitioned one a sharded dispatch needs."""
-    import math
-
+def bucket_args(
+    Gp: int, Cp: int, Ep: int, R: int, P: int,
+    shards: int = 0, rsv_k: Optional[int] = None,
+    group_cap: bool = False, conflict: bool = False,
+    quota: bool = False,
+) -> tuple[tuple, dict]:
+    """ShapeDtypeStruct (args, kwargs) for one PADDED pack_split_flat
+    bucket — the single source of the kernel's input signature, shared
+    by the warm pool's AOT compiles and the telemetry capture worker
+    (solver/telemetry.py), so the two can never drift. With
+    `shards > 1` the structs carry the sharded solve's committed input
+    shardings (config axis split over the mesh, everything else
+    replicated). `rsv_k` is the rsv_cap row count (None: no
+    reservation inputs at all; sharded buckets always pass them —
+    pack._run_pack: an in-jit constant would fold the reservation
+    reductions into regions the SPMD partitioner rejects)."""
     import jax.numpy as jnp
     from jax import ShapeDtypeStruct as _S
 
-    from karpenter_tpu.solver import faults
-    from karpenter_tpu.solver.pack import (
-        _bucket,
-        _mesh,
-        _pad_axis,
-        pack_split_flat,
-    )
-
-    faults.fire("warm")
-    Gp = _pad_axis(G)
-    step = math.lcm(32, shards) if shards > 1 else 32
-    Cp = -(-_pad_axis(C) // step) * step
-    Ep = _pad_axis(E) if E else 0
     if shards > 1:
         from jax.sharding import NamedSharding, PartitionSpec as _P
+
+        from karpenter_tpu.solver.pack import _mesh
 
         mesh = _mesh(shards)
         _spec = {
@@ -363,11 +375,6 @@ def _compile_bucket(
     else:
         def S(shape, dtype, part=None):
             return _S(shape, dtype)
-    # N names the FRESH node axis: solve_packing_async buckets the
-    # fresh axis independently of the (already padded) bound block, so
-    # only _bucket values ever reach the kernel as max_free — deriving
-    # F any other way would compile programs no real solve can reuse
-    F = _bucket(max(N, 1))
     args = (
         S((Gp, Cp), jnp.bool_, "nc"),       # compat
         S((Gp, R), jnp.float32),            # group_req
@@ -383,20 +390,53 @@ def _compile_bucket(
         S((Cp,), jnp.float32, "cfg"),       # cfg_price
     )
     kw = {}
-    if shards > 1:
-        # sharded dispatches always pass cfg_rsv/rsv_cap as traced
-        # inputs (pack._run_pack: an in-jit constant would fold the
-        # reservation reductions into regions the SPMD partitioner
-        # rejects); warm the reservation-free K=0 shape — per-fleet
-        # reservation counts change the rsv_cap shape and can't be
-        # enumerated here
+    if shards > 1 and rsv_k is None:
+        rsv_k = 0
+    if rsv_k is not None:
         kw["cfg_rsv"] = S((Cp,), jnp.int32, "cfg")
-        kw["rsv_cap"] = S((0,), jnp.float32)
-    if topo:
+        kw["rsv_cap"] = S((rsv_k,), jnp.float32)
+    if group_cap:
         kw["group_cap"] = S((Gp,), jnp.int32)
+    if conflict:
         kw["conflict"] = S((Gp, Gp), jnp.bool_)
-        if Ep:
-            kw["bound_quota"] = S((Ep, Gp), jnp.int16)
+    if quota and Ep:
+        kw["bound_quota"] = S((Ep, Gp), jnp.int16)
+    return args, kw
+
+
+def _compile_bucket(
+    G: int, C: int, E: int, N: int, mode: str,
+    R: int = 4, P: int = 1, topo: bool = False, shards: int = 0,
+) -> None:
+    """AOT-compile pack_split_flat for one padded shape bucket using
+    ShapeDtypeStructs (no real arrays, no execution). The padding must
+    mirror _run_pack exactly or the warmed program never matches a real
+    solve (the arg construction itself lives in `bucket_args`)."""
+    import math
+
+    from karpenter_tpu.solver import faults, telemetry
+    from karpenter_tpu.solver.pack import (
+        _bucket,
+        _pad_axis,
+        pack_split_flat,
+    )
+
+    faults.fire("warm")
+    Gp = _pad_axis(G)
+    step = math.lcm(32, shards) if shards > 1 else 32
+    Cp = -(-_pad_axis(C) // step) * step
+    Ep = _pad_axis(E) if E else 0
+    # N names the FRESH node axis: solve_packing_async buckets the
+    # fresh axis independently of the (already padded) bound block, so
+    # only _bucket values ever reach the kernel as max_free — deriving
+    # F any other way would compile programs no real solve can reuse
+    F = _bucket(max(N, 1))
+    rsv_k = 0 if shards > 1 else None
+    quota = topo and Ep > 0
+    args, kw = bucket_args(
+        Gp, Cp, Ep, R, P, shards=shards, rsv_k=rsv_k,
+        group_cap=topo, conflict=topo, quota=quota,
+    )
     # a real solve of this bucket dispatches EITHER the wavefront or
     # the sequential jaxpr depending on its REAL (unpadded) group
     # count (pack.wavefront_plan); the bucket spec only knows G, so
@@ -406,10 +446,26 @@ def _compile_bucket(
 
     wf = wavefront_plan(G, shards)
     if wf > 1:
-        pack_split_flat.lower(
+        compiled = pack_split_flat.lower(
             *args, max_free=F, mode=mode, wavefront=wf, **kw
         ).compile()
-    pack_split_flat.lower(*args, max_free=F, mode=mode, **kw).compile()
+        telemetry.record_compiled(
+            "pack",
+            (Gp, Cp, Ep, F, mode,
+             telemetry.variant_tag(wf, rsv_k, topo, topo, quota)),
+            compiled, shards=shards,
+        )
+    compiled = pack_split_flat.lower(
+        *args, max_free=F, mode=mode, **kw
+    ).compile()
+    # the AOT compile already holds the Compiled object, so XLA's own
+    # memory/cost analyses are recorded for free (solver/telemetry.py)
+    telemetry.record_compiled(
+        "pack",
+        (Gp, Cp, Ep, F, mode,
+         telemetry.variant_tag(0, rsv_k, topo, topo, quota)),
+        compiled, shards=shards,
+    )
     # padded-signature registry: lets the flight recorder attribute a
     # solve's compile span to a warm-pool hit (pack.py annotates
     # warm_hit when its padded shape matches a pre-compiled bucket)
@@ -585,6 +641,13 @@ def start_background(
                 "(%d failed, %d skipped)",
                 counts["ok"], counts["error"], counts["skipped"],
             )
+            if not stop.is_set():
+                # materialize any telemetry captures queued during the
+                # warm-up (LP ascent buckets) — this thread is the one
+                # place background XLA work is sanctioned to burn CPU
+                from karpenter_tpu.solver import telemetry
+
+                telemetry.drain(timeout=30.0)
         except Exception:  # never take the operator down
             log.exception("solver warm pool crashed")
 

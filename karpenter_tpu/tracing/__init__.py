@@ -371,8 +371,17 @@ def summarize(trace_dict: Optional[dict]) -> Optional[dict]:
 
 # attrs excluded from structure(): coupled to wall-clock progress of
 # background threads (the warm pool races its compiles against early
-# ticks), so they legitimately differ across byte-identical replays
+# ticks), so they legitimately differ across byte-identical replays.
+# The "tm_" prefix marks device-telemetry attrs (solver/telemetry.py)
+# wholesale — compiled-analysis availability tracks the background
+# capture worker, and live memory_stats are timing-coupled by nature.
 _NONSTRUCTURAL_ATTRS = frozenset({"warm_hit"})
+_NONSTRUCTURAL_ATTR_PREFIX = "tm_"
+
+# events excluded from structure(): the regression sentinel flags
+# timing anomalies (metrics/sentinel.py), which machine load can trip
+# in only one of two byte-identical fault replays
+_NONSTRUCTURAL_EVENTS = frozenset({"sentinel_anomaly"})
 
 
 def structure(trace_dict: dict) -> list:
@@ -390,9 +399,11 @@ def structure(trace_dict: dict) -> list:
             tuple(sorted(
                 (k, v) for k, v in s["attrs"].items()
                 if k not in _NONSTRUCTURAL_ATTRS
+                and not k.startswith(_NONSTRUCTURAL_ATTR_PREFIX)
             )),
             tuple(
                 tuple(sorted(e.items())) for e in s["events"]
+                if e.get("name") not in _NONSTRUCTURAL_EVENTS
             ),
             [node(c) for c in children.get(s["span_id"], [])],
         ]

@@ -185,3 +185,130 @@ class TestCompareUnit:
         assert not regressions  # at the threshold is not past it
         _, regressions = compare(base, past, 0.25)
         assert regressions
+
+
+class TestMemoryGate:
+    """ISSUE 13 satellite: null-tolerant absolute-delta gating on
+    peak_rss_mb and the per-arm device-telemetry peaks."""
+
+    def test_rss_growth_past_tolerance_gates(self, tmp_path, capsys):
+        base = {"s": {"wall_s": 1.0, "peak_rss_mb": 1000.0,
+                      "peak_rss_scope": "arm"}}
+        cur = {"s": {"wall_s": 1.0, "peak_rss_mb": 1700.0,
+                     "peak_rss_scope": "arm"}}
+        rc = main([
+            _artifact(tmp_path, "b.json", base),
+            _artifact(tmp_path, "c.json", cur),
+            "--mem-tolerance", "512",
+        ])
+        assert rc == 1
+        assert "s.peak_rss_mb" in capsys.readouterr().out
+
+    def test_rss_within_tolerance_passes(self, tmp_path):
+        base = {"s": {"peak_rss_mb": 1000.0, "peak_rss_scope": "arm"}}
+        cur = {"s": {"peak_rss_mb": 1400.0, "peak_rss_scope": "arm"}}
+        rc = main([
+            _artifact(tmp_path, "b.json", base),
+            _artifact(tmp_path, "c.json", cur),
+            "--mem-tolerance", "512",
+        ])
+        assert rc == 0
+
+    def test_device_telemetry_peaks_gate_when_arm_scoped(
+        self, tmp_path, capsys
+    ):
+        base = {"s": {"device_telemetry": {
+            "compiled_peak_temp_mb": 100.0, "compiled_scope": "arm",
+            "device_peak_in_use_mb": 2000.0, "device_scope": "arm",
+        }}}
+        cur = {"s": {"device_telemetry": {
+            "compiled_peak_temp_mb": 100.0, "compiled_scope": "arm",
+            "device_peak_in_use_mb": 4000.0, "device_scope": "arm",
+        }}}
+        rc = main([
+            _artifact(tmp_path, "b.json", base),
+            _artifact(tmp_path, "c.json", cur),
+            "--mem-tolerance", "512",
+        ])
+        assert rc == 1
+        assert "device_peak_in_use_mb" in capsys.readouterr().out
+
+    def test_process_scoped_device_peaks_never_gate(
+        self, tmp_path, capsys
+    ):
+        """A process-cumulative device watermark (XLA's
+        peak_bytes_in_use has no reset) inflates with every earlier
+        arm — a big delta must report, never gate."""
+        base = {"s": {"device_telemetry": {
+            "device_peak_in_use_mb": 2000.0, "device_scope": "process",
+        }}}
+        cur = {"s": {"device_telemetry": {
+            "device_peak_in_use_mb": 9000.0, "device_scope": "process",
+        }}}
+        rc = main([
+            _artifact(tmp_path, "b.json", base),
+            _artifact(tmp_path, "c.json", cur),
+            "--mem-tolerance", "512",
+        ])
+        assert rc == 0
+        assert "not gated" in capsys.readouterr().out
+
+    def test_null_and_missing_never_gate(self, tmp_path, capsys):
+        """Pre-ISSUE-13 artifacts and CPU hosts produce nulls/absences
+        everywhere — reported loudly, exit 0 (the r05 gate depends on
+        this: no recorded round carries the new keys)."""
+        base = {"s": {"peak_rss_mb": 1000.0, "peak_rss_scope": "arm",
+                      "device_telemetry": {
+                          "compiled_peak_temp_mb": 50.0,
+                          "device_peak_in_use_mb": None,
+                      }}}
+        cur = {"s": {"device_telemetry": {
+            "compiled_peak_temp_mb": None,
+            "device_peak_in_use_mb": None,
+        }}}
+        rc = main([
+            _artifact(tmp_path, "b.json", base),
+            _artifact(tmp_path, "c.json", cur),
+        ])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "not gated" in out
+
+    def test_new_memory_key_is_reported_not_gated(self, tmp_path, capsys):
+        """The first round after telemetry lands: the baseline has no
+        memory keys at all — the current run's peaks must be VISIBLE
+        in the report without gating (no baseline to gate against)."""
+        base = {"s": {"wall_s": 1.0}}
+        cur = {"s": {"wall_s": 1.0, "peak_rss_mb": 20000.0,
+                     "peak_rss_scope": "arm"}}
+        rc = main([
+            _artifact(tmp_path, "b.json", base),
+            _artifact(tmp_path, "c.json", cur),
+        ])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "null -> 20000.0MB (new key; not gated)" in out
+
+    def test_process_scoped_watermark_never_gates(self, tmp_path, capsys):
+        """A process-lifetime VmHWM accumulates every earlier arm;
+        gating it against an arm-scoped peak would fire on ordering,
+        not memory."""
+        base = {"s": {"peak_rss_mb": 500.0, "peak_rss_scope": "arm"}}
+        cur = {"s": {"peak_rss_mb": 9000.0,
+                     "peak_rss_scope": "process"}}
+        rc = main([
+            _artifact(tmp_path, "b.json", base),
+            _artifact(tmp_path, "c.json", cur),
+            "--mem-tolerance", "512",
+        ])
+        assert rc == 0
+        assert "process-scoped" in capsys.readouterr().out
+
+    def test_mem_tolerance_boundary(self):
+        base = {"s": {"peak_rss_mb": 100.0, "peak_rss_scope": "arm"}}
+        at = {"s": {"peak_rss_mb": 612.0, "peak_rss_scope": "arm"}}
+        past = {"s": {"peak_rss_mb": 612.1, "peak_rss_scope": "arm"}}
+        _, regressions = compare(base, at, 0.25, mem_tolerance=512.0)
+        assert not regressions
+        _, regressions = compare(base, past, 0.25, mem_tolerance=512.0)
+        assert regressions

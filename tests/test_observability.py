@@ -302,6 +302,193 @@ class TestObservabilityServer:
 import urllib.error  # noqa: E402  (used in except clauses above)
 
 
+class TestSLOEndpoint:
+    """ISSUE 13 satellite: /debug/slo + the telemetry plane's gauge
+    exposition, in the same torture style as the rest of this file."""
+
+    def _operator(self):
+        kube = KubeClient()
+        cloud = KwokCloudProvider(kube)
+        return Operator(kube=kube, cloud_provider=cloud,
+                        options=Options())
+
+    def test_debug_slo_serves_the_engine_report(self):
+        op = self._operator()
+        server = op.serve_observability(port=0)
+        try:
+            op.kube.create(mk_nodepool("default"))
+            op.kube.create(mk_pod(cpu=1.0))
+            for i in range(3):
+                op.step(now=1_700_000_000.0 + i)
+            status, body = _get(server.port, "/debug/slo")
+            assert status == 200
+            report = json.loads(body)
+            assert report["ticks"] == 3
+            assert set(report["verdicts"]) == {
+                "tick_latency", "schedulability", "solve_integrity",
+                "admission", "optimality",
+            }
+            assert set(report["slis"]) == set(report["verdicts"])
+            for sli in report["slis"].values():
+                assert 0 < sli["objective"] < 1
+            assert report["thresholds"]["page_burn"] > (
+                report["thresholds"]["warn_burn"]
+            )
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/debug/slo", timeout=5
+            ) as resp:
+                assert resp.headers["Content-Type"] == "application/json"
+        finally:
+            op.stop_observability()
+
+    def test_debug_slo_404_without_a_report_callable(self):
+        """A raw ObservabilityServer (no operator, no engine) must 404
+        the path, same contract as /debug/profile."""
+        import urllib.error
+
+        from karpenter_tpu.operator.httpserv import ObservabilityServer
+
+        server = ObservabilityServer(
+            healthz=lambda: {"ok": True}, readyz=lambda: {"ok": True},
+            port=0,
+        )
+        server.start()
+        try:
+            for path in ("/debug/slo", "/debug/slo/extra",
+                         "/debug/slo?x=1/../"):
+                try:
+                    _get(server.port, path)
+                    status = 200
+                except urllib.error.HTTPError as err:
+                    status = err.code
+                assert status == 404, path
+        finally:
+            server.stop()
+
+    def test_debug_slo_report_crash_is_a_500_not_a_hang(self):
+        import urllib.error
+
+        from karpenter_tpu.operator.httpserv import ObservabilityServer
+
+        server = ObservabilityServer(
+            healthz=lambda: {"ok": True}, readyz=lambda: {"ok": True},
+            port=0,
+            slo_report=lambda: (_ for _ in ()).throw(RuntimeError("boom")),
+        )
+        server.start()
+        try:
+            try:
+                _get(server.port, "/debug/slo")
+                status = 200
+            except urllib.error.HTTPError as err:
+                status = err.code
+                body = err.read().decode()
+                assert "boom" in body
+            assert status == 500
+        finally:
+            server.stop()
+
+    def test_slo_and_sentinel_gauges_expose_on_metrics(self):
+        """The new registrations render as well-formed Prometheus
+        text: TYPE lines, label pairs, and escaping through a hostile
+        signal name fed via the sentinel."""
+        from karpenter_tpu.metrics import sentinel as sentinel_mod
+
+        op = self._operator()
+        server = op.serve_observability(port=0)
+        try:
+            op.kube.create(mk_nodepool("default"))
+            op.step(now=1_700_000_000.0)
+            hostile = 'sig"quote\\slash\nline'
+            for _ in range(3):
+                sentinel_mod.observe(hostile, 0.01)
+            status, text = _get(server.port, "/metrics")
+            assert status == 200
+            assert "# TYPE karpenter_slo_burn_rate gauge" in text
+            assert (
+                'karpenter_slo_burn_rate{slo="tick_latency",'
+                'window="short"}' in text
+            )
+            assert (
+                'karpenter_slo_burn_rate{slo="tick_latency",'
+                'window="long"}' in text
+            )
+            assert 'karpenter_slo_ok{slo="tick_latency"} 1' in text
+            assert (
+                'karpenter_slo_error_budget_remaining'
+                '{slo="schedulability"} 1' in text
+            )
+            assert "# TYPE karpenter_slo_alerts_total counter" in text
+            assert "# TYPE karpenter_sentinel_baseline gauge" in text
+            # escaping torture: quote -> \", backslash -> \\, real
+            # newline -> literal \n, exactly once each
+            assert (
+                'karpenter_sentinel_baseline{signal='
+                '"sig\\"quote\\\\slash\\nline",stat="ewma"}' in text
+            )
+            assert "# TYPE karpenter_sentinel_anomaly_total counter" in text
+            assert "# TYPE karpenter_device_memory_bytes gauge" in text
+        finally:
+            op.stop_observability()
+
+    def test_device_telemetry_gauges_expose_with_bucket_labels(self):
+        from karpenter_tpu.solver import telemetry, warm_pool
+
+        telemetry.reset()
+        warm_pool._compile_bucket(16, 256, 0, 64, "ffd")
+        op = self._operator()
+        server = op.serve_observability(port=0)
+        try:
+            status, text = _get(server.port, "/metrics")
+            assert status == 200
+            assert (
+                "# TYPE karpenter_device_compiled_memory_bytes gauge"
+                in text
+            )
+            line = next(
+                ln for ln in text.splitlines()
+                if ln.startswith("karpenter_device_compiled_memory_bytes")
+                and 'component="temp"' in ln
+                # other suites may have recorded probe/lp buckets into
+                # the process registry first — pick the pack kernel's
+                and 'kernel="pack"' in ln
+            )
+            assert 'shards="0"' in line
+            assert float(line.rsplit(" ", 1)[1]) > 0
+            assert (
+                'karpenter_device_compiled_cost{' in text
+                and 'stat="flops"' in text
+            )
+        finally:
+            op.stop_observability()
+
+    def test_readyz_slo_digest_rides_the_probe(self):
+        """readyz()["slo"] over real HTTP: the digest is in the probe
+        body and stays there when the probe goes 503 for OTHER reasons
+        (a burning SLO must not hide behind an unsynced mirror)."""
+        import urllib.error
+
+        op = self._operator()
+        server = op.serve_observability(port=0)
+        try:
+            op.kube.create(mk_nodepool("default"))
+            op.step(now=1_700_000_000.0)
+            status, body = _get(server.port, "/readyz")
+            assert status == 200
+            digest = json.loads(body)["slo"]
+            assert digest["ticks"] == 1
+            assert digest["worst"] in ("ok", "warn", "page")
+            op.cluster.synced = lambda: False
+            try:
+                _get(server.port, "/readyz")
+                raise AssertionError("expected 503")
+            except urllib.error.HTTPError as err:
+                assert err.code == 503
+                assert json.loads(err.read().decode())["slo"]["ticks"] == 1
+        finally:
+            op.stop_observability()
+
+
 class TestEntrypoint:
     def test_boot_provision_shutdown_resume(self, tmp_path):
         """kwok/main.go parity: the module boots as a process, the demo

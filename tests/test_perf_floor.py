@@ -550,36 +550,30 @@ def test_resilience_wrapper_overhead_under_5_percent():
     solve_packing(enc, mode="ffd")          # compile the shape bucket
     rs.solve_packing(enc, mode="ffd")       # and the wrapper's path
 
-    # INTERLEAVED best-of-N with EARLY EXIT: measuring the two sides
-    # in separate blocks lets a load shift between the blocks (other
-    # tests' GC, CI noisy neighbors) masquerade as wrapper overhead —
-    # alternating iterations expose both sides to the same noise, and
-    # sampling stops the moment the floor is satisfied (after a
-    # minimum of 5 rounds) so a single load spike early in the run
-    # cannot doom the remaining fixed-count samples. A systematic >5%
-    # overhead still fails: no sample combination can satisfy the
-    # floor. The 2ms absolute grace absorbs scheduler-quantum jitter
-    # the min can't; GC off so a collection landing inside one side's
-    # solve can't masquerade as overhead (same rationale as the kube
-    # funnel guard below). This flaked under full-suite CPU contention
-    # at fixed best-of-20 (CHANGES.md) — same pattern as the tracing
-    # overhead guard.
-    import gc as _gc
+    # Interleaved best-of-N with early exit via the SHARED helper
+    # (karpenter_tpu.testing.interleaved_best_of — this guard is where
+    # the pattern was grown; it flaked under full-suite CPU contention
+    # at fixed best-of-20, CHANGES.md). The 2ms absolute grace absorbs
+    # scheduler-quantum jitter the min can't.
+    from karpenter_tpu.testing import interleaved_best_of
 
-    direct = wrapped = float("inf")
-    _gc.disable()
-    try:
-        for i in range(40):
+    def timed(fn):
+        def sample():
             t0 = time.perf_counter()
-            solve_packing(enc, mode="ffd")
-            direct = min(direct, time.perf_counter() - t0)
-            t0 = time.perf_counter()
-            rs.solve_packing(enc, mode="ffd")
-            wrapped = min(wrapped, time.perf_counter() - t0)
-            if i >= 4 and wrapped < direct * 1.05 + 0.002:
-                break
-    finally:
-        _gc.enable()
+            fn()
+            return time.perf_counter() - t0
+        return sample
+
+    best = interleaved_best_of(
+        {
+            "direct": timed(lambda: solve_packing(enc, mode="ffd")),
+            "wrapped": timed(lambda: rs.solve_packing(enc, mode="ffd")),
+        },
+        rounds=40,
+        min_rounds=5,
+        satisfied=lambda b: b["wrapped"] < b["direct"] * 1.05 + 0.002,
+    )
+    direct, wrapped = best["direct"], best["wrapped"]
     assert wrapped < direct * 1.05 + 0.002, (
         f"resilient solve {wrapped * 1000:.2f}ms vs direct "
         f"{direct * 1000:.2f}ms — wrapper overhead above 5%"
@@ -787,18 +781,88 @@ def test_tracing_overhead_under_5_percent(monkeypatch):
 
     sample("1")
     sample("0")
-    import gc as _gc
+    from karpenter_tpu.testing import interleaved_best_of
 
-    with_trace = without = float("inf")
-    _gc.disable()
     try:
-        for _ in range(20):
-            with_trace = min(with_trace, sample("1"))
-            without = min(without, sample("0"))
+        # the shared interleaved best-of-N helper, WITH early exit —
+        # the fixed-count loop this guard originally ran flaked under
+        # suite load in two of four rounds (ISSUE 13 satellite)
+        best = interleaved_best_of(
+            {"traced": lambda: sample("1"),
+             "untraced": lambda: sample("0")},
+            rounds=20,
+            min_rounds=5,
+            satisfied=lambda b: (
+                b["traced"] < b["untraced"] * 1.05 + 0.002
+            ),
+        )
     finally:
-        _gc.enable()
         tracing.clear()
+    with_trace, without = best["traced"], best["untraced"]
     assert with_trace < without * 1.05 + 0.002, (
         f"traced steady tick {with_trace * 1000:.2f}ms vs untraced "
         f"{without * 1000:.2f}ms — flight-recorder overhead above 5%"
+    )
+
+
+def test_telemetry_plane_overhead_under_5_percent(monkeypatch):
+    """ISSUE-13 guard: the telemetry plane runs INLINE on every tick —
+    sentinel baselines over the solver phases + tick wall, SLO
+    evaluation with its burn-window gauges, and the device-telemetry
+    hooks on the solve path — so its healthy-path cost, measured
+    TOGETHER, must stay under 5% of the steady-state tick. Interleaved
+    best-of-N via the shared helper, the three kill switches flipped
+    per sample."""
+    from karpenter_tpu import tracing
+    from karpenter_tpu.cloudprovider.fake import GIB, make_instance_type
+    from karpenter_tpu.operator.operator import Operator
+    from karpenter_tpu.operator.options import Options
+    from karpenter_tpu.testing import Environment, interleaved_best_of
+
+    monkeypatch.delenv("KARPENTER_FAULTS", raising=False)
+    types = [make_instance_type("c4", cpu=4, memory=16 * GIB, price=1.0)]
+    env = Environment(types=types)
+    pool = mk_nodepool("p")
+    pool.spec.disruption.consolidate_after = "Never"
+    env.kube.create(pool)
+    env.provision(
+        *[mk_pod(name=f"tp-{i}", cpu=1.0, memory=2 * GIB)
+          for i in range(240)]
+    )
+    op = Operator(kube=env.kube, cloud_provider=env.cloud,
+                  options=Options())
+    now = time.time()
+    op.step(now=now)
+    op.step(now=now + 1)
+
+    tick = {"i": 0}
+
+    def sample(flag: str) -> float:
+        for knob in ("KARPENTER_SENTINEL", "KARPENTER_SLO",
+                     "KARPENTER_DEVICE_TELEMETRY"):
+            monkeypatch.setenv(knob, flag)
+        tick["i"] += 1
+        t0 = time.perf_counter()
+        # 0.9s spacing stays inside every periodic interval
+        op.step(now=now + 2 + tick["i"] * 0.9)
+        return time.perf_counter() - t0
+
+    sample("1")
+    sample("0")
+    try:
+        best = interleaved_best_of(
+            {"armed": lambda: sample("1"),
+             "disarmed": lambda: sample("0")},
+            rounds=20,
+            min_rounds=5,
+            satisfied=lambda b: (
+                b["armed"] < b["disarmed"] * 1.05 + 0.002
+            ),
+        )
+    finally:
+        tracing.clear()
+    armed, disarmed = best["armed"], best["disarmed"]
+    assert armed < disarmed * 1.05 + 0.002, (
+        f"telemetry-armed steady tick {armed * 1000:.2f}ms vs disarmed "
+        f"{disarmed * 1000:.2f}ms — telemetry-plane overhead above 5%"
     )

@@ -372,18 +372,36 @@ class TestDeadlines:
         the result assertion holds every attempt; the timing-coupled
         win-counter assertion must hold on at least one of three —
         a systematically broken hedge still fails all three."""
+        from karpenter_tpu.testing import interleaved_best_of
+
         enc = _enc(seed=37)
         monkeypatch.setenv("KARPENTER_FAULTS", "exec_delay=1.5s")
         monkeypatch.setenv("KARPENTER_SOLVE_DEADLINE_MS", "500")
         monkeypatch.setenv("KARPENTER_SOLVE_HEDGE_MS", "50")
-        for attempt in range(3):
+
+        def attempt() -> float:
             faults.reset()
             wins = SOLVER_HEDGE.value({"outcome": "win"})
             out = resilience.shared().solve_packing(enc, mode="ffd")
+            # the RESULT must be right on every attempt; only the
+            # timing-coupled win counter gets the best-of-N retry
             assert _same_pack(out, host_pack_result(enc))
-            if SOLVER_HEDGE.value({"outcome": "win"}) == wins + 1:
-                return
-        raise AssertionError(
+            return float(
+                SOLVER_HEDGE.value({"outcome": "win"}) == wins + 1
+            )
+
+        # the shared interleaved best-of-N helper, degenerate single
+        # side with reduce=max: early exit on the first win, up to 3
+        # attempts — a systematically broken hedge still fails all 3
+        best = interleaved_best_of(
+            {"hedge_won": attempt},
+            rounds=3,
+            min_rounds=1,
+            satisfied=lambda b: b["hedge_won"] >= 1.0,
+            reduce=max,
+            disable_gc=False,
+        )
+        assert best["hedge_won"] >= 1.0, (
             "hedge never supplied the degraded answer in 3 attempts"
         )
 

@@ -1,0 +1,169 @@
+"""In-process regression sentinel (ISSUE 13 tentpole part 3)."""
+
+import pytest
+
+from karpenter_tpu import tracing
+from karpenter_tpu.metrics import sentinel
+from karpenter_tpu.metrics.sentinel import Sentinel
+from karpenter_tpu.metrics.store import SENTINEL_ANOMALIES
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    for knob in ("KARPENTER_SENTINEL", "KARPENTER_SENTINEL_WARMUP",
+                 "KARPENTER_SENTINEL_K", "KARPENTER_SENTINEL_ALPHA",
+                 "KARPENTER_SENTINEL_FLOOR_MS"):
+        monkeypatch.delenv(knob, raising=False)
+    sentinel.reset()
+    tracing.clear()
+    yield
+    sentinel.reset()
+    tracing.clear()
+
+
+class TestBaselines:
+    def test_warmup_suppresses_flags(self, monkeypatch):
+        """Nothing flags before WARMUP samples — a fresh process has no
+        baseline to regress against."""
+        monkeypatch.setenv("KARPENTER_SENTINEL_WARMUP", "10")
+        s = Sentinel()
+        for _ in range(9):
+            assert not s.observe("sig", 0.01)
+        # sample 10 arrives with only 9 baselined — still warmup
+        assert not s.observe("sig", 50.0)
+        # the spike became sample 10; the NEXT spike is eligible
+        assert s.observe("sig", 100.0)
+
+    def test_steady_signal_never_flags(self):
+        s = Sentinel()
+        for _ in range(200):
+            assert not s.observe("sig", 0.050)
+
+    def test_regression_flags_then_becomes_the_new_normal(self, monkeypatch):
+        """A 10x step change flags immediately; after ~1/alpha samples
+        at the new level the baselines absorb it and flags stop — the
+        counter records the transition, which is the signal."""
+        monkeypatch.setenv("KARPENTER_SENTINEL_ALPHA", "0.2")
+        s = Sentinel()
+        for _ in range(30):
+            s.observe("sig", 0.050)
+        assert s.observe("sig", 0.500), "step change did not flag"
+        flags = sum(s.observe("sig", 0.500) for _ in range(60))
+        assert not s.observe("sig", 0.500), (
+            "baseline never absorbed the new level"
+        )
+        assert flags < 60
+
+    def test_floor_absorbs_microsecond_jitter(self, monkeypatch):
+        """Sub-floor deviations never flag even at huge MAD multiples:
+        steady-state encode runs sub-millisecond and scheduler jitter
+        there is not a regression."""
+        monkeypatch.setenv("KARPENTER_SENTINEL_FLOOR_MS", "5")
+        s = Sentinel()
+        for _ in range(50):
+            s.observe("sig", 0.0001)
+        assert not s.observe("sig", 0.004)   # +3.9ms: under the floor
+        assert s.observe("sig", 0.020)       # +19.9ms: over it
+
+    def test_ewma_and_mad_update_without_wall_clock(self):
+        """The baseline is a pure function of the sample SEQUENCE —
+        pinned arithmetic, no time source anywhere."""
+        s = Sentinel()
+        s.observe("sig", 1.0)
+        s.observe("sig", 2.0)
+        summary = s.summary()["sig"]
+        # first sample seeds ewma=1.0; second: ewma += 0.05*(2-1),
+        # and the deviation (vs the PRE-update ewma, |2-1|=1) folds
+        # into the MAD estimate as 0.05*1
+        assert summary["ewma_ms"] == pytest.approx(1050.0)
+        assert summary["mad_ms"] == pytest.approx(0.05 * 1000.0)
+        assert summary["samples"] == 2
+
+    def test_kill_switch_and_bad_input_never_raise(self, monkeypatch):
+        s = Sentinel()
+        assert not s.observe("sig", float("nan"))
+        assert not s.observe("sig", float("inf"))
+        assert not s.observe("sig", None)    # type: ignore[arg-type]
+        # non-finite samples are dropped BEFORE the baselines: nothing
+        # was recorded, and no NaN ever lands on the baseline gauges
+        assert "sig" not in s.summary()
+        monkeypatch.setenv("KARPENTER_SENTINEL", "0")
+        assert not s.observe("sig", 99.0)
+
+    def test_nan_gauge_renders_instead_of_killing_the_scrape(self):
+        """Belt and braces for the exposition itself: a poisoned NaN
+        series renders as the literal NaN the text format specifies,
+        never a ValueError mid-scrape."""
+        from karpenter_tpu.metrics.exposition import render
+        from karpenter_tpu.metrics.store import Registry
+
+        reg = Registry()
+        reg.gauge("t_poison", "x").set(float("nan"))
+        reg.gauge("t_neg", "x").set(float("-inf"))
+        text = render(reg)
+        assert "t_poison NaN" in text
+        assert "t_neg -Inf" in text
+
+
+class TestWiring:
+    def test_anomaly_lands_on_counter_and_span_event(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_SENTINEL_WARMUP", "5")
+        before = SENTINEL_ANOMALIES.value({"signal": "solve.compile"})
+        with tracing.trace("tick"):
+            for _ in range(10):
+                sentinel.observe_phase("compile", 0.01)
+            assert sentinel.observe_phase("compile", 9.0)
+        assert SENTINEL_ANOMALIES.value(
+            {"signal": "solve.compile"}
+        ) == before + 1
+        trace = tracing.last_trace()
+        events = [e for s in trace["spans"] for e in s["events"]]
+        anomaly = [e for e in events if e["name"] == "sentinel_anomaly"]
+        assert anomaly and anomaly[0]["signal"] == "solve.compile"
+        assert anomaly[0]["value_ms"] == 9000.0
+
+    def test_anomaly_event_is_nonstructural(self):
+        """Machine load can trip the sentinel in only one of two
+        byte-identical fault replays — structure() must not see it."""
+        with tracing.trace("a"):
+            with tracing.span("s"):
+                tracing.add_event("sentinel_anomaly", signal="x",
+                                  value_ms=1.0)
+        with tracing.trace("b"):
+            with tracing.span("s"):
+                pass
+        a, b = tracing.traces()
+        assert tracing.structure(a)[0][3] == tracing.structure(b)[0][3]
+
+    def test_solver_phases_feed_the_shared_sentinel(self):
+        """A real solve observes every phase into the process
+        sentinel: encode, transfer, compile, execute, decode."""
+        from karpenter_tpu.cloudprovider.fake import instance_types
+        from karpenter_tpu.solver.solver import solve
+        from karpenter_tpu.testing import mk_nodepool, mk_pod
+
+        sentinel.reset()
+        solve(
+            [mk_pod(name=f"sw-{i}", cpu=1.0) for i in range(20)],
+            [(mk_nodepool("default"), instance_types(5))],
+        )
+        signals = set(sentinel.summary())
+        assert {"solve.encode", "solve.transfer", "solve.compile",
+                "solve.execute", "solve.decode"} <= signals, signals
+
+    def test_operator_tick_feeds_tick_wall(self):
+        from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
+        from karpenter_tpu.kube.client import KubeClient
+        from karpenter_tpu.operator.operator import Operator
+        from karpenter_tpu.operator.options import Options
+        from karpenter_tpu.testing import mk_nodepool
+
+        sentinel.reset()
+        kube = KubeClient()
+        op = Operator(kube=kube, cloud_provider=KwokCloudProvider(kube),
+                      options=Options())
+        kube.create(mk_nodepool("default"))
+        op.step(now=1_700_000_000.0)
+        summary = sentinel.summary()
+        assert "tick_wall" in summary
+        assert summary["tick_wall"]["samples"] == 1

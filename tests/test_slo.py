@@ -1,0 +1,239 @@
+"""SLO burn-rate engine (ISSUE 13 tentpole part 2).
+
+Two contracts under test: window arithmetic pinned at boundaries under
+the injectable clock, and byte-identical verdicts/burn windows between
+a faulted operator run and its byte-identical fault replay."""
+
+import json
+
+import pytest
+
+from karpenter_tpu.metrics import slo
+from karpenter_tpu.metrics.slo import SLI, SLOEngine
+from karpenter_tpu.solver import faults
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    for knob in ("KARPENTER_SLO", "KARPENTER_SLO_WINDOW_SHORT",
+                 "KARPENTER_SLO_WINDOW_LONG", "KARPENTER_SLO_WARN_BURN",
+                 "KARPENTER_SLO_PAGE_BURN", "KARPENTER_FAULTS"):
+        monkeypatch.delenv(knob, raising=False)
+    slo.reset_last_digest()
+    yield
+    slo.reset_last_digest()
+
+
+def _good(signals):
+    return signals["good"]
+
+
+def _engine(monkeypatch, short=3, long=6, objective=0.5):
+    monkeypatch.setenv("KARPENTER_SLO_WINDOW_SHORT", str(short))
+    monkeypatch.setenv("KARPENTER_SLO_WINDOW_LONG", str(long))
+    return SLOEngine(
+        slis=(SLI("t", "test sli", objective, _good),),
+        clock=lambda: 0.0,
+    )
+
+
+class TestWindowArithmetic:
+    def test_burn_rate_exact_at_window_boundaries(self, monkeypatch):
+        """objective 0.5 => error budget 0.5 => burn = 2 x bad_frac.
+        Feed bad,good,bad into short window 3 / long window 6 and pin
+        every intermediate value."""
+        eng = _engine(monkeypatch)
+        d = eng.observe_tick({"good": (0.0, 1.0)})
+        assert d["verdicts"]["t"]["burn_short"] == 2.0   # 1/1 bad
+        d = eng.observe_tick({"good": (1.0, 1.0)})
+        assert d["verdicts"]["t"]["burn_short"] == 1.0   # 1/2 bad
+        d = eng.observe_tick({"good": (0.0, 1.0)})
+        assert d["verdicts"]["t"]["burn_short"] == pytest.approx(4 / 3)
+        # tick 4: the short window slides — the first bad tick falls
+        # out of the 3-tick window (good,bad remain + this good)
+        d = eng.observe_tick({"good": (1.0, 1.0)})
+        assert d["verdicts"]["t"]["burn_short"] == pytest.approx(2 / 3)
+        # long window still sees all 4 ticks: 2 bad / 4 => burn 1.0
+        assert d["verdicts"]["t"]["burn_long"] == 1.0
+
+    def test_long_window_evicts_at_exactly_maxlen(self, monkeypatch):
+        """6 bad ticks then 6 good ticks: at tick 12 the long window
+        holds ONLY the good ticks — burn must be exactly 0."""
+        eng = _engine(monkeypatch)
+        for _ in range(6):
+            eng.observe_tick({"good": (0.0, 1.0)})
+        last = None
+        for _ in range(6):
+            last = eng.observe_tick({"good": (1.0, 1.0)})
+        assert last["verdicts"]["t"]["burn_long"] == 0.0
+        assert last["verdicts"]["t"]["data_ticks"] == 6
+
+    def test_dataless_ticks_do_not_move_the_budget(self, monkeypatch):
+        """evaluate() returning None (no cost solve ran, so no gap)
+        must neither consume nor replenish the window."""
+        eng = _engine(monkeypatch)
+        eng.observe_tick({"good": (0.0, 1.0)})
+        before = eng.digest()["verdicts"]["t"]
+        for _ in range(10):
+            eng.observe_tick({})   # KeyError inside evaluate -> None
+        after = eng.digest()["verdicts"]["t"]
+        assert after["burn_short"] == before["burn_short"]
+        assert after["data_ticks"] == 1
+
+    def test_multiwindow_alerting_requires_both_windows(self, monkeypatch):
+        """A short-window spike alone must not page: the long window
+        is the blip suppressor. Alerts count state TRANSITIONS."""
+        from karpenter_tpu.metrics.store import SLO_ALERTS
+
+        monkeypatch.setenv("KARPENTER_SLO_PAGE_BURN", "2.0")
+        monkeypatch.setenv("KARPENTER_SLO_WARN_BURN", "1.5")
+        eng = _engine(monkeypatch, short=2, long=8)
+        for _ in range(8):
+            eng.observe_tick({"good": (1.0, 1.0)})
+        # two bad ticks: short burn = 2.0 but long = 2/8*2 = 0.5
+        eng.observe_tick({"good": (0.0, 1.0)})
+        d = eng.observe_tick({"good": (0.0, 1.0)})
+        assert d["verdicts"]["t"]["burn_short"] == 2.0
+        assert d["verdicts"]["t"]["state"] == "ok"
+        # sustain the badness until the long window burns too
+        pages0 = SLO_ALERTS.value({"slo": "t", "severity": "page"})
+        last = None
+        for _ in range(8):
+            last = eng.observe_tick({"good": (0.0, 1.0)})
+        assert last["verdicts"]["t"]["state"] == "page"
+        assert last["worst"] == "page"
+        # one transition into page, not one increment per burning tick
+        assert SLO_ALERTS.value(
+            {"slo": "t", "severity": "page"}
+        ) == pages0 + 1
+
+    def test_kill_switch(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_SLO", "0")
+        eng = _engine(monkeypatch)
+        d = eng.observe_tick({"good": (0.0, 1.0)})
+        assert d == {"enabled": False, "ticks": 0}
+
+
+class TestDefaultSLIs:
+    def test_tick_latency_budget_boundary(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_SLO_TICK_BUDGET_MS", "100")
+        from karpenter_tpu.metrics.slo import _tick_latency
+
+        assert _tick_latency({"tick_wall_s": 0.1}) == (1.0, 1.0)
+        assert _tick_latency({"tick_wall_s": 0.1001}) == (0.0, 1.0)
+        assert _tick_latency({}) is None
+
+    def test_optimality_gap_threshold(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_SLO_GAP_MAX", "0.02")
+        from karpenter_tpu.metrics.slo import _optimality
+
+        assert _optimality({"gap_vs_lp": 0.02}) == (1.0, 1.0)
+        assert _optimality({"gap_vs_lp": 0.03}) == (0.0, 1.0)
+        assert _optimality({}) is None
+
+    def test_note_buffer_drains_once(self):
+        slo.note("gap_vs_lp", 0.01)
+        slo.note("gap_vs_lp", 0.02)   # last value wins within a tick
+        assert slo.take_noted() == {"gap_vs_lp": 0.02}
+        assert slo.take_noted() == {}
+
+    def test_unscheduled_pod_ticks_accumulate(self, monkeypatch):
+        eng = SLOEngine(clock=lambda: 0.0)
+        eng.observe_tick({"tick_wall_s": 0.01, "unschedulable_pods": 3,
+                          "oracle_divergences": 0, "priority_shed": 0})
+        eng.observe_tick({"tick_wall_s": 0.01, "unschedulable_pods": 2,
+                          "oracle_divergences": 0, "priority_shed": 0})
+        assert eng.digest()["unscheduled_pod_ticks"] == 5.0
+
+
+@pytest.mark.chaos
+class TestChaosDeterminism:
+    def _run(self, spec, monkeypatch, ticks=6):
+        """One operator run under `spec` with an injected SLO clock;
+        returns (slo report, fault replay log)."""
+        from karpenter_tpu import tracing
+        from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
+        from karpenter_tpu.kube.client import KubeClient
+        from karpenter_tpu.metrics.slo import SLOEngine
+        from karpenter_tpu.operator.operator import Operator
+        from karpenter_tpu.operator.options import Options
+        from karpenter_tpu.testing import mk_nodepool, mk_pod
+
+        monkeypatch.setenv("KARPENTER_FAULTS", spec)
+        monkeypatch.setenv("KARPENTER_FAULT_SEED", "11")
+        faults.reset()
+        tracing.clear()
+        slo.reset_last_digest()
+        kube = KubeClient()
+        op = Operator(kube=kube, cloud_provider=KwokCloudProvider(kube),
+                      options=Options())
+        # the injectable clock: each tick's wall is exactly one unit,
+        # so the tick-latency SLI sees identical values in both runs
+        counter = iter(range(10_000))
+        op.slo = SLOEngine(clock=lambda: float(next(counter)))
+        kube.create(mk_nodepool("default"))
+        for i in range(4):
+            kube.create(mk_pod(name=f"sd-{i}", cpu=1.0))
+        base = 1_700_000_000.0
+        op.provisioner.batcher.trigger(now=base)
+        for i in range(ticks):
+            op.step(now=base + 2 + i)
+        inj = faults.get()
+        log = inj.snapshot_log() if inj is not None else []
+        tracing.clear()
+        return op.slo.report(), log
+
+    def test_faulted_run_and_replay_have_identical_verdicts(
+        self, monkeypatch
+    ):
+        """The acceptance criterion: a chaos run and its byte-identical
+        replay produce byte-identical SLO verdicts AND burn windows —
+        the whole report compares equal as JSON."""
+        spec = "device_lost@solve:2,kube_conflict@kube_write:1"
+        r1, log1 = self._run(spec, monkeypatch)
+        r2, log2 = self._run(spec, monkeypatch)
+        assert log1 == log2, "fault replay itself diverged"
+        assert json.dumps(r1, sort_keys=True) == json.dumps(
+            r2, sort_keys=True
+        )
+        # the run evaluated real ticks, not an empty engine
+        assert r1["ticks"] >= 6
+        assert r1["verdicts"]
+
+    def test_clean_run_matches_its_own_replay_too(self, monkeypatch):
+        r1, _ = self._run("", monkeypatch)
+        r2, _ = self._run("", monkeypatch)
+        assert json.dumps(r1, sort_keys=True) == json.dumps(
+            r2, sort_keys=True
+        )
+
+
+class TestOperatorWiring:
+    def test_readyz_carries_slo_digest(self):
+        from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
+        from karpenter_tpu.kube.client import KubeClient
+        from karpenter_tpu.operator.operator import Operator
+        from karpenter_tpu.operator.options import Options
+        from karpenter_tpu.testing import mk_nodepool, mk_pod
+
+        kube = KubeClient()
+        op = Operator(kube=kube, cloud_provider=KwokCloudProvider(kube),
+                      options=Options())
+        digest = op.readyz()["slo"]
+        assert digest["ticks"] == 0 and digest["worst"] == "ok"
+        kube.create(mk_nodepool("default"))
+        kube.create(mk_pod(name="rz-0", cpu=1.0))
+        for i in range(3):
+            op.step(now=1_700_000_000.0 + i)
+        digest = op.readyz()["slo"]
+        assert digest["ticks"] == 3
+        assert set(digest["verdicts"]) == {
+            "tick_latency", "schedulability", "solve_integrity",
+            "admission", "optimality",
+        }
+        assert digest["worst"] in ("ok", "warn", "page")
+        json.dumps(op.readyz())   # the whole probe stays serializable
+        # a live tick also published the process-global digest bench
+        # arms read
+        assert slo.last_digest() is not None
+        assert slo.last_digest()["ticks"] == 3

@@ -1,0 +1,305 @@
+"""Device cost/memory accounting (ISSUE 13 tentpole part 1).
+
+Null-safety is the acceptance bar: every surface must produce
+well-formed (possibly-null) output on CPU-only hosts with no
+memory_stats(), and the registries must fill from both the warm pool's
+AOT compiles and the cold-dispatch background capture."""
+
+import json
+
+import pytest
+
+from karpenter_tpu.cloudprovider.fake import instance_types
+from karpenter_tpu.solver import telemetry
+from karpenter_tpu.testing import mk_nodepool, mk_pod
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    telemetry.reset()
+    yield
+    telemetry.reset()
+
+
+class TestCompiledAccounting:
+    def test_warm_pool_compile_records_memory_and_cost(self):
+        """An AOT bucket compile holds the Compiled object, so XLA's
+        memory_analysis and cost_analysis land in the registry (and
+        the gauges) for free."""
+        from karpenter_tpu.metrics.store import (
+            DEVICE_COMPILED_COST,
+            DEVICE_COMPILED_MEMORY,
+        )
+        from karpenter_tpu.solver import warm_pool
+
+        warm_pool._compile_bucket(16, 256, 0, 64, "ffd")
+        snap = telemetry.snapshot()
+        assert snap["compiled"], "no compiled entry recorded"
+        key, entry = next(iter(snap["compiled"].items()))
+        assert key.startswith("pack[")
+        assert entry["source"] == "warm_pool"
+        # XLA:CPU reports real byte counts for all four components
+        assert set(entry["memory"]) == {
+            "argument", "output", "temp", "generated_code"
+        }
+        assert entry["memory"]["temp"] > 0
+        assert entry["cost"]["flops"] > 0
+        assert entry["cost"]["bytes_accessed"] > 0
+        # the roll-up bench_compare gates on
+        assert snap["compiled_peak_temp_mb"] > 0
+        # gauges carry the same numbers
+        assert any(
+            dict(pairs).get("component") == "temp" and value > 0
+            for pairs, value in DEVICE_COMPILED_MEMORY.samples()
+        )
+        assert any(
+            dict(pairs).get("stat") == "flops" and value > 0
+            for pairs, value in DEVICE_COMPILED_COST.samples()
+        )
+
+    def test_cold_solve_captures_cost_on_drain(self):
+        """A cold `_run_pack` dispatch (no warm-pool bucket) enqueues
+        its padded signature; drain() lowers the same shapes once in
+        the caller's thread and records cost analysis — the tick path
+        itself never pays the lowering."""
+        from karpenter_tpu.solver.encode import encode, group_pods
+        from karpenter_tpu.solver.pack import solve_packing
+
+        pods = [mk_pod(name=f"ct-{i}", cpu=1.0) for i in range(40)]
+        enc = encode(group_pods(pods),
+                     [(mk_nodepool("default"), instance_types(10))])
+        solve_packing(enc, mode="ffd")
+        assert telemetry.drain(30.0), "capture worker did not drain"
+        snap = telemetry.snapshot()
+        pack_entries = {
+            k: v for k, v in (snap["compiled"] or {}).items()
+            if k.startswith("pack[")
+        }
+        assert pack_entries, "cold dispatch recorded no pack bucket"
+        entry = next(iter(pack_entries.values()))
+        assert entry["source"] == "cold_lowering"
+        assert entry["cost"]["flops"] > 0
+        # auto mode lowers but never compiles: memory stays null
+        assert entry["memory"] is None
+
+    def test_force_mode_compiles_cold_buckets_for_memory(self, monkeypatch):
+        """KARPENTER_DEVICE_TELEMETRY=force pays one analysis compile
+        per cold bucket so memory_analysis exists everywhere."""
+        monkeypatch.setenv("KARPENTER_DEVICE_TELEMETRY", "force")
+        telemetry._capture_pack(dict(
+            Gp=16, Cp=32, Ep=0, F=32, R=4, P=1, mode="ffd",
+            wavefront=0, shards=0, rsv_k=None, group_cap=False,
+            conflict=False, quota=False,
+        ))
+        entry = telemetry.compiled_entry(
+            "pack", (16, 32, 0, 32, "ffd", telemetry.variant_tag(0))
+        )
+        assert entry is not None
+        assert entry["memory"] is not None
+        assert entry["memory"]["temp"] > 0
+
+    def test_warm_record_never_downgraded_by_cost_only_capture(self):
+        """A warm-pool record (memory + cost) must survive a later
+        cost-only capture of the same bucket."""
+        class FakeCompiled:
+            def memory_analysis(self):
+                class S:
+                    argument_size_in_bytes = 10
+                    output_size_in_bytes = 20
+                    temp_size_in_bytes = 30
+                    generated_code_size_in_bytes = 0
+                return S()
+
+            def cost_analysis(self):
+                return [{"flops": 5.0, "bytes accessed": 7.0}]
+
+        class FakeLowered:
+            def cost_analysis(self):
+                return {"flops": 5.0, "bytes accessed": 7.0}
+
+        telemetry.record_compiled("pack", (1, 2, 3), FakeCompiled())
+        telemetry.record_lowered("pack", (1, 2, 3), FakeLowered())
+        entry = telemetry.compiled_entry("pack", (1, 2, 3))
+        assert entry["memory"] == {"argument": 10, "output": 20,
+                                   "temp": 30, "generated_code": 0}
+        assert entry["source"] == "warm_pool"
+
+    def test_kill_switch_records_nothing(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_DEVICE_TELEMETRY", "0")
+        assert not telemetry.enabled()
+
+        class Boom:
+            def memory_analysis(self):
+                raise AssertionError("must not be called when off")
+
+            cost_analysis = memory_analysis
+
+        telemetry.record_compiled("pack", (9, 9), Boom())
+        telemetry.request_pack_capture(
+            16, 32, 0, 32, 4, 1, "ffd", 0, 0, None, False, False
+        )
+        assert telemetry.snapshot()["compiled"] is None
+
+    def test_broken_analysis_is_swallowed(self):
+        """memory_analysis/cost_analysis raising (backend quirk) must
+        never propagate into the compile path."""
+        class Broken:
+            def memory_analysis(self):
+                raise RuntimeError("unsupported")
+
+            def cost_analysis(self):
+                raise RuntimeError("unsupported")
+
+        telemetry.record_compiled("pack", (5, 5), Broken())
+        entry = telemetry.compiled_entry("pack", (5, 5))
+        assert entry == {"memory": None, "cost": None,
+                         "source": "warm_pool"}
+
+
+class TestDeviceMemory:
+    def test_cpu_memory_stats_are_null_safe(self):
+        """XLA:CPU reports no allocator stats: the snapshot carries
+        stats=None per device, publish leaves no gauge series, and
+        headroom() is None — the million_pod assertion's vacuous case."""
+        snap = telemetry.device_memory_snapshot()
+        assert snap, "device list empty on a live backend"
+        assert all(d["stats"] is None for d in snap)
+        published = telemetry.publish_device_memory()
+        assert all(d["stats"] is None for d in published)
+        assert telemetry.headroom() is None
+
+    def test_headroom_from_real_stats(self, monkeypatch):
+        """With real allocator stats the asserted headroom is the min
+        over devices of 1 - bytes_IN_USE/limit (live footprint at the
+        call site); the process-lifetime peak rides along as
+        provenance only — asserting on it would fire on whatever ran
+        EARLIER in the process, not on the caller's own work."""
+        monkeypatch.setattr(
+            telemetry, "device_memory_snapshot",
+            lambda: [
+                {"device": "tpu:0", "platform": "tpu",
+                 "stats": {"bytes_in_use": 30, "peak_bytes_in_use": 80,
+                           "bytes_limit": 100}},
+                {"device": "tpu:1", "platform": "tpu",
+                 "stats": {"bytes_in_use": 10, "peak_bytes_in_use": 40,
+                           "bytes_limit": 100}},
+            ],
+        )
+        head = telemetry.headroom()
+        assert head == {"min_headroom_fraction": 0.7,
+                        "min_peak_headroom_fraction": 0.2,
+                        "devices_reporting": 2}
+
+
+class TestStagingAndSnapshot:
+    def test_stream_commit_unifies_staging_stats(self):
+        """stream._Staging.commit lands the per-solve stats on the
+        telemetry gauges and in snapshot()["staging"]."""
+        from karpenter_tpu.metrics.store import DEVICE_STAGING
+        from karpenter_tpu.solver import stream
+
+        staging = stream._Staging()
+        staging.arrays = 2
+        staging.blocks = 8
+        staging.peak_block_bytes = 1024
+        staging.full_bytes = 8192
+        staging.commit()
+        snap = telemetry.snapshot()
+        assert snap["staging"]["peak_block_bytes"] == 1024
+        assert snap["staging"]["full_bytes"] == 8192
+        assert DEVICE_STAGING.value({"stat": "peak_block"}) == 1024.0
+        assert DEVICE_STAGING.value({"stat": "full"}) == 8192.0
+
+    def test_snapshot_is_always_well_formed_json(self):
+        """The bench block contract: every field present, nulls where
+        the host has no signal, and the whole thing JSON-serializable."""
+        snap = telemetry.snapshot()
+        assert set(snap) == {
+            "mode", "compiled", "devices", "staging",
+            "compiled_peak_temp_mb", "compiled_scope",
+            "device_peak_in_use_mb", "device_scope",
+        }
+        assert snap["compiled"] is None
+        assert snap["staging"] is None
+        assert snap["compiled_peak_temp_mb"] is None
+        assert snap["device_peak_in_use_mb"] is None
+        # without a before-set the compiled roll-up covers the process
+        # lifetime; the live-device watermark always does (no reset)
+        assert snap["compiled_scope"] == "process"
+        assert snap["device_scope"] == "process"
+        json.dumps(snap)  # must not raise
+
+    def test_arm_scoped_compiled_rollup_covers_only_new_buckets(self):
+        """With compiled_before, snapshot()'s peak covers only buckets
+        recorded since — the per-arm provenance bench_compare's gate
+        needs (a process-cumulative peak would fire on arm ordering)."""
+        from karpenter_tpu.solver import warm_pool
+
+        warm_pool._compile_bucket(16, 256, 0, 64, "ffd")
+        before = telemetry.compiled_keys()
+        whole = telemetry.snapshot()
+        arm = telemetry.snapshot(compiled_before=before)
+        assert whole["compiled_peak_temp_mb"] > 0
+        assert arm["compiled_scope"] == "arm"
+        assert arm["compiled_peak_temp_mb"] is None  # nothing new
+        warm_pool._compile_bucket(16, 256, 0, 64, "cost")
+        arm2 = telemetry.snapshot(compiled_before=before)
+        assert arm2["compiled_peak_temp_mb"] > 0
+
+    def test_evicted_request_can_re_enqueue(self):
+        """A request squeezed out of the bounded queue must drop its
+        dedup key too — the bucket re-enqueues on its next dispatch
+        instead of being silently blacklisted forever."""
+        first_key = ("pack", 16, 32, 0, 32, "ffd", 0, 0, None,
+                     False, False, False)
+        telemetry.request_pack_capture(
+            16, 32, 0, 32, 4, 1, "ffd", 0, 0, None, False, False
+        )
+        # flood the queue past its bound with distinct signatures
+        for i in range(telemetry._QUEUE_MAX + 8):
+            telemetry.request_pack_capture(
+                16, 32 * (i + 2), 0, 32, 4, 1, "ffd", 0, 0, None,
+                False, False,
+            )
+        assert first_key not in telemetry._requested
+        # re-request succeeds (lands back in the dedup set + queue)
+        telemetry.request_pack_capture(
+            16, 32, 0, 32, 4, 1, "ffd", 0, 0, None, False, False
+        )
+        assert first_key in telemetry._requested
+
+
+class TestSpanAttribution:
+    def test_compile_span_carries_tm_attrs_once_recorded(self):
+        """Once a bucket's analysis exists, the next solve of that
+        bucket annotates its solve.compile span with tm_* attrs — and
+        tracing.structure() strips them (they track background capture
+        progress, so replays may disagree)."""
+        from karpenter_tpu import tracing
+        from karpenter_tpu.solver.encode import encode, group_pods
+        from karpenter_tpu.solver.pack import solve_packing
+
+        pods = [mk_pod(name=f"sa-{i}", cpu=1.0) for i in range(40)]
+        enc = encode(group_pods(pods),
+                     [(mk_nodepool("default"), instance_types(10))])
+        solve_packing(enc, mode="ffd")       # cold: enqueue capture
+        assert telemetry.drain(30.0)
+        tracing.clear()
+        with tracing.trace("tick"):
+            solve_packing(enc, mode="ffd")   # warm: attrs available
+        trace = tracing.last_trace()
+        spans = [s for s in trace["spans"] if s["name"] == "solve.compile"]
+        assert spans
+        assert any("tm_flops" in s["attrs"] for s in spans), (
+            "no compile span carried telemetry attrs"
+        )
+        structure = tracing.structure(trace)
+
+        def walk(node):
+            name, attrs, events, children = node
+            assert not any(k.startswith("tm_") for k, _ in attrs), attrs
+            for child in children:
+                walk(child)
+
+        for root in structure:
+            walk(root)

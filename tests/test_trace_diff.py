@@ -1,0 +1,99 @@
+"""tools/trace_report.py --diff (ISSUE 13 satellite): per-span-name
+count/p50/p99 delta between two /debug/traces payloads or bench
+trace_summary blocks, with the --threshold exit-1 CI gate."""
+
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+from trace_report import diff_report, main, stats_of  # noqa: E402
+
+from karpenter_tpu import tracing  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _clean_ring():
+    tracing.clear()
+    yield
+    tracing.clear()
+
+
+def _ring_payload(span_seconds: float) -> dict:
+    clock = iter([0.0, 0.0, span_seconds, span_seconds + 0.1])
+    with tracing.trace("tick", clock=lambda: next(clock)):
+        with tracing.span("solve.compile"):
+            pass
+    payload = {"traces": tracing.traces()}
+    tracing.clear()
+    return payload
+
+
+class TestStatsOf:
+    def test_traces_payload(self):
+        stats = stats_of(_ring_payload(0.5))
+        assert stats["solve.compile"]["p50_s"] == 0.5
+
+    def test_bare_list(self):
+        stats = stats_of(_ring_payload(0.5)["traces"])
+        assert "tick" in stats
+
+    def test_bench_artifact_prefixes_arms(self):
+        bench = {"detail": {
+            "reserved_50k": {"trace_summary": {"spans": {
+                "tick": {"count": 3, "p50_s": 0.1, "p99_s": 0.2},
+            }, "traces_sampled": 3, "ring_capacity": 64}},
+            "mixed_10k": {"wall_s": 1.0},   # no summary: skipped
+        }}
+        stats = stats_of(bench)
+        assert set(stats) == {"reserved_50k/tick"}
+
+    def test_bare_trace_summary_block(self):
+        block = {"spans": {"tick": {"count": 1, "p50_s": 0.1,
+                                    "p99_s": 0.1}},
+                 "traces_sampled": 1, "ring_capacity": 64}
+        assert set(stats_of(block)) == {"tick"}
+
+
+class TestDiff:
+    def test_delta_table_and_gate(self):
+        base = {"solve.compile": {"count": 4, "p50_s": 0.100,
+                                  "p99_s": 0.200}}
+        cur = {"solve.compile": {"count": 4, "p50_s": 0.140,
+                                 "p99_s": 0.210}}
+        table, regressions = diff_report(base, cur, threshold=0.25)
+        assert "solve.compile" in table and "+40.0%" in table
+        assert len(regressions) == 1 and "p50_s" in regressions[0]
+        # below threshold: report only
+        _, regressions = diff_report(base, cur, threshold=0.5)
+        assert not regressions
+        # no threshold: never gates
+        _, regressions = diff_report(base, cur, threshold=None)
+        assert not regressions
+
+    def test_one_sided_spans_reported_not_gated(self):
+        base = {"gone": {"count": 1, "p50_s": 1.0, "p99_s": 1.0}}
+        cur = {"new": {"count": 1, "p50_s": 1.0, "p99_s": 1.0}}
+        table, regressions = diff_report(base, cur, threshold=0.01)
+        assert "only in baseline" in table and "only in current" in table
+        assert not regressions
+
+    def test_main_exit_codes(self, tmp_path, capsys):
+        a = tmp_path / "a.json"
+        b = tmp_path / "b.json"
+        a.write_text(json.dumps(_ring_payload(0.1)))
+        b.write_text(json.dumps(_ring_payload(0.5)))
+        rc = main(["trace_report.py", "--diff", str(a), str(b),
+                   "--threshold", "0.25"])
+        assert rc == 1
+        out = capsys.readouterr().out
+        assert "REGRESSIONS" in out and "solve.compile" in out
+        # improvement direction passes
+        assert main(["trace_report.py", "--diff", str(b), str(a),
+                     "--threshold", "0.25"]) == 0
+        # no threshold: report-only mode always exits 0
+        assert main(["trace_report.py", "--diff", str(a), str(b)]) == 0

@@ -2,12 +2,16 @@
 """Diff two bench artifacts and gate on regressions (ISSUE 11).
 
     python tools/bench_compare.py BASELINE.json CURRENT.json \
-        [--threshold 0.25] [--scenarios reserved_50k,steady_state_churn]
+        [--threshold 0.25] [--scenarios reserved_50k,steady_state_churn] \
+        [--gap-tolerance 0.01] [--mem-tolerance 512]
 
 Compares, per scenario present in BOTH artifacts' detail:
 - wall-clock keys (lower is better): wall_s, p50_s, p99_s, and every
   *_wall_s / *_p50_s variant a scenario reports;
-- pods_per_sec (higher is better).
+- pods_per_sec (higher is better);
+- gap_vs_lp by absolute delta (--gap-tolerance);
+- peak_rss_mb and the per-arm device-telemetry peaks by absolute MB
+  delta (--mem-tolerance, null-tolerant on either side).
 
 Exit codes: 0 = no regression past the threshold, 1 = at least one
 regression, 2 = an artifact could not be parsed. A regression is a
@@ -43,6 +47,23 @@ RATE_KEY = "pods_per_sec"
 # deltas (a gap is already a ratio; relative-change gating would make
 # a 0.1% -> 0.3% move a "200% regression"), gated by --gap-tolerance
 GAP_KEYS = ("gap_vs_lp",)
+# lower-is-better memory keys (ISSUE 13): host peak RSS plus the
+# device-telemetry roll-ups, gated by --mem-tolerance in the same
+# absolute-delta style as the gap keys (MB — RSS jitters a few percent
+# per run, and percent-of-gigabytes gating would page on noise).
+# Null-tolerant: a side without the key (pre-ISSUE-13 artifact,
+# CPU-only host with no device stats) is reported, never gated.
+MEM_KEYS = ("peak_rss_mb",)
+# the same keys nested one level down in the per-arm device_telemetry
+# block (telemetry.snapshot() keeps scalar roll-ups at its top level
+# exactly so this gate can read them without walking the detail),
+# mapped to the scope field that must read "arm" on BOTH sides before
+# the key gates — process-scoped peaks accumulate every earlier arm,
+# so a delta would fire on arm ordering, not memory
+DEVICE_MEM_KEYS = {
+    "compiled_peak_temp_mb": "compiled_scope",
+    "device_peak_in_use_mb": "device_scope",
+}
 
 
 def load_detail(path: str) -> dict:
@@ -121,16 +142,76 @@ def _salvage_scenarios(tail: str) -> dict:
     return out
 
 
+def _mem_value(arm: dict, key: str):
+    """A memory key's numeric value from an arm, looking through the
+    device_telemetry block for the device keys; None when absent or
+    null (the null-tolerant contract)."""
+    if key in MEM_KEYS:
+        return arm.get(key) if isinstance(arm.get(key), (int, float)) else None
+    dt = arm.get("device_telemetry")
+    if isinstance(dt, dict) and isinstance(dt.get(key), (int, float)):
+        return dt[key]
+    return None
+
+
+def _mem_scope(arm: dict, key: str) -> str:
+    """The scope stamped next to a memory key: "arm" means the value
+    covers only that arm's work and may gate; anything else (process
+    watermark, pre-scope artifact) is report-only."""
+    if key in MEM_KEYS:
+        return arm.get("peak_rss_scope", "")
+    dt = arm.get("device_telemetry")
+    if isinstance(dt, dict):
+        return str(dt.get(DEVICE_MEM_KEYS[key], ""))
+    return ""
+
+
+def _compare_mem(name: str, b: dict, c: dict, mem_tolerance: float,
+                 lines: list[str], regressions: list[str]) -> None:
+    for key in MEM_KEYS + tuple(DEVICE_MEM_KEYS):
+        bv, cv = _mem_value(b, key), _mem_value(c, key)
+        if bv is None:
+            if cv is not None:
+                # the first round after telemetry lands: no baseline
+                # to gate against, but the new peak must be VISIBLE
+                lines.append(
+                    f"  {name}.{key}: null -> {cv:.1f}MB "
+                    "(new key; not gated)"
+                )
+            continue
+        if cv is None:
+            lines.append(
+                f"  {name}.{key}: {bv:.1f}MB -> null "
+                "(telemetry unavailable; not gated)"
+            )
+            continue
+        if _mem_scope(b, key) != "arm" or _mem_scope(c, key) != "arm":
+            # a process-lifetime watermark accumulates every earlier
+            # arm; gating it would fire on arm ordering, not memory
+            lines.append(
+                f"  {name}.{key}: {bv:.1f}MB -> {cv:.1f}MB "
+                "(process-scoped peak; not gated)"
+            )
+            continue
+        delta = cv - bv
+        tag = f"{name}.{key}: {bv:.1f}MB -> {cv:.1f}MB ({delta:+.1f}MB)"
+        if delta > mem_tolerance:
+            regressions.append(tag)
+        else:
+            lines.append("  " + tag)
+
+
 def compare(
     base: dict, cur: dict, threshold: float, scenarios=None,
-    gap_tolerance: float = 0.01,
+    gap_tolerance: float = 0.01, mem_tolerance: float = 512.0,
 ) -> tuple[list[str], list[str]]:
     """-> (report lines, regression lines). A regression is a wall
-    increase or pods/sec decrease past `threshold` relative change, or
-    a gap_vs_lp increase past `gap_tolerance` absolute. A gap present
-    in the baseline but null in the current run (bound machinery went
-    missing) is reported loudly but does not gate — the wall/rate keys
-    still cover the scenario."""
+    increase or pods/sec decrease past `threshold` relative change, a
+    gap_vs_lp increase past `gap_tolerance` absolute, or a memory-peak
+    increase past `mem_tolerance` MB absolute. A gap/memory key
+    present in the baseline but null in the current run (bound or
+    telemetry machinery went missing) is reported loudly but does not
+    gate — the wall/rate keys still cover the scenario."""
     lines: list[str] = []
     regressions: list[str] = []
     meta = {"backend", "backend_provenance"}
@@ -192,6 +273,7 @@ def compare(
                 regressions.append(tag)
             else:
                 lines.append("  " + tag)
+        _compare_mem(name, b, c, mem_tolerance, lines, regressions)
     return lines, regressions
 
 
@@ -219,6 +301,13 @@ def main(argv=None) -> int:
         "jitter, not machine load)",
     )
     parser.add_argument(
+        "--mem-tolerance", type=float, default=512.0,
+        help="absolute peak-memory increase in MB allowed before "
+        "gating (default 512 — covers peak_rss_mb and the per-arm "
+        "device-telemetry peaks; same absolute-delta style as "
+        "--gap-tolerance, null-tolerant on either side)",
+    )
+    parser.add_argument(
         "--quiet", action="store_true",
         help="print regressions only",
     )
@@ -234,7 +323,9 @@ def main(argv=None) -> int:
         or None
     )
     lines, regressions = compare(
-        base, cur, args.threshold, wanted, gap_tolerance=args.gap_tolerance
+        base, cur, args.threshold, wanted,
+        gap_tolerance=args.gap_tolerance,
+        mem_tolerance=args.mem_tolerance,
     )
     if not args.quiet and lines:
         print("compared (within threshold):")

@@ -1,6 +1,6 @@
 #!/usr/bin/env python3
 """Render a saved flight-recorder trace ring as a per-span latency
-table.
+table — or diff two of them.
 
 Input: the JSON an operator serves at /debug/traces (`{"traces":
 [...]}`), a bare list of trace dicts, or a bench JSON whose arms carry
@@ -11,6 +11,15 @@ the same digest bench artifacts embed per arm (tracing.span_stats).
     curl -s localhost:8080/debug/traces | python tools/trace_report.py
     python tools/trace_report.py ring.json
     python tools/trace_report.py BENCH_r06.json   # per-arm summaries
+
+`--diff A.json B.json` prints the per-span-name count/p50/p99 delta
+table between two payloads (any accepted shape on either side; bench
+artifacts contribute every arm's spans as `arm/span`). With
+`--threshold 0.25` the tool exits 1 when any span's p50 or p99 grew
+past the relative threshold — the CI gate:
+
+    python tools/trace_report.py --diff r05_ring.json r06_ring.json \\
+        --threshold 0.25
 """
 
 from __future__ import annotations
@@ -76,10 +85,119 @@ def report(payload) -> str:
     return "\n\n".join(sections)
 
 
+def stats_of(payload) -> dict[str, dict]:
+    """One flat {span_name: stats} mapping from any accepted payload
+    shape — the diff's per-side input. Bench artifacts contribute
+    every arm's summary spans as `arm/span` so two rounds diff arm by
+    arm."""
+    if isinstance(payload, list):
+        return span_stats(payload)
+    if "traces" in payload:
+        return span_stats(payload["traces"])
+    if "spans" in payload and not any(
+        isinstance(v, dict) and "trace_summary" in v
+        for v in payload.values() if isinstance(v, dict)
+    ):
+        # a bare trace_summary block ({spans, traces_sampled, ...})
+        return dict(payload["spans"])
+    detail = payload.get("detail", payload)
+    out: dict[str, dict] = {}
+    for arm, body in detail.items():
+        if isinstance(body, dict) and "trace_summary" in body:
+            summary = body["trace_summary"]
+            for name, stats in summary.get("spans", summary).items():
+                out[f"{arm}/{name}"] = stats
+    return out
+
+
+def diff_report(
+    base: dict[str, dict], cur: dict[str, dict],
+    threshold: float | None = None,
+) -> tuple[str, list[str]]:
+    """-> (rendered delta table, regression lines). A regression is a
+    p50 or p99 relative increase past `threshold` on a span present
+    in both payloads (None: report only, never gate)."""
+    names = sorted(set(base) | set(cur))
+    headers = ("span", "count", "p50_s", "p99_s")
+    rows = []
+    regressions: list[str] = []
+    for name in names:
+        b, c = base.get(name), cur.get(name)
+        if b is None or c is None:
+            side = "current" if b is None else "baseline"
+            rows.append((name, f"only in {side}", "-", "-"))
+            continue
+        cells = [f"{b['count']} -> {c['count']}"]
+        for key in ("p50_s", "p99_s"):
+            bv, cv = b.get(key), c.get(key)
+            if not isinstance(bv, (int, float)) or not isinstance(
+                cv, (int, float)
+            ):
+                cells.append("-")
+                continue
+            if bv > 0:
+                rel = cv / bv - 1.0
+                cells.append(f"{bv:.6f} -> {cv:.6f} ({rel:+.1%})")
+                if threshold is not None and rel > threshold:
+                    regressions.append(
+                        f"{name}.{key}: {bv:.6f}s -> {cv:.6f}s "
+                        f"({rel:+.1%})"
+                    )
+            else:
+                cells.append(f"{bv:.6f} -> {cv:.6f}")
+        rows.append((name, *cells))
+    if not rows:
+        return "(no spans on either side)", regressions
+    widths = [
+        max(len(h), *(len(str(r[i])) for r in rows))
+        for i, h in enumerate(headers)
+    ]
+    lines = [
+        "  ".join(h.ljust(w) for h, w in zip(headers, widths)),
+        "  ".join("-" * w for w in widths),
+    ]
+    for row in rows:
+        lines.append("  ".join(str(c).ljust(w) for c, w in zip(row, widths)))
+    return "\n".join(lines), regressions
+
+
+def _load(path: str):
+    with open(path) as fh:
+        return json.load(fh)
+
+
 def main(argv: list[str]) -> int:
+    if "--diff" in argv:
+        import argparse
+
+        parser = argparse.ArgumentParser(
+            description="diff two trace payloads per span name"
+        )
+        parser.add_argument("--diff", nargs=2, metavar=("BASE", "CURRENT"))
+        parser.add_argument(
+            "--threshold", type=float, default=None,
+            help="relative p50/p99 increase that exits 1 (omit to "
+            "report without gating)",
+        )
+        args = parser.parse_args(argv[1:])
+        table, regressions = diff_report(
+            stats_of(_load(args.diff[0])), stats_of(_load(args.diff[1])),
+            threshold=args.threshold,
+        )
+        print(table)
+        if regressions:
+            print(
+                f"\nREGRESSIONS past {args.threshold:.0%} "
+                f"({args.diff[0]} -> {args.diff[1]}):"
+            )
+            for line in regressions:
+                print("  " + line)
+            return 1
+        if args.threshold is not None:
+            print(f"\nno span regressions past {args.threshold:.0%}")
+        return 0
     if len(argv) > 1:
-        with open(argv[1]) as fh:
-            payload = json.load(fh)
+        payload = _load(argv[1])
     else:
         payload = json.load(sys.stdin)
     print(report(payload))
