@@ -1948,6 +1948,7 @@ def main() -> int:
         errors.append(backend_error)
     backend = jax.default_backend()
     detail = {"backend": backend, "backend_provenance": provenance}
+    from karpenter_tpu import explain as _explain
     from karpenter_tpu import tracing
     from karpenter_tpu.metrics import sentinel as _sentinel
     from karpenter_tpu.metrics import slo as _slo
@@ -1960,6 +1961,9 @@ def main() -> int:
         # spot_mix) leave tick traces behind; their per-span p50/p99
         # breakdown lands in the arm's JSON below
         tracing.clear()
+        # scope the explain ring the same way: the arm's verdict
+        # histogram + funnel depth must cover THIS arm's ticks only
+        _explain.clear()
         # scope the telemetry plane the same way: sentinel anomaly
         # deltas, the last SLO digest, and the compiled-bucket roll-up
         # are per-arm provenance
@@ -2008,6 +2012,11 @@ def main() -> int:
             )
         if "slo_summary" not in detail[name]:
             detail[name]["slo_summary"] = _slo.last_digest()
+        if "explain_summary" not in detail[name]:
+            # verdict histogram + funnel depth p50 over the arm's
+            # explain ring (ISSUE 14) — zeros/null when the arm never
+            # ticked a live operator, never absent
+            detail[name]["explain_summary"] = _explain.summarize_ring()
         if "sentinel_summary" not in detail[name]:
             detail[name]["sentinel_summary"] = {
                 "signals": _sentinel.summary(),

@@ -48,6 +48,7 @@ from karpenter_tpu.apis.v1.nodepool import (
     NodePool,
 )
 from karpenter_tpu.cloudprovider.types import CloudProvider, effective_price
+from karpenter_tpu import explain
 from karpenter_tpu.kube.client import KubeClient
 from karpenter_tpu.metrics.store import (
     DISRUPTION_EVALUATION_DURATION,
@@ -262,9 +263,23 @@ class DisruptionEngine:
         self, node: StateNode, reason: str, pdb: PdbLimits, now: float,
         protected: frozenset = frozenset(),
     ) -> Optional[Candidate]:
-        if node.deleting() or node.nominated(now):
+        # Every node the scan rejects for a POLICY reason gets a
+        # structured verdict in the explain plane (`kept:<reason>`) —
+        # the answer to "why is this node still here". Mechanical
+        # skips (deleting, unmanaged, static pools, not-drifted in a
+        # drift scan) stay silent: they are the normal state of most
+        # of the fleet, not a decision worth a record.
+        if node.deleting():
             return None
-        if node.validate_node_disruptable() is not None:
+        if node.nominated(now):
+            explain.note_candidate(node.name, explain.KEPT_NOMINATED)
+            return None
+        disruptable_err = node.validate_node_disruptable()
+        if disruptable_err is not None:
+            if "do-not-disrupt" in disruptable_err:
+                explain.note_candidate(
+                    node.name, explain.KEPT_DO_NOT_DISRUPT, source="node"
+                )
             return None
         claim = node.node_claim
         if claim is None:
@@ -277,6 +292,7 @@ class DisruptionEngine:
             # holding a cloud interruption notice: the interruption
             # controller owns this node's replacement — a concurrent
             # consolidation command would race the drain
+            explain.note_candidate(node.name, explain.KEPT_INTERRUPTED)
             return None
         pool = self.kube.get_node_pool(node.nodepool_name())
         if pool is None or pool.is_static():
@@ -284,6 +300,9 @@ class DisruptionEngine:
         # method eligibility via conditions
         if reason == REASON_EMPTY or reason == REASON_UNDERUTILIZED:
             if not claim.status_conditions.is_true(COND_CONSOLIDATABLE):
+                explain.note_candidate(
+                    node.name, explain.KEPT_NOT_CONSOLIDATABLE, weak=True
+                )
                 return None
             if (
                 reason == REASON_UNDERUTILIZED
@@ -318,8 +337,14 @@ class DisruptionEngine:
                 == "true"
                 and not eventual
             ):
+                explain.note_candidate(
+                    node.name, explain.KEPT_DO_NOT_DISRUPT, pod=pod_key
+                )
                 return None
             if pdb.can_evict(pod) is not None and not eventual:
+                explain.note_candidate(
+                    node.name, explain.KEPT_PDB_BLOCKED, pod=pod_key
+                )
                 return None
             if pod.owner_kind() == "DaemonSet":
                 continue
@@ -336,6 +361,7 @@ class DisruptionEngine:
                     "no offering price for node %s; skipping candidate",
                     node.name,
                 )
+                explain.note_candidate(node.name, explain.KEPT_UNPRICED)
                 return None
             # emptiness/drift never price-compare: a candidate with a
             # missing/unresolvable instance type is still disruptable
@@ -447,6 +473,11 @@ class DisruptionEngine:
             if taken.get(pool, 0) < budgets.get(pool, 0):
                 taken[pool] = taken.get(pool, 0) + 1
                 out.append(c)
+            else:
+                explain.note_candidate(
+                    c.state_node.name, explain.KEPT_BUDGET,
+                    weak=True, pool=pool, allowed=budgets.get(pool, 0),
+                )
         return out
 
     # -- simulation (helpers.go:52-143) ----------------------------------------
@@ -582,6 +613,13 @@ class DisruptionEngine:
                         "pods of priority %d are displaced",
                         key, starved.spec.priority, floor,
                     )
+                    for c in candidates:
+                        explain.note_candidate(
+                            c.state_node.name, explain.KEPT_PRIORITY_VETO,
+                            starved_pod=key,
+                            starved_priority=int(starved.spec.priority),
+                            displaced_priority=int(floor),
+                        )
                     all_ok = False
                     break
         return results, all_ok
@@ -614,11 +652,28 @@ class DisruptionEngine:
                 tracing.add_event(
                     "probe_pruned", candidates=len(candidates)
                 )
+                # the certificate IS the explanation — "kept because
+                # no replacement can beat $X/hr", with the weak-
+                # duality numbers attached (λ'·d bound vs price)
+                cert = getattr(pruner, "last", None) or {}
+                for c in candidates:
+                    explain.note_candidate(
+                        c.state_node.name, explain.KEPT_LP_PRUNE, **cert
+                    )
                 return None
         results, all_ok = self.simulate_scheduling(candidates)
         if not all_ok:
+            for c in candidates:
+                explain.note_candidate(
+                    c.state_node.name, explain.KEPT_SIMULATION, weak=True
+                )
             return None
         if len(results.new_node_plans) > 1:
+            for c in candidates:
+                explain.note_candidate(
+                    c.state_node.name, explain.KEPT_NEEDS_MULTIPLE,
+                    weak=True, replacement_nodes=len(results.new_node_plans),
+                )
             return None
         current_price = sum(c.price for c in candidates)
         if not results.new_node_plans:
@@ -633,6 +688,18 @@ class DisruptionEngine:
         # interruption regime is about to reclaim
         cheaper = [o for o in plan.offerings if effective_price(o) < current_price]
         if not cheaper:
+            cheapest = (
+                min(effective_price(o) for o in plan.offerings)
+                if plan.offerings else None
+            )
+            for c in candidates:
+                explain.note_candidate(
+                    c.state_node.name, explain.KEPT_NOT_CHEAPER,
+                    current_price=round(current_price, 6),
+                    replacement_price=(
+                        round(cheapest, 6) if cheapest is not None else None
+                    ),
+                )
             return None
         all_spot = all(c.capacity_type == CAPACITY_TYPE_SPOT for c in candidates)
         # the launch resolves to the cheapest surviving offering (raw
@@ -647,6 +714,7 @@ class DisruptionEngine:
             # forced to spot; single-node additionally demands >=15
             # cheaper instance types and truncates the launch set to 15
             if not self.options.feature_gates.spot_to_spot_consolidation:
+                self._note_spot_gated(candidates, "feature-gate-off")
                 return None
             spot_offerings = [
                 o for o in cheaper if o.capacity_type == CAPACITY_TYPE_SPOT
@@ -657,9 +725,15 @@ class DisruptionEngine:
                     if o in it.offerings and it.name not in type_names:
                         type_names.append(it.name)
             if not type_names:
+                self._note_spot_gated(candidates, "no-cheaper-spot-types")
                 return None
             if len(candidates) == 1:
                 if len(type_names) < SPOT_TO_SPOT_MIN_TYPES:
+                    self._note_spot_gated(
+                        candidates,
+                        f"{len(type_names)}<{SPOT_TO_SPOT_MIN_TYPES}"
+                        " flexible types",
+                    )
                     return None
                 type_names = type_names[:SPOT_TO_SPOT_MIN_TYPES]
             keep = set(type_names)
@@ -688,9 +762,20 @@ class DisruptionEngine:
                         names.add(it.name)
             plan.instance_types = [it for it in plan.instance_types if it.name in names]
         if not plan.instance_types:
+            for c in candidates:
+                explain.note_candidate(
+                    c.state_node.name, explain.KEPT_NOT_CHEAPER, weak=True
+                )
             return None
         plan.price = min(o.price for o in plan.offerings)
         return Command(reason=REASON_UNDERUTILIZED, candidates=candidates, results=results)
+
+    @staticmethod
+    def _note_spot_gated(candidates: list[Candidate], why: str) -> None:
+        for c in candidates:
+            explain.note_candidate(
+                c.state_node.name, explain.KEPT_SPOT_GATED, gate=why
+            )
 
     # -- methods ---------------------------------------------------------------
 
@@ -868,6 +953,14 @@ class DisruptionEngine:
             self._set_probe_pruner(None)
         if best is not None and len(best.candidates) >= 2:
             if not self._same_type_guard(best):
+                # N same-type nodes would churn into one node of their
+                # own type with no launchable alternative — anti-churn
+                names = {c.instance_type_name for c in best.candidates}
+                for c in best.candidates:
+                    explain.note_candidate(
+                        c.state_node.name, explain.KEPT_SAME_TYPE,
+                        instance_type=sorted(names)[0] if names else "",
+                    )
                 return None
             return best
         return None
@@ -1053,6 +1146,15 @@ class DisruptionEngine:
                 {"method": method.__name__},
             )
             if command is not None:
+                # the decided command's candidates get the terminal
+                # verdict — overwriting any kept:<reason> an earlier
+                # probe of the same ladder recorded for them
+                for c in command.candidates:
+                    explain.note_candidate(
+                        c.state_node.name, explain.VERDICT_CONSOLIDATED,
+                        reason=command.reason,
+                        replacements=command.replacement_count,
+                    )
                 # crash window: the disruption decision exists only in
                 # memory — a restart recomputes it from cluster state
                 from karpenter_tpu.solver import faults as _faults

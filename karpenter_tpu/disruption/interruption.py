@@ -185,7 +185,16 @@ class InterruptionController:
             )
             return []
         self._inflight.append((command, pids))
+        from karpenter_tpu import explain
+
         for candidate in candidates:
+            # terminal verdict: the cloud is reclaiming this node; the
+            # wave's drain-after-replace owns it from here (overwrites
+            # any weak keep a deferred earlier simulation recorded)
+            explain.note_candidate(
+                candidate.state_node.name, explain.VERDICT_INTERRUPTED,
+                replacements=command.replacement_count,
+            )
             INTERRUPTION_COMMANDS.inc(
                 {"nodepool": candidate.node_pool.metadata.name}
             )

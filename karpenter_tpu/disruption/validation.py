@@ -83,6 +83,25 @@ class Validator:
 
     def validate_for_execution(self, command: "Command",
                                now: Optional[float] = None) -> None:
+        """Raises ValidationError (roll back) / ValidationRetry
+        (defer). An invalid verdict is a DECISION about the command's
+        candidates — the explain plane records it on each of them
+        (`kept:validation-failed`, carrying the validator's own
+        message) before the queue rolls the command back."""
+        try:
+            self._validate_for_execution(command, now)
+        except ValidationError as err:
+            from karpenter_tpu import explain
+
+            for candidate in command.candidates:
+                explain.note_candidate(
+                    candidate.state_node.name, explain.KEPT_VALIDATION,
+                    reason=str(err), command=command.reason,
+                )
+            raise
+
+    def _validate_for_execution(self, command: "Command",
+                                now: Optional[float] = None) -> None:
         now = time.time() if now is None else now
         kube = self.engine.kube
         if command.reason == REASON_INTERRUPTED:

@@ -62,7 +62,14 @@ class EventRecorder:
         # MAX_EVENTS to keep long sims from leaking
         self._sink_fifo: "deque" = deque()
 
-    def publish(self, event: Event, now: Optional[float] = None) -> bool:
+    def publish(self, event: Event, now: Optional[float] = None,
+                sticky: bool = False) -> bool:
+        """`sticky` makes the frozen-key dedupe window SLIDING: a
+        duplicate republished within the TTL refreshes the window, so
+        a condition that persists tick after tick (an unschedulable
+        pod) bumps the one posted Event's count forever instead of
+        reposting an identical message every DEDUPE_TTL — persistence
+        stays visible through counters, not apiserver spam."""
         now = time.time() if now is None else now
         # prune the dedupe cache so distinct one-off events can't grow
         # it without bound
@@ -76,6 +83,8 @@ class EventRecorder:
             }
         last = self._last_seen.get(event)
         if last is not None and now - last < self.DEDUPE_TTL:
+            if sticky:
+                self._last_seen[event] = now
             for rec in reversed(self.events):
                 if rec.event == event:
                     rec.count += 1

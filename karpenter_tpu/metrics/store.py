@@ -443,6 +443,25 @@ SENTINEL_BASELINE = REGISTRY.gauge(
     "The sentinel's rolling baseline per signal and stat (ewma / mad, "
     "in the signal's own units) — what the anomaly threshold is "
     "currently judged against")
+# decision explainability plane (karpenter_tpu/explain): structured
+# "why" records per tick — verdicts tally once at record finish, so
+# a candidate re-probed many times in one tick counts once
+EXPLAIN_VERDICTS = REGISTRY.counter(
+    "karpenter_explain_verdicts_total",
+    "Disruption-candidate verdicts recorded by the explainability "
+    "plane, by verdict (consolidated / interrupted / kept:<reason> — "
+    "see README's verdict taxonomy table), tallied once per tick at "
+    "record finish")
+EXPLAIN_TRUNCATED = REGISTRY.counter(
+    "karpenter_explain_truncated_total",
+    "Explain entries dropped past the per-tick caps "
+    "(KARPENTER_EXPLAIN_MAX_PODS / _MAX_NODES) — a bounded plane "
+    "never drops silently")
+POD_UNSCHEDULABLE_TICKS = REGISTRY.counter(
+    "karpenter_pod_unschedulable_ticks",
+    "Ticks a pod stayed unschedulable, by structured reason code "
+    "(scheduler.reason_code) — the persistence signal the deduped "
+    "FailedScheduling corev1 Event no longer repeats tick after tick")
 
 
 class Store:

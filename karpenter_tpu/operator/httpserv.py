@@ -1,5 +1,5 @@
 """Observability over HTTP: /metrics, /healthz, /readyz,
-/debug/profile, /debug/traces, /debug/slo.
+/debug/profile, /debug/traces, /debug/slo, /debug/explain.
 
 Counterpart of the ports the reference mounts on its manager
 (pkg/operator/operator.go:183-222: metrics server, healthz/readyz
@@ -14,6 +14,12 @@ taken from Options.metrics_port (0 picks an ephemeral port, exposed as
 with ?format=perfetto (load into ui.perfetto.dev), one trace's
 segments with ?trace_id=<id> — the id a NodeClaim's
 karpenter.sh/provenance annotation carries.
+
+/debug/explain serves the decision explainability ring
+(karpenter_tpu/explain): ?pod=<ns/name> the pod's elimination funnel
+and verdict, ?node=<name> the node's disruption verdict,
+?tick=<trace_id> one tick's whole record — the same id the flight
+recorder keys on, so "why" joins "when".
 """
 
 from __future__ import annotations
@@ -132,6 +138,30 @@ class ObservabilityServer:
             except Exception as err:
                 body = json.dumps({"error": str(err)}).encode()
                 status = 500
+            handler.send_response(status)
+            handler.send_header("Content-Type", "application/json")
+            handler.send_header("Content-Length", str(len(body)))
+            handler.end_headers()
+            handler.wfile.write(body)
+        elif path == "/debug/explain":
+            # the decision explainability plane (karpenter_tpu/explain):
+            # one pod's elimination funnel, one node's disruption
+            # verdict, or one tick's whole record. Unknown keys 404;
+            # a crash inside the plane 500s — it must never hang or
+            # kill the server (the /debug/slo contract).
+            from karpenter_tpu import explain
+
+            params = self._query(handler)
+            try:
+                status, text = explain.render_json(
+                    pod=params.get("pod", ""),
+                    node=params.get("node", ""),
+                    trace_id=params.get("tick", ""),
+                )
+                body = text.encode()
+            except Exception as err:
+                status = 500
+                body = json.dumps({"error": str(err)}).encode()
             handler.send_response(status)
             handler.send_header("Content-Type", "application/json")
             handler.send_header("Content-Length", str(len(body)))

@@ -287,7 +287,14 @@ class Operator:
         _slo_mod.take_noted()
         with tracing.trace("tick") as root:
             self._last_trace_id = getattr(root, "trace_id", "")
-            self._step(now)
+            # the explain record shares the tick's trace id so
+            # explanations join the flight recorder: a NodeClaim's
+            # provenance annotation resolves to BOTH the span tree
+            # (/debug/traces) and the decision record (/debug/explain)
+            from karpenter_tpu import explain
+
+            with explain.tick(self._last_trace_id):
+                self._step(now)
         wall = time.perf_counter() - wall0
         OPERATOR_TICK_DURATION.observe(wall)
         # telemetry plane (ISSUE 13): the sentinel baselines the tick
@@ -851,7 +858,16 @@ class Operator:
             # verdict per SLI, deterministic under the injectable clock
             # (full report at /debug/slo)
             "slo": self.slo.digest(),
+            # decision explainability (ISSUE 14): the last tick's
+            # verdict counts (full records at /debug/explain)
+            "explain": self._explain_digest(),
         }
+
+    @staticmethod
+    def _explain_digest() -> dict:
+        from karpenter_tpu import explain
+
+        return explain.digest()
 
     @staticmethod
     def _solver_status() -> dict:

@@ -332,6 +332,7 @@ class IncrementalTickScheduler:
                     set(shadow.degraded_rungs) | {"incremental_poison"}
                 )
                 faults.fire("crash_incr_commit")
+                self._note_explanations(pods, shadow, pools_with_types)
                 self._publish_solver_metrics(shadow, t0)
                 tracing.annotate(path="quarantined",
                                  reason=audit_trigger)
@@ -349,6 +350,7 @@ class IncrementalTickScheduler:
         # crash window: solved, plans not yet handed back for
         # NodeClaim writes
         faults.fire("crash_incr_commit")
+        self._note_explanations(pods, results, pools_with_types)
         self._publish_solver_metrics(results, t0)
         tracing.annotate(
             path="incremental",
@@ -360,6 +362,24 @@ class IncrementalTickScheduler:
         })
         self._counts["incremental"] += 1
         return results
+
+    def _note_explanations(self, pods, results: SchedulerResults,
+                           pools_with_types) -> None:
+        """Explain-plane parity with the full path (ISSUE 14): a pod
+        left unschedulable by the LIVE serve — incremental fast path
+        or the quarantine tick's shadow decision — gets the same
+        verdict + elimination funnel the full Scheduler would record,
+        through the same module-level seam."""
+        if not results.errors:
+            return
+        from karpenter_tpu.provisioning.scheduler import (
+            note_unschedulable_explanations,
+        )
+
+        note_unschedulable_explanations(
+            pods, results, self._sorted_pools(pools_with_types),
+            list(self._inputs.values()), self._daemon_overhead,
+        )
 
     def _publish_solver_metrics(self, results: SchedulerResults,
                                 t0: float) -> None:

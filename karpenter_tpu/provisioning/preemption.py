@@ -279,6 +279,23 @@ class PreemptionController:
         pod.status.nominated_node_name = node.name
         self.kube.touch(pod)
         PREEMPTION_NOMINATIONS.inc()
+        from karpenter_tpu import explain
+
+        if explain.active() is not None:
+            # the preemption verdict: who landed where, at what
+            # priority cutoff, over which victim set — queryable at
+            # /debug/explain?pod=<preemptor or victim>
+            explain.note_pod(
+                pod.key, verdict="preempted-onto", node=node.name,
+                cutoff_priority=int(pod.spec.priority),
+                victims=sorted(v.key for v in victims),
+            )
+            for victim in victims:
+                explain.note_pod(
+                    victim.key, verdict="preemption-victim",
+                    preemptor=pod.key, node=node.name,
+                    victim_priority=self._priority(victim),
+                )
         self._record(pod, node, victims, now)
         evicted = 0
         for victim in victims:

@@ -16,34 +16,46 @@ preferences.go:129-141) has no analogue here because this build's
 `tolerates` never blocks on PreferNoSchedule in the first place
 (scheduling/taints.py) — same outcome, no relaxation round needed.
 
-Returns True if something was relaxed (caller retries), False when the
-ladder is exhausted.
+Returns the NAME of the rung relaxed (truthy — callers retry), or
+None when the ladder is exhausted. The rung name is what the
+explainability plane (karpenter_tpu/explain) records per retry, so an
+operator can see exactly which preference steps a pod burned before
+it scheduled (or didn't).
 """
 
 from __future__ import annotations
 
 from dataclasses import replace
+from typing import Optional
 
 from karpenter_tpu.kube.objects import Affinity, NodeAffinity, Pod, PodAffinity
 
 _RELAXED_MARK = "karpenter.sh/relaxed"
 
+# ladder rung names, in relaxation order — the structured step codes
+# the explain plane records
+RELAX_PREFERRED_NODE_AFFINITY = "preferred-node-affinity"
+RELAX_REQUIRED_NODE_AFFINITY_TERM = "required-node-affinity-term"
+RELAX_SCHEDULE_ANYWAY_SPREAD = "schedule-anyway-spread"
+RELAX_PREFERRED_POD_AFFINITY = "preferred-pod-affinity"
+RELAX_PREFERRED_POD_ANTI_AFFINITY = "preferred-pod-anti-affinity"
 
-def relax(pod: Pod) -> bool:
+
+def relax(pod: Pod) -> Optional[str]:
     aff = pod.spec.affinity
     # 1. preferred node affinity
     if aff and aff.node_affinity and aff.node_affinity.preferred:
         pod.spec.affinity = replace(
             aff, node_affinity=replace(aff.node_affinity, preferred=())
         )
-        return True
+        return RELAX_PREFERRED_NODE_AFFINITY
     # 2. required node affinity terms (drop the first OR-term)
     if aff and aff.node_affinity and len(aff.node_affinity.required) > 1:
         pod.spec.affinity = replace(
             aff,
             node_affinity=replace(aff.node_affinity, required=aff.node_affinity.required[1:]),
         )
-        return True
+        return RELAX_REQUIRED_NODE_AFFINITY_TERM
     # 3. ScheduleAnyway spread constraints
     soft_tsc = [
         t for t in pod.spec.topology_spread_constraints
@@ -54,16 +66,16 @@ def relax(pod: Pod) -> bool:
             t for t in pod.spec.topology_spread_constraints
             if t.when_unsatisfiable != "ScheduleAnyway"
         ]
-        return True
+        return RELAX_SCHEDULE_ANYWAY_SPREAD
     # 4. preferred pod affinity / anti-affinity
     if aff and aff.pod_affinity and aff.pod_affinity.preferred:
         pod.spec.affinity = replace(
             aff, pod_affinity=replace(aff.pod_affinity, preferred=())
         )
-        return True
+        return RELAX_PREFERRED_POD_AFFINITY
     if aff and aff.pod_anti_affinity and aff.pod_anti_affinity.preferred:
         pod.spec.affinity = replace(
             aff, pod_anti_affinity=replace(aff.pod_anti_affinity, preferred=())
         )
-        return True
-    return False
+        return RELAX_PREFERRED_POD_ANTI_AFFINITY
+    return None

@@ -468,10 +468,21 @@ class Provisioner:
         for pod in shed:
             results.errors[pod.key] = padm.PRIORITY_SHED_ERROR
         if shed:
-            from karpenter_tpu import tracing
+            from karpenter_tpu import explain, tracing
 
             tracing.annotate(shed=len(shed),
                              cutoff_priority=order[cut].spec.priority)
+            if explain.active() is not None:
+                # the admission cutoff is the explanation: the pod was
+                # placeable, but everything at or past this priority
+                # was shed so the higher-priority prefix stays clean
+                cutoff = int(order[cut].spec.priority)
+                for pod in shed:
+                    explain.note_pod(
+                        pod.key, verdict="shed", code="priority_shed",
+                        cutoff_priority=cutoff,
+                        pod_priority=int(pod.spec.priority),
+                    )
             PRIORITY_SHED.inc(value=float(len(shed)))
             log.warning(
                 "priority admission: demand exceeds capacity; shed %d "
@@ -512,12 +523,19 @@ class Provisioner:
         for plan in results.new_node_plans:
             claim = self._claim_from_plan(plan, usage_by_pool)
             if claim is None:
+                from karpenter_tpu import explain
                 from karpenter_tpu.provisioning.priority import (
                     LIMITS_ERROR,
                 )
 
                 for pod in plan.pods:
                     results.errors[pod.key] = LIMITS_ERROR
+                    if explain.active() is not None:
+                        explain.note_pod(
+                            pod.key, verdict="unschedulable",
+                            error=LIMITS_ERROR, code="limits",
+                            pool=plan.pool.metadata.name,
+                        )
                 continue
             if claim.status.capacity:
                 pool_name = plan.pool.metadata.name
@@ -767,13 +785,28 @@ class Provisioner:
                             f"{plan.claim_name}",
                 ), now=now)
         if results.errors:
+            from karpenter_tpu import explain
+            from karpenter_tpu.explain import funnel as funnel_mod
+            from karpenter_tpu.metrics.store import POD_UNSCHEDULABLE_TICKS
+            from karpenter_tpu.provisioning.scheduler import reason_code
+
             for key, reason in results.errors.items():
                 pod = self.kube.get_pod(*key.split("/", 1))
                 if pod is None:
                     continue
+                # persistence stays visible through the counter even
+                # while the (sticky-deduped) Event below never reposts
+                POD_UNSCHEDULABLE_TICKS.inc({"reason": reason_code(reason)})
+                message = f"Failed to schedule pod: {reason}"
+                exclusions = funnel_mod.top_exclusions(explain.find_pod(key))
+                if exclusions:
+                    message += " (" + "; ".join(exclusions) + ")"
+                # sticky: an identical message republished tick after
+                # tick refreshes the recorder's frozen-key dedupe
+                # window instead of reposting every DEDUPE_TTL
                 self.recorder.publish(Event(
                     kind="Pod", name=pod.metadata.name,
                     namespace=pod.metadata.namespace, type="Warning",
                     reason="FailedScheduling",
-                    message=f"Failed to schedule pod: {reason}",
-                ), now=now)
+                    message=message,
+                ), now=now, sticky=True)

@@ -94,6 +94,48 @@ DRA_ERROR = (
     "supported"
 )
 
+# the relaxation ladder's terminal failure on the per-pod topology
+# path — single-sourced like NO_CAPACITY_ERROR/LIMITS_ERROR (ISSUE 14
+# satellite): the exact string is the contract; consumers that need a
+# machine-readable class go through reason_code() below
+TOPOLOGY_INCOMPATIBLE_ERROR = (
+    "incompatible with topology constraints or no capacity"
+)
+
+# minValues rejects are parametric ("minValues requirement not met:
+# <detail>"); this prefix is their stable, matchable head
+MIN_VALUES_ERROR_PREFIX = "minValues requirement not met"
+
+
+def reason_code(error: str) -> str:
+    """Structured reason code for one scheduler error string — the
+    label the unschedulable-ticks counter and the explain plane carry
+    so dashboards never regex free-form prose. Exact-string consumers
+    (priority shedding, preemption, the disruption veto) keep matching
+    the canonical constants; this is the classification layer on top."""
+    if error == NO_CAPACITY_ERROR:
+        return "no_capacity"
+    if error == TOPOLOGY_INCOMPATIBLE_ERROR:
+        return "topology_or_capacity"
+    if error == TIMEOUT_ERROR:
+        return "timeout"
+    if error == DRA_ERROR:
+        return "dra_unsupported"
+    if error.startswith(MIN_VALUES_ERROR_PREFIX):
+        return "min_values"
+    # late imports: provisioning.priority imports THIS module for the
+    # canonical capacity string, so its constants resolve lazily here
+    from karpenter_tpu.provisioning.priority import (
+        LIMITS_ERROR,
+        PRIORITY_SHED_ERROR,
+    )
+
+    if error == LIMITS_ERROR:
+        return "limits"
+    if error == PRIORITY_SHED_ERROR:
+        return "priority_shed"
+    return "other"
+
 
 @dataclass
 class SchedulerResults:
@@ -201,6 +243,52 @@ def pool_spot_budget(pool: NodePool) -> tuple[float, int]:
     floor = _knob(SPOT_MIN_ON_DEMAND_ANNOTATION, SPOT_MIN_ON_DEMAND_ENV,
                   0, int, 0)
     return (min(frac, 1.0), floor)
+
+
+def note_unschedulable_explanations(
+    pods: Sequence[Pod],
+    results: "SchedulerResults",
+    pools_with_types,
+    existing_inputs: Sequence[ExistingNodeInput],
+    daemon_overhead: Optional[dict] = None,
+    reserved_in_use: Optional[dict[str, int]] = None,
+) -> None:
+    """Record a verdict for every unschedulable pod — the error, its
+    structured reason code, and (for capacity-class failures) the
+    elimination funnel. Module-level: the full Scheduler and the
+    incremental live tick explain through the same function, so the
+    two paths' accounts cannot drift. Runs AFTER the solve, only over
+    the failed set, with the funnel memoized per scheduling signature
+    so a thousand identical starved pods pay one catalog walk."""
+    from karpenter_tpu import explain
+    from karpenter_tpu.explain import funnel as funnel_mod
+
+    if explain.active() is None or not results.errors:
+        return
+    by_key = {p.key: p for p in pods}
+    funnel_cache: dict[tuple, dict] = {}
+    for key, error in sorted(results.errors.items()):
+        code = reason_code(error)
+        explain.note_pod(key, verdict="unschedulable", error=error,
+                         code=code)
+        if code not in ("no_capacity", "topology_or_capacity"):
+            continue
+        pod = by_key.get(key)
+        if pod is None:
+            continue
+        sig = (
+            Requirements.from_pod(pod).signature(),
+            tuple(sorted(pod.spec.tolerations, key=repr)),
+            tuple(sorted(resutil.pod_requests(pod).items())),
+        )
+        funnel = funnel_cache.get(sig)
+        if funnel is None:
+            funnel = funnel_mod.compute(
+                pod, pools_with_types, existing_inputs,
+                daemon_overhead, reserved_in_use,
+            )
+            funnel_cache[sig] = funnel
+        explain.note_funnel(key, funnel)
 
 
 class NodeInputBuilder:
@@ -608,6 +696,36 @@ class Scheduler:
 
             slo.note("gap_vs_lp", solution.total_price / est - 1.0)
 
+    # -- decision explainability (karpenter_tpu/explain) ----------------------
+
+    def _explaining(self) -> bool:
+        """True only for the LIVE provisioning solve with an explain
+        record open: disruption simulations solve restricted
+        sub-problems whose 'errors' are probe verdicts, not scheduling
+        verdicts — they must not pollute pod explanations (the same
+        controller gate the SLO optimality feed uses)."""
+        if self.metrics_controller != "provisioner":
+            return False
+        from karpenter_tpu import explain
+
+        return explain.active() is not None
+
+    def _note_relax(self, pod: Pod, step: str) -> None:
+        if self._explaining():
+            from karpenter_tpu import explain
+
+            explain.note_relax(pod.key, step)
+
+    def _note_explanations(
+        self, pods: Sequence[Pod], results: SchedulerResults
+    ) -> None:
+        if not results.errors or not self._explaining():
+            return
+        note_unschedulable_explanations(
+            pods, results, self.pools_with_types, self.existing_inputs,
+            self.daemon_overhead, self.reserved_in_use,
+        )
+
     def _accept_solution(
         self, solution: Solution, open_plans: list, results: SchedulerResults,
         round_in_use: dict[str, int],
@@ -711,6 +829,7 @@ class Scheduler:
             ) as tsp:
                 results = self._solve(pods)
                 tsp.annotate(errors=len(results.errors))
+            self._note_explanations(pods, results)
             return results
         finally:
             degraded = resilience.pop_degraded()
@@ -868,6 +987,7 @@ class Scheduler:
                 if self.honor_preferences:
                     relaxed = relax(pod)
                     if relaxed:
+                        self._note_relax(pod, relaxed)
                         retry = self._batched_solve(
                             [pod], required_only=True,
                             reserved_in_use=round_in_use,
@@ -877,6 +997,13 @@ class Scheduler:
                                 retry, open_plans, results, round_in_use
                             )
                             retried = True
+                            if self._explaining():
+                                from karpenter_tpu import explain
+
+                                explain.note_pod(
+                                    pod.key, verdict="scheduled-after-relax",
+                                    relax_unlocked=relaxed,
+                                )
                 if not retried:
                     results.errors[pod.key] = NO_CAPACITY_ERROR
             for plan in open_plans:
@@ -1118,7 +1245,7 @@ class Scheduler:
             plan.min_values_relaxed = True
             return True
         for pod in plan.pods:
-            results.errors[pod.key] = f"minValues requirement not met: {err}"
+            results.errors[pod.key] = f"{MIN_VALUES_ERROR_PREFIX}: {err}"
         return False
 
     def _pod_domains(self) -> dict[str, dict[str, str]]:
@@ -1293,15 +1420,26 @@ class Scheduler:
             if self._timed_out():
                 results.errors[pod.key] = TIMEOUT_ERROR
                 continue
+            last_step: Optional[str] = None
             for _ in range(8):  # relaxation ladder bound
                 if self._try_place(pod, open_plans, topology, results, round_in_use):
+                    if last_step is not None and self._explaining():
+                        # the ladder unlocked this placement: say
+                        # which rung did it
+                        from karpenter_tpu import explain
+
+                        explain.note_pod(
+                            pod.key, verdict="scheduled-after-relax",
+                            relax_unlocked=last_step,
+                        )
                     break
                 topology.invalidate(pod.key)  # relax() mutates the pod
-                if not (self.honor_preferences and relax(pod)):
-                    results.errors[pod.key] = (
-                        "incompatible with topology constraints or no capacity"
-                    )
+                step = relax(pod) if self.honor_preferences else None
+                if not step:
+                    results.errors[pod.key] = TOPOLOGY_INCOMPATIBLE_ERROR
                     break
+                last_step = step
+                self._note_relax(pod, step)
 
     def _try_place(
         self,

@@ -601,8 +601,15 @@ class ProbePruner:
 
     def __init__(self, lane_solver: LaneSolver):
         self.lane_solver = lane_solver
+        # the last prune's certificate numbers (λ'·d floor vs price),
+        # valid when cannot_pay just returned True — the engine hands
+        # them to the explain plane as the kept:lp-prune evidence
+        self.last: Optional[dict] = None
 
     def cannot_pay(self, candidates) -> bool:
+        from karpenter_tpu.solver import lp_device
+
+        self.last = None
         ls = self.lane_solver
         cert = ls.dual_certificate()
         if cert is None or ls.last_enc is None:
@@ -624,7 +631,17 @@ class ProbePruner:
                 demand[g] += 1
         if current_price <= 0:
             return False
-        return cert.cannot_pay(demand, rows, current_price)
+        margin = lp_device.prune_margin()
+        floor = cert.floor(demand, rows)
+        pruned = cert.cannot_pay(demand, rows, current_price,
+                                 margin=margin, floor=floor)
+        if pruned:
+            self.last = {
+                "lp_floor": round(floor, 6),
+                "current_price": round(current_price, 6),
+                "margin": margin,
+            }
+        return pruned
 
 
 def _relaxable(pod: Pod) -> bool:

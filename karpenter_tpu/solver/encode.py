@@ -645,6 +645,18 @@ def _encode_impl(
     )
 
 
+def requirement_compat(
+    groups: Sequence[PodGroup], configs: Sequence[ConfigInfo]
+) -> np.ndarray:
+    """[G, C] requirement-only compatibility — the funnel stage the
+    explainability plane (karpenter_tpu/explain/funnel.py) replays
+    from the SAME vocab-mask machinery the solver encode uses, so an
+    explanation can never disagree with what the device saw. Taint
+    tolerance is deliberately excluded: the funnel accounts it as its
+    own stage."""
+    return _compat_matrix(groups, configs)
+
+
 def _full_compat(
     groups: Sequence[PodGroup], configs: Sequence[ConfigInfo]
 ) -> np.ndarray:

@@ -515,13 +515,58 @@ def maybe_solve(enc: Encoded, shards: int = 0):
     if enc.compat.shape[0] == 0 or not (enc.cfg_pool >= 0).any():
         return None
     try:
-        return solve(enc, shards=shards)
+        dlp = solve(enc, shards=shards)
     except Exception as err:
         from karpenter_tpu.metrics.store import SOLVER_LP_SOLVES
 
         SOLVER_LP_SOLVES.inc({"outcome": "degraded"})
         log.warning("device LP degraded to unguided path: %s", err)
         return None
+    # decision explainability (karpenter_tpu/explain): the duals ARE
+    # the economic reading of the tick — attach a per-solve summary to
+    # the open record (no record open / kill switch -> one global read)
+    from karpenter_tpu import explain
+
+    if explain.active() is not None:
+        explain.note_lp(dual_summary(enc, dlp))
+    return dlp
+
+
+def dual_summary(enc: Encoded, dlp: DeviceLP, k: int = 3) -> dict:
+    """The per-solve dual digest the explain plane records: the top-k
+    binding demand groups (their scaled dual prices — what one more
+    pod of that shape would cost the fleet), the reservation-cap duals
+    (what one more reserved instance would be worth), and the
+    certified bound. Values are the float64 host-certified duals,
+    rounded for stable replay comparison."""
+    lam = dlp.lam
+    order = [
+        int(gi) for gi in np.argsort(-lam, kind="stable")[:k]
+        if lam[gi] > 0
+    ]
+    return {
+        "bound": round(float(dlp.lower_bound), 6),
+        "binding_groups": [
+            {
+                "group": gi,
+                "dual": round(float(lam[gi]), 6),
+                "pods": int(enc.group_count[gi]),
+                "priority": (
+                    int(enc.group_priority[gi])
+                    if enc.group_priority is not None else 0
+                ),
+            }
+            for gi in order
+        ],
+        "reservation_cap_duals": [
+            round(float(m), 6) for m in dlp.mu.tolist()
+        ],
+        "iterations": int(dlp.iterations),
+        "converged": bool(dlp.converged),
+        # NOTE: cache_hit/wall_s deliberately absent — both track
+        # process history (the LRU, machine speed), not the decision,
+        # and would break the replay byte-identity contract
+    }
 
 
 def rank_prices(enc: Encoded, dlp: DeviceLP,
@@ -624,22 +669,39 @@ class DualCertificate:
             total = float(vals.sum())
         self.absorb_total = total
 
+    def floor(
+        self,
+        demand: np.ndarray,          # [G] pod counts of the candidates
+        candidate_rows: list[int],   # existing_index of each candidate
+    ) -> float:
+        """The weak-duality lower bound on ANY repack of `demand`
+        without the candidate rows: λ'·d minus the rest of the
+        fleet's absorbable value minus the reservation-cap term. The
+        number IS the economic explanation the explain plane records
+        ('kept because no replacement can beat $X/hr')."""
+        absorb_rest = self.absorb_total - sum(
+            self.absorb.get(r, 0.0) for r in set(candidate_rows)
+        )
+        return (
+            float(self.lam @ demand.astype(np.float64))
+            - max(absorb_rest, 0.0)
+            - self.cap_term
+        )
+
     def cannot_pay(
         self,
         demand: np.ndarray,          # [G] pod counts of the candidates
         candidate_rows: list[int],   # existing_index of each candidate
         current_price: float,
         margin: float | None = None,
+        floor: float | None = None,
     ) -> bool:
+        """THE prune predicate — callers that also report the floor
+        (the explain plane's kept:lp-prune evidence) pass it back in
+        so the decision and the evidence can never desync."""
         margin = prune_margin() if margin is None else margin
-        absorb_rest = self.absorb_total - sum(
-            self.absorb.get(r, 0.0) for r in set(candidate_rows)
-        )
-        floor = (
-            float(self.lam @ demand.astype(np.float64))
-            - max(absorb_rest, 0.0)
-            - self.cap_term
-        )
+        if floor is None:
+            floor = self.floor(demand, candidate_rows)
         return floor >= current_price * (1.0 + margin) + 1e-9
 
 

@@ -1,0 +1,84 @@
+"""Verdict-taxonomy docs-drift guard (ISSUE 14 satellite, the
+test_fault_docs pattern): every `kept:<reason>` verdict code the
+disruption layer can emit must have a row in README's verdict
+taxonomy table, and the table must not claim codes no code emits.
+
+Codes are extracted from the AST of the explain package (where the
+constants live) and of every module under karpenter_tpu/disruption/
+(where they are emitted — a literal landed there without a constant
+still counts), so the guard tracks the source of truth without
+importing conventions.
+"""
+
+import ast
+import pathlib
+import re
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+README = REPO / "README.md"
+SOURCES = [
+    REPO / "karpenter_tpu" / "explain" / "__init__.py",
+    *sorted((REPO / "karpenter_tpu" / "disruption").glob("*.py")),
+]
+
+_CODE = re.compile(r"^kept:[a-z0-9-]+$")
+
+
+def emitted_codes() -> dict[str, str]:
+    """{verdict code: relative module path} for every kept:<reason>
+    string constant in the explain package and the disruption layer."""
+    out: dict[str, str] = {}
+    for path in SOURCES:
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.Constant)
+                and isinstance(node.value, str)
+                and _CODE.match(node.value)
+            ):
+                out[node.value] = str(path.relative_to(REPO))
+    return out
+
+
+def _table_rows() -> list[str]:
+    return [
+        line.strip() for line in README.read_text().splitlines()
+        if line.strip().startswith("|")
+    ]
+
+
+def test_every_kept_verdict_code_has_a_readme_table_row():
+    rows = _table_rows()
+    missing = []
+    for code, module in sorted(emitted_codes().items()):
+        pattern = re.compile(r"^\|\s*`" + re.escape(code) + r"`\s*\|")
+        if not any(pattern.match(row) for row in rows):
+            missing.append(f"{code} ({module})")
+    assert not missing, (
+        "kept:<reason> verdict codes emitted in code without a row in "
+        f"README's verdict taxonomy table: {missing}"
+    )
+
+
+def test_readme_taxonomy_names_no_phantom_codes():
+    """The reverse direction: a README row claiming a kept:* code no
+    code emits is stale documentation."""
+    known = set(emitted_codes())
+    phantom = []
+    for row in _table_rows():
+        m = re.match(r"^\|\s*`(kept:[a-z0-9-]+)`\s*\|", row)
+        if m and m.group(1) not in known:
+            phantom.append(m.group(1))
+    assert not phantom, (
+        f"README verdict taxonomy rows with no emitting code: {phantom}"
+    )
+
+
+def test_guard_reads_the_real_constants():
+    """Self-check: the extraction actually sees the explain package's
+    constants — a refactor that moves them must update this guard, not
+    silently stop guarding."""
+    codes = emitted_codes()
+    assert "kept:lp-prune" in codes
+    assert "kept:same-type-guard" in codes
+    assert len(codes) >= 10

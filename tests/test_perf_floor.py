@@ -866,3 +866,66 @@ def test_telemetry_plane_overhead_under_5_percent(monkeypatch):
         f"telemetry-armed steady tick {armed * 1000:.2f}ms vs disarmed "
         f"{disarmed * 1000:.2f}ms — telemetry-plane overhead above 5%"
     )
+
+
+def test_explain_plane_overhead_under_5_percent(monkeypatch):
+    """ISSUE-14 guard: the explain plane runs INLINE on every tick —
+    the per-tick record open/finish, the note fast-paths on the
+    scheduler/engine hot sites, and the event-message fold — so its
+    healthy-path cost must stay under 5% of the steady-state tick.
+    Interleaved best-of-N with KARPENTER_EXPLAIN flipped per sample
+    (the telemetry-plane guard's shape). The steady tick here is
+    healthy (every pod bound), which is exactly the path that must
+    stay free: funnel computation only ever runs for failed pods."""
+    from karpenter_tpu import explain, tracing
+    from karpenter_tpu.cloudprovider.fake import GIB, make_instance_type
+    from karpenter_tpu.operator.operator import Operator
+    from karpenter_tpu.operator.options import Options
+    from karpenter_tpu.testing import Environment, interleaved_best_of
+
+    monkeypatch.delenv("KARPENTER_FAULTS", raising=False)
+    types = [make_instance_type("c4", cpu=4, memory=16 * GIB, price=1.0)]
+    env = Environment(types=types)
+    pool = mk_nodepool("p")
+    pool.spec.disruption.consolidate_after = "Never"
+    env.kube.create(pool)
+    env.provision(
+        *[mk_pod(name=f"xp-{i}", cpu=1.0, memory=2 * GIB)
+          for i in range(240)]
+    )
+    op = Operator(kube=env.kube, cloud_provider=env.cloud,
+                  options=Options())
+    now = time.time()
+    op.step(now=now)
+    op.step(now=now + 1)
+
+    tick = {"i": 0}
+
+    def sample(flag: str) -> float:
+        monkeypatch.setenv("KARPENTER_EXPLAIN", flag)
+        tick["i"] += 1
+        t0 = time.perf_counter()
+        # 0.9s spacing stays inside every periodic interval
+        op.step(now=now + 2 + tick["i"] * 0.9)
+        return time.perf_counter() - t0
+
+    sample("1")
+    sample("0")
+    try:
+        best = interleaved_best_of(
+            {"armed": lambda: sample("1"),
+             "disarmed": lambda: sample("0")},
+            rounds=20,
+            min_rounds=5,
+            satisfied=lambda b: (
+                b["armed"] < b["disarmed"] * 1.05 + 0.002
+            ),
+        )
+    finally:
+        tracing.clear()
+        explain.clear()
+    armed, disarmed = best["armed"], best["disarmed"]
+    assert armed < disarmed * 1.05 + 0.002, (
+        f"explain-armed steady tick {armed * 1000:.2f}ms vs disarmed "
+        f"{disarmed * 1000:.2f}ms — explain-plane overhead above 5%"
+    )

@@ -1,0 +1,138 @@
+#!/usr/bin/env python3
+"""Render a /debug/explain payload — or a bench artifact's
+explain_summary blocks — as human-readable text.
+
+Input (file argument or stdin):
+
+- a pod explanation (`/debug/explain?pod=<key>`): the elimination
+  funnel as an arrow chain, the relaxation steps burned, the error;
+- a node verdict (`/debug/explain?node=<name>`): the kept/consolidated
+  verdict with its evidence (LP certificate numbers, prices, vetoes);
+- a whole tick record (`/debug/explain?tick=<trace_id>`): every pod
+  and node verdict of that tick;
+- the bare /debug/explain digest;
+- a bench JSON whose arms carry `explain_summary` blocks: one verdict
+  histogram table per arm.
+
+    curl -s 'localhost:8080/debug/explain?pod=default/web-0' \\
+        | python tools/explain.py
+    python tools/explain.py BENCH_r06.json
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from karpenter_tpu.explain import funnel as funnel_mod  # noqa: E402
+
+
+def _fmt_counts(counts: dict[str, int]) -> str:
+    if not counts:
+        return "  (none)"
+    width = max(len(k) for k in counts)
+    return "\n".join(
+        f"  {k.ljust(width)}  {v}" for k, v in sorted(counts.items())
+    )
+
+
+def _render_pod(payload: dict) -> str:
+    head = f"pod {payload.get('pod', '?')}"
+    if payload.get("trace_id"):
+        head += f"  (tick {payload['trace_id']})"
+    verdict = payload.get("verdict")
+    if verdict:
+        head += f"  verdict={verdict}"
+    return head + "\n" + funnel_mod.render(payload)
+
+
+def _render_node(payload: dict) -> str:
+    lines = [f"node {payload.get('node', '?')}"
+             f"  (tick {payload.get('trace_id', '?')})"]
+    lines.append(f"verdict: {payload.get('verdict', '?')}")
+    for key in sorted(payload):
+        if key in ("node", "trace_id", "verdict"):
+            continue
+        lines.append(f"  {key}: {payload[key]}")
+    return "\n".join(lines)
+
+
+def _render_record(payload: dict) -> str:
+    lines = [f"tick {payload.get('trace_id', '?')}: "
+             f"{len(payload.get('pods', {}))} pod verdict(s), "
+             f"{len(payload.get('nodes', {}))} node verdict(s), "
+             f"{len(payload.get('lp', []))} LP summar(ies)"]
+    for key, rec in sorted(payload.get("pods", {}).items()):
+        lines.append(f"\npod {key}:")
+        lines.append(funnel_mod.render(rec))
+    for name, rec in sorted(payload.get("nodes", {}).items()):
+        extra = ", ".join(
+            f"{k}={v}" for k, v in sorted(rec.items()) if k != "verdict"
+        )
+        lines.append(
+            f"\nnode {name}: {rec.get('verdict', '?')}"
+            + (f"  ({extra})" if extra else "")
+        )
+    for lp in payload.get("lp", []):
+        groups = ", ".join(
+            f"g{g['group']}@{g['dual']}" for g in lp.get("binding_groups", [])
+        )
+        lines.append(
+            f"\nlp solve: bound={lp.get('bound')} binding=[{groups}] "
+            f"cap_duals={lp.get('reservation_cap_duals')}"
+        )
+    return "\n".join(lines)
+
+
+def _render_summary(name: str, summary: dict) -> str:
+    lines = [f"== {name} ==",
+             f"ticks={summary.get('ticks', 0)} "
+             f"pods={summary.get('pods_recorded', 0)} "
+             f"nodes={summary.get('nodes_recorded', 0)} "
+             f"funnel_depth_p50={summary.get('funnel_depth_p50')}"]
+    lines.append("verdicts:")
+    lines.append(_fmt_counts(summary.get("verdicts", {})))
+    lines.append("pod codes:")
+    lines.append(_fmt_counts(summary.get("pod_codes", {})))
+    return "\n".join(lines)
+
+
+def report(payload: dict) -> str:
+    """Dispatch on the payload shape (see module docstring)."""
+    if "pod" in payload and "pods" not in payload:
+        return _render_pod(payload)
+    if "node" in payload and "nodes" not in payload:
+        return _render_node(payload)
+    if "pods" in payload and "nodes" in payload:
+        return _render_record(payload)
+    if "digest" in payload:
+        return (
+            f"{len(payload.get('ticks', []))} tick record(s); last: "
+            + json.dumps(payload["digest"], sort_keys=True)
+        )
+    # bench JSON: arms carrying explain_summary blocks
+    detail = payload.get("detail", payload)
+    sections = [
+        _render_summary(arm, body["explain_summary"])
+        for arm, body in detail.items()
+        if isinstance(body, dict) and "explain_summary" in body
+    ]
+    if not sections:
+        return "(no explanation or explain_summary blocks found)"
+    return "\n\n".join(sections)
+
+
+def main(argv: list[str]) -> int:
+    if len(argv) > 1:
+        with open(argv[1]) as fh:
+            payload = json.load(fh)
+    else:
+        payload = json.load(sys.stdin)
+    print(report(payload))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
