@@ -1458,23 +1458,135 @@ def _live_operator_arm(n_pods: int, ticks: int, churn: float) -> dict:
     — the same full-fleet workload `tests/test_perf_floor.py` guards,
     so the bench and the perf floor measure one workload."""
     from karpenter_tpu.metrics.store import INCREMENTAL_DIVERGENCE
-    from karpenter_tpu.testing import build_churn_operator, churn_tick_walls
+    from karpenter_tpu.testing import (
+        build_churn_operator,
+        churn_tick_walls,
+        disruption_scan_walls,
+    )
 
     churn_k = max(1, int(n_pods * churn))
 
-    def run_arm(env_overrides: dict) -> tuple[float, dict]:
+    def _with_env(env_overrides: dict, fn):
         saved = {k: os.environ.get(k) for k in env_overrides}
         os.environ.update(env_overrides)
         try:
-            env, op, now = build_churn_operator(n_pods)
-            p50, _ = churn_tick_walls(env, op, now, ticks, churn_k)
-            return p50, op.provisioner.incremental.status()
+            return fn()
         finally:
             for k, v in saved.items():
                 if v is None:
                     os.environ.pop(k, None)
                 else:
                     os.environ[k] = v
+
+    def run_arm(env_overrides: dict) -> tuple[float, dict]:
+        def body():
+            env, op, now = build_churn_operator(n_pods)
+            p50, _ = churn_tick_walls(env, op, now, ticks, churn_k)
+            return p50, op.provisioner.incremental.status()
+
+        return _with_env(env_overrides, body)
+
+    def scan_arm(snapshot_on: str) -> tuple[float, dict]:
+        """Disruption-scan wall (ISSUE 15): the candidate-scan +
+        fleet-snapshot pass on a dirty fleet, retained seam on vs the
+        from-scratch build."""
+
+        def body():
+            env, op, now = build_churn_operator(n_pods)
+            p50, _ = disruption_scan_walls(env, op, now, scans=5,
+                                           churn_pods=churn_k)
+            return p50, op.disruption.fleet_seam.status()
+
+        return _with_env(
+            {"KARPENTER_DISRUPTION_SNAPSHOT": snapshot_on}, body
+        )
+
+    def envelope_arm() -> dict:
+        """Previously-ineligible (topology/reservation/priority) churn
+        ticks on the O(dirty) path with the shadow audit forced EVERY
+        tick: incremental serves with zero divergences is the
+        acceptance claim, recorded here per round."""
+        from karpenter_tpu.kube.objects import (
+            LabelSelector,
+            TopologySpreadConstraint,
+        )
+        from karpenter_tpu.metrics.store import INCREMENTAL_TICK
+        from karpenter_tpu.cloudprovider.fake import (
+            GIB,
+            make_instance_type,
+        )
+        from karpenter_tpu.testing import (
+            Environment,
+            mk_nodepool,
+            mk_pod,
+        )
+
+        def _mixed(tick: int) -> list:
+            pods = []
+            for i in range(6):
+                pods.append(mk_pod(
+                    name=f"env-{tick}-p{i}", cpu=0.8,
+                    memory=2 * GIB,
+                    priority=100 if i % 2 == 0 else 0,
+                ))
+            for i in range(2):
+                pod = mk_pod(name=f"env-{tick}-s{i}", cpu=0.7,
+                             memory=2 * GIB, labels={"app": "spread"})
+                pod.spec.topology_spread_constraints = [
+                    TopologySpreadConstraint(
+                        max_skew=1,
+                        topology_key="topology.kubernetes.io/zone",
+                        when_unsatisfiable="DoNotSchedule",
+                        label_selector=LabelSelector.of(
+                            {"app": "spread"}
+                        ),
+                    )
+                ]
+                pods.append(pod)
+            return pods
+
+        def body():
+            def serves():
+                return sum(
+                    v for k, v in INCREMENTAL_TICK.samples()
+                    if dict(k).get("path") == "incremental"
+                )
+
+            div0 = INCREMENTAL_DIVERGENCE.total()
+            env = Environment(types=[make_instance_type(
+                "c4", cpu=4, memory=16 * GIB, price=1.0,
+                reservations=[("rsv-1", "test-zone-1", 2)],
+            )])
+            env.kube.create(mk_nodepool("p"))
+            env.provision(*_mixed(0))
+            env.provision()   # warm the retained state
+            s0 = serves()
+            for t in range(1, 4):
+                bound = sorted(
+                    (p for p in env.kube.pods() if p.spec.node_name),
+                    key=lambda p: p.metadata.name,
+                )
+                for pod in bound[:2]:
+                    env.kube.delete(pod)
+                env.provision(*_mixed(t))
+            status = env.provisioner.incremental.status()
+            return {
+                "incremental_ticks": int(serves() - s0),
+                "oracle_divergences": int(
+                    INCREMENTAL_DIVERGENCE.total() - div0
+                ),
+                "fallbacks": status["fallbacks"],
+                "quarantined": status["quarantined"],
+            }
+
+        return _with_env({
+            "KARPENTER_INCREMENTAL": "1",
+            "KARPENTER_INCR_AUDIT_EVERY": "1",
+            # the arm proves envelope ELIGIBILITY + decision identity;
+            # the tiny fixture's churn fraction must not shunt ticks
+            # onto the (separately measured) churn backstop
+            "KARPENTER_INCR_CHURN_MAX": "1.0",
+        }, body)
 
     div0 = INCREMENTAL_DIVERGENCE.total()
     inc_p50, inc_status = run_arm({
@@ -1485,6 +1597,8 @@ def _live_operator_arm(n_pods: int, ticks: int, churn: float) -> dict:
     })
     full_p50, _ = run_arm({"KARPENTER_INCREMENTAL": "0"})
     divergences = int(INCREMENTAL_DIVERGENCE.total() - div0)
+    scan_p50, seam_status = scan_arm("1")
+    scan_fresh_p50, _ = scan_arm("0")
     return {
         "pods": n_pods,
         "ticks": ticks,
@@ -1498,6 +1612,15 @@ def _live_operator_arm(n_pods: int, ticks: int, churn: float) -> dict:
         "audited_ticks": audit_status["ticks"],
         "last_audit": audit_status["last_audit"],
         "oracle_divergences": divergences,
+        # ISSUE 15: disruption-scan wall with the retained seam vs the
+        # from-scratch snapshot build, and how much the seam reused
+        "disruption_scan_wall_s": round(scan_p50, 4),
+        "disruption_scan_fresh_wall_s": round(scan_fresh_p50, 4),
+        "disruption_scan_speedup": (
+            round(scan_fresh_p50 / scan_p50, 2) if scan_p50 > 0 else 0.0
+        ),
+        "snapshot_reuse": seam_status,
+        "envelope": envelope_arm(),
     }
 
 

@@ -53,11 +53,16 @@ from karpenter_tpu.kube.client import KubeClient
 from karpenter_tpu.metrics.store import (
     DISRUPTION_EVALUATION_DURATION,
     DISRUPTION_PROBE_STARVATION,
+    DISRUPTION_SNAPSHOT,
     NODECLAIMS_DISRUPTED,
 )
 from karpenter_tpu.kube.objects import Pod
 from karpenter_tpu.provisioning.provisioner import Provisioner
-from karpenter_tpu.provisioning.scheduler import Scheduler, SchedulerResults
+from karpenter_tpu.provisioning.scheduler import (
+    Scheduler,
+    SchedulerResults,
+    _state_node_key,
+)
 from karpenter_tpu.state.cluster import Cluster, StateNode
 from karpenter_tpu.utils.pdb import PdbLimits
 
@@ -95,6 +100,24 @@ class Candidate:
     zone: str
     price: float
     disruption_cost: float
+
+
+@dataclass
+class _CandidateCore:
+    """Retained per-node candidate-scan material (see
+    DisruptionEngine._candidate_core). PDB verdicts are deliberately
+    NOT cached: disruptions_allowed derives from the whole selected
+    pod population's live health, which pod events on OTHER nodes
+    change without touching this node's dirt — the scan re-asks
+    can_evict per pod against a per-scan allowance-memoized PdbLimits
+    instead."""
+
+    ver: tuple
+    # [(pod, is_daemon)] over the node's bound pods, sorted by pod key
+    pod_info: list
+    labels: dict
+    price_fp: object = None   # catalog fingerprint price resolved at
+    price: Optional[float] = None
 
 
 @dataclass
@@ -160,6 +183,23 @@ class DisruptionEngine:
         # batched-probe state: a thread-local probe cache — the search
         # methods prime it, simulate_scheduling consults it
         self._probe_tls = threading.local()
+        # the retained-inputs seam (ISSUE 15): every fleet snapshot a
+        # scan or simulation consumes comes from here, O(dirty) —
+        # candidate scans additionally retain per-node cores (pod
+        # lists, PDB verdicts, labels, prices) stamped with the seam's
+        # dirt generations
+        from karpenter_tpu.state.retained import RetainedFleetSeam
+
+        self.fleet_seam = RetainedFleetSeam(
+            kube, cluster,
+            pools_fn=provisioner.ready_pools_with_types,
+            options=self.options,
+        )
+        self._cand_cores: dict[str, "_CandidateCore"] = {}
+        self._cand_scans = 0
+        self._audit_scan = False
+        self._retain_cores = True
+        self._core_hits = self._core_rebuilds = 0
         from karpenter_tpu.disruption.validation import Validator
 
         self.queue.validator = Validator(self)
@@ -222,9 +262,14 @@ class DisruptionEngine:
         from karpenter_tpu.solver.consolidation_batch import BatchProbeSolver
 
         try:
+            # the retained seam serves the ladder's shared snapshot;
+            # the batch never mutates its rows (lanes are evaluated
+            # against encoded arrays), so a whole probe ladder costs
+            # zero re-copies
+            snapshot, input_cache = self.fleet_seam.fleet_snapshot()
             solver = BatchProbeSolver(
                 pools_with_types=self.provisioner.ready_pools_with_types(),
-                snapshot=self.cluster.deep_copy_nodes(),
+                snapshot=snapshot,
                 daemonsets=self.cluster.daemonsets(),
                 cluster_pods=self.kube.pods(),
                 pending_pods=self.provisioner.get_pending_pods(),
@@ -232,6 +277,7 @@ class DisruptionEngine:
                 kube=self.kube,
                 clock=self.clock,
                 compat_cache=self.provisioner.encode_cache,
+                existing_input_cache=input_cache,
             )
         except Exception:
             log.exception("probe batch setup failed; probing sequentially")
@@ -245,23 +291,129 @@ class DisruptionEngine:
 
     def get_candidates(self, reason: str, now: float) -> list[Candidate]:
         out = []
-        pdb = PdbLimits(self.kube)
+        # allowance memoized per SCAN: disruptions_allowed walks the
+        # namespace's whole pod population per selecting PDB, and a
+        # read-only scan over a fixed population sees one answer per
+        # PDB — per-pod recomputation was the dominant scan cost
+        pdb = PdbLimits(self.kube, memoize_allowance=True)
         # price lookups hit a per-round offering index instead of
         # re-fetching the full catalog per candidate (O(candidates ×
         # catalog) otherwise; the reference resolves prices from the
         # instance types already fetched for the scheduling run)
         self._price_index = {}
         protected = self.queue.protected_claim_names()
+        # retained candidate cores (ISSUE 15): the per-node pod list,
+        # PDB verdicts, labels and price survive across scans and
+        # methods, refreshed only for keys the seam's watch dirt
+        # names; every Nth scan is an identity audit against the
+        # from-scratch derivation
+        self.fleet_seam.sync()
+        self._cand_scans += 1
+        audit_every = self.fleet_seam.audit_every
+        self._audit_scan = (
+            audit_every > 0 and self._cand_scans % audit_every == 0
+        )
+        from karpenter_tpu.state.retained import retained_enabled
+
+        self._retain_cores = retained_enabled()
+        self._core_hits = self._core_rebuilds = 0
+        catalog_fp = self._candidate_catalog_fp()
         for node in self.cluster.nodes():
             candidate = self._build_candidate(node, reason, pdb, now,
-                                              protected)
+                                              protected,
+                                              catalog_fp=catalog_fp)
             if candidate is not None:
                 out.append(candidate)
+        self._audit_scan = False
+        # metric increments batched per scan (a per-node inc was
+        # measurable against the scan wall the cores exist to shrink)
+        if self._core_hits:
+            DISRUPTION_SNAPSHOT.inc(
+                {"outcome": "hit"}, value=float(self._core_hits)
+            )
+            self.fleet_seam.hits += self._core_hits
+        if self._core_rebuilds:
+            DISRUPTION_SNAPSHOT.inc(
+                {"outcome": "rebuild"}, value=float(self._core_rebuilds)
+            )
+            self.fleet_seam.rebuilds += self._core_rebuilds
         return out
+
+    def _candidate_catalog_fp(self):
+        """Cheap catalog identity stamping the cores' cached prices —
+        a reprice/overlay/ICE flip re-resolves them, nothing else
+        does. None (fetch hiccup) disables price caching this scan."""
+        try:
+            from karpenter_tpu.solver.incremental import (
+                catalog_fingerprint,
+            )
+
+            return catalog_fingerprint(
+                self.provisioner.ready_pools_with_types()
+            )
+        except Exception:
+            return None
+
+    def _candidate_core(
+        self, node: StateNode, pdb: PdbLimits, catalog_fp,
+    ) -> "_CandidateCore":
+        """The retained expensive half of one node's candidate scan:
+        pod fetches, PDB matching, the label merge and the price
+        lookup. Stamped with the seam's dirt generations; a stale (or
+        audit-scan) core rebuilds from scratch, and an audit mismatch
+        invalidates every core."""
+        key = _state_node_key(node)
+        ver = self.fleet_seam.node_version(key) + (
+            self.fleet_seam.pdb_epoch,
+        )
+        core = self._cand_cores.get(key) if key else None
+        retain = self._retain_cores and bool(key)
+        if core is not None and core.ver == ver and not self._audit_scan:
+            self._core_hits += 1
+            return core
+        fresh = _CandidateCore(ver=ver, pod_info=[],
+                               labels=dict(node.labels()))
+        for pod_key in sorted(node.pod_keys):
+            pod = self.kube.get_pod(*pod_key.split("/", 1))
+            if pod is None:
+                continue
+            fresh.pod_info.append((
+                pod,
+                pod.owner_kind() == "DaemonSet",
+            ))
+        if core is not None and core.ver == ver and self._audit_scan:
+            # decision-identity oracle: the retained core must match
+            # the from-scratch derivation field for field
+            DISRUPTION_SNAPSHOT.inc({"outcome": "audit"})
+            same = (
+                core.labels == fresh.labels
+                and len(core.pod_info) == len(fresh.pod_info)
+                and all(
+                    a[0] is b[0] and a[1] == b[1]
+                    for a, b in zip(core.pod_info, fresh.pod_info)
+                )
+            )
+            if not same:
+                DISRUPTION_SNAPSHOT.inc({"outcome": "divergence"})
+                log.error(
+                    "retained candidate core for %s diverged from the "
+                    "from-scratch scan; invalidating candidate cores",
+                    key,
+                )
+                self._cand_cores.clear()
+            else:
+                fresh.price_fp = core.price_fp
+                fresh.price = core.price
+        else:
+            self._core_rebuilds += 1
+        if retain:
+            self._cand_cores[key] = fresh
+        return fresh
 
     def _build_candidate(
         self, node: StateNode, reason: str, pdb: PdbLimits, now: float,
         protected: frozenset = frozenset(),
+        catalog_fp=None,
     ) -> Optional[Candidate]:
         # Every node the scan rejects for a POLICY reason gets a
         # structured verdict in the explain plane (`kept:<reason>`) —
@@ -327,10 +479,15 @@ class DisruptionEngine:
         # ACTIVE pod (mirror and daemonset pods may block with the
         # annotation too); the PDB check self-gates on evictability
         # (mirror pods bypass it, daemonset pods do not)
+        core = self._candidate_core(node, pdb, catalog_fp)
         pods = []
-        for pod_key in node.pod_keys:
-            pod = self.kube.get_pod(*pod_key.split("/", 1))
-            if pod is None or pod.is_terminal() or pod.is_terminating():
+        for pod, is_daemon in core.pod_info:
+            # terminal-state, annotation and PDB-budget reads stay
+            # LIVE per scan (the budget depends on OTHER nodes' pod
+            # health; the allowance memo on `pdb` bounds its cost to
+            # once per PDB per scan) — the store lookups and label
+            # merges are what the core retains
+            if pod.is_terminal() or pod.is_terminating():
                 continue
             if (
                 pod.metadata.annotations.get(DO_NOT_DISRUPT_ANNOTATION)
@@ -338,19 +495,25 @@ class DisruptionEngine:
                 and not eventual
             ):
                 explain.note_candidate(
-                    node.name, explain.KEPT_DO_NOT_DISRUPT, pod=pod_key
+                    node.name, explain.KEPT_DO_NOT_DISRUPT, pod=pod.key
                 )
                 return None
             if pdb.can_evict(pod) is not None and not eventual:
                 explain.note_candidate(
-                    node.name, explain.KEPT_PDB_BLOCKED, pod=pod_key
+                    node.name, explain.KEPT_PDB_BLOCKED, pod=pod.key
                 )
                 return None
-            if pod.owner_kind() == "DaemonSet":
+            if is_daemon:
                 continue
             pods.append(pod)
-        labels = node.labels()
-        price = self._node_price(labels)
+        labels = core.labels
+        if catalog_fp is not None and core.price_fp == catalog_fp:
+            price = core.price
+        else:
+            price = self._node_price(labels)
+            if catalog_fp is not None:
+                core.price_fp = catalog_fp
+                core.price = price
         if price is None:
             if reason == REASON_UNDERUTILIZED:
                 # unpriceable candidates are excluded from consolidation
@@ -518,8 +681,13 @@ class DisruptionEngine:
                 if hit is not None:
                     return hit
         deleting_names = {c.state_node.name for c in candidates}
+        # the retained seam serves the snapshot rows + input cache; the
+        # Scheduler below commits displaced pods onto the served rows,
+        # so the touched keys are reported back (note_mutated) and
+        # re-copied before the next serve
+        rows, input_cache = self.fleet_seam.fleet_snapshot()
         snapshot = []
-        for node in self.cluster.deep_copy_nodes():
+        for node in rows:
             if node.name in deleting_names:
                 continue
             # uninitialized-node guard (helpers.go:122-141): abort while
@@ -532,8 +700,12 @@ class DisruptionEngine:
                     False,
                 )
             snapshot.append(node)
-        return self._simulate_on_snapshot(candidates, snapshot, objective,
-                                          include_pending)
+        results, all_ok = self._simulate_on_snapshot(
+            candidates, snapshot, objective, include_pending,
+            existing_input_cache=input_cache,
+        )
+        self.fleet_seam.note_mutated(results.existing_assignments.keys())
+        return results, all_ok
 
     def has_uninitialized_capacity(
         self, exclude_names: Optional[set] = None
@@ -552,10 +724,12 @@ class DisruptionEngine:
     def _simulate_on_snapshot(
         self, candidates: Sequence[Candidate], snapshot: list,
         objective: str, include_pending: bool,
+        existing_input_cache: Optional[dict] = None,
     ) -> tuple[SchedulerResults, bool]:
         pods = [p for c in candidates for p in c.reschedulable_pods]
         pending = self.provisioner.get_pending_pods() if include_pending else []
         scheduler = Scheduler(
+            existing_input_cache=existing_input_cache,
             pools_with_types=self.provisioner.ready_pools_with_types(),
             state_nodes=snapshot,
             daemonsets=self.cluster.daemonsets(),

@@ -202,6 +202,13 @@ SOLVER_ENCODE_CACHE = REGISTRY.counter(
 SOLVER_INCREMENTAL_TICKS = REGISTRY.counter(
     "karpenter_solver_incremental_ticks_total",
     "Warm-start pipeline ticks, by mode (incremental/full) and reason")
+SOLVER_INCREMENTAL_DUAL = REGISTRY.counter(
+    "karpenter_solver_incremental_dual_total",
+    "Dual-guided residual repack activity, by outcome (rank_win: the "
+    "reduced-cost-ordered repack beat the unguided pack and was "
+    "kept; rank_loss: the unguided pack stayed; floor_skip: a drift "
+    "backstop re-solve skipped because weak duality proved the "
+    "retained fleet already prices within epsilon of the LP floor)")
 # incremental live tick (provisioning/incremental_tick.py): the
 # provisioner's retained-state reconcile path and its self-audit
 INCREMENTAL_TICK = REGISTRY.counter(
@@ -230,6 +237,13 @@ DISRUPTION_SCAN_SKIPPED = REGISTRY.counter(
     "Disruption reconcile rounds skipped because nothing went dirty "
     "since the last empty-handed scan (the watch-driven O(changes) "
     "gate; a periodic forced scan bounds staleness)")
+DISRUPTION_SNAPSHOT = REGISTRY.counter(
+    "karpenter_disruption_snapshot_total",
+    "Retained disruption snapshot rows, by outcome (hit: row served "
+    "from the retained fleet seam; rebuild: row re-derived for a "
+    "dirty/volatile node; audit: from-scratch identity audits of a "
+    "retained scan; divergence: audit mismatches — each one "
+    "invalidates the retained rows and serves the fresh build)")
 SOLVER_DEVICE_STEPS = REGISTRY.histogram(
     "karpenter_solver_device_steps",
     "Outer-loop device steps per packing solve, by path "

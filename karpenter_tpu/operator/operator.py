@@ -825,8 +825,13 @@ class Operator:
             # the API ({} until the first tick has run)
             "recovery": dict(self._recovery),
             # incremental live tick: last oracle-audit verdict,
-            # retained-state fingerprint + age, quarantine state
+            # retained-state fingerprint + age, quarantine state,
+            # per-reason full-path fallback rollup
             "incremental": self.provisioner.incremental.status(),
+            # retained disruption snapshots (ISSUE 15): row reuse hit
+            # rate + identity-audit verdicts for the fleet seam every
+            # candidate scan and simulation consumes
+            "disruption_snapshot": self.disruption.fleet_seam.status(),
             # per-pool launch/registration health (state/nodepoolhealth
             # ring buffers): a pool failing most recent registrations
             # is visible here and in

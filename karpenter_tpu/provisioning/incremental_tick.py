@@ -13,29 +13,46 @@ operator tick:
   across rounds and refreshed only for keys the kube watch stream
   marked dirty (`DirtyTracker` with mapped keys: a Pod event dirties
   the node it is bound to; a NodeClaim event dirties both its claim
-  key and its node). A 410-driven relist marks EVERYTHING dirty — the
-  diff events of a relist cannot prove nothing else changed while the
-  watch was stale, so lost continuity always costs one full rebuild,
-  never a silent stale row.
+  key and its node). Alongside each input row the tick retains the
+  node's TOPOLOGY-DOMAIN columns (labels, taints, hostname) and its
+  RESERVATION column (the reservation id the node consumes) —
+  refreshed by the same dirty marks, so topology-spread and
+  reservation-holding ticks ride the O(dirty) path too (ISSUE 15). A
+  410-driven relist marks EVERYTHING dirty — the diff events of a
+  relist cannot prove nothing else changed while the watch was stale,
+  so lost continuity always costs one full rebuild, never a silent
+  stale row.
 
-- **Backstops**: strict eligibility gates route anything the batched
-  fast path cannot express (topology, host ports, volumes, DRA,
-  minValues pools, spot budgets, reservations) to the unchanged full
-  Scheduler; a churn threshold (`KARPENTER_INCR_CHURN_MAX`) does the
-  same when the dirty fraction says incrementality has nothing left to
-  save.
+- **Eligibility envelope** (ISSUE 15 widened it): the fast path now
+  expresses topology-spread constraints (lowered through the same
+  `solver/topo_batch` machinery the full Scheduler uses, against a
+  Topology built from the retained domain columns), reservation
+  budgets (the retained reservation ledger feeds the encode exactly
+  as `Scheduler.reserved_in_use` does), and priority-bearing ticks
+  (priority-major grouping is inherited from `group_pods`; a
+  mixed-priority tick that hits a capacity failure — the only case
+  the admission/shed machinery acts on — hands the whole tick to the
+  full path, reason `priority`). Strict gates still route anything
+  the batched path cannot express (pod affinity/anti-affinity, host
+  ports, volumes, DRA, minValues pools, non-default spot budgets) to
+  the unchanged full Scheduler; a churn threshold
+  (`KARPENTER_INCR_CHURN_MAX`) does the same when the dirty fraction
+  says incrementality has nothing left to save. Per-reason fallback
+  counts are retained and surfaced in `readyz()["incremental"]
+  ["fallbacks"]` so envelope regressions are visible at a glance.
 
 - **Oracle audit**: on a sampled cadence (`KARPENTER_INCR_AUDIT_EVERY`)
-  — and ALWAYS after fault-injector activity, crash recovery, or while
-  on post-quarantine probation — the tick also runs the full Scheduler
-  as a shadow and fingerprints both decision sets. Divergence
-  quarantines the retained state (cleared, encoder cache busted,
-  divergence recorded for replay) and serves the full-solve decision;
-  the next tick rebuilds from scratch and must pass a probation audit
-  before the cache is trusted again. The `incremental_poison`
-  degradation rung (solver/resilience.py) records every quarantined
-  serve, so a poisoned cache degrades to a full solve — never to a
-  wrong fleet.
+  — and ALWAYS after fault-injector activity, crash recovery, the
+  first tick that exercises a newly-widened envelope shape
+  (`envelope` trigger), or while on post-quarantine probation — the
+  tick also runs the full Scheduler as a shadow and fingerprints both
+  decision sets. Divergence quarantines the retained state (cleared,
+  encoder cache busted, divergence recorded for replay) and serves
+  the full-solve decision; the next tick rebuilds from scratch and
+  must pass a probation audit before the cache is trusted again. The
+  `incremental_poison` degradation rung (solver/resilience.py)
+  records every quarantined serve, so a poisoned cache degrades to a
+  full solve — never to a wrong fleet.
 
 - **Chaos**: `cache_poison@incremental` (solver/faults.py) corrupts
   one retained capacity row deterministically; `operator_crash` fires
@@ -47,7 +64,8 @@ operator tick:
 Decision identity is the design invariant: on eligible ticks the
 encode inputs (same builder, same ordering — live nodes in cluster
 order, in-flight fewest-pods-first — same catalog sort, same residual
-prune that provably preserves first-feasible order) match the full
+prune that provably preserves first-feasible order, same topology
+lowering fed from the retained domain columns) match the full
 Scheduler's, so the audit asserts equality, not a tolerance band.
 """
 
@@ -56,9 +74,15 @@ from __future__ import annotations
 import logging
 import os
 import time
-from dataclasses import replace
+from dataclasses import dataclass, replace
 from typing import Callable, Optional, Sequence
 
+from karpenter_tpu.apis.v1.labels import (
+    CAPACITY_TYPE_LABEL,
+    HOSTNAME_LABEL,
+    RESERVATION_ID_LABEL,
+    TOPOLOGY_ZONE_LABEL,
+)
 from karpenter_tpu.kube.dirty import DirtyTracker
 from karpenter_tpu.kube.objects import Pod
 from karpenter_tpu.metrics.store import (
@@ -70,6 +94,7 @@ from karpenter_tpu.metrics.store import (
     SCHEDULER_SCHEDULING_DURATION,
     SCHEDULER_UNSCHEDULABLE_PODS,
 )
+from karpenter_tpu.provisioning.preferences import relaxable
 from karpenter_tpu.provisioning.scheduler import (
     NO_CAPACITY_ERROR,
     SOLVE_TIMEOUT_SECONDS,
@@ -78,11 +103,15 @@ from karpenter_tpu.provisioning.scheduler import (
     _pool_requirements,
     _state_node_key,
     finalize_plan,
+    plan_domains,
+    plan_pseudo_input,
     pool_spot_budget,
 )
 from karpenter_tpu.scheduling.hostports import pod_host_ports
+from karpenter_tpu.scheduling.requirements import Requirements
+from karpenter_tpu.scheduling.topology import Topology
 from karpenter_tpu import tracing
-from karpenter_tpu.solver import faults
+from karpenter_tpu.solver import faults, topo_batch
 from karpenter_tpu.solver.encode import encode, group_pods
 from karpenter_tpu.solver.incremental import (
     _env_float,
@@ -124,6 +153,21 @@ def _claim_keys(event: str, claim) -> list[str]:
     if claim.status.node_name:
         keys.append(claim.status.node_name)
     return keys
+
+
+@dataclass
+class _NodeMeta:
+    """The retained non-capacity columns of one node: what the full
+    Scheduler re-derives per round for topology-domain discovery,
+    pod-domain mapping and the reservation ledger. Rebuilt exactly
+    when the node's `ExistingNodeInput` row rebuilds (same dirty
+    marks), so the two retained views cannot drift from each other."""
+
+    name: str                     # node name ("" while claim-keyed)
+    labels: dict[str, str]
+    taints: tuple
+    rid: str                      # reservation id consumed, "" if none
+    node: object                  # the LIVE StateNode (pod_keys source)
 
 
 def decision_fingerprint(results: SchedulerResults) -> tuple:
@@ -169,6 +213,7 @@ class IncrementalTickScheduler:
         make_scheduler: Callable,
         options=None,
         clock=None,
+        plans_over_limits: Optional[Callable] = None,
     ):
         self.kube = kube
         self.cluster = cluster
@@ -178,6 +223,11 @@ class IncrementalTickScheduler:
         # for the shadow oracle so the audit compares against exactly
         # what the fallback path would have decided
         self._make_scheduler = make_scheduler
+        # Provisioner._plans_over_limits: the admission loop's limit
+        # simulation. A mixed-priority tick whose plans would blow a
+        # pool limit must route to the full path (the shed/cutoff
+        # machinery wraps only full-path results).
+        self._plans_over_limits = plans_over_limits
         self.options = options
         self.clock = clock if clock is not None else time.monotonic
         self.churn_max = _env_float(ENV_CHURN_MAX, 0.25)
@@ -191,11 +241,13 @@ class IncrementalTickScheduler:
         self._tracker.watch("DaemonSet", key=lambda e, o: ["*"])
         # retained state
         self._inputs: dict = {}            # state key -> ExistingNodeInput
+        self._meta: dict[str, _NodeMeta] = {}   # state key -> _NodeMeta
         self._order: list[str] = []        # Scheduler's existing-node order
         self._builder: Optional[NodeInputBuilder] = None
         self._builder_fp: Optional[tuple] = None
         self._daemon_overhead: dict = {}
-        self._catalog_has_reserved = False
+        self._rsv_in_use: dict[str, int] = {}   # Scheduler.reserved_in_use
+        self._has_reserved = False
         # audit / quarantine state
         self._ticks = 0
         self._since_audit = 0
@@ -208,6 +260,13 @@ class IncrementalTickScheduler:
         self.divergences: list[dict] = []
         self._counts = {"incremental": 0, "full_backstop": 0,
                         "quarantined": 0}
+        # per-reason full-path fallback rollup (ISSUE 15 satellite):
+        # readyz()["incremental"]["fallbacks"] surfaces it so envelope
+        # regressions show up at a glance
+        self._fallbacks: dict[str, int] = {}
+        # which widened-envelope shapes this cache generation has
+        # served — the FIRST tick of each shape forces an audit
+        self._envelope_seen: set[str] = set()
 
     # -- external triggers ----------------------------------------------------
 
@@ -220,6 +279,7 @@ class IncrementalTickScheduler:
 
     def _invalidate(self, trigger: str) -> None:
         self._inputs.clear()
+        self._meta.clear()
         self._order = []
         if self._builder is not None:
             self._builder = None
@@ -227,8 +287,15 @@ class IncrementalTickScheduler:
         self._tracker.clear()
         self._force_audit = trigger
         self._age = 0
+        self._envelope_seen.clear()
 
     # -- tick -----------------------------------------------------------------
+
+    def _note_fallback(self, reason: str) -> None:
+        tracing.annotate(path="full_backstop", reason=reason)
+        INCREMENTAL_TICK.inc({"path": "full_backstop", "reason": reason})
+        self._counts["full_backstop"] += 1
+        self._fallbacks[reason] = self._fallbacks.get(reason, 0) + 1
 
     def tick(
         self, pods: Sequence[Pod], pools_with_types,
@@ -251,9 +318,7 @@ class IncrementalTickScheduler:
 
         reason = self._ineligible(pods, pools_with_types)
         if reason is not None:
-            tracing.annotate(path="full_backstop", reason=reason)
-            INCREMENTAL_TICK.inc({"path": "full_backstop", "reason": reason})
-            self._counts["full_backstop"] += 1
+            self._note_fallback(reason)
             return None
 
         pools = self._sorted_pools(pools_with_types)
@@ -275,12 +340,24 @@ class IncrementalTickScheduler:
             # perf-floor guarantee) and warm on the NEXT tick, whose
             # sync is the one-time O(fleet) rebuild.
             self._warm_pending = True
-            tracing.annotate(path="full_backstop", reason="cold")
-            INCREMENTAL_TICK.inc({"path": "full_backstop",
-                                  "reason": "cold"})
-            self._counts["full_backstop"] += 1
+            self._note_fallback("cold")
             return None
         self._warm_pending = False
+        # the FIRST tick exercising a newly-widened envelope shape
+        # (topology spread / reservations / priority) since the cache
+        # was (re)built earns a forced audit: the equality claim for
+        # the new machinery is proven live before it is trusted
+        shape = set()
+        if any(p.spec.topology_spread_constraints for p in pods):
+            shape.add("topology")
+        if self._has_reserved:
+            shape.add("reserved")
+        if any(p.spec.priority for p in pods):
+            shape.add("priority")
+        if shape - self._envelope_seen:
+            self._envelope_seen |= shape
+            if self._force_audit is None and not self._quarantined:
+                self._force_audit = "envelope"
         churn = self._sync(pools)
         # the poison site fires AFTER sync so a corrupted row is not
         # immediately rebuilt away — the audit must catch it instead
@@ -292,10 +369,7 @@ class IncrementalTickScheduler:
         if pods and not cold and churn > self.churn_max and (
             not self._quarantined
         ):
-            tracing.annotate(path="full_backstop", reason="churn")
-            INCREMENTAL_TICK.inc({"path": "full_backstop",
-                                  "reason": "churn"})
-            self._counts["full_backstop"] += 1
+            self._note_fallback("churn")
             return None
 
         from karpenter_tpu.solver import resilience
@@ -310,12 +384,10 @@ class IncrementalTickScheduler:
             )
             results.degraded_rungs = sorted(set(degraded))
         if results is None:
-            # the solve left pods only the relaxation ladder can help:
-            # hand the whole tick to the full path
-            tracing.annotate(path="full_backstop", reason=fallback)
-            INCREMENTAL_TICK.inc({"path": "full_backstop",
-                                  "reason": fallback})
-            self._counts["full_backstop"] += 1
+            # the solve left pods only the full path's machinery (the
+            # relaxation ladder, the per-pod topology path, priority
+            # admission) can finish: hand the whole tick over
+            self._note_fallback(fallback)
             return None
 
         self._since_audit += 1
@@ -397,26 +469,19 @@ class IncrementalTickScheduler:
         """First reason this tick cannot ride the retained-state fast
         path, or None. Every gate here names machinery only the full
         Scheduler implements — the audit's equality claim holds only
-        inside this envelope."""
+        inside this envelope. ISSUE 15 widened the envelope: topology
+        SPREAD constraints, reservation-holding catalogs and
+        priority-bearing pods are now expressible (pod affinity /
+        anti-affinity, host ports, volumes, DRA, minValues and
+        non-default spot budgets still route full)."""
         from karpenter_tpu.utils.pod import has_dra_requirements
 
         for pod in pods:
             spec = pod.spec
-            if spec.priority or spec.priority_class_name:
-                # priority-bearing ticks route to the full path: the
-                # admission contract (Provisioner._enforce_priority_
-                # admission) wraps the full Scheduler's results, and
-                # the retained-state solve has no shed/cutoff
-                # machinery. Conservative first cut — widening the
-                # envelope to uniform-nonzero-priority ticks is a
-                # follow-up once the oracle audit covers it.
-                return "priority"
             if spec.volumes or spec.injected_requirements:
                 return "volumes"
             if pod_host_ports(pod):
                 return "host_ports"
-            if spec.topology_spread_constraints:
-                return "topology"
             aff = spec.affinity
             if aff is not None and (aff.pod_affinity or aff.pod_anti_affinity):
                 return "topology"
@@ -436,10 +501,19 @@ class IncrementalTickScheduler:
                 has_reserved = any(
                     o.is_reserved() for it in types for o in it.offerings
                 )
-        if has_reserved:
-            # reservation budgets need the live reserved_in_use ledger
+        if has_reserved and not self._allow_reserved():
+            # the ReservedCapacity gate strips reserved offerings from
+            # the catalog — a per-round InstanceType rebuild the
+            # retained fingerprints cannot cache; route full (rare
+            # configuration, not worth fast-pathing)
             return "reserved"
+        self._has_reserved = has_reserved
         return None
+
+    def _allow_reserved(self) -> bool:
+        if self.options is None:
+            return True
+        return bool(self.options.feature_gates.reserved_capacity)
 
     @staticmethod
     def _sorted_pools(pools_with_types):
@@ -450,6 +524,21 @@ class IncrementalTickScheduler:
         )
 
     # -- retained-state sync --------------------------------------------------
+
+    def _node_meta(self, sn) -> _NodeMeta:
+        labels = dict(sn.labels())
+        # rid is extracted UNCONDITIONALLY (two cheap reads): metas
+        # survive catalog changes, so a meta rebuilt while the catalog
+        # was temporarily reservation-free (an ICE window) must not
+        # undercount the ledger once the reservation returns
+        rid = _node_reservation_id(sn, labels)
+        return _NodeMeta(
+            name=sn.name,
+            labels=labels,
+            taints=tuple(sn.taints()),
+            rid=rid,
+            node=sn,
+        )
 
     def _sync(self, pools) -> float:
         """Refresh the retained inputs from cluster state, O(dirty).
@@ -485,14 +574,23 @@ class IncrementalTickScheduler:
             self._daemon_overhead = self._builder.daemon_overhead()
         if rebuild_all:
             self._inputs.clear()
+            self._meta.clear()
             self._age = 0
 
         rebuilt = 0
         live: list[str] = []
         inflight: list[tuple[tuple, str]] = []
         seen: set[str] = set()
+        deleting_rids: list[str] = []
         for sn in self.cluster.nodes():
             if sn.deleting():
+                # a deleting node holds its reservation instance until
+                # it is gone (reservationmanager.go) — the ledger must
+                # count it even though no retained row exists for it
+                if self._has_reserved:
+                    rid = _node_reservation_id(sn, sn.labels())
+                    if rid:
+                        deleting_rids.append(rid)
                 continue
             key = _state_node_key(sn)
             if not key:
@@ -510,6 +608,7 @@ class IncrementalTickScheduler:
             if key not in self._inputs or key in dirty or volatile:
                 self._builder.invalidate(key)
                 self._inputs[key] = self._builder.existing_input(sn)
+                self._meta[key] = self._node_meta(sn)
                 if not volatile:
                     rebuilt += 1
             if sn.initialized():
@@ -518,9 +617,25 @@ class IncrementalTickScheduler:
                 inflight.append(((len(sn.pod_keys), sn.name), key))
         for key in [k for k in self._inputs if k not in seen]:
             del self._inputs[key]
+            self._meta.pop(key, None)
             self._builder.invalidate(key)
         inflight.sort()
         self._order = live + [key for _, key in inflight]
+        # the reservation ledger, exactly as Scheduler.__init__ builds
+        # it: live usage (every node holding a reservation id, incl.
+        # deleting ones) bounds how many more instances a round may
+        # open. Retained rids for live rows; deleting rows scanned
+        # fresh above (few). Reservation-free catalogs skip all of it.
+        if self._has_reserved:
+            rsv: dict[str, int] = {}
+            for meta in self._meta.values():
+                if meta.rid:
+                    rsv[meta.rid] = rsv.get(meta.rid, 0) + 1
+            for rid in deleting_rids:
+                rsv[rid] = rsv.get(rid, 0) + 1
+            self._rsv_in_use = rsv
+        else:
+            self._rsv_in_use = {}
         return rebuilt / max(1, len(self._inputs))
 
     def _consume_poison(self) -> None:
@@ -552,54 +667,97 @@ class IncrementalTickScheduler:
     def _solve(
         self, pods: Sequence[Pod], pools,
     ) -> tuple[Optional[SchedulerResults], str]:
-        """The batched fast path against the retained inputs. Returns
-        (results, "") or (None, reason) when only the full path's
-        relaxation ladder can finish the tick."""
+        """The batched fast path against the retained inputs —
+        mirroring Scheduler._solve's structure: the simple pods ride
+        one batched solve (+ eviction retries), topology-spread pods
+        ride the lowered topo_batch solve against a Topology built
+        from the retained domain columns, and the round's reservation
+        ledger is debited across both phases. Returns (results, "")
+        or (None, reason) when only the full path's machinery can
+        finish the tick."""
         results = SchedulerResults(new_node_plans=[],
                                    existing_assignments={})
         if not pods:
             return results, ""
         work = dict(self._inputs)   # per-tick view; commits copy-on-write
         open_plans: list = []
-        place = list(pods)
-        still_failed: list[Pod] = []
         # same wall budget the full Scheduler's _solve enforces; a
         # blown budget hands the WHOLE tick to the full path, which
         # owns the TIMEOUT_ERROR semantics (stamping partial timeouts
         # here would make the audit's fingerprint comparison racy)
         deadline = self.clock() + SOLVE_TIMEOUT_SECONDS
+        # reservation budget for THIS round: live usage plus every
+        # plan opened during the round (Scheduler's round_in_use)
+        round_in_use: dict[str, int] = dict(self._rsv_in_use)
+
+        # split exactly as Scheduler._solve routes: topology-spread
+        # pods run the lowered batch; everything else is the fast
+        # path. (Pod affinity/anti-affinity, volumes, host ports and
+        # DRA made the whole tick ineligible already.)
+        simple = [p for p in pods
+                  if not p.spec.topology_spread_constraints]
+        complex_ = [p for p in pods if p.spec.topology_spread_constraints]
+
+        ok, reason = self._solve_simple(
+            simple, pools, work, open_plans, results, round_in_use,
+            deadline,
+        )
+        if not ok:
+            return None, reason
+        if complex_:
+            topology = self._build_topology(pods, pools)
+            # fast-path plans' pods enter the topology tracker before
+            # the lowered solve, exactly as Scheduler._solve registers
+            # its open plans after the fast path drains
+            for plan in open_plans:
+                for pod in plan.pods:
+                    topology.register(
+                        pod, plan_domains(plan),
+                        source_taints=tuple(
+                            plan.pool.spec.template.spec.taints),
+                    )
+            ok, reason = self._solve_topology(
+                complex_, pools, topology, work, open_plans, results,
+                round_in_use, deadline,
+            )
+            if not ok:
+                return None, reason
+
+        for plan in open_plans:
+            finalize_plan(plan)
+            results.new_node_plans.append(plan)
+
+        if self._priority_overloaded(pods, results):
+            # a mixed-priority tick with a capacity failure is exactly
+            # where the admission shed/cutoff machinery acts — and it
+            # wraps only full-path results
+            return None, "priority"
+        return results, ""
+
+    def _solve_simple(
+        self, place, pools, work, open_plans, results, round_in_use,
+        deadline,
+    ) -> tuple[bool, str]:
+        place = list(place)
+        still_failed: list[Pod] = []
         for _ in range(1 + RETRY_ROUNDS):
             if not place:
                 break
             if self.clock() > deadline:
-                return None, "timeout"
+                return False, "timeout"
             groups = group_pods(place)
             chosen = self._pruned_keys(groups, work)
             enc = encode(
                 groups, pools,
                 [work[k] for k in chosen],
                 self._daemon_overhead,
+                reserved_in_use=round_in_use,
                 compat_cache=self.cache,
             )
             sol = solve_encoded(enc)
-            for a in sol.existing:
-                key = chosen[a.existing_index]
-                results.existing_assignments.setdefault(key, []).extend(
-                    a.pods
-                )
-                inp = work[key]
-                usage = resutil.requests_for_pods(a.pods)
-                work[key] = replace(
-                    inp,
-                    available=resutil.positive(
-                        resutil.subtract(inp.available, usage)
-                    ),
-                    pod_count=inp.pod_count + len(a.pods),
-                )
-                # the committed row is provisional until the pods bind;
-                # rebuild it from cluster truth next tick
-                self._tracker.mark("Node", key)
+            self._commit_existing(sol, chosen, work, results)
             open_plans.extend(sol.new_nodes)
+            _debit_reservations(sol.new_nodes, round_in_use)
             evicted_keys = {p.key for p in sol.evicted}
             still_failed.extend(
                 p for p in sol.unschedulable if p.key not in evicted_keys
@@ -610,18 +768,221 @@ class IncrementalTickScheduler:
         still_failed.extend(place)  # retry bound hit
 
         for pod in still_failed:
-            aff = pod.spec.affinity
-            if aff is not None and aff.node_affinity is not None:
-                # the relaxation ladder could still place this pod
-                # (drop preferred terms / trailing OR-terms) — that
-                # machinery lives only in the full Scheduler
-                return None, "relaxation"
+            if relaxable(pod):
+                # the relaxation ladder could still place this pod —
+                # that machinery lives only in the full Scheduler
+                # (relaxable() checks WITHOUT mutating; relax() edits
+                # the pod the full path is about to re-solve)
+                return False, "relaxation"
             results.errors[pod.key] = NO_CAPACITY_ERROR
+        return True, ""
 
+    def _commit_existing(self, sol, chosen, work, results) -> None:
+        for a in sol.existing:
+            key = chosen[a.existing_index]
+            results.existing_assignments.setdefault(key, []).extend(
+                a.pods
+            )
+            inp = work[key]
+            usage = resutil.requests_for_pods(a.pods)
+            work[key] = replace(
+                inp,
+                available=resutil.positive(
+                    resutil.subtract(inp.available, usage)
+                ),
+                pod_count=inp.pod_count + len(a.pods),
+            )
+            # the committed row is provisional until the pods bind;
+            # rebuild it from cluster truth next tick
+            self._tracker.mark("Node", key)
+
+    # -- topology phase (ISSUE 15) --------------------------------------------
+
+    def _build_topology(self, pods, pools) -> Topology:
+        """The Topology the full Scheduler would build, derived from
+        the RETAINED domain columns instead of a per-round walk that
+        re-parses every node's labels: pool/type domains (O(catalog),
+        both paths pay it), per-node domain + taint provenance from
+        `_NodeMeta` (maintained O(dirty)), and pod->domain mappings
+        read through the retained labels. Only ticks that actually
+        carry topology constraints build one."""
+        from karpenter_tpu.scheduling.requirement import IN
+        from karpenter_tpu.solver.encode import pool_template_requirements
+
+        domains: dict[str, set] = {}
+        domain_taints: dict[str, dict[str, list]] = {}
+
+        def record(key: str, value: str, taints) -> None:
+            domains.setdefault(key, set()).add(value)
+            domain_taints.setdefault(key, {}).setdefault(value, []).append(
+                tuple(taints)
+            )
+
+        for pool, types in pools:
+            pool_reqs = pool_template_requirements(pool)
+            pool_taints = tuple(pool.spec.template.spec.taints)
+            for it in types:
+                for key in (TOPOLOGY_ZONE_LABEL, CAPACITY_TYPE_LABEL):
+                    req = it.requirements.get(key)
+                    if req.operator() == IN:
+                        gate = pool_reqs.get(key)
+                        for v in req.values:
+                            if gate.has(v):
+                                record(key, v, pool_taints)
+        pod_domains: dict[str, dict[str, str]] = {}
+        for key in self._order:
+            meta = self._meta.get(key)
+            if meta is None:
+                continue
+            for lk, lv in meta.labels.items():
+                record(lk, lv, meta.taints)
+            if meta.name:
+                record(HOSTNAME_LABEL, meta.name, meta.taints)
+            mapping = dict(meta.labels)
+            mapping[HOSTNAME_LABEL] = meta.name
+            for pod_key in meta.node.pod_keys:
+                pod_domains[pod_key] = mapping
+        scheduled = [p for p in self.kube.pods() if p.spec.node_name]
+        return Topology(
+            domains=domains,
+            cluster_pods=scheduled,
+            pending_pods=list(pods),
+            pod_domains=pod_domains,
+            honor_schedule_anyway=True,
+            domain_taints=domain_taints,
+        )
+
+    def _solve_topology(
+        self, complex_, pools, topology, work, open_plans, results,
+        round_in_use, deadline,
+    ) -> tuple[bool, str]:
+        """Scheduler._solve's lowered-topology block against the
+        retained rows. Anything the lowering cannot express (per-pod
+        fallback, deferred pods, plan joins with no fitting type)
+        hands the whole tick to the full path — the per-pod topology
+        loop and its relaxation ladder live only there."""
+        if self.clock() > deadline:
+            return False, "timeout"
+        plan_refs = []
+        plan_inputs = []
         for plan in open_plans:
-            finalize_plan(plan)
-            results.new_node_plans.append(plan)
-        return results, ""
+            inp = plan_pseudo_input(plan, self._daemon_overhead)
+            if inp is not None:
+                plan_refs.append(plan)
+                plan_inputs.append(inp)
+        row_keys = [k for k in self._order if k in work]
+        existing_rows = [work[k] for k in row_keys]
+        existing_all = existing_rows + plan_inputs
+        tb = topo_batch.prepare(complex_, topology, existing_all, {})
+        results.errors.update(tb.errors)
+        if tb.fallback:
+            return False, "topology"
+        if not tb.groups:
+            return True, ""
+        enc = encode(
+            tb.groups, pools, existing_all, self._daemon_overhead,
+            reserved_in_use=round_in_use,
+            group_cap=tb.group_cap,
+            conflict=tb.conflict,
+            existing_quota=tb.existing_quota,
+            compat_cache=self.cache,
+        )
+        sol = solve_encoded(enc)
+        n_before = len(open_plans)
+        open_plans.extend(sol.new_nodes)
+        _debit_reservations(sol.new_nodes, round_in_use)
+        E = len(existing_rows)
+        deferred: list[Pod] = []
+        for a in sol.existing:
+            if a.existing_index >= E:
+                # pods joined an open fast-path plan: narrow its
+                # options to types that hold the enlarged pod set and
+                # admit the new pods' requirements (the in-flight
+                # NodeClaim re-filter, nodeclaim.go:373-447)
+                plan = plan_refs[a.existing_index - E]
+                used = resutil.merge(
+                    self._daemon_overhead.get(plan.pool.metadata.name, {}),
+                    resutil.requests_for_pods(plan.pods + a.pods),
+                )
+                joined_reqs = [Requirements.from_pod(p) for p in a.pods]
+                fitting = [
+                    it for it in plan.instance_types
+                    if resutil.fits(used, it.allocatable)
+                    and all(
+                        it.requirements.intersects(r) is None
+                        for r in joined_reqs
+                    )
+                ]
+                if not fitting:
+                    deferred.extend(a.pods)
+                    continue
+                plan.instance_types = fitting
+                plan.offerings = [
+                    o for o in plan.offerings
+                    if any(it.offerings and o in it.offerings
+                           for it in fitting)
+                ] or plan.offerings
+                plan.pods.extend(a.pods)
+                domains = plan_domains(plan)
+                for p in a.pods:
+                    chosen = dict(domains)
+                    chosen.update(tb.assignments.get(p.key, {}))
+                    topology.register(p, chosen)
+                continue
+            key = row_keys[a.existing_index]
+            results.existing_assignments.setdefault(key, []).extend(
+                a.pods
+            )
+            inp = work[key]
+            usage = resutil.requests_for_pods(a.pods)
+            work[key] = replace(
+                inp,
+                available=resutil.positive(
+                    resutil.subtract(inp.available, usage)
+                ),
+                pod_count=inp.pod_count + len(a.pods),
+            )
+            self._tracker.mark("Node", key)
+            meta = self._meta.get(key)
+            labels = dict(meta.labels) if meta is not None else {}
+            labels[HOSTNAME_LABEL] = key
+            for p in a.pods:
+                chosen = dict(labels)
+                chosen.update(tb.assignments.get(p.key, {}))
+                topology.register(p, chosen)
+        for plan in open_plans[n_before:]:
+            domains = plan_domains(plan)
+            for p in plan.pods:
+                chosen = dict(domains)
+                chosen.update(tb.assignments.get(p.key, {}))
+                topology.register(p, chosen)
+        deferred.extend(sol.unschedulable)
+        if deferred:
+            return False, "topology"
+        return True, ""
+
+    # -- priority overload gate (ISSUE 15) ------------------------------------
+
+    def _priority_overloaded(self, pods, results) -> bool:
+        """True exactly when the full path's priority admission loop
+        would act: mixed priorities AND a capacity-class failure (the
+        solve's own no-capacity error, or a plan NodePool limits
+        would reject at create). Healthy mixed-priority ticks (the
+        common case) pay one scan and serve incrementally."""
+        from karpenter_tpu.provisioning.priority import mixed_priorities
+
+        if not mixed_priorities(list(pods)):
+            return False
+        if any(
+            err == NO_CAPACITY_ERROR for err in results.errors.values()
+        ):
+            return True
+        if (
+            self._plans_over_limits is not None
+            and any(p.pool.spec.limits for p in results.new_node_plans)
+        ):
+            return bool(self._plans_over_limits(results.new_node_plans))
+        return False
 
     def _pruned_keys(self, groups, work: dict) -> list[str]:
         """Residual prune (exact, from IncrementalPipeline): a node
@@ -650,8 +1011,15 @@ class IncrementalTickScheduler:
             inp = work.get(key)
             if inp is None:
                 continue
+            # float32-scale margin: the prune runs in float64 host
+            # arithmetic while the kernel judges fits in float32 — a
+            # boundary-exact fill (4x0.8 on a 4.0 node leaves
+            # 0.7999999999999994) reads as "full" here but as exactly
+            # 0.8f on device. Prune only nodes the kernel could never
+            # accept; a kept-but-infeasible row is a no-op column.
             if any(
-                inp.available.get(k, 0.0) < v for k, v in min_req.items()
+                inp.available.get(k, 0.0) < v * (1.0 - 1e-6)
+                for k, v in min_req.items()
             ):
                 continue
             out.append(key)
@@ -758,4 +1126,31 @@ class IncrementalTickScheduler:
             "last_audit": dict(self._last_audit),
             "divergences": len(self.divergences),
             "ticks": dict(self._counts),
+            # per-reason full-path fallback rollup (the
+            # karpenter_incremental_tick_total{path="full_backstop",
+            # reason} series as a readyz digest)
+            "fallbacks": dict(self._fallbacks),
         }
+
+
+def _node_reservation_id(sn, labels: dict[str, str]) -> str:
+    """The reservation a node consumes — its label once launched, or
+    the pinned claim requirement before launch (exactly the two reads
+    Scheduler.__init__'s ledger loop does)."""
+    rid = labels.get(RESERVATION_ID_LABEL, "")
+    if not rid and sn.node_claim is not None:
+        for spec in sn.node_claim.spec.requirements:
+            if spec.key == RESERVATION_ID_LABEL and spec.values:
+                rid = spec.values[0]
+                break
+    return rid
+
+
+def _debit_reservations(plans, round_in_use: dict[str, int]) -> None:
+    """Scheduler._debit_reservations, for the incremental round's
+    ledger: each plan opened against a reservation consumes one
+    instance for the remainder of the tick."""
+    for plan in plans:
+        rid = getattr(plan, "reservation_id", "")
+        if rid:
+            round_in_use[rid] = round_in_use.get(rid, 0) + 1

@@ -79,3 +79,29 @@ def relax(pod: Pod) -> Optional[str]:
         )
         return RELAX_PREFERRED_POD_ANTI_AFFINITY
     return None
+
+
+def relaxable(pod: Pod) -> bool:
+    """True when relax() would strip something — WITHOUT mutating the
+    pod. Retained-state fast paths (the incremental live tick, the
+    batched probe solver) use this to decide whether an unscheduled
+    pod must route to the full Scheduler's relaxation ladder; calling
+    relax() to find out would mutate the pod the full path is about to
+    re-solve."""
+    aff = pod.spec.affinity
+    if aff and aff.node_affinity:
+        if aff.node_affinity.preferred:
+            return True
+        if len(aff.node_affinity.required) > 1:
+            return True
+    if any(
+        t.when_unsatisfiable == "ScheduleAnyway"
+        for t in pod.spec.topology_spread_constraints
+    ):
+        return True
+    if aff:
+        if aff.pod_affinity and aff.pod_affinity.preferred:
+            return True
+        if aff.pod_anti_affinity and aff.pod_anti_affinity.preferred:
+            return True
+    return False

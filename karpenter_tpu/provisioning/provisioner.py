@@ -156,6 +156,11 @@ class Provisioner:
             kube, cluster, self.encode_cache,
             make_scheduler=self._make_scheduler,
             options=options, clock=self.clock,
+            # the admission loop's limit simulation: a mixed-priority
+            # incremental tick whose plans would blow a pool limit
+            # must fall back to the full path (where the shed/cutoff
+            # machinery wraps the results)
+            plans_over_limits=self._plans_over_limits,
         )
 
     # -- pod intake (provisioner.go:172-195, utils/node) ----------------------
@@ -283,9 +288,11 @@ class Provisioner:
         pools = self.ready_pools_with_types()
         # the incremental live tick is the default path; it returns
         # None for ticks outside its envelope (explicit extra_pods are
-        # a caller-scripted solve, not the live reconcile; priority-
-        # bearing ticks route to the full path via its eligibility
-        # gates, so admission below only ever sees full-path results).
+        # a caller-scripted solve, not the live reconcile). A
+        # mixed-priority tick that hits a capacity failure — the only
+        # case the admission loop below would act on — falls back to
+        # the full path inside the tick (reason "priority"), so an
+        # incremental serve never needs the shed/cutoff machinery.
         # The route span carries the decision + reason — the
         # incremental tick annotates it from its gates.
         if not extra_pods:

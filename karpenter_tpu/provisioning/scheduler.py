@@ -498,6 +498,56 @@ class NodeInputBuilder:
         return out
 
 
+def plan_domains(plan: NodePlan) -> dict[str, str]:
+    """Representative domains for a planned node. Module-level: the
+    full Scheduler and the incremental live tick derive a planned
+    node's topology contribution through the same function."""
+    out: dict[str, str] = {}
+    if plan.offerings:
+        out[TOPOLOGY_ZONE_LABEL] = plan.offerings[0].zone
+        out[CAPACITY_TYPE_LABEL] = plan.offerings[0].capacity_type
+    out[HOSTNAME_LABEL] = f"planned-{id(plan)}"
+    out[NODEPOOL_LABEL] = plan.pool.metadata.name
+    return out
+
+
+def plan_pseudo_input(
+    plan: NodePlan, daemon_overhead: dict
+) -> Optional[ExistingNodeInput]:
+    """An open plan as a pseudo-existing node for the lowered topology
+    solve — the in-flight NodeClaim model (scheduling/nodeclaim.go:
+    114-167): remaining capacity is the cheapest instance-type option
+    that still holds the plan's current pods. Module-level so the
+    incremental live tick's topology phase builds the exact same
+    pseudo rows the full Scheduler does."""
+    used = resutil.merge(
+        daemon_overhead.get(plan.pool.metadata.name, {}),
+        resutil.requests_for_pods(plan.pods),
+    )
+    for it in plan.instance_types:  # price-ordered
+        if resutil.fits(used, it.allocatable):
+            avail = resutil.positive(resutil.subtract(it.allocatable, used))
+            break
+    else:
+        return None
+    labels = plan_domains(plan)
+    reqs = Requirements.from_labels(labels)
+    for key, value in plan.pool.spec.template.labels.items():
+        reqs.add(Requirement(key, IN, [value]))
+    # permanent taints only: startupTaints clear before pods run, so
+    # they never gate placement onto the planned node (same rule as
+    # build_configs / statenode.go:322-326)
+    taints = tuple(plan.pool.spec.template.spec.taints)
+    return ExistingNodeInput(
+        name=f"planned-{id(plan)}",
+        requirements=reqs,
+        taints=taints,
+        available=avail,
+        pool_name=plan.pool.metadata.name,
+        pod_count=len(plan.pods),
+    )
+
+
 def finalize_plan(plan: NodePlan) -> None:
     """Price-order and truncate instance types, honoring the pool's
     minValues floors (results.TruncateInstanceTypes,
@@ -534,6 +584,7 @@ class Scheduler:
         metrics_controller: str = "provisioner",
         objective: str = "ffd",
         compat_cache=None,
+        existing_input_cache: Optional[dict[str, ExistingNodeInput]] = None,
     ):
         # "cost" engages the LP planner on the batched fast path (the
         # global-repack consolidation re-solve); topology/per-pod paths
@@ -615,7 +666,24 @@ class Scheduler:
         inflight = [n for n in state_nodes if not n.deleting() and not n.initialized()]
         inflight.sort(key=lambda n: (len(n.pod_keys), n.name))
         self.state_nodes = live + inflight
-        self.existing_inputs = [self._existing_input(n) for n in self.state_nodes]
+        # `existing_input_cache` (state/retained.RetainedFleetSeam):
+        # retained, dirty-tracked ExistingNodeInput rows keyed by
+        # _state_node_key — a cached row is exactly what
+        # _existing_input would build (the seam only retains rows for
+        # stable launched nodes and rebuilds on watch dirt), so a hit
+        # skips the per-node label/reserve derivation. Commits during
+        # the solve refresh the LOCAL list only; the shared cache dict
+        # is never mutated here.
+        if existing_input_cache:
+            self.existing_inputs = [
+                existing_input_cache.get(_state_node_key(n))
+                or self._existing_input(n)
+                for n in self.state_nodes
+            ]
+        else:
+            self.existing_inputs = [
+                self._existing_input(n) for n in self.state_nodes
+            ]
 
         # live reservation usage: nodes (incl. deleting — the instance
         # is held until gone) already launched against a reservation id
@@ -1351,46 +1419,10 @@ class Scheduler:
             self._host_ports.setdefault(host_port_key, HostPortUsage()).add(pod)
 
     def _plan_input(self, plan: NodePlan) -> Optional[ExistingNodeInput]:
-        """An open plan as a pseudo-existing node for the lowered
-        topology solve — the in-flight NodeClaim model (scheduling/
-        nodeclaim.go:114-167): remaining capacity is the cheapest
-        instance-type option that still holds the plan's current pods."""
-        used = resutil.merge(
-            self.daemon_overhead.get(plan.pool.metadata.name, {}),
-            resutil.requests_for_pods(plan.pods),
-        )
-        for it in plan.instance_types:  # price-ordered
-            if resutil.fits(used, it.allocatable):
-                avail = resutil.positive(resutil.subtract(it.allocatable, used))
-                break
-        else:
-            return None
-        labels = self._plan_domains(plan)
-        reqs = Requirements.from_labels(labels)
-        for key, value in plan.pool.spec.template.labels.items():
-            reqs.add(Requirement(key, IN, [value]))
-        # permanent taints only: startupTaints clear before pods run,
-        # so they never gate placement onto the planned node (same
-        # rule as build_configs / statenode.go:322-326)
-        taints = tuple(plan.pool.spec.template.spec.taints)
-        return ExistingNodeInput(
-            name=f"planned-{id(plan)}",
-            requirements=reqs,
-            taints=taints,
-            available=avail,
-            pool_name=plan.pool.metadata.name,
-            pod_count=len(plan.pods),
-        )
+        return plan_pseudo_input(plan, self.daemon_overhead)
 
     def _plan_domains(self, plan: NodePlan) -> dict[str, str]:
-        """Representative domains for a planned node."""
-        out: dict[str, str] = {}
-        if plan.offerings:
-            out[TOPOLOGY_ZONE_LABEL] = plan.offerings[0].zone
-            out[CAPACITY_TYPE_LABEL] = plan.offerings[0].capacity_type
-        out[HOSTNAME_LABEL] = f"planned-{id(plan)}"
-        out[NODEPOOL_LABEL] = plan.pool.metadata.name
-        return out
+        return plan_domains(plan)
 
     # -- slow path ------------------------------------------------------------
 

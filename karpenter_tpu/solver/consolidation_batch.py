@@ -647,24 +647,12 @@ class ProbePruner:
 def _relaxable(pod: Pod) -> bool:
     """True when preferences.relax() would strip something — the
     sequential path retries such pods, so a batched lane that left one
-    unscheduled must be re-probed sequentially, not cached."""
-    aff = pod.spec.affinity
-    if aff is not None and aff.node_affinity is not None:
-        if aff.node_affinity.preferred:
-            return True
-        if len(aff.node_affinity.required) > 1:
-            return True
-    if any(
-        t.when_unsatisfiable == "ScheduleAnyway"
-        for t in pod.spec.topology_spread_constraints
-    ):
-        return True
-    if aff is not None:
-        if aff.pod_affinity is not None and aff.pod_affinity.preferred:
-            return True
-        if aff.pod_anti_affinity is not None and aff.pod_anti_affinity.preferred:
-            return True
-    return False
+    unscheduled must be re-probed sequentially, not cached. One
+    canonical predicate (provisioning/preferences.relaxable) shared
+    with the incremental live tick's fallback gate."""
+    from karpenter_tpu.provisioning.preferences import relaxable
+
+    return relaxable(pod)
 
 
 class BatchProbeSolver:
@@ -690,6 +678,7 @@ class BatchProbeSolver:
         kube,
         clock,
         compat_cache=None,
+        existing_input_cache=None,
     ):
         from karpenter_tpu.provisioning.scheduler import Scheduler
 
@@ -697,6 +686,10 @@ class BatchProbeSolver:
         self.scheduler = Scheduler(
             pools_with_types=pools_with_types,
             state_nodes=snapshot,
+            # retained ExistingNodeInput rows from the fleet seam
+            # (state/retained.py): unchanged nodes skip the per-node
+            # input derivation
+            existing_input_cache=existing_input_cache,
             daemonsets=daemonsets,
             cluster_pods=cluster_pods,
             allow_reserved=options.feature_gates.reserved_capacity,

@@ -311,7 +311,8 @@ class ResidualNode:
 class TickResult:
     mode: str                  # "incremental" | "full"
     reason: str                # "steady" | "cold" | "churn" | "catalog"
-                               # | "drift" | "checked" | "invalidate"
+                               # | "drift" | "checked" | "dual_floor"
+                               # | "invalidate"
     scheduled: int
     unschedulable: int
     fleet_price: float
@@ -326,6 +327,52 @@ def _env_float(name: str, default: float) -> float:
         return float(os.environ.get(name, "") or default)
     except ValueError:
         return default
+
+
+def _env_on(name: str, default: str = "1") -> bool:
+    return os.environ.get(name, default).lower() not in (
+        "0", "false", "off"
+    )
+
+
+@dataclass
+class _DualFloor:
+    """The cached dual certificate the residual repack spends (ISSUE
+    15): built from the device LP of the last FULL solve's encode and
+    valid for as long as the catalog fingerprint holds (prices,
+    offerings and the reprice/penalty knobs are all inside the
+    fingerprint, so a reprice busts it through the normal full-tick
+    path).
+
+    - `lam_by_sig`: Farley-scaled demand duals keyed by group
+      signature (requirements signature, tolerations, resource
+      vector) — demand-INDEPENDENT dual feasibility means they bound
+      any later tick's demand: unknown signatures price at 0
+      (conservative), so `bound_for` is a valid weak-duality lower
+      bound on ANY fresh-fleet covering of the current pod set.
+    - `rank_launch`: the dual-adjusted reduced-cost price ordering
+      over the launchable config prefix (lp_device.rank_prices) — the
+      repack feeds it to the kernel as its type-preference input via
+      solve_encoded(price_hint=...); decode keeps true prices.
+    """
+
+    lam_by_sig: dict
+    cap_term: float
+    rank_launch: np.ndarray
+    n_launch: int
+
+    def bound_for(self, groups: Sequence[PodGroup]) -> float:
+        total = 0.0
+        for g in groups:
+            sig = (
+                g.requirements.signature(),
+                g.tolerations,
+                tuple(sorted(g.resources.items())),
+            )
+            lam = self.lam_by_sig.get(sig)
+            if lam:
+                total += lam * len(g.pods)
+        return max(0.0, total - self.cap_term)
 
 
 class IncrementalPipeline:
@@ -367,6 +414,9 @@ class IncrementalPipeline:
         )
         self.daemon_overhead = daemon_overhead or {}
         self.repack_objective = repack_objective
+        # dual certificate from the last full solve's encode
+        # (KARPENTER_INCR_DUAL_RANK / KARPENTER_INCR_DUAL_FLOOR knobs)
+        self._dual: Optional[_DualFloor] = None
         self._fleet: Optional[list[ResidualNode]] = None
         self._where: dict[str, ResidualNode] = {}
         self._pods: dict[str, Pod] = {}
@@ -392,6 +442,7 @@ class IncrementalPipeline:
         self._unplaced = set()
         self._marked = set()
         self._catalog_fp = None
+        self._dual = None
         self.cache.invalidate()
         if self._tracker is not None:
             # the next tick rebuilds from scratch anyway; stale dirty
@@ -455,6 +506,10 @@ class IncrementalPipeline:
                 "ResidualNode list aligned with the solve's "
                 "ExistingNodeInput order"
             )
+        # an externally-computed adoption invalidates the cached dual
+        # certificate (its catalog may differ); _full_tick re-derives
+        # it right after from its own encode
+        self._dual = None
         self._fleet = []
         self._where = {}
         self._pods = {p.key: p for p in pods}
@@ -592,14 +647,23 @@ class IncrementalPipeline:
     def _full_tick(
         self, pods, pools_with_types, objective, reason, churn=0.0
     ) -> TickResult:
-        from karpenter_tpu.solver.solver import solve
+        from karpenter_tpu.solver.encode import encode, group_pods
+        from karpenter_tpu.solver.solver import solve_encoded
 
-        sol = solve(
-            pods, pools_with_types,
-            daemon_overhead=self.daemon_overhead or None,
-            objective=objective, compat_cache=self.cache,
+        # encode here (instead of delegating to solve()) so the full
+        # problem's Encoded is in hand: the dual certificate the
+        # residual repack spends is derived from it, and under the
+        # cost objective the LP was already solved for this very
+        # fingerprint (maybe_solve is a cache hit)
+        groups = group_pods(pods)
+        enc = encode(
+            groups, pools_with_types, (),
+            self.daemon_overhead or None,
+            compat_cache=self.cache,
         )
+        sol = solve_encoded(enc, objective=objective)
         self.adopt(pods, sol, pools_with_types)
+        self._refresh_dual(enc)
         SOLVER_INCREMENTAL_TICKS.inc({"mode": "full", "reason": reason})
         return TickResult(
             mode="full",
@@ -612,12 +676,133 @@ class IncrementalPipeline:
             placed=len(pods),
         )
 
+    def _refresh_dual(self, enc) -> None:
+        """Rebuild the cached dual certificate from one full solve's
+        encode (see _DualFloor). Degrades to None — the repack then
+        runs exactly the unguided path."""
+        self._dual = None
+        if not (
+            _env_on("KARPENTER_INCR_DUAL_RANK")
+            or _env_on("KARPENTER_INCR_DUAL_FLOOR")
+        ):
+            return
+        from karpenter_tpu.solver import lp_device
+
+        dlp = lp_device.maybe_solve(enc)
+        if dlp is None:
+            return
+        try:
+            launch = enc.cfg_pool >= 0
+            n_launch = int(launch.sum())
+            # plannability mask, exactly as lp_device._stage derives
+            # it: the ascent prices only groups some launchable
+            # machine can hold one pod of — duals of excluded groups
+            # never entered the Farley scaling, so they must not
+            # enter the floor either
+            req = enc.group_req.astype(np.float64)
+            eff = np.clip(
+                enc.cfg_alloc
+                - enc.pool_overhead[np.maximum(enc.cfg_pool, 0)],
+                0.0, None,
+            )
+            eff = np.where(launch[:, None], eff, 0.0)
+            safe = np.where(req > 0, req, 1.0)
+            with np.errstate(divide="ignore", invalid="ignore"):
+                k = np.floor((eff[None, :, :] + 1e-4) / safe[:, None, :])
+            k = np.where(req[:, None, :] > 0, k, np.inf).min(axis=2)
+            k = np.where(enc.compat & launch[None, :], k, 0.0)
+            plannable = np.asarray(k >= 1).any(axis=1)
+            lam_by_sig: dict = {}
+            for gi, g in enumerate(enc.groups):
+                if not plannable[gi]:
+                    continue
+                sig = (
+                    g.requirements.signature(),
+                    g.tolerations,
+                    tuple(sorted(g.resources.items())),
+                )
+                lam = float(dlp.lam[gi])
+                prev = lam_by_sig.get(sig)
+                # signature collisions keep the smaller dual: the
+                # bound must stay valid for either group's demand
+                lam_by_sig[sig] = lam if prev is None else min(prev, lam)
+            cap_term = 0.0
+            if enc.rsv_cap is not None and len(dlp.mu):
+                cap_term = float(
+                    dlp.mu @ enc.rsv_cap.astype(np.float64)
+                )
+            self._dual = _DualFloor(
+                lam_by_sig=lam_by_sig,
+                cap_term=cap_term,
+                rank_launch=lp_device.rank_prices(enc, dlp)[:n_launch],
+                n_launch=n_launch,
+            )
+        except Exception:
+            import logging
+
+            logging.getLogger("karpenter.solver.incremental").exception(
+                "dual certificate refresh failed; repack runs unguided"
+            )
+            self._dual = None
+
+    def _repack_solve(self, enc):
+        """One residual repack solve, dual-rank-guided when the cached
+        certificate applies: the unguided pack runs first; only when
+        it OPENS fresh nodes (the one case ordering can matter — the
+        steady churn tick that lands every pod in freed slots pays
+        nothing) is the reduced-cost-ordered arm raced, and the
+        cheaper fleet kept (ties keep unguided). Decode prices are
+        the true catalog prices on both arms (price_hint contract)."""
+        from karpenter_tpu.metrics.store import SOLVER_INCREMENTAL_DUAL
+        from karpenter_tpu.solver.solver import solve_encoded
+
+        sol = solve_encoded(enc, objective=self.repack_objective)
+        dual = self._dual
+        if (
+            dual is None
+            or not sol.new_nodes
+            or not _env_on("KARPENTER_INCR_DUAL_RANK")
+            or self.repack_objective == "cost"  # has its own race
+        ):
+            return sol
+        # race only when the repack's fresh-open SPEND is a real
+        # fraction of the fleet (KARPENTER_INCR_DUAL_RANK_MIN,
+        # default 2%): the steady churn tick that opens a node or two
+        # has pennies of ordering headroom but would pay a second
+        # kernel dispatch (and its fresh-axis regrow/compile churn)
+        # every tick — the race engages on the scale-out bursts where
+        # LP-efficient type selection actually moves the bill
+        spend = sum(float(p.price) for p in sol.new_nodes)
+        floor_frac = _env_float("KARPENTER_INCR_DUAL_RANK_MIN", 0.02)
+        if spend < floor_frac * max(self.fleet_price, 1e-9):
+            return sol
+        launch = enc.cfg_pool >= 0
+        if int(launch.sum()) != dual.n_launch:
+            return sol
+        hint = enc.cfg_price.astype(np.float32).copy()
+        hint[: dual.n_launch] = dual.rank_launch
+        guided = solve_encoded(
+            enc, objective=self.repack_objective, price_hint=hint
+        )
+
+        def key(s):
+            return (
+                len(s.unschedulable),
+                round(sum(float(p.price) for p in s.new_nodes), 9),
+                len(s.new_nodes),
+            )
+
+        if key(guided) < key(sol):
+            SOLVER_INCREMENTAL_DUAL.inc({"outcome": "rank_win"})
+            return guided
+        SOLVER_INCREMENTAL_DUAL.inc({"outcome": "rank_loss"})
+        return sol
+
     def _incremental_tick(
         self, pools_with_types, removed, changed_keys, changed_pods,
         place_new, churn,
     ) -> TickResult:
         from karpenter_tpu.solver.encode import encode, group_pods
-        from karpenter_tpu.solver.solver import solve_encoded
 
         # free capacity held by deleted/changed pods
         for key in list(removed) + list(changed_keys):
@@ -689,8 +874,13 @@ class IncrementalPipeline:
             order: list[ResidualNode] = []
             for node in self._fleet:
                 avail = node.available()
+                # float32-scale margin, same as the live tick's prune:
+                # a boundary-exact fill reads "full" in float64 but
+                # exactly-fitting in the kernel's float32 — never drop
+                # a node the kernel could still use
                 if any(
-                    avail.get(k, 0.0) < v for k, v in min_req.items()
+                    avail.get(k, 0.0) < v * (1.0 - 1e-6)
+                    for k, v in min_req.items()
                 ):
                     continue
                 inputs.append(
@@ -713,8 +903,9 @@ class IncrementalPipeline:
             # a churn-burst tick whose residual demand spans many group
             # signatures commits them in batched rounds, while the
             # typical small tick (few signatures) stays on the
-            # sequential kernel via pack.WAVEFRONT_MIN_GROUPS
-            sol = solve_encoded(enc, objective=self.repack_objective)
+            # sequential kernel via pack.WAVEFRONT_MIN_GROUPS.
+            # Dual-rank-guided when fresh nodes open (ISSUE 15).
+            sol = self._repack_solve(enc)
             for a in sol.existing:
                 node = order[a.existing_index]
                 for p in a.pods:
@@ -755,8 +946,45 @@ class IncrementalPipeline:
         """Periodic correctness backstop: run the full solve and
         compare. The incremental fleet survives only while it prices
         within drift_eps of (or beats) the full re-solve AND places
-        exactly as many pods; otherwise the full solution is adopted."""
+        exactly as many pods; otherwise the full solution is adopted.
+
+        Weak-duality short-circuit (ISSUE 15): with every pod placed
+        and the retained fleet priced within drift_eps of the cached
+        LP floor for the CURRENT demand, no full re-solve can beat it
+        by more than epsilon — drift <= fleet/bound - 1 <= drift_eps
+        and placed_fewer is impossible — so the backstop's adoption
+        decision is already known and the O(pods) solve is skipped
+        (decision-identical by construction; the floor is the
+        float64-certified dual bound, conservative for new demand
+        because unknown group signatures price at zero)."""
+        from karpenter_tpu.solver.encode import group_pods
         from karpenter_tpu.solver.solver import solve
+
+        if (
+            self._dual is not None
+            and not self._unplaced
+            and _env_on("KARPENTER_INCR_DUAL_FLOOR")
+            and result.unschedulable == 0
+        ):
+            bound = self._dual.bound_for(group_pods(pods))
+            if bound > 0 and result.fleet_price <= bound * (
+                1.0 + self.drift_eps
+            ):
+                from karpenter_tpu.metrics.store import (
+                    SOLVER_INCREMENTAL_DUAL,
+                )
+
+                SOLVER_INCREMENTAL_DUAL.inc({"outcome": "floor_skip"})
+                SOLVER_INCREMENTAL_TICKS.inc(
+                    {"mode": "incremental", "reason": "dual_floor"}
+                )
+                result.reason = "dual_floor"
+                # upper bound on true drift (the full solve prices
+                # somewhere in [bound, fleet_price])
+                result.drift = (
+                    result.fleet_price / bound - 1.0 if bound > 0 else 0.0
+                )
+                return result
 
         sol = solve(
             pods, pools_with_types,

@@ -350,15 +350,12 @@ def _stage(enc: Encoded):
     valid_r = ~bad.any(axis=0)
     # priority weights: resolved PriorityClass folded into the ascent
     # objective so the guidance duals price priority, not just dollars
-    w = np.ones(G, np.float64)
-    if enc.group_priority is not None and np.any(enc.group_priority != 0):
-        pw = _env_float("KARPENTER_LP_PRIORITY_WEIGHT", 0.25)
-        scale = float(np.max(np.abs(enc.group_priority)))
-        if scale > 0 and pw > 0:
-            w = np.clip(
-                1.0 + pw * enc.group_priority.astype(np.float64) / scale,
-                0.05, None,
-            )
+    # — ONE formula shared with the host column generation's pricing
+    # (lp_plan.priority_weights; the ISSUE-15 satellite closing the
+    # "host prices dollars only" gap)
+    from karpenter_tpu.solver.lp_plan import priority_weights
+
+    w = priority_weights(enc.group_priority, G)
     return dict(
         G=G, C=C, R=R, count=count, count_w=count * w, compat=compat,
         req=enc.group_req.astype(np.float64), alloc=eff.astype(np.float64),

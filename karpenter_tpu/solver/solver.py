@@ -248,10 +248,19 @@ def solve(
 
 def solve_encoded(
     enc: Encoded, backend: Optional[str] = None, objective: str = "ffd",
-    shards: int = 0,
+    shards: int = 0, price_hint: Optional[np.ndarray] = None,
 ) -> Solution:
     """`shards > 1` partitions the solver's config axis over a device
-    mesh (see pack.solve_packing); 0 inherits KARPENTER_SOLVER_SHARDS."""
+    mesh (see pack.solve_packing); 0 inherits KARPENTER_SOLVER_SHARDS.
+
+    `price_hint` (ISSUE 15): an alternative [C] price vector fed to
+    the PACKING KERNEL as its type-preference ordering — the same
+    ordering-is-an-input contract the cost race's rank arm uses.
+    Decode always prices nodes from the true `enc.cfg_price`, so a
+    hinted solve's plans carry real catalog prices; the hint only
+    steers which configs the kernel opens. Ignored on the host
+    backend and under the cost objective (which runs its own guided
+    race)."""
     G, C = enc.compat.shape
     if G == 0 or C == 0:
         return Solution(
@@ -262,14 +271,22 @@ def solve_encoded(
     backend = backend or _backend()
     if backend == "host":
         return _decode_host(enc)
-    return _decode_device(enc, objective, shards)
+    return _decode_device(enc, objective, shards, price_hint=price_hint)
 
 
 def _decode_device(
-    enc: Encoded, objective: str = "ffd", shards: int = 0
+    enc: Encoded, objective: str = "ffd", shards: int = 0,
+    price_hint: Optional[np.ndarray] = None,
 ) -> Solution:
     if objective != "cost":
-        result = _solve_packing(enc, mode=objective, shards=shards)
+        kernel_enc = enc
+        if price_hint is not None:
+            from dataclasses import replace as _hint_replace
+
+            kernel_enc = _hint_replace(
+                enc, cfg_price=np.asarray(price_hint, np.float32)
+            )
+        result = _solve_packing(kernel_enc, mode=objective, shards=shards)
         return _build_solution_arrays(
             enc,
             np.flatnonzero(result.node_active[: result.node_count]),

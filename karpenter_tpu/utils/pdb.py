@@ -25,9 +25,16 @@ def _scaled(value: int | str, total: int, round_up: bool) -> int:
 
 
 class PdbLimits:
-    def __init__(self, kube: KubeClient):
+    def __init__(self, kube: KubeClient, memoize_allowance: bool = False):
+        """`memoize_allowance`: cache disruptions_allowed per PDB for
+        this instance's lifetime. ONLY safe for read-only passes over
+        a fixed pod population (the disruption candidate scan, which
+        constructs a fresh instance per scan and evicts nothing while
+        it runs) — eviction loops must keep the default so each
+        verdict sees the shrinking budget."""
         self.kube = kube
         self.pdbs = kube.pdbs()
+        self._allowance_cache: dict = {} if memoize_allowance else None
 
     def _matching(self, pod: Pod) -> list[PodDisruptionBudget]:
         return [
@@ -47,6 +54,16 @@ class PdbLimits:
     def disruptions_allowed(self, pdb: PodDisruptionBudget) -> int:
         """Compute allowed disruptions from live pod state (the real
         controller-manager maintains status; we derive it)."""
+        if self._allowance_cache is not None:
+            hit = self._allowance_cache.get(pdb.key)
+            if hit is not None:
+                return hit
+        out = self._disruptions_allowed(pdb)
+        if self._allowance_cache is not None:
+            self._allowance_cache[pdb.key] = out
+        return out
+
+    def _disruptions_allowed(self, pdb: PodDisruptionBudget) -> int:
         pods = [
             p
             for p in self.kube.pods(namespace=pdb.metadata.namespace,

@@ -312,3 +312,68 @@ class TestMemoryGate:
         assert not regressions
         _, regressions = compare(base, past, 0.25, mem_tolerance=512.0)
         assert regressions
+
+
+class TestLiveOperatorScanGate:
+    """ISSUE 15: the live_operator block's disruption-scan wall gates
+    relative like the wall keys, null-tolerant like the gap keys."""
+
+    def _base(self):
+        return {
+            "steady_state_churn": {
+                "incremental_p50_s": 0.05,
+                "live_operator": {
+                    "incremental_tick_p50_s": 0.02,
+                    "full_reconcile_p50_s": 0.2,
+                    "disruption_scan_wall_s": 0.01,
+                },
+            },
+        }
+
+    def test_scan_wall_regression_gates(self, tmp_path, capsys):
+        cur = self._base()
+        cur["steady_state_churn"]["live_operator"][
+            "disruption_scan_wall_s"
+        ] = 0.05
+        rc = main([
+            _artifact(tmp_path, "base.json", self._base()),
+            _artifact(tmp_path, "cur.json", cur),
+            "--threshold", "0.25",
+        ])
+        assert rc == 1
+        assert (
+            "live_operator.disruption_scan_wall_s"
+            in capsys.readouterr().out
+        )
+
+    def test_null_current_reports_but_never_gates(self, tmp_path,
+                                                  capsys):
+        cur = self._base()
+        del cur["steady_state_churn"]["live_operator"][
+            "disruption_scan_wall_s"
+        ]
+        rc = main([
+            _artifact(tmp_path, "base.json", self._base()),
+            _artifact(tmp_path, "cur.json", cur),
+        ])
+        assert rc == 0
+        assert "not gated" in capsys.readouterr().out
+
+    def test_missing_live_block_is_null_tolerant(self, tmp_path):
+        cur = {"steady_state_churn": {"incremental_p50_s": 0.05}}
+        rc = main([
+            _artifact(tmp_path, "base.json", self._base()),
+            _artifact(tmp_path, "cur.json", cur),
+        ])
+        assert rc == 0
+
+    def test_within_threshold_passes(self, tmp_path):
+        cur = self._base()
+        cur["steady_state_churn"]["live_operator"][
+            "disruption_scan_wall_s"
+        ] = 0.011
+        rc = main([
+            _artifact(tmp_path, "base.json", self._base()),
+            _artifact(tmp_path, "cur.json", cur),
+        ])
+        assert rc == 0

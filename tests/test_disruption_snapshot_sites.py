@@ -1,0 +1,120 @@
+"""Static snapshot-seam check (ISSUE-15 satellite, pattern of
+test_solve_entry_sites): disruption candidate snapshots must come
+through the shared retained-inputs seam (`state/retained.py`'s
+RetainedFleetSeam) — no disruption controller may rebuild fleet state
+from the store directly. A controller calling
+`cluster.deep_copy_nodes()` (or hand-copying StateNodes) would
+silently bypass the seam's dirty-tracking, its mutation discipline
+(note_mutated), AND its decision-identity oracle; this tier-1 test
+makes that a failing build instead of an unaudited O(fleet) scan.
+"""
+
+import ast
+import pathlib
+
+PKG = pathlib.Path(__file__).resolve().parent.parent / "karpenter_tpu"
+
+# controllers that consume fleet snapshots for DISRUPTION decisions:
+# every snapshot they take must come from the retained seam
+GUARDED_DIRS = ("disruption",)
+
+# the seam itself (and the cluster mirror that owns the copy
+# primitive) are the only modules allowed to touch the raw copy path
+SNAPSHOT_NAMES = {"deep_copy_nodes", "shallow_copy"}
+
+
+def _guarded_files():
+    for dirname in GUARDED_DIRS:
+        for path in sorted((PKG / dirname).rglob("*.py")):
+            yield path
+
+
+def _snapshot_calls(tree):
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if isinstance(func, ast.Attribute) and func.attr in SNAPSHOT_NAMES:
+            out.append((node.lineno, func.attr))
+    return out
+
+
+def test_disruption_controllers_route_through_the_retained_seam():
+    offenders = []
+    for path in _guarded_files():
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for lineno, name in _snapshot_calls(tree):
+            offenders.append(
+                f"{path.relative_to(PKG.parent)}:{lineno} calls {name}"
+            )
+    assert not offenders, (
+        "disruption controllers rebuilding fleet state from the store "
+        "instead of the retained seam (state/retained.py): "
+        f"{offenders}"
+    )
+
+
+def test_engine_snapshot_sites_use_the_seam():
+    """The two snapshot consumers — the sequential simulation and the
+    batched probe solver setup — are pinned to fleet_seam calls, and
+    the sequential path reports its mutations back (note_mutated)."""
+    source = (PKG / "disruption" / "engine.py").read_text()
+    tree = ast.parse(source, filename="disruption/engine.py")
+    seam_calls = []
+    mutation_notes = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if not isinstance(func, ast.Attribute):
+            continue
+        if (
+            func.attr == "fleet_snapshot"
+            and isinstance(func.value, ast.Attribute)
+            and func.value.attr == "fleet_seam"
+        ):
+            seam_calls.append(node.lineno)
+        if (
+            func.attr == "note_mutated"
+            and isinstance(func.value, ast.Attribute)
+            and func.value.attr == "fleet_seam"
+        ):
+            mutation_notes.append(node.lineno)
+    assert len(seam_calls) >= 2, (
+        "simulate_scheduling and _build_probe_solver must both take "
+        f"their snapshots from the seam (found {seam_calls})"
+    )
+    assert mutation_notes, (
+        "the sequential simulation mutates served rows and must report "
+        "them back through fleet_seam.note_mutated"
+    )
+
+
+def test_seam_owns_the_only_retained_copy_path():
+    """Outside state/ (the seam + the mirror that owns shallow_copy),
+    provisioning's full path is the one legitimate deep_copy_nodes
+    caller left (the provisioner snapshots for the full Scheduler,
+    whose per-round mutation model predates the seam)."""
+    allowed = {
+        ("state", "retained.py"),
+        ("state", "cluster.py"),
+        ("provisioning", "provisioner.py"),
+    }
+    offenders = []
+    for path in sorted(PKG.rglob("*.py")):
+        rel = path.relative_to(PKG)
+        key = (rel.parts[0], rel.name) if len(rel.parts) > 1 else ("", rel.name)
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "deep_copy_nodes"
+            ):
+                if key not in allowed:
+                    offenders.append(f"{rel}:{node.lineno}")
+    assert not offenders, (
+        f"unexpected deep_copy_nodes call sites: {offenders} — route "
+        "through state/retained.RetainedFleetSeam"
+    )

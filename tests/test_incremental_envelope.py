@@ -1,0 +1,324 @@
+"""Widened incremental-tick eligibility envelope (ISSUE 15): the
+incremental-vs-full fuzz oracle over the newly eligible tick shapes —
+topology spreads x reservations x mixed priorities x churn.
+
+The contract under test: every eligible live tick decides EXACTLY what
+the full Scheduler would (decision-fingerprint equality, enforced by
+forcing the shadow oracle audit on every tick), and a poisoned
+retained row on a widened-envelope tick still quarantines and serves
+the full-solve decision. The fingerprint comparison is the audit's own
+(`decision_fingerprint`), so this suite exercises the same machinery
+production runs on — zero divergences here means zero divergences for
+this workload family live.
+"""
+
+import itertools
+import time
+
+import pytest
+
+from karpenter_tpu.cloudprovider.fake import GIB, make_instance_type
+from karpenter_tpu.kube.objects import (
+    LabelSelector,
+    TopologySpreadConstraint,
+)
+from karpenter_tpu.metrics.store import (
+    INCREMENTAL_DIVERGENCE,
+    INCREMENTAL_TICK,
+)
+from karpenter_tpu.solver import faults
+from karpenter_tpu.testing import Environment, mk_nodepool, mk_pod
+
+
+@pytest.fixture()
+def clean(monkeypatch):
+    monkeypatch.delenv("KARPENTER_FAULTS", raising=False)
+    monkeypatch.delenv("KARPENTER_INCREMENTAL", raising=False)
+    faults.reset()
+    yield monkeypatch
+    faults.reset()
+
+
+def _types(reserved: bool):
+    reservations = [("rsv-1", "test-zone-1", 2)] if reserved else None
+    return [
+        make_instance_type(
+            "c4", cpu=4, memory=16 * GIB, price=1.0,
+            reservations=reservations,
+        )
+    ]
+
+
+def _spread_pod(name: str, cpu: float) -> object:
+    pod = mk_pod(name=name, cpu=cpu, labels={"app": "spread"})
+    pod.spec.topology_spread_constraints = [
+        TopologySpreadConstraint(
+            max_skew=1,
+            topology_key="topology.kubernetes.io/zone",
+            when_unsatisfiable="DoNotSchedule",
+            label_selector=LabelSelector.of({"app": "spread"}),
+        )
+    ]
+    return pod
+
+
+def _workload(tick: int, topology: bool, priorities: bool) -> list:
+    """Deterministic mixed demand for one tick of one scenario."""
+    pods = []
+    for i in range(3):
+        kwargs = {}
+        if priorities:
+            kwargs["priority"] = 100 if i % 2 == 0 else 0
+        pods.append(
+            mk_pod(name=f"t{tick}-plain-{i}", cpu=0.8 + 0.2 * (i % 2),
+                   **kwargs)
+        )
+    if topology:
+        pods.append(_spread_pod(f"t{tick}-spread-a", cpu=0.7))
+        pods.append(_spread_pod(f"t{tick}-spread-b", cpu=0.7))
+    return pods
+
+
+def _fleet_fingerprint(env):
+    return sorted(
+        (
+            n.metadata.labels.get("node.kubernetes.io/instance-type", ""),
+            n.metadata.labels.get("topology.kubernetes.io/zone", ""),
+            n.metadata.labels.get("karpenter.sh/capacity-type", ""),
+            tuple(sorted(
+                p.metadata.name
+                for p in env.kube.pods_on_node(n.metadata.name)
+            )),
+        )
+        for n in env.kube.nodes()
+    )
+
+
+def _incremental_serves():
+    return sum(
+        v for k, v in INCREMENTAL_TICK.samples()
+        if dict(k).get("path") == "incremental"
+    )
+
+
+SHAPES = sorted(
+    itertools.product((False, True), repeat=3),
+    reverse=True,
+)
+
+
+class TestEnvelopeOracle:
+    @pytest.mark.parametrize(
+        "topology,reserved,priorities", SHAPES,
+        ids=lambda v: str(v),
+    )
+    def test_widened_shapes_ride_incremental_and_match_full(
+        self, clean, topology, reserved, priorities
+    ):
+        """Every combination of the widened shapes, churned over
+        several ticks, with the shadow audit forced EVERY tick: the
+        incremental path must serve (not fall back) and every audit
+        must verdict ok — decision-fingerprint equality with the full
+        Scheduler, tick by tick."""
+        clean.setenv("KARPENTER_INCR_AUDIT_EVERY", "1")
+        div0 = INCREMENTAL_DIVERGENCE.total()
+        env = Environment(types=_types(reserved))
+        env.kube.create(mk_nodepool("p"))
+        env.provision(*_workload(0, topology, priorities))
+        env.provision()   # warm the retained state past the cold bail
+        serves0 = _incremental_serves()
+        for tick in range(1, 4):
+            # churn: retire one bound pod, add a fresh wave
+            bound = sorted(
+                (p for p in env.kube.pods() if p.spec.node_name),
+                key=lambda p: p.metadata.name,
+            )
+            if bound:
+                env.kube.delete(bound[0])
+            env.provision(*_workload(tick, topology, priorities))
+        assert INCREMENTAL_DIVERGENCE.total() == div0, (
+            "widened-envelope tick diverged from the full Scheduler"
+        )
+        assert _incremental_serves() > serves0, (
+            "the widened shapes must ride the incremental path, not "
+            f"fall back: {env.provisioner.incremental.status()['fallbacks']}"
+        )
+        status = env.provisioner.incremental.status()
+        assert not status["quarantined"]
+        assert status["divergences"] == 0
+
+    @pytest.mark.parametrize("topology,reserved,priorities",
+                             [(True, True, True)], ids=["all-on"])
+    def test_end_fleet_matches_full_path(
+        self, clean, topology, reserved, priorities
+    ):
+        """The same mixed churn workload lands the same name-agnostic
+        fleet with the incremental path on and off."""
+
+        def run():
+            env = Environment(types=_types(reserved))
+            env.kube.create(mk_nodepool("p"))
+            env.provision(*_workload(0, topology, priorities))
+            env.provision()
+            for tick in range(1, 3):
+                env.provision(*_workload(tick, topology, priorities))
+            return _fleet_fingerprint(env)
+
+        clean.setenv("KARPENTER_INCREMENTAL", "1")
+        with_inc = run()
+        clean.setenv("KARPENTER_INCREMENTAL", "0")
+        without = run()
+        assert with_inc == without
+
+    def test_boundary_exact_fill_churn_does_not_diverge(self, clean):
+        """Regression pin for the float32-margin residual prune: a
+        node filled to a float64 boundary (4 x 0.8 cpu leaves
+        0.7999999999999994) must NOT be pruned out of the incremental
+        solve's existing axis — the kernel's float32 view accepts one
+        more 0.8 pod there, and the host prune dropping the row made
+        the two paths diverge (caught live by the oracle)."""
+        clean.setenv("KARPENTER_INCR_AUDIT_EVERY", "1")
+        clean.setenv("KARPENTER_INCR_CHURN_MAX", "1.0")
+        div0 = INCREMENTAL_DIVERGENCE.total()
+        env = Environment(types=_types(True))
+        env.kube.create(mk_nodepool("p"))
+
+        def wave(tick):
+            # 0.8-cpu pods accumulate to the float64 boundary; the
+            # spread pods keep the topology phase in play
+            pods = [
+                mk_pod(name=f"bf-{tick}-{i}", cpu=0.8,
+                       priority=100 if i % 2 == 0 else 0)
+                for i in range(6)
+            ]
+            pods.append(_spread_pod(f"bf-{tick}-s", cpu=0.7))
+            return pods
+
+        env.provision(*wave(0))
+        env.provision()
+        for tick in range(1, 4):
+            bound = sorted(
+                (p for p in env.kube.pods() if p.spec.node_name),
+                key=lambda p: p.metadata.name,
+            )
+            for pod in bound[:2]:
+                env.kube.delete(pod)
+            env.provision(*wave(tick))
+        assert INCREMENTAL_DIVERGENCE.total() == div0
+        assert not env.provisioner.incremental.status()["quarantined"]
+
+    def test_first_envelope_tick_forces_audit(self, clean):
+        """The first tick exercising a newly-widened shape after a
+        cache (re)build earns a forced shadow audit (trigger
+        `envelope`) — the equality claim is proven before trusted."""
+        from karpenter_tpu.metrics.store import INCREMENTAL_AUDITS
+
+        clean.setenv("KARPENTER_INCR_AUDIT_EVERY", "0")
+        before = INCREMENTAL_AUDITS.value(
+            {"verdict": "ok", "trigger": "envelope"}
+        )
+        env = Environment(types=_types(False))
+        env.kube.create(mk_nodepool("p"))
+        env.provision(mk_pod(name="warm-0", cpu=1.0))
+        env.provision()  # warm
+        env.provision(_spread_pod("first-topo", cpu=0.5))
+        assert INCREMENTAL_AUDITS.value(
+            {"verdict": "ok", "trigger": "envelope"}
+        ) > before
+
+    def test_poisoned_topology_tick_quarantines(self, clean):
+        """cache_poison on a widened-envelope (topology) tick: the
+        audit catches the phantom row, quarantines, and the fleet
+        matches the calm run byte-for-byte."""
+
+        def run(spec):
+            if spec:
+                clean.setenv("KARPENTER_FAULTS", spec)
+            else:
+                clean.delenv("KARPENTER_FAULTS", raising=False)
+            faults.reset()
+            env = Environment(types=_types(False))
+            env.kube.create(mk_nodepool("p"))
+            env.provision(*[
+                mk_pod(name=f"f-{i}", cpu=3.5) for i in range(3)
+            ])
+            env.provision()   # warm
+            env.provision(
+                _spread_pod("sp-0", cpu=1.0), _spread_pod("sp-1", cpu=1.0)
+            )
+            clean.delenv("KARPENTER_FAULTS", raising=False)
+            return env
+
+        calm = run("")
+        want = _fleet_fingerprint(calm)
+        div0 = INCREMENTAL_DIVERGENCE.total()
+        env = run("cache_poison@incremental:*")
+        assert _fleet_fingerprint(env) == want
+        assert INCREMENTAL_DIVERGENCE.total() > div0
+        status = env.provisioner.incremental.status()
+        assert status["quarantined"] or status["divergences"] > 0
+
+    def test_priority_overload_falls_back_to_admission(self, clean):
+        """A mixed-priority tick that cannot place everything must
+        hand the tick to the full path (the shed machinery lives
+        there): the unscheduled set is the lowest-priority tail."""
+        from karpenter_tpu.provisioning.priority import (
+            PRIORITY_SHED_ERROR,
+        )
+
+        env = Environment(types=_types(False))
+        pool = mk_nodepool("p")
+        pool.spec.limits = {"cpu": 8.0}   # two c4 nodes, tops
+        env.kube.create(pool)
+        env.provision(mk_pod(name="seed-0", cpu=1.0))
+        env.provision()  # warm
+        results = env.provision(*[
+            mk_pod(name=f"over-{i}", cpu=3.5,
+                   priority=100 if i < 2 else 0)
+            for i in range(4)
+        ])
+        shed = [
+            k for k, err in results.errors.items()
+            if err == PRIORITY_SHED_ERROR
+        ]
+        assert shed, f"expected a priority shed, got {results.errors}"
+        # the shed set is the lowest-priority TAIL of the admission
+        # order: if any high-priority pod was shed (capacity cut the
+        # line above the priority split), every low-priority pod must
+        # be shed with it
+        assert {"default/over-2", "default/over-3"} <= set(shed), (
+            f"low-priority pods must be in the shed tail: {shed}"
+        )
+        assert env.provisioner.incremental.status()["fallbacks"].get(
+            "priority", 0
+        ) >= 1
+
+
+class TestFallbackRollup:
+    def test_readyz_surfaces_per_reason_fallbacks(self, clean):
+        from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
+        from karpenter_tpu.kube.client import KubeClient
+        from karpenter_tpu.operator.operator import Operator
+
+        kube = KubeClient()
+        op = Operator(
+            kube=kube,
+            cloud_provider=KwokCloudProvider(kube, types=_types(False)),
+        )
+        kube.create(mk_nodepool("p"))
+        kube.create(mk_pod(name="r-0", cpu=1.0))
+        now = time.time()
+        for i in range(4):
+            op.step(now=now + i * 2.0)
+        assert isinstance(
+            op.readyz()["incremental"]["fallbacks"], dict
+        )
+        # an ineligible pod (DRA requirements route full) shows up
+        # under its reason in the rollup
+        pod = mk_pod(name="r-dra", cpu=1.0)
+        pod.spec.containers[0].resource_claims = ["gpu"]
+        kube.create(pod)
+        for i in range(4, 8):
+            op.step(now=now + i * 2.0)
+        fallbacks = op.readyz()["incremental"]["fallbacks"]
+        assert fallbacks.get("dra", 0) >= 1, fallbacks

@@ -481,3 +481,125 @@ class TestWarmPool:
         import os
 
         assert os.path.isdir(path)
+
+
+class TestDualGuidedRepack:
+    """ISSUE 15: the residual repack spends the cached DualCertificate
+    — weak-duality floor skips of the drift backstop, and the
+    reduced-cost-ordered repack race — invalidated on catalog
+    movement, never worse than unguided by construction."""
+
+    def _exact_fill_problem(self, n_pods=12):
+        # 3 x 1.3 cpu fills a c4's 3.9 allocatable exactly, so the
+        # fleet price sits ON the LP floor and the weak-duality skip
+        # must engage
+        pods = [
+            mk_pod(name=f"df-{i}", cpu=1.3, memory=2 * GIB)
+            for i in range(n_pods)
+        ]
+        pools = [(
+            mk_nodepool("p"),
+            [make_instance_type("c4", cpu=4, memory=16 * GIB, price=1.0)],
+        )]
+        return pods, pools
+
+    def test_floor_skips_drift_backstop(self, monkeypatch):
+        from karpenter_tpu.metrics.store import SOLVER_INCREMENTAL_DUAL
+
+        monkeypatch.delenv("KARPENTER_INCR_DUAL_FLOOR", raising=False)
+        pods, pools = self._exact_fill_problem()
+        pipe = IncrementalPipeline(full_every=2)
+        r1 = pipe.solve_tick(pods, pools)
+        assert r1.mode == "full" and r1.unschedulable == 0
+        before = SOLVER_INCREMENTAL_DUAL.value({"outcome": "floor_skip"})
+        r2 = pipe.solve_tick(pods, pools)   # tick 2: the backstop slot
+        assert r2.reason == "dual_floor", (
+            "an LP-optimal retained fleet must skip the backstop solve"
+        )
+        assert SOLVER_INCREMENTAL_DUAL.value(
+            {"outcome": "floor_skip"}
+        ) > before
+        assert r2.unschedulable == 0
+        assert r2.drift is not None and r2.drift <= pipe.drift_eps + 1e-9
+
+    def test_floor_skip_is_decision_identical(self, monkeypatch):
+        """Same churned workload, floor skip on vs off: every tick's
+        retained fleet fingerprint matches."""
+
+        def run(floor_on):
+            monkeypatch.setenv(
+                "KARPENTER_INCR_DUAL_FLOOR", "1" if floor_on else "0"
+            )
+            pipe = IncrementalPipeline(full_every=2)
+            pods, pools = self._exact_fill_problem()
+            fps = []
+            for t in range(5):
+                churned = pods[t:] + [
+                    mk_pod(name=f"c{t}-{i}", cpu=1.3, memory=2 * GIB)
+                    for i in range(t)
+                ]
+                pipe.solve_tick(churned, pools)
+                fps.append(pipe.state_fingerprint())
+            return fps
+
+        assert run(True) == run(False)
+
+    def test_rank_race_never_worse(self, monkeypatch):
+        """Churn that forces fresh opens on a heterogeneous catalog:
+        the guided arm may win or lose the race, but the served fleet
+        must never be worse than the unguided run's."""
+        from karpenter_tpu.cloudprovider.fake import (
+            heterogeneous_instance_types,
+        )
+
+        def run(rank_on):
+            monkeypatch.setenv(
+                "KARPENTER_INCR_DUAL_RANK", "1" if rank_on else "0"
+            )
+            pipe = IncrementalPipeline(full_every=0)
+            pools = [(mk_nodepool("p"), heterogeneous_instance_types(12))]
+            rng = np.random.default_rng(11)
+            pods = [_pod(f"rr-{i}", i, rng) for i in range(24)]
+            res = pipe.solve_tick(pods, pools)
+            for t in range(3):
+                # drop a couple, add bigger pods that need new nodes
+                pods = pods[2:] + [
+                    mk_pod(name=f"rg-{t}-{i}", cpu=2.0 + i,
+                           memory=(4 + 2 * i) * GIB)
+                    for i in range(3)
+                ]
+                res = pipe.solve_tick(pods, pools)
+            return res
+
+        guided = run(True)
+        unguided = run(False)
+        assert guided.unschedulable == unguided.unschedulable
+        assert guided.fleet_price <= unguided.fleet_price + 1e-6
+
+    def test_catalog_move_invalidates_certificate(self):
+        pods, pools = self._exact_fill_problem(6)
+        pipe = IncrementalPipeline(full_every=0)
+        pipe.solve_tick(pods, pools)
+        assert pipe._dual is not None
+        # reprice: the catalog fingerprint moves, the next tick runs
+        # full and re-derives the certificate from the NEW prices
+        repriced = [(
+            pools[0][0],
+            [make_instance_type("c4", cpu=4, memory=16 * GIB, price=2.0)],
+        )]
+        old = pipe._dual
+        res = pipe.solve_tick(pods, repriced)
+        assert res.reason == "catalog"
+        assert pipe._dual is not old
+
+    def test_external_adopt_drops_certificate(self):
+        pods, pools = self._exact_fill_problem(6)
+        pipe = IncrementalPipeline(full_every=0)
+        pipe.solve_tick(pods, pools)
+        assert pipe._dual is not None
+        sol = solve(pods, pools, objective="cost")
+        pipe.adopt(pods, sol, pools)
+        assert pipe._dual is None, (
+            "an externally-computed adoption cannot vouch for the "
+            "cached duals"
+        )

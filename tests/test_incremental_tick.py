@@ -123,28 +123,38 @@ class TestDefaultRouting:
         assert with_inc == without
 
     def test_ineligible_tick_falls_back_with_reason(self, clean_faults):
-        """A topology-constrained pod routes the whole tick to the
-        full Scheduler (recorded as a full_backstop)."""
+        """A pod-anti-affinity pod routes the whole tick to the full
+        Scheduler (recorded as a full_backstop with its reason in the
+        readyz fallback rollup). Topology SPREAD constraints are
+        inside the widened envelope (ISSUE 15) and must NOT fall
+        back — see test_incremental_envelope.py for the oracle."""
         from karpenter_tpu.kube.objects import (
+            Affinity,
             LabelSelector,
-            TopologySpreadConstraint,
+            PodAffinity,
+            PodAffinityTerm,
         )
 
         before = _counter_totals()
         env = Environment(types=_types())
         env.kube.create(mk_nodepool("p"))
-        pod = mk_pod(name="spread-0", cpu=1.0, labels={"app": "x"})
-        pod.spec.topology_spread_constraints = [
-            TopologySpreadConstraint(
-                max_skew=1,
-                topology_key="topology.kubernetes.io/zone",
-                when_unsatisfiable="ScheduleAnyway",
-                label_selector=LabelSelector.of({"app": "x"}),
-            )
-        ]
+        pod = mk_pod(name="anti-0", cpu=1.0, labels={"app": "x"})
+        pod.spec.affinity = Affinity(
+            pod_anti_affinity=PodAffinity(required=(
+                PodAffinityTerm(
+                    topology_key="kubernetes.io/hostname",
+                    label_selector=LabelSelector.of({"app": "x"}),
+                ),
+            )),
+        )
         env.provision(pod)
         after = _counter_totals()
         assert after["full_backstop"] > before["full_backstop"]
+        assert (
+            env.provisioner.incremental.status()["fallbacks"].get(
+                "topology", 0
+            ) >= 1
+        )
 
 
 class TestOracleAuditAndPoison:

@@ -352,3 +352,65 @@ class TestScipyAbsence:
         assert out["lp_estimate"] is None
         assert out["gap_vs_lp"] is None
         assert out["scheduled"] > 0
+
+
+class TestHostPriorityPricing:
+    """ISSUE 15 satellite: the host column generation prices with the
+    SAME priority weights as the device ascent's objective — one
+    formula (lp_plan.priority_weights), two consumers that cannot
+    drift — while both reported bounds stay dollar-certified."""
+
+    def test_one_weight_formula_feeds_both_solvers(self):
+        enc, _, _ = build_enc(43, priorities=True)
+        G = enc.compat.shape[0]
+        w = lp_plan.priority_weights(enc.group_priority, G)
+        assert np.any(enc.group_priority != 0)
+        assert np.any(w != 1.0)
+        dlp = lp_device.solve(enc)
+        # the device guidance duals are exactly lam * w — the shared
+        # formula IS what the ascent folded in
+        np.testing.assert_allclose(dlp.lam_guide, dlp.lam * w,
+                                   rtol=1e-12, atol=1e-12)
+
+    def test_uniform_priorities_weigh_exactly_one(self):
+        enc, _, _ = build_enc(47, priorities=False)
+        G = enc.compat.shape[0]
+        w = lp_plan.priority_weights(enc.group_priority, G)
+        assert (w == 1.0).all()
+
+    def test_host_and_device_objectives_agree_under_priorities(self):
+        """With priorities folded into BOTH pricing loops, the two
+        bound relationships that make guidance sound must hold: the
+        device bound stays dollar-valid (never above the host master
+        estimate), and the host lower_bound stays a true floor under
+        the FFD fleet price — priority weighting steers discovery,
+        never the certificates."""
+        _clear_solver_caches()
+        enc, _, _ = build_enc(53, priorities=True, n_pods=300)
+        plan = lp_plan.plan(enc)
+        assert plan is not None
+        dlp = lp_device.solve(enc)
+        assert dlp.lower_bound <= plan.objective_estimate * (1 + 1e-9)
+        assert plan.lower_bound <= plan.objective_estimate * (1 + 1e-9)
+        from karpenter_tpu.solver.solver import solve_encoded
+
+        sol = solve_encoded(enc, objective="ffd")
+        fleet = sum(float(p.price) for p in sol.new_nodes)
+        if not sol.unschedulable:
+            assert plan.lower_bound <= fleet * (1 + 1e-6)
+            assert dlp.lower_bound <= fleet * (1 + 1e-6)
+
+    def test_weight_knob_busts_the_warm_plan(self, monkeypatch):
+        """KARPENTER_LP_PRIORITY_WEIGHT is part of the host planner's
+        warm fingerprint: flipping it must not serve a pattern set
+        discovered under different weights."""
+        _clear_solver_caches()
+        enc, _, _ = build_enc(59, priorities=True, n_pods=200)
+        monkeypatch.setenv("KARPENTER_LP_PRIORITY_WEIGHT", "0.25")
+        a = lp_plan.plan(enc)
+        monkeypatch.setenv("KARPENTER_LP_PRIORITY_WEIGHT", "0.75")
+        b = lp_plan.plan(enc)
+        assert a is not None and b is not None
+        # both plans remain dollar-certified floors
+        assert a.lower_bound <= a.objective_estimate * (1 + 1e-9)
+        assert b.lower_bound <= b.objective_estimate * (1 + 1e-9)

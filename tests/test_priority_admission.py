@@ -281,7 +281,12 @@ class TestDisruptionPriorityVeto:
 
 
 class TestIncrementalPriorityGate:
-    def test_priority_bearing_tick_routes_to_full_path(self):
+    def test_priority_bearing_tick_is_eligible(self):
+        """ISSUE 15 widened the envelope: a priority-bearing tick
+        rides the incremental path (priority-major grouping is
+        inherited from group_pods); only a mixed-priority tick with a
+        capacity failure — where the admission machinery would act —
+        falls back (see _priority_overloaded)."""
         env, _ = _env()
         pod = mk_pod(name="p", cpu=1.0)
         pod.spec.priority = 10
@@ -289,13 +294,29 @@ class TestIncrementalPriorityGate:
         reason = env.provisioner.incremental._ineligible(
             [pod], env.provisioner.ready_pools_with_types()
         )
-        assert reason == "priority"
+        assert reason is None
 
-    def test_class_name_alone_gates_too(self):
-        env, _ = _env()
-        pod = mk_pod(name="p", cpu=1.0)
-        pod.spec.priority_class_name = "gold"
-        reason = env.provisioner.incremental._ineligible(
-            [pod], env.provisioner.ready_pools_with_types()
+    def test_mixed_priority_capacity_failure_falls_back(self):
+        """The overload gate: mixed priorities + a no-capacity error
+        is exactly where the shed/cutoff machinery acts, and it wraps
+        only full-path results."""
+        from karpenter_tpu.provisioning.scheduler import (
+            NO_CAPACITY_ERROR,
+            SchedulerResults,
         )
-        assert reason == "priority"
+
+        env, _ = _env()
+        tick = env.provisioner.incremental
+        hi = mk_pod(name="hi", cpu=1.0)
+        hi.spec.priority = 10
+        lo = mk_pod(name="lo", cpu=1.0)
+        clean = SchedulerResults(new_node_plans=[],
+                                 existing_assignments={})
+        assert not tick._priority_overloaded([hi, lo], clean)
+        failed = SchedulerResults(
+            new_node_plans=[], existing_assignments={},
+            errors={"default/lo": NO_CAPACITY_ERROR},
+        )
+        assert tick._priority_overloaded([hi, lo], failed)
+        # uniform priority never engages admission, failure or not
+        assert not tick._priority_overloaded([lo], failed)

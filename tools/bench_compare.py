@@ -11,7 +11,10 @@ Compares, per scenario present in BOTH artifacts' detail:
 - pods_per_sec (higher is better);
 - gap_vs_lp by absolute delta (--gap-tolerance);
 - peak_rss_mb and the per-arm device-telemetry peaks by absolute MB
-  delta (--mem-tolerance, null-tolerant on either side).
+  delta (--mem-tolerance, null-tolerant on either side);
+- the live_operator block's tick and disruption-scan walls (ISSUE 15),
+  relative like the wall keys but null-tolerant like the gap keys (a
+  side without the live arm reports loudly, never gates).
 
 Exit codes: 0 = no regression past the threshold, 1 = at least one
 regression, 2 = an artifact could not be parsed. A regression is a
@@ -54,6 +57,14 @@ GAP_KEYS = ("gap_vs_lp",)
 # Null-tolerant: a side without the key (pre-ISSUE-13 artifact,
 # CPU-only host with no device stats) is reported, never gated.
 MEM_KEYS = ("peak_rss_mb",)
+# lower-is-better wall keys nested under a scenario's live_operator
+# block (ISSUE 15): gated RELATIVE like WALL_KEYS, but null-tolerant
+# like the gap keys — a side whose live arm didn't run (BENCH_LIVE_PODS
+# = 0, pre-ISSUE artifact) is reported loudly, never gated
+LIVE_WALL_KEYS = (
+    "incremental_tick_p50_s", "full_reconcile_p50_s",
+    "disruption_scan_wall_s",
+)
 # the same keys nested one level down in the per-arm device_telemetry
 # block (telemetry.snapshot() keeps scalar roll-ups at its top level
 # exactly so this gate can read them without walking the detail),
@@ -257,6 +268,33 @@ def compare(
                 regressions.append(tag)
             else:
                 lines.append("  " + tag)
+        blo, clo = b.get("live_operator"), c.get("live_operator")
+        if isinstance(blo, dict) or isinstance(clo, dict):
+            for key in LIVE_WALL_KEYS:
+                bv = blo.get(key) if isinstance(blo, dict) else None
+                cv = clo.get(key) if isinstance(clo, dict) else None
+                if not isinstance(bv, (int, float)) or bv <= 0:
+                    if isinstance(cv, (int, float)):
+                        lines.append(
+                            f"  {name}.live_operator.{key}: null -> "
+                            f"{cv:.3f}s (new key; not gated)"
+                        )
+                    continue
+                if not isinstance(cv, (int, float)):
+                    lines.append(
+                        f"  {name}.live_operator.{key}: {bv:.3f}s -> "
+                        "null (live arm unavailable; not gated)"
+                    )
+                    continue
+                rel = cv / bv - 1.0
+                tag = (
+                    f"{name}.live_operator.{key}: {bv:.3f}s -> "
+                    f"{cv:.3f}s ({rel:+.1%})"
+                )
+                if rel > threshold:
+                    regressions.append(tag)
+                else:
+                    lines.append("  " + tag)
         for gkey in GAP_KEYS:
             bv, cv = b.get(gkey), c.get(gkey)
             if not isinstance(bv, (int, float)):
