@@ -1624,6 +1624,122 @@ def _live_operator_arm(n_pods: int, ticks: int, churn: float) -> dict:
     }
 
 
+def scenario_live_operator_100k() -> dict:
+    """Sharded state plane at scale (ISSUE 16): a REAL operator over a
+    100k-pod fleet, churned with the SAME absolute pod count as a
+    10x-smaller control arm. The claim under test is O(dirty) — if
+    every layer of the tick (watch pump, dirty-scoped retained state,
+    bind/evict queues, in-envelope shed/relax) really does work
+    proportional to what changed, equal churn means comparable tick
+    walls regardless of fleet size, so the 100k p50 must stay within
+    ~2x of the 10k p50. Divergences must be zero: after the measured
+    steady window, two extra ticks run with the shadow full-solve
+    oracle audit forced to prove the O(dirty) decisions byte-match the
+    O(fleet) path at this scale. The steady arm's fallback rollup must
+    show NO priority/relax envelope escapes — shed and relaxation run
+    inside the incremental envelope now.
+
+    Scale: BENCH_LIVE_PODS (default 100000; 0 disables the arm).
+    Churn: BENCH_LIVE_CHURN pods per tick (default 64), identical in
+    both arms by construction."""
+    from karpenter_tpu.metrics.store import INCREMENTAL_DIVERGENCE
+    from karpenter_tpu.testing import (
+        build_churn_operator,
+        churn_tick_wall_series,
+    )
+
+    n_100k = int(os.environ.get("BENCH_LIVE_PODS", "100000"))
+    if n_100k <= 0:
+        return {"skipped": True}
+    n_10k = max(100, n_100k // 10)
+    churn_k = int(os.environ.get("BENCH_LIVE_CHURN", "64"))
+    ticks = 7
+
+    def _with_env(env_overrides: dict, fn):
+        saved = {k: os.environ.get(k) for k in env_overrides}
+        os.environ.update(env_overrides)
+        try:
+            return fn()
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    def run_arm(n_pods: int) -> dict:
+        def body():
+            div0 = INCREMENTAL_DIVERGENCE.total()
+            env, op, now = build_churn_operator(n_pods)
+            walls, now = churn_tick_wall_series(
+                env, op, now, ticks, churn_k
+            )
+            walls = sorted(walls)
+            # audit probe: prove decision identity AT SCALE, outside
+            # the measured steady window (the shadow solve is O(fleet)
+            # by design). audit_every is captured at construction, so
+            # the probe flips the live knob, not the env
+            inc = op.provisioner.incremental
+            inc.audit_every, saved_every = 1, inc.audit_every
+            inc._since_audit = 1
+            _, now = churn_tick_wall_series(env, op, now, 2, churn_k)
+            inc.audit_every = saved_every
+            incr = op.readyz()["incremental"]
+            return {
+                "pods": n_pods,
+                "tick_p50_s": round(walls[len(walls) // 2], 4),
+                "tick_p99_s": round(
+                    walls[min(len(walls) - 1,
+                              int(0.99 * len(walls)))], 4),
+                "oracle_divergences": int(
+                    INCREMENTAL_DIVERGENCE.total() - div0
+                ),
+                "fallbacks": incr["fallbacks"],
+                "quarantined": incr["quarantined"],
+                "last_audit": incr["last_audit"],
+            }
+
+        return _with_env({
+            "KARPENTER_INCREMENTAL": "1",
+            "KARPENTER_INCR_AUDIT_EVERY": "0",
+            # equal-churn absolute counts: the 100k arm's fraction is
+            # tiny; keep the small control arm off the churn backstop
+            # too so both measure the same envelope
+            "KARPENTER_INCR_CHURN_MAX": "1.0",
+        }, body)
+
+    small = run_arm(n_10k)
+    big = run_arm(n_100k)
+    p50_small = small["tick_p50_s"]
+    p50_big = big["tick_p50_s"]
+    steady_fallbacks = {
+        k: v for k, v in big["fallbacks"].items()
+        if k in ("priority", "relax") and v
+    }
+    return {
+        "pods_100k": n_100k,
+        "pods_10k": n_10k,
+        "ticks": ticks,
+        "churn_per_tick": churn_k,
+        "tick_p50_s_100k": p50_big,
+        "tick_p99_s_100k": big["tick_p99_s"],
+        "tick_p50_s_10k": p50_small,
+        "tick_p99_s_10k": small["tick_p99_s"],
+        "wall_ratio_100k_vs_10k": (
+            round(p50_big / p50_small, 2) if p50_small > 0 else 0.0
+        ),
+        "oracle_divergences": (
+            small["oracle_divergences"] + big["oracle_divergences"]
+        ),
+        # the acceptance gate: shed/relax served IN the envelope at
+        # 100k — any escape shows up here by reason
+        "envelope_escapes": steady_fallbacks,
+        "fallbacks": big["fallbacks"],
+        "quarantined": big["quarantined"],
+        "last_audit": big["last_audit"],
+    }
+
+
 def scenario_hetero(n_pods: int = 10000, n_types: int = 200) -> dict:
     """Family-priced catalog (no reservations): $/vCPU varies by memory
     ratio like real cloud families, so shape-aware packing has real
@@ -2056,6 +2172,7 @@ def main() -> int:
         "spot_mix": scenario_spot_mix,
         "overload_surge": scenario_overload_surge,
         "million_pod": scenario_million_pod,
+        "live_operator_100k": scenario_live_operator_100k,
     }
     if only:
         wanted = set(only.split(","))

@@ -344,25 +344,42 @@ class KubeClient:
         for handler in self._watchers.get(kind, []):
             handler(event, obj)
 
-    def deliver(self, limit: Optional[int] = None) -> int:
+    def deliver(self, limit: Optional[int] = None,
+                shard: Optional[int] = None) -> int:
         """Drain queued watch events to their handlers (the informer
         stream catching up with the API server). Returns the number
         delivered. `limit` delivers only the oldest N, letting tests
-        hold the cache arbitrarily stale."""
+        hold the cache arbitrarily stale. `shard` delivers only the
+        events routed to one state-plane shard (state/shards.py),
+        leaving the rest queued — the per-shard logical stream the
+        cross-shard ordering tests replay in both orders."""
         with self._deliver_lock:
             if self._delivering:
                 return 0
             self._delivering = True
             try:
                 with self._lock:
-                    n = len(self._pending_events) if limit is None else min(
-                        limit, len(self._pending_events)
-                    )
-                    batch = self._pending_events[:n]
-                    del self._pending_events[:n]
+                    if shard is None:
+                        n = len(self._pending_events) if limit is None \
+                            else min(limit, len(self._pending_events))
+                        batch = self._pending_events[:n]
+                        del self._pending_events[:n]
+                    else:
+                        from karpenter_tpu.state.shards import shard_of_event
+
+                        batch, kept = [], []
+                        for item in self._pending_events:
+                            kind, _, obj = item
+                            if shard_of_event(kind, obj) == shard and (
+                                limit is None or len(batch) < limit
+                            ):
+                                batch.append(item)
+                            else:
+                                kept.append(item)
+                        self._pending_events = kept
                 for kind, event, obj in batch:
                     self._dispatch(kind, event, obj)
-                return n
+                return len(batch)
             finally:
                 self._delivering = False
 

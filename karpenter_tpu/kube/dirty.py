@@ -52,6 +52,9 @@ class DirtyTracker:
         # last relist generation observed per kind (clients that never
         # relist — the in-memory substrate — simply never advance it)
         self._relist_gen: dict[str, int] = {}
+        # last PER-SHARD relist generation observed, (kind, shard) ->
+        # gen — the sharded state plane's scoped continuity latch
+        self._shard_gen: dict[tuple[str, int], int] = {}
 
     def watch(self, *kinds: str, key: Optional[KeyFn] = None) -> "DirtyTracker":
         for kind in kinds:
@@ -99,6 +102,33 @@ class DirtyTracker:
                 self._relist_gen[kind] = gen
                 hit = True
         return hit
+
+    def relisted_shards(self, *kinds: str) -> Optional[set[int]]:
+        """Shard-scoped continuity latch (ISSUE 16): the set of shard
+        ids whose relist epoch advanced for any of `kinds` since the
+        last call — each named shard's retained keys must be treated as
+        dirty, while every OTHER shard's rows stay warm. Returns None
+        when a relist happened but the client cannot scope it (no
+        per-shard epochs — the merged contract's conservative reading:
+        everything dirty). Returns an empty set when nothing relisted.
+
+        Latches the merged per-kind generation alongside the shard
+        generations, so mixing `relisted_shards` and `relisted` over
+        the same kinds never double-fires for one relist."""
+        gens_of = getattr(self.kube, "relist_generations", None)
+        if gens_of is None:
+            return None if self.relisted(*kinds) else set()
+        out: set[int] = set()
+        for kind in kinds:
+            for shard, gen in gens_of(kind).items():
+                if gen != self._shard_gen.get((kind, shard), 0):
+                    self._shard_gen[(kind, shard)] = gen
+                    out.add(shard)
+        gen_of = getattr(self.kube, "relist_generation", None)
+        if gen_of is not None:
+            for kind in kinds:
+                self._relist_gen[kind] = gen_of(kind)
+        return out
 
     def clear(self) -> None:
         """Drop all pending dirt without reporting it (used after a

@@ -60,7 +60,12 @@ from karpenter_tpu.kube.client import (
 from karpenter_tpu.kube.objects import LabelSelector
 from karpenter_tpu.kube.retry import RetryPolicy
 from karpenter_tpu.kube.serialize import FROM_CR, from_cr, to_cr
-from karpenter_tpu.metrics.store import KUBE_RELIST
+from karpenter_tpu.metrics.store import (
+    KUBE_RELIST,
+    STATE_SHARD_RELIST,
+    STATE_SHARDS,
+)
+from karpenter_tpu.state.shards import route_key, shard_count, shard_of, SHARDED_KINDS
 from karpenter_tpu.solver import faults as _faults
 
 # kind -> (api prefix, plural, namespaced)
@@ -803,7 +808,30 @@ class RealKubeClient:
         # per lost-continuity window (the relist's diff events alone
         # cannot prove nothing else changed while the watch was stale)
         self._relist_gen: dict[str, int] = {}
+        # sharded logical streams (state/shards.py): per-shard watch
+        # cursors + relist generations for the node-keyed kinds. The
+        # pump groups shards by cursor value, so the steady state (all
+        # cursors equal) is ONE watch scan with zero routing work;
+        # cursors diverge only across a shard-scoped relist window.
+        self._shards = shard_count()
+        STATE_SHARDS.set(float(self._shards))
+        self._shard_rv: dict[str, list[int]] = {
+            k: [0] * self._shards for k in self.kinds if k in SHARDED_KINDS
+        }
+        self._shard_relist_gen: dict[str, list[int]] = {
+            k: [0] * self._shards for k in self._shard_rv
+        }
+        # deletion tombstones (kind -> key -> deletion rv), recorded
+        # only while shard cursors are divergent: a behind shard's
+        # replay of a pre-delete MODIFIED must not resurrect a key a
+        # faster shard (or a scoped relist) already deleted. Cleared
+        # when a kind's cursors reconverge to a single group.
+        self._tombstones: dict[str, dict[str, int]] = {}
         self.sync()
+        with self._lock:
+            for kind in self._shard_rv:
+                rv = self._last_rv.get(kind, 0)
+                self._shard_rv[kind] = [rv] * self._shards
 
     # -- transport funnel --------------------------------------------------
 
@@ -895,38 +923,96 @@ class RealKubeClient:
                 self._relist(kind, reason="snapshot")
             return
         for kind in self.kinds:
-            try:
-                events = self.transport.watch_events(
-                    kind, self._last_rv[kind]
-                )
-            except ApiError as err:
-                if err.status == 410:
-                    # watch fell off the server's event horizon:
-                    # re-LIST and diff (informer relist), then the
-                    # next pump restarts the stream at the fresh rv
-                    self._relist(kind)
-                continue
-            for event, cr, rv in events:
-                with self._lock:
-                    self._last_rv[kind] = max(self._last_rv[kind], rv)
-                if event == DELETED:
-                    with self._lock:
-                        gone = self._mirror[kind].pop(
-                            self._from_item(kind, cr).key, None
-                        )
-                        if gone is not None:
-                            # only announce deletes the mirror knew
-                            # about: our own deletes were announced at
-                            # write time, and never-seen objects have
-                            # no consumers to notify
-                            self._index_pod(gone, removed=True)
-                            self._pending_events.append(
-                                (kind, DELETED, gone)
-                            )
+            shard_rv = self._shard_rv.get(kind)
+            if shard_rv is None:
+                # unsharded (fleet-wide) kind: single logical stream
+                try:
+                    events = self.transport.watch_events(
+                        kind, self._last_rv[kind]
+                    )
+                except ApiError as err:
+                    if err.status == 410:
+                        # watch fell off the server's event horizon:
+                        # re-LIST and diff (informer relist), then the
+                        # next pump restarts the stream at the fresh rv
+                        self._relist(kind)
                     continue
-                self._apply(kind, self._from_item(kind, cr), rv, event)
+                for event, cr, rv in events:
+                    with self._lock:
+                        self._last_rv[kind] = max(self._last_rv[kind], rv)
+                    self._ingest(kind, event, cr, rv)
+                continue
+            # sharded kind: ONE watch scan per DISTINCT cursor value.
+            # Steady state — all shard cursors equal — is a single
+            # group covering every shard, i.e. exactly the unsharded
+            # scan with zero routing work. After a shard-scoped relist
+            # the cursors diverge: each group's pass processes only the
+            # events routed to its member shards (every event is owned
+            # by exactly one group, so nothing is double-applied), and
+            # the groups reconverge as soon as both reach stream head.
+            groups: dict[int, list[int]] = {}
+            for shard, cursor in enumerate(shard_rv):
+                groups.setdefault(cursor, []).append(shard)
+            if len(groups) == 1:
+                self._tombstones.pop(kind, None)
+            gone_shards: set[int] = set()
+            for since_rv, members in sorted(groups.items()):
+                try:
+                    events = self.transport.watch_events(kind, since_rv)
+                except ApiError as err:
+                    if err.status == 410:
+                        gone_shards.update(members)
+                    continue
+                member_set = (
+                    None if len(members) == self._shards else set(members)
+                )
+                high = since_rv
+                for event, cr, rv in events:
+                    high = max(high, rv)
+                    obj = self._from_item(kind, cr)
+                    if member_set is not None and shard_of(
+                        route_key(kind, obj), self._shards
+                    ) not in member_set:
+                        continue  # another group's pass owns this event
+                    self._ingest(kind, event, cr, rv, obj=obj,
+                                 tombstone=member_set is not None)
+                with self._lock:
+                    for shard in members:
+                        shard_rv[shard] = max(shard_rv[shard], high)
+                    self._last_rv[kind] = max(self._last_rv[kind], high)
+            if gone_shards:
+                # ONE LIST covers every lost shard; a 410 on a subset
+                # of shards dirties only that subset's relist epochs
+                self._relist(
+                    kind,
+                    shards=(sorted(gone_shards)
+                            if len(gone_shards) < self._shards else None),
+                )
 
-    def _relist(self, kind: str, reason: str = "watch_gone") -> None:
+    def _ingest(self, kind: str, event: str, cr: dict, rv: int,
+                obj=None, tombstone: bool = False) -> None:
+        """Apply one watch event to the mirror + pending queue.
+        `tombstone` is set by divergent-cursor pump passes: the delete
+        is recorded so a behind shard's replay of an older MODIFIED
+        cannot resurrect the key (see _tombstones)."""
+        if obj is None:
+            obj = self._from_item(kind, cr)
+        if event == DELETED:
+            with self._lock:
+                if tombstone:
+                    self._tombstones.setdefault(kind, {})[obj.key] = rv
+                gone = self._mirror[kind].pop(obj.key, None)
+                if gone is not None:
+                    # only announce deletes the mirror knew about: our
+                    # own deletes were announced at write time, and
+                    # never-seen objects have no consumers to notify
+                    self._index_pod(gone, removed=True)
+                    self._pending_events.append((kind, DELETED, gone))
+            return
+        self._apply(kind, obj, rv, event)
+
+    def _relist(self, kind: str, reason: str = "watch_gone",
+                shards: Optional[list[int]] = None) -> None:
         """Full LIST + mirror diff for one kind (the informer's
         reaction to 410 Gone), synthesizing DELETED for keys that
         vanished while the watch was stale. 410-driven relists are
@@ -934,7 +1020,16 @@ class RealKubeClient:
         flapping watch degrades freshness by one bounded interval
         instead of hammering the apiserver with O(cluster) LISTs every
         pump — the 410 stays pending server-side, so a skipped relist
-        is retried on the next pump."""
+        is retried on the next pump.
+
+        With `shards` given (and the kind sharded), the relist is
+        SCOPED: one LIST still hits the server, but only items routed
+        to those shards are applied, DELETED is synthesized only for
+        mirror keys in those shards, and only those shards' relist
+        epochs and cursors advance — every other shard's stream
+        continuity (and therefore every other shard's retained rows
+        downstream) stays intact."""
+        scoped = shards is not None and kind in self._shard_rv
         if reason == "watch_gone":
             import os as _os
             import time as _time
@@ -948,31 +1043,63 @@ class RealKubeClient:
             if now - self._relist_at.get(kind, float("-inf")) < min_s:
                 return
             self._relist_at[kind] = now
-            KUBE_RELIST.inc({"kind": kind})
+            if scoped:
+                for shard in shards:
+                    STATE_SHARD_RELIST.inc(
+                        {"kind": kind, "shard": str(shard)}
+                    )
+            else:
+                KUBE_RELIST.inc({"kind": kind})
         status, body = self._request("list", "GET", _path(kind))
         if status != 200:
             return  # transient; the next pump retries
         if reason == "watch_gone":
             # only 410 relists lose event-stream continuity (snapshot
             # pumps re-LIST every cycle by design); retained-state
-            # consumers key "mark everything dirty" off this
+            # consumers key "mark everything dirty" off this — scoped
+            # to the lost shards when the stream loss was scoped
             with self._lock:
                 self._relist_gen[kind] = self._relist_gen.get(kind, 0) + 1
+                gens = self._shard_relist_gen.get(kind)
+                if gens is not None:
+                    for shard in (shards if scoped
+                                  else range(self._shards)):
+                        gens[shard] += 1
+        shard_set = set(shards) if scoped else None
         live_keys = set()
         for item in body.get("items", []):
             rv = int(item["metadata"].get("resourceVersion", "0") or 0)
             obj = self._from_item(kind, item)
+            if shard_set is not None and shard_of(
+                route_key(kind, obj), self._shards
+            ) not in shard_set:
+                continue  # other shards' mirror rows stay untouched
             live_keys.add(obj.key)
             self._apply(kind, obj, rv)
         with self._lock:
-            for key in set(self._mirror[kind]) - live_keys:
-                gone = self._mirror[kind].pop(key)
-                self._index_pod(gone, removed=True)
-                self._pending_events.append((kind, DELETED, gone))
             list_rv = int(
                 body.get("metadata", {}).get("resourceVersion", "0") or 0
             )
+            stale = [
+                key for key, cur in self._mirror[kind].items()
+                if key not in live_keys and (
+                    shard_set is None or shard_of(
+                        route_key(kind, cur), self._shards
+                    ) in shard_set
+                )
+            ]
+            for key in stale:
+                gone = self._mirror[kind].pop(key)
+                self._index_pod(gone, removed=True)
+                self._pending_events.append((kind, DELETED, gone))
+                if shard_set is not None:
+                    self._tombstones.setdefault(kind, {})[key] = list_rv
             self._last_rv[kind] = max(self._last_rv[kind], list_rv)
+            cursors = self._shard_rv.get(kind)
+            if cursors is not None:
+                for shard in (shard_set if shard_set is not None
+                              else range(self._shards)):
+                    cursors[shard] = max(cursors[shard], list_rv)
 
     def close(self) -> None:
         """Tear down transport-side watch machinery (stream threads)."""
@@ -984,6 +1111,11 @@ class RealKubeClient:
         """Merge one fresh object into the mirror, preserving the
         identity of the canonical instance controllers hold."""
         with self._lock:
+            tomb = self._tombstones.get(kind)
+            if tomb is not None and rv <= tomb.get(obj.key, -1):
+                # a behind shard replaying a pre-delete event must not
+                # resurrect a key another shard already deleted
+                return
             current = self._mirror[kind].get(obj.key)
             if current is not None and current.metadata.resource_version >= rv:
                 return  # self-echo or stale replay
@@ -1184,6 +1316,18 @@ class RealKubeClient:
         lost-continuity signal DirtyTracker.relisted latches."""
         with self._lock:
             return self._relist_gen.get(kind, 0)
+
+    def relist_generations(self, kind: str) -> dict[int, int]:
+        """Per-shard relist generations for one kind (empty for
+        unsharded kinds) — the scoped lost-continuity signal
+        DirtyTracker.relisted_shards latches. A full-stream relist
+        bumps every shard's generation, so shard-aware consumers see
+        it as all-shards-dirty (the merged contract's reading)."""
+        with self._lock:
+            gens = self._shard_relist_gen.get(kind)
+            if gens is None:
+                return {}
+            return {shard: gen for shard, gen in enumerate(gens)}
 
     def touch(self, obj) -> None:
         """In-place mutations must land on the server: touch IS update

@@ -166,15 +166,39 @@ class EvictionQueue:
     def prune(self) -> None:
         """Drop bookkeeping for pods that no longer exist (the
         reference's queue removes items on pod deletion events), and
-        deliver successors owed to since-cleared wedged pods."""
-        live = {p.key for p in self.kube.pods()}
+        deliver successors owed to since-cleared wedged pods.
+
+        O(tracked), never O(fleet): liveness is answered per tracked
+        key through the mirror's O(1) get_pod — the queue only ever
+        holds pods of actively-draining nodes, so drain bookkeeping
+        must not cost a 100k-pod set build per reconcile."""
+        def gone(key: str) -> bool:
+            ns, _, name = key.partition("/")
+            return self.kube.get_pod(ns, name) is None
+
         for key in list(self.blocked.keys() | self._retry_at.keys()):
-            if key not in live:
+            if gone(key):
                 self._forget(key)
         for key, successor in list(self._pending_rebirth.items()):
-            if key not in live:
+            if gone(key):
                 del self._pending_rebirth[key]
                 self.kube.create(successor)
+        self._report_pending()
+
+    def _report_pending(self) -> None:
+        """Per-shard backlog gauge: a wedged drain shows up as ITS
+        shard's backlog, not an anonymous total."""
+        from karpenter_tpu.metrics.store import STATE_SHARD_QUEUE_PENDING
+        from karpenter_tpu.state.shards import shard_count, shard_of
+
+        shards = shard_count()
+        counts = [0] * shards
+        for key in self.blocked.keys() | self._retry_at.keys():
+            counts[shard_of(key, shards)] += 1
+        for s, n in enumerate(counts):
+            STATE_SHARD_QUEUE_PENDING.set(
+                float(n), {"queue": "evict", "shard": str(s)}
+            )
 
     def restore(self) -> int:
         """Rebuild the owed-successor set from the store after a
@@ -375,6 +399,21 @@ class TerminationController:
     # -- helpers ---------------------------------------------------------------
 
     def _claim_for(self, node: Node):
+        # O(1) through the cluster's name/provider-id index; re-read
+        # through the mirror so the caller mutates (and updates) the
+        # live object, not the cluster's view of it
+        if self.cluster is not None:
+            sn = self.cluster.node_for_name(node.metadata.name)
+            if sn is not None and sn.node_claim is not None:
+                claim = self.kube.get_node_claim(
+                    sn.node_claim.metadata.name
+                )
+                if claim is not None and (
+                    claim.status.provider_id == node.spec.provider_id
+                ):
+                    return claim
+        # cluster-less fallback (bare-constructed controllers in
+        # tests), or the index hasn't absorbed the claim yet
         for claim in self.kube.node_claims():
             if claim.status.provider_id == node.spec.provider_id:
                 return claim

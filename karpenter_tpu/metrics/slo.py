@@ -46,6 +46,7 @@ Knobs (all read per tick, so chaos suites can flip them live):
 | KARPENTER_SLO_WINDOW_LONG | 72 | long burn window (and history), in ticks |
 | KARPENTER_SLO_TICK_BUDGET_MS | 1000 | tick-latency SLI budget |
 | KARPENTER_SLO_GAP_MAX | 0.05 | optimality SLI: max acceptable gap_vs_lp |
+| KARPENTER_SLO_BIND_P99_S | 60 | pod_to_bind_latency SLI: p99 arrival->bind budget |
 | KARPENTER_SLO_WARN_BURN | 2.0 | warn when both windows burn past this |
 | KARPENTER_SLO_PAGE_BURN | 10.0 | page when both windows burn past this |
 """
@@ -126,6 +127,16 @@ def _admission(signals: dict) -> Optional[tuple[float, float]]:
     return (1.0, 1.0) if shed <= 0 else (0.0, 1.0)
 
 
+def _bind_latency(signals: dict) -> Optional[tuple[float, float]]:
+    # p99 arrival->bind wall from the binding queue's enqueue stamps;
+    # absent when the tick bound nothing (data-free, not "good")
+    p99 = signals.get("pod_to_bind_p99_s")
+    if p99 is None:
+        return None
+    budget = _env_float("KARPENTER_SLO_BIND_P99_S", 60.0)
+    return (1.0, 1.0) if p99 <= budget else (0.0, 1.0)
+
+
 def _optimality(signals: dict) -> Optional[tuple[float, float]]:
     gap = signals.get("gap_vs_lp")
     if gap is None:
@@ -150,6 +161,9 @@ DEFAULT_SLIS: tuple[SLI, ...] = (
     SLI("admission",
         "zero pods shed by priority admission",
         0.95, _admission),
+    SLI("pod_to_bind_latency",
+        "p99 pod arrival->bind wall under KARPENTER_SLO_BIND_P99_S",
+        0.99, _bind_latency),
     SLI("optimality",
         "gap_vs_lp under KARPENTER_SLO_GAP_MAX on cost solves",
         0.90, _optimality),

@@ -350,6 +350,28 @@ KUBE_RELIST = REGISTRY.counter(
     "karpenter_kube_relist_total",
     "Informer relists after a watch fell off the server's event "
     "horizon (410 Gone), by kind")
+# sharded state plane (state/shards.py): per-shard stream continuity
+# and scoped invalidation accounting
+STATE_SHARDS = REGISTRY.gauge(
+    "karpenter_state_shards",
+    "Configured state-plane shard count (KARPENTER_STATE_SHARDS) — "
+    "the hash-partition width shared by the watch pump's logical "
+    "streams, the retained-state invalidation domains, and the "
+    "bind/evict queues")
+STATE_SHARD_RELIST = REGISTRY.counter(
+    "karpenter_state_shard_relist_total",
+    "Shard-scoped informer relists (a 410 on one shard's logical "
+    "stream re-LISTed only that shard's keys, leaving other shards' "
+    "retained rows warm), by kind and shard")
+STATE_SHARD_INVALIDATIONS = REGISTRY.counter(
+    "karpenter_state_shard_invalidations_total",
+    "Shard-scoped retained-state invalidations (rows dropped for the "
+    "relisted shards only instead of a whole-cache bust), by layer "
+    "(disruption_snapshot / incremental)")
+STATE_SHARD_QUEUE_PENDING = REGISTRY.gauge(
+    "karpenter_state_shard_queue_pending",
+    "Items pending in a sharded operator queue, by queue (bind / "
+    "evict) and shard")
 OPERATOR_RECOVERY = REGISTRY.counter(
     "karpenter_operator_recovery_total",
     "Crash-recovery actions taken at operator boot, by action "

@@ -204,8 +204,14 @@ class Operator:
                 _os.environ[env_key] = str(value)
         # plans whose pods await binding (the kube-scheduler's job in a
         # real cluster; this runtime owns the whole substrate, so it
-        # binds pods to the nodes the solver placed them on)
-        self._pending_bindings: list = []
+        # binds pods to the nodes the solver placed them on). Sharded
+        # queue: drain cost tracks pods still pending, never fleet size
+        from karpenter_tpu.operator.bindqueue import BindingQueue
+
+        self._pending_bindings = BindingQueue(
+            self.kube, self.cluster, self._bind_one,
+            lambda t: self.provisioner.batcher.trigger(now=t),
+        )
         # crash/restart convergence: the first tick rebuilds in-flight
         # intent from the API alone (see _recover)
         self._recovered = False
@@ -343,6 +349,13 @@ class Operator:
         }
         self._slo_divergences0 = divergences
         self._slo_shed0 = shed
+        # arrival->bind walls the binding queue collected this tick; an
+        # absent signal is a data-free tick (no binds), not a zero
+        lats = sorted(self._pending_bindings.take_latencies())
+        if lats:
+            signals["pod_to_bind_p99_s"] = lats[
+                min(len(lats) - 1, int(0.99 * len(lats)))
+            ]
         signals.update(_slo.take_noted())
         self.slo.observe_tick(signals)
 
@@ -666,119 +679,20 @@ class Operator:
         return False
 
     def _enqueue_bindings(self, results, now: float, ttl: float) -> None:
-        results.bind_deadline = now + ttl
-        self._pending_bindings.append(results)
+        self._pending_bindings.enqueue(results, now, ttl)
 
     def _bind_pending(self, now: Optional[float] = None) -> None:
         """Bind pods from completed scheduling results to their target
         nodes once those nodes exist (and immediately for placements on
         live nodes). Results are dropped once fully bound or once every
-        pod found a different home."""
+        pod found a different home. The queue's drain is O(pods still
+        pending): terminally-handled pods are never re-walked."""
         now = time.time() if now is None else now
         if not self._pending_bindings:
             return
         with tracing.span("bind", plans=len(self._pending_bindings)) as sp:
-            self._bind_pending_traced(now, sp)
-
-    def _bind_pending_traced(self, now: float, sp) -> None:
-        bound = 0
-        remaining = []
-        for results in self._pending_bindings:
-            if now > getattr(results, "bind_deadline", float("inf")):
-                continue  # stale plan: its pods re-solve via the batcher
-            unbound = False
-            for plan in results.new_node_plans:
-                claim = (
-                    self.kube.get_node_claim(plan.claim_name)
-                    if plan.claim_name else None
-                )
-                node_name = claim.status.node_name if claim is not None else ""
-                claim_gone = claim is None or (
-                    claim.metadata.deletion_timestamp is not None
-                )
-                for pod in plan.pods:
-                    live = self.kube.get_pod(pod.metadata.namespace, pod.metadata.name)
-                    if live is None or (
-                        live.spec.node_name
-                        and node_name
-                        and live.spec.node_name != node_name
-                    ):
-                        # awaiting rebirth, or still bound to the node
-                        # the command is draining: HOLD the plan until
-                        # the pod comes free (deadline-bounded) — a
-                        # plan dropped while its pods are still bound
-                        # never fires at all (seed-11 oscillation)
-                        unbound = True
-                        continue
-                    if live.spec.node_name:
-                        if not node_name and not claim_gone:
-                            # still bound to the node being drained
-                            # while the replacement claim has no
-                            # status.node_name yet (created this tick,
-                            # registers in a later lifecycle phase):
-                            # HOLD the plan like the
-                            # existing-assignments branch below —
-                            # treating this as "already home" silently
-                            # dropped pure-replace command plans before
-                            # their claims ever registered (ADVICE r5)
-                            unbound = True
-                        continue  # already home (or nothing to wait on)
-                    if node_name and not claim_gone:
-                        if self._bind_one(live, node_name):
-                            bound += 1
-                        else:
-                            unbound = True
-                    elif claim_gone:
-                        # binding target never materializes (ICE /
-                        # liveness timeout deleted the claim): re-queue
-                        # the still-pending pod through the batcher —
-                        # the controller analogue of the reference's
-                        # pod-event-driven re-provisioning; simulated
-                        # clock threaded through so batcher windows
-                        # never mix wall and sim time
-                        self.provisioner.batcher.trigger(now=now)
-                    else:
-                        unbound = True  # node still materializing
-            for node_name, pods in results.existing_assignments.items():
-                # an in-flight assignment is keyed by CLAIM name; bind
-                # only once the claim's node materialized — a bind to
-                # the raw key would pin pods to a node that will never
-                # exist under that name
-                target = node_name
-                if self.cluster.node_for_name(node_name) is None:
-                    claim = self.kube.get_node_claim(node_name)
-                    if claim is not None and claim.metadata.deletion_timestamp is None:
-                        target = claim.status.node_name
-                        if not target:
-                            unbound = True
-                            continue
-                    elif not any(
-                        n.metadata.name == node_name
-                        for n in self.kube.nodes()
-                    ):
-                        # the claim died (ICE/liveness) before its node
-                        # existed, or the node vanished: never bind to
-                        # a name that will not materialize — re-queue
-                        # the pods through the batcher instead
-                        self.provisioner.batcher.trigger(now=now)
-                        continue
-                for pod in pods:
-                    live = self.kube.get_pod(pod.metadata.namespace, pod.metadata.name)
-                    if live is not None and not live.spec.node_name:
-                        if self._bind_one(live, target):
-                            bound += 1
-                        else:
-                            unbound = True
-                    elif live is None or live.spec.node_name != target:
-                        # awaiting rebirth from the drain, or still
-                        # bound to the node being drained: HOLD the
-                        # plan (deadline-bounded) so the pod lands on
-                        # the planned capacity, not a fresh solve
-                        unbound = True
-            if unbound:
-                remaining.append(results)
-        self._pending_bindings = remaining
-        sp.annotate(bound=bound, held=len(remaining))
+            bound, held = self._pending_bindings.drain(now)
+            sp.annotate(bound=bound, held=held)
 
     def healthz(self) -> dict:
         """Liveness: the process and its store are responsive, and the

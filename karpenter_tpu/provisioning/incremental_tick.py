@@ -93,8 +93,10 @@ from karpenter_tpu.metrics.store import (
     SCHEDULER_QUEUE_DEPTH,
     SCHEDULER_SCHEDULING_DURATION,
     SCHEDULER_UNSCHEDULABLE_PODS,
+    STATE_SHARD_INVALIDATIONS,
 )
-from karpenter_tpu.provisioning.preferences import relaxable
+from karpenter_tpu.state.shards import shard_of
+from karpenter_tpu.provisioning.preferences import relax, relaxable
 from karpenter_tpu.provisioning.scheduler import (
     NO_CAPACITY_ERROR,
     SOLVE_TIMEOUT_SECONDS,
@@ -128,6 +130,15 @@ ENV_CHURN_MAX = "KARPENTER_INCR_CHURN_MAX"
 
 MAX_DIVERGENCE_RECORDS = 16
 RETRY_ROUNDS = 16  # k-way-evicted re-solve bound, mirrors Scheduler._solve
+
+
+class _EnvelopeEscape(Exception):
+    """An admission-loop re-solve left the incremental envelope
+    (timeout, topology fallback): the whole tick must hand over."""
+
+    def __init__(self, reason: str):
+        super().__init__(reason)
+        self.reason = reason
 
 
 def incremental_enabled() -> bool:
@@ -224,9 +235,8 @@ class IncrementalTickScheduler:
         # what the fallback path would have decided
         self._make_scheduler = make_scheduler
         # Provisioner._plans_over_limits: the admission loop's limit
-        # simulation. A mixed-priority tick whose plans would blow a
-        # pool limit must route to the full path (the shed/cutoff
-        # machinery wraps only full-path results).
+        # simulation, consumed by the in-envelope shed/cutoff loop
+        # (priority.enforce_admission over the incremental core).
         self._plans_over_limits = plans_over_limits
         self.options = options
         self.clock = clock if clock is not None else time.monotonic
@@ -354,6 +364,8 @@ class IncrementalTickScheduler:
             shape.add("reserved")
         if any(p.spec.priority for p in pods):
             shape.add("priority")
+        if any(relaxable(p) for p in pods):
+            shape.add("relax")
         if shape - self._envelope_seen:
             self._envelope_seen |= shape
             if self._force_audit is None and not self._quarantined:
@@ -374,6 +386,17 @@ class IncrementalTickScheduler:
 
         from karpenter_tpu.solver import resilience
 
+        # pre-relax preference state, per relaxable pod: relax()
+        # REPLACES spec.affinity / the spread-constraint list (never
+        # mutates them in place), so holding the old references is a
+        # faithful snapshot. The audit restores these before its
+        # shadow solve — the oracle must replay the same ladder from
+        # the same base, not solve already-relaxed pods.
+        prefs = {
+            p.key: (p.spec.affinity,
+                    tuple(p.spec.topology_spread_constraints))
+            for p in pods if relaxable(p)
+        }
         resilience.pop_degraded()  # scope the report to THIS solve
         results, fallback = self._solve(pods, pools)
         degraded = resilience.pop_degraded()
@@ -394,7 +417,7 @@ class IncrementalTickScheduler:
         audit_trigger = self._audit_trigger(pods)
         if audit_trigger is not None:
             ok, shadow = self._audit(pods, pools_with_types, results,
-                                     audit_trigger)
+                                     audit_trigger, prefs)
             if not ok:
                 # serve the full-solve decision; retained state is
                 # already quarantined by _audit. The tick degraded
@@ -444,12 +467,24 @@ class IncrementalTickScheduler:
         through the same module-level seam."""
         if not results.errors:
             return
+        from karpenter_tpu.provisioning.priority import PRIORITY_SHED_ERROR
         from karpenter_tpu.provisioning.scheduler import (
             note_unschedulable_explanations,
         )
 
+        # shed pods already carry the richer "shed" verdict from the
+        # in-envelope admission loop (stamped after the last re-solve,
+        # matching the full path's note ordering) — renoting them here
+        # would overwrite it with a generic "unschedulable"
+        noted = results
+        if any(e == PRIORITY_SHED_ERROR for e in results.errors.values()):
+            noted = replace(
+                results,
+                errors={k: e for k, e in results.errors.items()
+                        if e != PRIORITY_SHED_ERROR},
+            )
         note_unschedulable_explanations(
-            pods, results, self._sorted_pools(pools_with_types),
+            pods, noted, self._sorted_pools(pools_with_types),
             list(self._inputs.values()), self._daemon_overhead,
         )
 
@@ -543,9 +578,13 @@ class IncrementalTickScheduler:
     def _sync(self, pools) -> float:
         """Refresh the retained inputs from cluster state, O(dirty).
         Returns the churn fraction (rebuilt rows / fleet)."""
-        rebuild_all = self._tracker.relisted(
-            "Node", "NodeClaim", "Pod", "DaemonSet"
-        )
+        # node-keyed kinds ride the SCOPED continuity latch: a 410 on
+        # one shard's logical stream dirties only the retained keys
+        # routed to that shard (None = unscoped relist: everything).
+        # DaemonSet relists stay whole-cache — daemon reserves are
+        # fleet-wide.
+        shards = self._tracker.relisted_shards("Node", "NodeClaim", "Pod")
+        rebuild_all = shards is None or self._tracker.relisted("DaemonSet")
         if self._tracker.drain("DaemonSet"):
             rebuild_all = True
         dirty = (
@@ -553,6 +592,9 @@ class IncrementalTickScheduler:
             | self._tracker.drain("NodeClaim")
             | self._tracker.drain("Pod")
         )
+        if shards and not rebuild_all:
+            dirty |= {k for k in self._inputs if shard_of(k) in shards}
+            STATE_SHARD_INVALIDATIONS.inc({"layer": "incremental"})
         fp = catalog_fingerprint(pools)
         if rebuild_all or fp != self._builder_fp or self._builder is None:
             # catalog moved (price flip, pool edit, type rebuild): the
@@ -667,14 +709,63 @@ class IncrementalTickScheduler:
     def _solve(
         self, pods: Sequence[Pod], pools,
     ) -> tuple[Optional[SchedulerResults], str]:
+        """One incremental solve: the batched core, then — exactly
+        when the full path's admission loop would act — the shared
+        priority shed/cutoff loop re-solving the admitted prefix
+        through the same core. Returns (results, "") or (None,
+        reason) when only the full path's machinery can finish."""
+        results, reason = self._solve_core(pods, pools)
+        if results is None:
+            return None, reason
+        if self._priority_overloaded(pods, results):
+            return self._enforce_admission(pods, pools, results)
+        return results, ""
+
+    def _enforce_admission(
+        self, pods, pools, results,
+    ) -> tuple[Optional[SchedulerResults], str]:
+        """Provisioner._enforce_priority_admission's shed/cutoff loop
+        (provisioning/priority.py), in-envelope: the admitted prefix
+        re-solves through the incremental core instead of a fresh full
+        Scheduler. A re-solve that escapes the envelope mid-loop
+        (timeout, topology lowering fallback) hands the WHOLE tick to
+        the full path — a half-shed decision must never serve."""
+        from karpenter_tpu.provisioning import priority as padm
+
+        # first shed on this cache generation earns a forced audit,
+        # like every other newly-widened envelope shape
+        if "shed" not in self._envelope_seen:
+            self._envelope_seen.add("shed")
+            if self._force_audit is None and not self._quarantined:
+                self._force_audit = "envelope"
+
+        def solve_fn(keep):
+            res, reason = self._solve_core(keep, pools)
+            if res is None:
+                raise _EnvelopeEscape(reason)
+            return res
+
+        try:
+            results = padm.enforce_admission(
+                list(pods), pools, results, solve_fn,
+                plans_over_limits=self._plans_over_limits,
+                daemon_overhead=lambda: self._daemon_overhead,
+            )
+        except _EnvelopeEscape as esc:
+            return None, esc.reason
+        return results, ""
+
+    def _solve_core(
+        self, pods: Sequence[Pod], pools,
+    ) -> tuple[Optional[SchedulerResults], str]:
         """The batched fast path against the retained inputs —
         mirroring Scheduler._solve's structure: the simple pods ride
-        one batched solve (+ eviction retries), topology-spread pods
-        ride the lowered topo_batch solve against a Topology built
-        from the retained domain columns, and the round's reservation
-        ledger is debited across both phases. Returns (results, "")
-        or (None, reason) when only the full path's machinery can
-        finish the tick."""
+        one batched solve (+ eviction retries + per-pod relaxation),
+        topology-spread pods ride the lowered topo_batch solve against
+        a Topology built from the retained domain columns, and the
+        round's reservation ledger is debited across both phases.
+        Returns (results, "") or (None, reason) when only the full
+        path's machinery can finish the tick."""
         results = SchedulerResults(new_node_plans=[],
                                    existing_assignments={})
         if not pods:
@@ -726,12 +817,6 @@ class IncrementalTickScheduler:
         for plan in open_plans:
             finalize_plan(plan)
             results.new_node_plans.append(plan)
-
-        if self._priority_overloaded(pods, results):
-            # a mixed-priority tick with a capacity failure is exactly
-            # where the admission shed/cutoff machinery acts — and it
-            # wraps only full-path results
-            return None, "priority"
         return results, ""
 
     def _solve_simple(
@@ -768,14 +853,59 @@ class IncrementalTickScheduler:
         still_failed.extend(place)  # retry bound hit
 
         for pod in still_failed:
-            if relaxable(pod):
-                # the relaxation ladder could still place this pod —
-                # that machinery lives only in the full Scheduler
-                # (relaxable() checks WITHOUT mutating; relax() edits
-                # the pod the full path is about to re-solve)
-                return False, "relaxation"
-            results.errors[pod.key] = NO_CAPACITY_ERROR
+            # Scheduler._solve's relaxation block, in-envelope (ISSUE
+            # 16): one rung stripped, one solo required-only retry
+            # against the committed round state. The incremental path
+            # serves only the live provisioner tick, which always
+            # honors preferences, so the mutation-then-retry sequence
+            # is byte-identical to what the full path would run — the
+            # audit restores pre-relax preferences before its shadow
+            # solve so the oracle replays the same ladder steps.
+            if self.clock() > deadline:
+                return False, "timeout"
+            retried = False
+            relaxed = relax(pod)
+            if relaxed:
+                self._note_relax(pod, relaxed)
+                groups = group_pods([pod], required_only=True)
+                chosen = self._pruned_keys(groups, work)
+                enc = encode(
+                    groups, pools,
+                    [work[k] for k in chosen],
+                    self._daemon_overhead,
+                    reserved_in_use=round_in_use,
+                    compat_cache=self.cache,
+                )
+                retry = solve_encoded(enc)
+                if not retry.unschedulable:
+                    self._commit_existing(retry, chosen, work, results)
+                    open_plans.extend(retry.new_nodes)
+                    _debit_reservations(retry.new_nodes, round_in_use)
+                    retried = True
+                    if self._explaining():
+                        from karpenter_tpu import explain
+
+                        explain.note_pod(
+                            pod.key, verdict="scheduled-after-relax",
+                            relax_unlocked=relaxed,
+                        )
+            if not retried:
+                results.errors[pod.key] = NO_CAPACITY_ERROR
         return True, ""
+
+    def _explaining(self) -> bool:
+        """The incremental tick serves only the LIVE provisioning
+        solve, so unlike Scheduler._explaining there is no controller
+        gate — an open explain record is the whole condition."""
+        from karpenter_tpu import explain
+
+        return explain.active() is not None
+
+    def _note_relax(self, pod: Pod, step: str) -> None:
+        if self._explaining():
+            from karpenter_tpu import explain
+
+            explain.note_relax(pod.key, step)
 
     def _commit_existing(self, sol, chosen, work, results) -> None:
         for a in sol.existing:
@@ -1042,15 +1172,41 @@ class IncrementalTickScheduler:
 
     def _audit(
         self, pods, pools_with_types, results: SchedulerResults,
-        trigger: str,
+        trigger: str, prefs: Optional[dict] = None,
     ) -> tuple[bool, SchedulerResults]:
         """Shadow full solve + decision fingerprint diff. On
         divergence: quarantine the retained state, record the episode
         for replay, and hand back the shadow decision."""
+        from karpenter_tpu.provisioning import priority as padm
+
         self._since_audit = 0
-        shadow = self._make_scheduler(
-            pools_with_types, "incremental_audit"
-        ).solve(list(pods))
+        # undo the live solve's relaxation mutations: the shadow must
+        # climb the same ladder from the same pre-tick base (it then
+        # deterministically re-applies the identical rungs, so the
+        # pods end the audit in the same state the live solve left)
+        if prefs:
+            for pod in pods:
+                saved = prefs.get(pod.key)
+                if saved is not None:
+                    pod.spec.affinity = saved[0]
+                    pod.spec.topology_spread_constraints = list(saved[1])
+
+        def shadow_solve(keep):
+            return self._make_scheduler(
+                pools_with_types, "incremental_audit"
+            ).solve(list(keep))
+
+        shadow = shadow_solve(pods)
+        # the full path wraps its solve in the admission loop; the
+        # shadow must too, or an in-envelope shed tick would diff
+        # against an unshed oracle. note=False: the live serve already
+        # counted the shed metrics/explanations.
+        shadow = padm.enforce_admission(
+            list(pods), pools_with_types, shadow, shadow_solve,
+            plans_over_limits=self._plans_over_limits,
+            daemon_overhead=lambda: self._daemon_overhead,
+            note=False,
+        )
         want = decision_fingerprint(shadow)
         got = decision_fingerprint(results)
         ok = want == got

@@ -81,6 +81,111 @@ def admission_order(pods: Sequence[Pod]) -> list[Pod]:
     return [p for g in group_pods(pods) for p in g.pods]
 
 
+def enforce_admission(
+    pods: Sequence[Pod],
+    pools,
+    results,
+    solve_fn,
+    plans_over_limits=None,
+    daemon_overhead=None,
+    note: bool = True,
+):
+    """The overload degradation contract, shared by every solve path:
+    when capacity (catalog or pool limits) truncates the solve, the
+    unscheduled set must be exactly the lowest-priority tail of the
+    admission order. Iterates cutoff-and-re-solve until the admitted
+    prefix is clean; the cutoff strictly decreases, so the loop
+    terminates. No-op on uniform-priority rounds.
+
+    - `solve_fn(keep)` re-solves the admitted prefix (the full path
+      passes a fresh Scheduler solve; the incremental tick its own
+      retained-state core — an escape there aborts the loop by
+      raising through this frame).
+    - `plans_over_limits(plans)` simulates NodePool limit rejection
+      (Provisioner._plans_over_limits); None skips limit folding.
+    - `daemon_overhead()` lazily supplies the per-pool overhead the
+      placeability check charges (built only on the first failure).
+    - `note=False` suppresses metrics/explain/tracing — the oracle
+      audit's shadow run must not double-count the live decision."""
+    pods = list(pods)
+    if not mixed_priorities(pods):
+        return results
+    # order/placeable are built lazily on the FIRST capacity failure:
+    # the healthy mixed-priority round pays only the mixed scan above
+    # and the caller's limit simulation
+    order: Optional[list] = None
+    pos: dict = {}
+    placeable: set = set()
+    cut = 0
+    for _ in range(16):
+        raw_failed = [
+            key for key, error in results.errors.items()
+            if error == NO_CAPACITY_ERROR
+        ]
+        if plans_over_limits is not None:
+            for plan in plans_over_limits(results.new_node_plans):
+                raw_failed.extend(p.key for p in plan.pods)
+        if order is None:
+            if not raw_failed:
+                return results
+            order = admission_order(pods)
+            pos = {p.key: i for i, p in enumerate(order)}
+            cut = len(order)
+            placeable = placeable_keys(
+                pods, pools,
+                daemon_overhead() if daemon_overhead is not None else None,
+            )
+        failed = [
+            k for k in raw_failed
+            if k in placeable and pos.get(k, cut) < cut
+        ]
+        if not failed:
+            break
+        cut = min(pos[k] for k in failed)
+        # re-solve the admitted prefix; unplaceable pods rejoin so
+        # their permanent errors keep reporting
+        keep = order[:cut] + [
+            p for p in order[cut:] if p.key not in placeable
+        ]
+        results = solve_fn(keep)
+    else:
+        if note:
+            log.warning(
+                "priority admission did not converge in 16 rounds; "
+                "serving the last solve's results"
+            )
+    if order is None or cut >= len(order):
+        return results
+    shed = [p for p in order[cut:] if p.key in placeable]
+    for pod in shed:
+        results.errors[pod.key] = PRIORITY_SHED_ERROR
+    if shed and note:
+        from karpenter_tpu import explain, tracing
+        from karpenter_tpu.metrics.store import PRIORITY_SHED
+
+        tracing.annotate(shed=len(shed),
+                         cutoff_priority=order[cut].spec.priority)
+        if explain.active() is not None:
+            # the admission cutoff is the explanation: the pod was
+            # placeable, but everything at or past this priority was
+            # shed so the higher-priority prefix stays clean
+            cutoff = int(order[cut].spec.priority)
+            for pod in shed:
+                explain.note_pod(
+                    pod.key, verdict="shed", code="priority_shed",
+                    cutoff_priority=cutoff,
+                    pod_priority=int(pod.spec.priority),
+                )
+        PRIORITY_SHED.inc(value=float(len(shed)))
+        log.warning(
+            "priority admission: demand exceeds capacity; shed %d "
+            "pod(s) at or below priority %d (cutoff honors the "
+            "deterministic admission order)",
+            len(shed), order[cut].spec.priority,
+        )
+    return results
+
+
 def placeable_keys(
     pods: Sequence[Pod],
     pools_with_types,

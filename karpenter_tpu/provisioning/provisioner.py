@@ -415,89 +415,17 @@ class Provisioner:
         admission order. Iterates cutoff-and-re-solve until the
         admitted prefix is clean; the cutoff strictly decreases, so the
         loop terminates. No-op on uniform-priority rounds."""
-        from karpenter_tpu.metrics.store import PRIORITY_SHED
         from karpenter_tpu.provisioning import priority as padm
+        from karpenter_tpu.provisioning.scheduler import NodeInputBuilder
 
-        pods = list(pods)
-        if not padm.mixed_priorities(pods):
-            return results
-        # order/placeable are built lazily on the FIRST capacity
-        # failure: the healthy mixed-priority round pays only the
-        # mixed scan above and the limit simulation below
-        order: Optional[list] = None
-        pos: dict = {}
-        placeable: set = set()
-        cut = 0
-        for _ in range(16):
-            raw_failed = [
-                key for key, error in results.errors.items()
-                if error == padm.NO_CAPACITY_ERROR
-            ]
-            for plan in self._plans_over_limits(results.new_node_plans):
-                raw_failed.extend(p.key for p in plan.pods)
-            if order is None:
-                if not raw_failed:
-                    return results
-                from karpenter_tpu.provisioning.scheduler import (
-                    NodeInputBuilder,
-                )
-
-                order = padm.admission_order(pods)
-                pos = {p.key: i for i, p in enumerate(order)}
-                cut = len(order)
-                placeable = padm.placeable_keys(
-                    pods, pools,
-                    NodeInputBuilder(
-                        pools, self.cluster.daemonsets()
-                    ).daemon_overhead(),
-                )
-            failed = [
-                k for k in raw_failed
-                if k in placeable and pos.get(k, cut) < cut
-            ]
-            if not failed:
-                break
-            cut = min(pos[k] for k in failed)
-            # re-solve the admitted prefix; unplaceable pods rejoin so
-            # their permanent errors keep reporting
-            keep = order[:cut] + [
-                p for p in order[cut:] if p.key not in placeable
-            ]
-            results = self._make_scheduler(pools).solve(keep)
-        else:
-            log.warning(
-                "priority admission did not converge in 16 rounds; "
-                "serving the last solve's results"
-            )
-        if order is None or cut >= len(order):
-            return results
-        shed = [p for p in order[cut:] if p.key in placeable]
-        for pod in shed:
-            results.errors[pod.key] = padm.PRIORITY_SHED_ERROR
-        if shed:
-            from karpenter_tpu import explain, tracing
-
-            tracing.annotate(shed=len(shed),
-                             cutoff_priority=order[cut].spec.priority)
-            if explain.active() is not None:
-                # the admission cutoff is the explanation: the pod was
-                # placeable, but everything at or past this priority
-                # was shed so the higher-priority prefix stays clean
-                cutoff = int(order[cut].spec.priority)
-                for pod in shed:
-                    explain.note_pod(
-                        pod.key, verdict="shed", code="priority_shed",
-                        cutoff_priority=cutoff,
-                        pod_priority=int(pod.spec.priority),
-                    )
-            PRIORITY_SHED.inc(value=float(len(shed)))
-            log.warning(
-                "priority admission: demand exceeds capacity; shed %d "
-                "pod(s) at or below priority %d (cutoff honors the "
-                "deterministic admission order)",
-                len(shed), order[cut].spec.priority,
-            )
-        return results
+        return padm.enforce_admission(
+            list(pods), pools, results,
+            solve_fn=lambda keep: self._make_scheduler(pools).solve(keep),
+            plans_over_limits=self._plans_over_limits,
+            daemon_overhead=lambda: NodeInputBuilder(
+                pools, self.cluster.daemonsets()
+            ).daemon_overhead(),
+        )
 
     # -- create (provisioner.go:407-459) --------------------------------------
 

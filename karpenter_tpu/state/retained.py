@@ -125,9 +125,16 @@ class RetainedFleetSeam:
         """Drain watch dirt into per-key versions. Cheap; callers
         (the engine's candidate-core cache and fleet_snapshot) share
         one tracker through this method."""
-        if self._tracker.relisted(
-            "Node", "NodeClaim", "Pod", "DaemonSet", "PodDisruptionBudget"
-        ):
+        # node-keyed kinds first, through the SCOPED continuity latch:
+        # a shard's lost stream dirties only that shard's rows (None
+        # means the client can't scope it — whole-cache bust)
+        shards = self._tracker.relisted_shards("Node", "NodeClaim", "Pod")
+        if shards is None:
+            self.invalidate()
+        elif shards:
+            self.invalidate_shards(shards)
+        # fleet-wide kinds keep the merged (whole-cache) contract
+        if self._tracker.relisted("DaemonSet", "PodDisruptionBudget"):
             self.invalidate()
         if self._tracker.drain("PodDisruptionBudget"):
             self.pdb_epoch += 1
@@ -155,6 +162,30 @@ class RetainedFleetSeam:
         self.pdb_epoch += 1
         self._builder = None
         self._tracker.clear()
+
+    def invalidate_shards(self, shards: set[int]) -> None:
+        """Shard-scoped bust (ISSUE 16): drop retained rows/inputs
+        only for keys routed to the relisted shards, leaving every
+        other shard's rows warm. Version bumps cover the union of row
+        and version keys in the affected shards (the engine's
+        candidate-core cache stamps entries with `node_version`, which
+        can outlive a pruned row). `pdb_epoch` is bumped conservatively
+        — the relist's diff events can't prove no PDB-relevant pod
+        churn hid in the stale window — but the build epoch and the
+        input builder survive, which is the whole point."""
+        from karpenter_tpu.metrics.store import STATE_SHARD_INVALIDATIONS
+        from karpenter_tpu.state.shards import shard_of
+
+        for key in [
+            k for k in set(self._rows) | set(self._ver)
+            if shard_of(k) in shards
+        ]:
+            self._rows.pop(key, None)
+            self._inputs.pop(key, None)
+            self._built.pop(key, None)
+            self._ver[key] = self._ver.get(key, 0) + 1
+        self.pdb_epoch += 1
+        STATE_SHARD_INVALIDATIONS.inc({"layer": "disruption_snapshot"})
 
     def note_mutated(self, keys: Iterable[str]) -> None:
         """A simulation committed pods onto these served rows; re-copy

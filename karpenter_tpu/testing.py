@@ -359,10 +359,19 @@ def build_churn_operator(n_pods: int):
 
 
 def churn_tick_walls(env, op, now: float, ticks: int, churn_pods: int):
-    """Per-tick wall of the operator step that runs the churn solve:
-    each tick deletes `churn_pods` bound pods, creates as many
-    same-shape ones, and measures the step where the batcher fires.
+    """Per-tick wall of the operator step that runs the churn solve.
     Returns (p50_wall_seconds, now)."""
+    walls, now = churn_tick_wall_series(env, op, now, ticks, churn_pods)
+    return sorted(walls)[len(walls) // 2], now
+
+
+def churn_tick_wall_series(env, op, now: float, ticks: int,
+                           churn_pods: int):
+    """Per-tick wall series of the operator step that runs the churn
+    solve: each tick deletes `churn_pods` bound pods, creates as many
+    same-shape ones, and measures the step where the batcher fires.
+    Returns (walls, now) — callers pick their own percentiles (the
+    100k bench arm reports p50 AND p99)."""
     import time
 
     from karpenter_tpu.cloudprovider.fake import GIB
@@ -389,7 +398,7 @@ def churn_tick_walls(env, op, now: float, ticks: int, churn_pods: int):
         walls.append(time.perf_counter() - t0)
         now += 2.0
         op.step(now=now)   # bind/settle
-    return sorted(walls)[len(walls) // 2], now
+    return walls, now
 
 
 def disruption_scan_walls(env, op, now: float, scans: int,

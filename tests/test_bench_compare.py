@@ -377,3 +377,61 @@ class TestLiveOperatorScanGate:
             _artifact(tmp_path, "cur.json", cur),
         ])
         assert rc == 0
+
+
+class TestScaleWallGate:
+    """ISSUE 16: the live_operator_100k scenario's scale walls gate
+    relative like the wall keys, null-tolerant and loud like the
+    live_operator block — a side that skipped the 100k arm is
+    reported, never gated."""
+
+    def _base(self):
+        return {
+            "live_operator_100k": {
+                "pods_100k": 100000,
+                "tick_p50_s_100k": 0.08,
+                "tick_p99_s_100k": 0.3,
+                "tick_p50_s_10k": 0.05,
+                "wall_ratio_100k_vs_10k": 1.6,
+                "oracle_divergences": 0,
+            },
+        }
+
+    def test_scale_wall_regression_gates(self, tmp_path, capsys):
+        cur = self._base()
+        cur["live_operator_100k"]["tick_p50_s_100k"] = 0.4
+        rc = main([
+            _artifact(tmp_path, "base.json", self._base()),
+            _artifact(tmp_path, "cur.json", cur),
+            "--threshold", "0.25",
+        ])
+        assert rc == 1
+        assert "tick_p50_s_100k" in capsys.readouterr().out
+
+    def test_skipped_arm_reports_but_never_gates(self, tmp_path,
+                                                 capsys):
+        cur = {"live_operator_100k": {"skipped": True}}
+        rc = main([
+            _artifact(tmp_path, "base.json", self._base()),
+            _artifact(tmp_path, "cur.json", cur),
+        ])
+        assert rc == 0
+        assert "not gated" in capsys.readouterr().out
+
+    def test_new_scale_arm_reports_not_gated(self, tmp_path, capsys):
+        base = {"live_operator_100k": {"skipped": True}}
+        rc = main([
+            _artifact(tmp_path, "base.json", base),
+            _artifact(tmp_path, "cur.json", self._base()),
+        ])
+        assert rc == 0
+        assert "new key; not gated" in capsys.readouterr().out
+
+    def test_within_threshold_passes(self, tmp_path):
+        cur = self._base()
+        cur["live_operator_100k"]["tick_p50_s_100k"] = 0.085
+        rc = main([
+            _artifact(tmp_path, "base.json", self._base()),
+            _artifact(tmp_path, "cur.json", cur),
+        ])
+        assert rc == 0

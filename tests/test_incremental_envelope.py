@@ -258,10 +258,12 @@ class TestEnvelopeOracle:
         status = env.provisioner.incremental.status()
         assert status["quarantined"] or status["divergences"] > 0
 
-    def test_priority_overload_falls_back_to_admission(self, clean):
-        """A mixed-priority tick that cannot place everything must
-        hand the tick to the full path (the shed machinery lives
-        there): the unscheduled set is the lowest-priority tail."""
+    def test_priority_overload_sheds_in_envelope(self, clean):
+        """A mixed-priority tick that cannot place everything runs
+        the shared shed/cutoff loop IN-envelope (ISSUE 16): the
+        unscheduled set is the lowest-priority tail, the tick serves
+        incrementally (no `priority` fallback), and the forced
+        envelope audit agrees with the full path's decision."""
         from karpenter_tpu.provisioning.priority import (
             PRIORITY_SHED_ERROR,
         )
@@ -289,9 +291,12 @@ class TestEnvelopeOracle:
         assert {"default/over-2", "default/over-3"} <= set(shed), (
             f"low-priority pods must be in the shed tail: {shed}"
         )
-        assert env.provisioner.incremental.status()["fallbacks"].get(
-            "priority", 0
-        ) >= 1
+        status = env.provisioner.incremental.status()
+        assert "priority" not in status["fallbacks"], status["fallbacks"]
+        assert status["ticks"]["incremental"] >= 1, status["ticks"]
+        assert status["divergences"] == 0, (
+            "in-envelope shed diverged from the full path's admission"
+        )
 
 
 class TestFallbackRollup:

@@ -1,0 +1,190 @@
+"""Static O(dirty) seam check (ISSUE-16 satellite, pattern of
+test_solve_entry_sites): reconcile-path modules may not iterate the
+full pod/node/claim store outside the allowlisted seams. The sharded
+state plane's contract is that steady-state tick work is proportional
+to what CHANGED — a stray `self.kube.pods()` in a per-tick path
+silently reintroduces an O(fleet) walk that no unit test notices at
+50 pods and every operator pays at 100k. Full-store walks remain
+legitimate in exactly three shapes, all enumerated below:
+
+- full-resync backstops (`reconcile_all`, the periodic sweeps) — the
+  informer-resync analogue, explicitly NOT the steady-state path;
+- startup/recovery rebuilds (`restore`, `_recover`, `adopt_in_flight`)
+  — run once per process, correctness over latency;
+- state-layer internals (cluster indexes, the retained fleet seam's
+  own build) — the seams the O(dirty) layers read THROUGH.
+
+Adding a new full-store call site fails this test until the site is
+deliberately added here with a justification that places it in one of
+those shapes.
+"""
+
+import ast
+import pathlib
+
+PKG = pathlib.Path(__file__).resolve().parent.parent / "karpenter_tpu"
+
+# reconcile-path layers: everything that runs inside (or feeds) the
+# operator tick. The solver package, codecs, and bench are
+# solver-internal surfaces with no store access of their own.
+CONTROLLER_DIRS = (
+    "provisioning", "disruption", "operator", "lifecycle", "state",
+    "metrics", "events",
+)
+
+# full-store iteration entry points on the kube mirror / cluster state
+FULL_SCAN_NAMES = {"pods", "nodes", "node_claims"}
+
+# (path relative to karpenter_tpu/, enclosing function) -> why the
+# full walk is legitimate there
+ALLOWED = {
+    # -- the incremental envelope IS a seam: its _sync diffs the store
+    #    against retained inputs (scoped by relisted_shards), its
+    #    topology/tick reads are the audited O(dirty) machinery itself
+    ("provisioning/incremental_tick.py", "_sync"),
+    ("provisioning/incremental_tick.py", "_build_topology"),
+    ("provisioning/incremental_tick.py", "tick"),
+    # -- full-path provisioning: the batcher-gated solve (fires on
+    #    events, not per tick) and its intake filter
+    ("provisioning/provisioner.py", "get_pending_pods"),
+    ("provisioning/provisioner.py", "reschedulable_pods_from_deleting_nodes"),
+    ("provisioning/provisioner.py", "_make_scheduler"),
+    # -- preemption victim search: runs only on capacity failure
+    ("provisioning/preemption.py", "_choose_victims"),
+    # -- static capacity: per-pool claim/cost accounting over pools'
+    #    own claims (bounded by static pools, not the fleet)
+    ("provisioning/static.py", "cost"),
+    ("provisioning/static.py", "_pool_claims"),
+    # -- disruption: candidate scan + budget mapping read through the
+    #    retained fleet seam (ISSUE 15); the rest are command-scoped
+    #    or full-resync passes
+    ("disruption/engine.py", "get_candidates"),
+    ("disruption/engine.py", "budget_mapping"),
+    ("disruption/engine.py", "_untaint_leftovers"),
+    ("disruption/engine.py", "_simulate_on_snapshot"),
+    ("disruption/engine.py", "_build_probe_solver"),
+    ("disruption/engine.py", "has_uninitialized_capacity"),
+    ("disruption/conditions.py", "reconcile_all"),
+    ("disruption/conditions.py", "reconcile_dirty"),
+    ("disruption/interruption.py", "_node_for_pid"),
+    # -- startup/recovery rebuilds: once per process
+    ("operator/operator.py", "_recover"),
+    ("lifecycle/nodeclaim_lifecycle.py", "adopt_in_flight"),
+    ("lifecycle/termination.py", "restore"),
+    # -- GC/health: interval-gated sweeps, the reap-what-leaked backstop
+    ("lifecycle/garbagecollection.py", "reconcile"),
+    # -- hygiene/lifecycle: full-resync passes + interval-gated
+    #    invariant sweeps (their reconcile_dirty walks are bounded by
+    #    deleting-claim re-queues, kept as-is)
+    ("lifecycle/hygiene.py", "reconcile_all"),
+    ("lifecycle/hygiene.py", "reconcile_dirty"),
+    ("lifecycle/hygiene.py", "_check"),
+    ("lifecycle/hygiene.py", "_counter"),
+    ("lifecycle/hygiene.py", "_hash_propagation"),
+    ("lifecycle/nodeclaim_lifecycle.py", "reconcile_all"),
+    ("lifecycle/nodeclaim_lifecycle.py", "reconcile_dirty"),
+    ("lifecycle/nodeclaim_lifecycle.py", "_finalize"),
+    ("lifecycle/nodeclaim_lifecycle.py", "_node_for"),
+    ("lifecycle/termination.py", "reconcile_all"),
+    ("lifecycle/termination.py", "reconcile_dirty"),
+    ("lifecycle/termination.py", "_claim_for"),
+    # -- state layer: the indexes and seams the O(dirty) layers read
+    #    through are built FROM full walks, by definition
+    ("state/cluster.py", "synced"),
+    ("state/cluster.py", "deep_copy_nodes"),
+    ("state/cluster.py", "nodepool_resources"),
+    ("state/cluster.py", "nodepool_node_count"),
+    ("state/retained.py", "fleet_snapshot"),
+    # -- metrics: interval-gated gauge republication
+    ("metrics/controllers.py", "reconcile_all"),
+    ("metrics/controllers.py", "_object_conditions"),
+}
+
+
+def _controller_files():
+    for dirname in CONTROLLER_DIRS:
+        for path in sorted((PKG / dirname).rglob("*.py")):
+            yield dirname, path
+
+
+def _full_scan_calls(tree):
+    """(lineno, attr, enclosing function) for every call of the shape
+    `<anything>.pods()` / `.nodes()` / `.node_claims()`."""
+    spans = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            spans.append((node.lineno, node.end_lineno, node.name))
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if not (
+            isinstance(func, ast.Attribute)
+            and func.attr in FULL_SCAN_NAMES
+        ):
+            continue
+        owner = "<module>"
+        best = None
+        for lo, hi, name in spans:
+            if lo <= node.lineno <= (hi or lo):
+                if best is None or lo > best[0]:
+                    best = (lo, name)
+        if best is not None:
+            owner = best[1]
+        out.append((node.lineno, func.attr, owner))
+    return out
+
+
+def test_reconcile_paths_do_not_walk_the_full_store():
+    offenders = []
+    for dirname, path in _controller_files():
+        rel = str(path.relative_to(PKG)).replace("\\", "/")
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for lineno, attr, owner in _full_scan_calls(tree):
+            if (rel, owner) in ALLOWED:
+                continue
+            offenders.append(
+                f"{rel}:{lineno} {owner}() iterates .{attr}()"
+            )
+    assert not offenders, (
+        "reconcile-path full-store walks outside the allowlisted "
+        "O(dirty) seams (add deliberately to ALLOWED in this test "
+        f"with a justification, or route through a seam): {offenders}"
+    )
+
+
+def test_allowlist_carries_no_dead_entries():
+    """Every allowlisted (file, function) must still contain a
+    full-scan call — a stale entry is a hole the guard silently keeps
+    open after the site was fixed."""
+    live = set()
+    for dirname, path in _controller_files():
+        rel = str(path.relative_to(PKG)).replace("\\", "/")
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for _, _, owner in _full_scan_calls(tree):
+            live.add((rel, owner))
+    dead = ALLOWED - live
+    assert not dead, f"stale ALLOWED entries (site no longer scans): {dead}"
+
+
+def test_binding_and_eviction_queues_stay_o_pending():
+    """The ISSUE-16 queues specifically: the binding queue's drain and
+    the eviction queue's prune were THE per-tick fleet walks this PR
+    removed; they must never regrow one."""
+    for rel in ("operator/bindqueue.py",):
+        tree = ast.parse((PKG / rel).read_text(), filename=rel)
+        calls = _full_scan_calls(tree)
+        assert not calls, f"{rel} reintroduced a full-store walk: {calls}"
+    tree = ast.parse(
+        (PKG / "lifecycle/termination.py").read_text(),
+        filename="lifecycle/termination.py",
+    )
+    offenders = [
+        (lineno, attr) for lineno, attr, owner in _full_scan_calls(tree)
+        if owner in ("prune", "evict", "_maybe_rebirth", "_forget",
+                     "_report_pending")
+    ]
+    assert not offenders, (
+        f"EvictionQueue hot paths regrew a full-store walk: {offenders}"
+    )

@@ -326,7 +326,7 @@ class TestSLOEndpoint:
             assert report["ticks"] == 3
             assert set(report["verdicts"]) == {
                 "tick_latency", "schedulability", "solve_integrity",
-                "admission", "optimality",
+                "admission", "optimality", "pod_to_bind_latency",
             }
             assert set(report["slis"]) == set(report["verdicts"])
             for sli in report["slis"].values():

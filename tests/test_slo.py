@@ -229,7 +229,7 @@ class TestOperatorWiring:
         assert digest["ticks"] == 3
         assert set(digest["verdicts"]) == {
             "tick_latency", "schedulability", "solve_integrity",
-            "admission", "optimality",
+            "admission", "pod_to_bind_latency", "optimality",
         }
         assert digest["worst"] in ("ok", "warn", "page")
         json.dumps(op.readyz())   # the whole probe stays serializable

@@ -1,0 +1,205 @@
+"""Sharded state plane (ISSUE 16): routing determinism, cross-shard
+event ordering, and scoped-relist warmth.
+
+The watch/list pump partitions its stream into per-shard logical
+streams by a process-stable hash of the routing key (state/shards.py);
+the dirty tracker, retained seam, and queues consume shard-scoped
+continuity. Three contracts pin the plane:
+
+- routing is a pure, process-stable function of the key (crc32 — a
+  restart or a second client must agree on shard ownership);
+- delivery order ACROSS shards is immaterial: a pod event and a
+  bound-node event on different shards produce the same dirty set
+  whichever shard's stream drains first, at any shard count;
+- a shard-scoped relist (410 on one logical stream) busts only that
+  shard's retained rows — every other shard's rows stay warm, the
+  whole point of sharding the stream.
+"""
+
+import zlib
+
+import pytest
+
+from karpenter_tpu.kube.client import KubeClient
+from karpenter_tpu.kube.dirty import DirtyTracker
+from karpenter_tpu.kube.objects import Node, ObjectMeta
+from karpenter_tpu.state.shards import (
+    DEFAULT_SHARDS,
+    route_key,
+    shard_count,
+    shard_of,
+)
+from karpenter_tpu.testing import mk_pod
+
+
+class TestRouting:
+    def test_shard_of_is_crc32_stable(self):
+        # the routing function is part of the plane's contract: any
+        # component (or a restarted process) recomputes the same owner
+        for key in ("node-1", "default/web-0", "zz", ""):
+            assert shard_of(key, 8) == zlib.crc32(key.encode()) % 8
+        assert shard_of("node-1", 1) == 0
+
+    def test_shard_count_env(self, monkeypatch):
+        monkeypatch.delenv("KARPENTER_STATE_SHARDS", raising=False)
+        assert shard_count() == DEFAULT_SHARDS
+        monkeypatch.setenv("KARPENTER_STATE_SHARDS", "3")
+        assert shard_count() == 3
+        monkeypatch.setenv("KARPENTER_STATE_SHARDS", "0")
+        assert shard_count() == 1  # floor: at least one shard
+
+    def test_bound_pod_routes_by_node(self):
+        # a bound pod lives on its node's stream: the consumers that
+        # care about it (retained rows, disruption cores) are keyed by
+        # node, and split-brain between a node and its pods would make
+        # scoped relists unsound
+        pod = mk_pod(name="w-0", cpu=0.5)
+        assert route_key("Pod", pod) == pod.key
+        pod.spec.node_name = "node-7"
+        assert route_key("Pod", pod) == "node-7"
+
+    def test_node_routes_by_name(self):
+        node = Node(metadata=ObjectMeta(name="node-7"))
+        assert route_key("Node", node) == "node-7"
+        # bound pod and its node agree on the shard at every count
+        pod = mk_pod(name="w-1", cpu=0.5)
+        pod.spec.node_name = "node-7"
+        for n in (1, 2, 8, 13):
+            assert (
+                shard_of(route_key("Pod", pod), n)
+                == shard_of(route_key("Node", node), n)
+            )
+
+
+def _names_in_distinct_shards(n_shards: int) -> tuple[str, str]:
+    """Two node names owned by different shards (same name pair works
+    for count 1 — there IS only one shard, the property still holds)."""
+    if n_shards == 1:
+        return "node-a", "node-b"
+    base = "node-a"
+    for i in range(256):
+        other = f"node-{i}"
+        if shard_of(other, n_shards) != shard_of(base, n_shards):
+            return base, other
+    raise AssertionError("crc32 cannot be this degenerate")
+
+
+class TestCrossShardOrdering:
+    @pytest.mark.parametrize("n_shards", [1, 2, 8])
+    def test_order_across_shards_is_immaterial(self, monkeypatch,
+                                               n_shards):
+        monkeypatch.setenv("KARPENTER_STATE_SHARDS", str(n_shards))
+        name_a, name_b = _names_in_distinct_shards(n_shards)
+        shard_a = shard_of(name_a, n_shards)
+        shard_b = shard_of(name_b, n_shards)
+
+        def run(order: tuple[int, ...]) -> set[str]:
+            kube = KubeClient(async_delivery=True)
+            tracker = DirtyTracker(kube).watch("Pod", "Node")
+            # one pod event bound to node_a's shard, one node event on
+            # node_b's shard, queued but undelivered
+            pod = mk_pod(name="w-0", cpu=0.5)
+            pod.spec.node_name = name_a
+            kube.create(pod)
+            kube.create(Node(metadata=ObjectMeta(name=name_b)))
+            for shard in order:
+                kube.deliver(shard=shard)
+            kube.deliver()   # flush anything not shard-routed
+            return tracker.drain("Pod") | tracker.drain("Node")
+
+        forward = run((shard_a, shard_b))
+        backward = run((shard_b, shard_a))
+        assert forward == backward
+        assert {"default/w-0", name_b} <= forward
+
+    @pytest.mark.parametrize("n_shards", [2, 8])
+    def test_shard_scoped_delivery_holds_other_shards(self, monkeypatch,
+                                                      n_shards):
+        """deliver(shard=s) drains ONLY s's stream — the other shard's
+        event stays queued (the per-shard logical stream contract the
+        ordering property above replays)."""
+        monkeypatch.setenv("KARPENTER_STATE_SHARDS", str(n_shards))
+        name_a, name_b = _names_in_distinct_shards(n_shards)
+        kube = KubeClient(async_delivery=True)
+        tracker = DirtyTracker(kube).watch("Node")
+        kube.create(Node(metadata=ObjectMeta(name=name_a)))
+        kube.create(Node(metadata=ObjectMeta(name=name_b)))
+        kube.deliver(shard=shard_of(name_a, n_shards))
+        assert tracker.drain("Node") == {name_a}
+        assert kube.pending_events(["Node"]) == 1
+        kube.deliver()
+        assert tracker.drain("Node") == {name_b}
+
+
+class TestScopedRelistWarmth:
+    """ISSUE-16 satellite (c): a shard-scoped relist-epoch bump leaves
+    other shards' retained rows warm."""
+
+    def _seam_over_fleet(self, monkeypatch, n_nodes: int = 24):
+        from karpenter_tpu.kube.real import (
+            InMemoryApiServer,
+            RealKubeClient,
+        )
+        from karpenter_tpu.state.retained import RetainedFleetSeam
+
+        monkeypatch.setenv("KARPENTER_KUBE_RELIST_MIN_MS", "0")
+        server = InMemoryApiServer()
+        kube = RealKubeClient(server)
+        user = RealKubeClient(server)
+        names = [f"n-{i}" for i in range(n_nodes)]
+        for name in names:
+            user.create(Node(metadata=ObjectMeta(name=name)))
+        kube.deliver()
+        seam = RetainedFleetSeam(kube, cluster=None)
+        seam.sync()          # absorb the create dirt
+        for name in names:
+            # seed warm rows directly: the warmth contract is about
+            # WHICH keys the scoped bust touches, not how rows build
+            seam._rows[name] = object()
+            seam._inputs[name] = object()
+            seam._built[name] = seam._ver.get(name, 0)
+        return kube, seam, names
+
+    def test_scoped_relist_keeps_other_shards_warm(self, monkeypatch):
+        kube, seam, names = self._seam_over_fleet(monkeypatch)
+        target = shard_of(names[0])
+        hit = [n for n in names if shard_of(n) == target]
+        warm = [n for n in names if shard_of(n) != target]
+        assert hit and warm   # 24 names over 8 crc32 shards: both sides
+        ver_before = {n: seam._ver.get(n, 0) for n in names}
+
+        kube._relist("Node", reason="watch_gone", shards=[target])
+        seam.sync()
+
+        for name in warm:
+            assert name in seam._rows, f"{name} lost its warm row"
+            assert seam._ver.get(name, 0) == ver_before[name]
+        for name in hit:
+            assert name not in seam._rows
+            assert seam._ver.get(name, 0) > ver_before[name]
+
+    def test_full_relist_busts_every_shard(self, monkeypatch):
+        kube, seam, names = self._seam_over_fleet(monkeypatch)
+        kube._relist("Node", reason="watch_gone")
+        seam.sync()
+        for name in names:
+            assert name not in seam._rows
+
+    def test_scoped_relist_metric_and_generations(self, monkeypatch):
+        from karpenter_tpu.metrics.store import STATE_SHARD_RELIST
+
+        kube, seam, names = self._seam_over_fleet(monkeypatch)
+        target = shard_of(names[0])
+        gens0 = dict(kube.relist_generations("Node"))
+        before = STATE_SHARD_RELIST.value(
+            {"kind": "Node", "shard": str(target)}
+        )
+        kube._relist("Node", reason="watch_gone", shards=[target])
+        gens1 = dict(kube.relist_generations("Node"))
+        assert gens1[target] == gens0.get(target, 0) + 1
+        assert {
+            s: g for s, g in gens1.items() if s != target
+        } == {s: g for s, g in gens0.items() if s != target}
+        assert STATE_SHARD_RELIST.value(
+            {"kind": "Node", "shard": str(target)}
+        ) == before + 1

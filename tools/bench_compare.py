@@ -71,6 +71,13 @@ LIVE_WALL_KEYS = (
 # mapped to the scope field that must read "arm" on BOTH sides before
 # the key gates — process-scoped peaks accumulate every earlier arm,
 # so a delta would fire on arm ordering, not memory
+# sharded-state-plane scale walls (ISSUE 16): top-level keys of the
+# live_operator_100k scenario, gated RELATIVE like WALL_KEYS but
+# null-tolerant and LOUD like LIVE_WALL_KEYS — a side that skipped the
+# arm (BENCH_LIVE_PODS=0, pre-ISSUE artifact) is reported, never gated
+SCALE_WALL_KEYS = (
+    "tick_p50_s_100k", "tick_p99_s_100k", "tick_p50_s_10k",
+)
 DEVICE_MEM_KEYS = {
     "compiled_peak_temp_mb": "compiled_scope",
     "device_peak_in_use_mb": "device_scope",
@@ -295,6 +302,29 @@ def compare(
                     regressions.append(tag)
                 else:
                     lines.append("  " + tag)
+        for key in SCALE_WALL_KEYS:
+            bv, cv = b.get(key), c.get(key)
+            if key not in b and key not in c:
+                continue
+            if not isinstance(bv, (int, float)) or bv <= 0:
+                if isinstance(cv, (int, float)):
+                    lines.append(
+                        f"  {name}.{key}: null -> {cv:.3f}s "
+                        "(new key; not gated)"
+                    )
+                continue
+            if not isinstance(cv, (int, float)):
+                lines.append(
+                    f"  {name}.{key}: {bv:.3f}s -> null "
+                    "(scale arm unavailable; not gated)"
+                )
+                continue
+            rel = cv / bv - 1.0
+            tag = f"{name}.{key}: {bv:.3f}s -> {cv:.3f}s ({rel:+.1%})"
+            if rel > threshold:
+                regressions.append(tag)
+            else:
+                lines.append("  " + tag)
         for gkey in GAP_KEYS:
             bv, cv = b.get(gkey), c.get(gkey)
             if not isinstance(bv, (int, float)):
