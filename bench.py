@@ -1677,13 +1677,16 @@ def scenario_live_operator_100k() -> dict:
             walls = sorted(walls)
             # audit probe: prove decision identity AT SCALE, outside
             # the measured steady window (the shadow solve is O(fleet)
-            # by design). audit_every is captured at construction, so
-            # the probe flips the live knob, not the env
+            # by design). audit_every is a live env knob (re-read per
+            # tick since ISSUE 17), so the probe flips the env
             inc = op.provisioner.incremental
-            inc.audit_every, saved_every = 1, inc.audit_every
             inc._since_audit = 1
-            _, now = churn_tick_wall_series(env, op, now, 2, churn_k)
-            inc.audit_every = saved_every
+            os.environ["KARPENTER_INCR_AUDIT_EVERY"] = "1"
+            try:
+                _, now = churn_tick_wall_series(env, op, now, 2,
+                                                churn_k)
+            finally:
+                os.environ["KARPENTER_INCR_AUDIT_EVERY"] = "0"
             incr = op.readyz()["incremental"]
             return {
                 "pods": n_pods,
@@ -1737,6 +1740,276 @@ def scenario_live_operator_100k() -> dict:
         "fallbacks": big["fallbacks"],
         "quarantined": big["quarantined"],
         "last_audit": big["last_audit"],
+    }
+
+
+def scenario_sustained_arrival_stream() -> dict:
+    """Event-driven reactive placement (ISSUE 17): a Poisson pod
+    arrival stream at 10k-pod scale, measured as arrival->bind
+    latency percentiles under two control arms over the SAME arrival
+    schedule and the same pre-warmed fleet:
+
+    - **reactive**: the live loop's shape — watch arrivals debounce
+      into micro-solves (the incremental tick's O(dirty) path), bind
+      plans drain on wake, full ticks demoted to a background
+      audit/repack cadence (BENCH_ARRIVAL_FULL_TICK_EVERY, default
+      5s);
+    - **periodic**: the legacy loop — a full operator step every 1s,
+      arrivals wait for the batcher.
+
+    Both arms run with the shadow-oracle audit forced on a cadence
+    (KARPENTER_INCR_AUDIT_EVERY=BENCH_ARRIVAL_AUDIT_EVERY, default 8)
+    — a live env knob since ISSUE 17's satellite — and report their
+    divergence deltas, which must be ZERO. The reactive arm also
+    reports the micro-solve outcome counts and the SLO engine's
+    pod_to_bind_latency verdict (burn must be 0).
+
+    Scale knobs: BENCH_ARRIVAL_PODS (default 10000; 0 disables),
+    BENCH_ARRIVAL_RATE (arrivals/s, default 100), BENCH_SEED."""
+    import random
+    import time as _time
+
+    from karpenter_tpu.cloudprovider.fake import GIB, make_instance_type
+    from karpenter_tpu.metrics import slo as _slo
+    from karpenter_tpu.metrics.store import (
+        INCREMENTAL_DIVERGENCE,
+        MICRO_SOLVE,
+    )
+    from karpenter_tpu.operator.operator import Operator
+    from karpenter_tpu.operator.options import Options
+    from karpenter_tpu.testing import Environment, mk_nodepool, mk_pod
+
+    n_pods = int(os.environ.get("BENCH_ARRIVAL_PODS", "10000"))
+    if n_pods <= 0:
+        return {"skipped": True}
+    rate = float(os.environ.get("BENCH_ARRIVAL_RATE", "100"))
+    audit_every = os.environ.get("BENCH_ARRIVAL_AUDIT_EVERY", "8")
+    full_every = float(
+        os.environ.get("BENCH_ARRIVAL_FULL_TICK_EVERY", "5")
+    )
+    seed = int(os.environ.get("BENCH_SEED", "42"))
+
+    def _with_env(env_overrides: dict, fn):
+        saved = {k: os.environ.get(k) for k in env_overrides}
+        os.environ.update(env_overrides)
+        try:
+            return fn()
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    # one Poisson schedule, shared by both arms (identical offered load)
+    rng = random.Random(seed)
+    offsets = []
+    t = 0.0
+    for _ in range(n_pods):
+        t += rng.expovariate(rate)
+        offsets.append(t)
+    duration = offsets[-1]
+
+    arrival_cpu = 0.1
+
+    def build():
+        """Pre-warmed fleet: big nodes bought by pinned warm pods,
+        with enough free room that the arrival stream lands on
+        EXISTING capacity — the micro path's placement case. The
+        incremental envelope is warmed with a couple of periodic
+        solves so the measured window never pays the cold bail."""
+        types = [make_instance_type("c64", cpu=64, memory=256 * GIB,
+                                    price=1.0)]
+        env = Environment(types=types)
+        pool = mk_nodepool("arrival")
+        pool.spec.disruption.consolidate_after = "Never"
+        env.kube.create(pool)
+        # sized so the whole stream lands on EXISTING capacity: enough
+        # free cpu AND enough per-node pod slots (110/node default)
+        warm_nodes = max(
+            4,
+            int(n_pods * arrival_cpu / 30.0) + 3,
+            n_pods // 100 + 3,
+        )
+        env.provision(*[
+            mk_pod(name=f"warm-{i}", cpu=33.0, memory=8 * GIB)
+            for i in range(warm_nodes)
+        ])
+        op = Operator(kube=env.kube, cloud_provider=env.cloud,
+                      options=Options())
+        now = _time.time()
+        for i in range(4):
+            op.step(now=now + i * 2.0)
+        now += 10.0
+        for r in range(3):   # warm the incremental path
+            env.kube.create(mk_pod(name=f"warmup-{r}", cpu=arrival_cpu,
+                                   memory=256 * 2**20))
+            op.provisioner.batcher.trigger(now=now)
+            op.step(now=now)
+            now += 2.0
+            op.step(now=now)
+            now += 2.0
+        return env, op, now
+
+    def _mk_arrival(i: int, stamp: float):
+        pod = mk_pod(name=f"arr-{i:05d}", cpu=arrival_cpu,
+                     memory=256 * 2**20)
+        pod.metadata.creation_timestamp = stamp
+        return pod
+
+    def _unbound_arrivals(env) -> int:
+        return sum(
+            1 for p in env.kube.pods()
+            if not p.spec.node_name
+            and p.metadata.name.startswith("arr-")
+        )
+
+    def _percentiles(lats: list) -> tuple[float, float]:
+        if not lats:
+            return 0.0, 0.0
+        lats = sorted(lats)
+        return (
+            lats[len(lats) // 2],
+            lats[min(len(lats) - 1, int(0.99 * len(lats)))],
+        )
+
+    def _micro_counts() -> dict:
+        out = {}
+        for labels, value in MICRO_SOLVE.samples():
+            out[dict(labels).get("outcome", "")] = int(value)
+        return out
+
+    def run_reactive() -> dict:
+        env, op, now0 = build()
+        h0 = len(op._pending_bindings.history)
+        div0 = INCREMENTAL_DIVERGENCE.total()
+        m0 = _micro_counts()
+        _slo.reset_last_digest()
+        i = 0
+        t = now0
+        next_full = now0
+        hard_stop = now0 + duration + 120.0
+        full_ticks = micro_steps = 0
+        while t < hard_stop:
+            cands = [next_full]
+            if i < n_pods:
+                cands.append(now0 + offsets[i])
+            md = op.reactive.next_deadline(t)
+            if md is not None:
+                cands.append(md)
+            t = max(t, min(cands))
+            if i < n_pods and now0 + offsets[i] <= t:
+                op.reactive.observe_now(t)
+                while i < n_pods and now0 + offsets[i] <= t:
+                    env.kube.create(_mk_arrival(i, now0 + offsets[i]))
+                    i += 1
+            if t >= next_full:
+                op.step(now=t)
+                full_ticks += 1
+                next_full = t + full_every
+                if i >= n_pods and not _unbound_arrivals(env):
+                    break
+            else:
+                op.micro_step(now=t)
+                micro_steps += 1
+        lats = list(op._pending_bindings.history)[h0:]
+        p50, p99 = _percentiles(lats)
+        digest = _slo.last_digest() or {}
+        bind_verdict = (digest.get("verdicts") or {}).get(
+            "pod_to_bind_latency", {}
+        )
+        m1 = _micro_counts()
+        return {
+            "pod_to_bind_p50_s": round(p50, 4),
+            "pod_to_bind_p99_s": round(p99, 4),
+            "bound": len(lats),
+            "unbound_arrivals": _unbound_arrivals(env),
+            "full_ticks": full_ticks,
+            "micro_steps": micro_steps,
+            "micro_solves": {
+                k: m1.get(k, 0) - m0.get(k, 0)
+                for k in set(m0) | set(m1)
+            },
+            "oracle_divergences": int(
+                INCREMENTAL_DIVERGENCE.total() - div0
+            ),
+            "slo_bind_burn_long": bind_verdict.get("burn_long"),
+            "slo_bind_state": bind_verdict.get("state"),
+            "micro_rollup": op.provisioner.incremental.status()["micro"],
+        }
+
+    def run_periodic() -> dict:
+        env, op, now0 = build()
+        h0 = len(op._pending_bindings.history)
+        div0 = INCREMENTAL_DIVERGENCE.total()
+        i = 0
+        t = now0
+        hard_stop = now0 + duration + 120.0
+        while t < hard_stop:
+            t += 1.0
+            if i < n_pods and now0 + offsets[i] <= t:
+                op.reactive.observe_now(t)
+                while i < n_pods and now0 + offsets[i] <= t:
+                    env.kube.create(_mk_arrival(i, now0 + offsets[i]))
+                    i += 1
+                op.provisioner.batcher.trigger(now=t)
+            op.step(now=t)
+            if i >= n_pods and not _unbound_arrivals(env):
+                break
+        lats = list(op._pending_bindings.history)[h0:]
+        p50, p99 = _percentiles(lats)
+        return {
+            "pod_to_bind_p50_s": round(p50, 4),
+            "pod_to_bind_p99_s": round(p99, 4),
+            "bound": len(lats),
+            "unbound_arrivals": _unbound_arrivals(env),
+            "oracle_divergences": int(
+                INCREMENTAL_DIVERGENCE.total() - div0
+            ),
+        }
+
+    shared = {
+        "KARPENTER_INCREMENTAL": "1",
+        "KARPENTER_REACTIVE": "1",
+        "KARPENTER_INCR_AUDIT_EVERY": audit_every,
+        # equal-churn absolute counts (the live_operator_100k arm's
+        # convention): a sustained stream into a small warm fleet makes
+        # early batches a large FRACTION of the fleet, and the churn
+        # backstop would shunt them to the slow path in both arms
+        "KARPENTER_INCR_CHURN_MAX": "1.0",
+        # latency-focused micro window: idle-close fast, bound the
+        # window well under the periodic arm's 1s cadence
+        "KARPENTER_MICRO_DEBOUNCE_MS": os.environ.get(
+            "BENCH_ARRIVAL_DEBOUNCE_MS", "20"
+        ),
+        "KARPENTER_MICRO_MAX_WAIT_MS": os.environ.get(
+            "BENCH_ARRIVAL_MAX_WAIT_MS", "100"
+        ),
+    }
+    reactive = _with_env(shared, run_reactive)
+    periodic = _with_env(shared, run_periodic)
+    r_p99 = reactive["pod_to_bind_p99_s"]
+    r_p50 = reactive["pod_to_bind_p50_s"]
+    return {
+        "pods": n_pods,
+        "rate_per_s": rate,
+        "duration_s": round(duration, 2),
+        "full_tick_every_s": full_every,
+        "audit_every": int(audit_every),
+        "reactive": reactive,
+        "periodic": periodic,
+        "p50_speedup": (
+            round(periodic["pod_to_bind_p50_s"] / r_p50, 2)
+            if r_p50 > 0 else 0.0
+        ),
+        "p99_speedup": (
+            round(periodic["pod_to_bind_p99_s"] / r_p99, 2)
+            if r_p99 > 0 else 0.0
+        ),
+        "oracle_divergences": (
+            reactive["oracle_divergences"]
+            + periodic["oracle_divergences"]
+        ),
     }
 
 
@@ -2173,6 +2446,7 @@ def main() -> int:
         "overload_surge": scenario_overload_surge,
         "million_pod": scenario_million_pod,
         "live_operator_100k": scenario_live_operator_100k,
+        "sustained_arrival_stream": scenario_sustained_arrival_stream,
     }
     if only:
         wanted = set(only.split(","))

@@ -49,6 +49,10 @@ class DirtyTracker:
         self.kube = kube
         self._sets: dict[str, set[str]] = {}
         self._watched: set[str] = set()
+        # dirty-wake hooks (ISSUE 17): cheap callables fired when a
+        # watched kind gains a dirty key, so an event-driven loop can
+        # sleep on an Event instead of polling peek()
+        self._hooks: list[Callable[[], None]] = []
         # last relist generation observed per kind (clients that never
         # relist — the in-memory substrate — simply never advance it)
         self._relist_gen: dict[str, int] = {}
@@ -69,8 +73,18 @@ class DirtyTracker:
                     self._sets[_k].add(obj.key)
                 else:
                     self._sets[_k].update(_key(event, obj))
+                for hook in self._hooks:
+                    hook()
 
             self.kube.watch(kind, handler)
+        return self
+
+    def on_dirty(self, hook: Callable[[], None]) -> "DirtyTracker":
+        """Register a cheap, exception-free callable (e.g.
+        threading.Event.set) fired on every event a watched kind
+        receives — the reactive wake seam for consumers that sleep
+        between ticks and only want to run when O(dirty) work exists."""
+        self._hooks.append(hook)
         return self
 
     def mark(self, kind: str, key: str) -> None:

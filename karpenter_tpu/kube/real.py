@@ -312,6 +312,16 @@ class _KindWatch:
             return
         with self._lock:
             self._queue.append((etype, obj, rv))
+        # reactive wake (ISSUE 17): tell the embedder an event is
+        # queued so its live loop runs deliver() now instead of
+        # sleeping the tick interval out. Fired outside the lock; the
+        # hook must be cheap and thread-safe (threading.Event.set)
+        hook = getattr(self.transport, "on_watch_event", None)
+        if hook is not None:
+            try:
+                hook(self.kind)
+            except Exception:
+                pass
 
 
 class HTTPTransport:
@@ -344,6 +354,13 @@ class HTTPTransport:
         self._gone_pending: set[str] = set()  # kinds owing a 410
         self._streams_lock = threading.Lock()
         self._list_cache: dict[str, dict] = {}  # path -> last LIST body
+        # queued-event hook (ISSUE 17): the watch reader threads call
+        # this (with the kind) the moment an event lands, so an
+        # event-driven embedder can wake its loop sub-tick
+        self.on_watch_event = None
+
+    def set_event_hook(self, hook) -> None:
+        self.on_watch_event = hook
 
     def _bearer(self) -> str:
         if self.token_file:
@@ -821,6 +838,12 @@ class RealKubeClient:
         self._shard_relist_gen: dict[str, list[int]] = {
             k: [0] * self._shards for k in self._shard_rv
         }
+        # reactive wake seam (ISSUE 17): called whenever events are
+        # known to be pending delivery — from the transport's watch
+        # reader threads (async) and from self-originated writes'
+        # _announce (sync) — so the operator's live loop can sleep on
+        # an Event instead of polling deliver()
+        self._event_pending_hook = None
         # deletion tombstones (kind -> key -> deletion rv), recorded
         # only while shard cursors are divergent: a behind shard's
         # replay of a pre-delete MODIFIED must not resurrect a key a
@@ -1131,6 +1154,14 @@ class RealKubeClient:
             self._index_pod(obj)
             self._pending_events.append((kind, event, obj))
 
+    def set_event_pending_hook(self, hook) -> None:
+        """Register a cheap thread-safe callable fired whenever watch
+        events are pending delivery (the operator's reactive wake)."""
+        self._event_pending_hook = hook
+        forward = getattr(self.transport, "set_event_hook", None)
+        if forward is not None and hook is not None:
+            forward(lambda _kind: hook())
+
     def watch(self, kind: str, handler: WatchHandler) -> None:
         with self._lock:
             self._watchers.setdefault(kind, []).append(handler)
@@ -1278,6 +1309,11 @@ class RealKubeClient:
         on it — DirtyTracker, state informers, the batcher hook)."""
         with self._lock:
             self._pending_events.append((kind, event, obj))
+        if self._event_pending_hook is not None:
+            try:
+                self._event_pending_hook()
+            except Exception:
+                pass
 
     def create(self, obj):
         self._push("POST", obj, _path(obj.kind, namespace=obj.metadata.namespace))

@@ -128,8 +128,13 @@ def _admission(signals: dict) -> Optional[tuple[float, float]]:
 
 
 def _bind_latency(signals: dict) -> Optional[tuple[float, float]]:
-    # p99 arrival->bind wall from the binding queue's enqueue stamps;
-    # absent when the tick bound nothing (data-free, not "good")
+    # p99 arrival->bind wall: with the reactive plane on, measured
+    # from the WATCH-STREAM arrival stamp (the pod's first sighting),
+    # so the SLI covers debounce + micro-solve + bind — the headline
+    # number event-driven placement exists to shrink. Absent when the
+    # tick bound nothing (data-free, not "good"). The tick's signal
+    # dict also carries pod_to_bind_p50_s for dashboards/bench; the
+    # objective gates on the tail
     p99 = signals.get("pod_to_bind_p99_s")
     if p99 is None:
         return None
@@ -148,7 +153,13 @@ def _optimality(signals: dict) -> Optional[tuple[float, float]]:
     )
 
 
+# pod_to_bind_latency leads: with reactive placement (ISSUE 17) the
+# arrival->bind tail is THE user-facing objective the control plane is
+# shaped around — everything else guards how it is achieved
 DEFAULT_SLIS: tuple[SLI, ...] = (
+    SLI("pod_to_bind_latency",
+        "p99 pod arrival->bind wall under KARPENTER_SLO_BIND_P99_S",
+        0.99, _bind_latency),
     SLI("tick_latency",
         "operator tick wall under KARPENTER_SLO_TICK_BUDGET_MS",
         0.99, _tick_latency),
@@ -161,9 +172,6 @@ DEFAULT_SLIS: tuple[SLI, ...] = (
     SLI("admission",
         "zero pods shed by priority admission",
         0.95, _admission),
-    SLI("pod_to_bind_latency",
-        "p99 pod arrival->bind wall under KARPENTER_SLO_BIND_P99_S",
-        0.99, _bind_latency),
     SLI("optimality",
         "gap_vs_lp under KARPENTER_SLO_GAP_MAX on cost solves",
         0.90, _optimality),

@@ -232,6 +232,23 @@ INCREMENTAL_FINGERPRINT_AGE = REGISTRY.gauge(
     "Incremental ticks served since the retained fleet state was last "
     "rebuilt from scratch — the staleness horizon the oracle audit "
     "bounds")
+# reactive placement (operator/reactive.py + Operator.micro_step): the
+# event-driven sub-tick arrival→bind path (ISSUE 17)
+MICRO_SOLVE = REGISTRY.counter(
+    "karpenter_micro_solve_total",
+    "Event-driven micro-solves, by outcome (served: bind plans "
+    "enqueued from the O(dirty) incremental path; deferred: the "
+    "envelope routed the batch to the next full tick; empty: the "
+    "debounced batch resolved to nothing live to place)")
+MICRO_BATCH_SIZE = REGISTRY.histogram(
+    "karpenter_micro_batch_size",
+    "Pod arrivals per debounced micro-solve batch",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024))
+MICRO_DEBOUNCE_LATENCY = REGISTRY.histogram(
+    "karpenter_micro_debounce_latency_seconds",
+    "Oldest-arrival age when a debounced micro batch fires — the "
+    "queueing delay the debounce window itself adds to arrival→bind",
+    buckets=(0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5))
 DISRUPTION_SCAN_SKIPPED = REGISTRY.counter(
     "karpenter_disruption_scan_skipped_total",
     "Disruption reconcile rounds skipped because nothing went dirty "

@@ -32,6 +32,7 @@ list behavior: `append(results)` enqueues under the results' own
 from __future__ import annotations
 
 import time
+from collections import deque
 from typing import Callable, Optional
 
 from karpenter_tpu.metrics.store import STATE_SHARD_QUEUE_PENDING
@@ -39,14 +40,23 @@ from karpenter_tpu.state.shards import shard_count, shard_of
 
 
 class _Item:
-    __slots__ = ("results", "enqueued_at", "done")
+    __slots__ = ("results", "enqueued_at", "done", "arrivals")
 
-    def __init__(self, results, enqueued_at: float):
+    def __init__(self, results, enqueued_at: float, arrivals=None):
         self.results = results
         self.enqueued_at = enqueued_at
         # pod keys whose binding reached a terminal outcome; never
         # re-examined on later drains
         self.done: set[str] = set()
+        # pod key -> watch-stream arrival stamp (ISSUE 17): when the
+        # reactive plane saw the pod first. Bind latency is measured
+        # from here so the SLI covers the wait-for-solve, not just the
+        # queue residency; pods without a stamp (command plans, pods
+        # predating the plane's clock) fall back to enqueued_at.
+        self.arrivals: dict[str, float] = arrivals or {}
+
+    def latency_start(self, pod_key: str) -> float:
+        return self.arrivals.get(pod_key, self.enqueued_at)
 
     @property
     def deadline(self) -> float:
@@ -65,15 +75,21 @@ class BindingQueue:
         cluster,
         bind_one: Callable[[object, str], bool],
         requeue: Callable[[float], None],
+        on_enqueue: Optional[Callable[[], None]] = None,
     ):
         self.kube = kube
         self.cluster = cluster
         self._bind_one = bind_one
         self._requeue = requeue
+        # wake-on-enqueue (ISSUE 17): the live loop drains a fresh plan
+        # immediately instead of sleeping the tick interval out
+        self._on_enqueue = on_enqueue
         self._shards = shard_count()
         self._items: list[_Item] = []
         # arrival->bind walls of binds since the last take_latencies()
         self._latencies: list[float] = []
+        # full-run latency ledger (bench p50/p99; bounded)
+        self.history: deque[float] = deque(maxlen=200_000)
 
     # -- list compatibility (operator internals + tests) ---------------
 
@@ -90,13 +106,37 @@ class BindingQueue:
 
     # -- queue API -----------------------------------------------------
 
-    def enqueue(self, results, now: float, ttl: float) -> None:
+    def enqueue(self, results, now: float, ttl: float, arrivals=None) -> None:
         results.bind_deadline = now + ttl
-        self._items.append(_Item(results, now))
+        self._items.append(_Item(results, now, arrivals))
+        if self._on_enqueue is not None:
+            self._on_enqueue()
 
     def take_latencies(self) -> list[float]:
         out, self._latencies = self._latencies, []
         return out
+
+    def planned_pod_keys(self) -> set[str]:
+        """Pod keys a held plan already covers (O(pending)): the
+        micro-solve path filters these so an arrival never gets two
+        competing placements while its plan is materializing."""
+        keys: set[str] = set()
+        for item in self._items:
+            results = item.results
+            for plan in results.new_node_plans:
+                for pod in plan.pods:
+                    if pod.key not in item.done:
+                        keys.add(pod.key)
+            for pods in results.existing_assignments.values():
+                for pod in pods:
+                    if pod.key not in item.done:
+                        keys.add(pod.key)
+        return keys
+
+    def _record_latency(self, now: float, item: _Item, pod_key: str) -> None:
+        latency = max(0.0, now - item.latency_start(pod_key))
+        self._latencies.append(latency)
+        self.history.append(latency)
 
     def drain(self, now: float) -> tuple[int, int]:
         """One binding pass. Returns (bound, held_plans). Results are
@@ -165,9 +205,7 @@ class BindingQueue:
                         if self._bind_one(live, node_name):
                             bound += 1
                             done.add(pod.key)
-                            self._latencies.append(
-                                max(0.0, now - item.enqueued_at)
-                            )
+                            self._record_latency(now, item, pod.key)
                         else:
                             unbound = True
                             hold(target)
@@ -219,9 +257,7 @@ class BindingQueue:
                         if self._bind_one(live, target):
                             bound += 1
                             done.add(pod.key)
-                            self._latencies.append(
-                                max(0.0, now - item.enqueued_at)
-                            )
+                            self._record_latency(now, item, pod.key)
                         else:
                             unbound = True
                             hold(target)

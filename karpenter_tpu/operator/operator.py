@@ -202,6 +202,12 @@ class Operator:
         ):
             if value and env_key not in _os.environ:
                 _os.environ[env_key] = str(value)
+        # reactive placement plane (ISSUE 17): debounces watch-stream
+        # pod arrivals into micro-solve batches and owns the
+        # arrival-stamp ledger behind the arrival->bind SLI
+        from karpenter_tpu.operator.reactive import ReactivePlane
+
+        self.reactive = ReactivePlane()
         # plans whose pods await binding (the kube-scheduler's job in a
         # real cluster; this runtime owns the whole substrate, so it
         # binds pods to the nodes the solver placed them on). Sharded
@@ -211,6 +217,7 @@ class Operator:
         self._pending_bindings = BindingQueue(
             self.kube, self.cluster, self._bind_one,
             lambda t: self.provisioner.batcher.trigger(now=t),
+            on_enqueue=self.reactive.wake.set,
         )
         # crash/restart convergence: the first tick rebuilds in-flight
         # intent from the API alone (see _recover)
@@ -219,11 +226,42 @@ class Operator:
 
         # pod/node watch events drive the provisioning batcher
         # (provisioning/controller.go PodController/NodeController)
+        # and the reactive plane: an unbound arrival opens/extends the
+        # micro-solve debounce window; a bound pod vanishing frees
+        # capacity that deferred demand should retry against
         def on_pod_event(event: str, pod) -> None:
             if event in ("ADDED", "MODIFIED") and not pod.spec.node_name:
                 self.provisioner.batcher.trigger()
+                self.reactive.note_arrival(
+                    pod.key,
+                    stamp=self.reactive.clamp_stamp(
+                        pod.metadata.creation_timestamp
+                    ),
+                )
+            elif event == "MODIFIED" and pod.spec.node_name:
+                # bound (by us or anyone): a pending arrival is moot
+                self.reactive.discard(pod.key)
+            elif event == "DELETED":
+                if pod.spec.node_name:
+                    self.reactive.note_capacity_freed()
+                self.reactive.forget(pod.key)
 
         self.kube.watch("Pod", on_pod_event)
+
+        # claim registration is a capacity-freeing event too: planned
+        # capacity materializing should wake the bind drain, and any
+        # demand the envelope deferred can retry against it
+        def on_claim_event(event: str, claim) -> None:
+            if event == "MODIFIED" and claim.status.node_name:
+                self.reactive.note_capacity_freed()
+
+        self.kube.watch("NodeClaim", on_claim_event)
+        # async transports (RealKubeClient's watch pump) expose a
+        # queued-event hook: wake the live loop so deliver() runs now
+        # instead of after the sleep; synchronous stores don't need it
+        hook = getattr(self.kube, "set_event_pending_hook", None)
+        if hook is not None:
+            hook(self.reactive.wake.set)
 
         # Incremental disruption gate: the engine's candidate scan +
         # probe ladder is O(fleet) even when it decides nothing. When
@@ -238,7 +276,7 @@ class Operator:
 
         self._disruption_dirty = DirtyTracker(self.kube).watch(
             "Node", "NodeClaim", "Pod", "NodePool", "PodDisruptionBudget"
-        )
+        ).on_dirty(self.reactive.wake.set)
         self._disruption_idle = False    # last round found nothing
         self._disruption_catalog_fp = None
         self._last_forced_disruption = 0.0
@@ -356,10 +394,16 @@ class Operator:
             signals["pod_to_bind_p99_s"] = lats[
                 min(len(lats) - 1, int(0.99 * len(lats)))
             ]
+            signals["pod_to_bind_p50_s"] = lats[
+                min(len(lats) - 1, int(0.50 * len(lats)))
+            ]
         signals.update(_slo.take_noted())
         self.slo.observe_tick(signals)
 
     def _step(self, now: float) -> None:
+        # the reactive plane's clock advances before the informer pump
+        # so arrivals delivered this tick stamp against a live now
+        self.reactive.observe_now(now)
         # informer pump: under async delivery, queued watch events land
         # at tick start, so every controller in the tick reads one
         # consistent (possibly one-tick-stale) mirror — the informer
@@ -370,6 +414,11 @@ class Operator:
             return  # standby replica: keep the mirror warm, do nothing
         if not self._recovered:
             self._recover(now)
+        if self.reactive.take_capacity_freed():
+            # freed/registered capacity: demand the envelope deferred
+            # (or a solve shed) retries without waiting for the
+            # periodic pending-scan backstop
+            self.provisioner.batcher.trigger(now=now)
         if self.overlay_controller is not None:
             # overlay snapshot before anything consumes instance types
             self.overlay_controller.reconcile(now=now)
@@ -393,6 +442,9 @@ class Operator:
                 gc.freeze()
             self.hydration.reconcile_all()
             self.nodepool_status.reconcile_all(now=now)
+            # arrival-stamp ledger hygiene: stamps for pods that shed
+            # and never bound age out at resync cadence (O(backlog))
+            self.reactive.prune(now)
         else:
             self.hydration.reconcile_dirty()
             self.nodepool_status.reconcile_dirty(now=now)
@@ -542,6 +594,85 @@ class Operator:
             self.nodepool_metrics.reconcile_all(now=now)
             self.status_condition_metrics.reconcile_all(now=now)
 
+    # -- reactive micro-solve (ISSUE 17) ---------------------------------------
+
+    def micro_step(self, now: Optional[float] = None) -> Optional[dict]:
+        """Sub-tick arrival->bind round: deliver queued watch events,
+        and when the reactive plane's debounce window closed, route the
+        batch through the incremental tick's O(dirty) micro path and
+        straight into the binding queue. Anything the envelope defers
+        (cold cache, churn, ineligible shape, quarantine, priority
+        pressure) re-arms the batcher for the next full tick — the
+        micro path NEVER runs the full solver.
+
+        Returns a small digest dict when a batch fired (the chaos
+        suite's debounce-determinism test replays these), else None.
+        Deterministic under the injectable `now`; crash faults
+        propagate exactly like step()'s (the restart harness catches
+        OperatorCrashError mid-micro-solve)."""
+        from karpenter_tpu.metrics.store import (
+            MICRO_BATCH_SIZE,
+            MICRO_DEBOUNCE_LATENCY,
+            MICRO_SOLVE,
+        )
+        from karpenter_tpu.operator.reactive import reactive_enabled
+
+        now = time.time() if now is None else now
+        self.reactive.observe_now(now)
+        self.kube.deliver()
+        if not reactive_enabled():
+            return None
+        if self.leader_election and not self.elector.is_leader():
+            return None  # standby: the lease is renewed on full ticks
+        if not self._recovered:
+            return None  # the first FULL tick owns crash recovery
+        if self.reactive.take_capacity_freed():
+            self.provisioner.batcher.trigger(now=now)
+        # drain plans whose nodes materialized since the last round:
+        # wake-on-enqueue lands here long before the next full tick
+        self._bind_pending(now=now)
+        if not self.reactive.ready(now):
+            return None
+        batch = self.reactive.take_batch(now)
+        planned = self._pending_bindings.planned_pod_keys()
+        pods = []
+        for key in batch["keys"]:
+            ns, _, name = key.partition("/")
+            live = self.kube.get_pod(ns, name)
+            if live is None:
+                self.reactive.forget(key)  # gone before the window shut
+                continue
+            if live.spec.node_name or key in planned:
+                continue  # already home, or a held plan covers it
+            pods.append(live)
+        MICRO_BATCH_SIZE.observe(float(len(batch["keys"])))
+        MICRO_DEBOUNCE_LATENCY.observe(batch["debounce_latency"])
+        digest = {
+            "batch": list(batch["keys"]),
+            "solved": len(pods),
+            "debounce_latency": batch["debounce_latency"],
+            "outcome": "empty",
+        }
+        if not pods:
+            MICRO_SOLVE.inc({"outcome": "empty"})
+            return digest
+        with tracing.trace("micro"), \
+                tracing.span("solve.micro", batch=len(pods)):
+            results = self.provisioner.micro_solve(pods, now=now)
+        if results is None:
+            # deferred: the periodic path owns the batch — stamps stay
+            # in the ledger so the full tick's plan still measures
+            # arrival->bind from the original sighting
+            MICRO_SOLVE.inc({"outcome": "deferred"})
+            self.provisioner.batcher.trigger(now=now)
+            digest["outcome"] = "deferred"
+            return digest
+        MICRO_SOLVE.inc({"outcome": "served"})
+        self._enqueue_bindings(results, now, BIND_RESULTS_TTL_SECONDS)
+        self._bind_pending(now=now)
+        digest["outcome"] = "served"
+        return digest
+
     def _skip_disruption_scan(self, now: float) -> bool:
         """True when this poll's disruption scan provably repeats the
         last empty-handed one (see the gate's construction in
@@ -678,8 +809,25 @@ class Operator:
                     "re-enqueued", pod.key, node_name, status)
         return False
 
-    def _enqueue_bindings(self, results, now: float, ttl: float) -> None:
-        self._pending_bindings.enqueue(results, now, ttl)
+    def _enqueue_bindings(self, results, now: float, ttl: float,
+                          arrivals: Optional[dict] = None) -> None:
+        """Queue a plan for binding. Arrival stamps for the covered
+        pods are consumed from the reactive plane (O(plan pods)) so
+        `pod_to_bind_latency` measures from watch-stream arrival on
+        BOTH paths — micro-solve and periodic — not from enqueue."""
+        if arrivals is None:
+            keys = [
+                p.key
+                for plan in results.new_node_plans
+                for p in plan.pods
+            ]
+            keys += [
+                p.key
+                for pods in results.existing_assignments.values()
+                for p in pods
+            ]
+            arrivals = self.reactive.consume_stamps(keys)
+        self._pending_bindings.enqueue(results, now, ttl, arrivals=arrivals)
 
     def _bind_pending(self, now: Optional[float] = None) -> None:
         """Bind pods from completed scheduling results to their target
@@ -742,6 +890,10 @@ class Operator:
             # retained-state fingerprint + age, quarantine state,
             # per-reason full-path fallback rollup
             "incremental": self.provisioner.incremental.status(),
+            # reactive placement plane (ISSUE 17): debounce-window
+            # backlog + arrival-stamp ledger size; micro-solve
+            # serve/defer counts live under "incremental"."micro"
+            "reactive": self.reactive.status(),
             # retained disruption snapshots (ISSUE 15): row reuse hit
             # rate + identity-audit verdicts for the fleet seam every
             # candidate scan and simulation consumes
@@ -842,41 +994,93 @@ class Operator:
             server.stop()
             self._observability = None
 
+    def _full_tick_every(self, tick_seconds: float) -> float:
+        """Seconds between FULL ticks. Legacy cadence (every
+        `tick_seconds`) unless the reactive plane owns the loop and
+        KARPENTER_FULL_TICK_EVERY demotes full ticks to a background
+        audit/repack cadence. Re-read per loop iteration (satellite-1
+        discipline: cadence knobs are live, never construction-frozen)."""
+        from karpenter_tpu.operator.reactive import (
+            ENV_FULL_TICK_EVERY,
+            _env_float,
+            reactive_enabled,
+        )
+
+        if not reactive_enabled():
+            return tick_seconds
+        every = _env_float(ENV_FULL_TICK_EVERY, 0.0)
+        return every if every > 0 else tick_seconds
+
     def run(self, stop_after: Optional[float] = None, tick_seconds: float = 1.0,
             serve: bool = False, should_stop=None) -> None:
-        """Wall-clock loop (operator.Start). `stop_after` bounds the
-        run for embedding in tests/sims; `serve=True` mounts the
-        observability endpoints for the duration of the loop (opt-in:
-        embedders must not grow a listening port as a side effect —
-        the binary serves explicitly); `should_stop` is polled each
-        tick (signal handlers)."""
+        """Wall-clock loop (operator.Start). With the reactive plane
+        enabled the loop is EVENT-DRIVEN: between full ticks it sleeps
+        on the plane's wake event and runs `micro_step` when watch
+        traffic (or a bind-plan enqueue) arrives, so arrival->bind is
+        bounded by the debounce window, not the tick interval. Full
+        `step()` ticks keep running every `tick_seconds` (or every
+        KARPENTER_FULL_TICK_EVERY seconds when set) as the background
+        audit/repack/disruption cadence and shadow-oracle safety net.
+
+        `stop_after` bounds the run for embedding in tests/sims;
+        `serve=True` mounts the observability endpoints for the
+        duration of the loop (opt-in: embedders must not grow a
+        listening port as a side effect — the binary serves
+        explicitly); `should_stop` is polled each iteration (signal
+        handlers)."""
         if serve:
             self.serve_observability()
         self._tick_interval = tick_seconds
         try:
             deadline = None if stop_after is None else time.time() + stop_after
             first_tick = True
+            next_full = time.time()
             while deadline is None or time.time() < deadline:
                 if should_stop is not None and should_stop():
                     break
-                self.step()
-                if first_tick:
-                    first_tick = False
-                    # Long-lived-service GC hygiene, AFTER the first
-                    # tick so the synced cluster mirror and the first
-                    # solve's jitted kernels exist: move them to the
-                    # permanent generation so CPython's stop-the-world
-                    # gen-2 scans stop re-walking ~1M mirror objects
-                    # on every threshold crossing (the Go reference's
-                    # GC is concurrent, so it never pays this).
-                    # Per-reconcile garbage is still collected, and
-                    # full-resync ticks unfreeze+collect+refreeze so
-                    # replaced first-tick objects in cycles are
-                    # reclaimed at resync cadence (see step()).
-                    gc.collect()
-                    gc.freeze()
-                    self._gc_frozen = True
-                time.sleep(tick_seconds)
+                now = time.time()
+                if now >= next_full:
+                    self.step()
+                    next_full = time.time() + self._full_tick_every(
+                        tick_seconds
+                    )
+                    if first_tick:
+                        first_tick = False
+                        # Long-lived-service GC hygiene, AFTER the
+                        # first tick so the synced cluster mirror and
+                        # the first solve's jitted kernels exist: move
+                        # them to the permanent generation so CPython's
+                        # stop-the-world gen-2 scans stop re-walking
+                        # ~1M mirror objects on every threshold
+                        # crossing (the Go reference's GC is
+                        # concurrent, so it never pays this).
+                        # Per-reconcile garbage is still collected, and
+                        # full-resync ticks unfreeze+collect+refreeze
+                        # so replaced first-tick objects in cycles are
+                        # reclaimed at resync cadence (see step()).
+                        gc.collect()
+                        gc.freeze()
+                        self._gc_frozen = True
+                else:
+                    self.micro_step(now)
+                # sleep until whichever comes first: the next full
+                # tick, the plane's next debounce deadline, or the run
+                # deadline — interruptible by the wake event so a
+                # watch burst or bind-plan enqueue is handled NOW
+                now = time.time()
+                wake_at = next_full
+                micro_deadline = self.reactive.next_deadline(now)
+                if micro_deadline is not None:
+                    wake_at = min(wake_at, micro_deadline)
+                if deadline is not None:
+                    wake_at = min(wake_at, deadline)
+                timeout = max(0.0, min(wake_at - now, tick_seconds))
+                if timeout <= 0:
+                    # floor: a batch that is ready but unconsumable
+                    # (standby replica, disabled plane) must not spin
+                    timeout = min(tick_seconds, 0.005)
+                self.reactive.wake.wait(timeout)
+                self.reactive.wake.clear()
         finally:
             if serve:
                 self.stop_observability()

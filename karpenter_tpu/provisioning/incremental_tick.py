@@ -117,6 +117,7 @@ from karpenter_tpu.solver import faults, topo_batch
 from karpenter_tpu.solver.encode import encode, group_pods
 from karpenter_tpu.solver.incremental import (
     _env_float,
+    _env_on,
     catalog_fingerprint,
 )
 from karpenter_tpu.solver.solver import solve_encoded
@@ -127,6 +128,10 @@ log = logging.getLogger("karpenter.incremental")
 ENV_ENABLE = "KARPENTER_INCREMENTAL"
 ENV_AUDIT_EVERY = "KARPENTER_INCR_AUDIT_EVERY"
 ENV_CHURN_MAX = "KARPENTER_INCR_CHURN_MAX"
+# micro-solve dual certificate (ISSUE 17): opt-in reduced-cost batch
+# ordering, plus an optional certified-spend defer gate (0 = off)
+ENV_MICRO_DUAL = "KARPENTER_MICRO_DUAL"
+ENV_MICRO_SPEND_MAX = "KARPENTER_MICRO_DUAL_SPEND_MAX"
 
 MAX_DIVERGENCE_RECORDS = 16
 RETRY_ROUNDS = 16  # k-way-evicted re-solve bound, mirrors Scheduler._solve
@@ -241,7 +246,10 @@ class IncrementalTickScheduler:
         self.options = options
         self.clock = clock if clock is not None else time.monotonic
         self.churn_max = _env_float(ENV_CHURN_MAX, 0.25)
-        self.audit_every = int(_env_float(ENV_AUDIT_EVERY, 16))
+        # KARPENTER_INCR_AUDIT_EVERY is re-read per access (ISSUE 17
+        # satellite): PR 16's bench needed a forced-audit probe because
+        # the knob froze at construction. Assignment still pins it.
+        self._audit_every_override: Optional[int] = None
         self._tracker = DirtyTracker(kube)
         self._tracker.watch("Node")
         self._tracker.watch("NodeClaim", key=_claim_keys)
@@ -269,7 +277,13 @@ class IncrementalTickScheduler:
         self._last_audit: dict = {}
         self.divergences: list[dict] = []
         self._counts = {"incremental": 0, "full_backstop": 0,
-                        "quarantined": 0}
+                        "quarantined": 0, "micro": 0}
+        # micro-solve plane (ISSUE 17): defer rollup + the retained
+        # dual certificate the micro batch ordering/defer gate spends
+        self._micro_defers: dict[str, int] = {}
+        self._micro_active = False
+        self._dual = None
+        self._dual_stale = True
         # per-reason full-path fallback rollup (ISSUE 15 satellite):
         # readyz()["incremental"]["fallbacks"] surfaces it so envelope
         # regressions show up at a glance
@@ -277,6 +291,22 @@ class IncrementalTickScheduler:
         # which widened-envelope shapes this cache generation has
         # served — the FIRST tick of each shape forces an audit
         self._envelope_seen: set[str] = set()
+
+    # -- knobs ----------------------------------------------------------------
+
+    @property
+    def audit_every(self) -> int:
+        """Audit cadence, live from the environment on every read so
+        bench arms and operators can retune a running scheduler; an
+        explicit assignment (tests pinning the cadence) overrides the
+        env until reassigned."""
+        if self._audit_every_override is not None:
+            return self._audit_every_override
+        return int(_env_float(ENV_AUDIT_EVERY, 16))
+
+    @audit_every.setter
+    def audit_every(self, value) -> None:
+        self._audit_every_override = None if value is None else int(value)
 
     # -- external triggers ----------------------------------------------------
 
@@ -298,6 +328,8 @@ class IncrementalTickScheduler:
         self._force_audit = trigger
         self._age = 0
         self._envelope_seen.clear()
+        self._dual = None
+        self._dual_stale = True
 
     # -- tick -----------------------------------------------------------------
 
@@ -307,11 +339,37 @@ class IncrementalTickScheduler:
         self._counts["full_backstop"] += 1
         self._fallbacks[reason] = self._fallbacks.get(reason, 0) + 1
 
+    def _note_defer(self, reason: str) -> None:
+        """Micro-solve defer (ISSUE 17): the envelope routed a
+        debounced arrival batch to the NEXT FULL TICK — nothing solves
+        now, the operator re-arms the batcher. Kept distinct from
+        fallbacks so readyz separates 'the micro path punted' from
+        'the periodic tick left the envelope'."""
+        tracing.annotate(path="micro_defer", reason=reason)
+        INCREMENTAL_TICK.inc({"path": "micro_defer", "reason": reason})
+        self._micro_defers[reason] = self._micro_defers.get(reason, 0) + 1
+
     def tick(
-        self, pods: Sequence[Pod], pools_with_types,
+        self, pods: Sequence[Pod], pools_with_types, micro: bool = False,
     ) -> Optional[SchedulerResults]:
+        """One reconcile solve. `micro=True` is the event-driven
+        sub-tick path (ISSUE 17): same retained inputs, same audits,
+        but every condition the full-path Scheduler would have to
+        finish (ineligible shapes, cold cache, churn blow-out,
+        mixed-priority shedding, quarantine) DEFERS to the next full
+        tick instead of falling through to a full solve — the micro
+        path must never pay O(fleet)."""
+        self._micro_active = micro
         if not incremental_enabled():
-            tracing.annotate(path="full", reason="disabled")
+            if micro:
+                self._note_defer("disabled")
+            else:
+                tracing.annotate(path="full", reason="disabled")
+            return None
+        if micro and self._quarantined:
+            # quarantine falls back to PURE periodic ticks: probation
+            # audits belong to the full cadence, not the arrival path
+            self._note_defer("quarantined")
             return None
         t0 = self.clock()
         self._ticks += 1
@@ -328,11 +386,21 @@ class IncrementalTickScheduler:
 
         reason = self._ineligible(pods, pools_with_types)
         if reason is not None:
-            self._note_fallback(reason)
+            if micro:
+                self._note_defer(reason)
+            else:
+                self._note_fallback(reason)
             return None
 
         pools = self._sorted_pools(pools_with_types)
         cold = not self._inputs
+        if micro and cold:
+            # a cold cache has nothing retained to solve against; the
+            # next full tick owns the one-time O(fleet) warm-up — the
+            # micro path never pays it (and must not flip the
+            # _warm_pending latch the full path's cold bail owns)
+            self._note_defer("cold")
+            return None
         if (
             cold
             and not self._warm_pending
@@ -381,8 +449,25 @@ class IncrementalTickScheduler:
         if pods and not cold and churn > self.churn_max and (
             not self._quarantined
         ):
-            self._note_fallback("churn")
+            if micro:
+                self._note_defer("churn")
+            else:
+                self._note_fallback("churn")
             return None
+        if micro and self._dual is not None:
+            spend_max = _env_float(ENV_MICRO_SPEND_MAX, 0.0)
+            if spend_max > 0:
+                try:
+                    bound = self._dual.bound_for(group_pods(list(pods)))
+                except Exception:
+                    bound = 0.0
+                if bound > spend_max:
+                    # weak duality certifies the batch buys at least
+                    # `bound` of fresh capacity — non-trivial spend is
+                    # the full tick's call (its repack/consolidation
+                    # machinery sees the whole fleet picture)
+                    self._note_defer("dual_spend")
+                    return None
 
         from karpenter_tpu.solver import resilience
 
@@ -398,7 +483,7 @@ class IncrementalTickScheduler:
             for p in pods if relaxable(p)
         }
         resilience.pop_degraded()  # scope the report to THIS solve
-        results, fallback = self._solve(pods, pools)
+        results, fallback = self._solve(pods, pools, micro=micro)
         degraded = resilience.pop_degraded()
         if results is not None and degraded:
             log.warning(
@@ -410,7 +495,10 @@ class IncrementalTickScheduler:
             # the solve left pods only the full path's machinery (the
             # relaxation ladder, the per-pod topology path, priority
             # admission) can finish: hand the whole tick over
-            self._note_fallback(fallback)
+            if micro:
+                self._note_defer(fallback)
+            else:
+                self._note_fallback(fallback)
             return None
 
         self._since_audit += 1
@@ -447,15 +535,11 @@ class IncrementalTickScheduler:
         faults.fire("crash_incr_commit")
         self._note_explanations(pods, results, pools_with_types)
         self._publish_solver_metrics(results, t0)
-        tracing.annotate(
-            path="incremental",
-            reason="audited" if audit_trigger is not None else "steady",
-        )
-        INCREMENTAL_TICK.inc({
-            "path": "incremental",
-            "reason": "audited" if audit_trigger is not None else "steady",
-        })
-        self._counts["incremental"] += 1
+        path = "micro" if micro else "incremental"
+        reason = "audited" if audit_trigger is not None else "steady"
+        tracing.annotate(path=path, reason=reason)
+        INCREMENTAL_TICK.inc({"path": path, "reason": reason})
+        self._counts[path] += 1
         return results
 
     def _note_explanations(self, pods, results: SchedulerResults,
@@ -614,6 +698,10 @@ class IncrementalTickScheduler:
             )
             self._builder_fp = fp
             self._daemon_overhead = self._builder.daemon_overhead()
+            # a catalog move invalidates the dual certificate: its
+            # duals were Farley-scaled against the OLD prices
+            self._dual = None
+            self._dual_stale = True
         if rebuild_all:
             self._inputs.clear()
             self._meta.clear()
@@ -707,7 +795,7 @@ class IncrementalTickScheduler:
     # -- solve ----------------------------------------------------------------
 
     def _solve(
-        self, pods: Sequence[Pod], pools,
+        self, pods: Sequence[Pod], pools, micro: bool = False,
     ) -> tuple[Optional[SchedulerResults], str]:
         """One incremental solve: the batched core, then — exactly
         when the full path's admission loop would act — the shared
@@ -718,6 +806,11 @@ class IncrementalTickScheduler:
         if results is None:
             return None, reason
         if self._priority_overloaded(pods, results):
+            if micro:
+                # a mixed-priority capacity failure is the shed loop's
+                # case; shedding belongs to the full tick (ISSUE 17) —
+                # a micro batch must never half-shed the backlog
+                return None, "priority"
             return self._enforce_admission(pods, pools, results)
         return results, ""
 
@@ -839,6 +932,21 @@ class IncrementalTickScheduler:
                 reserved_in_use=round_in_use,
                 compat_cache=self.cache,
             )
+            if (
+                self._dual_stale
+                and not self._micro_active
+                and _env_on(ENV_MICRO_DUAL, "0")
+            ):
+                # refresh the micro path's dual certificate from a
+                # FULL tick's encode (never a micro batch: its demand
+                # axis is a sliver of the backlog) — opt-in, degrades
+                # to None and the micro path runs arrival-ordered
+                from karpenter_tpu.solver.incremental import (
+                    build_dual_floor,
+                )
+
+                self._dual = build_dual_floor(enc)
+                self._dual_stale = False
             sol = solve_encoded(enc)
             self._commit_existing(sol, chosen, work, results)
             open_plans.extend(sol.new_nodes)
@@ -1091,6 +1199,36 @@ class IncrementalTickScheduler:
             return False, "topology"
         return True, ""
 
+    # -- micro-batch ordering (ISSUE 17) --------------------------------------
+
+    def micro_order(self, pods: Sequence[Pod]) -> list[Pod]:
+        """`_DualFloor` reduced-cost ordering for a debounced micro
+        batch: cheapest certified placements first, so a truncated
+        batch spends its window on the pods the duals price as easy
+        wins. The operator applies this BEFORE handing the batch to
+        tick(), so the shadow audit sees the identical pod order and
+        the equality claim is untouched. Without a certificate
+        (KARPENTER_MICRO_DUAL off, or no full solve yet) arrival
+        order stands; ties keep arrival order (stable sort)."""
+        pods = list(pods)
+        dual = self._dual
+        if dual is None or len(pods) < 2:
+            return pods
+        try:
+            price: dict[str, float] = {}
+            for g in group_pods(pods):
+                sig = (
+                    g.requirements.signature(),
+                    g.tolerations,
+                    tuple(sorted(g.resources.items())),
+                )
+                lam = dual.lam_by_sig.get(sig, 0.0)
+                for p in g.pods:
+                    price[p.key] = lam
+            return sorted(pods, key=lambda p: price.get(p.key, 0.0))
+        except Exception:
+            return pods
+
     # -- priority overload gate (ISSUE 15) ------------------------------------
 
     def _priority_overloaded(self, pods, results) -> bool:
@@ -1286,6 +1424,14 @@ class IncrementalTickScheduler:
             # karpenter_incremental_tick_total{path="full_backstop",
             # reason} series as a readyz digest)
             "fallbacks": dict(self._fallbacks),
+            # event-driven micro-solve rollup (ISSUE 17): served count
+            # rides ticks["micro"]; defers are per-reason, mirroring
+            # karpenter_incremental_tick_total{path="micro_defer"}
+            "micro": {
+                "served": self._counts["micro"],
+                "deferred": dict(self._micro_defers),
+                "dual_certificate": self._dual is not None,
+            },
         }
 
 

@@ -687,6 +687,49 @@ class Provisioner:
         self.batcher.reset()
         return results
 
+    def micro_solve(
+        self, pods: Sequence[Pod], now: Optional[float] = None,
+    ) -> Optional[SchedulerResults]:
+        """Event-driven micro provisioning round (ISSUE 17): a
+        debounced arrival batch rides the incremental tick's O(dirty)
+        path against retained inputs. Intake is the batch the reactive
+        plane resolved — never a store walk. Returns None when the
+        incremental envelope DEFERRED the batch to the next full tick
+        (ineligible shape, cold cache, churn, quarantine, priority
+        shedding); the operator re-arms the batcher in that case."""
+        from karpenter_tpu import tracing
+
+        if not pods or not self.cluster.synced():
+            return None
+        reprice = getattr(self.cloud_provider, "reprice", None)
+        if reprice is not None and now is not None:
+            reprice(now)
+        pods = list(pods)
+        from karpenter_tpu.scheduling.priority import (
+            resolve_pod_priorities,
+        )
+
+        resolve_pod_priorities(pods, self.kube)
+        if self._catalog_dirty.drain("NodePool"):
+            self.encode_cache.invalidate()
+        pools = self.ready_pools_with_types()
+        # reduced-cost ordering from the retained dual certificate —
+        # applied BEFORE tick() so the shadow audit sees the same order
+        pods = self.incremental.micro_order(pods)
+        with tracing.span("route"):
+            results = self.incremental.tick(pods, pools, micro=True)
+        if results is None:
+            return None
+        # same crash window as reconcile(): decided, nothing written —
+        # the chaos suite kills the operator mid-micro-solve here
+        from karpenter_tpu.solver import faults as _faults
+
+        _faults.fire("crash_claims")
+        self.create_node_claims(results, now=now)
+        self._record_events(results, now=now)
+        self.cluster.mark_pod_scheduling_decisions(pods)
+        return results
+
     def _record_events(self, results: SchedulerResults,
                        now: Optional[float] = None) -> None:
         """Pod-facing scheduling events (scheduling/events.go:46-68:

@@ -375,6 +375,71 @@ class _DualFloor:
         return max(0.0, total - self.cap_term)
 
 
+def build_dual_floor(enc) -> Optional[_DualFloor]:
+    """Construct the dual certificate from one solve's encode (shared
+    by the repack pipeline and the live tick's micro path, ISSUE 17).
+    Returns None when the device LP is unavailable or the derivation
+    fails — callers run exactly the unguided path."""
+    from karpenter_tpu.solver import lp_device
+
+    dlp = lp_device.maybe_solve(enc)
+    if dlp is None:
+        return None
+    try:
+        launch = enc.cfg_pool >= 0
+        n_launch = int(launch.sum())
+        # plannability mask, exactly as lp_device._stage derives
+        # it: the ascent prices only groups some launchable
+        # machine can hold one pod of — duals of excluded groups
+        # never entered the Farley scaling, so they must not
+        # enter the floor either
+        req = enc.group_req.astype(np.float64)
+        eff = np.clip(
+            enc.cfg_alloc
+            - enc.pool_overhead[np.maximum(enc.cfg_pool, 0)],
+            0.0, None,
+        )
+        eff = np.where(launch[:, None], eff, 0.0)
+        safe = np.where(req > 0, req, 1.0)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            k = np.floor((eff[None, :, :] + 1e-4) / safe[:, None, :])
+        k = np.where(req[:, None, :] > 0, k, np.inf).min(axis=2)
+        k = np.where(enc.compat & launch[None, :], k, 0.0)
+        plannable = np.asarray(k >= 1).any(axis=1)
+        lam_by_sig: dict = {}
+        for gi, g in enumerate(enc.groups):
+            if not plannable[gi]:
+                continue
+            sig = (
+                g.requirements.signature(),
+                g.tolerations,
+                tuple(sorted(g.resources.items())),
+            )
+            lam = float(dlp.lam[gi])
+            prev = lam_by_sig.get(sig)
+            # signature collisions keep the smaller dual: the
+            # bound must stay valid for either group's demand
+            lam_by_sig[sig] = lam if prev is None else min(prev, lam)
+        cap_term = 0.0
+        if enc.rsv_cap is not None and len(dlp.mu):
+            cap_term = float(
+                dlp.mu @ enc.rsv_cap.astype(np.float64)
+            )
+        return _DualFloor(
+            lam_by_sig=lam_by_sig,
+            cap_term=cap_term,
+            rank_launch=lp_device.rank_prices(enc, dlp)[:n_launch],
+            n_launch=n_launch,
+        )
+    except Exception:
+        import logging
+
+        logging.getLogger("karpenter.solver.incremental").exception(
+            "dual certificate derivation failed; caller runs unguided"
+        )
+        return None
+
+
 class IncrementalPipeline:
     """Tick-to-tick warm-start solver over one pod population.
 
@@ -686,64 +751,7 @@ class IncrementalPipeline:
             or _env_on("KARPENTER_INCR_DUAL_FLOOR")
         ):
             return
-        from karpenter_tpu.solver import lp_device
-
-        dlp = lp_device.maybe_solve(enc)
-        if dlp is None:
-            return
-        try:
-            launch = enc.cfg_pool >= 0
-            n_launch = int(launch.sum())
-            # plannability mask, exactly as lp_device._stage derives
-            # it: the ascent prices only groups some launchable
-            # machine can hold one pod of — duals of excluded groups
-            # never entered the Farley scaling, so they must not
-            # enter the floor either
-            req = enc.group_req.astype(np.float64)
-            eff = np.clip(
-                enc.cfg_alloc
-                - enc.pool_overhead[np.maximum(enc.cfg_pool, 0)],
-                0.0, None,
-            )
-            eff = np.where(launch[:, None], eff, 0.0)
-            safe = np.where(req > 0, req, 1.0)
-            with np.errstate(divide="ignore", invalid="ignore"):
-                k = np.floor((eff[None, :, :] + 1e-4) / safe[:, None, :])
-            k = np.where(req[:, None, :] > 0, k, np.inf).min(axis=2)
-            k = np.where(enc.compat & launch[None, :], k, 0.0)
-            plannable = np.asarray(k >= 1).any(axis=1)
-            lam_by_sig: dict = {}
-            for gi, g in enumerate(enc.groups):
-                if not plannable[gi]:
-                    continue
-                sig = (
-                    g.requirements.signature(),
-                    g.tolerations,
-                    tuple(sorted(g.resources.items())),
-                )
-                lam = float(dlp.lam[gi])
-                prev = lam_by_sig.get(sig)
-                # signature collisions keep the smaller dual: the
-                # bound must stay valid for either group's demand
-                lam_by_sig[sig] = lam if prev is None else min(prev, lam)
-            cap_term = 0.0
-            if enc.rsv_cap is not None and len(dlp.mu):
-                cap_term = float(
-                    dlp.mu @ enc.rsv_cap.astype(np.float64)
-                )
-            self._dual = _DualFloor(
-                lam_by_sig=lam_by_sig,
-                cap_term=cap_term,
-                rank_launch=lp_device.rank_prices(enc, dlp)[:n_launch],
-                n_launch=n_launch,
-            )
-        except Exception:
-            import logging
-
-            logging.getLogger("karpenter.solver.incremental").exception(
-                "dual certificate refresh failed; repack runs unguided"
-            )
-            self._dual = None
+        self._dual = build_dual_floor(enc)
 
     def _repack_solve(self, enc):
         """One residual repack solve, dual-rank-guided when the cached

@@ -89,15 +89,15 @@ class RetainedFleetSeam:
         pools_fn: Optional[Callable] = None,
         options=None,
     ):
-        from karpenter_tpu.solver.incremental import _env_float
-
         self.kube = kube
         self.cluster = cluster
         # zero-arg catalog source (Provisioner.ready_pools_with_types)
         # — consulted only when the input builder must be (re)built
         self.pools_fn = pools_fn
         self.options = options
-        self.audit_every = int(_env_float(ENV_AUDIT, 16))
+        # audit cadence is a LIVE knob (ISSUE 17 satellite): re-read
+        # from the env per serve unless a test pins an override
+        self._audit_every_override: Optional[int] = None
         self._tracker = DirtyTracker(kube)
         self._tracker.watch("Node")
         self._tracker.watch("NodeClaim", key=_claim_keys)
@@ -118,6 +118,23 @@ class RetainedFleetSeam:
         self.rebuilds = 0
         self.audits = 0
         self.divergences = 0
+
+    # -- knobs ----------------------------------------------------------------
+
+    @property
+    def audit_every(self) -> int:
+        """Serves between identity audits — KARPENTER_DISRUPTION_
+        SNAPSHOT_AUDIT read per access (a deploy retuning the cadence
+        must not need a restart), unless explicitly assigned."""
+        if self._audit_every_override is not None:
+            return self._audit_every_override
+        from karpenter_tpu.solver.incremental import _env_float
+
+        return int(_env_float(ENV_AUDIT, 16))
+
+    @audit_every.setter
+    def audit_every(self, value: Optional[int]) -> None:
+        self._audit_every_override = None if value is None else int(value)
 
     # -- dirt -----------------------------------------------------------------
 

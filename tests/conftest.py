@@ -44,6 +44,11 @@ def pytest_configure(config):
         "scenarios (part of tier-1; select alone with -m surge_chaos)",
     )
     config.addinivalue_line(
+        "markers",
+        "reactive_chaos: storms against the event-driven micro-solve "
+        "loop (part of tier-1; select alone with -m reactive_chaos)",
+    )
+    config.addinivalue_line(
         "markers", "slow: excluded from the tier-1 verify run"
     )
 

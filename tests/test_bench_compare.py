@@ -435,3 +435,100 @@ class TestScaleWallGate:
             _artifact(tmp_path, "cur.json", cur),
         ])
         assert rc == 0
+
+
+class TestLatencyGate:
+    """ISSUE 17: the sustained_arrival_stream scenario's arrival->bind
+    percentiles gate relative like the wall keys — at a scenario's top
+    level and nested under its reactive/periodic arm blocks — and a
+    side that skipped the arm (BENCH_ARRIVAL_PODS=0, pre-ISSUE
+    artifact) is reported loudly, never gated."""
+
+    def _base(self):
+        return {
+            "sustained_arrival_stream": {
+                "pods": 10000,
+                "p99_speedup": 9.9,
+                "oracle_divergences": 0,
+                "reactive": {
+                    "pod_to_bind_p50_s": 0.04,
+                    "pod_to_bind_p99_s": 0.1,
+                    "bound": 10000,
+                },
+                "periodic": {
+                    "pod_to_bind_p50_s": 0.56,
+                    "pod_to_bind_p99_s": 0.99,
+                    "bound": 10000,
+                },
+            },
+        }
+
+    def test_reactive_p99_regression_gates(self, tmp_path, capsys):
+        cur = self._base()
+        cur["sustained_arrival_stream"]["reactive"][
+            "pod_to_bind_p99_s"
+        ] = 0.5
+        rc = main([
+            _artifact(tmp_path, "base.json", self._base()),
+            _artifact(tmp_path, "cur.json", cur),
+            "--threshold", "0.25",
+        ])
+        assert rc == 1
+        assert "reactive.pod_to_bind_p99_s" in capsys.readouterr().out
+
+    def test_p50_regression_gates_in_periodic_arm_too(self, tmp_path,
+                                                      capsys):
+        cur = self._base()
+        cur["sustained_arrival_stream"]["periodic"][
+            "pod_to_bind_p50_s"
+        ] = 2.0
+        rc = main([
+            _artifact(tmp_path, "base.json", self._base()),
+            _artifact(tmp_path, "cur.json", cur),
+        ])
+        assert rc == 1
+        assert "periodic.pod_to_bind_p50_s" in capsys.readouterr().out
+
+    def test_top_level_latency_key_gates(self, tmp_path, capsys):
+        base = {"sustained_arrival_stream": {"pod_to_bind_p99_s": 0.1}}
+        cur = {"sustained_arrival_stream": {"pod_to_bind_p99_s": 0.9}}
+        rc = main([
+            _artifact(tmp_path, "base.json", base),
+            _artifact(tmp_path, "cur.json", cur),
+        ])
+        assert rc == 1
+        assert ("sustained_arrival_stream.pod_to_bind_p99_s"
+                in capsys.readouterr().out)
+
+    def test_skipped_arm_reports_but_never_gates(self, tmp_path,
+                                                 capsys):
+        cur = {"sustained_arrival_stream": {"skipped": True}}
+        rc = main([
+            _artifact(tmp_path, "base.json", self._base()),
+            _artifact(tmp_path, "cur.json", cur),
+        ])
+        assert rc == 0
+        assert "not gated" in capsys.readouterr().out
+
+    def test_new_arrival_arm_reports_not_gated(self, tmp_path, capsys):
+        base = {"sustained_arrival_stream": {"skipped": True}}
+        rc = main([
+            _artifact(tmp_path, "base.json", base),
+            _artifact(tmp_path, "cur.json", self._base()),
+        ])
+        assert rc == 0
+        assert "new key; not gated" in capsys.readouterr().out
+
+    def test_improvement_and_within_threshold_pass(self, tmp_path):
+        cur = self._base()
+        cur["sustained_arrival_stream"]["reactive"][
+            "pod_to_bind_p99_s"
+        ] = 0.05
+        cur["sustained_arrival_stream"]["periodic"][
+            "pod_to_bind_p99_s"
+        ] = 1.05
+        rc = main([
+            _artifact(tmp_path, "base.json", self._base()),
+            _artifact(tmp_path, "cur.json", cur),
+        ])
+        assert rc == 0

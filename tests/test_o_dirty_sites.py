@@ -188,3 +188,34 @@ def test_binding_and_eviction_queues_stay_o_pending():
     assert not offenders, (
         f"EvictionQueue hot paths regrew a full-store walk: {offenders}"
     )
+
+
+def test_micro_solve_chain_stays_o_batch():
+    """The ISSUE-17 reactive chain: arrival event -> debounce plane ->
+    Operator.micro_step -> Provisioner.micro_solve -> incremental tick.
+    Every hop is pinned to ZERO full-store walks — the whole point of
+    the sub-tick path is that its cost scales with the BATCH, and one
+    stray `.pods()` turns every watch event into an O(fleet) walk at a
+    far higher frequency than the periodic tick ever ran."""
+    tree = ast.parse(
+        (PKG / "operator/reactive.py").read_text(),
+        filename="operator/reactive.py",
+    )
+    calls = _full_scan_calls(tree)
+    assert not calls, (
+        f"the reactive plane touched the store (it must only ever see "
+        f"keys the watch hands it): {calls}"
+    )
+    for rel, hot in (
+        ("operator/operator.py", ("micro_step",)),
+        ("provisioning/provisioner.py", ("micro_solve",)),
+    ):
+        tree = ast.parse((PKG / rel).read_text(), filename=rel)
+        offenders = [
+            (lineno, attr)
+            for lineno, attr, owner in _full_scan_calls(tree)
+            if owner in hot
+        ]
+        assert not offenders, (
+            f"{rel} micro chain regrew a full-store walk: {offenders}"
+        )

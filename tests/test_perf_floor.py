@@ -931,6 +931,67 @@ def test_explain_plane_overhead_under_5_percent(monkeypatch):
     )
 
 
+def test_reactive_plumbing_overhead_under_5_percent(monkeypatch):
+    """ISSUE-17 guard: the reactive plane rides INLINE on the periodic
+    path too — watch-event hooks noting arrivals/frees, the stamp
+    ledger, the per-step observe_now/prune — so with the fleet calm
+    (no arrivals, nothing pending) a steady full tick with
+    KARPENTER_REACTIVE armed must cost <5% over the same tick with the
+    plane disarmed. Interleaved best-of-N via the shared helper, knob
+    flipped per sample (the telemetry-plane guard's shape)."""
+    from karpenter_tpu import tracing
+    from karpenter_tpu.cloudprovider.fake import GIB, make_instance_type
+    from karpenter_tpu.operator.operator import Operator
+    from karpenter_tpu.operator.options import Options
+    from karpenter_tpu.testing import Environment, interleaved_best_of
+
+    monkeypatch.delenv("KARPENTER_FAULTS", raising=False)
+    types = [make_instance_type("c4", cpu=4, memory=16 * GIB, price=1.0)]
+    env = Environment(types=types)
+    pool = mk_nodepool("p")
+    pool.spec.disruption.consolidate_after = "Never"
+    env.kube.create(pool)
+    env.provision(
+        *[mk_pod(name=f"rp-{i}", cpu=1.0, memory=2 * GIB)
+          for i in range(240)]
+    )
+    op = Operator(kube=env.kube, cloud_provider=env.cloud,
+                  options=Options())
+    now = time.time()
+    op.step(now=now)
+    op.step(now=now + 1)
+
+    tick = {"i": 0}
+
+    def sample(flag: str) -> float:
+        monkeypatch.setenv("KARPENTER_REACTIVE", flag)
+        tick["i"] += 1
+        t0 = time.perf_counter()
+        # 0.9s spacing stays inside every periodic interval
+        op.step(now=now + 2 + tick["i"] * 0.9)
+        return time.perf_counter() - t0
+
+    sample("1")
+    sample("0")
+    try:
+        best = interleaved_best_of(
+            {"armed": lambda: sample("1"),
+             "disarmed": lambda: sample("0")},
+            rounds=20,
+            min_rounds=5,
+            satisfied=lambda b: (
+                b["armed"] < b["disarmed"] * 1.05 + 0.002
+            ),
+        )
+    finally:
+        tracing.clear()
+    armed, disarmed = best["armed"], best["disarmed"]
+    assert armed < disarmed * 1.05 + 0.002, (
+        f"reactive-armed steady tick {armed * 1000:.2f}ms vs disarmed "
+        f"{disarmed * 1000:.2f}ms — reactive-plumbing overhead above 5%"
+    )
+
+
 def test_retained_disruption_scan_beats_from_scratch(monkeypatch):
     """ISSUE-15 floor. Two claims, asserted separately because the
     retained-core work FIXED the from-scratch path too:

@@ -1,0 +1,389 @@
+"""Reactive-placement chaos suite (ISSUE 17): the event-driven
+micro-solve loop under fire.
+
+The loop shape under test is run()'s: a full audit/repack tick, then
+micro-steps riding the debounced watch stream between ticks. Three
+storms hit it at once — `demand_surge@provision_intake` floods the
+intake, `kube_watch_drop@kube_watch` kills watch streams mid-flight
+(410 relists), and `operator_crash@crash_incr_solve` kills the process
+INSIDE a micro-solve — and the converged fleet must equal the calm
+PURE-PERIODIC run's fingerprint, with the fault schedule replaying
+byte-identically (`FaultInjector.snapshot_log`).
+
+Two more contracts ride along:
+
+- debounce determinism: the reactive plane is a pure function of the
+  operator-supplied clock and the event sequence, so two runs of the
+  same scripted schedule produce IDENTICAL micro_step digests (batch
+  composition, boundaries, latencies) — a chaos failure found in CI
+  replays exactly on a laptop;
+- quarantine fallback: a poisoned retained cache quarantines the
+  incremental plane; every micro-solve must then DEFER (reason
+  `quarantined`, pure periodic ticks own the pods), and once probation
+  clears the quarantine the micro path serves again.
+"""
+
+import time
+
+import pytest
+
+from karpenter_tpu.cloudprovider.fake import GIB, make_instance_type
+from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
+from karpenter_tpu.kube.real import InMemoryApiServer, RealKubeClient
+from karpenter_tpu.operator.operator import Operator
+from karpenter_tpu.solver import faults
+from karpenter_tpu.testing import mk_nodepool, mk_pod
+
+
+@pytest.fixture()
+def clean_faults(monkeypatch):
+    monkeypatch.delenv("KARPENTER_FAULTS", raising=False)
+    monkeypatch.delenv("KARPENTER_FAULT_SEED", raising=False)
+    monkeypatch.delenv("KARPENTER_REACTIVE", raising=False)
+    monkeypatch.setenv("KARPENTER_KUBE_RETRY_BASE_MS", "1")
+    monkeypatch.setenv("KARPENTER_KUBE_RELIST_MIN_MS", "0")
+    # the singleton fleet is tiny (≤9 nodes), so a two-pod micro batch
+    # exceeds the default 0.25 dirty fraction; the churn gate is not
+    # under test here (the envelope gates are), so open it up
+    monkeypatch.setenv("KARPENTER_INCR_CHURN_MAX", "1.0")
+    faults.reset()
+    yield monkeypatch
+    faults.reset()
+
+
+def _singleton_types():
+    # one-pod-per-node catalog (the restart-chaos trick): a 1.5-cpu pod
+    # only fits a c2, so every solve — full, micro, or post-crash
+    # partial — is forced to the same singleton partition, and the
+    # fleet fingerprint is assertable exactly. A 0.5-cpu surge pod fits
+    # neither the 0.4-cpu headroom of a full c2 nor (pool limit) a new
+    # node: surge demand sheds by construction.
+    return [make_instance_type("c2", cpu=2, memory=8 * GIB, price=2.0)]
+
+
+class Harness:
+    """A surviving API server + surviving cloud under an operator that
+    runs the REACTIVE loop shape — full tick, then scripted micro-steps
+    until the next — and may die (OperatorCrashError) in either and be
+    rebooted with fresh memory."""
+
+    def __init__(self, cpu_limit=18.0):
+        self.server = InMemoryApiServer()
+        kube = RealKubeClient(self.server)
+        self.cloud = KwokCloudProvider(kube, types=_singleton_types())
+        self.op = Operator(kube=kube, cloud_provider=self.cloud)
+        self.user = RealKubeClient(self.server)
+        self.now = time.time()
+        self.crashes = 0
+        self.micro_crashes = 0
+        self.digests: list = []
+        # 0s consolidation: nodes a surge pod transiently claimed are
+        # collected once the storm retires, so the converged fleet has
+        # no empty-node residue to diff against the calm run (1.5-cpu
+        # singletons cannot merge, so the calm fleet never churns)
+        pool = mk_nodepool("default", limits={"cpu": cpu_limit})
+        pool.spec.disruption.consolidate_after = "0s"
+        self.user.create(pool)
+
+    def _restart(self):
+        kube = RealKubeClient(self.server)
+        self.cloud.kube = kube
+        self.op = Operator(kube=kube, cloud_provider=self.cloud)
+
+    def create_pod(self, name, cpu=1.5, stamp=None):
+        # the workload outranks both surge halves (±100): admission
+        # must never hand a surge pod capacity the workload wants
+        pod = mk_pod(name=name, cpu=cpu)
+        pod.spec.priority = 1000
+        if stamp is not None:
+            pod.metadata.creation_timestamp = stamp
+        self.user.create(pod)
+
+    def drive(self, ticks, dt=2.0, micro_per_tick=4, arrivals=None):
+        """Each outer tick: one full step, then `micro_per_tick`
+        micro-steps spaced evenly across the interval. `arrivals` maps
+        a (tick, micro-slot) to pod names created at that sub-tick
+        offset — the event stream the debounce window batches."""
+        arrivals = arrivals or {}
+        for k in range(ticks):
+            self.now += dt
+            try:
+                self.op.step(now=self.now)
+            except faults.OperatorCrashError:
+                self.crashes += 1
+                self._restart()
+                continue
+            for j in range(1, micro_per_tick + 1):
+                t = self.now + dt * j / (micro_per_tick + 1)
+                for name in arrivals.get((k, j), ()):
+                    self.create_pod(name, stamp=t)
+                try:
+                    digest = self.op.micro_step(now=t)
+                except faults.OperatorCrashError:
+                    self.crashes += 1
+                    self.micro_crashes += 1
+                    self._restart()
+                    break
+                if digest is not None:
+                    self.digests.append(digest)
+
+    def retire_surge(self):
+        from karpenter_tpu.provisioning.provisioner import SURGE_LABEL
+
+        self.user.deliver()
+        for pod in list(self.user.pods()):
+            if SURGE_LABEL in pod.metadata.labels:
+                self.user.delete(pod)
+
+    def fingerprint(self):
+        """Name-agnostic converged state + the no-leak invariants
+        (the restart-chaos contract, reused)."""
+        kube = self.op.kube
+        claims = kube.node_claims()
+        assert all(
+            c.metadata.deletion_timestamp is None for c in claims
+        ), "wedged-deleting nodeclaim"
+        claim_pids = sorted(
+            c.status.provider_id for c in claims if c.status.provider_id
+        )
+        assert len(claim_pids) == len(claims), "claim never launched"
+        inst_pids = sorted(
+            i.status.provider_id for i in self.cloud.list()
+        )
+        assert inst_pids == claim_pids, (
+            f"leak/double-launch: cloud={inst_pids} claims={claim_pids}"
+        )
+        nodes = kube.nodes()
+        assert sorted(n.spec.provider_id for n in nodes) == claim_pids
+        live = [
+            p for p in kube.pods()
+            if p.metadata.deletion_timestamp is None
+        ]
+        assert all(p.spec.node_name for p in live), (
+            "stranded: "
+            f"{[p.metadata.name for p in live if not p.spec.node_name]}"
+        )
+        return sorted(
+            (
+                n.metadata.labels.get(
+                    "node.kubernetes.io/instance-type", ""
+                ),
+                tuple(sorted(
+                    p.metadata.name
+                    for p in kube.pods_on_node(n.metadata.name)
+                )),
+            )
+            for n in nodes
+        )
+
+
+# nine 1.5-cpu pods arriving as sub-tick events in two waves. The early
+# wave (ticks 1-3) lands while the micro path is still COLD — no full
+# tick has synced a fleet into the retained cache yet — so those pods
+# are exercise for the cold-defer gate and the periodic safety net. The
+# late wave (ticks 8-10) arrives after the fleet has materialized and
+# MUST ride the warm micro path. The pool's cpu-18 limit (exactly nine
+# c2 nodes) leaves zero room for the storm's surge pods.
+ARRIVALS = {
+    (1, 1): ("w-0",), (1, 3): ("w-1",),
+    (2, 1): ("w-2", "w-3"), (2, 4): ("w-4",),
+    (3, 2): ("w-5",),
+    (8, 1): ("w-6",), (9, 3): ("w-7",), (10, 2): ("w-8",),
+}
+
+
+def _reactive_run(spec, monkeypatch, seed="17"):
+    if spec:
+        monkeypatch.setenv("KARPENTER_FAULTS", spec)
+        monkeypatch.setenv("KARPENTER_FAULT_SEED", seed)
+    else:
+        monkeypatch.delenv("KARPENTER_FAULTS", raising=False)
+    faults.reset()
+    h = Harness()
+    h.drive(16, dt=2.0, arrivals=ARRIVALS)
+    # ride past the GC interval so reaped double-launches are collected
+    h.retire_surge()
+    h.now += 130
+    h.drive(10, dt=15.0)
+    inj = faults.get()
+    h.fault_log = inj.snapshot_log() if inj is not None else []
+    monkeypatch.delenv("KARPENTER_FAULTS", raising=False)
+    return h
+
+
+def _periodic_run(monkeypatch):
+    """The calm CONTROL arm: same workload script, pure periodic ticks
+    — KARPENTER_REACTIVE=0, zero micro-solves. The storm runs must
+    converge to THIS fleet."""
+    monkeypatch.delenv("KARPENTER_FAULTS", raising=False)
+    monkeypatch.setenv("KARPENTER_REACTIVE", "0")
+    faults.reset()
+    h = Harness()
+    h.drive(16, dt=2.0, arrivals=ARRIVALS)
+    h.now += 130
+    h.drive(10, dt=15.0)
+    monkeypatch.delenv("KARPENTER_REACTIVE", raising=False)
+    return h
+
+
+_REFERENCE: dict = {}
+
+
+def _reference(monkeypatch):
+    if "calm" not in _REFERENCE:
+        h = _periodic_run(monkeypatch)
+        assert h.digests == [], "reactive off must mean zero micro fires"
+        _REFERENCE["calm"] = h.fingerprint()
+    return _REFERENCE["calm"]
+
+
+# the combined storm: intake flood + watch-stream kills + a process
+# crash landing inside a micro-solve (crash_incr_solve fires on every
+# incremental solve; the early occurrences land on the sub-tick micro
+# path because the arrival script feeds it between full ticks)
+STORM = (
+    "demand_surge@provision_intake:2-3=8,"
+    "kube_watch_drop@kube_watch:4-6,"
+    "operator_crash@crash_incr_solve:3"
+)
+
+
+@pytest.mark.reactive_chaos
+def test_reactive_storm_converges_to_calm_periodic_fingerprint(
+    clean_faults,
+):
+    want = _reference(clean_faults)
+    assert sum(len(p[1]) for p in want) == 9
+    h = _reactive_run(STORM, clean_faults)
+    kinds = {kind for _, _, kind in h.fault_log}
+    assert "demand_surge" in kinds, "surge never fired"
+    assert "kube_watch_drop" in kinds, "watch drop never fired"
+    assert h.crashes >= 1, "the operator never crashed"
+    assert h.fingerprint() == want
+    # the micro path actually carried arrivals in this run
+    assert any(d["outcome"] == "served" for d in h.digests), (
+        f"no micro-solve served: {[d['outcome'] for d in h.digests]}"
+    )
+
+
+@pytest.mark.reactive_chaos
+def test_crash_mid_micro_solve_restarts_and_converges(clean_faults):
+    """The crash specifically lands INSIDE micro_step (the micro
+    solve's crash_incr_solve site): the restarted operator re-derives
+    everything from the API, the periodic safety net owns the orphaned
+    batch, and the fleet still converges."""
+    want = _reference(clean_faults)
+    # occurrence 5 of the crash site is the first micro-path solve in
+    # this schedule (the first warm batch after the late arrival wave);
+    # earlier occurrences are the full ticks that built the fleet
+    h = _reactive_run("operator_crash@crash_incr_solve:5", clean_faults)
+    assert h.crashes >= 1, "crash never fired"
+    assert h.micro_crashes >= 1, (
+        "the crash must land inside a micro-solve, not a full tick"
+    )
+    assert h.fingerprint() == want
+
+
+@pytest.mark.reactive_chaos
+def test_reactive_storm_replays_byte_identically(clean_faults):
+    h_a = _reactive_run(STORM, clean_faults, seed="29")
+    h_b = _reactive_run(STORM, clean_faults, seed="29")
+    assert h_a.fault_log, "storm never fired"
+    assert h_a.fault_log == h_b.fault_log
+    assert h_a.crashes == h_b.crashes >= 1
+    assert h_a.fingerprint() == h_b.fingerprint()
+
+
+@pytest.mark.reactive_chaos
+def test_debounce_batches_replay_identically(clean_faults):
+    """The determinism contract in isolation: no faults, a scripted
+    sub-tick arrival schedule, two runs — identical micro_step digests
+    (same batches, same boundaries, same debounce latencies). The
+    plane must be a pure function of the injected clock and the event
+    sequence; any wall-clock read in the batch logic breaks this."""
+
+    def run():
+        faults.reset()
+        h = Harness()
+        h.drive(12, dt=2.0, arrivals=ARRIVALS)
+        return h
+
+    h_a, h_b = run(), run()
+    strip = lambda ds: [  # noqa: E731  (latencies are run-relative)
+        {
+            "batch": d["batch"],
+            "solved": d["solved"],
+            "outcome": d["outcome"],
+            "debounce_latency": round(d["debounce_latency"], 9),
+        }
+        for d in ds
+    ]
+    assert strip(h_a.digests) == strip(h_b.digests)
+    assert any(d["outcome"] == "served" for d in h_a.digests)
+    assert h_a.fingerprint() == h_b.fingerprint()
+
+
+@pytest.mark.reactive_chaos
+def test_quarantine_falls_back_to_periodic_and_recovers(clean_faults):
+    """cache_poison quarantines the retained state mid-run: every
+    micro-solve while quarantined must DEFER (reason `quarantined` —
+    pure periodic ticks own placement, the shadow oracle's safety
+    net), the pods still land via the full tick, and once the
+    probation audit clears the quarantine the micro path serves
+    again."""
+    clean_faults.setenv(
+        "KARPENTER_FAULTS", "cache_poison@incremental:3"
+    )
+    faults.reset()
+    h = Harness()
+    # warm up: the first wave lands periodically (micro path is cold),
+    # the poison fires on an early warm solve and quarantines
+    h.drive(8, dt=2.0, arrivals={(1, 1): ("w-0", "w-1"),
+                                 (3, 2): ("w-2",), (5, 1): ("w-3",)})
+    inc = h.op.provisioner.incremental
+    assert inc.status()["quarantined"] or inc.status()["divergences"], (
+        f"poison never quarantined: {inc.status()}"
+    )
+    deferred0 = dict(inc.status()["micro"]["deferred"])
+    # arrivals DURING quarantine: micro must defer, periodic must bind
+    was_quarantined = inc.status()["quarantined"]
+    h.drive(6, dt=2.0, arrivals={(0, 2): ("q-0",), (1, 1): ("q-1",)})
+    inc = h.op.provisioner.incremental
+    if was_quarantined:
+        assert inc.status()["micro"]["deferred"].get(
+            "quarantined", 0
+        ) > deferred0.get("quarantined", 0), (
+            "a quarantined micro-solve must defer to the periodic path"
+        )
+    h.user.deliver()
+    for name in ("q-0", "q-1"):
+        pod = h.user.get_pod("default", name)
+        assert pod is not None and pod.spec.node_name, (
+            f"{name} must land via the periodic safety net"
+        )
+    # the fault is spent: probation clears, micro serves again
+    assert not inc.status()["quarantined"], (
+        f"probation should have cleared quarantine: {inc.status()}"
+    )
+    served0 = inc.status()["micro"]["served"]
+    h.drive(6, dt=2.0, arrivals={(1, 2): ("r-0",), (2, 1): ("r-1",)})
+    assert h.op.provisioner.incremental.status()["micro"][
+        "served"
+    ] > served0, "micro path must recover after probation"
+    h.fingerprint()
+
+
+@pytest.mark.reactive_chaos
+def test_watch_drop_keeps_arrival_to_bind_honest(clean_faults):
+    """A 410 relist mid-stream must not strand arrivals: pods created
+    while the watch was dead are picked up (relist replay or periodic
+    resync) and every live pod still lands."""
+    clean_faults.setenv(
+        "KARPENTER_FAULTS", "kube_watch_drop@kube_watch:2-5"
+    )
+    faults.reset()
+    h = Harness()
+    h.drive(14, dt=2.0, arrivals=ARRIVALS)
+    h.now += 130
+    h.drive(8, dt=15.0)
+    assert h.fingerprint() == _reference(clean_faults)

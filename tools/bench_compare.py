@@ -78,6 +78,16 @@ LIVE_WALL_KEYS = (
 SCALE_WALL_KEYS = (
     "tick_p50_s_100k", "tick_p99_s_100k", "tick_p50_s_10k",
 )
+# arrival->bind latency percentiles (ISSUE 17): the reactive
+# placement headline SLI, reported by the sustained_arrival_stream
+# arm both at a scenario's top level and nested under its per-arm
+# blocks (LATENCY_ARMS). Gated RELATIVE like WALL_KEYS — a latency
+# regression is a ratio problem, not an absolute one — but
+# null-tolerant and LOUD like the scale walls: a side without the
+# arm (BENCH_ARRIVAL_PODS=0, pre-ISSUE artifact) is reported, never
+# gated
+LATENCY_KEYS = ("pod_to_bind_p50_s", "pod_to_bind_p99_s")
+LATENCY_ARMS = ("reactive", "periodic")
 DEVICE_MEM_KEYS = {
     "compiled_peak_temp_mb": "compiled_scope",
     "device_peak_in_use_mb": "device_scope",
@@ -325,6 +335,38 @@ def compare(
                 regressions.append(tag)
             else:
                 lines.append("  " + tag)
+        for arm in (None,) + LATENCY_ARMS:
+            ba = b if arm is None else b.get(arm)
+            ca = c if arm is None else c.get(arm)
+            if not isinstance(ba, dict) and not isinstance(ca, dict):
+                continue
+            for key in LATENCY_KEYS:
+                bv = ba.get(key) if isinstance(ba, dict) else None
+                cv = ca.get(key) if isinstance(ca, dict) else None
+                if bv is None and cv is None:
+                    continue
+                label = f"{name}.{key}" if arm is None else (
+                    f"{name}.{arm}.{key}"
+                )
+                if not isinstance(bv, (int, float)) or bv <= 0:
+                    if isinstance(cv, (int, float)):
+                        lines.append(
+                            f"  {label}: null -> {cv:.3f}s "
+                            "(new key; not gated)"
+                        )
+                    continue
+                if not isinstance(cv, (int, float)):
+                    lines.append(
+                        f"  {label}: {bv:.3f}s -> null "
+                        "(arrival arm unavailable; not gated)"
+                    )
+                    continue
+                rel = cv / bv - 1.0
+                tag = f"{label}: {bv:.3f}s -> {cv:.3f}s ({rel:+.1%})"
+                if rel > threshold:
+                    regressions.append(tag)
+                else:
+                    lines.append("  " + tag)
         for gkey in GAP_KEYS:
             bv, cv = b.get(gkey), c.get(gkey)
             if not isinstance(bv, (int, float)):
