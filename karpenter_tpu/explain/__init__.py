@@ -373,6 +373,27 @@ def summarize_ring() -> dict:
     }
 
 
+def verdict_distance(observed: dict, expected: dict) -> float:
+    """Normalized L1 (total-variation) distance between two verdict
+    histograms, on [0, 1]: 0.0 means identical SHARES (counts may
+    scale — a 2x-longer soak with the same decision mix is distance
+    0), 1.0 means disjoint support. The soak judge (ISSUE 18) scores
+    a run's summarize_ring() histogram against the scenario's
+    declared expectation envelope with this — unexplained-verdict
+    DRIFT gates on shape, never on raw volume."""
+    tot_obs = float(sum(observed.values())) if observed else 0.0
+    tot_exp = float(sum(expected.values())) if expected else 0.0
+    if tot_obs <= 0.0 and tot_exp <= 0.0:
+        return 0.0
+    if tot_obs <= 0.0 or tot_exp <= 0.0:
+        return 1.0
+    keys = set(observed) | set(expected)
+    return round(0.5 * sum(
+        abs(observed.get(k, 0) / tot_obs - expected.get(k, 0) / tot_exp)
+        for k in keys
+    ), 6)
+
+
 def structure(record: dict) -> str:
     """The deterministic skeleton of one record: everything but the
     run-random trace id, as canonical JSON — what chaos suites compare

@@ -168,6 +168,49 @@ class Sentinel:
         with self._lock:
             return sum(b.anomalies for b in self._baselines.values())
 
+    def snapshot(self) -> dict:
+        """Checkpoint view of every baseline (ISSUE 18 satellite):
+        summary() plus per-signal warmup state and the anomaly total —
+        the block readyz()[\"sentinel\"] mirrors, and what
+        reset_baselines() hands back as the phase checkpoint."""
+        warmup = _env_int("KARPENTER_SENTINEL_WARMUP", 16)
+        with self._lock:
+            return {
+                "signals": {
+                    name: {
+                        "samples": b.n,
+                        "ewma_ms": round(b.ewma * 1000.0, 3),
+                        "mad_ms": round(b.mad * 1000.0, 3),
+                        "last_ms": round(b.last_value * 1000.0, 3),
+                        "anomalies": b.anomalies,
+                        "warmed": b.n >= warmup,
+                    }
+                    for name, b in sorted(self._baselines.items())
+                },
+                "anomaly_total": sum(
+                    b.anomalies for b in self._baselines.values()
+                ),
+            }
+
+    def reset_baselines(self, signals=None) -> dict:
+        """Drop baselines so the named signals (all, when None)
+        re-enter warmup deterministically — the soak harness's
+        phase-boundary seam: a regime change (diurnal wave -> surge
+        storm) is a NEW normal, and carrying the old baseline across
+        it would page on the phase transition itself. Returns the
+        pre-reset snapshot() (the phase checkpoint); the in-object
+        anomaly counts reset with their baselines, while
+        karpenter_sentinel_anomaly_total keeps the whole-process
+        history."""
+        checkpoint = self.snapshot()
+        with self._lock:
+            if signals is None:
+                self._baselines.clear()
+            else:
+                for name in signals:
+                    self._baselines.pop(name, None)
+        return checkpoint
+
     def reset(self) -> None:
         with self._lock:
             self._baselines.clear()
@@ -198,6 +241,14 @@ def summary() -> dict:
 
 def anomaly_total() -> int:
     return _shared.anomaly_total()
+
+
+def snapshot() -> dict:
+    return _shared.snapshot()
+
+
+def reset_baselines(signals=None) -> dict:
+    return _shared.reset_baselines(signals)
 
 
 def reset() -> None:

@@ -224,6 +224,13 @@ class SLOEngine:
         self._alerts: dict[str, dict[str, int]] = {
             s.name: {"warn": 0, "page": 0} for s in self.slis
         }
+        # whole-run good/total units per SLI (ISSUE 18): unlike the
+        # window deques these never roll off, so a long soak can
+        # convert them into burn-minutes — total error budget consumed
+        # over the trace, not just over the last long window
+        self._cumulative: dict[str, list[float]] = {
+            s.name: [0.0, 0.0] for s in self.slis
+        }
         self.unscheduled_pod_ticks = 0.0
 
     @staticmethod
@@ -283,6 +290,9 @@ class SLOEngine:
                 if result is not None:
                     good, total = result
                     history.append((float(good), float(total)))
+                    cum = self._cumulative[sli.name]
+                    cum[0] += float(good)
+                    cum[1] += float(total)
                 burn_short = self._burn(sli.name, sli.objective, short_w)
                 burn_long = self._burn(sli.name, sli.objective, long_w)
                 if burn_short >= page_at and burn_long >= page_at:
@@ -329,6 +339,22 @@ class SLOEngine:
         _remember(digest)
         return digest
 
+    def cumulative(self) -> dict:
+        """Whole-run per-SLI units (ISSUE 18): good/total/bad summed
+        over EVERY data tick this engine ever observed — the
+        window-free ledger the soak judge turns into burn-minutes
+        (bad_units x tick_minutes / error_budget). Deterministic under
+        the injected clock like everything else here."""
+        with self._lock:
+            return {
+                name: {
+                    "good_units": round(cum[0], 3),
+                    "total_units": round(cum[1], 3),
+                    "bad_units": round(cum[1] - cum[0], 3),
+                }
+                for name, cum in sorted(self._cumulative.items())
+            }
+
     def digest(self) -> dict:
         """The readyz()["slo"] block: last observe_tick's digest, or a
         zero-tick placeholder before the first tick."""
@@ -363,6 +389,7 @@ class SLOEngine:
                 }
         out = self.digest()
         out["slis"] = slis
+        out["cumulative"] = self.cumulative()
         out["thresholds"] = {
             "warn_burn": _env_float("KARPENTER_SLO_WARN_BURN", 2.0),
             "page_burn": _env_float("KARPENTER_SLO_PAGE_BURN", 10.0),

@@ -343,6 +343,16 @@ FAULTS_REJECTED = REGISTRY.counter(
     "karpenter_faults_rejected_total",
     "Malformed KARPENTER_FAULTS entries dropped at parse — nonzero "
     "means a chaos knob is typo'd and injecting nothing")
+# scenario flywheel (ISSUE 18): trace-driven chaos soak + judge
+SCENARIO_EVENTS = REGISTRY.counter(
+    "karpenter_scenario_events_total",
+    "Workload events the scenario flywheel's composed schedule applied "
+    "against the soak cluster, by layer and kind (create / delete)")
+SOAK_VERDICT = REGISTRY.gauge(
+    "karpenter_soak_verdict",
+    "Last scenario-flywheel soak judge verdict, by scenario (1 pass / "
+    "0 fail — a fail names the losing observability plane in the "
+    "verdict artifact)")
 # spot capacity tier (cloudprovider spot offerings, disruption/
 # interruption.py, scheduler spot budget)
 SPOT_INTERRUPTIONS = REGISTRY.counter(

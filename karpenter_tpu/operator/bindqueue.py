@@ -52,7 +52,10 @@ class _Item:
         # reactive plane saw the pod first. Bind latency is measured
         # from here so the SLI covers the wait-for-solve, not just the
         # queue residency; pods without a stamp (command plans, pods
-        # predating the plane's clock) fall back to enqueued_at.
+        # predating the plane's clock) fall back to enqueued_at. For
+        # held replace-then-drain plans, drain() re-stamps pods still
+        # bound to the node being drained — a migrating pod is serving,
+        # not pending, until its rebirth.
         self.arrivals: dict[str, float] = arrivals or {}
 
     def latency_start(self, pod_key: str) -> float:
@@ -183,7 +186,12 @@ class BindingQueue:
                         # the command is draining: HOLD the plan until
                         # the pod comes free (deadline-bounded) — a
                         # plan dropped while its pods are still bound
-                        # never fires at all (seed-11 oscillation)
+                        # never fires at all (seed-11 oscillation).
+                        # The pod is not pending here — it is still
+                        # serving on the old node (or mid-rebirth), so
+                        # the bind-latency clock must not run: advance
+                        # its stamp to this sighting
+                        item.arrivals[pod.key] = now
                         unbound = True
                         hold(target)
                         continue
@@ -197,7 +205,10 @@ class BindingQueue:
                             # existing-assignments branch below —
                             # treating this as "already home" silently
                             # dropped pure-replace command plans before
-                            # their claims ever registered (ADVICE r5)
+                            # their claims ever registered (ADVICE r5).
+                            # Still bound = not pending: keep the
+                            # latency clock parked at this sighting
+                            item.arrivals[pod.key] = now
                             unbound = True
                             hold(target)
                         continue  # already home (or nothing to wait on)
@@ -265,7 +276,9 @@ class BindingQueue:
                         # awaiting rebirth from the drain, or still
                         # bound to the node being drained: HOLD the
                         # plan (deadline-bounded) so the pod lands on
-                        # the planned capacity, not a fresh solve
+                        # the planned capacity, not a fresh solve.
+                        # Not pending — park the latency clock here
+                        item.arrivals[pod.key] = now
                         unbound = True
                         hold(target)
             if unbound:

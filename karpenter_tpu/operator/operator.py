@@ -929,6 +929,11 @@ class Operator:
             # verdict per SLI, deterministic under the injectable clock
             # (full report at /debug/slo)
             "slo": self.slo.digest(),
+            # regression-sentinel baselines (ISSUE 18 satellite): the
+            # per-signal EWMA/MAD checkpoint view — a phase-boundary
+            # reset_baselines() re-enters warmup, visible here as
+            # warmed=false until the warmup count refills
+            "sentinel": self._sentinel_snapshot(),
             # decision explainability (ISSUE 14): the last tick's
             # verdict counts (full records at /debug/explain)
             "explain": self._explain_digest(),
@@ -939,6 +944,12 @@ class Operator:
         from karpenter_tpu import explain
 
         return explain.digest()
+
+    @staticmethod
+    def _sentinel_snapshot() -> dict:
+        from karpenter_tpu.metrics import sentinel
+
+        return sentinel.snapshot()
 
     @staticmethod
     def _solver_status() -> dict:

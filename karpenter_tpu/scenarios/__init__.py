@@ -1,0 +1,60 @@
+"""Scenario flywheel (ISSUE 18): composable trace-driven workloads +
+a deterministic chaos soak judged by the observability planes.
+
+Three pieces:
+
+- **spec.py** — declarative scenario specs whose layers (diurnal
+  serving waves, batch trains, demand surges, mixed-priority tenancy,
+  expiry churn, spot-interruption storms) each compile to a pure
+  function of (spec, seed, the injected clock origin): `compose()`
+  emits a byte-identical pod/fault event schedule every run —
+  extending the fault injector's replay-identity contract from fault
+  LOGS to workload SCHEDULES (composed KARPENTER_FAULTS specs ride
+  along with per-layer `#seed`s);
+- **soak.py** — the long-horizon soak harness: replays a composed
+  trace against the full reactive Operator (full ticks + micro-solves,
+  crash-and-reboot on injected operator death) under accelerated
+  injected time, with forced oracle audits on;
+- **judge.py** — renders the structured verdict artifact, FAILING on
+  SLO error-budget exhaustion, sentinel anomaly transitions, oracle
+  divergence, unexplained-verdict drift against the spec's declared
+  expectation envelope, or leaked claims/pods at trace end.
+
+The planes do the judging — there are no hand-pinned walls here, so
+every future scale PR inherits this as its regression oracle (the
+`soak_flywheel` bench arm + tools/bench_compare.py gate the artifact).
+"""
+
+from karpenter_tpu.scenarios.judge import judge
+from karpenter_tpu.scenarios.soak import run_soak
+from karpenter_tpu.scenarios.spec import (
+    BatchTrain,
+    DemandSurgeBurst,
+    DiurnalWave,
+    ExpectationEnvelope,
+    ExpiryChurn,
+    MixedTenancy,
+    ScenarioSpec,
+    Schedule,
+    SpotStorm,
+    compose,
+    flywheel_spec,
+    smoke_spec,
+)
+
+__all__ = [
+    "BatchTrain",
+    "DemandSurgeBurst",
+    "DiurnalWave",
+    "ExpectationEnvelope",
+    "ExpiryChurn",
+    "MixedTenancy",
+    "ScenarioSpec",
+    "Schedule",
+    "SpotStorm",
+    "compose",
+    "flywheel_spec",
+    "judge",
+    "run_soak",
+    "smoke_spec",
+]

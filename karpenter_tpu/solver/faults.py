@@ -12,7 +12,7 @@ spec produce byte-identical fault sequences (see `snapshot_log`).
 
 Spec grammar (KARPENTER_FAULTS, comma-separated entries):
 
-    entry  = kind [ "@" site ] [ ":" occ ] [ "=" param ]
+    entry  = kind [ "@" site ] [ ":" occ ] [ "=" param ] [ "#" seed ]
     kind   = device_lost | rpc_drop | compile_delay | exec_delay
            | kube_conflict | kube_throttle | kube_watch_drop
            | kube_stale_list | kube_write_partial | operator_crash
@@ -21,6 +21,11 @@ Spec grammar (KARPENTER_FAULTS, comma-separated entries):
     param  = duration                         (delay / retry-after kinds)
            | rate                             (spot_interruption: 0 < r <= 1)
            | count                            (demand_surge: pods per burst)
+    seed   = per-entry replay seed for rate-based admission and surge
+             shapes; composed schedules (the scenario flywheel) layer
+             independently-seeded storms into ONE spec this way.
+             Entries without a "#seed" fall back to the injector-wide
+             KARPENTER_FAULT_SEED.
 
 Examples:
     device_lost@solve:3        third device solve raises DeviceLostError
@@ -44,6 +49,12 @@ Examples:
                                              KARPENTER_FAULT_SEED picks the
                                              schedule; same seed + same spec
                                              replay byte-identically.
+    spot_interruption@cloud_interrupt:*=0.1#storm-a
+                                             same, but the schedule is drawn
+                                             from THIS entry's own seed — a
+                                             composed spec can carry several
+                                             independently-seeded storms
+                                             without them aliasing each other
     demand_surge@provision_intake:2=500      the 2nd live provisioning intake
                                              absorbs a seeded burst of 500
                                              pending pods (mixed low/high
@@ -272,6 +283,10 @@ class FaultRule:
     delay: float = 0.0
     rate: float = 1.0  # <1.0: fire w.p. rate, seeded-hash-decided per seq
     count: int = 0     # demand_surge: pods per injected burst
+    # per-entry replay seed (the "#seed" suffix); None falls back to
+    # the injector-wide KARPENTER_FAULT_SEED — composed specs carry
+    # one independently-seeded schedule per layer this way
+    seed: Optional[str] = None
 
     def matches(self, seq: int) -> bool:
         if self.lo == 0:
@@ -316,7 +331,19 @@ def parse(spec: str, rejected: Optional[list] = None) -> list[FaultRule]:
         if not raw:
             continue
         try:
-            body, _, param = raw.partition("=")
+            # the "#seed" suffix splits off FIRST: params (durations,
+            # rates, counts) never contain "#", and the seed must not
+            # leak into the =param float parse
+            entry, hash_sep, rule_seed = raw.partition("#")
+            if hash_sep:
+                rule_seed = rule_seed.strip()
+                if not rule_seed or any(
+                    c in rule_seed for c in "@:=#"
+                ) or any(c.isspace() for c in rule_seed):
+                    raise ValueError(f"bad per-entry seed {rule_seed!r}")
+            else:
+                rule_seed = None
+            body, _, param = entry.partition("=")
             head, _, occ = body.partition(":")
             kind, _, site = head.partition("@")
             kind = kind.strip()
@@ -356,7 +383,8 @@ def parse(spec: str, rejected: Optional[list] = None) -> list[FaultRule]:
                 delay = _parse_duration(param) if param else 0.0
             if kind.endswith("_delay") and delay <= 0.0:
                 raise ValueError("delay kind needs a =duration")
-            rules.append(FaultRule(kind, site, lo, hi, delay, rate, count))
+            rules.append(FaultRule(kind, site, lo, hi, delay, rate,
+                                   count, rule_seed))
         except (ValueError, IndexError) as err:
             log.warning("ignoring malformed fault entry %r: %s", raw, err)
             if rejected is not None:
@@ -396,7 +424,11 @@ class FaultInjector:
             return False
         if rule.rate >= 1.0:
             return True
-        return _hash01(self.seed, site, seq) < rule.rate
+        # a rule carrying its own "#seed" replays from that seed; the
+        # injector-wide seed covers the rest — two rate rules in one
+        # composed spec draw from independent schedules
+        seed = rule.seed if rule.seed is not None else self.seed
+        return _hash01(seed, site, seq) < rule.rate
 
     def fire(self, site: str) -> None:
         """Advance `site`'s sequence counter and apply matching rules:
@@ -436,8 +468,10 @@ class FaultInjector:
         if rule.kind == "kube_throttle":
             return KubeThrottleError(message, retry_after=rule.delay)
         if rule.kind == "demand_surge":
-            return DemandSurgeError(message, count=rule.count, seq=seq,
-                                    seed=self.seed)
+            return DemandSurgeError(
+                message, count=rule.count, seq=seq,
+                seed=rule.seed if rule.seed is not None else self.seed,
+            )
         cls = {
             "device_lost": DeviceLostError,
             "rpc_drop": RpcDropError,

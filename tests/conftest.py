@@ -49,6 +49,13 @@ def pytest_configure(config):
         "loop (part of tier-1; select alone with -m reactive_chaos)",
     )
     config.addinivalue_line(
+        "markers",
+        "soak_chaos: deterministic scenario-flywheel soak replays "
+        "judged by the observability planes (smoke soak rides in "
+        "tier-1; the multi-hour flywheel is slow-marked; select alone "
+        "with -m soak_chaos)",
+    )
+    config.addinivalue_line(
         "markers", "slow: excluded from the tier-1 verify run"
     )
 

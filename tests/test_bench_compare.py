@@ -532,3 +532,142 @@ class TestLatencyGate:
             _artifact(tmp_path, "cur.json", cur),
         ])
         assert rc == 0
+
+
+class TestSoakGate:
+    """ISSUE 18: the soak_flywheel judge verdict gates — a FAILING
+    current verdict gates even without a baseline (the soak is
+    deterministic), pass->fail flips gate, burn-minutes and the
+    verdict-histogram distance gate by absolute delta, and a side
+    missing the arm reports loudly, never gates."""
+
+    def _soak(self, passing=True, burn=None, dist=0.05, failures=()):
+        return {
+            "pass": passing,
+            "failures": list(failures),
+            "report_digest": "abc123",
+            "schedule_digest": "def456",
+            "burn_minutes": dict(burn if burn is not None
+                                 else {"tick_latency": 0.2,
+                                       "admission": 0.0}),
+            "whole_run_burn": {"tick_latency": 0.01},
+            "verdict_histogram_distance": dist,
+            "sentinel_anomalies": 0,
+            "oracle_divergences": 0,
+            "leaks": 0,
+        }
+
+    def _base(self, **soak_kwargs):
+        return {"soak_flywheel": {"wall_s": 2.5,
+                                  "soak": self._soak(**soak_kwargs)}}
+
+    def test_calm_passing_soak_exits_zero(self, tmp_path):
+        rc = main([
+            _artifact(tmp_path, "base.json", self._base()),
+            _artifact(tmp_path, "cur.json", self._base()),
+        ])
+        assert rc == 0
+
+    def test_failing_current_verdict_gates(self, tmp_path, capsys):
+        cur = self._base(passing=False, failures=["slo", "sentinel"])
+        rc = main([
+            _artifact(tmp_path, "base.json", self._base()),
+            _artifact(tmp_path, "cur.json", cur),
+        ])
+        assert rc == 1
+        out = capsys.readouterr().out
+        assert "judge verdict FAIL" in out
+        assert "slo, sentinel" in out
+
+    def test_failing_verdict_gates_even_without_baseline(
+        self, tmp_path, capsys
+    ):
+        """A new soak arm whose judge FAILED is a real regression, not
+        'a new arm is not a regression' — the soak is deterministic."""
+        base = {"reserved_50k": {"wall_s": 0.6}}
+        cur = dict(base, **self._base(passing=False, failures=["oracle"]))
+        rc = main([
+            _artifact(tmp_path, "base.json", base),
+            _artifact(tmp_path, "cur.json", cur),
+        ])
+        assert rc == 1
+        assert "oracle" in capsys.readouterr().out
+
+    def test_new_passing_soak_arm_never_gates(self, tmp_path, capsys):
+        base = {"reserved_50k": {"wall_s": 0.6}}
+        cur = dict(base, **self._base())
+        rc = main([
+            _artifact(tmp_path, "base.json", base),
+            _artifact(tmp_path, "cur.json", cur),
+        ])
+        assert rc == 0
+        assert "new arm" in capsys.readouterr().out
+
+    def test_burn_minutes_delta_past_tolerance_gates(
+        self, tmp_path, capsys
+    ):
+        cur = self._base(burn={"tick_latency": 1.5, "admission": 0.0})
+        rc = main([
+            _artifact(tmp_path, "base.json", self._base()),
+            _artifact(tmp_path, "cur.json", cur),
+            "--soak-burn-tolerance", "1.0",
+        ])
+        assert rc == 1
+        assert ("soak.burn_minutes.tick_latency"
+                in capsys.readouterr().out)
+
+    def test_burn_minutes_within_tolerance_passes(self, tmp_path):
+        cur = self._base(burn={"tick_latency": 1.0, "admission": 0.0})
+        rc = main([
+            _artifact(tmp_path, "base.json", self._base()),
+            _artifact(tmp_path, "cur.json", cur),
+            "--soak-burn-tolerance", "1.0",
+        ])
+        assert rc == 0
+
+    def test_histogram_distance_delta_gates(self, tmp_path, capsys):
+        cur = self._base(dist=0.25)
+        rc = main([
+            _artifact(tmp_path, "base.json", self._base(dist=0.05)),
+            _artifact(tmp_path, "cur.json", cur),
+            "--soak-dist-tolerance", "0.1",
+        ])
+        assert rc == 1
+        assert ("soak.verdict_histogram_distance"
+                in capsys.readouterr().out)
+
+    def test_null_distance_reports_but_never_gates(self, tmp_path,
+                                                   capsys):
+        """A spec without an expectation envelope reports distance as
+        null — loud, never gated (the LATENCY_KEYS contract)."""
+        cur = self._base(dist=None)
+        rc = main([
+            _artifact(tmp_path, "base.json", self._base(dist=0.05)),
+            _artifact(tmp_path, "cur.json", cur),
+        ])
+        assert rc == 0
+        assert "not gated" in capsys.readouterr().out
+
+    def test_missing_current_soak_arm_reports_not_gated(
+        self, tmp_path, capsys
+    ):
+        cur = {"soak_flywheel": {"wall_s": 2.5}}
+        rc = main([
+            _artifact(tmp_path, "base.json", self._base()),
+            _artifact(tmp_path, "cur.json", cur),
+        ])
+        assert rc == 0
+        assert "soak arm unavailable; not gated" in capsys.readouterr().out
+
+    def test_scenario_restriction_covers_current_only_soak(
+        self, tmp_path
+    ):
+        """--scenarios excludes a current-only failing soak arm too."""
+        base = {"reserved_50k": {"wall_s": 0.6}}
+        cur = dict(base, **self._base(passing=False, failures=["slo"]))
+        rc = main([
+            _artifact(tmp_path, "base.json", base),
+            _artifact(tmp_path, "cur.json", cur),
+            "--scenarios", "reserved_50k",
+        ])
+        assert rc == 0

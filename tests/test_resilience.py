@@ -125,6 +125,98 @@ class TestFaultSpec:
             faults.fire("solve")  # fresh injector: occurrence 1 again
 
 
+class TestFaultSeedGrammar:
+    """ISSUE 18 satellite: the per-entry `#seed` suffix — each entry
+    replays its rate/surge schedule from its OWN seed (scenario layers
+    compose independently-seeded storms into one spec this way),
+    falling back to the injector-wide KARPENTER_FAULT_SEED."""
+
+    def test_seed_suffix_parses_on_every_param_shape(self):
+        rules = faults.parse(
+            "spot_interruption@cloud_interrupt:*=0.05#storm-a,"
+            "compile_delay=5s#lag.1,"
+            "demand_surge@provision_intake:2=100#burst_x,"
+            "device_lost@solve:3#s"
+        )
+        assert [r.seed for r in rules] == [
+            "storm-a", "lag.1", "burst_x", "s",
+        ]
+        assert rules[0].rate == 0.05 and rules[1].delay == 5.0
+        assert rules[2].count == 100 and rules[3].lo == 3
+
+    def test_entries_without_suffix_keep_none_seed(self):
+        rules = faults.parse("spot_interruption@cloud_interrupt:*=0.1")
+        assert rules[0].seed is None
+
+    @pytest.mark.parametrize("bad", [
+        "spot_interruption@cloud_interrupt:*=0.1#",       # empty
+        "spot_interruption@cloud_interrupt:*=0.1#a#b",    # embedded #
+        "spot_interruption@cloud_interrupt:*=0.1#a:b",    # embedded :
+        "spot_interruption@cloud_interrupt:*=0.1#a=b",    # embedded =
+        "spot_interruption@cloud_interrupt:*=0.1#a@b",    # embedded @
+        "spot_interruption@cloud_interrupt:*=0.1#a b",    # whitespace
+    ])
+    def test_malformed_seeds_rejected_loudly(self, bad):
+        from karpenter_tpu.metrics.store import FAULTS_REJECTED
+
+        before = FAULTS_REJECTED.total()
+        rejected: list = []
+        rules = faults.parse(bad, rejected=rejected)
+        assert rules == []
+        assert rejected == [bad]
+        assert FAULTS_REJECTED.total() == before + 1
+
+    def test_per_entry_seed_overrides_injector_seed(self):
+        """Same injector-wide seed, different `#seed`s: the rate
+        schedules must diverge — and the same `#seed` must replay
+        byte-identically regardless of the injector seed."""
+        def fired(spec, injector_seed):
+            inj = faults.FaultInjector(
+                faults.parse(spec), sleep=lambda _t: None,
+                seed=injector_seed,
+            )
+            out = []
+            for seq in range(200):
+                try:
+                    inj.fire("cloud_interrupt")
+                except faults.FaultError:
+                    out.append(seq)
+            return out
+
+        spec_a = "spot_interruption@cloud_interrupt:*=0.2#aaa"
+        spec_b = "spot_interruption@cloud_interrupt:*=0.2#bbb"
+        assert fired(spec_a, "7") != fired(spec_b, "7")
+        assert fired(spec_a, "7") == fired(spec_a, "99")
+
+    def test_unseeded_entry_follows_injector_seed(self):
+        def fired(injector_seed):
+            inj = faults.FaultInjector(
+                faults.parse("spot_interruption@cloud_interrupt:*=0.2"),
+                sleep=lambda _t: None, seed=injector_seed,
+            )
+            out = []
+            for seq in range(200):
+                try:
+                    inj.fire("cloud_interrupt")
+                except faults.FaultError:
+                    out.append(seq)
+            return out
+
+        assert fired("7") == fired("7")
+        assert fired("7") != fired("99")
+
+    def test_env_seed_fallback_via_get(self, monkeypatch):
+        monkeypatch.setenv(
+            "KARPENTER_FAULTS",
+            "spot_interruption@cloud_interrupt:*=0.5#pinned",
+        )
+        monkeypatch.setenv("KARPENTER_FAULT_SEED", "3")
+        faults.reset()
+        inj = faults.get()
+        assert inj.seed == "3"
+        assert inj.rules[0].seed == "pinned"
+
+
 class TestClassification:
     def test_taxonomy(self):
         assert classify(faults.DeviceLostError("x")) == "device_lost"

@@ -167,3 +167,102 @@ class TestWiring:
         summary = sentinel.summary()
         assert "tick_wall" in summary
         assert summary["tick_wall"]["samples"] == 1
+
+
+class TestSnapshotAndReset:
+    """ISSUE 18 satellite: the snapshot()/reset_baselines() seam the
+    soak harness checkpoints at phase boundaries."""
+
+    def test_snapshot_shape_and_anomaly_total(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_SENTINEL_WARMUP", "5")
+        s = Sentinel()
+        for _ in range(10):
+            s.observe("sig", 0.01)
+        assert s.observe("sig", 9.0)
+        snap = s.snapshot()
+        sig = snap["signals"]["sig"]
+        assert sig["samples"] == 11
+        assert sig["anomalies"] == 1
+        assert sig["warmed"] is True
+        assert sig["last_ms"] == 9000.0
+        assert snap["anomaly_total"] == 1
+
+    def test_warmed_flips_with_warmup_count(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_SENTINEL_WARMUP", "4")
+        s = Sentinel()
+        for _ in range(3):
+            s.observe("sig", 0.01)
+        assert s.snapshot()["signals"]["sig"]["warmed"] is False
+        s.observe("sig", 0.01)
+        assert s.snapshot()["signals"]["sig"]["warmed"] is True
+
+    def test_reset_baselines_returns_checkpoint_and_rewarms(
+        self, monkeypatch
+    ):
+        """The phase-boundary contract: reset hands back the pre-reset
+        snapshot, and the signal re-enters warmup so the regime change
+        itself never flags."""
+        monkeypatch.setenv("KARPENTER_SENTINEL_WARMUP", "5")
+        s = Sentinel()
+        for _ in range(10):
+            s.observe("sig", 0.01)
+        assert s.observe("sig", 9.0)
+        checkpoint = s.reset_baselines()
+        assert checkpoint["anomaly_total"] == 1
+        assert checkpoint["signals"]["sig"]["samples"] == 11
+        # post-reset: empty baselines, and the new regime's level —
+        # 100x the old one — warms up WITHOUT flagging
+        assert s.snapshot()["signals"] == {}
+        for _ in range(20):
+            assert not s.observe("sig", 1.0)
+        assert s.snapshot()["signals"]["sig"]["anomalies"] == 0
+
+    def test_rewarmup_is_deterministic(self, monkeypatch):
+        """Reset + the same sample sequence reproduces the same
+        snapshot byte for byte — the property the soak's judged
+        sentinel plane rides on."""
+        monkeypatch.setenv("KARPENTER_SENTINEL_WARMUP", "5")
+        s = Sentinel()
+
+        def run():
+            s.reset_baselines()
+            for i in range(30):
+                s.observe("a", 0.01 + 0.001 * (i % 3))
+                s.observe("b", 0.5)
+            return s.snapshot()
+
+        assert run() == run()
+
+    def test_selective_reset_keeps_other_signals(self):
+        s = Sentinel()
+        for _ in range(3):
+            s.observe("keep", 0.01)
+            s.observe("drop", 0.01)
+        s.reset_baselines(signals=["drop"])
+        snap = s.snapshot()
+        assert "keep" in snap["signals"]
+        assert "drop" not in snap["signals"]
+
+    def test_module_wrappers_hit_the_shared_instance(self):
+        sentinel.observe("modsig", 0.02)
+        assert "modsig" in sentinel.snapshot()["signals"]
+        checkpoint = sentinel.reset_baselines()
+        assert "modsig" in checkpoint["signals"]
+        assert sentinel.snapshot()["signals"] == {}
+
+    def test_readyz_mirrors_shared_snapshot(self):
+        from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
+        from karpenter_tpu.kube.client import KubeClient
+        from karpenter_tpu.operator.operator import Operator
+        from karpenter_tpu.operator.options import Options
+        from karpenter_tpu.testing import mk_nodepool
+
+        sentinel.reset()
+        kube = KubeClient()
+        op = Operator(kube=kube, cloud_provider=KwokCloudProvider(kube),
+                      options=Options())
+        kube.create(mk_nodepool("default"))
+        op.step(now=1_700_000_000.0)
+        block = op.readyz()["sentinel"]
+        assert block == sentinel.snapshot()
+        assert "tick_wall" in block["signals"]

@@ -1,0 +1,108 @@
+"""Scenario-flywheel soak + judge (ISSUE 18): the tier-1 smoke trace
+replays byte-identically (same spec + seed => same schedule digest AND
+same judge report digest), a calm replay PASSES every observability
+plane, and an injected latency fault flips the verdict to FAIL through
+the tick-latency SLO — the sensitivity control proving the judge is
+wired to the planes, not rubber-stamping. The multi-hour flywheel
+trace rides behind the `slow` marker."""
+
+import dataclasses
+import os
+
+import pytest
+
+from karpenter_tpu.scenarios import flywheel_spec, run_soak, smoke_spec
+from karpenter_tpu.solver import faults
+
+pytestmark = pytest.mark.soak_chaos
+
+
+@pytest.fixture(autouse=True)
+def clean_soak_env(monkeypatch):
+    """run_soak pins and restores its own environment; this guards the
+    AMBIENT side — a fault spec or reactive override exported by the
+    surrounding shell must not leak into the soak's determinism."""
+    for key in ("KARPENTER_FAULTS", "KARPENTER_FAULT_SEED",
+                "KARPENTER_REACTIVE"):
+        monkeypatch.delenv(key, raising=False)
+    faults.reset()
+    yield
+    faults.reset()
+
+
+class TestSmokeSoak:
+    def test_calm_replay_passes_and_is_byte_identical(self):
+        """The acceptance gate: two soaks of the same spec + seed in
+        one process agree on the schedule digest AND the full judge
+        report digest, and a calm trace passes every plane."""
+        first = run_soak(smoke_spec())
+        second = run_soak(smoke_spec())
+
+        assert first["pass"], first["failures"]
+        assert first["failures"] == []
+        assert (first["schedule_digest"]
+                == second["schedule_digest"])
+        assert first["report_digest"] == second["report_digest"]
+
+        obs = first["observations"]
+        # the trace actually exercised the operator: events landed,
+        # ticks ran, the spot storm fired, and the fleet converged
+        assert obs["events_applied"]["create"] > 20
+        assert obs["ticks"] > 20
+        assert obs["fault_log_len"] > 0
+        assert "spot_interruption" in obs["fault_kinds"]
+        assert obs["leaks"] == []
+
+    def test_calm_verdict_planes_and_gauge(self):
+        from karpenter_tpu.metrics.store import SOAK_VERDICT
+
+        report = run_soak(smoke_spec())
+        assert set(report["planes"]) == {
+            "slo", "sentinel", "oracle", "explain", "leaks",
+        }
+        for name, plane in report["planes"].items():
+            assert plane["pass"], (name, plane)
+        assert report["planes"]["slo"]["budget_exhausted"] == []
+        # the verdict gauge carries the last judgement per scenario
+        assert SOAK_VERDICT.series()[
+            (("scenario", "smoke_flywheel"),)
+        ] == 1.0
+
+    def test_injected_latency_fault_fails_through_slo(self):
+        """Sensitivity control: a 2s exec delay at the always-fired
+        crash_tick site burns the 1s tick-latency budget every tick —
+        the judge must FAIL and name the slo plane (the sentinel
+        trips on the same latency step)."""
+        spec = dataclasses.replace(
+            smoke_spec(),
+            name="smoke_flywheel_injected",
+            faults=("exec_delay@crash_tick:*=2s#lag",),
+        )
+        report = run_soak(spec)
+        assert not report["pass"]
+        assert "slo" in report["failures"]
+        slo = report["planes"]["slo"]
+        assert "tick_latency" in slo["budget_exhausted"]
+        assert slo["whole_run_burn"]["tick_latency"] >= 1.0
+        assert slo["burn_minutes"]["tick_latency"] > 0.0
+
+    def test_soak_restores_ambient_environment(self):
+        os.environ["KARPENTER_FAULT_SEED"] = "999"
+        try:
+            run_soak(smoke_spec(duration_s=40.0))
+            assert os.environ["KARPENTER_FAULT_SEED"] == "999"
+            assert "KARPENTER_FAULTS" not in os.environ
+        finally:
+            os.environ.pop("KARPENTER_FAULT_SEED", None)
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(
+    not os.environ.get("KARPENTER_PERF_TESTS"),
+    reason="multi-hour virtual trace; set KARPENTER_PERF_TESTS=1",
+)
+class TestFlywheelSoak:
+    def test_full_flywheel_trace_passes(self):
+        report = run_soak(flywheel_spec())
+        assert report["pass"], report["failures"]
+        assert report["observations"]["virtual_seconds"] > 14400

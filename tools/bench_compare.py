@@ -14,7 +14,13 @@ Compares, per scenario present in BOTH artifacts' detail:
   delta (--mem-tolerance, null-tolerant on either side);
 - the live_operator block's tick and disruption-scan walls (ISSUE 15),
   relative like the wall keys but null-tolerant like the gap keys (a
-  side without the live arm reports loudly, never gates).
+  side without the live arm reports loudly, never gates);
+- the soak_flywheel verdict block (ISSUE 18): a FAILING current
+  verdict always gates (the soak is deterministic, so a FAIL is a
+  real regression, not jitter), a pass->fail flip gates, per-SLI
+  burn-minutes gate by absolute delta (--soak-burn-tolerance) and the
+  verdict-histogram distance by absolute delta (--soak-dist-tolerance);
+  a side missing the arm reports loudly, never gates.
 
 Exit codes: 0 = no regression past the threshold, 1 = at least one
 regression, 2 = an artifact could not be parsed. A regression is a
@@ -92,6 +98,17 @@ DEVICE_MEM_KEYS = {
     "compiled_peak_temp_mb": "compiled_scope",
     "device_peak_in_use_mb": "device_scope",
 }
+# the scenario-flywheel soak verdict block (ISSUE 18): nested under a
+# scenario as `soak` (the soak_flywheel bench arm). Gated
+# null-tolerant-but-LOUD like LATENCY_KEYS — a side without the arm is
+# reported, never gated — but the verdict itself is binary: a current
+# run whose judge FAILED gates even with no baseline at all, and a
+# pass -> fail flip gates regardless of any tolerance. burn-minutes
+# per SLI gate on absolute delta (--soak-burn-tolerance, minutes of
+# error budget — the soak is deterministic, so the tolerance absorbs
+# intended spec growth, not noise), the verdict-histogram distance on
+# absolute delta (--soak-dist-tolerance)
+SOAK_BLOCK = "soak"
 
 
 def load_detail(path: str) -> dict:
@@ -229,9 +246,104 @@ def _compare_mem(name: str, b: dict, c: dict, mem_tolerance: float,
             lines.append("  " + tag)
 
 
+def _compare_soak(name: str, b: dict, c: dict, burn_tolerance: float,
+                  dist_tolerance: float, lines: list[str],
+                  regressions: list[str]) -> None:
+    """Gate the soak_flywheel judge verdict (ISSUE 18). The soak is
+    fully deterministic (trace + faults + injected clock all seeded),
+    so unlike the wall gates there is no jitter to absorb: a FAILING
+    current verdict gates unconditionally, a pass->fail flip gates,
+    and the burn/distance tolerances exist only to let intentional
+    spec growth through without a baseline refresh."""
+    bs = b.get(SOAK_BLOCK) if isinstance(b.get(SOAK_BLOCK), dict) else None
+    cs = c.get(SOAK_BLOCK) if isinstance(c.get(SOAK_BLOCK), dict) else None
+    if bs is None and cs is None:
+        return
+    if cs is None:
+        lines.append(
+            f"  {name}.soak: verdict -> null "
+            "(soak arm unavailable; not gated)"
+        )
+        return
+    cur_pass = cs.get("pass")
+    failures = ", ".join(cs.get("failures") or ()) or "unknown plane"
+    if cur_pass is False:
+        # the judge already named the failing plane; no baseline needed
+        regressions.append(
+            f"{name}.soak: judge verdict FAIL ({failures})"
+        )
+    if bs is None:
+        lines.append(
+            f"  {name}.soak: null -> "
+            f"{'pass' if cur_pass else 'FAIL'} (new arm; verdict-only gate)"
+        )
+        return
+    if bs.get("pass") is True and cur_pass is False:
+        regressions.append(
+            f"{name}.soak: verdict pass -> FAIL ({failures})"
+        )
+    elif bs.get("pass") != cur_pass:
+        lines.append(
+            f"  {name}.soak: verdict "
+            f"{'pass' if bs.get('pass') else 'FAIL'} -> "
+            f"{'pass' if cur_pass else 'FAIL'}"
+        )
+    bb = bs.get("burn_minutes") if isinstance(
+        bs.get("burn_minutes"), dict) else {}
+    cb = cs.get("burn_minutes") if isinstance(
+        cs.get("burn_minutes"), dict) else {}
+    for sli in sorted(set(bb) | set(cb)):
+        bv, cv = bb.get(sli), cb.get(sli)
+        if not isinstance(bv, (int, float)):
+            if isinstance(cv, (int, float)) and cv > 0:
+                lines.append(
+                    f"  {name}.soak.burn_minutes.{sli}: null -> "
+                    f"{cv:.2f}min (new SLI; not gated)"
+                )
+            continue
+        if not isinstance(cv, (int, float)):
+            lines.append(
+                f"  {name}.soak.burn_minutes.{sli}: {bv:.2f}min -> null "
+                "(SLI unavailable; not gated)"
+            )
+            continue
+        delta = cv - bv
+        tag = (
+            f"{name}.soak.burn_minutes.{sli}: {bv:.2f}min -> "
+            f"{cv:.2f}min ({delta:+.2f}min abs)"
+        )
+        if delta > burn_tolerance:
+            regressions.append(tag)
+        elif bv or cv:
+            lines.append("  " + tag)
+    bv = bs.get("verdict_histogram_distance")
+    cv = cs.get("verdict_histogram_distance")
+    if isinstance(bv, (int, float)) and not isinstance(cv, (int, float)):
+        lines.append(
+            f"  {name}.soak.verdict_histogram_distance: {bv:.4f} -> "
+            "null (no expectation envelope; not gated)"
+        )
+    elif not isinstance(bv, (int, float)) and isinstance(cv, (int, float)):
+        lines.append(
+            f"  {name}.soak.verdict_histogram_distance: null -> "
+            f"{cv:.4f} (new key; not gated)"
+        )
+    elif isinstance(bv, (int, float)) and isinstance(cv, (int, float)):
+        delta = cv - bv
+        tag = (
+            f"{name}.soak.verdict_histogram_distance: {bv:.4f} -> "
+            f"{cv:.4f} ({delta:+.4f} abs)"
+        )
+        if delta > dist_tolerance:
+            regressions.append(tag)
+        else:
+            lines.append("  " + tag)
+
+
 def compare(
     base: dict, cur: dict, threshold: float, scenarios=None,
     gap_tolerance: float = 0.01, mem_tolerance: float = 512.0,
+    soak_burn_tolerance: float = 1.0, soak_dist_tolerance: float = 0.1,
 ) -> tuple[list[str], list[str]]:
     """-> (report lines, regression lines). A regression is a wall
     increase or pods/sec decrease past `threshold` relative change, a
@@ -384,6 +496,19 @@ def compare(
             else:
                 lines.append("  " + tag)
         _compare_mem(name, b, c, mem_tolerance, lines, regressions)
+        _compare_soak(name, b, c, soak_burn_tolerance,
+                      soak_dist_tolerance, lines, regressions)
+    # a current-only scenario is normally skipped (a new arm is not a
+    # regression), but a soak verdict is a pass/fail judgement, not a
+    # comparison — a FAILING judge gates even without any baseline
+    for name in sorted(set(cur) - set(base)):
+        c = cur[name]
+        if not isinstance(c, dict) or "error" in c:
+            continue
+        if scenarios and name not in scenarios:
+            continue
+        _compare_soak(name, {}, c, soak_burn_tolerance,
+                      soak_dist_tolerance, lines, regressions)
     return lines, regressions
 
 
@@ -418,6 +543,19 @@ def main(argv=None) -> int:
         "--gap-tolerance, null-tolerant on either side)",
     )
     parser.add_argument(
+        "--soak-burn-tolerance", type=float, default=1.0,
+        help="absolute per-SLI error-budget burn increase in minutes "
+        "allowed before the soak gate fires (default 1.0; the soak is "
+        "deterministic, so the knob absorbs intended scenario growth, "
+        "not noise)",
+    )
+    parser.add_argument(
+        "--soak-dist-tolerance", type=float, default=0.1,
+        help="absolute verdict-histogram distance increase allowed "
+        "before the soak gate fires (default 0.1 of total-variation "
+        "distance against the spec's expectation envelope)",
+    )
+    parser.add_argument(
         "--quiet", action="store_true",
         help="print regressions only",
     )
@@ -436,6 +574,8 @@ def main(argv=None) -> int:
         base, cur, args.threshold, wanted,
         gap_tolerance=args.gap_tolerance,
         mem_tolerance=args.mem_tolerance,
+        soak_burn_tolerance=args.soak_burn_tolerance,
+        soak_dist_tolerance=args.soak_dist_tolerance,
     )
     if not args.quiet and lines:
         print("compared (within threshold):")

@@ -1,89 +1,217 @@
-"""Randomized convergence soak (not part of the CI suite).
+"""Soak CLIs: the deterministic scenario flywheel, plus the legacy
+randomized convergence soak (neither is part of the tier-1 CI suite).
 
-Drives a full Operator through thousands of ticks of adversarial churn
-(pod create/delete, PDB flap, pool-template drift, provider ICE
-injection), then drains with no faults and requires TOTAL convergence:
-zero unbound pods, zero deleting claims, zero stale disrupted taints,
-an empty orchestration queue, and claims == provider instances.
+Flywheel mode (default) replays a composed scenario trace against the
+full reactive Operator under accelerated injected time and exits with
+the judge's verdict — byte-identical across runs of the same
+spec + seed (karpenter_tpu/scenarios/):
 
-    PYTHONPATH=. JAX_PLATFORMS=cpu python tools/soak.py <seed> \
-        <churn_wall_seconds> <drain_wall_seconds>
+    PYTHONPATH=. JAX_PLATFORMS=cpu python tools/soak.py \
+        [--spec smoke|flywheel] [--seed N] [--duration SECONDS] \
+        [--faults EXTRA_FAULT_ENTRIES] [--out report.json]
 
-Round-5 findings fixed via this harness: the emptiness-eats-replacement
-livelock, deleting-object requeue wedges, the pending-pod backstop, and
-the planned-placement binding hold (plans must be HELD until the
-drained pods actually come free — dropping them while pods were still
-bound pre-eviction made every drain re-solve from scratch and
-oscillate). Seeds 7/11/23/42 all drain to total convergence at full
-scale.
+Exit code 0 when the judge passes, 1 when any observability plane
+fails (the report names the failing planes), 2 on usage errors.
+`--faults` appends raw KARPENTER_FAULTS entries to the composed spec —
+the regression-injection knob (e.g. `exec_delay@crash_tick:*=2s#lag`
+burns the tick-latency SLO and must flip the verdict to FAIL).
+
+Legacy mode is the original randomized wall-clock churn soak (seeded
+random pod churn, PDB flap, pool drift, ICE injection, then fault-free
+drain to TOTAL convergence):
+
+    PYTHONPATH=. JAX_PLATFORMS=cpu python tools/soak.py legacy \
+        <seed> <churn_wall_seconds> <drain_wall_seconds>
+
+Round-5 findings fixed via the legacy harness: the
+emptiness-eats-replacement livelock, deleting-object requeue wedges,
+the pending-pod backstop, and the planned-placement binding hold.
+Seeds 7/11/23/42 all drain to total convergence at full scale.
 """
 
-import random, sys, time
-from karpenter_tpu.cloudprovider.fake import GIB, make_instance_type
-from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
-from karpenter_tpu.cloudprovider.types import InsufficientCapacityError
-from karpenter_tpu.kube.client import KubeClient
-from karpenter_tpu.operator.operator import Operator
-from karpenter_tpu.testing import mk_nodepool, mk_pod
-from karpenter_tpu.kube.objects import (LabelSelector, ObjectMeta,
-    PodDisruptionBudget, PodDisruptionBudgetSpec)
+import argparse
+import dataclasses
+import json
+import sys
 
-seed = int(sys.argv[1]); budget = float(sys.argv[2]); drain_budget = float(sys.argv[3])
-rng = random.Random(seed)
-kube = KubeClient()
-types = [make_instance_type("c2", cpu=2, memory=8*GIB, price=2.0),
-         make_instance_type("c4", cpu=4, memory=16*GIB, price=3.0),
-         make_instance_type("c8", cpu=8, memory=32*GIB, price=5.0)]
-cloud = KwokCloudProvider(kube, types=types)
-op = Operator(kube, cloud)
-pool = mk_nodepool("default")
-pool.spec.disruption.consolidate_after = "30s"
-kube.create(pool)
-now = time.time(); pdb = None; created = 0; start = time.time()
-for tick in range(6000):
-    if time.time() - start > budget: break
-    now += rng.choice([1.0, 2.0, 11.0])
-    r = rng.random()
-    if r < 0.30:
-        created += 1
-        kube.create(mk_pod(name=f"w-{created}", cpu=rng.choice([0.3,0.5,1.0,1.9,3.5]),
-                           labels={"app": rng.choice(["a","b","c"])}))
-    elif r < 0.50:
-        live = [p for p in kube.pods() if not p.is_terminal() and p.metadata.deletion_timestamp is None]
-        if live: kube.delete(rng.choice(live))
-    elif r < 0.55:
-        if pdb is None:
-            pdb = PodDisruptionBudget(metadata=ObjectMeta(name="pdb"),
-                spec=PodDisruptionBudgetSpec(selector=LabelSelector.of({"app": "a"}),
-                                             max_unavailable=rng.choice([0,1])))
-            kube.create(pdb)
-        else:
-            kube.delete(pdb); pdb = None
-    elif r < 0.58:
-        pool.spec.template.labels["rev"] = str(tick); kube.touch(pool)
-    elif r < 0.62:
-        cloud.next_create_error = InsufficientCapacityError("flaky zone")
-    op.step(now=now)
-if pdb is not None: kube.delete(pdb)
-converged = None
-drain_start = time.time()
-i = -1
-for i in range(3000):
-    if time.time() - drain_start > drain_budget: break
-    now += 11; op.step(now=now)
-    live = [p for p in kube.pods() if not p.is_terminal() and p.metadata.deletion_timestamp is None]
-    unbound = [p for p in live if not p.spec.node_name]
-    deleting = [c for c in kube.node_claims() if c.metadata.deletion_timestamp is not None]
-    tainted = [n for n in kube.nodes()
-               if any(t.key == "karpenter.sh/disrupted" for t in n.spec.taints)
-               and n.metadata.deletion_timestamp is None]
-    if not unbound and not deleting and not tainted and not op.disruption.queue.active:
-        converged = i; break
-ok = converged is not None and len(kube.node_claims()) == len(cloud.list())
-print(f"seed={seed} ticks={tick} drain_ticks={i} converged_at={converged} claims={len(kube.node_claims())} instances={len(cloud.list())} {'OK' if ok else 'FAIL'}")
-if not ok:
-    live = [p for p in kube.pods() if not p.is_terminal() and p.metadata.deletion_timestamp is None]
-    print("unbound:", [p.metadata.name for p in live if not p.spec.node_name][:5])
-    print("deleting:", [c.metadata.name for c in kube.node_claims() if c.metadata.deletion_timestamp is not None][:5])
-    print("queue:", [(c.reason, round(now-c.started_at)) for c in op.disruption.queue.active])
-sys.exit(0 if ok else 1)
+
+def flywheel_main(argv) -> int:
+    parser = argparse.ArgumentParser(
+        prog="soak.py", description="deterministic scenario-flywheel soak"
+    )
+    parser.add_argument("--spec", choices=("smoke", "flywheel"),
+                        default="flywheel",
+                        help="scenario preset (default: flywheel)")
+    parser.add_argument("--seed", type=int, default=None,
+                        help="override the preset's seed")
+    parser.add_argument("--duration", type=float, default=None,
+                        help="override the trace horizon, virtual seconds")
+    parser.add_argument("--faults", default=None,
+                        help="extra KARPENTER_FAULTS entries appended to "
+                             "the composed spec (comma-separated)")
+    parser.add_argument("--out", default=None,
+                        help="write the full verdict artifact here (JSON)")
+    args = parser.parse_args(argv)
+
+    from karpenter_tpu.scenarios import flywheel_spec, run_soak, smoke_spec
+
+    preset = smoke_spec if args.spec == "smoke" else flywheel_spec
+    kwargs = {}
+    if args.seed is not None:
+        kwargs["seed"] = args.seed
+    if args.duration is not None:
+        kwargs["duration_s"] = args.duration
+    spec = preset(**kwargs)
+    if args.faults:
+        extra = tuple(e.strip() for e in args.faults.split(",") if e.strip())
+        spec = dataclasses.replace(
+            spec, name=spec.name + "_injected",
+            faults=spec.faults + extra,
+        )
+
+    report = run_soak(spec)
+    if args.out:
+        with open(args.out, "w") as fh:
+            json.dump(report, fh, indent=1, sort_keys=True)
+            fh.write("\n")
+    planes = report["planes"]
+    print(f"scenario={report['scenario']} seed={report['seed']} "
+          f"digest={report['report_digest'][:16]} "
+          f"{'PASS' if report['pass'] else 'FAIL'}")
+    for name in sorted(planes):
+        plane = planes[name]
+        print(f"  {name}: {'pass' if plane['pass'] else 'FAIL'}")
+    if not report["pass"]:
+        print("failing planes:", ", ".join(report["failures"]))
+        slo = planes["slo"]
+        if slo["budget_exhausted"]:
+            print("  slo budget exhausted:",
+                  ", ".join(slo["budget_exhausted"]),
+                  "burn:", slo["whole_run_burn"])
+        if planes["leaks"]["leaks"]:
+            print("  leaks:", "; ".join(planes["leaks"]["leaks"]))
+    return 0 if report["pass"] else 1
+
+
+def legacy_main(argv) -> int:
+    import random
+    import time
+
+    from karpenter_tpu.cloudprovider.fake import GIB, make_instance_type
+    from karpenter_tpu.cloudprovider.kwok import KwokCloudProvider
+    from karpenter_tpu.cloudprovider.types import InsufficientCapacityError
+    from karpenter_tpu.kube.client import KubeClient
+    from karpenter_tpu.kube.objects import (
+        LabelSelector,
+        ObjectMeta,
+        PodDisruptionBudget,
+        PodDisruptionBudgetSpec,
+    )
+    from karpenter_tpu.operator.operator import Operator
+    from karpenter_tpu.testing import mk_nodepool, mk_pod
+
+    seed = int(argv[0])
+    budget = float(argv[1])
+    drain_budget = float(argv[2])
+    rng = random.Random(seed)
+    kube = KubeClient()
+    types = [make_instance_type("c2", cpu=2, memory=8 * GIB, price=2.0),
+             make_instance_type("c4", cpu=4, memory=16 * GIB, price=3.0),
+             make_instance_type("c8", cpu=8, memory=32 * GIB, price=5.0)]
+    cloud = KwokCloudProvider(kube, types=types)
+    op = Operator(kube, cloud)
+    pool = mk_nodepool("default")
+    pool.spec.disruption.consolidate_after = "30s"
+    kube.create(pool)
+    now = time.time()
+    pdb = None
+    created = 0
+    start = time.time()
+    tick = 0
+    for tick in range(6000):
+        if time.time() - start > budget:
+            break
+        now += rng.choice([1.0, 2.0, 11.0])
+        r = rng.random()
+        if r < 0.30:
+            created += 1
+            kube.create(mk_pod(
+                name=f"w-{created}",
+                cpu=rng.choice([0.3, 0.5, 1.0, 1.9, 3.5]),
+                labels={"app": rng.choice(["a", "b", "c"])},
+            ))
+        elif r < 0.50:
+            live = [p for p in kube.pods() if not p.is_terminal()
+                    and p.metadata.deletion_timestamp is None]
+            if live:
+                kube.delete(rng.choice(live))
+        elif r < 0.55:
+            if pdb is None:
+                pdb = PodDisruptionBudget(
+                    metadata=ObjectMeta(name="pdb"),
+                    spec=PodDisruptionBudgetSpec(
+                        selector=LabelSelector.of({"app": "a"}),
+                        max_unavailable=rng.choice([0, 1]),
+                    ),
+                )
+                kube.create(pdb)
+            else:
+                kube.delete(pdb)
+                pdb = None
+        elif r < 0.58:
+            pool.spec.template.labels["rev"] = str(tick)
+            kube.touch(pool)
+        elif r < 0.62:
+            cloud.next_create_error = InsufficientCapacityError("flaky zone")
+        op.step(now=now)
+    if pdb is not None:
+        kube.delete(pdb)
+    converged = None
+    drain_start = time.time()
+    i = -1
+    for i in range(3000):
+        if time.time() - drain_start > drain_budget:
+            break
+        now += 11
+        op.step(now=now)
+        live = [p for p in kube.pods() if not p.is_terminal()
+                and p.metadata.deletion_timestamp is None]
+        unbound = [p for p in live if not p.spec.node_name]
+        deleting = [c for c in kube.node_claims()
+                    if c.metadata.deletion_timestamp is not None]
+        tainted = [n for n in kube.nodes()
+                   if any(t.key == "karpenter.sh/disrupted"
+                          for t in n.spec.taints)
+                   and n.metadata.deletion_timestamp is None]
+        if (not unbound and not deleting and not tainted
+                and not op.disruption.queue.active):
+            converged = i
+            break
+    ok = converged is not None and (
+        len(kube.node_claims()) == len(cloud.list())
+    )
+    print(f"seed={seed} ticks={tick} drain_ticks={i} "
+          f"converged_at={converged} claims={len(kube.node_claims())} "
+          f"instances={len(cloud.list())} {'OK' if ok else 'FAIL'}")
+    if not ok:
+        live = [p for p in kube.pods() if not p.is_terminal()
+                and p.metadata.deletion_timestamp is None]
+        print("unbound:",
+              [p.metadata.name for p in live if not p.spec.node_name][:5])
+        print("deleting:",
+              [c.metadata.name for c in kube.node_claims()
+               if c.metadata.deletion_timestamp is not None][:5])
+        print("queue:", [(c.reason, round(now - c.started_at))
+                         for c in op.disruption.queue.active])
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    argv = sys.argv[1:]
+    if argv and argv[0] == "legacy":
+        if len(argv) != 4:
+            print("usage: soak.py legacy <seed> <churn_wall_seconds> "
+                  "<drain_wall_seconds>", file=sys.stderr)
+            sys.exit(2)
+        sys.exit(legacy_main(argv[1:]))
+    sys.exit(flywheel_main(argv))
